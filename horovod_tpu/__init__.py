@@ -1,0 +1,43 @@
+"""horovod_tpu: a TPU-native distributed training framework.
+
+Same capabilities as Horovod (the reference at yhlim5221/horovod), re-designed
+for TPU: XLA collectives over the ICI mesh instead of NCCL/MPI, jit compile
+caching instead of coordinator negotiation, ``jax.distributed`` bootstrap
+instead of Gloo HTTP-KV rendezvous.
+
+Typical use mirrors ``import horovod.torch as hvd``:
+
+    import horovod_tpu as hvd
+    hvd.init()
+    grads = hvd.allreduce(stacked_grads)          # eager, rank-major layout
+    # ... or inside your pjit'd train step:
+    from horovod_tpu.ops import in_jit
+    g = in_jit.allreduce(g, axis_name='hvd')
+"""
+
+from horovod_tpu.version import __version__  # noqa: F401
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
+    local_size, cross_size, process_index, process_count, is_homogeneous,
+    mpi_threads_supported, mpi_enabled, mpi_built, gloo_enabled, gloo_built,
+    nccl_built, ddl_built, ccl_built, cuda_built, rocm_built, xla_built,
+    ici_built, start_timeline, stop_timeline, topology, config,
+)
+from horovod_tpu.common.exceptions import (  # noqa: F401
+    HorovodInternalError, HostsUpdatedInterrupt, NotInitializedError,
+)
+from horovod_tpu.common.process_sets import (  # noqa: F401
+    ProcessSet, global_process_set, add_process_set, remove_process_set,
+    process_set_by_id, process_sets,
+)
+from horovod_tpu.ops.collective_ops import (  # noqa: F401
+    ReduceOp, Average, Sum, Adasum, Min, Max, Product,
+    allreduce, grouped_allreduce, allgather, grouped_allgather,
+    allgather_ragged, broadcast, grouped_broadcast, reducescatter,
+    grouped_reducescatter, alltoall, barrier, join,
+    allreduce_async, grouped_allreduce_async, allgather_async,
+    broadcast_async, alltoall_async, reducescatter_async,
+    poll, synchronize, Handle, broadcast_object, allgather_object,
+)
+from horovod_tpu.ops import in_jit  # noqa: F401

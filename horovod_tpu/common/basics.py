@@ -1,0 +1,261 @@
+"""Global runtime state and the core init/query API.
+
+TPU-native equivalent of the reference's ``HorovodBasics`` ctypes wrapper +
+C API (reference: horovod/common/basics.py:29-505 and
+horovod/common/operations.cc:934-1449 ``horovod_init``/``horovod_rank``/...).
+
+Key semantic shift: the reference binds one OS process to one accelerator, so
+``rank()`` is "my process". On TPU a single controller process typically owns
+many chips, so a *rank is a chip* (a position in the global mesh). For each
+process:
+
+- ``size()``/``local_size()``/``cross_size()`` describe the global chip mesh,
+- ``rank()`` is the first chip this process owns (0 on a single controller),
+- ``process_index()``/``process_count()`` expose the host-level view.
+
+There is no background negotiation thread: jitted collectives need no per-step
+negotiation (the compile cache keyed on tensor signatures plays the reference's
+response-cache role, reference: horovod/common/response_cache.h:45), and the
+eager path's bucketing runtime lives in :mod:`horovod_tpu.ops.fusion`.
+"""
+
+import atexit
+import os
+import threading
+
+import jax
+
+from horovod_tpu.common import logging as hvd_logging
+from horovod_tpu.common.config import Config
+from horovod_tpu.common.exceptions import NotInitializedError
+from horovod_tpu.common.topology import build_topology
+
+_lock = threading.RLock()
+_state = None
+
+
+def _distributed_client_active():
+    try:
+        if hasattr(jax.distributed, "is_initialized"):
+            return jax.distributed.is_initialized()
+        from jax._src import distributed as _dist
+        return _dist.global_state.client is not None
+    except Exception:
+        return False
+
+
+class _State:
+    def __init__(self, topology, config):
+        self.topology = topology
+        self.config = config
+        self.process_set_table = None   # set by process_sets module
+        self.timeline = None            # set lazily by timeline module
+        self.fusion = None              # set lazily by ops.fusion
+        self.parameter_manager = None   # set lazily by autotune
+        self.joined_ranks = set()       # ranks that called join()
+        self.shutdown_called = False
+
+
+def init(comm=None, process_sets=None, devices=None):
+    """Initialize Horovod-TPU.
+
+    Mirrors ``hvd.init(comm, process_sets)`` (reference: basics.py:51-148).
+    ``comm`` is accepted for API compatibility; rank subsets should use
+    ``process_sets`` / :class:`horovod_tpu.ProcessSet` instead.
+
+    In a multi-host launch (``hvdrun``), ``jax.distributed`` bootstrap replaces
+    the reference's Gloo HTTP-KV rendezvous (reference:
+    horovod/common/gloo/gloo_context.cc:160-230): the launcher exports
+    ``HOROVOD_COORDINATOR_ADDR/PORT`` + ``HOROVOD_CROSS_RANK/CROSS_SIZE`` and we
+    call ``jax.distributed.initialize`` here.
+    """
+    global _state
+    with _lock:
+        if _state is not None:
+            return
+        config = Config.from_env()
+
+        # Decide on distributed bootstrap from the env alone: probing
+        # jax.process_count() here would initialize the local backend and
+        # forbid jax.distributed.initialize afterwards.
+        if config.coordinator_addr and config.cross_size > 1 \
+                and not _distributed_client_active():
+            jax.distributed.initialize(
+                coordinator_address=(
+                    f"{config.coordinator_addr}:{config.coordinator_port}"),
+                num_processes=config.cross_size,
+                process_id=config.cross_rank,
+            )
+
+        topology = build_topology(devices)
+        _state = _State(topology, config)
+
+        from horovod_tpu.common import process_sets as ps
+        ps._init_table(_state, process_sets)
+
+        if config.timeline_filename:
+            start_timeline(config.timeline_filename,
+                           mark_cycles=config.timeline_mark_cycles)
+
+        hvd_logging.info(
+            "horovod_tpu initialized: size=%d local_size=%d cross_size=%d",
+            topology.size, topology.local_size, topology.cross_size)
+        atexit.register(shutdown)
+
+
+def shutdown():
+    """Finalize: flush pending fused collectives and the timeline
+    (reference: horovod_shutdown, operations.cc:1006-1013)."""
+    global _state
+    with _lock:
+        if _state is None or _state.shutdown_called:
+            return
+        _state.shutdown_called = True
+        if _state.fusion is not None:
+            try:
+                _state.fusion.flush_all()
+            except Exception as e:  # pragma: no cover
+                hvd_logging.warning("flush on shutdown failed: %s", e)
+        if _state.timeline is not None:
+            _state.timeline.close()
+        _state = None
+
+
+def is_initialized():
+    """reference: horovod_is_initialized (operations.cc:1027)."""
+    return _state is not None
+
+
+def _get_state():
+    if _state is None:
+        raise NotInitializedError()
+    return _state
+
+
+def topology():
+    return _get_state().topology
+
+
+def config():
+    return _get_state().config
+
+
+# --- rank / size queries (reference: operations.cc:1119-1229) ---
+
+def size():
+    return _get_state().topology.size
+
+
+def local_size():
+    return _get_state().topology.local_size
+
+
+def cross_size():
+    return _get_state().topology.cross_size
+
+
+def rank():
+    t = _get_state().topology
+    return t.local_device_ranks[0] if t.local_device_ranks else 0
+
+
+def local_rank():
+    t = _get_state().topology
+    return rank() % t.local_size
+
+
+def cross_rank():
+    t = _get_state().topology
+    return rank() // t.local_size
+
+
+def process_index():
+    return _get_state().topology.process_index
+
+
+def process_count():
+    return jax.process_count()
+
+
+def is_homogeneous():
+    """TPU slices are homogeneous by construction
+    (reference: horovod_is_homogeneous, operations.cc:1233)."""
+    _get_state()
+    return True
+
+
+# --- build-capability queries (reference: operations.cc:1307-1449).
+# These exist so code written against the reference API keeps working; the
+# honest answers for a TPU runtime are below.
+
+def mpi_threads_supported():
+    return False
+
+
+def mpi_enabled():
+    return False
+
+
+def mpi_built():
+    return False
+
+
+def gloo_enabled():
+    return False
+
+
+def gloo_built():
+    return False
+
+
+def nccl_built():
+    return 0
+
+
+def ddl_built():
+    return False
+
+
+def ccl_built():
+    return False
+
+
+def cuda_built():
+    return False
+
+
+def rocm_built():
+    return False
+
+
+def xla_built():
+    """The entire data plane is XLA on this framework."""
+    return True
+
+
+def ici_built():
+    """TPU inter-chip-interconnect collectives available."""
+    return True
+
+
+# --- timeline control (reference: horovod_start_timeline, operations.cc:1079) ---
+
+def start_timeline(file_path, mark_cycles=False):
+    st = _get_state()
+    from horovod_tpu.timeline import Timeline
+    if st.timeline is not None:
+        st.timeline.close()
+    st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
+    return st.timeline
+
+
+def stop_timeline():
+    st = _get_state()
+    if st.timeline is not None:
+        st.timeline.close()
+        st.timeline = None
+
+
+def timeline():
+    st = _state
+    return st.timeline if st is not None else None

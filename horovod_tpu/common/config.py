@@ -1,0 +1,146 @@
+"""Runtime configuration knobs.
+
+The reference core reads ~30 ``HOROVOD_*`` environment variables at background-thread
+start (reference: horovod/common/operations.cc:456-646, full knob list
+horovod/common/common.h:115-149). This module is the TPU-native mirror: every knob is
+an attribute of :class:`Config`, populated from the same environment variable names so
+launcher-side ``config_parser.set_env_from_args`` semantics carry over unchanged.
+
+Knobs that only make sense for the CUDA/NCCL runtime (num NCCL streams, GPU ops
+selection) are accepted-and-ignored for compatibility; TPU-specific knobs are added
+under the same naming convention.
+"""
+
+import dataclasses
+import os
+
+
+def _env_bool(name, default=False):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    return v.lower() in ("1", "true", "yes", "on")
+
+
+def _env_int(name, default):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return int(v)
+    except ValueError:
+        return default
+
+
+def _env_float(name, default):
+    v = os.environ.get(name)
+    if v is None:
+        return default
+    try:
+        return float(v)
+    except ValueError:
+        return default
+
+
+@dataclasses.dataclass
+class Config:
+    # --- fusion / cycle (reference common.h:119-121, operations.cc:515,551) ---
+    # Fusion buffer threshold in bytes; batches small eager tensors into one
+    # fused collective. Reference default 128 MB - ours is 64 MB because XLA
+    # fuses aggressively already and HBM is the scarce resource.
+    fusion_threshold: int = 64 * 1024 * 1024
+    # Background flush cycle in ms for the eager bucketing runtime.
+    cycle_time_ms: float = 1.0
+
+    # --- cache (reference common.h:122-123) ---
+    # Compiled-program cache capacity (the response cache's TPU analog is the
+    # jit cache keyed on the fused tensor-set signature).
+    cache_capacity: int = 1024
+
+    # --- algorithm selection (reference common.h:130-132) ---
+    hierarchical_allreduce: bool = False
+    hierarchical_allgather: bool = False
+    torus_allreduce: bool = False  # fork knob HOROVOD_TORUS_ALLREDUCE (common.h:132)
+
+    # --- autotune (reference common.h:133-138) ---
+    autotune: bool = False
+    autotune_log_file: str = ""
+    autotune_warmup_samples: int = 3
+    autotune_steps_per_sample: int = 10
+    autotune_bayes_opt_max_samples: int = 20
+    autotune_gaussian_process_noise: float = 0.8
+
+    # --- timeline (reference common.h:117-118) ---
+    timeline_filename: str = ""
+    timeline_mark_cycles: bool = False
+
+    # --- stall inspector (reference common.h:124-125) ---
+    stall_check_disable: bool = False
+    stall_check_time_seconds: float = 60.0
+    stall_shutdown_time_seconds: float = 0.0
+
+    # --- elastic / process sets (reference common.h:139-143) ---
+    elastic: bool = False
+    dynamic_process_sets: bool = False
+
+    # --- bootstrap (reference gloo_run.py:203-214 env plumbing) ---
+    rank: int = 0
+    local_rank: int = 0
+    cross_rank: int = 0
+    size: int = -1
+    local_size: int = -1
+    cross_size: int = -1
+    coordinator_addr: str = ""
+    coordinator_port: int = 0
+
+    # --- TPU-specific additions ---
+    # Reduction dtype on the wire for fused gradient buckets ("" = keep dtype).
+    wire_dtype: str = ""
+    # Donate fused buffers to XLA (buffer reuse).
+    donate_buffers: bool = True
+
+    @classmethod
+    def from_env(cls):
+        c = cls()
+        c.fusion_threshold = _env_int("HOROVOD_FUSION_THRESHOLD", c.fusion_threshold)
+        c.cycle_time_ms = _env_float("HOROVOD_CYCLE_TIME", c.cycle_time_ms)
+        c.cache_capacity = _env_int("HOROVOD_CACHE_CAPACITY", c.cache_capacity)
+        c.hierarchical_allreduce = _env_bool("HOROVOD_HIERARCHICAL_ALLREDUCE",
+                                             c.hierarchical_allreduce)
+        c.hierarchical_allgather = _env_bool("HOROVOD_HIERARCHICAL_ALLGATHER",
+                                             c.hierarchical_allgather)
+        c.torus_allreduce = _env_bool("HOROVOD_TORUS_ALLREDUCE", c.torus_allreduce)
+        c.autotune = _env_bool("HOROVOD_AUTOTUNE", c.autotune)
+        c.autotune_log_file = os.environ.get("HOROVOD_AUTOTUNE_LOG", c.autotune_log_file)
+        c.autotune_warmup_samples = _env_int("HOROVOD_AUTOTUNE_WARMUP_SAMPLES",
+                                             c.autotune_warmup_samples)
+        c.autotune_steps_per_sample = _env_int("HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE",
+                                               c.autotune_steps_per_sample)
+        c.autotune_bayes_opt_max_samples = _env_int(
+            "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", c.autotune_bayes_opt_max_samples)
+        c.autotune_gaussian_process_noise = _env_float(
+            "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", c.autotune_gaussian_process_noise)
+        c.timeline_filename = os.environ.get("HOROVOD_TIMELINE", c.timeline_filename)
+        c.timeline_mark_cycles = _env_bool("HOROVOD_TIMELINE_MARK_CYCLES",
+                                           c.timeline_mark_cycles)
+        c.stall_check_disable = _env_bool("HOROVOD_STALL_CHECK_DISABLE",
+                                          c.stall_check_disable)
+        c.stall_check_time_seconds = _env_float("HOROVOD_STALL_CHECK_TIME_SECONDS",
+                                                c.stall_check_time_seconds)
+        c.stall_shutdown_time_seconds = _env_float(
+            "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", c.stall_shutdown_time_seconds)
+        c.elastic = _env_bool("HOROVOD_ELASTIC", c.elastic)
+        c.dynamic_process_sets = _env_bool("HOROVOD_DYNAMIC_PROCESS_SETS",
+                                           c.dynamic_process_sets)
+        c.rank = _env_int("HOROVOD_RANK", c.rank)
+        c.local_rank = _env_int("HOROVOD_LOCAL_RANK", c.local_rank)
+        c.cross_rank = _env_int("HOROVOD_CROSS_RANK", c.cross_rank)
+        c.size = _env_int("HOROVOD_SIZE", c.size)
+        c.local_size = _env_int("HOROVOD_LOCAL_SIZE", c.local_size)
+        c.cross_size = _env_int("HOROVOD_CROSS_SIZE", c.cross_size)
+        c.coordinator_addr = os.environ.get("HOROVOD_COORDINATOR_ADDR",
+                                            c.coordinator_addr)
+        c.coordinator_port = _env_int("HOROVOD_COORDINATOR_PORT", c.coordinator_port)
+        c.wire_dtype = os.environ.get("HOROVOD_WIRE_DTYPE", c.wire_dtype)
+        c.donate_buffers = _env_bool("HOROVOD_DONATE_BUFFERS", c.donate_buffers)
+        return c

@@ -1,0 +1,63 @@
+"""Exception types for horovod_tpu.
+
+Parity with reference horovod/common/exceptions.py (HorovodInternalError,
+HorovodVersionMismatchError, HostsUpdatedInterrupt, get_version_mismatch_message).
+"""
+
+
+class HorovodInternalError(RuntimeError):
+    """Internal error raised when a collective routine fails.
+
+    Elastic training recovers from this by restoring the last committed state
+    (reference: horovod/common/exceptions.py:20-24).
+    """
+
+
+class HorovodVersionMismatchError(ImportError):
+    """Raised when the installed framework version doesn't match the one the
+    native extension was built against (reference: exceptions.py:27-37)."""
+
+    def __init__(self, name, version, installed_version):
+        super().__init__(get_version_mismatch_message(name, version, installed_version))
+        self.name = name
+        self.version = version
+        self.installed_version = installed_version
+
+
+def get_version_mismatch_message(name, version, installed_version):
+    return (
+        f'Framework {name} installed with version {installed_version} '
+        f'but found version {version}.\n\n'
+        f'This can result in unexpected behavior including runtime errors.\n'
+        f'Reinstall Horovod-TPU using `pip install --no-cache-dir` to build '
+        f'with the new version.'
+    )
+
+
+class HostsUpdatedInterrupt(RuntimeError):
+    """Raised in elastic mode when the driver notifies workers that the host
+    set changed. The training loop keeps its current (uncommitted) state and
+    re-initializes collectives (reference: exceptions.py:40-50).
+    """
+
+    def __init__(self, skip_sync=False):
+        super().__init__()
+        self.skip_sync = skip_sync
+
+
+class NotInitializedError(RuntimeError):
+    """Raised when a horovod_tpu API is used before hvd.init()."""
+
+    def __init__(self, what="Horovod-TPU"):
+        super().__init__(
+            f'{what} has not been initialized; run hvd.init() first.')
+
+
+class ProcessSetError(ValueError):
+    """Invalid process-set operation (unknown set, duplicate ranks, ...)."""
+
+
+class TensorShapeMismatchError(ValueError):
+    """Ranks submitted mismatched shapes/dtypes for one collective, the moral
+    equivalent of the coordinator's error response
+    (reference: horovod/common/controller.cc ConstructResponse error checks)."""

@@ -1,0 +1,168 @@
+"""Process sets: collectives over subsets of ranks.
+
+Reference semantics (horovod/common/process_set.h:26-180 + horovod/common/
+process_sets.py:18-190): a process set is an ordered subset of global ranks with
+its own communicator; ops carry a ``process_set_id``; sets can be added/removed
+dynamically when ``HOROVOD_DYNAMIC_PROCESS_SETS`` is on.
+
+TPU-native mapping: a process set is a device sub-mesh (eager path,
+:func:`horovod_tpu.common.topology.build_submesh`) or an
+``axis_index_groups`` partition for in-jit collectives — XLA's native notion of
+rank subgroups, so no extra communicator state is needed inside jit.
+"""
+
+import threading
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.exceptions import ProcessSetError
+from horovod_tpu.common.topology import build_submesh
+
+_lock = threading.RLock()
+
+
+class ProcessSet:
+    """An ordered subset of Horovod ranks (reference: process_sets.py:18-77).
+
+    Instantiate with a list of ranks and register via ``hvd.init(process_sets=...)``
+    or ``hvd.add_process_set``.
+    """
+
+    def __init__(self, ranks_or_comm=None):
+        if ranks_or_comm is not None:
+            ranks_or_comm = list(ranks_or_comm)
+        self.ranks = ranks_or_comm  # None = global set
+        self.process_set_id = None
+        self._mesh = None
+
+    def _invalidate(self):
+        self._mesh = None
+
+    @property
+    def mesh(self):
+        """1-D mesh over this set's devices (lazy)."""
+        if self._mesh is None:
+            topo = basics.topology()
+            ranks = self.ranks if self.ranks is not None \
+                else list(range(topo.size))
+            self._mesh = build_submesh(topo, ranks)
+        return self._mesh
+
+    def size(self):
+        if self.process_set_id is None:
+            raise ProcessSetError("Process set is not yet registered.")
+        if self.ranks is None:
+            return basics.size()
+        return len(self.ranks)
+
+    def rank(self):
+        """This process's rank within the set, or -1 if not included
+        (reference: process_sets.py:60-70)."""
+        if self.process_set_id is None:
+            raise ProcessSetError("Process set is not yet registered.")
+        my = basics.rank()
+        ranks = self.ranks if self.ranks is not None \
+            else list(range(basics.size()))
+        try:
+            return ranks.index(my)
+        except ValueError:
+            return -1
+
+    def included(self):
+        return self.rank() >= 0
+
+    def rank_list(self):
+        if self.ranks is None:
+            return list(range(basics.size()))
+        return list(self.ranks)
+
+    def __eq__(self, other):
+        return isinstance(other, ProcessSet) and self.ranks == other.ranks
+
+    def __hash__(self):
+        return hash(tuple(self.ranks) if self.ranks is not None else None)
+
+    def __str__(self):
+        return f"ProcessSet(process_set_id={self.process_set_id}, ranks={self.ranks})"
+
+
+global_process_set = ProcessSet(None)
+global_process_set.process_set_id = 0
+
+
+class _ProcessSetTable:
+    """reference: ProcessSetTable (process_set.h:89-180)."""
+
+    def __init__(self):
+        self.by_id = {0: global_process_set}
+        self.next_id = 1
+
+    def register(self, ps):
+        for existing in self.by_id.values():
+            if existing == ps:
+                ps.process_set_id = existing.process_set_id
+                return existing
+        topo_size = basics.size()
+        if ps.ranks is not None:
+            if len(set(ps.ranks)) != len(ps.ranks):
+                raise ProcessSetError(f"Duplicate ranks in process set: {ps.ranks}")
+            if any(r < 0 or r >= topo_size for r in ps.ranks):
+                raise ProcessSetError(
+                    f"Process set ranks out of range [0,{topo_size}): {ps.ranks}")
+        ps.process_set_id = self.next_id
+        self.next_id += 1
+        self.by_id[ps.process_set_id] = ps
+        return ps
+
+    def remove(self, ps):
+        if ps.process_set_id in (None, 0):
+            raise ProcessSetError("Cannot remove the global process set.")
+        del self.by_id[ps.process_set_id]
+        ps.process_set_id = None
+        ps._invalidate()
+
+
+def _init_table(state, process_sets):
+    table = _ProcessSetTable()
+    state.process_set_table = table
+    global_process_set._invalidate()
+    if process_sets:
+        for ps in process_sets:
+            table.register(ps)
+
+
+def _table():
+    table = basics._get_state().process_set_table
+    if table is None:
+        raise ProcessSetError("Process set table missing (init not complete).")
+    return table
+
+
+def add_process_set(process_set):
+    """Register a new process set at runtime
+    (reference: process_sets.py:101-133, requires HOROVOD_DYNAMIC_PROCESS_SETS).
+
+    Unlike the reference we don't require the dynamic knob: set creation is a
+    host-side-only operation on TPU (sub-meshes are free), so it's always on.
+    """
+    with _lock:
+        if not isinstance(process_set, ProcessSet):
+            process_set = ProcessSet(process_set)
+        return _table().register(process_set)
+
+
+def remove_process_set(process_set):
+    """reference: process_sets.py:136-155."""
+    with _lock:
+        _table().remove(process_set)
+
+
+def process_set_by_id(process_set_id):
+    try:
+        return _table().by_id[process_set_id]
+    except KeyError:
+        raise ProcessSetError(f"Unknown process_set_id {process_set_id}")
+
+
+def process_sets():
+    """id -> ProcessSet mapping (reference: process_sets.py:80-98)."""
+    return dict(_table().by_id)

@@ -1,0 +1,101 @@
+"""Device topology and mesh construction.
+
+The reference derives rank/local_rank/cross_rank from the launcher env and builds
+MPI/Gloo communicators for the global, node-local, and cross-node rings
+(reference: horovod/common/mpi/mpi_context.h, gloo/gloo_context.cc:67-94).
+
+TPU-native replacement: one ``jax.sharding.Mesh`` over all addressable devices.
+A Horovod *rank* is a mesh position (one chip), not an OS process:
+
+- ``hvd`` axis   — flat 1-D axis over all chips; global collectives ride ICI.
+- ``cross``/``local`` axes — 2-D factorization (host × chip-per-host) used by the
+  hierarchical/torus allreduce equivalents, mapping the reference's
+  NCCLHierarchicalAllreduce / NCCLTorusAllreduce two-level strategies
+  (reference: horovod/common/ops/nccl_operations.cc:606-843) onto DCN × ICI.
+
+Multi-process (multi-host) setups get the same mesh via ``jax.distributed``; each
+process contributes its local devices, and rank r owns device ``mesh.devices[r]``.
+"""
+
+import dataclasses
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+HVD_AXIS = "hvd"
+CROSS_AXIS = "cross"
+LOCAL_AXIS = "local"
+
+
+@dataclasses.dataclass
+class Topology:
+    """Resolved topology for one init() call."""
+
+    devices: list                  # all devices, rank-major order
+    mesh: Mesh                     # 1-D mesh, axis ('hvd',)
+    mesh2d: Mesh                   # 2-D mesh, axes ('cross', 'local')
+    size: int                      # number of ranks == number of chips
+    local_size: int                # chips per host
+    cross_size: int                # number of hosts
+    process_index: int             # this process's index (0 in single-controller)
+    local_device_ranks: list       # ranks owned by this process
+
+    def rank_of_device(self, device):
+        return self.devices.index(device)
+
+
+def _sorted_devices(devices):
+    # Rank-major order: group by host (process_index), then stable device order
+    # within the host. This makes local_rank = rank % local_size and
+    # cross_rank = rank // local_size, matching the reference's host-major slot
+    # assignment (reference: horovod/runner/common/util/hosts.py:100
+    # get_host_assignments).
+    return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def build_topology(devices=None):
+    """Build the global topology over all (or the given) devices."""
+    if devices is None:
+        devices = jax.devices()
+    devices = _sorted_devices(list(devices))
+    size = len(devices)
+
+    # Chips per host. On TPU pods every host exposes the same number of chips;
+    # fall back to size (single host) when process information is unavailable.
+    proc_ids = sorted({d.process_index for d in devices})
+    cross_size = len(proc_ids)
+    if size % cross_size != 0:
+        raise ValueError(
+            f"Non-uniform hosts: {size} devices over {cross_size} processes. "
+            f"Horovod-TPU requires the same chip count per host.")
+    local_size = size // cross_size
+
+    dev_array = np.array(devices, dtype=object)
+    mesh = Mesh(dev_array, (HVD_AXIS,))
+    mesh2d = Mesh(dev_array.reshape(cross_size, local_size), (CROSS_AXIS, LOCAL_AXIS))
+
+    process_index = jax.process_index()
+    local_device_ranks = [i for i, d in enumerate(devices)
+                          if d.process_index == process_index]
+
+    return Topology(
+        devices=devices,
+        mesh=mesh,
+        mesh2d=mesh2d,
+        size=size,
+        local_size=local_size,
+        cross_size=cross_size,
+        process_index=process_index,
+        local_device_ranks=local_device_ranks,
+    )
+
+
+def build_submesh(topology, ranks):
+    """A 1-D mesh over a subset of ranks (a process set's communicator).
+
+    Maps the reference's per-process-set communicators
+    (reference: horovod/common/process_set.cc) onto a device sub-mesh.
+    """
+    devs = np.array([topology.devices[r] for r in ranks], dtype=object)
+    return Mesh(devs, (HVD_AXIS,))

@@ -1,0 +1,2 @@
+from horovod_tpu.ops import in_jit  # noqa: F401
+from horovod_tpu.ops.collective_ops import *  # noqa: F401,F403

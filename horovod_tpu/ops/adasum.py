@@ -1,0 +1,58 @@
+"""Adasum: scale-invariant gradient combination.
+
+Reference algorithm (horovod/common/ops/adasum/adasum.h:103+): combine two
+gradient vectors ``a``, ``b`` as
+
+    adasum(a, b) = (1 - a.b / (2*||a||^2)) * a  +  (1 - a.b / (2*||b||^2)) * b
+
+applied pairwise in a recursive-halving-doubling tree (VHDD) so the result is
+invariant to gradient scale and converges like a trust-region method.
+
+TPU-native design: the dot products and norms are tiny reductions XLA fuses
+into the surrounding program, so instead of the reference's hand-rolled MPI
+recursive halving (adasum_mpi.cc) we gather shards over ICI once and run the
+combine tree locally on every chip — identical math, one collective. The
+numerics run in fp32 regardless of input dtype, matching the reference's
+accumulate-in-float behavior for fp16 (adasum.h AVX fp16 paths).
+"""
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def adasum_combine(a, b, eps=1e-30):
+    """Combine two same-shaped gradient tensors (reference: adasum.h:103+)."""
+    af = a.astype(jnp.float32)
+    bf = b.astype(jnp.float32)
+    dot = jnp.sum(af * bf)
+    na = jnp.sum(af * af)
+    nb = jnp.sum(bf * bf)
+    ca = jnp.where(na > eps, 1.0 - dot / (2.0 * jnp.maximum(na, eps)), 1.0)
+    cb = jnp.where(nb > eps, 1.0 - dot / (2.0 * jnp.maximum(nb, eps)), 1.0)
+    return (ca * af + cb * bf).astype(a.dtype)
+
+
+def adasum_tree(tensors):
+    """Pairwise combine a list of tensors in a binary tree, matching the
+    reference's recursive halving-doubling combination order."""
+    level = list(tensors)
+    while len(level) > 1:
+        nxt = []
+        for i in range(0, len(level) - 1, 2):
+            nxt.append(adasum_combine(level[i], level[i + 1]))
+        if len(level) % 2 == 1:
+            nxt.append(level[-1])
+        level = nxt
+    return level[0]
+
+
+def adasum_reduce_shard(x, axis_name, n):
+    """In-shard_map Adasum reduction across ``axis_name``.
+
+    ``x`` is this rank's local slice. Gathers all ranks' slices (one ICI
+    all-gather) and evaluates the combine tree locally; every rank computes the
+    same result, mirroring the allreduce contract of
+    AdasumMPIAllreduceOp (reference: adasum_mpi_operations.cc).
+    """
+    g = lax.all_gather(x, axis_name)  # (n, ...) leading axis = ranks
+    return adasum_tree([g[i] for i in range(n)])

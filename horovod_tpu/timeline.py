@@ -1,0 +1,93 @@
+"""Horovod Timeline: Chrome-tracing profile of collective activity.
+
+Reference (horovod/common/timeline.cc, 678 LoC + docs/timeline.rst): rank 0
+writes a chrome://tracing JSON covering every tensor's NEGOTIATE/QUEUE/MEMCPY/
+NCCL_* phases, fed by a lock-free queue + writer thread, start/stoppable at
+runtime (operations.cc:1079-1111).
+
+TPU-native version: there is no negotiation thread to trace; the phases that
+exist are ENQUEUE (eager call), FUSION (bucketing), COMPILE (first-time jit)
+and EXECUTE (device run, async). Events are buffered in-process and flushed by
+a background writer thread; ``jax.profiler`` XPlane traces cover the
+XLA-internal schedule and can be correlated via the op name strings we emit.
+Cycle markers mirror ``--timeline-mark-cycles`` (reference: timeline.cc
+MarkCycle, operations.cc:759-762).
+"""
+
+import json
+import os
+import queue
+import threading
+import time
+from contextlib import contextmanager
+
+
+class Timeline:
+    def __init__(self, file_path, mark_cycles=False):
+        self.file_path = file_path
+        self.mark_cycles = mark_cycles
+        self._queue = queue.Queue()
+        self._events = []
+        self._closed = False
+        self._t0 = time.perf_counter_ns()
+        self._writer = threading.Thread(target=self._drain, daemon=True)
+        self._writer.start()
+
+    # --- recording -----------------------------------------------------
+    def _now_us(self):
+        return (time.perf_counter_ns() - self._t0) / 1000.0
+
+    def record(self, name, phase, cat, ts_us, dur_us=None, args=None):
+        ev = {"name": name, "ph": phase, "cat": cat, "ts": ts_us,
+              "pid": 0, "tid": threading.get_ident() % 100000}
+        if dur_us is not None:
+            ev["dur"] = dur_us
+        if args:
+            ev["args"] = args
+        self._queue.put(ev)
+
+    @contextmanager
+    def op_span(self, name, op_kind):
+        """Complete-event span around one eager collective dispatch."""
+        start = self._now_us()
+        try:
+            yield
+        finally:
+            self.record(name or op_kind, "X", op_kind, start,
+                        dur_us=self._now_us() - start)
+
+    def mark_cycle(self):
+        if self.mark_cycles:
+            self.record("CYCLE", "i", "cycle", self._now_us(),
+                        args={"s": "g"})
+
+    def negotiate(self, name, op_kind, dur_us):
+        """Host-side coordination time (size exchange for ragged ops etc.) —
+        the surviving analog of NEGOTIATE_* (reference: timeline.cc)."""
+        self.record(f"NEGOTIATE_{op_kind}:{name}", "X", "negotiate",
+                    self._now_us() - dur_us, dur_us=dur_us)
+
+    # --- writer --------------------------------------------------------
+    def _drain(self):
+        while not self._closed:
+            try:
+                ev = self._queue.get(timeout=0.25)
+                self._events.append(ev)
+            except queue.Empty:
+                continue
+
+    def close(self):
+        if self._closed:
+            return
+        self._closed = True
+        self._writer.join(timeout=2.0)
+        while True:
+            try:
+                self._events.append(self._queue.get_nowait())
+            except queue.Empty:
+                break
+        os.makedirs(os.path.dirname(os.path.abspath(self.file_path)),
+                    exist_ok=True)
+        with open(self.file_path, "w") as f:
+            json.dump({"traceEvents": self._events,
+                       "displayTimeUnit": "ms"}, f)

@@ -1,0 +1,38 @@
+"""Test harness: an 8-device virtual CPU mesh.
+
+Mirrors the reference's tier-2 strategy (SURVEY.md §4): the reference runs its
+test files under ``horovodrun -np 2 -H localhost:2`` so N local processes
+exercise the full negotiation/collective stack; here N virtual XLA CPU devices
+exercise the full mesh/collective stack in one process.
+"""
+
+import os
+
+# Force CPU even when the environment pins a TPU platform (tests model the
+# multi-chip mesh with virtual CPU devices; bench.py uses the real chip).
+# jax may already be imported by site customization, so set the config
+# directly as well as the env.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import numpy as np  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def hvd():
+    import horovod_tpu as hvd
+    hvd.init()
+    return hvd
+
+
+@pytest.fixture()
+def rng():
+    return np.random.default_rng(42)

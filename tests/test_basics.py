@@ -1,0 +1,64 @@
+"""Init/rank/size/process-set tests.
+
+Modeled on reference test/parallel/test_torch.py rank/size assertions and
+test/parallel/test_process_sets* (SURVEY.md §4).
+"""
+
+import pytest
+
+
+def test_init_idempotent(hvd):
+    assert hvd.is_initialized()
+    hvd.init()  # second call is a no-op
+    assert hvd.is_initialized()
+
+
+def test_sizes(hvd):
+    assert hvd.size() == 8
+    assert hvd.local_size() == 8
+    assert hvd.cross_size() == 1
+    assert hvd.rank() == 0
+    assert hvd.local_rank() == 0
+    assert hvd.cross_rank() == 0
+    assert hvd.is_homogeneous()
+
+
+def test_build_flags(hvd):
+    assert hvd.xla_built()
+    assert hvd.ici_built()
+    assert not hvd.mpi_built()
+    assert not hvd.nccl_built()
+
+
+def test_process_set_registration(hvd):
+    ps = hvd.add_process_set([0, 1, 2, 3])
+    try:
+        assert ps.process_set_id is not None and ps.process_set_id != 0
+        assert ps.size() == 4
+        assert ps.rank() == 0  # controller's first device is rank 0
+        assert ps.included()
+        sets = hvd.process_sets()
+        assert ps.process_set_id in sets
+        # duplicate registration returns the existing set id
+        ps2 = hvd.add_process_set([0, 1, 2, 3])
+        assert ps2.process_set_id == ps.process_set_id
+    finally:
+        hvd.remove_process_set(ps)
+    assert ps.process_set_id is None
+
+
+def test_process_set_validation(hvd):
+    from horovod_tpu.common.exceptions import ProcessSetError
+    with pytest.raises(ProcessSetError):
+        hvd.add_process_set([0, 0, 1])
+    with pytest.raises(ProcessSetError):
+        hvd.add_process_set([0, 99])
+    with pytest.raises(ProcessSetError):
+        hvd.remove_process_set(hvd.global_process_set)
+
+
+def test_global_process_set(hvd):
+    gps = hvd.global_process_set
+    assert gps.process_set_id == 0
+    assert gps.size() == 8
+    assert gps.rank_list() == list(range(8))
