@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 synthetic data-parallel training throughput.
+
+Mirrors the reference's benchmark procedure (reference:
+docs/benchmarks.rst:15-64 — tf_cnn_benchmarks with synthetic ImageNet data,
+images/sec): one full training step (fwd + bwd + fused gradient allreduce +
+SGD update) on synthetic 224x224x3 batches, bf16 activations.
+
+Baseline for ``vs_baseline``: the reference's only published absolute number,
+1656.82 images/sec on 16 Pascal GPUs (ResNet-101, batch 64/GPU,
+docs/benchmarks.rst:28-42) -> 103.55 images/sec/chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+"""
+
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.models import ResNet50
+    from horovod_tpu.optim import DistributedOptimizer
+    from horovod_tpu.parallel import TrainState, make_train_step
+
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.global_process_set.mesh
+
+    per_chip_batch = 128
+    batch = per_chip_batch * n
+    model = ResNet50(num_classes=1000, dtype=jnp.bfloat16, train=True)
+
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),
+                         jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), images[:1])
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+
+    opt = DistributedOptimizer(
+        optax.sgd(0.1, momentum=0.9),
+        compression=hvd.Compression.none)
+
+    def loss_fn(p, b, extra):
+        logits, updates = model.apply(
+            {"params": p, "batch_stats": extra}, b["x"],
+            mutable=["batch_stats"])
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]).mean()
+        return loss, updates["batch_stats"]
+
+    step = make_train_step(loss_fn, opt, mesh, has_aux=True, donate=True)
+    state = TrainState.create(params, opt, extra=batch_stats)
+
+    data = {"x": images, "y": labels}
+    # warmup (compile). float() is a device_get: unlike block_until_ready it
+    # forces real execution on every backend, including remote-tunnel TPU.
+    for _ in range(3):
+        state, loss = step(state, data)
+    float(loss)
+
+    iters = 30
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, data)
+    float(loss)
+    dt = time.perf_counter() - t0
+
+    imgs_per_sec = batch * iters / dt
+    per_chip = imgs_per_sec / n
+    baseline_per_chip = 1656.82 / 16.0
+    print(json.dumps({
+        "metric": "resnet50_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / baseline_per_chip, 3),
+    }))
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -41,3 +41,9 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     poll, synchronize, Handle, broadcast_object, allgather_object,
 )
 from horovod_tpu.ops import in_jit  # noqa: F401
+from horovod_tpu.ops.compression import Compression  # noqa: F401
+from horovod_tpu.ops.sync_batch_norm import SyncBatchNorm  # noqa: F401
+from horovod_tpu.optim import (  # noqa: F401
+    DistributedOptimizer, allreduce_gradients_transform, fused_allreduce_tree,
+    distributed_value_and_grad, broadcast_parameters, broadcast_object_tree,
+)

@@ -1,0 +1,133 @@
+"""BERT in flax, TPU-first.
+
+BERT-Large fine-tune is one of the tracked baseline configs (BASELINE.md,
+driver config "BERT-Large fine-tune with tensor fusion"). Written fresh for
+TPU: bfloat16 activations, fused QKV projection (one MXU matmul instead of
+three), static shapes throughout, no data-dependent control flow.
+"""
+
+import dataclasses
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class BertConfig:
+    vocab_size: int = 30522
+    hidden_size: int = 1024          # BERT-Large
+    num_layers: int = 24
+    num_heads: int = 16
+    intermediate_size: int = 4096
+    max_position_embeddings: int = 512
+    type_vocab_size: int = 2
+    dropout_rate: float = 0.1
+    dtype: Any = jnp.bfloat16
+
+    @staticmethod
+    def base():
+        return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
+                          intermediate_size=3072)
+
+    @staticmethod
+    def large():
+        return BertConfig()
+
+    @staticmethod
+    def tiny():
+        """For tests / dry runs."""
+        return BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
+                          num_heads=4, intermediate_size=256,
+                          max_position_embeddings=128)
+
+
+class SelfAttention(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic=True):
+        c = self.config
+        head_dim = c.hidden_size // c.num_heads
+        # Fused QKV: one (h, 3h) matmul keeps the MXU busy with a single
+        # large tile instead of three small ones.
+        qkv = nn.Dense(3 * c.hidden_size, dtype=c.dtype, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (c.num_heads, head_dim))
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+        if mask is not None:
+            big_neg = jnp.asarray(-1e9, scores.dtype)
+            scores = jnp.where(mask[:, None, None, :], scores, big_neg)
+        probs = nn.softmax(scores.astype(jnp.float32)).astype(c.dtype)
+        probs = nn.Dropout(c.dropout_rate)(probs, deterministic=deterministic)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(out.shape[:-2] + (c.hidden_size,))
+        return nn.Dense(c.hidden_size, dtype=c.dtype, name="out")(out)
+
+
+class TransformerBlock(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, x, mask, deterministic=True):
+        c = self.config
+        a = SelfAttention(c, name="attention")(x, mask, deterministic)
+        a = nn.Dropout(c.dropout_rate)(a, deterministic=deterministic)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x + a)
+        h = nn.Dense(c.intermediate_size, dtype=c.dtype, name="mlp_in")(x)
+        h = nn.gelu(h)
+        h = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlp_out")(h)
+        h = nn.Dropout(c.dropout_rate)(h, deterministic=deterministic)
+        return nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x + h)
+
+
+class BertModel(nn.Module):
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic=True):
+        c = self.config
+        B, L = input_ids.shape
+        if token_type_ids is None:
+            token_type_ids = jnp.zeros_like(input_ids)
+        if attention_mask is None:
+            attention_mask = jnp.ones_like(input_ids, dtype=bool)
+        tok = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                       name="tok_emb")(input_ids)
+        pos = nn.Embed(c.max_position_embeddings, c.hidden_size,
+                       dtype=c.dtype, name="pos_emb")(
+                           jnp.arange(L)[None].repeat(B, 0))
+        typ = nn.Embed(c.type_vocab_size, c.hidden_size, dtype=c.dtype,
+                       name="type_emb")(token_type_ids)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_emb")(tok + pos + typ)
+        x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
+        for i in range(c.num_layers):
+            x = TransformerBlock(c, name=f"layer_{i}")(
+                x, attention_mask.astype(bool), deterministic)
+        pooled = nn.tanh(nn.Dense(c.hidden_size, dtype=c.dtype,
+                                  name="pooler")(x[:, 0]))
+        return x, pooled
+
+
+class BertForPreTraining(nn.Module):
+    """MLM + NSP heads, the standard pre-training/fine-tune objective."""
+    config: BertConfig
+
+    @nn.compact
+    def __call__(self, input_ids, token_type_ids=None, attention_mask=None,
+                 deterministic=True):
+        c = self.config
+        x, pooled = BertModel(c, name="bert")(
+            input_ids, token_type_ids, attention_mask, deterministic)
+        mlm = nn.Dense(c.hidden_size, dtype=c.dtype, name="mlm_transform")(x)
+        mlm = nn.LayerNorm(dtype=c.dtype, name="mlm_ln")(nn.gelu(mlm))
+        mlm_logits = nn.Dense(c.vocab_size, dtype=jnp.float32,
+                              name="mlm_head")(mlm)
+        nsp_logits = nn.Dense(2, dtype=jnp.float32, name="nsp_head")(pooled)
+        return mlm_logits.astype(jnp.float32), nsp_logits.astype(jnp.float32)

@@ -1,0 +1,20 @@
+"""Small MLP (MNIST-scale) — the smoke-test model, mirroring the role of the
+reference's MNIST examples in CI (reference: .buildkite/gen-pipeline.sh MNIST
+smoke runs)."""
+
+from typing import Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class MLP(nn.Module):
+    features: Sequence[int] = (128, 64, 10)
+    dtype: jnp.dtype = jnp.float32
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        for i, f in enumerate(self.features[:-1]):
+            x = nn.relu(nn.Dense(f, dtype=self.dtype)(x))
+        return nn.Dense(self.features[-1], dtype=jnp.float32)(x)

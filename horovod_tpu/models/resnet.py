@@ -1,0 +1,116 @@
+"""ResNet family in flax, TPU-first.
+
+The reference benchmarks Horovod with tf_cnn_benchmarks ResNet-50/101
+(reference: docs/benchmarks.rst:15-64); this is the equivalent flagship model
+for the TPU build's data-parallel benchmark (BASELINE.md target:
+images/sec/chip, ResNet-50).
+
+TPU-first choices: bfloat16 activations with fp32 params/batch-stats (MXU
+native dtype), channels-last NHWC (XLA TPU's preferred conv layout), optional
+cross-replica SyncBatchNorm over the DP axis.
+"""
+
+from functools import partial
+from typing import Any, Callable, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from horovod_tpu.ops.sync_batch_norm import SyncBatchNorm
+
+ModuleDef = Any
+
+
+class ResNetBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: tuple = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (3, 3), self.strides)(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class BottleneckResNetBlock(nn.Module):
+    filters: int
+    conv: ModuleDef
+    norm: ModuleDef
+    act: Callable
+    strides: tuple = (1, 1)
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = self.act(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides,
+                                 name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return self.act(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    block_cls: ModuleDef
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+    act: Callable = nn.relu
+    sync_batch_norm_axis: str = None  # DP mesh axis for SyncBatchNorm
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=None):
+        train = self.train if train is None else train
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        if self.sync_batch_norm_axis is not None:
+            norm = partial(SyncBatchNorm, use_running_average=not train,
+                           axis_name=self.sync_batch_norm_axis,
+                           momentum=0.9, dtype=self.dtype)
+        else:
+            norm = partial(nn.BatchNorm, use_running_average=not train,
+                           momentum=0.9, epsilon=1e-5, dtype=self.dtype)
+
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
+                 name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = self.act(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_size in enumerate(self.stage_sizes):
+            for j in range(block_size):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = self.block_cls(self.num_filters * 2 ** i, conv=conv,
+                                   norm=norm, act=self.act, strides=strides)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+ResNet18 = partial(ResNet, stage_sizes=[2, 2, 2, 2], block_cls=ResNetBlock)
+ResNet34 = partial(ResNet, stage_sizes=[3, 4, 6, 3], block_cls=ResNetBlock)
+ResNet50 = partial(ResNet, stage_sizes=[3, 4, 6, 3],
+                   block_cls=BottleneckResNetBlock)
+ResNet101 = partial(ResNet, stage_sizes=[3, 4, 23, 3],
+                    block_cls=BottleneckResNetBlock)
+ResNet152 = partial(ResNet, stage_sizes=[3, 8, 36, 3],
+                    block_cls=BottleneckResNetBlock)

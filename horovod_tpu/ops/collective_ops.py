@@ -528,10 +528,23 @@ class Handle:
 
 def allreduce_async(tensor, op=Average, prescale_factor=1.0,
                     postscale_factor=1.0, process_set=None, name=None):
-    out = allreduce(tensor, op=op, prescale_factor=prescale_factor,
-                    postscale_factor=postscale_factor, process_set=process_set,
-                    name=name)
-    return Handle(out, name)
+    """Async allreduce through the tensor-fusion runtime: small tensors
+    submitted back-to-back are batched into one fused collective
+    (reference: every async allreduce rides the fusion buffer + cycle loop,
+    operations.cc:747-853). Process-set ops bypass fusion (the runtime fuses
+    per the global mesh only, like the reference fuses per process set)."""
+    if process_set is not None and process_set.ranks is not None:
+        return Handle(allreduce(tensor, op=op, prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor,
+                                process_set=process_set, name=name), name)
+    from horovod_tpu.ops.fusion import get_runtime
+    t = tensor if hasattr(tensor, "ndim") else np.asarray(tensor)
+    _check_stacked(t, basics.size(), "allreduce_async")
+    if op == Average and not _is_float(_dtype_of(t)):
+        raise ValueError("Average is not supported for integer tensors; use "
+                         "hvd.Sum (matches reference torch/mpi_ops.py checks).")
+    return get_runtime().enqueue_allreduce(t, op, prescale_factor,
+                                           postscale_factor, name)
 
 
 def grouped_allreduce_async(tensors, op=Average, prescale_factor=1.0,

@@ -1,0 +1,169 @@
+"""Tensor-fusion (bucketing) runtime for the eager path.
+
+Reference mechanism (horovod/common/fusion_buffer_manager.h:30-62 + the cycle
+loop operations.cc:747-853): small tensors submitted within one cycle are
+memcpy'd into a persistent fusion buffer and reduced with ONE collective, then
+scattered back out; buffer capacity is ``HOROVOD_FUSION_THRESHOLD`` (128 MB)
+and the loop wakes every ``HOROVOD_CYCLE_TIME`` (1 ms).
+
+TPU-native design: there is no background thread and no memcpy staging —
+pending tensors are raveled and concatenated *inside one jitted program* per
+(names, shapes, dtypes, op) signature, reduced with a single ``psum`` on the
+flat buffer, and split back, all fused by XLA. The signature-keyed program
+cache means a steady-state training loop hits the same compiled fused program
+every step (the response-cache fast path, reference: response_cache.h:45).
+
+Flush triggers: pending bytes >= fusion_threshold, an explicit
+``synchronize()`` on any returned handle, or ``flush_all()``.
+"""
+
+import functools
+import threading
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from horovod_tpu.common import basics
+from horovod_tpu.common.topology import HVD_AXIS
+from horovod_tpu.ops.collective_ops import (ReduceOp, _prepare, _reduce_shard)
+
+
+class FusedHandle:
+    """Handle for a tensor pending in the fusion queue. Resolves after the
+    bucket it lands in is flushed (reference analog: HandleManager int handle
+    + per-entry callback, torch/handle_manager.h)."""
+
+    __slots__ = ("_runtime", "_result", "name")
+
+    def __init__(self, runtime, name):
+        self._runtime = runtime
+        self._result = None
+        self.name = name
+
+    def _set(self, value):
+        self._result = value
+
+    def poll(self):
+        if self._result is None:
+            # Polling plays the role of the reference's cycle tick: a pending
+            # bucket is flushed the first time anyone asks about it
+            # (reference: RunLoopOnce wakes every cycle, operations.cc:747).
+            self._runtime.flush_all()
+        return all(o.is_ready() if hasattr(o, "is_ready") else True
+                   for o in jax.tree_util.tree_leaves(self._result))
+
+    def synchronize(self):
+        if self._result is None:
+            self._runtime.flush_all()
+        jax.block_until_ready(self._result)
+        return self._result
+
+
+@functools.lru_cache(maxsize=2048)
+def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
+                   wire_dtype):
+    """One flat-buffer reduction for a whole bucket."""
+    sizes = [int(np.prod(s[1:])) for s in shapes]
+
+    def body(*xs):
+        # xs: local slices (1, ...). Flatten each, concat per the bucket
+        # layout (the MemcpyInFusionBuffer analog, fused by XLA into the
+        # collective's input), one psum, then split back out. Buckets are
+        # formed per effective wire dtype so the concat is homogeneous.
+        # Adasum must normalize per-tensor (its coefficients are norms of the
+        # individual gradients, reference: adasum.h:103+), so its tensors are
+        # reduced individually inside the single dispatch instead of fused.
+        if op == ReduceOp.ADASUM:
+            return tuple(
+                _reduce_shard(x, op, n, prescale, postscale, HVD_AXIS)
+                for x in xs)
+        flats = []
+        for x in xs:
+            f = x.reshape(-1)
+            if wire_dtype is not None and jnp.issubdtype(f.dtype, jnp.floating):
+                f = f.astype(wire_dtype)
+            flats.append(f)
+        buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        buf = _reduce_shard(buf[None], op, n, prescale, postscale, HVD_AXIS)[0]
+        outs, off = [], 0
+        for x, sz in zip(xs, sizes):
+            piece = lax.slice_in_dim(buf, off, off + sz).astype(x.dtype)
+            outs.append(piece.reshape(x.shape))
+            off += sz
+        return tuple(outs)
+
+    f = jax.shard_map(body, mesh=mesh,
+                      in_specs=tuple(P(HVD_AXIS) for _ in shapes),
+                      out_specs=tuple(P(HVD_AXIS) for _ in shapes))
+    return jax.jit(f)
+
+
+class FusionRuntime:
+    def __init__(self, config):
+        self.threshold = config.fusion_threshold
+        self.wire_dtype = jnp.dtype(config.wire_dtype).type \
+            if config.wire_dtype else None
+        self._lock = threading.RLock()
+        self._pending = []  # (tensor, op, prescale, postscale, handle)
+        self._pending_bytes = 0
+
+    def enqueue_allreduce(self, tensor, op, prescale, postscale, name=None):
+        handle = FusedHandle(self, name)
+        with self._lock:
+            self._pending.append((tensor, ReduceOp(op), float(prescale),
+                                  float(postscale), handle))
+            self._pending_bytes += tensor.nbytes
+            if self._pending_bytes >= self.threshold:
+                self._flush_locked()
+        return handle
+
+    def flush_all(self):
+        with self._lock:
+            self._flush_locked()
+
+    def _flush_locked(self):
+        if not self._pending:
+            return
+        pending, self._pending = self._pending, []
+        self._pending_bytes = 0
+        topo = basics.topology()
+        mesh = topo.mesh
+        n = topo.size
+        # Bucket by (op, prescale, postscale, effective wire dtype) — tensors
+        # in one bucket share one flat reduction, like responses fused up to
+        # the threshold (reference: controller.h:170 FuseResponses).
+        def _eff(t):
+            dt = jnp.dtype(t.dtype) if hasattr(t, "dtype") else np.result_type(t)
+            if self.wire_dtype is not None and jnp.issubdtype(dt, jnp.floating):
+                return str(jnp.dtype(self.wire_dtype))
+            return str(dt)
+
+        buckets = {}
+        for t, op, pre, post, h in pending:
+            buckets.setdefault((op, pre, post, _eff(t)), []).append((t, h))
+        tl = basics.timeline()
+        for (op, pre, post, _), items in buckets.items():
+            tensors = [i[0] for i in items]
+            tensors = _prepare(tensors, mesh, n, "fused_allreduce")
+            shapes = tuple(tuple(t.shape) for t in tensors)
+            dtypes = tuple(str(t.dtype) for t in tensors)
+            prog = _fused_program(mesh, n, op, pre, post, shapes, dtypes,
+                                  self.wire_dtype)
+            if tl is not None:
+                with tl.op_span(f"fused_allreduce[{len(items)}]", "ALLREDUCE"):
+                    outs = prog(*tensors)
+            else:
+                outs = prog(*tensors)
+            for (_, h), o in zip(items, outs):
+                h._set(o)
+
+
+def get_runtime():
+    st = basics._get_state()
+    if st.fusion is None:
+        from horovod_tpu.ops.fusion import FusionRuntime
+        st.fusion = FusionRuntime(st.config)
+    return st.fusion

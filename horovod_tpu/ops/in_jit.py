@@ -175,3 +175,19 @@ def alltoall(x, axis_name=HVD_AXIS, process_set=None, split_axis=0,
 def ppermute(x, perm, axis_name=HVD_AXIS):
     """Point-to-point ring shifts — the primitive ring attention builds on."""
     return lax.ppermute(x, axis_name, perm)
+
+
+def mark_varying(tree, axis_name=HVD_AXIS):
+    """Lift every leaf to device-varying over ``axis_name`` (no-op for leaves
+    already varying). Needed when mixing replicated values (e.g. an initial
+    carry built from constants) with per-rank values inside shard_map scans
+    and conds under JAX's varying-manual-axes checking."""
+    import jax as _jax
+
+    def mv(x):
+        vma = getattr(_jax.typeof(x), "vma", ())
+        if axis_name in vma:
+            return x
+        return lax.pcast(x, axis_name, to="varying")
+
+    return _jax.tree_util.tree_map(mv, tree)

@@ -1,0 +1,6 @@
+from horovod_tpu.parallel.dp import (  # noqa: F401
+    make_train_step, make_eval_step, TrainState,
+)
+from horovod_tpu.parallel.strategies import (  # noqa: F401
+    allreduce_hierarchical, allreduce_torus,
+)
