@@ -44,6 +44,14 @@ def _distributed_client_active():
         return False
 
 
+def _current_coordinator():
+    try:
+        from jax._src import distributed as _dist
+        return _dist.global_state.coordinator_address
+    except Exception:
+        return None
+
+
 class _State:
     def __init__(self, topology, config):
         self.topology = topology
@@ -78,14 +86,40 @@ def init(comm=None, process_sets=None, devices=None):
         # Decide on distributed bootstrap from the env alone: probing
         # jax.process_count() here would initialize the local backend and
         # forbid jax.distributed.initialize afterwards.
-        if config.coordinator_addr and config.cross_size > 1 \
-                and not _distributed_client_active():
-            jax.distributed.initialize(
-                coordinator_address=(
-                    f"{config.coordinator_addr}:{config.coordinator_port}"),
-                num_processes=config.cross_size,
-                process_id=config.cross_rank,
-            )
+        if config.coordinator_addr and config.cross_size > 1:
+            target = f"{config.coordinator_addr}:{config.coordinator_port}"
+            replace = False
+            if _distributed_client_active():
+                current = _current_coordinator()
+                if current == target:
+                    replace = False  # our cluster already bootstrapped
+                else:
+                    # A platform site hook pre-created a distributed client
+                    # that doesn't belong to our cluster — replace it.
+                    hvd_logging.warning(
+                        "replacing pre-existing jax.distributed client "
+                        "(%s) with launcher coordinator %s", current, target)
+                    jax.distributed.shutdown()
+                    replace = True
+            else:
+                replace = True
+            if replace:
+                # Backends created before distributed bootstrap (again,
+                # site hooks) would freeze a single-process view; clear them
+                # so they rebuild with the cluster's global topology.
+                try:
+                    from jax._src import xla_bridge as _xb
+                    if _xb.backends_are_initialized():
+                        hvd_logging.warning(
+                            "clearing pre-initialized XLA backends before "
+                            "distributed bootstrap")
+                        _xb._clear_backends()
+                except ImportError:  # pragma: no cover
+                    pass
+                jax.distributed.initialize(
+                    coordinator_address=target,
+                    num_processes=config.cross_size,
+                    process_id=config.cross_rank)
 
         topology = build_topology(devices)
         _state = _State(topology, config)

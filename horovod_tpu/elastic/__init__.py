@@ -1,0 +1,3 @@
+from horovod_tpu.elastic.state import (  # noqa: F401
+    State, ObjectState, TpuState, run,
+)

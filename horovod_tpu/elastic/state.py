@@ -1,0 +1,182 @@
+"""Elastic training state: commit / restore / sync.
+
+Reference: horovod/common/elastic.py (State:29, ObjectState:127, run_fn:168)
+plus the framework handlers (torch/elastic/state.py:30-255): training state is
+committed in memory each epoch/step-group; on a collective failure
+(``HorovodInternalError``) the last commit is restored and collectives
+re-initialize; on a membership notification (``HostsUpdatedInterrupt``) the
+current state is kept. ``sync()`` broadcasts rank-0's state to all ranks after
+a rendezvous.
+
+TPU adaptation: device arrays are immutable, so ``commit`` is O(1) reference
+capture (no deep copy — the reference must clone mutable torch tensors);
+``sync`` rides :func:`horovod_tpu.optim.broadcast_parameters` for pytrees and
+``broadcast_object`` for python attrs. Re-initialization maps to rebuilding
+the mesh from the new host set.
+"""
+
+import copy
+
+from horovod_tpu.common import basics
+from horovod_tpu.common import logging as hvd_logging
+from horovod_tpu.common.exceptions import (HorovodInternalError,
+                                           HostsUpdatedInterrupt)
+
+
+class State:
+    """Base elastic state (reference: common/elastic.py:29-126)."""
+
+    def __init__(self, **kwargs):
+        self._host_messages = None  # set by the elastic worker loop
+        self._reset_callbacks = []
+        for k, v in kwargs.items():
+            setattr(self, k, v)
+
+    def register_reset_callbacks(self, callbacks):
+        """Callbacks invoked after a reset (LR re-scaling etc.,
+        reference: elastic.py:44-52)."""
+        self._reset_callbacks.extend(callbacks)
+
+    def on_reset(self):
+        self.reset()
+        for cb in self._reset_callbacks:
+            cb()
+
+    def commit(self):
+        """Commit (save) + check for host changes (reference: elastic.py:54)."""
+        self.save()
+        self.check_host_updates()
+
+    def save(self):
+        raise NotImplementedError
+
+    def restore(self):
+        raise NotImplementedError
+
+    def sync(self):
+        raise NotImplementedError
+
+    def reset(self):
+        pass
+
+    def check_host_updates(self):
+        """Raise HostsUpdatedInterrupt when the driver published a new host
+        set (reference: elastic.py:75-100 via WorkerNotificationManager; here
+        a KV version poll)."""
+        if self._host_messages is None:
+            return
+        if self._host_messages.updated():
+            # Acknowledge before raising so the next commit after recovery
+            # doesn't re-trigger on the same membership version.
+            ack = getattr(self._host_messages, "acknowledge", None)
+            if ack is not None:
+                ack()
+            raise HostsUpdatedInterrupt(skip_sync=False)
+
+
+class ObjectState(State):
+    """State of arbitrary python attributes, synced by object broadcast
+    (reference: common/elastic.py:127-170)."""
+
+    def __init__(self, bcast_object=None, **kwargs):
+        from horovod_tpu.ops.collective_ops import broadcast_object
+        self._bcast_object = bcast_object or broadcast_object
+        self._saved_state = dict(kwargs)
+        super().__init__(**kwargs)
+
+    def save(self):
+        new_state = {}
+        for attr in self._saved_state.keys():
+            new_state[attr] = copy.deepcopy(getattr(self, attr))
+        self._saved_state = new_state
+
+    def restore(self):
+        for attr, value in self._saved_state.items():
+            setattr(self, attr, copy.deepcopy(value))
+
+    def sync(self):
+        if self._saved_state:
+            synced = self._bcast_object(self._saved_state, root_rank=0)
+            for attr, value in synced.items():
+                setattr(self, attr, value)
+            self._saved_state = synced
+
+
+class TpuState(ObjectState):
+    """Model/optimizer state for JAX training loops.
+
+    Tracked pytrees (``params``, ``opt_state``, anything passed as a pytree
+    kwarg) are committed by reference (immutability makes this safe and free)
+    and synced with a fused broadcast — the analog of
+    TorchState(model=..., optimizer=...) (reference: torch/elastic/state.py).
+    """
+
+    def __init__(self, trees=None, **kwargs):
+        self._trees = dict(trees or {})
+        self._saved_trees = dict(self._trees)
+        super().__init__(**kwargs)
+
+    def __getattr__(self, name):
+        trees = self.__dict__.get("_trees", {})
+        if name in trees:
+            return trees[name]
+        raise AttributeError(name)
+
+    def __setattr__(self, name, value):
+        if not name.startswith("_") and "_trees" in self.__dict__ \
+                and name in self._trees:
+            self._trees[name] = value
+        else:
+            super().__setattr__(name, value)
+
+    def save(self):
+        # jax arrays are immutable: capturing references IS a snapshot.
+        self._saved_trees = dict(self._trees)
+        super().save()
+
+    def restore(self):
+        self._trees = dict(self._saved_trees)
+        super().restore()
+
+    def sync(self):
+        from horovod_tpu.optim import broadcast_parameters
+        for name, tree in self._trees.items():
+            self._trees[name] = broadcast_parameters(tree, root_rank=0)
+        super().sync()
+
+
+def run(func):
+    """Elastic run decorator (reference: common/elastic.py:168 run_fn).
+
+    ``@hvd.elastic.run`` wraps ``train(state, ...)``: syncs state on entry,
+    retries on ``HorovodInternalError`` (restore last commit) and
+    ``HostsUpdatedInterrupt`` (keep state), re-initializing between attempts.
+    """
+
+    def wrapper(state, *args, **kwargs):
+        reset_required = False
+        skip_sync = False
+        while True:
+            if reset_required:
+                _reset(state)
+            if not skip_sync:
+                state.sync()
+            skip_sync = False
+            try:
+                return func(state, *args, **kwargs)
+            except HorovodInternalError:
+                hvd_logging.warning(
+                    "collective failure; restoring last committed state")
+                state.restore()
+                reset_required = True
+            except HostsUpdatedInterrupt as e:
+                hvd_logging.info("host set updated; re-initializing")
+                reset_required = True
+                skip_sync = e.skip_sync
+
+    def _reset(state):
+        basics.shutdown()
+        basics.init()
+        state.on_reset()
+
+    return wrapper

@@ -1,0 +1,39 @@
+"""Worker-side elastic plumbing: membership-change detection.
+
+Reference: horovod/runner/elastic/worker.py WorkerNotificationService — the
+driver pushes HostsUpdated to workers over a socket service. Here workers
+poll the driver's KV version counter (HOROVOD_KV_ADDR/PORT env, written by
+run_elastic_driver) — same contract, simpler transport.
+"""
+
+import os
+
+from horovod_tpu.runner.http_kv import KVStoreClient
+
+
+class HostUpdateListener:
+    def __init__(self, addr=None, port=None):
+        addr = addr or os.environ.get("HOROVOD_KV_ADDR")
+        port = port or os.environ.get("HOROVOD_KV_PORT")
+        self._client = KVStoreClient(addr, int(port)) if addr and port else None
+        self._seen = self._current()
+
+    def _current(self):
+        if self._client is None:
+            return 0
+        v = self._client.get("elastic", "version")
+        return int(v) if v else 0
+
+    def updated(self):
+        return self._current() != self._seen
+
+    def acknowledge(self):
+        self._seen = self._current()
+
+
+def attach_listener(state):
+    """Attach a KV listener to an elastic State when launched by hvdrun
+    (no-op outside an elastic launch)."""
+    if os.environ.get("HOROVOD_ELASTIC") and os.environ.get("HOROVOD_KV_ADDR"):
+        state._host_messages = HostUpdateListener()
+    return state

@@ -1,0 +1,10 @@
+"""hvdrun launcher package.
+
+Reference: horovod/runner/ (CLI launch.py:841 LoC, gloo_run, elastic driver,
+HTTP rendezvous). The TPU control plane is much thinner: there is no per-rank
+worker process to place — one process per *host* joins a
+``jax.distributed`` cluster and owns that host's chips — so the launcher's
+job is host bookkeeping, env plumbing, ssh fan-out, and elastic membership.
+"""
+
+from horovod_tpu.runner.api import run, run_elastic  # noqa: F401

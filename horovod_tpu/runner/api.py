@@ -1,0 +1,101 @@
+"""In-process ``run()`` API.
+
+Reference: horovod/runner/__init__.py:95-247 — ``horovod.run(func, np=…)``
+cloudpickles ``func`` and launches it on every rank, returning the per-rank
+results.
+
+TPU adaptation: with one process per host, ``func`` executes once per host;
+results are collected through the KV store and returned host-major. On a
+single host this degenerates to "init and call" with zero serialization.
+"""
+
+import os
+import sys
+import tempfile
+
+import cloudpickle
+
+from horovod_tpu.runner import launch as launch_mod
+
+
+def run(func, args=(), kwargs=None, np=None, hosts=None, hostfile=None,
+        use_ssh=None, ssh_port=None, ssh_identity_file=None, verbose=False,
+        extra_env=None):
+    """Run ``func(*args, **kwargs)`` under horovod_tpu on the given hosts and
+    return the list of per-host results (reference: runner/__init__.py run)."""
+    kwargs = kwargs or {}
+    single_host = hosts is None and hostfile is None
+    if single_host:
+        import horovod_tpu as hvd
+        if extra_env:
+            os.environ.update(extra_env)
+        hvd.init()
+        return [func(*args, **kwargs)]
+
+    with tempfile.TemporaryDirectory(prefix="hvdtpu_run_") as tmp:
+        fn_path = os.path.join(tmp, "func.pkl")
+        with open(fn_path, "wb") as f:
+            cloudpickle.dump((func, args, kwargs), f)
+
+        argv = []
+        if np:
+            argv += ["-np", str(np)]
+        if hosts:
+            argv += ["-H", hosts]
+        if hostfile:
+            argv += ["--hostfile", hostfile]
+        if ssh_port:
+            argv += ["--ssh-port", str(ssh_port)]
+        if ssh_identity_file:
+            argv += ["--ssh-identity-file", ssh_identity_file]
+        if verbose:
+            argv += ["--verbose"]
+        argv += [sys.executable, "-m", "horovod_tpu.runner.task", fn_path]
+
+        parsed = launch_mod.parse_args(argv)
+        harvested = {}
+
+        def harvest(kv):
+            # Workers PUT pickled results into the KV store keyed by
+            # cross_rank — reachable from remote hosts, unlike a local
+            # tmpdir (reference: run collects per-rank results,
+            # runner/__init__.py).
+            idx = 0
+            while True:
+                v = kv.get("results", str(idx))
+                if v is None:
+                    break
+                harvested[idx] = cloudpickle.loads(v)
+                idx += 1
+
+        rc = launch_mod._run_static(parsed, harvest=harvest)
+        if rc != 0:
+            raise RuntimeError(f"hvdrun failed with exit code {rc}")
+        n_hosts = len(set(
+            s.hostname for s in _assignments_for(parsed)))
+        missing = [i for i in range(n_hosts) if i not in harvested]
+        if missing:
+            raise RuntimeError(
+                f"run() completed but results from host indices {missing} "
+                f"were not reported")
+        return [harvested[i] for i in range(n_hosts)]
+
+
+def _assignments_for(parsed_args):
+    from horovod_tpu.runner.hosts import get_host_assignments
+    from horovod_tpu.runner.launch import _resolve_hosts
+    return get_host_assignments(_resolve_hosts(parsed_args),
+                                parsed_args.np or None)
+
+
+def run_elastic(func, args=(), kwargs=None, min_np=1, max_np=None,
+                host_discovery_script=None, reset_limit=None, verbose=False):
+    """Elastic variant (reference: horovod.run with elastic args +
+    gloo_run_elastic)."""
+    kwargs = kwargs or {}
+    if host_discovery_script is None:
+        # Single-host elastic degenerates to plain run
+        return run(func, args, kwargs)
+    raise NotImplementedError(
+        "multi-host elastic run() API lands with the elastic driver CLI; "
+        "use `hvdrun --min-np/--max-np --host-discovery-script` meanwhile")

@@ -1,0 +1,77 @@
+"""CLI/config-file -> HOROVOD_* environment plumbing.
+
+Reference: horovod/runner/common/util/config_parser.py (set_env_from_args:19-205
+and the YAML ``--config-file`` loader) — every tunable flag maps to the env
+var the core reads, so flags, YAML and raw env are interchangeable.
+"""
+
+# flag attribute -> env var (reference: config_parser.py constants)
+_ARG_ENV_MAP = [
+    ("fusion_threshold_mb", "HOROVOD_FUSION_THRESHOLD",
+     lambda v: str(int(v * 1024 * 1024))),
+    ("cycle_time_ms", "HOROVOD_CYCLE_TIME", str),
+    ("cache_capacity", "HOROVOD_CACHE_CAPACITY", str),
+    ("hierarchical_allreduce", "HOROVOD_HIERARCHICAL_ALLREDUCE",
+     lambda v: "1" if v else None),
+    ("hierarchical_allgather", "HOROVOD_HIERARCHICAL_ALLGATHER",
+     lambda v: "1" if v else None),
+    ("torus_allreduce", "HOROVOD_TORUS_ALLREDUCE",
+     lambda v: "1" if v else None),
+    ("autotune", "HOROVOD_AUTOTUNE", lambda v: "1" if v else None),
+    ("autotune_log_file", "HOROVOD_AUTOTUNE_LOG", str),
+    ("autotune_warmup_samples", "HOROVOD_AUTOTUNE_WARMUP_SAMPLES", str),
+    ("autotune_steps_per_sample", "HOROVOD_AUTOTUNE_STEPS_PER_SAMPLE", str),
+    ("autotune_bayes_opt_max_samples",
+     "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", str),
+    ("autotune_gaussian_process_noise",
+     "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", str),
+    ("timeline_filename", "HOROVOD_TIMELINE", str),
+    ("timeline_mark_cycles", "HOROVOD_TIMELINE_MARK_CYCLES",
+     lambda v: "1" if v else None),
+    ("no_stall_check", "HOROVOD_STALL_CHECK_DISABLE",
+     lambda v: "1" if v else None),
+    ("stall_check_warning_time_seconds", "HOROVOD_STALL_CHECK_TIME_SECONDS",
+     str),
+    ("stall_check_shutdown_time_seconds",
+     "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", str),
+    ("log_level", "HOROVOD_LOG_LEVEL", str),
+    ("log_hide_timestamp", "HOROVOD_LOG_HIDE_TIME",
+     lambda v: "1" if v else None),
+    ("wire_dtype", "HOROVOD_WIRE_DTYPE", str),
+]
+
+
+def set_env_from_args(env, args):
+    """reference: config_parser.py set_env_from_args."""
+    for attr, var, conv in _ARG_ENV_MAP:
+        v = getattr(args, attr, None)
+        if v is None or v is False or v == "":
+            continue
+        cv = conv(v)
+        if cv is not None:
+            env[var] = cv
+    return env
+
+
+def parse_config_file(args, path):
+    """YAML config overriding CLI defaults (reference: config_parser.py:205
+    --config-file). Only keys matching known arg names are applied."""
+    import yaml
+    with open(path) as f:
+        cfg = yaml.safe_load(f) or {}
+    known = {a for a, _, _ in _ARG_ENV_MAP} | {
+        "np", "hosts", "hostfile", "verbose", "min_np", "max_np",
+        "slots_per_host", "ssh_port", "ssh_identity_file", "start_timeout"}
+    for section, values in cfg.items():
+        if isinstance(values, dict):
+            items = values.items()
+        else:
+            items = [(section, values)]
+        for k, v in items:
+            k = k.replace("-", "_")
+            # Explicit CLI flags win over the config file; YAML only fills
+            # defaults (reference precedence: config_parser.py applies config
+            # where the arg wasn't set).
+            if k in known and getattr(args, k, None) in (None, False, ""):
+                setattr(args, k, v)
+    return args

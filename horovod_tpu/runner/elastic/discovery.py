@@ -1,0 +1,105 @@
+"""Elastic host discovery.
+
+Reference: horovod/runner/elastic/discovery.py — ``HostDiscovery`` interface,
+``HostDiscoveryScript`` (runs a user script printing ``hostname:slots`` lines,
+:146+), and ``HostManager`` with per-host blacklist + cooldown (:33-110) so a
+flapping host isn't immediately reused.
+"""
+
+import subprocess
+import time
+import threading
+
+from horovod_tpu.common import logging as hvd_logging
+from horovod_tpu.runner.hosts import HostInfo
+
+
+class HostDiscovery:
+    def find_available_hosts_and_slots(self):
+        """Return {hostname: slots}."""
+        raise NotImplementedError
+
+
+class FixedHosts(HostDiscovery):
+    def __init__(self, hosts):
+        self._hosts = {h.hostname: h.slots for h in hosts}
+
+    def find_available_hosts_and_slots(self):
+        return dict(self._hosts)
+
+
+class HostDiscoveryScript(HostDiscovery):
+    """reference: discovery.py HostDiscoveryScript — executes the script,
+    parses ``host`` or ``host:slots`` lines."""
+
+    def __init__(self, discovery_script, default_slots=1):
+        self._script = discovery_script
+        self._default_slots = default_slots
+
+    def find_available_hosts_and_slots(self):
+        out = self._execute_discovery_script()
+        hosts = {}
+        for line in out.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            hi = HostInfo.from_string(line)
+            hosts[hi.hostname] = hi.slots if ":" in line \
+                else self._default_slots
+        return hosts
+
+    def _execute_discovery_script(self):
+        return subprocess.check_output(
+            self._script, shell=True, timeout=60).decode()
+
+
+class HostState:
+    """Per-host blacklist/cooldown bookkeeping
+    (reference: discovery.py:33-110 HostState with exponential cooldown)."""
+
+    COOLDOWN_BASE = 10.0
+    COOLDOWN_MAX = 600.0
+
+    def __init__(self):
+        self.blacklisted = False
+        self.failures = 0
+        self.cooldown_until = 0.0
+
+    def record_failure(self):
+        self.failures += 1
+        cooldown = min(self.COOLDOWN_BASE * (2 ** (self.failures - 1)),
+                       self.COOLDOWN_MAX)
+        self.cooldown_until = time.time() + cooldown
+
+    def blacklist(self):
+        self.blacklisted = True
+
+    def usable(self):
+        return not self.blacklisted and time.time() >= self.cooldown_until
+
+
+class HostManager:
+    """Tracks current hosts + their health; computes the usable set
+    (reference: driver.py + discovery.py host bookkeeping)."""
+
+    def __init__(self, discovery):
+        self._discovery = discovery
+        self._states = {}
+        self._lock = threading.Lock()
+
+    def state(self, host):
+        with self._lock:
+            return self._states.setdefault(host, HostState())
+
+    def record_failure(self, host):
+        hvd_logging.warning("host %s failed; cooling down", host)
+        self.state(host).record_failure()
+
+    def blacklist(self, host):
+        hvd_logging.warning("blacklisting host %s", host)
+        self.state(host).blacklist()
+
+    def current_hosts(self):
+        available = self._discovery.find_available_hosts_and_slots()
+        return {h: s for h, s in available.items()
+                if self.state(h).usable()}

@@ -1,0 +1,230 @@
+"""Elastic driver: membership management + worker lifecycle.
+
+Reference: horovod/runner/elastic/driver.py (ElasticDriver:69 — discovery
+thread :188-208, rank-preserving reassignment :240-283, worker respawn
+:284-302, exit handling + blacklist :304+), rendezvous.py (workers fetch their
+SlotInfo per membership version), registration.py/worker.py (host-update push).
+
+TPU adaptation: membership is per *host* (a host owns all its chips; TPU
+slices don't shrink by one chip). Workers learn about membership changes by
+polling a version counter in the KV store (replacing the push
+WorkerNotificationService); on a version bump the driver respawns workers
+with the new assignment — jax.distributed clusters are rebuilt rather than
+patched, which is the honest TPU equivalent of "re-rendezvous".
+"""
+
+import threading
+import time
+
+from horovod_tpu.common import logging as hvd_logging
+from horovod_tpu.runner.elastic.discovery import HostManager
+from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
+
+DISCOVER_INTERVAL_SECS = 1.0
+
+
+class ElasticDriver:
+    def __init__(self, discovery, min_np, max_np=None, reset_limit=None,
+                 spawn_fn=None, shutdown_fn=None):
+        """``spawn_fn(assignment, version)`` starts workers for the host set;
+        ``shutdown_fn(reason)`` stops them. Injected for testability — the
+        reference tests drive ``_update_host_assignments`` the same way
+        (reference: test_elastic_driver.py:46-509)."""
+        self._host_manager = HostManager(discovery)
+        self._min_np = min_np
+        self._max_np = max_np
+        self._reset_limit = reset_limit
+        self._spawn_fn = spawn_fn or (lambda assignment, version: None)
+        self._shutdown_fn = shutdown_fn or (lambda reason: None)
+
+        self._assignment = []          # list[SlotInfo]
+        self._host_order = []          # rank-ordered hostnames
+        self._version = 0
+        self._reset_count = 0
+        self._shutdown = threading.Event()
+        self._assignment_cv = threading.Condition()
+        self._thread = None
+        self.results = {}
+
+    # --- lifecycle -----------------------------------------------------
+    def start(self):
+        self._thread = threading.Thread(target=self._discover_loop,
+                                        daemon=True)
+        self._thread.start()
+
+    def stop(self, reason="driver stop"):
+        self._shutdown.set()
+        # stop() may be reached from the discovery thread itself (e.g. the
+        # reset limit firing inside _discover_loop) — never join ourselves.
+        if self._thread and self._thread is not threading.current_thread():
+            self._thread.join(timeout=5)
+        self._shutdown_fn(reason)
+
+    def wait_for_available_slots(self, min_np, timeout=600):
+        """Block until discovery finds >= min_np slots
+        (reference: driver.py:153 wait_for_available_slots)."""
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            hosts = self._host_manager.current_hosts()
+            if sum(hosts.values()) >= min_np:
+                return hosts
+            if self._shutdown.is_set():
+                raise RuntimeError("driver shut down while waiting for slots")
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"fewer than min_np={min_np} slots available after {timeout}s")
+
+    # --- membership ----------------------------------------------------
+    def _discover_loop(self):
+        while not self._shutdown.is_set():
+            try:
+                hosts = self._host_manager.current_hosts()
+                self._maybe_update(hosts)
+            except Exception as e:  # discovery script hiccup: keep going
+                if self._shutdown.is_set():
+                    break  # stop() already ran (e.g. reset limit exceeded)
+                hvd_logging.warning("discovery error: %s", e)
+            self._shutdown.wait(DISCOVER_INTERVAL_SECS)
+
+    def _maybe_update(self, hosts):
+        current = {s.hostname for s in self._assignment}
+        if set(hosts.keys()) == current and self._assignment:
+            return
+        if sum(hosts.values()) < self._min_np:
+            hvd_logging.warning(
+                "available slots %s below min_np %d; waiting",
+                hosts, self._min_np)
+            return
+        self.update_host_assignments(hosts)
+
+    def update_host_assignments(self, hosts):
+        """Recompute SlotInfos, preserving the rank order of surviving hosts
+        so their state stays rank-stable (reference: driver.py:240-283)."""
+        with self._assignment_cv:
+            surviving = [h for h in self._host_order if h in hosts]
+            new = [h for h in hosts if h not in surviving]
+            order = surviving + new
+            host_infos = [HostInfo(h, hosts[h]) for h in order]
+            np_target = sum(hosts.values())
+            if self._max_np:
+                np_target = min(np_target, self._max_np)
+            assignment = get_host_assignments(host_infos, np_target)
+            self._host_order = order
+            self._assignment = assignment
+            self._version += 1
+            version = self._version
+            self._assignment_cv.notify_all()
+        hvd_logging.info("new assignment v%d over hosts %s", version, order)
+        self._reset_count += 1
+        if self._reset_limit is not None \
+                and self._reset_count > self._reset_limit:
+            self.stop(f"reset limit {self._reset_limit} exceeded")
+            raise RuntimeError(
+                f"elastic reset limit {self._reset_limit} exceeded")
+        self._spawn_fn(assignment, version)
+
+    def assignment(self):
+        with self._assignment_cv:
+            return list(self._assignment), self._version
+
+    def wait_for_assignment_change(self, known_version, timeout=None):
+        with self._assignment_cv:
+            self._assignment_cv.wait_for(
+                lambda: self._version != known_version, timeout=timeout)
+            return list(self._assignment), self._version
+
+    # --- worker results ------------------------------------------------
+    def record_worker_exit(self, host, exit_code):
+        """reference: driver.py:304+ _handle_worker_exit — failed hosts are
+        cooled down/blacklisted and the assignment recomputed."""
+        self.results[host] = exit_code
+        if exit_code != 0:
+            self._host_manager.record_failure(host)
+            hosts = self._host_manager.current_hosts()
+            if sum(hosts.values()) >= self._min_np:
+                self.update_host_assignments(hosts)
+
+
+def run_elastic_driver(args):
+    """CLI glue for ``hvdrun --min-np … --host-discovery-script …``."""
+    import socket
+
+    from horovod_tpu.runner.elastic.discovery import (FixedHosts,
+                                                      HostDiscoveryScript)
+    from horovod_tpu.runner.exec import WorkerProcess
+    from horovod_tpu.runner.http_kv import KVStoreServer
+    from horovod_tpu.runner.launch import _free_port, build_worker_env
+    from horovod_tpu.runner.hosts import (host_assignment_by_host, parse_hosts)
+
+    if args.host_discovery_script:
+        discovery = HostDiscoveryScript(args.host_discovery_script,
+                                        args.slots_per_host or 1)
+    elif args.hosts:
+        discovery = FixedHosts(parse_hosts(args.hosts))
+    else:
+        raise ValueError("elastic mode needs --host-discovery-script or -H")
+
+    kv = KVStoreServer()
+    kv_port = kv.start()
+    coordinator_addr = socket.gethostname()
+    state = {"workers": {}, "done": threading.Event(), "rc": 0,
+             "version": 0, "lock": threading.Lock()}
+
+    def spawn(assignment, version):
+        with state["lock"]:
+            # Terminations of superseded workers are intentional — their
+            # _watch threads must not report them as host failures.
+            state["version"] = version
+            old = list(state["workers"].values())
+            state["workers"].clear()
+        for w in old:
+            w.terminate()
+        kv.put("elastic", "version", str(version).encode())
+        coordinator_port = _free_port()
+        by_host = host_assignment_by_host(assignment)
+        for host, slots in by_host.items():
+            env = build_worker_env({"HOROVOD_ELASTIC": "1"}, slots,
+                                   coordinator_addr, coordinator_port,
+                                   kv_port, args)
+            w = WorkerProcess(host, args.command, env, tag=f"{host}@v{version}")
+            with state["lock"]:
+                state["workers"][host] = w
+            threading.Thread(target=_watch, args=(host, w, version),
+                             daemon=True).start()
+
+    def _watch(host, worker, version):
+        rc = worker.wait()
+        with state["lock"]:
+            stale = version != state["version"] \
+                or state["workers"].get(host) is not worker
+            if not stale:
+                state["workers"].pop(host, None)
+                remaining = bool(state["workers"])
+        if stale:
+            return  # superseded by a newer assignment; expected termination
+        driver.record_worker_exit(host, rc)
+        if not remaining:
+            state["rc"] = max(abs(rc or 0), state["rc"])
+            state["done"].set()
+
+    def shutdown(reason):
+        with state["lock"]:
+            workers = list(state["workers"].values())
+        for w in workers:
+            w.terminate()
+        if reason != "driver stop":
+            state["rc"] = max(state["rc"], 1)
+        state["done"].set()
+
+    driver = ElasticDriver(discovery, args.min_np or 1, args.max_np,
+                           args.reset_limit, spawn_fn=spawn,
+                           shutdown_fn=shutdown)
+    driver.start()
+    try:
+        driver.wait_for_available_slots(args.min_np or 1,
+                                        timeout=args.start_timeout)
+        state["done"].wait()
+        return state["rc"]
+    finally:
+        driver.stop()
+        kv.stop()

@@ -1,0 +1,99 @@
+"""Process fan-out: local subprocess and ssh remote execution.
+
+Reference: horovod/runner/util/remote.py + common/util/safe_shell_exec.py —
+per-slot ssh commands with pty capture, exit-code monitoring threads, and
+index-tagged output streaming (gloo_run.py:242-287).
+"""
+
+import os
+import shlex
+import subprocess
+import sys
+import threading
+
+from horovod_tpu.common import logging as hvd_logging
+
+
+def _stream(proc, tag, out):
+    for line in iter(proc.stdout.readline, b""):
+        out.write(f"[{tag}]<stdout> {line.decode(errors='replace')}")
+        out.flush()
+
+
+class WorkerProcess:
+    def __init__(self, hostname, command, env, tag, use_ssh=None,
+                 ssh_port=None, ssh_identity_file=None):
+        self.hostname = hostname
+        self.tag = tag
+        local = hostname in ("localhost", "127.0.0.1", os.uname().nodename) \
+            if use_ssh is None else not use_ssh
+        if local:
+            full = command
+            run_env = {**os.environ, **env}
+        else:
+            ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+            if ssh_port:
+                ssh += ["-p", str(ssh_port)]
+            if ssh_identity_file:
+                ssh += ["-i", ssh_identity_file]
+            exports = " ".join(f"{k}={shlex.quote(v)}"
+                               for k, v in env.items())
+            full = ssh + [hostname,
+                          f"cd {shlex.quote(os.getcwd())} && env {exports} "
+                          + " ".join(shlex.quote(c) for c in command)]
+            run_env = os.environ.copy()
+        hvd_logging.debug("launching worker %s: %s", tag, full)
+        self.proc = subprocess.Popen(full, env=run_env,
+                                     stdout=subprocess.PIPE,
+                                     stderr=subprocess.STDOUT)
+        self._thread = threading.Thread(
+            target=_stream, args=(self.proc, tag, sys.stdout), daemon=True)
+        self._thread.start()
+
+    def wait(self, timeout=None):
+        rc = self.proc.wait(timeout)
+        self._thread.join(timeout=2)
+        return rc
+
+    def terminate(self):
+        if self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+
+def wait_for_any_failure_or_all_success(workers):
+    """Monitor workers; on any nonzero exit terminate the rest
+    (reference: gloo_run.py:277-287 exit-code monitoring)."""
+    codes = {}
+    codes_lock = threading.Lock()
+    threads = []
+
+    def watch(w):
+        rc = w.wait()
+        with codes_lock:
+            codes[w.tag] = rc
+
+    for w in workers:
+        t = threading.Thread(target=watch, args=(w,), daemon=True)
+        t.start()
+        threads.append(t)
+    while threads:
+        for t in list(threads):
+            t.join(timeout=0.2)
+            if not t.is_alive():
+                threads.remove(t)
+        with codes_lock:
+            snapshot = dict(codes)
+        failed = {k: v for k, v in snapshot.items() if v not in (None, 0)}
+        if failed:
+            for w in workers:
+                if w.tag not in snapshot:
+                    w.terminate()
+            for t in threads:
+                t.join()
+            return failed
+    with codes_lock:
+        return {k: v for k, v in codes.items() if v != 0}

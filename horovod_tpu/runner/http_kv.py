@@ -1,0 +1,141 @@
+"""HTTP key-value store: rendezvous + elastic metadata.
+
+Reference: horovod/runner/http/http_server.py (RendezvousServer/KVStoreServer,
+PUT/GET/DELETE ``/scope/key``) + http_client.py, and the C++ HTTPStore client
+(horovod/common/gloo/http_store.cc) that workers use to rendezvous.
+
+On TPU the heavy rendezvous (full-mesh TCP setup) is gone —
+``jax.distributed`` does process bootstrap — but an out-of-band KV store is
+still the right tool for elastic membership, dynamic-shape size exchange, and
+worker notification, so the server/client pair is kept with the same
+verb/scope protocol.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib import error as urlerror
+from urllib import request as urlrequest
+
+
+class _KVHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence
+        pass
+
+    def _parse(self):
+        parts = self.path.strip("/").split("/", 1)
+        if len(parts) != 2:
+            return None, None
+        return parts[0], parts[1]
+
+    def do_GET(self):
+        scope, key = self._parse()
+        store = self.server.store
+        with self.server.lock:
+            value = store.get(scope, {}).get(key)
+        if value is None:
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        self.send_response(200)
+        self.send_header("Content-Length", str(len(value)))
+        self.end_headers()
+        self.wfile.write(value)
+
+    def do_PUT(self):
+        scope, key = self._parse()
+        length = int(self.headers.get("Content-Length", 0))
+        value = self.rfile.read(length)
+        with self.server.lock:
+            self.server.store.setdefault(scope, {})[key] = value
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+    def do_DELETE(self):
+        scope, key = self._parse()
+        with self.server.lock:
+            if key == "*":
+                self.server.store.pop(scope, None)
+            else:
+                self.server.store.get(scope, {}).pop(key, None)
+        self.send_response(200)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+
+
+class KVStoreServer:
+    """reference: http_server.py KVStoreServer (threaded, scoped KV)."""
+
+    def __init__(self, port=0):
+        self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
+        self._httpd.store = {}
+        self._httpd.lock = threading.Lock()
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(target=self._httpd.serve_forever,
+                                        daemon=True)
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=2)
+
+    # Direct (in-process) access for the driver side.
+    def get(self, scope, key):
+        with self._httpd.lock:
+            return self._httpd.store.get(scope, {}).get(key)
+
+    def put(self, scope, key, value):
+        with self._httpd.lock:
+            self._httpd.store.setdefault(scope, {})[key] = value
+
+
+class KVStoreClient:
+    """reference: http_client.py read_data_from_kvstore/put_data_into_kvstore."""
+
+    def __init__(self, addr, port, timeout=30):
+        self._base = f"http://{addr}:{port}"
+        self._timeout = timeout
+
+    def get(self, scope, key):
+        try:
+            with urlrequest.urlopen(f"{self._base}/{scope}/{key}",
+                                    timeout=self._timeout) as r:
+                return r.read()
+        except urlerror.HTTPError as e:
+            if e.code == 404:
+                return None
+            raise
+
+    def put(self, scope, key, value: bytes):
+        req = urlrequest.Request(f"{self._base}/{scope}/{key}", data=value,
+                                 method="PUT")
+        with urlrequest.urlopen(req, timeout=self._timeout):
+            pass
+
+    def delete(self, scope, key="*"):
+        req = urlrequest.Request(f"{self._base}/{scope}/{key}",
+                                 method="DELETE")
+        with urlrequest.urlopen(req, timeout=self._timeout):
+            pass
+
+    def wait_for(self, scope, key, timeout=60, interval=0.1):
+        import time
+        deadline = time.time() + timeout
+        while time.time() < deadline:
+            v = self.get(scope, key)
+            if v is not None:
+                return v
+            time.sleep(interval)
+        raise TimeoutError(f"KV key {scope}/{key} not set within {timeout}s")

@@ -1,0 +1,229 @@
+"""``hvdrun`` — the launcher CLI.
+
+Reference: horovod/runner/launch.py (``horovodrun``; arg surface :286-596,
+``_run``:806, ``run_commandline``:830). Differences by design:
+
+- No gloo/mpi/jsrun controller selection — the data plane is XLA over ICI/DCN
+  and bootstrap is ``jax.distributed``; the launcher only chooses hosts.
+- One worker process per *host* (it owns all local chips), not per slot.
+- The rendezvous HTTP-KV server still exists, serving elastic membership and
+  out-of-band metadata (reference: RendezvousServer http_server.py:192).
+
+Example::
+
+    hvdrun -np 8 -H host1:4,host2:4 python train.py
+    hvdrun -np 2 --min-np 1 --max-np 4 --host-discovery-script ./disc.sh \
+        python train.py     # elastic
+"""
+
+import argparse
+import os
+import socket
+import sys
+
+from horovod_tpu.common import logging as hvd_logging
+from horovod_tpu.runner import config_parser
+from horovod_tpu.runner.exec import (WorkerProcess,
+                                     wait_for_any_failure_or_all_success)
+from horovod_tpu.runner.hosts import (get_host_assignments,
+                                      host_assignment_by_host, parse_host_files,
+                                      parse_hosts)
+from horovod_tpu.runner.http_kv import KVStoreServer
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="hvdrun",
+        description="Launch TPU-native Horovod training across hosts.")
+    p.add_argument("-v", "--version", action="store_true")
+    p.add_argument("-np", "--num-proc", dest="np", type=int,
+                   help="Total number of chips (ranks).")
+    p.add_argument("-H", "--hosts", dest="hosts",
+                   help="host1:chips,host2:chips list.")
+    p.add_argument("-hostfile", "--hostfile", dest="hostfile",
+                   help="Hostfile with 'host slots=N' lines.")
+    p.add_argument("--ssh-port", type=int, dest="ssh_port")
+    p.add_argument("--ssh-identity-file", dest="ssh_identity_file")
+    p.add_argument("--verbose", action="store_true")
+    p.add_argument("--config-file", dest="config_file")
+    p.add_argument("--check-build", action="store_true")
+    p.add_argument("--start-timeout", type=int, default=600,
+                   dest="start_timeout")
+    p.add_argument("--disable-cache", action="store_true")
+
+    tuning = p.add_argument_group("tuning")
+    tuning.add_argument("--fusion-threshold-mb", type=float,
+                        dest="fusion_threshold_mb")
+    tuning.add_argument("--cycle-time-ms", type=float, dest="cycle_time_ms")
+    tuning.add_argument("--cache-capacity", type=int, dest="cache_capacity")
+    tuning.add_argument("--hierarchical-allreduce", action="store_true",
+                        dest="hierarchical_allreduce")
+    tuning.add_argument("--hierarchical-allgather", action="store_true",
+                        dest="hierarchical_allgather")
+    tuning.add_argument("--torus-allreduce", action="store_true",
+                        dest="torus_allreduce",
+                        help="2-level ICI/DCN torus allreduce "
+                             "(fork knob HOROVOD_TORUS_ALLREDUCE)")
+    tuning.add_argument("--wire-dtype", dest="wire_dtype",
+                        choices=["", "bfloat16", "float16"])
+
+    autotune = p.add_argument_group("autotune")
+    autotune.add_argument("--autotune", action="store_true", dest="autotune")
+    autotune.add_argument("--autotune-log-file", dest="autotune_log_file")
+    autotune.add_argument("--autotune-warmup-samples", type=int,
+                          dest="autotune_warmup_samples")
+    autotune.add_argument("--autotune-steps-per-sample", type=int,
+                          dest="autotune_steps_per_sample")
+    autotune.add_argument("--autotune-bayes-opt-max-samples", type=int,
+                          dest="autotune_bayes_opt_max_samples")
+    autotune.add_argument("--autotune-gaussian-process-noise", type=float,
+                          dest="autotune_gaussian_process_noise")
+
+    timeline = p.add_argument_group("timeline")
+    timeline.add_argument("--timeline-filename", dest="timeline_filename")
+    timeline.add_argument("--timeline-mark-cycles", action="store_true",
+                          dest="timeline_mark_cycles")
+
+    stall = p.add_argument_group("stall")
+    stall.add_argument("--no-stall-check", action="store_true",
+                       dest="no_stall_check")
+    stall.add_argument("--stall-check-warning-time-seconds", type=float,
+                       dest="stall_check_warning_time_seconds")
+    stall.add_argument("--stall-check-shutdown-time-seconds", type=float,
+                       dest="stall_check_shutdown_time_seconds")
+
+    elastic = p.add_argument_group("elastic")
+    elastic.add_argument("--min-np", type=int, dest="min_np")
+    elastic.add_argument("--max-np", type=int, dest="max_np")
+    elastic.add_argument("--slots-per-host", type=int, dest="slots_per_host")
+    elastic.add_argument("--host-discovery-script",
+                         dest="host_discovery_script")
+    elastic.add_argument("--reset-limit", type=int, dest="reset_limit")
+
+    logg = p.add_argument_group("logging")
+    logg.add_argument("--log-level", dest="log_level",
+                      choices=["trace", "debug", "info", "warning", "error",
+                               "fatal"])
+    logg.add_argument("--log-hide-timestamp", action="store_true",
+                      dest="log_hide_timestamp")
+
+    p.add_argument("command", nargs=argparse.REMAINDER,
+                   help="Training command, e.g. python train.py")
+    args = p.parse_args(argv)
+    if args.config_file:
+        config_parser.parse_config_file(args, args.config_file)
+    return args
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _resolve_hosts(args):
+    if args.hostfile:
+        return parse_host_files(args.hostfile)
+    if args.hosts:
+        return parse_hosts(args.hosts)
+    # Default: all local chips, single host (reference defaults to
+    # localhost:np, launch.py).
+    nlocal = args.np or 1
+    return parse_hosts(f"localhost:{nlocal}")
+
+
+def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
+                     coordinator_port, kv_port, args):
+    """Per-host env (reference: gloo_run.py:66-78, 203-227 — the rank/size env
+    contract between launcher and core)."""
+    first = slot_infos_for_host[0]
+    env = dict(base_env)
+    env.update({
+        "HOROVOD_RANK": str(first.rank),
+        "HOROVOD_SIZE": str(first.size),
+        "HOROVOD_LOCAL_RANK": str(first.local_rank),
+        "HOROVOD_LOCAL_SIZE": str(first.local_size),
+        "HOROVOD_CROSS_RANK": str(first.cross_rank),
+        "HOROVOD_CROSS_SIZE": str(first.cross_size),
+        "HOROVOD_COORDINATOR_ADDR": coordinator_addr,
+        "HOROVOD_COORDINATOR_PORT": str(coordinator_port),
+        "HOROVOD_KV_ADDR": coordinator_addr,
+        "HOROVOD_KV_PORT": str(kv_port),
+    })
+    config_parser.set_env_from_args(env, args)
+    return env
+
+
+def _run_static(args, extra_env=None, harvest=None):
+    hosts = _resolve_hosts(args)
+    slot_infos = get_host_assignments(hosts, args.np or None)
+    by_host = host_assignment_by_host(slot_infos)
+
+    coordinator_addr = socket.gethostname() \
+        if len(by_host) > 1 else "localhost"
+    coordinator_port = _free_port()
+    kv = KVStoreServer()
+    kv_port = kv.start()
+    kv.put("global", "size", str(slot_infos[0].size).encode())
+
+    workers = []
+    try:
+        for host, slots in by_host.items():
+            env = build_worker_env(dict(extra_env or {}), slots,
+                                   coordinator_addr, coordinator_port,
+                                   kv_port, args)
+            workers.append(WorkerProcess(
+                host, args.command, env, tag=f"{host}",
+                ssh_port=args.ssh_port,
+                ssh_identity_file=args.ssh_identity_file))
+        failures = wait_for_any_failure_or_all_success(workers)
+        if failures:
+            hvd_logging.error("workers failed: %s", failures)
+            return 1
+        if harvest is not None:
+            harvest(kv)
+        return 0
+    finally:
+        kv.stop()
+
+
+def _run_elastic(args):
+    from horovod_tpu.runner.elastic.driver import run_elastic_driver
+    return run_elastic_driver(args)
+
+
+def run_commandline(argv=None):
+    args = parse_args(argv)
+    if args.version:
+        from horovod_tpu.version import __version__
+        print(__version__)
+        return 0
+    if args.check_build:
+        from horovod_tpu.version import __version__
+        print(f"Horovod-TPU v{__version__}:\n\n"
+              "Available Frameworks:\n    [X] JAX/Flax\n\n"
+              "Available Backends:\n    [X] XLA/ICI\n    [X] XLA/DCN\n\n"
+              "Available Controllers:\n    [X] jax.distributed\n\n"
+              "Available Features:\n    [X] elastic\n    [X] autotune\n"
+              "    [X] timeline\n    [X] process sets\n")
+        return 0
+    if not args.command:
+        print("error: no training command given", file=sys.stderr)
+        return 2
+    if args.log_level:
+        os.environ["HOROVOD_LOG_LEVEL"] = args.log_level
+    try:
+        if args.host_discovery_script or args.min_np or args.max_np:
+            return _run_elastic(args)
+        return _run_static(args)
+    except (ValueError, TimeoutError) as e:
+        print(f"hvdrun: error: {e}", file=sys.stderr)
+        return 1
+
+
+def main():
+    sys.exit(run_commandline())
+
+
+if __name__ == "__main__":
+    main()

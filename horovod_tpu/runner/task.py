@@ -1,0 +1,39 @@
+"""Worker-side bootstrap for ``horovod_tpu.run()``.
+
+Reference: horovod/runner/run_task.py + task_fn.py — loads the cloudpickled
+function, initializes, executes, reports the result.
+"""
+
+import os
+import sys
+
+import cloudpickle
+
+
+def main():
+    fn_path = sys.argv[1]
+    with open(fn_path, "rb") as f:
+        func, args, kwargs = cloudpickle.load(f)
+
+    # Site hooks may force a platform via jax.config at interpreter start,
+    # overriding JAX_PLATFORMS; re-assert the launcher's env choice.
+    plat = os.environ.get("JAX_PLATFORMS")
+    if plat:
+        import jax
+        jax.config.update("jax_platforms", plat)
+
+    import horovod_tpu as hvd
+    hvd.init()
+    result = func(*args, **kwargs)
+
+    kv_addr = os.environ.get("HOROVOD_KV_ADDR")
+    kv_port = os.environ.get("HOROVOD_KV_PORT")
+    if kv_addr and kv_port:
+        from horovod_tpu.runner.http_kv import KVStoreClient
+        KVStoreClient(kv_addr, int(kv_port)).put(
+            "results", str(hvd.cross_rank()), cloudpickle.dumps(result))
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

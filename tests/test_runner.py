@@ -1,0 +1,273 @@
+"""Launcher/control-plane unit tests.
+
+Modeled on reference test/single/test_run.py (arg parsing, host parsing, env
+construction — 1199 LoC) and test/single/test_elastic_driver.py (in-process
+driver simulation with synthetic host lists, :46-509).
+"""
+
+import os
+
+import pytest
+
+
+class TestHosts:
+    def test_parse_hosts(self):
+        from horovod_tpu.runner.hosts import parse_hosts
+        hs = parse_hosts("a:4,b:2,c")
+        assert [(h.hostname, h.slots) for h in hs] == [
+            ("a", 4), ("b", 2), ("c", 1)]
+
+    def test_parse_host_files(self, tmp_path):
+        from horovod_tpu.runner.hosts import parse_host_files
+        f = tmp_path / "hf"
+        f.write_text("h1 slots=4\n# comment\nh2:2\nh3\n")
+        hs = parse_host_files(str(f))
+        assert [(h.hostname, h.slots) for h in hs] == [
+            ("h1", 4), ("h2", 2), ("h3", 1)]
+
+    def test_assignments(self):
+        from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
+        slots = get_host_assignments(parse_hosts("a:4,b:4"), 8)
+        assert len(slots) == 8
+        assert slots[0].rank == 0 and slots[0].local_rank == 0
+        assert slots[0].cross_rank == 0 and slots[0].hostname == "a"
+        assert slots[4].hostname == "b" and slots[4].local_rank == 0
+        assert slots[4].cross_rank == 1
+        assert all(s.size == 8 and s.local_size == 4 and s.cross_size == 2
+                   for s in slots)
+
+    def test_assignment_partial(self):
+        from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
+        slots = get_host_assignments(parse_hosts("a:4,b:4"), 6)
+        assert len(slots) == 6
+        assert slots[5].hostname == "b" and slots[5].local_size == 2
+
+    def test_oversubscription_raises(self):
+        from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
+        with pytest.raises(ValueError):
+            get_host_assignments(parse_hosts("a:2"), 4)
+
+
+class TestArgsAndEnv:
+    def test_parse_args_tunables(self):
+        from horovod_tpu.runner.launch import parse_args
+        args = parse_args([
+            "-np", "8", "-H", "h1:4,h2:4", "--fusion-threshold-mb", "32",
+            "--cycle-time-ms", "2.5", "--torus-allreduce", "--autotune",
+            "--timeline-filename", "/tmp/t.json", "--log-level", "debug",
+            "python", "train.py"])
+        assert args.np == 8 and args.hosts == "h1:4,h2:4"
+        assert args.command == ["python", "train.py"]
+        assert args.torus_allreduce and args.autotune
+
+    def test_env_construction(self):
+        """The env contract between launcher and core
+        (reference: gloo_run.py:66-78,203-227)."""
+        from horovod_tpu.runner.hosts import get_host_assignments, parse_hosts
+        from horovod_tpu.runner.launch import build_worker_env, parse_args
+        args = parse_args(["-np", "8", "--fusion-threshold-mb", "32",
+                           "--torus-allreduce", "python", "x.py"])
+        slots = get_host_assignments(parse_hosts("h1:4,h2:4"), 8)
+        env = build_worker_env({}, [s for s in slots if s.hostname == "h2"],
+                               "coord", 1234, 5678, args)
+        assert env["HOROVOD_RANK"] == "4"
+        assert env["HOROVOD_SIZE"] == "8"
+        assert env["HOROVOD_LOCAL_RANK"] == "0"
+        assert env["HOROVOD_CROSS_RANK"] == "1"
+        assert env["HOROVOD_CROSS_SIZE"] == "2"
+        assert env["HOROVOD_COORDINATOR_ADDR"] == "coord"
+        assert env["HOROVOD_FUSION_THRESHOLD"] == str(32 * 1024 * 1024)
+        assert env["HOROVOD_TORUS_ALLREDUCE"] == "1"
+
+    def test_config_file_yaml(self, tmp_path):
+        from horovod_tpu.runner.launch import parse_args
+        cfg = tmp_path / "cfg.yaml"
+        cfg.write_text("tuning:\n  fusion-threshold-mb: 16\n  "
+                       "cycle-time-ms: 5\nnp: 4\n")
+        args = parse_args(["--config-file", str(cfg), "python", "x.py"])
+        assert args.fusion_threshold_mb == 16
+        assert args.cycle_time_ms == 5
+        assert args.np == 4
+
+    def test_check_build(self, capsys):
+        from horovod_tpu.runner.launch import run_commandline
+        assert run_commandline(["--check-build"]) == 0
+        out = capsys.readouterr().out
+        assert "XLA/ICI" in out and "elastic" in out
+
+
+class TestKVStore:
+    def test_put_get_delete_roundtrip(self):
+        from horovod_tpu.runner.http_kv import KVStoreClient, KVStoreServer
+        srv = KVStoreServer()
+        port = srv.start()
+        try:
+            cli = KVStoreClient("localhost", port)
+            assert cli.get("s", "missing") is None
+            cli.put("s", "k", b"hello")
+            assert cli.get("s", "k") == b"hello"
+            assert srv.get("s", "k") == b"hello"
+            cli.delete("s", "k")
+            assert cli.get("s", "k") is None
+            cli.put("s", "k2", b"x")
+            assert cli.wait_for("s", "k2", timeout=2) == b"x"
+        finally:
+            srv.stop()
+
+
+class TestRunApi:
+    def test_single_host_inprocess(self, hvd):
+        from horovod_tpu.runner import run
+
+        def fn(a, b=1):
+            import horovod_tpu as h
+            return h.size() * a + b
+
+        assert run(fn, args=(2,), kwargs={"b": 3}) == [8 * 2 + 3]
+
+    def test_multiprocess_launch_collects_results(self, hvd):
+        """Full run() round trip: spawn 2 jax.distributed processes on
+        localhost aliases, collect per-host results via the KV store
+        (reference tier-3: test_interactiverun.py)."""
+        from horovod_tpu.runner import run
+
+        def fn(tag):
+            import horovod_tpu as h
+            return (tag, h.cross_rank(), h.process_count())
+
+        results = run(fn, args=("ok",), hosts="localhost:1,127.0.0.1:1")
+        assert results == [("ok", 0, 2), ("ok", 1, 2)]
+
+
+class TestElasticDriver:
+    """In-process simulation with synthetic host sets
+    (reference: test_elastic_driver.py drives _update_host_assignments)."""
+
+    def _driver(self, hosts_dict, min_np=2, max_np=None, **kw):
+        from horovod_tpu.runner.elastic.driver import ElasticDriver
+
+        class FakeDiscovery:
+            def __init__(self):
+                self.hosts = dict(hosts_dict)
+
+            def find_available_hosts_and_slots(self):
+                return dict(self.hosts)
+
+        spawned = []
+        d = ElasticDriver(FakeDiscovery(), min_np, max_np,
+                          spawn_fn=lambda a, v: spawned.append((v, a)), **kw)
+        return d, d._host_manager._discovery, spawned
+
+    def test_initial_assignment(self):
+        d, disc, spawned = self._driver({"a": 2, "b": 2})
+        d._maybe_update(disc.find_available_hosts_and_slots())
+        assert len(spawned) == 1
+        version, assignment = spawned[0]
+        assert len(assignment) == 4
+        assert {s.hostname for s in assignment} == {"a", "b"}
+
+    def test_host_added_preserves_ranks(self):
+        d, disc, spawned = self._driver({"a": 2, "b": 2})
+        d._maybe_update(disc.find_available_hosts_and_slots())
+        disc.hosts["c"] = 2
+        d._maybe_update(disc.find_available_hosts_and_slots())
+        _, assignment = spawned[-1]
+        # surviving hosts keep their leading ranks; new host appended
+        assert assignment[0].hostname in ("a", "b")
+        assert assignment[-1].hostname == "c"
+        assert assignment[-1].rank == 5
+
+    def test_host_removed_below_min_waits(self):
+        d, disc, spawned = self._driver({"a": 2, "b": 2}, min_np=3)
+        d._maybe_update(disc.find_available_hosts_and_slots())
+        disc.hosts = {"a": 2}  # below min_np=3
+        d._maybe_update(disc.find_available_hosts_and_slots())
+        assert len(spawned) == 1  # no new assignment
+
+    def test_worker_failure_blacklists_and_reassigns(self):
+        d, disc, spawned = self._driver({"a": 2, "b": 2})
+        d._maybe_update(disc.find_available_hosts_and_slots())
+        disc.hosts = {"a": 2, "b": 2, "c": 2}
+        d.record_worker_exit("b", 1)  # b cools down -> excluded
+        _, assignment = spawned[-1]
+        names = {s.hostname for s in assignment}
+        assert "b" not in names and "c" in names
+
+    def test_reset_limit(self):
+        d, disc, spawned = self._driver({"a": 2}, min_np=1, reset_limit=1)
+        d._maybe_update(disc.find_available_hosts_and_slots())
+        disc.hosts = {"a": 2, "b": 2}
+        with pytest.raises(RuntimeError, match="reset limit"):
+            d._maybe_update(disc.find_available_hosts_and_slots())
+
+    def test_wait_for_available_slots(self):
+        d, disc, spawned = self._driver({"a": 2, "b": 2})
+        hosts = d.wait_for_available_slots(4, timeout=5)
+        assert sum(hosts.values()) == 4
+        with pytest.raises(TimeoutError):
+            d.wait_for_available_slots(100, timeout=0.5)
+
+
+class TestElasticState:
+    def test_object_state_commit_restore(self, hvd):
+        from horovod_tpu.elastic import ObjectState
+        s = ObjectState(epoch=0, batch=0)
+        s.epoch = 5
+        s.commit()
+        s.epoch = 7
+        s.restore()
+        assert s.epoch == 5
+
+    def test_tpu_state_trees(self, hvd, rng):
+        import numpy as np
+        from horovod_tpu.elastic import TpuState
+        p0 = {"w": np.ones(4, np.float32)}
+        s = TpuState(trees={"params": p0}, epoch=0)
+        assert s.params is p0
+        s.commit()
+        s.params = {"w": np.zeros(4, np.float32)}
+        s.restore()
+        np.testing.assert_array_equal(s.params["w"], np.ones(4))
+        s.sync()  # broadcast from rank 0 must be a no-op value-wise
+        np.testing.assert_allclose(np.asarray(s.params["w"]), np.ones(4))
+
+    def test_run_decorator_retries(self, hvd):
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        from horovod_tpu.elastic import ObjectState, run
+
+        calls = {"n": 0}
+
+        @run
+        def train(state):
+            calls["n"] += 1
+            if calls["n"] == 1:
+                state.counter = 99  # uncommitted progress
+                raise HorovodInternalError("fake collective failure")
+            return state.counter
+
+        s = ObjectState(counter=1)
+        s.commit()
+        assert train(s) == 1  # restored to committed value
+        assert calls["n"] == 2
+
+
+class TestHostDiscoveryScript:
+    def test_script_parsing(self, tmp_path):
+        from horovod_tpu.runner.elastic.discovery import HostDiscoveryScript
+        script = tmp_path / "disc.sh"
+        script.write_text("#!/bin/sh\necho host1:4\necho host2\n")
+        script.chmod(0o755)
+        d = HostDiscoveryScript(str(script), default_slots=2)
+        hosts = d.find_available_hosts_and_slots()
+        assert hosts == {"host1": 4, "host2": 2}
+
+    def test_cooldown(self):
+        from horovod_tpu.runner.elastic.discovery import HostState
+        hs = HostState()
+        assert hs.usable()
+        hs.record_failure()
+        assert not hs.usable()
+        hs.cooldown_until = 0  # simulate elapse
+        assert hs.usable()
+        hs.blacklist()
+        assert not hs.usable()
