@@ -1,0 +1,1 @@
+from horovod_tpu.autotune.parameter_manager import ParameterManager  # noqa: F401

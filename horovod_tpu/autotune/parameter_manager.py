@@ -1,0 +1,106 @@
+"""Online autotuning of runtime knobs.
+
+Reference: horovod/common/parameter_manager.cc/.h (544+257 LoC) — tunes the
+fusion threshold and cycle time with Bayesian optimization (log2-scaled
+NumericParameter, scored by bytes-reduced-per-second), plus categorical knobs,
+over warmup/sample windows; winning parameters are logged and frozen after
+``bayes_opt_max_samples``.
+
+TPU adaptation: the knobs that still exist are the eager fusion runtime's
+``fusion_threshold`` (bucket bytes) and the wire dtype; jitted steps have no
+cycle loop to tune. Scoring is identical: bytes per second of reduced data
+over a sample window. The manager is wired into
+:class:`horovod_tpu.ops.fusion.FusionRuntime`, which reports each flush.
+"""
+
+import time
+
+import numpy as np
+
+from horovod_tpu.common import logging as hvd_logging
+from horovod_tpu.autotune.bayesian_optimization import BayesianOptimization
+
+
+class ParameterManager:
+    """reference: parameter_manager.h:42-252 ParameterManager."""
+
+    # log2 bounds for fusion threshold: 1 MB .. 256 MB
+    # (reference: NumericParameter fusion threshold 0..64MB log-scaled)
+    _LOG2_LOW = 20.0
+    _LOG2_HIGH = 28.0
+
+    def __init__(self, warmup_samples=3, steps_per_sample=10,
+                 bayes_opt_max_samples=20, gaussian_process_noise=0.8,
+                 log_file=None, initial_threshold=64 * 1024 * 1024):
+        self._warmup_remaining = warmup_samples
+        self._steps_per_sample = steps_per_sample
+        self._max_samples = bayes_opt_max_samples
+        self._bo = BayesianOptimization(
+            bounds=[[self._LOG2_LOW, self._LOG2_HIGH]],
+            alpha=gaussian_process_noise)
+        self._log_file = log_file
+        # clamp into tuning bounds (threshold 0 = "fusion disabled" would
+        # otherwise poison the GP with -inf)
+        self._current = float(np.clip(
+            np.log2(max(initial_threshold, 1)),
+            self._LOG2_LOW, self._LOG2_HIGH))
+        self._samples = 0
+        self._tuning = True
+        self._window_bytes = 0
+        self._window_steps = 0
+        self._window_start = time.perf_counter()
+        self._best = (None, -np.inf)
+        if self._log_file:
+            with open(self._log_file, "w") as f:
+                f.write("sample,fusion_threshold,score_bytes_per_sec\n")
+
+    @property
+    def fusion_threshold(self):
+        return int(2 ** self._current)
+
+    @property
+    def tuning(self):
+        return self._tuning
+
+    def record(self, nbytes):
+        """Report one flush of ``nbytes`` reduced bytes
+        (reference: ParameterManager::Update per-tensor byte accounting)."""
+        if not self._tuning:
+            return None
+        self._window_bytes += nbytes
+        self._window_steps += 1
+        if self._window_steps < self._steps_per_sample:
+            return None
+        return self._end_sample()
+
+    def _end_sample(self):
+        elapsed = max(time.perf_counter() - self._window_start, 1e-9)
+        score = self._window_bytes / elapsed
+        self._window_bytes = 0
+        self._window_steps = 0
+        self._window_start = time.perf_counter()
+
+        if self._warmup_remaining > 0:
+            # discard warmup windows (reference: warmup_samples)
+            self._warmup_remaining -= 1
+            return self.fusion_threshold
+
+        self._samples += 1
+        self._bo.add_sample([self._current], score)
+        if score > self._best[1]:
+            self._best = (self._current, score)
+        if self._log_file:
+            with open(self._log_file, "a") as f:
+                f.write(f"{self._samples},{self.fusion_threshold},"
+                        f"{score:.1f}\n")
+
+        if self._samples >= self._max_samples:
+            # freeze at the best observed configuration
+            self._current = self._best[0]
+            self._tuning = False
+            hvd_logging.info(
+                "autotune converged: fusion_threshold=%d (%.1f MB/s)",
+                self.fusion_threshold, self._best[1] / 1e6)
+        else:
+            self._current = float(self._bo.next_sample()[0])
+        return self.fusion_threshold

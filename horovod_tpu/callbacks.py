@@ -1,0 +1,161 @@
+"""Training-loop callbacks for flax/optax loops.
+
+Reference: horovod/_keras/callbacks.py (:23-193) — the four Horovod Keras
+callbacks: BroadcastGlobalVariablesCallback, MetricAverageCallback,
+LearningRateScheduleCallback, LearningRateWarmupCallback.
+
+Flax has no Model.fit, so these are loop-agnostic objects driven by a
+``CallbackList`` the user invokes at the standard hook points; semantics match
+the reference callback-for-callback.
+"""
+
+import numpy as np
+
+from horovod_tpu.common import basics
+
+
+class Callback:
+    def on_train_begin(self, state):
+        return state
+
+    def on_epoch_begin(self, epoch, state):
+        return state
+
+    def on_epoch_end(self, epoch, state, metrics):
+        return state, metrics
+
+    def on_batch_begin(self, batch, state):
+        return state
+
+
+class CallbackList:
+    def __init__(self, callbacks):
+        self.callbacks = list(callbacks)
+
+    def on_train_begin(self, state):
+        for c in self.callbacks:
+            state = c.on_train_begin(state)
+        return state
+
+    def on_epoch_begin(self, epoch, state):
+        for c in self.callbacks:
+            state = c.on_epoch_begin(epoch, state)
+        return state
+
+    def on_epoch_end(self, epoch, state, metrics):
+        for c in self.callbacks:
+            state, metrics = c.on_epoch_end(epoch, state, metrics)
+        return state, metrics
+
+    def on_batch_begin(self, batch, state):
+        for c in self.callbacks:
+            state = c.on_batch_begin(batch, state)
+        return state
+
+
+class BroadcastGlobalVariablesCallback(Callback):
+    """Broadcast initial state from ``root_rank`` at train start so all ranks
+    begin identical (reference: _keras/callbacks.py:23-49)."""
+
+    def __init__(self, root_rank=0):
+        self.root_rank = root_rank
+
+    def on_train_begin(self, state):
+        from horovod_tpu.optim import broadcast_parameters
+        return broadcast_parameters(state, root_rank=self.root_rank)
+
+
+class MetricAverageCallback(Callback):
+    """Average epoch metrics over ranks (reference: callbacks.py:52-84).
+
+    Metrics computed by :func:`horovod_tpu.parallel.make_eval_step` arrive
+    pre-averaged; this callback handles host-side python metrics given as
+    ``{name: per_rank_list_or_scalar}``.
+    """
+
+    def on_epoch_end(self, epoch, state, metrics):
+        out = {}
+        for k, v in metrics.items():
+            arr = np.asarray(v, np.float64)
+            out[k] = float(arr.mean()) if arr.ndim else float(arr)
+        return state, out
+
+
+class LearningRateScheduleCallback(Callback):
+    """Multiply the base LR by ``multiplier`` within [start_epoch, end_epoch)
+    (reference: callbacks.py:87-143). ``multiplier`` may be a constant or a
+    function of epoch; with ``staircase`` the epoch is floored.
+
+    The reference's ``momentum_correction`` (rescaling the momentum buffer
+    when LR jumps) is intentionally absent: it mutates optimizer-internal
+    state, which in optax belongs to the optimizer — use
+    ``optax.inject_hyperparams`` + a momentum-aware schedule instead.
+    """
+
+    def __init__(self, initial_lr, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, steps_per_epoch=None):
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.current_lr = initial_lr
+        self._epoch = 0
+        if callable(multiplier):
+            self.multiplier = multiplier
+            self._constant = False
+        else:
+            self.multiplier = lambda epoch: multiplier
+            self._constant = True
+
+    def _in_range(self, epoch):
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def lr(self, epoch, batch=0):
+        if not self._in_range(epoch):
+            return self.current_lr
+        e = epoch if self.staircase or not self.steps_per_epoch \
+            else epoch + batch / float(self.steps_per_epoch)
+        self.current_lr = self.initial_lr * self.multiplier(e)
+        return self.current_lr
+
+    def on_epoch_begin(self, epoch, state):
+        self._epoch = epoch
+        self.lr(epoch)
+        return state
+
+    def on_batch_begin(self, batch, state):
+        # smooth (non-staircase) ramp advances within the epoch tracked by
+        # on_epoch_begin (reference: callbacks.py on_batch_begin)
+        if not self.staircase and self.steps_per_epoch:
+            self.lr(self._epoch, batch)
+        return state
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Gradual LR warmup from ``initial_lr`` to ``initial_lr * size`` over
+    ``warmup_epochs`` — the "Facebook paper" ramp the reference implements
+    (reference: callbacks.py:146-193)."""
+
+    def __init__(self, initial_lr, warmup_epochs=5, steps_per_epoch=None,
+                 verbose=False, size=None):
+        self.size = size if size is not None else basics.size()
+        warmup = warmup_epochs
+
+        def multiplier(epoch):
+            # epoch may be fractional when steps_per_epoch is given
+            progress = min(max(epoch / float(warmup), 0.0), 1.0)
+            return 1.0 / self.size + progress * (1.0 - 1.0 / self.size)
+
+        super().__init__(initial_lr=initial_lr * self.size,
+                         multiplier=multiplier, start_epoch=0,
+                         end_epoch=warmup, staircase=False,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose
+
+    def _in_range(self, epoch):
+        # The warmup multiplier clamps to 1 past warmup_epochs, so always
+        # computing keeps LR at size*base after the ramp ends.
+        return True

@@ -147,7 +147,7 @@ def shutdown():
         _state.shutdown_called = True
         if _state.fusion is not None:
             try:
-                _state.fusion.flush_all()
+                _state.fusion.shutdown()
             except Exception as e:  # pragma: no cover
                 hvd_logging.warning("flush on shutdown failed: %s", e)
         if _state.timeline is not None:

@@ -1,0 +1,4 @@
+from horovod_tpu.data.data_loader import (  # noqa: F401
+    BaseDataLoader, AsyncDataLoaderMixin, ShardedDataLoader,
+    prefetch_to_device,
+)

@@ -109,6 +109,22 @@ class FusionRuntime:
         self._lock = threading.RLock()
         self._pending = []  # (tensor, op, prescale, postscale, handle)
         self._pending_bytes = 0
+        self._parameter_manager = None
+        if config.autotune:
+            from horovod_tpu.autotune import ParameterManager
+            self._parameter_manager = ParameterManager(
+                warmup_samples=config.autotune_warmup_samples,
+                steps_per_sample=config.autotune_steps_per_sample,
+                bayes_opt_max_samples=config.autotune_bayes_opt_max_samples,
+                gaussian_process_noise=config.autotune_gaussian_process_noise,
+                log_file=config.autotune_log_file or None,
+                initial_threshold=config.fusion_threshold)
+        self._stall_inspector = None
+        if not config.stall_check_disable:
+            from horovod_tpu.ops.stall_inspector import StallInspector
+            self._stall_inspector = StallInspector(
+                warning_secs=config.stall_check_time_seconds,
+                shutdown_secs=config.stall_shutdown_time_seconds)
 
     def enqueue_allreduce(self, tensor, op, prescale, postscale, name=None):
         handle = FusedHandle(self, name)
@@ -116,6 +132,8 @@ class FusionRuntime:
             self._pending.append((tensor, ReduceOp(op), float(prescale),
                                   float(postscale), handle))
             self._pending_bytes += tensor.nbytes
+            if self._stall_inspector is not None:
+                self._stall_inspector.record_enqueue(name or "tensor")
             if self._pending_bytes >= self.threshold:
                 self._flush_locked()
         return handle
@@ -124,11 +142,23 @@ class FusionRuntime:
         with self._lock:
             self._flush_locked()
 
+    def shutdown(self):
+        """Flush remaining work and stop background watchdogs."""
+        self.flush_all()
+        if self._stall_inspector is not None:
+            self._stall_inspector.stop()
+
     def _flush_locked(self):
         if not self._pending:
             return
         pending, self._pending = self._pending, []
-        self._pending_bytes = 0
+        flushed_bytes, self._pending_bytes = self._pending_bytes, 0
+        if self._stall_inspector is not None:
+            self._stall_inspector.record_flush()
+        if self._parameter_manager is not None:
+            new_threshold = self._parameter_manager.record(flushed_bytes)
+            if new_threshold is not None:
+                self.threshold = new_threshold
         topo = basics.topology()
         mesh = topo.mesh
         n = topo.size

@@ -1,0 +1,76 @@
+"""Stall inspector: watchdog for stuck eager collectives.
+
+Reference: horovod/common/stall_inspector.cc/.h (185+103 LoC) — the
+coordinator warns when some ranks submitted a tensor and others didn't within
+``HOROVOD_STALL_CHECK_TIME_SECONDS`` (60s) and can shut the job down after
+``HOROVOD_STALL_SHUTDOWN_TIME_SECONDS``.
+
+TPU adaptation: the rank-mismatch failure mode can't happen inside one
+controller (every rank's slice is submitted atomically), but its moral
+equivalent can: an async tensor enqueued into the fusion buffer and never
+flushed (the user forgot ``synchronize()``/``join()``), which in the reference
+would eventually stall peers. The inspector runs a daemon thread that warns
+about tensors pending longer than the threshold and optionally raises the
+shutdown flag checked by the next enqueue.
+"""
+
+import threading
+import time
+
+from horovod_tpu.common import logging as hvd_logging
+from horovod_tpu.common.exceptions import HorovodInternalError
+
+
+class StallInspector:
+    CHECK_INTERVAL_SECS = 5.0
+
+    def __init__(self, warning_secs=60.0, shutdown_secs=0.0):
+        self.warning_secs = warning_secs
+        self.shutdown_secs = shutdown_secs
+        self._lock = threading.Lock()
+        self._oldest_enqueue = None
+        self._pending_names = []
+        self._warned = False
+        self.shutdown_flagged = False
+        self._stop = threading.Event()
+        self._thread = threading.Thread(target=self._loop, daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        """Terminate the watchdog thread (called on hvd.shutdown so elastic
+        restart cycles don't leak threads)."""
+        self._stop.set()
+
+    def record_enqueue(self, name):
+        with self._lock:
+            if self._oldest_enqueue is None:
+                self._oldest_enqueue = time.monotonic()
+            self._pending_names.append(name)
+            if self.shutdown_flagged:
+                raise HorovodInternalError(
+                    "collective queue stalled beyond "
+                    f"{self.shutdown_secs}s (stall inspector shutdown, "
+                    "reference: HOROVOD_STALL_SHUTDOWN_TIME_SECONDS)")
+
+    def record_flush(self):
+        with self._lock:
+            self._oldest_enqueue = None
+            self._pending_names.clear()
+            self._warned = False
+
+    def _loop(self):
+        while not self._stop.wait(self.CHECK_INTERVAL_SECS):
+            with self._lock:
+                if self._oldest_enqueue is None:
+                    continue
+                age = time.monotonic() - self._oldest_enqueue
+                names = list(self._pending_names[:8])
+            if age > self.warning_secs and not self._warned:
+                hvd_logging.warning(
+                    "One or more tensors submitted to the fusion queue "
+                    "%.0fs ago were never reduced — missing synchronize()? "
+                    "Pending: %s (reference: stall_inspector.cc "
+                    "CheckForStalledTensors)", age, names)
+                self._warned = True
+            if self.shutdown_secs > 0 and age > self.shutdown_secs:
+                self.shutdown_flagged = True

@@ -4,3 +4,6 @@ from horovod_tpu.parallel.dp import (  # noqa: F401
 from horovod_tpu.parallel.strategies import (  # noqa: F401
     allreduce_hierarchical, allreduce_torus,
 )
+from horovod_tpu.parallel.sequence import (  # noqa: F401
+    local_attention, ring_attention, ulysses_attention,
+)

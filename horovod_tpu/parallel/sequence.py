@@ -1,0 +1,148 @@
+"""Sequence/context parallelism: ring attention and Ulysses.
+
+The reference has no attention code (SURVEY.md §5.7: Horovod operates below the
+model level) but exposes exactly the primitives sequence parallelism composes
+from — AllToAll with splits (Ulysses' head scatter, reference:
+collective_operations.h:199-268) and point-to-point rings. This module builds
+both schemes as first-class capabilities of the TPU framework:
+
+- **Ulysses** (all-to-all SP): tokens sharded over the ``sp`` axis are
+  exchanged for heads via one AllToAll, every chip computes full-sequence
+  attention for its head subset, and a second AllToAll restores the token
+  sharding. Communication: 2 all-to-alls of the activations, ICI-friendly.
+- **Ring attention**: K/V blocks rotate around the ring via
+  ``lax.ppermute`` while each chip accumulates flash-style online-softmax
+  partial results for its resident Q block. Communication overlaps compute;
+  memory stays O(L/n) per chip — the long-context workhorse.
+
+Both are numerically exact (fp32 accumulators, online softmax) and verified
+against full attention in tests.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+SP_AXIS = "hvd"  # default: sequence parallelism over the global mesh axis
+
+
+def _attention_weights(q, k, scale, mask=None):
+    # q: (B, Lq, H, D), k: (B, Lk, H, D) -> scores (B, H, Lq, Lk) in fp32
+    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if mask is not None:
+        s = jnp.where(mask, s, -jnp.inf)
+    return s
+
+
+def local_attention(q, k, v, causal=False):
+    """Plain softmax attention on local (unsharded) tensors; the correctness
+    oracle for the parallel schemes."""
+    scale = 1.0 / np.sqrt(q.shape[-1])
+    mask = None
+    if causal:
+        Lq, Lk = q.shape[1], k.shape[1]
+        mask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)[None, None]
+    s = _attention_weights(q, k, scale, mask)
+    p = jax.nn.softmax(s, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)) \
+        .astype(q.dtype)
+
+
+def _axis_bound(axis_name):
+    """True when ``axis_name`` is bound in the current trace (i.e. we're
+    inside shard_map over it). Lets the attention schemes run un-sharded —
+    e.g. during flax ``Module.init`` outside the mesh context — by degrading
+    to local attention."""
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+
+
+def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False):
+    """DeepSpeed-Ulysses-style sequence parallelism.
+
+    Inputs are sequence-sharded: local shapes (B, L/n, H, D) with H divisible
+    by n. Two AllToAlls re-shard tokens<->heads around a full-sequence local
+    attention. Outside the axis context (e.g. parameter init) this computes
+    plain local attention.
+    """
+    if not _axis_bound(axis_name):
+        return local_attention(q, k, v, causal=causal)
+    n = lax.axis_size(axis_name)
+    if q.shape[2] % n != 0:
+        raise ValueError(f"num heads {q.shape[2]} not divisible by sp={n}")
+
+    def scatter_heads(t):
+        # (B, L/n, H, D) -> (B, L, H/n, D)
+        return lax.all_to_all(t, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def gather_heads(t):
+        # (B, L, H/n, D) -> (B, L/n, H, D)
+        return lax.all_to_all(t, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    oh = local_attention(qh, kh, vh, causal=causal)
+    return gather_heads(oh)
+
+
+def ring_attention(q, k, v, axis_name=SP_AXIS, causal=False):
+    """Ring attention with online softmax (Liu et al.; blockwise parallel
+    transformers): exact attention over the full sequence with O(L/n) memory
+    and K/V rotating over ICI.
+
+    Local shapes (B, L/n, H, D); every chip owns the Q block for its sequence
+    shard and receives each K/V block exactly once. Outside the axis context
+    (e.g. parameter init) this computes plain local attention.
+    """
+    if not _axis_bound(axis_name):
+        return local_attention(q, k, v, causal=causal)
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    B, Lq, H, D = q.shape
+    scale = 1.0 / np.sqrt(D)
+    qf = q.astype(jnp.float32)
+
+    # global positions of my Q rows (for causal masking)
+    q_pos = idx * Lq + jnp.arange(Lq)  # (Lq,)
+
+    perm = [(i, (i - 1) % n) for i in range(n)]  # block s lives at rank+s
+
+    def step(s, carry):
+        o, m, l, ks, vs = carry
+        src = (idx + s) % n
+        scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
+                            ks.astype(jnp.float32)) * scale
+        if causal:
+            k_pos = src * Lq + jnp.arange(Lq)
+            mask = q_pos[:, None] >= k_pos[None, :]        # (Lq, Lk)
+            scores = jnp.where(mask[None, None], scores, -jnp.inf)
+        blk_max = jnp.max(scores, axis=-1)                  # (B, H, Lq)
+        m_new = jnp.maximum(m, blk_max)
+        # guard fully-masked rows (m_new = -inf): keep them at zero weight
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        p = jnp.exp(scores - safe_m[..., None])
+        p = jnp.where(jnp.isfinite(scores), p, 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        o_new = o * corr[..., None] \
+            + jnp.einsum("bhqk,bkhd->bhqd", p, vs.astype(jnp.float32))
+        ks = lax.ppermute(ks, axis_name, perm)
+        vs = lax.ppermute(vs, axis_name, perm)
+        return o_new, m_new, l_new, ks, vs
+
+    from horovod_tpu.ops.in_jit import mark_varying
+    o = jnp.zeros((B, H, Lq, D), jnp.float32)
+    m = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
+    l = jnp.zeros((B, H, Lq), jnp.float32)
+    # constants start axis-invariant; the loop carry must be device-varying
+    o, m, l = mark_varying((o, m, l), axis_name)
+    o, m, l, _, _ = lax.fori_loop(0, n, step, (o, m, l, k, v),
+                                  unroll=True)
+    out = o / jnp.maximum(l, 1e-30)[..., None]              # (B, H, Lq, D)
+    return jnp.moveaxis(out, 1, 2).astype(q.dtype)          # (B, Lq, H, D)

@@ -1,0 +1,168 @@
+"""Autotune, stall inspector, data loader, callback tests."""
+
+import numpy as np
+import pytest
+
+N = 8
+
+
+class TestGaussianProcess:
+    def test_fit_predict_recovers_function(self):
+        from horovod_tpu.autotune.gaussian_process import \
+            GaussianProcessRegressor
+        x = np.linspace(0, 6, 25)[:, None]
+        y = np.sin(x).ravel()
+        gp = GaussianProcessRegressor(alpha=1e-6).fit(x, y)
+        mu, sd = gp.predict(np.array([[1.5], [4.0]]))
+        np.testing.assert_allclose(mu, np.sin([1.5, 4.0]), atol=0.05)
+        assert (sd < 0.2).all()
+
+    def test_uncertainty_grows_off_data(self):
+        from horovod_tpu.autotune.gaussian_process import \
+            GaussianProcessRegressor
+        gp = GaussianProcessRegressor().fit(
+            np.array([[0.0], [1.0]]), np.array([0.0, 1.0]))
+        _, sd_near = gp.predict(np.array([[0.5]]))
+        _, sd_far = gp.predict(np.array([[50.0]]))
+        assert sd_far[0] > sd_near[0]
+
+
+class TestBayesianOptimization:
+    def test_finds_quadratic_max(self):
+        from horovod_tpu.autotune.bayesian_optimization import \
+            BayesianOptimization
+        bo = BayesianOptimization(bounds=[[0.0, 10.0]], alpha=1e-4)
+
+        def f(x):
+            return -(x - 7.0) ** 2
+
+        for _ in range(18):
+            x = float(bo.next_sample()[0])
+            bo.add_sample([x], f(x))
+        best = bo.x_samples[int(np.argmax(bo.y_samples))][0]
+        assert abs(best - 7.0) < 1.0, best
+
+
+class TestParameterManager:
+    def test_tunes_and_converges(self):
+        from horovod_tpu.autotune.parameter_manager import ParameterManager
+        pm = ParameterManager(warmup_samples=1, steps_per_sample=2,
+                              bayes_opt_max_samples=5)
+        seen = set()
+        for _ in range(40):
+            if not pm.tuning:
+                break
+            pm.record(1 << 20)
+            seen.add(pm.fusion_threshold)
+        assert not pm.tuning
+        assert 2 ** 20 <= pm.fusion_threshold <= 2 ** 28
+        assert len(seen) >= 2  # actually explored
+
+    def test_autotune_wired_into_fusion(self, hvd, monkeypatch):
+        from horovod_tpu.ops.fusion import FusionRuntime
+        from horovod_tpu.common.config import Config
+        cfg = Config()
+        cfg.autotune = True
+        cfg.autotune_warmup_samples = 0
+        cfg.autotune_steps_per_sample = 1
+        cfg.autotune_bayes_opt_max_samples = 2
+        rt = FusionRuntime(cfg)
+        assert rt._parameter_manager is not None
+        for _ in range(4):
+            h = rt.enqueue_allreduce(np.ones((N, 4), np.float32), 1, 1.0, 1.0)
+            h.synchronize()
+        assert not rt._parameter_manager.tuning
+
+
+class TestStallInspector:
+    def test_warns_and_flags_shutdown(self, monkeypatch):
+        import horovod_tpu.ops.stall_inspector as si_mod
+        monkeypatch.setattr(si_mod.StallInspector, "CHECK_INTERVAL_SECS", 0.05)
+        si = si_mod.StallInspector(warning_secs=0.01, shutdown_secs=0.05)
+        si.record_enqueue("g1")
+        import time
+        time.sleep(0.4)
+        assert si.shutdown_flagged
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        with pytest.raises(HorovodInternalError):
+            si.record_enqueue("g2")
+
+    def test_flush_resets(self, monkeypatch):
+        import horovod_tpu.ops.stall_inspector as si_mod
+        monkeypatch.setattr(si_mod.StallInspector, "CHECK_INTERVAL_SECS", 0.05)
+        si = si_mod.StallInspector(warning_secs=10, shutdown_secs=0.2)
+        si.record_enqueue("g1")
+        si.record_flush()
+        import time
+        time.sleep(0.3)
+        assert not si.shutdown_flagged
+
+
+class TestDataLoader:
+    def test_sharded_loader_batches(self, hvd):
+        from horovod_tpu.data import ShardedDataLoader
+        x = np.arange(64 * 3, dtype=np.float32).reshape(64, 3)
+        y = np.arange(64, dtype=np.int32)
+        dl = ShardedDataLoader([x, y], batch_size=2, shuffle=False)
+        batches = list(iter(dl))
+        assert len(batches) == len(dl) == 64 // (2 * N)
+        bx, by = batches[0]
+        assert bx.shape == (2 * N, 3) and by.shape == (2 * N,)
+
+    def test_async_mixin_yields_all(self):
+        from horovod_tpu.data import AsyncDataLoaderMixin, BaseDataLoader
+
+        class Loader(BaseDataLoader):
+            def __len__(self):
+                return 5
+
+            def _iterate(self):
+                yield from range(5)
+
+        class AsyncLoader(AsyncDataLoaderMixin, Loader):
+            pass
+
+        assert list(iter(AsyncLoader(async_loading=True))) == list(range(5))
+        assert list(iter(AsyncLoader(async_loading=False))) == list(range(5))
+
+    def test_prefetch_to_device(self, hvd):
+        from horovod_tpu.data import prefetch_to_device
+        batches = [{"x": np.full((N, 2), i, np.float32)} for i in range(4)]
+        out = list(prefetch_to_device(iter(batches), buffer_size=2))
+        assert len(out) == 4
+        for i, b in enumerate(out):
+            np.testing.assert_array_equal(np.asarray(b["x"]),
+                                          np.full((N, 2), i))
+
+
+class TestCallbacks:
+    def test_metric_average(self, hvd):
+        from horovod_tpu.callbacks import MetricAverageCallback
+        cb = MetricAverageCallback()
+        _, m = cb.on_epoch_end(0, None, {"loss": [1.0, 3.0], "acc": 0.5})
+        assert m == {"loss": 2.0, "acc": 0.5}
+
+    def test_lr_schedule(self, hvd):
+        from horovod_tpu.callbacks import LearningRateScheduleCallback
+        cb = LearningRateScheduleCallback(initial_lr=0.1, multiplier=0.5,
+                                          start_epoch=2)
+        assert cb.lr(0) == 0.1          # before start: unchanged
+        assert cb.lr(3) == pytest.approx(0.05)
+
+    def test_warmup_ramp(self, hvd):
+        from horovod_tpu.callbacks import LearningRateWarmupCallback
+        cb = LearningRateWarmupCallback(initial_lr=0.1, warmup_epochs=5)
+        lr0 = cb.lr(0)
+        lr5 = cb.lr(5)
+        assert lr0 == pytest.approx(0.1)           # starts at base LR
+        assert lr5 == pytest.approx(0.1 * 8)       # ends at size * base
+        assert cb.lr(2.5) == pytest.approx((lr0 + lr5) / 2, rel=1e-6)
+
+    def test_broadcast_callback(self, hvd, rng):
+        from horovod_tpu.callbacks import (BroadcastGlobalVariablesCallback,
+                                           CallbackList)
+        params = {"w": np.asarray(rng.standard_normal(3), np.float32)}
+        cl = CallbackList([BroadcastGlobalVariablesCallback(0)])
+        out = cl.on_train_begin(params)
+        np.testing.assert_allclose(np.asarray(out["w"]), params["w"],
+                                   rtol=1e-6)
