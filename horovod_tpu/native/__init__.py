@@ -1,0 +1,189 @@
+"""ctypes loader for libhvdtpu, the native host-side runtime.
+
+Builds the shared library on first import when a toolchain is present
+(make + g++); everything degrades gracefully to the pure-Python paths when it
+isn't — mirroring how the reference gates features on what was compiled in
+(reference: horovod_*_built checks, operations.cc:1307-1449).
+"""
+
+import ctypes
+import os
+import subprocess
+import threading
+
+from horovod_tpu.common import logging as hvd_logging
+
+_HERE = os.path.dirname(os.path.abspath(__file__))
+_LIB_PATH = os.path.join(_HERE, "libhvdtpu.so")
+_lock = threading.Lock()
+_lib = None
+_tried = False
+
+
+def _build():
+    try:
+        subprocess.run(["make", "-C", _HERE, "-s"], check=True,
+                       capture_output=True, timeout=120)
+        return True
+    except (subprocess.CalledProcessError, FileNotFoundError,
+            subprocess.TimeoutExpired) as e:
+        out = getattr(e, "stderr", b"") or b""
+        hvd_logging.debug("native build unavailable: %s %s", e,
+                          out.decode(errors="replace")[-500:])
+        return False
+
+
+def _bind(lib):
+    lib.hvd_timeline_create.restype = ctypes.c_int64
+    lib.hvd_timeline_create.argtypes = [ctypes.c_char_p]
+    lib.hvd_timeline_record.restype = None
+    lib.hvd_timeline_record.argtypes = [
+        ctypes.c_int64, ctypes.c_char_p, ctypes.c_char_p, ctypes.c_char,
+        ctypes.c_double, ctypes.c_double, ctypes.c_int64]
+    lib.hvd_timeline_close.restype = None
+    lib.hvd_timeline_close.argtypes = [ctypes.c_int64]
+    lib.hvd_timeline_count.restype = ctypes.c_int64
+    lib.hvd_timeline_count.argtypes = [ctypes.c_int64]
+    u16p = ctypes.POINTER(ctypes.c_uint16)
+    f32p = ctypes.POINTER(ctypes.c_float)
+    for name, argtypes in [
+        ("hvd_fp32_to_bf16", [f32p, u16p, ctypes.c_int64]),
+        ("hvd_bf16_to_fp32", [u16p, f32p, ctypes.c_int64]),
+        ("hvd_fp32_to_fp16", [f32p, u16p, ctypes.c_int64]),
+        ("hvd_fp16_to_fp32", [u16p, f32p, ctypes.c_int64]),
+        ("hvd_bf16_accumulate", [u16p, u16p, ctypes.c_int64]),
+        ("hvd_adasum_combine", [f32p, f32p, f32p, ctypes.c_int64]),
+    ]:
+        fn = getattr(lib, name)
+        fn.restype = None
+        fn.argtypes = argtypes
+    return lib
+
+
+def get_lib():
+    """The loaded library, or None when native support is unavailable."""
+    global _lib, _tried
+    with _lock:
+        if _lib is not None or _tried:
+            return _lib
+        _tried = True
+        if not os.path.exists(_LIB_PATH) and not _build():
+            return None
+        try:
+            _lib = _bind(ctypes.CDLL(_LIB_PATH))
+            hvd_logging.debug("loaded native runtime %s", _LIB_PATH)
+        except OSError as e:  # pragma: no cover
+            hvd_logging.warning("failed to load native runtime: %s", e)
+            _lib = None
+        return _lib
+
+
+def native_built():
+    return get_lib() is not None
+
+
+# ---- numpy-facing convenience wrappers ----
+
+def _as_ptr(arr, ctype):
+    return arr.ctypes.data_as(ctypes.POINTER(ctype))
+
+
+def _require_lib():
+    lib = get_lib()
+    if lib is None:
+        raise RuntimeError(
+            "native runtime not built (no working make/g++ toolchain); "
+            "check HOROVOD_LOG_LEVEL=debug for the build error")
+    return lib
+
+
+def fp32_to_bf16(src):
+    import numpy as np
+    lib = _require_lib()
+    src = np.ascontiguousarray(src, np.float32)
+    out = np.empty(src.shape, np.uint16)
+    lib.hvd_fp32_to_bf16(_as_ptr(src, ctypes.c_float),
+                         _as_ptr(out, ctypes.c_uint16), src.size)
+    return out
+
+
+def bf16_to_fp32(src):
+    import numpy as np
+    lib = _require_lib()
+    src = np.ascontiguousarray(src, np.uint16)
+    out = np.empty(src.shape, np.float32)
+    lib.hvd_bf16_to_fp32(_as_ptr(src, ctypes.c_uint16),
+                         _as_ptr(out, ctypes.c_float), src.size)
+    return out
+
+
+def fp32_to_fp16(src):
+    import numpy as np
+    lib = _require_lib()
+    src = np.ascontiguousarray(src, np.float32)
+    out = np.empty(src.shape, np.uint16)
+    lib.hvd_fp32_to_fp16(_as_ptr(src, ctypes.c_float),
+                         _as_ptr(out, ctypes.c_uint16), src.size)
+    return out
+
+
+def fp16_to_fp32(src):
+    import numpy as np
+    lib = _require_lib()
+    src = np.ascontiguousarray(src, np.uint16)
+    out = np.empty(src.shape, np.float32)
+    lib.hvd_fp16_to_fp32(_as_ptr(src, ctypes.c_uint16),
+                         _as_ptr(out, ctypes.c_float), src.size)
+    return out
+
+
+def bf16_accumulate(src, dst):
+    """dst += src on bf16 (uint16-viewed) buffers, accumulating in fp32 —
+    host-side wire-dtype accumulation (reference: half.cc fp16 sum ops).
+    Mutates and returns ``dst``."""
+    import numpy as np
+    lib = _require_lib()
+    src = np.ascontiguousarray(src, np.uint16)
+    dst = np.ascontiguousarray(dst, np.uint16)
+    lib.hvd_bf16_accumulate(_as_ptr(src, ctypes.c_uint16),
+                            _as_ptr(dst, ctypes.c_uint16), src.size)
+    return dst
+
+
+def adasum_combine(a, b):
+    import numpy as np
+    lib = _require_lib()
+    a = np.ascontiguousarray(a, np.float32)
+    b = np.ascontiguousarray(b, np.float32)
+    out = np.empty(a.shape, np.float32)
+    lib.hvd_adasum_combine(_as_ptr(a, ctypes.c_float),
+                           _as_ptr(b, ctypes.c_float),
+                           _as_ptr(out, ctypes.c_float), a.size)
+    return out
+
+
+class NativeTimeline:
+    """Chrome-trace writer backed by the C++ drain thread."""
+
+    def __init__(self, path):
+        lib = get_lib()
+        if lib is None:
+            raise RuntimeError("native runtime not built")
+        self._lib = lib
+        self._handle = lib.hvd_timeline_create(path.encode())
+        if self._handle == 0:
+            raise OSError(f"cannot open timeline file {path}")
+
+    def record(self, name, cat, ph, ts_us, dur_us=0.0, tid=0):
+        self._lib.hvd_timeline_record(
+            self._handle, name.encode(), cat.encode(),
+            ph.encode() if isinstance(ph, str) else ph,
+            float(ts_us), float(dur_us), int(tid))
+
+    def count(self):
+        return self._lib.hvd_timeline_count(self._handle)
+
+    def close(self):
+        if self._handle:
+            self._lib.hvd_timeline_close(self._handle)
+            self._handle = 0

@@ -1,0 +1,29 @@
+// Adasum combine — CPU ground truth for validating device numerics.
+//
+// Reference: horovod/common/ops/adasum/adasum.h:103+ — the scale-invariant
+// pairwise combination. Single pass computes the three reductions (a.b,
+// ||a||^2, ||b||^2); the compiler vectorizes the loops under -O3.
+
+#include <cmath>
+
+#include "api.h"
+
+extern "C" {
+
+void hvd_adasum_combine(const float* a, const float* b, float* out,
+                        int64_t n) {
+  double dot = 0.0, na = 0.0, nb = 0.0;
+  for (int64_t i = 0; i < n; ++i) {
+    dot += static_cast<double>(a[i]) * b[i];
+    na += static_cast<double>(a[i]) * a[i];
+    nb += static_cast<double>(b[i]) * b[i];
+  }
+  const double eps = 1e-30;
+  double ca = na > eps ? 1.0 - dot / (2.0 * na) : 1.0;
+  double cb = nb > eps ? 1.0 - dot / (2.0 * nb) : 1.0;
+  for (int64_t i = 0; i < n; ++i) {
+    out[i] = static_cast<float>(ca * a[i] + cb * b[i]);
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,41 @@
+// extern "C" interface of libhvdtpu — the native host-side runtime pieces.
+//
+// Parity with the reference's native core where native genuinely helps a TPU
+// runtime (the device data plane is XLA; these are the host-side hot paths):
+//  - timeline: lock-minimal event recording + background writer thread
+//    (reference: horovod/common/timeline.cc, 678 LoC, boost SPSC + writer)
+//  - half/bf16: vectorizable fp16/bf16 <-> fp32 conversion and fused
+//    accumulate (reference: horovod/common/half.cc AVX F16C paths)
+//  - adasum: the scale-invariant combine, the CPU ground truth used to
+//    validate device numerics (reference: horovod/common/ops/adasum/adasum.h)
+
+#pragma once
+#include <cstdint>
+
+extern "C" {
+
+// ---- timeline ----
+// Returns an opaque handle (>0) or 0 on failure.
+int64_t hvd_timeline_create(const char* path);
+// ph: 'X' complete event (dur_us used), 'i' instant.
+void hvd_timeline_record(int64_t handle, const char* name, const char* cat,
+                         char ph, double ts_us, double dur_us, int64_t tid);
+// Flush + finalize JSON; invalidates the handle.
+void hvd_timeline_close(int64_t handle);
+// Number of events written so far (for tests/diagnostics).
+int64_t hvd_timeline_count(int64_t handle);
+
+// ---- half / bf16 ----
+void hvd_fp32_to_bf16(const float* src, uint16_t* dst, int64_t n);
+void hvd_bf16_to_fp32(const uint16_t* src, float* dst, int64_t n);
+void hvd_fp32_to_fp16(const float* src, uint16_t* dst, int64_t n);
+void hvd_fp16_to_fp32(const uint16_t* src, float* dst, int64_t n);
+// dst += src elementwise, accumulating in fp32 (wire-dtype host reduction).
+void hvd_bf16_accumulate(const uint16_t* src, uint16_t* dst, int64_t n);
+
+// ---- adasum ----
+// out = (1 - dot/(2*||a||^2)) a + (1 - dot/(2*||b||^2)) b, fp32.
+void hvd_adasum_combine(const float* a, const float* b, float* out,
+                        int64_t n);
+
+}  // extern "C"

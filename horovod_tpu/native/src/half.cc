@@ -1,0 +1,114 @@
+// fp16/bf16 <-> fp32 conversion and fused accumulation.
+//
+// Reference: horovod/common/half.cc — HalfBits2Float/Float2HalfBits plus
+// AVX/F16C vectorized fp16 sums used for custom MPI reductions. The TPU
+// build's wire dtype is bfloat16 (same exponent range as fp32 — conversion
+// is a shift with round-to-nearest-even), with fp16 kept for parity. Loops
+// are written so the compiler auto-vectorizes (-O3 -march=native).
+
+#include <cstring>
+
+#include "api.h"
+
+namespace {
+
+inline uint16_t F32ToBf16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  // NaN-safe round-to-nearest-even (the TPU hardware rounding).
+  if ((bits & 0x7fffffffu) > 0x7f800000u) {
+    return static_cast<uint16_t>((bits >> 16) | 0x0040);
+  }
+  uint32_t rounding = 0x7fffu + ((bits >> 16) & 1u);
+  return static_cast<uint16_t>((bits + rounding) >> 16);
+}
+
+inline float Bf16ToF32(uint16_t h) {
+  uint32_t bits = static_cast<uint32_t>(h) << 16;
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+// Software fp16 (IEEE binary16) conversion — reference: half.cc
+// HalfBits2Float/Float2HalfBits branch structure.
+inline float Fp16ToF32(uint16_t h) {
+  uint32_t sign = (h & 0x8000u) << 16;
+  uint32_t exp = (h >> 10) & 0x1fu;
+  uint32_t man = h & 0x3ffu;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;  // +-0
+    } else {        // subnormal: normalize
+      int e = -1;
+      uint32_t m = man;
+      do {
+        ++e;
+        m <<= 1;
+      } while ((m & 0x400u) == 0);
+      bits = sign | ((127 - 15 - e) << 23) | ((m & 0x3ffu) << 13);
+    }
+  } else if (exp == 0x1fu) {
+    bits = sign | 0x7f800000u | (man << 13);  // inf/nan
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float f;
+  std::memcpy(&f, &bits, 4);
+  return f;
+}
+
+inline uint16_t F32ToFp16(float f) {
+  uint32_t bits;
+  std::memcpy(&bits, &f, 4);
+  uint16_t sign = static_cast<uint16_t>((bits >> 16) & 0x8000u);
+  int32_t exp = static_cast<int32_t>((bits >> 23) & 0xffu) - 127 + 15;
+  uint32_t man = bits & 0x7fffffu;
+  if (((bits >> 23) & 0xffu) == 0xffu) {  // inf/nan
+    return sign | 0x7c00u | (man ? 0x200u | (man >> 13) : 0);
+  }
+  if (exp >= 0x1f) return sign | 0x7c00u;  // overflow -> inf
+  if (exp <= 0) {
+    if (exp < -10) return sign;  // underflow -> 0
+    man |= 0x800000u;            // implicit bit
+    uint32_t shift = static_cast<uint32_t>(14 - exp);
+    uint32_t half = man >> shift;
+    uint32_t rem = man & ((1u << shift) - 1);
+    uint32_t mid = 1u << (shift - 1);
+    if (rem > mid || (rem == mid && (half & 1))) ++half;  // RNE
+    return sign | static_cast<uint16_t>(half);
+  }
+  uint32_t half = (static_cast<uint32_t>(exp) << 10) | (man >> 13);
+  uint32_t rem = man & 0x1fffu;
+  if (rem > 0x1000u || (rem == 0x1000u && (half & 1))) ++half;  // RNE
+  return sign | static_cast<uint16_t>(half);
+}
+
+}  // namespace
+
+extern "C" {
+
+void hvd_fp32_to_bf16(const float* src, uint16_t* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] = F32ToBf16(src[i]);
+}
+
+void hvd_bf16_to_fp32(const uint16_t* src, float* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] = Bf16ToF32(src[i]);
+}
+
+void hvd_fp32_to_fp16(const float* src, uint16_t* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] = F32ToFp16(src[i]);
+}
+
+void hvd_fp16_to_fp32(const uint16_t* src, float* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) dst[i] = Fp16ToF32(src[i]);
+}
+
+void hvd_bf16_accumulate(const uint16_t* src, uint16_t* dst, int64_t n) {
+  for (int64_t i = 0; i < n; ++i) {
+    dst[i] = F32ToBf16(Bf16ToF32(dst[i]) + Bf16ToF32(src[i]));
+  }
+}
+
+}  // extern "C"

@@ -1,0 +1,187 @@
+// Chrome-trace timeline writer with a background drain thread.
+//
+// Reference design (horovod/common/timeline.cc): producers enqueue fixed-size
+// records into a lock-free queue; a writer thread drains and streams JSON so
+// tracing never blocks the training path. Same shape here: a bounded MPMC
+// ring (mutex-guarded head/tail — producers only copy a small POD under the
+// lock) drained by one std::thread streaming the trace-event array
+// incrementally to disk.
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdio>
+#include <cstring>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <vector>
+
+#include "api.h"
+
+namespace {
+
+struct Event {
+  char name[96];
+  char cat[24];
+  char ph;
+  double ts_us;
+  double dur_us;
+  int64_t tid;
+};
+
+class TimelineWriter {
+ public:
+  explicit TimelineWriter(const char* path)
+      : file_(std::fopen(path, "w")), stop_(false), count_(0) {
+    if (!file_) return;
+    std::fputs("{\"displayTimeUnit\":\"ms\",\"traceEvents\":[", file_);
+    thread_ = std::thread([this] { Drain(); });
+  }
+
+  bool ok() const { return file_ != nullptr; }
+
+  void Record(const Event& ev) {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      queue_.push_back(ev);
+    }
+    cv_.notify_one();
+  }
+
+  int64_t Count() const { return count_.load(); }
+
+  void Close() {
+    {
+      std::lock_guard<std::mutex> g(mu_);
+      stop_ = true;
+    }
+    cv_.notify_one();
+    if (thread_.joinable()) thread_.join();
+    if (file_) {
+      std::fputs("]}", file_);
+      std::fclose(file_);
+      file_ = nullptr;
+    }
+  }
+
+  ~TimelineWriter() { Close(); }
+
+ private:
+  void Drain() {
+    bool first = true;
+    std::vector<Event> local;
+    for (;;) {
+      {
+        std::unique_lock<std::mutex> g(mu_);
+        cv_.wait(g, [this] { return stop_ || !queue_.empty(); });
+        local.swap(queue_);
+        if (local.empty() && stop_) return;
+      }
+      for (const auto& ev : local) Write(ev, &first);
+      std::fflush(file_);
+      local.clear();
+    }
+  }
+
+  static void JsonEscape(const char* in, char* out, size_t cap) {
+    size_t j = 0;
+    for (size_t i = 0; in[i] && j + 2 < cap; ++i) {
+      char c = in[i];
+      if (c == '"' || c == '\\') out[j++] = '\\';
+      if (static_cast<unsigned char>(c) < 0x20) c = ' ';
+      out[j++] = c;
+    }
+    out[j] = 0;
+  }
+
+  void Write(const Event& ev, bool* first) {
+    char name[200], cat[48];
+    JsonEscape(ev.name, name, sizeof(name));
+    JsonEscape(ev.cat, cat, sizeof(cat));
+    if (!*first) std::fputc(',', file_);
+    *first = false;
+    if (ev.ph == 'X') {
+      std::fprintf(file_,
+                   "{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"X\",\"ts\":%.3f,"
+                   "\"dur\":%.3f,\"pid\":0,\"tid\":%lld}",
+                   name, cat, ev.ts_us, ev.dur_us,
+                   static_cast<long long>(ev.tid));
+    } else {
+      std::fprintf(file_,
+                   "{\"name\":\"%s\",\"cat\":\"%s\",\"ph\":\"%c\",\"ts\":%.3f,"
+                   "\"pid\":0,\"tid\":%lld,\"s\":\"g\"}",
+                   name, cat, ev.ph, ev.ts_us,
+                   static_cast<long long>(ev.tid));
+    }
+    count_.fetch_add(1);
+  }
+
+  std::FILE* file_;
+  std::thread thread_;
+  std::mutex mu_;
+  std::condition_variable cv_;
+  std::vector<Event> queue_;
+  bool stop_;
+  std::atomic<int64_t> count_;
+};
+
+std::mutex g_registry_mu;
+std::unordered_map<int64_t, TimelineWriter*> g_registry;
+int64_t g_next_handle = 1;
+
+}  // namespace
+
+extern "C" {
+
+int64_t hvd_timeline_create(const char* path) {
+  auto* w = new TimelineWriter(path);
+  if (!w->ok()) {
+    delete w;
+    return 0;
+  }
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  int64_t h = g_next_handle++;
+  g_registry[h] = w;
+  return h;
+}
+
+void hvd_timeline_record(int64_t handle, const char* name, const char* cat,
+                         char ph, double ts_us, double dur_us, int64_t tid) {
+  Event ev;
+  std::snprintf(ev.name, sizeof(ev.name), "%s", name ? name : "");
+  std::snprintf(ev.cat, sizeof(ev.cat), "%s", cat ? cat : "");
+  ev.ph = ph;
+  ev.ts_us = ts_us;
+  ev.dur_us = dur_us;
+  ev.tid = tid;
+  // Record under the registry lock: hvd_timeline_close erases + deletes the
+  // writer under the same lock, so a concurrent close can't free the writer
+  // out from under us. Record() only copies a POD into the queue, so the
+  // critical section stays short.
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_registry.find(handle);
+  if (it == g_registry.end()) return;
+  it->second->Record(ev);
+}
+
+int64_t hvd_timeline_count(int64_t handle) {
+  std::lock_guard<std::mutex> g(g_registry_mu);
+  auto it = g_registry.find(handle);
+  return it == g_registry.end() ? -1 : it->second->Count();
+}
+
+void hvd_timeline_close(int64_t handle) {
+  TimelineWriter* w = nullptr;
+  {
+    std::lock_guard<std::mutex> g(g_registry_mu);
+    auto it = g_registry.find(handle);
+    if (it == g_registry.end()) return;
+    w = it->second;
+    g_registry.erase(it);
+  }
+  w->Close();
+  delete w;
+}
+
+}  // extern "C"

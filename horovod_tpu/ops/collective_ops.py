@@ -94,14 +94,39 @@ def _dtype_of(t):
 # In-jit reduction bodies (applied per-shard inside shard_map).
 # ----------------------------------------------------------------------------
 
-def _reduce_shard(x, op, n, prescale, postscale, axis_name):
-    """Reduce one rank's shard across ``axis_name``. x: (1, ...) local slice."""
+def _reduce_shard(x, op, n, prescale, postscale, axis_name, active=None):
+    """Reduce one rank's shard across ``axis_name``. x: (1, ...) local slice.
+
+    ``active``: optional 0/1 numpy vector over ranks — joined ranks are
+    excluded (reference: JOIN / joined_size accounting,
+    controller.cc:269-327): Sum treats them as zeros, Average divides by the
+    active count, Min/Max/Product/Adasum statically drop their slices.
+    """
     if prescale != 1.0:
         x = x * jnp.asarray(prescale, x.dtype)
+    n_active = n if active is None else int(active.sum())
     if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        if active is not None:
+            keep = jnp.asarray(active)[lax.axis_index(axis_name)]
+            x = x * keep.astype(x.dtype)
         y = lax.psum(x, axis_name)
         if op == ReduceOp.AVERAGE:
-            y = y / jnp.asarray(n, y.dtype)
+            y = y / jnp.asarray(n_active, y.dtype)
+    elif active is not None:
+        # non-linear ops: gather all, statically select the active ranks
+        g = lax.all_gather(jnp.squeeze(x, 0), axis_name)
+        g = g[np.nonzero(active)[0]]
+        if op == ReduceOp.MIN:
+            y = jnp.min(g, axis=0)[None]
+        elif op == ReduceOp.MAX:
+            y = jnp.max(g, axis=0)[None]
+        elif op == ReduceOp.PRODUCT:
+            y = jnp.prod(g, axis=0)[None]
+        elif op == ReduceOp.ADASUM:
+            from horovod_tpu.ops.adasum import adasum_tree
+            y = adasum_tree([g[i] for i in range(n_active)])[None]
+        else:
+            raise ValueError(f"Unknown reduce op {op}")
     elif op == ReduceOp.MIN:
         y = lax.pmin(x, axis_name)
     elif op == ReduceOp.MAX:
@@ -124,10 +149,18 @@ def _reduce_shard(x, op, n, prescale, postscale, axis_name):
 # ----------------------------------------------------------------------------
 
 @functools.lru_cache(maxsize=4096)
-def _allreduce_program(mesh, n, op, prescale, postscale, shapes, dtypes):
+def _allreduce_program(mesh, n, op, prescale, postscale, shapes, dtypes,
+                       active_mask=None):
+    """``active_mask``: optional tuple of 0/1 per rank — joined ranks are
+    masked out of the reduction and Average divides by the active count
+    (reference: JOIN handling / joined_size accounting, controller.cc:269-327
+    and operations.cc global joined_size)."""
+    active = None if active_mask is None else np.array(active_mask)
+
     def body(*xs):
         return tuple(
-            _reduce_shard(x, op, n, prescale, postscale, HVD_AXIS) for x in xs)
+            _reduce_shard(x, op, n, prescale, postscale, HVD_AXIS, active)
+            for x in xs)
 
     f = jax.shard_map(body, mesh=mesh,
                       in_specs=tuple(P(HVD_AXIS) for _ in shapes),
@@ -290,8 +323,10 @@ def grouped_allreduce(tensors, op=Average, prescale_factor=1.0,
                          "hvd.Sum (matches reference torch/mpi_ops.py checks).")
     tensors = _prepare(tensors, mesh, n, "allreduce")
     shapes, dtypes = _signature(tensors)
+    active_mask = _active_mask(ps)
     prog = _allreduce_program(mesh, n, ReduceOp(op), float(prescale_factor),
-                              float(postscale_factor), shapes, dtypes)
+                              float(postscale_factor), shapes, dtypes,
+                              active_mask)
     with _timeline_op(name or "grouped_allreduce", "ALLREDUCE"):
         return list(prog(*tensors))
 
@@ -485,19 +520,46 @@ def barrier(process_set=None, name=None):
         _barrier_program(mesh)(token).block_until_ready()
 
 
-def join(device=None):
-    """Signal this controller finished its uneven workload.
+def _active_mask(ps):
+    """0/1 tuple over the set's ranks excluding joined ranks, or None when
+    nobody has joined (the fast path)."""
+    st = basics._get_state()
+    if not st.joined_ranks:
+        return None
+    ranks = ps.rank_list()
+    if all(r in st.joined_ranks for r in ranks):
+        # Every participant of this set joined — there is nobody left to
+        # contribute, so the collective is a contract violation (the global
+        # set can't reach here: join() resets on world completion).
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        raise HorovodInternalError(
+            f"collective on process set {ranks} after all its ranks joined")
+    return tuple(0 if r in st.joined_ranks else 1 for r in ranks)
 
-    reference semantics (torch/mpi_ops.py DoJoin, controller.cc:269-327): a
-    joined rank contributes zeros to outstanding collectives until every rank
-    joins; returns the id of the last rank to join. In the single-controller
-    TPU model every rank the controller owns joins at once; across multiple
-    controller processes this is a barrier. Returns the last joined rank.
+
+def join(rank=None):
+    """Signal that ``rank`` (default: every rank this controller owns) has
+    exhausted its uneven workload.
+
+    reference semantics (torch/mpi_ops.py DoJoin, controller.cc:269-327,
+    joined_size accounting): a joined rank contributes nothing to subsequent
+    collectives — Sum treats it as zeros, Average divides by the active
+    count, Min/Max/Product/Adasum exclude it — until every rank has joined,
+    at which point the join completes and returns the id of the last rank to
+    join (and the join state resets).
     """
     st = basics._get_state()
-    st.joined_ranks.update(range(basics.size()))
-    barrier()
-    return basics.size() - 1
+    if rank is None:
+        st.joined_ranks.update(range(basics.size()))
+    else:
+        if not (0 <= rank < basics.size()):
+            raise ValueError(f"join: rank {rank} out of range")
+        st.joined_ranks.add(rank)
+    if len(st.joined_ranks) >= basics.size():
+        st.joined_ranks.clear()
+        barrier()
+        return basics.size() - 1
+    return -1
 
 
 # ----------------------------------------------------------------------------

@@ -64,9 +64,12 @@ class FusedHandle:
 
 @functools.lru_cache(maxsize=2048)
 def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
-                   wire_dtype):
-    """One flat-buffer reduction for a whole bucket."""
+                   wire_dtype, active_mask=None):
+    """One flat-buffer reduction for a whole bucket. ``active_mask`` carries
+    join state so async collectives honor the same joined-rank exclusion as
+    the sync path (reference: joined_size accounting)."""
     sizes = [int(np.prod(s[1:])) for s in shapes]
+    active = None if active_mask is None else np.array(active_mask)
 
     def body(*xs):
         # xs: local slices (1, ...). Flatten each, concat per the bucket
@@ -78,7 +81,7 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
         # reduced individually inside the single dispatch instead of fused.
         if op == ReduceOp.ADASUM:
             return tuple(
-                _reduce_shard(x, op, n, prescale, postscale, HVD_AXIS)
+                _reduce_shard(x, op, n, prescale, postscale, HVD_AXIS, active)
                 for x in xs)
         flats = []
         for x in xs:
@@ -87,7 +90,8 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
                 f = f.astype(wire_dtype)
             flats.append(f)
         buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-        buf = _reduce_shard(buf[None], op, n, prescale, postscale, HVD_AXIS)[0]
+        buf = _reduce_shard(buf[None], op, n, prescale, postscale, HVD_AXIS,
+                            active)[0]
         outs, off = [], 0
         for x, sz in zip(xs, sizes):
             piece = lax.slice_in_dim(buf, off, off + sz).astype(x.dtype)
@@ -175,13 +179,16 @@ class FusionRuntime:
         for t, op, pre, post, h in pending:
             buckets.setdefault((op, pre, post, _eff(t)), []).append((t, h))
         tl = basics.timeline()
+        from horovod_tpu.common.process_sets import global_process_set
+        from horovod_tpu.ops.collective_ops import _active_mask
+        active_mask = _active_mask(global_process_set)
         for (op, pre, post, _), items in buckets.items():
             tensors = [i[0] for i in items]
             tensors = _prepare(tensors, mesh, n, "fused_allreduce")
             shapes = tuple(tuple(t.shape) for t in tensors)
             dtypes = tuple(str(t.dtype) for t in tensors)
             prog = _fused_program(mesh, n, op, pre, post, shapes, dtypes,
-                                  self.wire_dtype)
+                                  self.wire_dtype, active_mask)
             if tl is not None:
                 with tl.op_span(f"fused_allreduce[{len(items)}]", "ALLREDUCE"):
                     outs = prog(*tensors)

@@ -23,23 +23,41 @@ from contextlib import contextmanager
 
 
 class Timeline:
-    def __init__(self, file_path, mark_cycles=False):
+    def __init__(self, file_path, mark_cycles=False, native=None):
         self.file_path = file_path
         self.mark_cycles = mark_cycles
-        self._queue = queue.Queue()
-        self._events = []
         self._closed = False
         self._t0 = time.perf_counter_ns()
-        self._writer = threading.Thread(target=self._drain, daemon=True)
-        self._writer.start()
+        # Prefer the C++ writer (lock-minimal queue + drain thread,
+        # reference: timeline.cc TimelineWriter); fall back to the Python
+        # thread when the native lib isn't built.
+        self._native = None
+        if native is not False:
+            try:
+                from horovod_tpu.native import NativeTimeline
+                self._native = NativeTimeline(file_path)
+            except Exception:
+                if native is True:
+                    raise
+        if self._native is None:
+            self._queue = queue.Queue()
+            self._events = []
+            self._writer = threading.Thread(target=self._drain, daemon=True)
+            self._writer.start()
 
     # --- recording -----------------------------------------------------
     def _now_us(self):
         return (time.perf_counter_ns() - self._t0) / 1000.0
 
     def record(self, name, phase, cat, ts_us, dur_us=None, args=None):
+        if self._closed:
+            return
+        tid = threading.get_ident() % 100000
+        if self._native is not None:
+            self._native.record(name, cat, phase, ts_us, dur_us or 0.0, tid)
+            return
         ev = {"name": name, "ph": phase, "cat": cat, "ts": ts_us,
-              "pid": 0, "tid": threading.get_ident() % 100000}
+              "pid": 0, "tid": tid}
         if dur_us is not None:
             ev["dur"] = dur_us
         if args:
@@ -80,6 +98,9 @@ class Timeline:
         if self._closed:
             return
         self._closed = True
+        if self._native is not None:
+            self._native.close()
+            return
         self._writer.join(timeout=2.0)
         while True:
             try:

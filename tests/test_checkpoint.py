@@ -1,0 +1,57 @@
+"""Checkpoint store tests (orbax-backed)."""
+
+import numpy as np
+import pytest
+
+
+class TestStore:
+    def test_paths(self, tmp_path):
+        from horovod_tpu.checkpoint import Store
+        s = Store.create(str(tmp_path / "store"))
+        assert "runs/exp1/checkpoints" in s.get_checkpoint_path("exp1")
+        assert not s.exists(s.get_checkpoint_path("exp1"))
+
+
+class TestCheckpointManager:
+    def test_save_restore_roundtrip(self, hvd, tmp_path, rng):
+        from horovod_tpu.checkpoint import CheckpointManager
+        state = {"params": {"w": np.asarray(rng.standard_normal((4, 3)),
+                                            np.float32)},
+                 "step": np.asarray(7, np.int32)}
+        m = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        m.save(1, state, wait=True)
+        assert m.has_checkpoint() and m.latest_step() == 1
+        out = m.restore()
+        np.testing.assert_allclose(out["params"]["w"], state["params"]["w"])
+        assert int(out["step"]) == 7
+        m.close()
+
+    def test_keep_policy(self, hvd, tmp_path, rng):
+        from horovod_tpu.checkpoint import CheckpointManager
+        m = CheckpointManager(str(tmp_path / "ckpt"), max_to_keep=2)
+        for s in range(4):
+            m.save(s, {"x": np.full(2, s, np.float32)}, wait=True)
+        steps = m.all_steps()
+        assert 3 in steps and len(steps) <= 2
+        m.close()
+
+    def test_restore_missing_raises(self, hvd, tmp_path):
+        from horovod_tpu.checkpoint import CheckpointManager
+        m = CheckpointManager(str(tmp_path / "empty"))
+        assert not m.has_checkpoint()
+        with pytest.raises(FileNotFoundError):
+            m.restore()
+        m.close()
+
+    def test_elastic_state_durable_cycle(self, hvd, tmp_path, rng):
+        """Durable elastic recovery: save TpuState trees, restore in a
+        'new process' (fresh manager)."""
+        from horovod_tpu.checkpoint import restore_state, save_state
+        from horovod_tpu.elastic import TpuState
+        params = {"w": np.asarray(rng.standard_normal(5), np.float32)}
+        st = TpuState(trees={"params": params}, epoch=3)
+        save_state(str(tmp_path / "st"), {"params": st.params,
+                                          "epoch": st.epoch})
+        loaded = restore_state(str(tmp_path / "st"))
+        np.testing.assert_allclose(loaded["params"]["w"], params["w"])
+        assert int(loaded["epoch"]) == 3

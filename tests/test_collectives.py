@@ -218,6 +218,69 @@ class TestAsyncAndMisc:
     def test_join(self, hvd):
         assert hvd.join() == N - 1
 
+    def test_join_uneven_batches(self, hvd, rng):
+        """Joined ranks contribute zeros; Average divides by active count
+        (reference: JOIN semantics, controller.cc:269-327)."""
+        x = _rank_data(rng, (5,), np.float32)
+        assert hvd.join(6) == -1
+        assert hvd.join(7) == -1
+        try:
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+            np.testing.assert_allclose(out[0], x[:6].sum(0), rtol=1e-5)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Average))
+            np.testing.assert_allclose(out[3], x[:6].mean(0), rtol=1e-5)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Min))
+            np.testing.assert_allclose(out[0], x[:6].min(0), rtol=1e-6)
+        finally:
+            for r in range(6):
+                hvd.join(r)  # completes and resets the join
+
+    def test_join_applies_to_async_path(self, hvd, rng):
+        x = _rank_data(rng, (4,), np.float32)
+        hvd.join(2)
+        try:
+            h = hvd.allreduce_async(x, op=hvd.Sum)
+            out = np.asarray(h.synchronize())
+            expected = np.delete(x, 2, axis=0).sum(0)
+            np.testing.assert_allclose(out[0], expected, rtol=1e-5)
+        finally:
+            for r in range(N):
+                if r != 2:
+                    hvd.join(r)
+
+    def test_join_masked_postscale(self, hvd, rng):
+        x = _rank_data(rng, (4,), np.float32)
+        hvd.join(0)
+        try:
+            out = np.asarray(hvd.allreduce(x, op=hvd.Max,
+                                           postscale_factor=2.0))
+            np.testing.assert_allclose(out[1], 2.0 * x[1:].max(0), rtol=1e-6)
+        finally:
+            for r in range(1, N):
+                hvd.join(r)
+
+    def test_collective_on_fully_joined_subset_raises(self, hvd, rng):
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        ps = hvd.add_process_set([3, 4])
+        hvd.join(3)
+        hvd.join(4)
+        try:
+            with pytest.raises(HorovodInternalError, match="joined"):
+                hvd.allreduce(np.zeros((2, 2), np.float32), process_set=ps)
+        finally:
+            for r in range(N):
+                if r not in (3, 4):
+                    hvd.join(r)
+            hvd.remove_process_set(ps)
+
+    def test_join_completion_resets(self, hvd, rng):
+        for r in range(N - 1):
+            assert hvd.join(r) == -1
+        assert hvd.join(N - 1) == N - 1
+        x = _rank_data(rng, (3,), np.float32)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        np.testing.assert_allclose(out[0], x.sum(0), rtol=1e-5)
+
     def test_broadcast_object(self, hvd):
         obj = {"lr": 0.1, "steps": [1, 2, 3]}
         assert hvd.broadcast_object(obj, root_rank=0) == obj

@@ -1,0 +1,111 @@
+"""Native runtime (libhvdtpu) tests: build, conversions, adasum, timeline.
+
+Validates the C++ host kernels against numpy/jax ground truth — the same
+role test_adasum_* plays against the Python reference in the reference suite
+(SURVEY.md §4).
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+native = pytest.importorskip("horovod_tpu.native")
+
+pytestmark = pytest.mark.skipif(not native.native_built(),
+                                reason="native toolchain unavailable")
+
+
+class TestHalfKernels:
+    def test_bf16_roundtrip_matches_jax(self, rng):
+        import jax.numpy as jnp
+        x = np.asarray(rng.standard_normal(1000) * 100, np.float32)
+        ours = native.fp32_to_bf16(x)
+        theirs = np.asarray(jnp.asarray(x).astype(jnp.bfloat16)).view(np.uint16)
+        np.testing.assert_array_equal(ours, theirs)
+        back = native.bf16_to_fp32(ours)
+        np.testing.assert_array_equal(
+            back, np.asarray(jnp.asarray(x).astype(jnp.bfloat16),
+                             np.float32))
+
+    def test_bf16_special_values(self):
+        x = np.array([0.0, -0.0, np.inf, -np.inf, np.nan, 1e-40],
+                     np.float32)
+        back = native.bf16_to_fp32(native.fp32_to_bf16(x))
+        assert back[0] == 0 and back[1] == 0
+        assert np.isposinf(back[2]) and np.isneginf(back[3])
+        assert np.isnan(back[4])
+
+    def test_fp16_matches_numpy(self, rng):
+        x = np.asarray(rng.standard_normal(1000) * 10, np.float32)
+        x = np.concatenate([x, [0.0, 65504.0, 1e6, -1e6, 1e-8, np.inf]]) \
+            .astype(np.float32)
+        with np.errstate(over="ignore"):  # 1e6 -> inf is the expected cast
+            ours = native.fp32_to_fp16(x)
+            theirs = x.astype(np.float16).view(np.uint16)
+            np.testing.assert_array_equal(ours, theirs)
+            np.testing.assert_array_equal(
+                native.fp16_to_fp32(ours),
+                x.astype(np.float16).astype(np.float32))
+
+
+class TestBf16Accumulate:
+    def test_accumulates_in_fp32(self, rng):
+        import jax.numpy as jnp
+        a = np.asarray(rng.standard_normal(256), np.float32)
+        b = np.asarray(rng.standard_normal(256), np.float32)
+        src = native.fp32_to_bf16(a)
+        dst = native.fp32_to_bf16(b)
+        out = native.bf16_accumulate(src, dst)
+        expected = np.asarray(
+            (jnp.asarray(a).astype(jnp.bfloat16).astype(jnp.float32)
+             + jnp.asarray(b).astype(jnp.bfloat16).astype(jnp.float32))
+            .astype(jnp.bfloat16)).view(np.uint16)
+        np.testing.assert_array_equal(out, expected)
+
+
+class TestNativeAdasum:
+    def test_matches_python_reference(self, rng):
+        from horovod_tpu.ops.adasum import adasum_combine
+        import jax.numpy as jnp
+        a = np.asarray(rng.standard_normal(512), np.float32)
+        b = np.asarray(rng.standard_normal(512) * 5, np.float32)
+        ours = native.adasum_combine(a, b)
+        ref = np.asarray(adasum_combine(jnp.asarray(a), jnp.asarray(b)))
+        np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-6)
+
+
+class TestNativeTimeline:
+    def test_writes_valid_chrome_trace(self, tmp_path):
+        path = str(tmp_path / "trace.json")
+        tl = native.NativeTimeline(path)
+        for i in range(100):
+            tl.record(f"op_{i}", "ALLREDUCE", "X", i * 10.0, 5.0, tid=i % 4)
+        tl.record("cycle", "cycle", "i", 1000.0)
+        tl.close()
+        data = json.load(open(path))
+        evs = data["traceEvents"]
+        assert len(evs) == 101
+        assert evs[0]["name"] == "op_0" and evs[0]["ph"] == "X"
+        assert evs[0]["dur"] == 5.0
+        assert evs[-1]["ph"] == "i"
+
+    def test_escapes_json(self, tmp_path):
+        path = str(tmp_path / "esc.json")
+        tl = native.NativeTimeline(path)
+        tl.record('weird"name\\x', "cat", "X", 0.0, 1.0)
+        tl.close()
+        evs = json.load(open(path))["traceEvents"]
+        assert evs[0]["name"] == 'weird"name\\x'
+
+    def test_python_timeline_uses_native(self, tmp_path, hvd):
+        from horovod_tpu.timeline import Timeline
+        path = str(tmp_path / "t.json")
+        tl = Timeline(path, native=True)
+        assert tl._native is not None
+        with tl.op_span("allreduce.g1", "ALLREDUCE"):
+            pass
+        tl.close()
+        evs = json.load(open(path))["traceEvents"]
+        assert len(evs) == 1 and evs[0]["cat"] == "ALLREDUCE"
