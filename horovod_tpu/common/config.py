@@ -138,6 +138,19 @@ class Config:
         c.size = _env_int("HOROVOD_SIZE", c.size)
         c.local_size = _env_int("HOROVOD_LOCAL_SIZE", c.local_size)
         c.cross_size = _env_int("HOROVOD_CROSS_SIZE", c.cross_size)
+        # mpirun/jsrun/srun-launched workers get no per-host HOROVOD_* rank
+        # env; derive the process index from the MPI/PMI/Slurm-provided env
+        # (reference: test/utils/common.py:32-64 mpi_env_rank_and_size reads
+        # the same variables).
+        if "HOROVOD_CROSS_RANK" not in os.environ:
+            for rank_var, size_var in (
+                    ("OMPI_COMM_WORLD_RANK", "OMPI_COMM_WORLD_SIZE"),
+                    ("PMI_RANK", "PMI_SIZE"),
+                    ("SLURM_PROCID", "SLURM_NTASKS")):
+                if rank_var in os.environ:
+                    c.cross_rank = _env_int(rank_var, c.cross_rank)
+                    c.cross_size = _env_int(size_var, c.cross_size)
+                    break
         c.coordinator_addr = os.environ.get("HOROVOD_COORDINATOR_ADDR",
                                             c.coordinator_addr)
         c.coordinator_port = _env_int("HOROVOD_COORDINATOR_PORT", c.coordinator_port)

@@ -21,15 +21,24 @@ _tried = False
 
 
 def _build():
+    # Build into a process-private target and publish with an atomic rename,
+    # so concurrent first-use builds (multiple workers, shared NFS checkout)
+    # can never leave a torn libhvdtpu.so behind.
+    tmp = f"libhvdtpu.{os.getpid()}.so"
     try:
-        subprocess.run(["make", "-C", _HERE, "-s"], check=True,
-                       capture_output=True, timeout=120)
+        subprocess.run(["make", "-C", _HERE, "-s", f"TARGET={tmp}"],
+                       check=True, capture_output=True, timeout=120)
+        os.replace(os.path.join(_HERE, tmp), _LIB_PATH)
         return True
-    except (subprocess.CalledProcessError, FileNotFoundError,
+    except (subprocess.CalledProcessError, FileNotFoundError, OSError,
             subprocess.TimeoutExpired) as e:
         out = getattr(e, "stderr", b"") or b""
         hvd_logging.debug("native build unavailable: %s %s", e,
                           out.decode(errors="replace")[-500:])
+        try:
+            os.unlink(os.path.join(_HERE, tmp))
+        except OSError:
+            pass
         return False
 
 
@@ -140,11 +149,15 @@ def fp16_to_fp32(src):
 def bf16_accumulate(src, dst):
     """dst += src on bf16 (uint16-viewed) buffers, accumulating in fp32 —
     host-side wire-dtype accumulation (reference: half.cc fp16 sum ops).
-    Mutates and returns ``dst``."""
+    Returns the accumulated buffer (``dst`` itself when it was already a
+    contiguous uint16 array, else a copy)."""
     import numpy as np
     lib = _require_lib()
     src = np.ascontiguousarray(src, np.uint16)
     dst = np.ascontiguousarray(dst, np.uint16)
+    if src.size != dst.size:
+        raise ValueError(
+            f"bf16_accumulate: size mismatch src={src.size} dst={dst.size}")
     lib.hvd_bf16_accumulate(_as_ptr(src, ctypes.c_uint16),
                             _as_ptr(dst, ctypes.c_uint16), src.size)
     return dst
@@ -155,6 +168,9 @@ def adasum_combine(a, b):
     lib = _require_lib()
     a = np.ascontiguousarray(a, np.float32)
     b = np.ascontiguousarray(b, np.float32)
+    if a.size != b.size:
+        raise ValueError(
+            f"adasum_combine: size mismatch a={a.size} b={b.size}")
     out = np.empty(a.shape, np.float32)
     lib.hvd_adasum_combine(_as_ptr(a, ctypes.c_float),
                            _as_ptr(b, ctypes.c_float),

@@ -169,7 +169,14 @@ def _allreduce_program(mesh, n, op, prescale, postscale, shapes, dtypes,
 
 
 @functools.lru_cache(maxsize=4096)
-def _allgather_program(mesh, n, shapes, dtypes):
+def _allgather_program(mesh, n, shapes, dtypes, active_mask=None):
+    """``active_mask``: joined ranks contribute a zero-size slice, i.e. their
+    rows are statically dropped from the concatenated output (reference: JOIN
+    gives joined ranks zero-size allgather contributions,
+    controller.cc:269-327)."""
+    active_idx = None if active_mask is None else \
+        np.nonzero(np.array(active_mask))[0]
+
     def body(*xs):
         out = []
         for x in xs:
@@ -177,6 +184,8 @@ def _allgather_program(mesh, n, shapes, dtypes):
             # flatten to the concatenated layout Horovod returns
             # (reference: collective_operations.h:137-174 size/displacement math).
             g = lax.all_gather(x, HVD_AXIS, axis=0, tiled=True)  # (n, m, ...)
+            if active_idx is not None:
+                g = g[active_idx]
             g = g.reshape((1, -1) + g.shape[2:]) if g.ndim > 1 else g
             out.append(g)
         return tuple(out)
@@ -213,7 +222,14 @@ def _broadcast_program(mesh, n, root_rank, shapes, dtypes):
 
 
 @functools.lru_cache(maxsize=4096)
-def _reducescatter_program(mesh, n, op, prescale, postscale, shapes, dtypes):
+def _reducescatter_program(mesh, n, op, prescale, postscale, shapes, dtypes,
+                           active_mask=None):
+    """``active_mask``: joined ranks contribute zeros to the reduction and
+    Average divides by the active count (reference: joined_size accounting,
+    controller.cc:269-327)."""
+    active = None if active_mask is None else np.array(active_mask)
+    n_active = n if active is None else int(active.sum())
+
     def body(*xs):
         out = []
         for x in xs:
@@ -222,10 +238,13 @@ def _reducescatter_program(mesh, n, op, prescale, postscale, shapes, dtypes):
             x = jnp.squeeze(x, 0)
             if prescale != 1.0:
                 x = x * jnp.asarray(prescale, x.dtype)
+            if active is not None:
+                keep = jnp.asarray(active)[lax.axis_index(HVD_AXIS)]
+                x = x * keep.astype(x.dtype)
             if op in (ReduceOp.SUM, ReduceOp.AVERAGE):
                 y = lax.psum_scatter(x, HVD_AXIS, scatter_dimension=0, tiled=True)
                 if op == ReduceOp.AVERAGE:
-                    y = y / jnp.asarray(n, y.dtype)
+                    y = y / jnp.asarray(n_active, y.dtype)
             else:
                 raise ValueError(
                     "reducescatter supports Sum/Average (reference parity: "
@@ -351,7 +370,7 @@ def grouped_allgather(tensors, process_set=None, name=None):
                 "allgather requires per-rank tensors of rank>=1 "
                 "(stacked input rank>=2)")
     shapes, dtypes = _signature(tensors)
-    prog = _allgather_program(mesh, n, shapes, dtypes)
+    prog = _allgather_program(mesh, n, shapes, dtypes, _active_mask(ps))
     with _timeline_op(name or "grouped_allgather", "ALLGATHER"):
         return list(prog(*tensors))
 
@@ -403,6 +422,13 @@ def grouped_broadcast(tensors, root_rank, process_set=None, name=None):
         root = root_rank
     if not (0 <= root < n):
         raise ValueError(f"root_rank {root_rank} out of range [0,{n})")
+    mask = _active_mask(ps)
+    if mask is not None and not mask[root]:
+        # Reference errors when the broadcast root has already joined
+        # (controller.cc join/root checks) — there is no data to send.
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        raise HorovodInternalError(
+            f"broadcast root_rank {root_rank} has joined")
     tensors = _prepare(tensors, mesh, n, "broadcast")
     shapes, dtypes = _signature(tensors)
     prog = _broadcast_program(mesh, n, int(root), shapes, dtypes)
@@ -435,7 +461,8 @@ def grouped_reducescatter(tensors, op=Sum, prescale_factor=1.0,
                 f"{n}, got {tuple(t.shape[1:])}")
     shapes, dtypes = _signature(tensors)
     prog = _reducescatter_program(mesh, n, ReduceOp(op), float(prescale_factor),
-                                  float(postscale_factor), shapes, dtypes)
+                                  float(postscale_factor), shapes, dtypes,
+                                  _active_mask(ps))
     with _timeline_op(name or "grouped_reducescatter", "REDUCESCATTER"):
         return list(prog(*tensors))
 
@@ -450,6 +477,11 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
     """
     mesh, ps = _mesh_for(process_set)
     n = ps.size()
+    if _active_mask(ps) is not None:
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        raise HorovodInternalError(
+            "alltoall is not supported while ranks have joined (matches the "
+            "reference: JOIN covers allreduce/allgather/broadcast only)")
     t = jnp.asarray(tensor)
     _check_stacked(t, n, "alltoall")
     if splits is None:

@@ -1,0 +1,52 @@
+"""Launch workers with ``jsrun`` on LSF/CSM clusters.
+
+Reference: horovod/runner/js_run.py (jsrun resource-set construction from the
+LSF allocation, :40-130). TPU-native simplification: one resource set per
+host, one worker process per resource set — the worker owns the host's chips
+and bootstraps ``jax.distributed``; per-core/per-GPU binding is irrelevant.
+Workers learn their process index from ``OMPI_COMM_WORLD_RANK`` (jsrun is
+Spectrum-MPI based) via Config.from_env fallbacks.
+"""
+
+import os
+import shutil
+import subprocess
+
+from horovod_tpu.runner import lsf
+from horovod_tpu.runner.mpi_run import _FORWARD_PREFIXES
+
+
+def js_available(env=None):
+    return shutil.which("jsrun") is not None
+
+
+def build_js_command(nhosts, env, command, extra_js_args=None):
+    """``jsrun`` line: one resource set per host, all cpus, one task each."""
+    cmd = ["jsrun",
+           "--nrs", str(nhosts),          # resource sets == hosts
+           "--tasks_per_rs", "1",         # one worker per host
+           "--rs_per_host", "1",
+           "--cpu_per_rs", "ALL_CPUS",
+           "--launch_distribution", "packed"]
+    names = sorted(k for k in env if k.startswith(_FORWARD_PREFIXES))
+    for n in names:
+        cmd += ["-E", n]
+    if extra_js_args:
+        cmd += list(extra_js_args)
+    cmd += list(command)
+    return cmd
+
+
+def js_run(hosts, env, command, extra_js_args=None, dry_run=False):
+    """Run across the LSF allocation via jsrun; returns exit code."""
+    if not js_available():
+        raise RuntimeError("hvdrun --launcher jsrun requires jsrun on PATH "
+                           "(Spectrum LSF with CSM)")
+    nhosts = len(hosts) if hosts else lsf.get_num_hosts()
+    full_env = {**os.environ, **env}
+    cmd = build_js_command(nhosts, full_env, command,
+                           extra_js_args=extra_js_args)
+    if dry_run:
+        return cmd
+    proc = subprocess.run(cmd, env=full_env)
+    return proc.returncode

@@ -50,6 +50,15 @@ def parse_args(argv=None):
     p.add_argument("--start-timeout", type=int, default=600,
                    dest="start_timeout")
     p.add_argument("--disable-cache", action="store_true")
+    p.add_argument("--launcher", dest="launcher", default="auto",
+                   choices=["auto", "ssh", "mpi", "jsrun"],
+                   help="Process launcher: ssh fan-out (default), mpirun, or "
+                        "jsrun on LSF. 'auto' picks jsrun inside an LSF "
+                        "allocation with jsrun available, else ssh. "
+                        "(reference: horovodrun --gloo/--mpi/... selection, "
+                        "launch.py:286-596 + js_run path)")
+    p.add_argument("--mpi-args", dest="mpi_args", default="",
+                   help="Extra args appended to mpirun/jsrun.")
 
     tuning = p.add_argument_group("tuning")
     tuning.add_argument("--fusion-threshold-mb", type=float,
@@ -126,10 +135,27 @@ def _resolve_hosts(args):
         return parse_host_files(args.hostfile)
     if args.hosts:
         return parse_hosts(args.hosts)
+    # Inside an LSF allocation with no explicit hosts, use the allocation
+    # (reference: launch.py LSF default via util/lsf.py).
+    from horovod_tpu.runner import lsf
+    if lsf.using_lsf():
+        return parse_hosts(lsf.lsf_hosts_string())
     # Default: all local chips, single host (reference defaults to
     # localhost:np, launch.py).
     nlocal = args.np or 1
     return parse_hosts(f"localhost:{nlocal}")
+
+
+def _resolve_launcher(args):
+    if getattr(args, "launcher", "auto") != "auto":
+        return args.launcher
+    # jsrun places tasks according to the LSF allocation, so auto-selecting it
+    # is only valid when the user did not name hosts explicitly.
+    if not args.hosts and not args.hostfile:
+        from horovod_tpu.runner import lsf
+        if lsf.using_jsrun():
+            return "jsrun"
+    return "ssh"
 
 
 def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
@@ -152,6 +178,51 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
     })
     config_parser.set_env_from_args(env, args)
     return env
+
+
+def _run_static_mpi(args, launcher, extra_env=None):
+    """mpirun/jsrun fan-out: a single launcher invocation starts one worker
+    per host; workers derive their process index from the MPI-provided env
+    (OMPI_COMM_WORLD_RANK / PMI_RANK, see Config.from_env fallbacks) instead
+    of per-host HOROVOD_CROSS_RANK."""
+    from horovod_tpu.runner import js_run as js_mod
+    from horovod_tpu.runner import mpi_run as mpi_mod
+
+    hosts = _resolve_hosts(args)
+    slot_infos = get_host_assignments(hosts, args.np or None)
+    by_host = host_assignment_by_host(slot_infos)
+    first = slot_infos[0]
+
+    coordinator_addr = socket.gethostname() \
+        if len(by_host) > 1 else "localhost"
+    coordinator_port = _free_port()
+    kv = KVStoreServer()
+    kv_port = kv.start()
+    kv.put("global", "size", str(first.size).encode())
+
+    env = dict(extra_env or {})
+    env.update({
+        "HOROVOD_SIZE": str(first.size),
+        "HOROVOD_LOCAL_SIZE": str(first.local_size),
+        "HOROVOD_CROSS_SIZE": str(len(by_host)),
+        "HOROVOD_COORDINATOR_ADDR": coordinator_addr,
+        "HOROVOD_COORDINATOR_PORT": str(coordinator_port),
+        "HOROVOD_KV_ADDR": coordinator_addr,
+        "HOROVOD_KV_PORT": str(kv_port),
+    })
+    config_parser.set_env_from_args(env, args)
+    import shlex
+    extra = shlex.split(args.mpi_args) if getattr(args, "mpi_args", "") \
+        else None
+    host_slots = [(h, slots[0].local_size) for h, slots in by_host.items()]
+    try:
+        if launcher == "jsrun":
+            return js_mod.js_run(host_slots, env, args.command,
+                                 extra_js_args=extra)
+        return mpi_mod.mpi_run(host_slots, env, args.command,
+                               extra_mpi_args=extra)
+    finally:
+        kv.stop()
 
 
 def _run_static(args, extra_env=None, harvest=None):
@@ -215,6 +286,9 @@ def run_commandline(argv=None):
     try:
         if args.host_discovery_script or args.min_np or args.max_np:
             return _run_elastic(args)
+        launcher = _resolve_launcher(args)
+        if launcher in ("mpi", "jsrun"):
+            return _run_static_mpi(args, launcher)
         return _run_static(args)
     except (ValueError, TimeoutError) as e:
         print(f"hvdrun: error: {e}", file=sys.stderr)

@@ -248,6 +248,58 @@ class TestAsyncAndMisc:
                 if r != 2:
                     hvd.join(r)
 
+    def test_join_allgather_drops_joined_slice(self, hvd, rng):
+        """reference: joined ranks give zero-size allgather contributions
+        (controller.cc:269-327)."""
+        x = _rank_data(rng, (3,), np.float32)
+        hvd.join(5)
+        try:
+            out = np.asarray(hvd.allgather(x))
+            assert out.shape == (N, (N - 1) * 3)
+            expected = np.delete(x, 5, axis=0).reshape(-1)
+            np.testing.assert_allclose(out[0], expected, rtol=1e-6)
+        finally:
+            for r in range(N):
+                if r != 5:
+                    hvd.join(r)
+
+    def test_join_reducescatter_excludes_joined(self, hvd, rng):
+        x = _rank_data(rng, (N * 2,), np.float32)
+        hvd.join(1)
+        try:
+            out = np.asarray(hvd.reducescatter(x, op=hvd.Average))
+            expected = np.delete(x, 1, axis=0).mean(0)
+            np.testing.assert_allclose(out[0], expected[:2], rtol=1e-5)
+        finally:
+            for r in range(N):
+                if r != 1:
+                    hvd.join(r)
+
+    def test_join_broadcast_from_joined_root_raises(self, hvd, rng):
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        x = _rank_data(rng, (2,), np.float32)
+        hvd.join(0)
+        try:
+            with pytest.raises(HorovodInternalError, match="joined"):
+                hvd.broadcast(x, root_rank=0)
+            # broadcasting from a live root still works
+            out = np.asarray(hvd.broadcast(x, root_rank=3))
+            np.testing.assert_allclose(out[0], x[3], rtol=1e-6)
+        finally:
+            for r in range(1, N):
+                hvd.join(r)
+
+    def test_join_alltoall_raises(self, hvd, rng):
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        x = _rank_data(rng, (N,), np.float32)
+        hvd.join(0)
+        try:
+            with pytest.raises(HorovodInternalError, match="alltoall"):
+                hvd.alltoall(x)
+        finally:
+            for r in range(1, N):
+                hvd.join(r)
+
     def test_join_masked_postscale(self, hvd, rng):
         x = _rank_data(rng, (4,), np.float32)
         hvd.join(0)

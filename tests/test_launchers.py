@@ -1,0 +1,156 @@
+"""MPI / jsrun / LSF launcher paths (reference test model:
+test/single/test_run.py — launcher logic with mocked mpirun availability)."""
+
+import os
+from unittest import mock
+
+import pytest
+
+from horovod_tpu.runner import js_run, lsf, mpi_run
+from horovod_tpu.runner.launch import _resolve_launcher, parse_args
+
+
+class TestMPIImplDetection:
+    def test_open_mpi(self):
+        out = "mpirun (Open MPI) 4.1.4\n\nReport bugs to ..."
+        assert mpi_run._impl_from_version_output(out) == mpi_run.OPENMPI
+
+    def test_spectrum(self):
+        assert mpi_run._impl_from_version_output(
+            "IBM Spectrum MPI 10.3") == mpi_run.SPECTRUM_MPI
+
+    def test_intel(self):
+        assert mpi_run._impl_from_version_output(
+            "Intel(R) MPI Library for Linux") == mpi_run.INTEL_MPI
+
+    def test_mpich(self):
+        assert mpi_run._impl_from_version_output(
+            "HYDRA build details:\n    Version: 4.0") == mpi_run.MPICH
+
+    def test_unknown(self):
+        assert mpi_run._impl_from_version_output("gibberish") == \
+            mpi_run.UNKNOWN
+
+    def test_missing_when_no_mpirun(self):
+        with mock.patch("shutil.which", return_value=None):
+            assert mpi_run.get_mpi_implementation() == mpi_run.MISSING
+            assert not mpi_run.mpi_available()
+
+
+class TestBuildMpiCommand:
+    def test_openmpi_multi_host(self):
+        env = {"HOROVOD_SIZE": "8", "PATH": "/usr/bin", "IRRELEVANT": "x"}
+        cmd = mpi_run.build_mpi_command(
+            mpi_run.OPENMPI, [("h1", 4), ("h2", 4)], env,
+            ["python", "train.py"])
+        assert cmd[0] == "mpirun"
+        assert cmd[cmd.index("-np") + 1] == "2"  # one proc per host
+        assert cmd[cmd.index("-H") + 1] == "h1:1,h2:1"
+        assert "-x" in cmd and "HOROVOD_SIZE" in cmd
+        assert "IRRELEVANT" not in cmd
+        assert cmd[-2:] == ["python", "train.py"]
+
+    def test_localhost_omits_host_flag(self):
+        cmd = mpi_run.build_mpi_command(
+            mpi_run.OPENMPI, [("localhost", 8)], {}, ["python", "t.py"])
+        assert "-H" not in cmd
+
+    def test_mpich_genvlist(self):
+        env = {"HOROVOD_SIZE": "8", "JAX_PLATFORMS": "cpu"}
+        cmd = mpi_run.build_mpi_command(
+            mpi_run.MPICH, [("h1", 4), ("h2", 4)], env, ["python", "t.py"])
+        gl = cmd[cmd.index("-genvlist") + 1]
+        assert "HOROVOD_SIZE" in gl and "JAX_PLATFORMS" in gl
+
+    def test_extra_args(self):
+        cmd = mpi_run.build_mpi_command(
+            mpi_run.OPENMPI, [("localhost", 1)], {}, ["python", "t.py"],
+            extra_mpi_args=["--tag-output"])
+        assert "--tag-output" in cmd
+
+    def test_mpi_run_raises_without_mpi(self):
+        with mock.patch.object(mpi_run, "get_mpi_implementation",
+                               return_value=mpi_run.MISSING):
+            with pytest.raises(RuntimeError, match="mpirun"):
+                mpi_run.mpi_run([("h1", 1)], {}, ["python", "t.py"])
+
+    def test_dry_run(self):
+        with mock.patch.object(mpi_run, "get_mpi_implementation",
+                               return_value=mpi_run.OPENMPI):
+            cmd = mpi_run.mpi_run([("h1", 1), ("h2", 1)], {"HOROVOD_SIZE": "2"},
+                                  ["python", "t.py"], dry_run=True)
+        assert cmd[0] == "mpirun"
+
+
+class TestJsRun:
+    def test_build(self):
+        cmd = js_run.build_js_command(
+            4, {"HOROVOD_SIZE": "16"}, ["python", "t.py"])
+        assert cmd[0] == "jsrun"
+        assert cmd[cmd.index("--nrs") + 1] == "4"
+        assert cmd[cmd.index("--tasks_per_rs") + 1] == "1"
+        assert "-E" in cmd and "HOROVOD_SIZE" in cmd
+
+    def test_unavailable_raises(self):
+        with mock.patch("shutil.which", return_value=None):
+            with pytest.raises(RuntimeError, match="jsrun"):
+                js_run.js_run([("h1", 1)], {}, ["python", "t.py"])
+
+
+class TestLSF:
+    def test_not_in_lsf(self):
+        assert not lsf.using_lsf(env={})
+
+    def test_hostfile(self, tmp_path):
+        hf = tmp_path / "hosts"
+        hf.write_text("launch1\nnode1\nnode1\nnode2\nnode2\n")
+        env = {"LSB_JOBID": "1", "LSB_DJOB_HOSTFILE": str(hf)}
+        assert lsf.get_compute_hosts(env) == [
+            ("launch1", 1), ("node1", 2), ("node2", 2)]
+        assert lsf.get_num_hosts(env) == 3
+        assert lsf.get_num_slots(env) == 5
+        assert lsf.lsf_hosts_string(env) == "launch1:1,node1:2,node2:2"
+
+    def test_mcpu_hosts(self):
+        env = {"LSB_JOBID": "1", "LSB_MCPU_HOSTS": "node1 4 node2 4"}
+        assert lsf.get_compute_hosts(env) == [("node1", 4), ("node2", 4)]
+
+    def test_no_host_info_raises(self):
+        with pytest.raises(ValueError):
+            lsf.get_compute_hosts({"LSB_JOBID": "1"})
+
+
+class TestLauncherSelection:
+    def test_default_ssh(self):
+        args = parse_args(["python", "t.py"])
+        with mock.patch.dict(os.environ, {}, clear=False):
+            os.environ.pop("LSB_JOBID", None)
+            assert _resolve_launcher(args) == "ssh"
+
+    def test_explicit_mpi(self):
+        args = parse_args(["--launcher", "mpi", "python", "t.py"])
+        assert _resolve_launcher(args) == "mpi"
+
+    def test_auto_jsrun_in_lsf(self):
+        args = parse_args(["python", "t.py"])
+        with mock.patch.dict(os.environ, {"LSB_JOBID": "7"}):
+            with mock.patch("shutil.which", return_value="/usr/bin/jsrun"):
+                assert _resolve_launcher(args) == "jsrun"
+
+
+class TestMpiEnvFallback:
+    def test_ompi_rank(self):
+        from horovod_tpu.common.config import Config
+        env = {"OMPI_COMM_WORLD_RANK": "3", "OMPI_COMM_WORLD_SIZE": "4"}
+        with mock.patch.dict(os.environ, env):
+            os.environ.pop("HOROVOD_CROSS_RANK", None)
+            c = Config.from_env()
+        assert c.cross_rank == 3 and c.cross_size == 4
+
+    def test_horovod_env_wins(self):
+        from horovod_tpu.common.config import Config
+        env = {"HOROVOD_CROSS_RANK": "1", "HOROVOD_CROSS_SIZE": "2",
+               "OMPI_COMM_WORLD_RANK": "3", "OMPI_COMM_WORLD_SIZE": "4"}
+        with mock.patch.dict(os.environ, env):
+            c = Config.from_env()
+        assert c.cross_rank == 1 and c.cross_size == 2

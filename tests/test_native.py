@@ -65,6 +65,22 @@ class TestBf16Accumulate:
         np.testing.assert_array_equal(out, expected)
 
 
+class TestNativeBounds:
+    def test_accumulate_size_mismatch_raises(self, rng):
+        src = native.fp32_to_bf16(
+            np.asarray(rng.standard_normal(64), np.float32))
+        dst = native.fp32_to_bf16(
+            np.asarray(rng.standard_normal(32), np.float32))
+        with pytest.raises(ValueError, match="size mismatch"):
+            native.bf16_accumulate(src, dst)
+
+    def test_adasum_size_mismatch_raises(self, rng):
+        a = np.asarray(rng.standard_normal(64), np.float32)
+        b = np.asarray(rng.standard_normal(32), np.float32)
+        with pytest.raises(ValueError, match="size mismatch"):
+            native.adasum_combine(a, b)
+
+
 class TestNativeAdasum:
     def test_matches_python_reference(self, rng):
         from horovod_tpu.ops.adasum import adasum_combine
