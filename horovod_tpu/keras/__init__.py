@@ -1,0 +1,73 @@
+"""Keras frontend — the ``horovod.keras`` API surface for Keras 3.
+
+Reference: horovod/keras/__init__.py (DistributedOptimizer :40-130,
+load_model :252) + horovod/_keras/ shared impl. The reference wraps the
+legacy ``optimizer.get_gradients``; Keras 3 removed it, so the TPU-native
+wrapper intercepts ``apply_gradients`` — the one choke point every Keras 3
+train step passes through — and allreduces there.
+"""
+
+from horovod_tpu.common.basics import (init, shutdown, is_initialized, rank,
+                                       local_rank, cross_rank, size,
+                                       local_size, cross_size)
+from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min, Product,
+                                            Sum)
+from horovod_tpu.tensorflow import (Compression, allgather, allreduce,
+                                    broadcast, broadcast_object,
+                                    broadcast_variables)
+
+from horovod_tpu.keras import callbacks  # noqa: F401
+
+__all__ = ["init", "shutdown", "is_initialized", "rank", "local_rank",
+           "cross_rank", "size", "local_size", "cross_size",
+           "Average", "Sum", "Adasum", "Min", "Max", "Product",
+           "Compression", "allreduce", "allgather", "broadcast",
+           "broadcast_object", "broadcast_variables",
+           "DistributedOptimizer", "load_model", "callbacks"]
+
+
+def DistributedOptimizer(optimizer, name=None,
+                         compression=Compression.none,
+                         sparse_as_dense=False, op=Average,
+                         backward_passes_per_step=1, process_set=None):
+    """Wrap a Keras optimizer so gradients are averaged across hosts inside
+    ``apply_gradients`` (reference: hvd.DistributedOptimizer
+    keras/__init__.py:40-130)."""
+    import horovod_tpu.tensorflow as hvd_tf
+
+    cls = optimizer.__class__
+
+    class _Distributed(cls):
+        _hvd_wrapped = True
+
+        def apply_gradients(self, grads_and_vars, *args, **kwargs):
+            grads_and_vars = list(grads_and_vars)
+            grads = [g for g, _ in grads_and_vars]
+            variables = [v for _, v in grads_and_vars]
+            live = [g for g in grads if g is not None]
+            if live:
+                reduced = iter(hvd_tf.grouped_allreduce(
+                    live, op=op, process_set=process_set))
+                grads = [None if g is None else next(reduced) for g in grads]
+            return super().apply_gradients(zip(grads, variables), *args,
+                                           **kwargs)
+
+    _Distributed.__name__ = cls.__name__
+    cfg = optimizer.get_config()
+    dist = _Distributed.from_config(cfg)
+    return dist
+
+
+def load_model(filepath, custom_optimizers=None, custom_objects=None,
+               compression=Compression.none):
+    """Load a Keras model wrapping its optimizer as a DistributedOptimizer
+    (reference: keras/__init__.py:252-289)."""
+    import keras
+
+    model = keras.models.load_model(filepath,
+                                    custom_objects=custom_objects)
+    if model.optimizer is not None and \
+            not getattr(model.optimizer, "_hvd_wrapped", False):
+        model.optimizer = DistributedOptimizer(model.optimizer,
+                                               compression=compression)
+    return model

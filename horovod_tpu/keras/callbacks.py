@@ -1,0 +1,118 @@
+"""Keras callbacks (reference: horovod/_keras/callbacks.py:23-193).
+
+The flax-loop equivalents live in horovod_tpu/callbacks.py; these are the
+keras.callbacks.Callback adapters over the same semantics.
+"""
+
+import keras
+import numpy as np
+
+
+class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
+    """Broadcast all model/optimizer variables from root at train start so
+    every host begins identically (reference: _keras/callbacks.py:23-60)."""
+
+    def __init__(self, root_rank=0, process_set=None):
+        super().__init__()
+        self.root_rank = root_rank
+        self.process_set = process_set
+        self.broadcast_done = False
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.broadcast_done:
+            return
+        import horovod_tpu.tensorflow as hvd_tf
+        hvd_tf.broadcast_variables(self.model.trainable_variables,
+                                   root_rank=self.root_rank,
+                                   process_set=self.process_set)
+        if self.model.optimizer is not None:
+            hvd_tf.broadcast_variables(self.model.optimizer.variables,
+                                       root_rank=self.root_rank,
+                                       process_set=self.process_set)
+        self.broadcast_done = True
+
+
+class MetricAverageCallback(keras.callbacks.Callback):
+    """Average epoch-end metrics across hosts (reference:
+    _keras/callbacks.py:62-109)."""
+
+    def __init__(self, process_set=None):
+        super().__init__()
+        self.process_set = process_set
+
+    def on_epoch_end(self, epoch, logs=None):
+        if not logs:
+            return
+        import horovod_tpu.tensorflow as hvd_tf
+        for k, v in list(logs.items()):
+            if isinstance(v, (int, float, np.floating, np.integer)):
+                logs[k] = float(hvd_tf.allreduce(
+                    np.asarray(v, np.float32), op=hvd_tf.Average,
+                    process_set=self.process_set).numpy())
+
+
+class LearningRateScheduleCallback(keras.callbacks.Callback):
+    """Multiply the base LR by ``multiplier`` inside [start_epoch, end_epoch)
+    (reference: _keras/callbacks.py:111-160)."""
+
+    def __init__(self, initial_lr, multiplier, start_epoch=0, end_epoch=None,
+                 staircase=True, momentum_correction=True, steps_per_epoch=None):
+        super().__init__()
+        self.initial_lr = initial_lr
+        self.start_epoch = start_epoch
+        self.end_epoch = end_epoch
+        self.staircase = staircase
+        self.steps_per_epoch = steps_per_epoch
+        self.current_epoch = 0
+        if callable(multiplier):
+            self.multiplier = multiplier
+        else:
+            self.multiplier = lambda epoch: multiplier
+
+    def _in_range(self, epoch):
+        if epoch < self.start_epoch:
+            return False
+        return self.end_epoch is None or epoch < self.end_epoch
+
+    def on_epoch_begin(self, epoch, logs=None):
+        self.current_epoch = epoch
+        if self.staircase and self._in_range(epoch):
+            self._set_lr(self.initial_lr * self.multiplier(epoch))
+
+    def on_batch_begin(self, batch, logs=None):
+        if self.staircase or not self._in_range(self.current_epoch):
+            return
+        if self.steps_per_epoch is None:
+            return
+        frac_epoch = self.current_epoch + batch / self.steps_per_epoch
+        self._set_lr(self.initial_lr * self.multiplier(frac_epoch))
+
+    def _set_lr(self, lr):
+        self.model.optimizer.learning_rate.assign(lr)
+
+    def on_epoch_end(self, epoch, logs=None):
+        if logs is not None:
+            logs["lr"] = float(
+                np.asarray(self.model.optimizer.learning_rate))
+
+
+class LearningRateWarmupCallback(LearningRateScheduleCallback):
+    """Linear LR ramp from initial_lr to initial_lr*size over warmup_epochs
+    (reference: _keras/callbacks.py:162-193 — the gradual warmup of the
+    'ImageNet in 1 Hour' recipe)."""
+
+    def __init__(self, initial_lr, warmup_epochs=5, momentum_correction=True,
+                 steps_per_epoch=None, verbose=0, process_set=None):
+        from horovod_tpu.common import basics
+
+        def multiplier(epoch):
+            # epoch may be fractional (per-batch ramp)
+            size = basics.size()
+            return 1.0 / size + epoch * (1.0 - 1.0 / size) / warmup_epochs
+
+        super().__init__(initial_lr=initial_lr, multiplier=multiplier,
+                         start_epoch=0, end_epoch=warmup_epochs,
+                         staircase=False,
+                         momentum_correction=momentum_correction,
+                         steps_per_epoch=steps_per_epoch)
+        self.verbose = verbose

@@ -397,8 +397,14 @@ def allgather_ragged(tensors, process_set=None, name=None):
         jnp.pad(t, [(0, max_size - s)] + [(0, 0)] * (t.ndim - 1))
         for t, s in zip(tensors, sizes)])
     gathered = allgather(padded, process_set=process_set, name=name)
-    row0 = gathered[0].reshape((n, max_size) + tuple(tensors[0].shape[1:]))
-    return jnp.concatenate([row0[r, :sizes[r]] for r in range(n)], axis=0)
+    # Joined ranks' slices were dropped by the masked allgather, so the
+    # output rows hold n_active blocks, in active-rank order.
+    mask = _active_mask(ps)
+    active = range(n) if mask is None else np.nonzero(np.array(mask))[0]
+    row0 = gathered[0].reshape(
+        (len(list(active)), max_size) + tuple(tensors[0].shape[1:]))
+    return jnp.concatenate(
+        [row0[i, :sizes[r]] for i, r in enumerate(active)], axis=0)
 
 
 def broadcast(tensor, root_rank, process_set=None, name=None):

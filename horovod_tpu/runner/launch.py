@@ -180,6 +180,22 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
     return env
 
 
+def _start_rendezvous(args):
+    """Shared bootstrap for all static launchers: host assignment, coordinator
+    address/port selection, KV server (reference: RendezvousServer start in
+    launch_gloo, gloo_run.py:242-260)."""
+    hosts = _resolve_hosts(args)
+    slot_infos = get_host_assignments(hosts, args.np or None)
+    by_host = host_assignment_by_host(slot_infos)
+    coordinator_addr = socket.gethostname() \
+        if len(by_host) > 1 else "localhost"
+    coordinator_port = _free_port()
+    kv = KVStoreServer()
+    kv_port = kv.start()
+    kv.put("global", "size", str(slot_infos[0].size).encode())
+    return slot_infos, by_host, coordinator_addr, coordinator_port, kv, kv_port
+
+
 def _run_static_mpi(args, launcher, extra_env=None):
     """mpirun/jsrun fan-out: a single launcher invocation starts one worker
     per host; workers derive their process index from the MPI-provided env
@@ -188,17 +204,9 @@ def _run_static_mpi(args, launcher, extra_env=None):
     from horovod_tpu.runner import js_run as js_mod
     from horovod_tpu.runner import mpi_run as mpi_mod
 
-    hosts = _resolve_hosts(args)
-    slot_infos = get_host_assignments(hosts, args.np or None)
-    by_host = host_assignment_by_host(slot_infos)
+    slot_infos, by_host, coordinator_addr, coordinator_port, kv, kv_port = \
+        _start_rendezvous(args)
     first = slot_infos[0]
-
-    coordinator_addr = socket.gethostname() \
-        if len(by_host) > 1 else "localhost"
-    coordinator_port = _free_port()
-    kv = KVStoreServer()
-    kv_port = kv.start()
-    kv.put("global", "size", str(first.size).encode())
 
     env = dict(extra_env or {})
     env.update({
@@ -226,16 +234,8 @@ def _run_static_mpi(args, launcher, extra_env=None):
 
 
 def _run_static(args, extra_env=None, harvest=None):
-    hosts = _resolve_hosts(args)
-    slot_infos = get_host_assignments(hosts, args.np or None)
-    by_host = host_assignment_by_host(slot_infos)
-
-    coordinator_addr = socket.gethostname() \
-        if len(by_host) > 1 else "localhost"
-    coordinator_port = _free_port()
-    kv = KVStoreServer()
-    kv_port = kv.start()
-    kv.put("global", "size", str(slot_infos[0].size).encode())
+    slot_infos, by_host, coordinator_addr, coordinator_port, kv, kv_port = \
+        _start_rendezvous(args)
 
     workers = []
     try:

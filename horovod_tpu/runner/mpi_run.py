@@ -108,15 +108,23 @@ def build_mpi_command(impl, hosts, env, command, extra_mpi_args=None):
 
 def mpi_run(hosts, env, command, extra_mpi_args=None, dry_run=False):
     """Run the training command across hosts via mpirun; returns exit code."""
-    impl = get_mpi_implementation(env)
+    # Probe and forward with the user's full shell environment — the probe
+    # needs PATH et al. (wrapper-script mpiruns), and HOROVOD_/JAX_/XLA_ vars
+    # exported in the shell must reach remote workers.
+    full_env = {**os.environ, **env}
+    impl = get_mpi_implementation(full_env)
     if impl == MISSING:
         raise RuntimeError(
             "hvdrun --launcher mpi requires an MPI installation with mpirun "
             "on PATH. Install Open MPI / MPICH, or use the default ssh "
             "launcher.")
-    # Forward-flag computation must see the user's shell environment too, so
-    # HOROVOD_/JAX_/XLA_ vars exported in the shell reach remote workers.
-    full_env = {**os.environ, **env}
+    if impl == UNKNOWN:
+        # Proceeding would emit no env-forwarding flags and the remote
+        # workers would silently train unsynchronized.
+        raise RuntimeError(
+            "hvdrun --launcher mpi: could not classify the installed MPI "
+            "from `mpirun --version` (need Open MPI, Spectrum MPI, MPICH, "
+            "or Intel MPI); use the default ssh launcher instead.")
     cmd = build_mpi_command(impl, hosts, full_env, command,
                             extra_mpi_args=extra_mpi_args)
     if dry_run:

@@ -1,0 +1,280 @@
+"""TensorFlow frontend — the ``horovod.tensorflow`` API surface.
+
+Reference: horovod/tensorflow/__init__.py (allreduce & friends :58-170,
+DistributedGradientTape :957-1110, broadcast_variables), mpi_ops.py.
+
+Design: like the torch frontend, TF here is a host-side frontend over the XLA
+eager runtime — a tf.Tensor is bridged via numpy (TF already yields ml_dtypes
+bfloat16 arrays, so the bf16 wire path is zero-copy in dtype terms), rides the
+host's mesh slices, and the chip-axis collective equals the cross-host
+collective. There is no TF custom-op/kernel registration (reference:
+tensorflow/mpi_ops.cc AsyncOpKernels) because there is no C++ scheduler to
+feed — dispatch is JAX's async dispatch.
+"""
+
+import numpy as np
+
+from horovod_tpu.common.basics import (init, shutdown, is_initialized, rank,
+                                       local_rank, cross_rank, size,
+                                       local_size, cross_size)
+from horovod_tpu.common.process_sets import (ProcessSet, add_process_set,
+                                             global_process_set,
+                                             process_set_by_id,
+                                             remove_process_set)
+from horovod_tpu.ops import collective_ops as C
+from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min, Product,
+                                            ReduceOp, Sum)
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "local_rank", "cross_rank",
+    "size", "local_size", "cross_size", "ProcessSet", "add_process_set",
+    "global_process_set", "process_set_by_id", "remove_process_set",
+    "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",
+    "allreduce", "grouped_allreduce", "allgather", "broadcast", "alltoall",
+    "reducescatter", "broadcast_variables", "broadcast_object",
+    "DistributedGradientTape", "Compression", "join", "barrier",
+]
+
+
+def _tf():
+    import tensorflow as tf
+    return tf
+
+
+def _to_numpy(t):
+    tf = _tf()
+    if isinstance(t, tf.IndexedSlices):
+        raise ValueError(
+            "IndexedSlices reach the dense path via convert_to_tensor first "
+            "(see allreduce(sparse_as_dense) below)")
+    if isinstance(t, tf.Variable):
+        t = t.value()
+    if not isinstance(t, tf.Tensor):
+        t = tf.convert_to_tensor(t)
+    return t.numpy(), t.dtype
+
+
+def _to_tf(a, tf_dtype):
+    tf = _tf()
+    return tf.constant(np.asarray(a), dtype=tf_dtype)
+
+
+def _stack(a, ps):
+    return np.broadcast_to(a, (ps.size(),) + a.shape)
+
+
+def _ps(process_set):
+    return process_set if process_set is not None else C.global_process_set
+
+
+class Compression:
+    """reference: horovod/tensorflow/compression.py."""
+
+    class none:
+        @staticmethod
+        def compress(a):
+            return a, None
+
+        @staticmethod
+        def decompress(a, ctx):
+            return a
+
+    class fp16:
+        @staticmethod
+        def compress(a):
+            if a.dtype in (np.float32, np.float64):
+                return a.astype(np.float16), a.dtype
+            return a, None
+
+        @staticmethod
+        def decompress(a, ctx):
+            return a if ctx is None else np.asarray(a).astype(ctx)
+
+    class bf16:
+        @staticmethod
+        def compress(a):
+            import ml_dtypes
+            if a.dtype in (np.float32, np.float64):
+                return a.astype(ml_dtypes.bfloat16), a.dtype
+            return a, None
+
+        @staticmethod
+        def decompress(a, ctx):
+            return a if ctx is None else np.asarray(a).astype(ctx)
+
+
+def allreduce(tensor, average=None, op=None, prescale_factor=1.0,
+              postscale_factor=1.0, compression=Compression.none,
+              sparse_as_dense=False, name=None, process_set=None):
+    """reference: hvd.allreduce (tensorflow/__init__.py:58-170, incl. the
+    IndexedSlices→dense path when sparse_as_dense)."""
+    tf = _tf()
+    if op is not None and average is not None:
+        raise ValueError("specify either op or the legacy average flag")
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    if isinstance(tensor, tf.IndexedSlices):
+        if not sparse_as_dense:
+            # dense allgather of values+indices is the reference's default
+            # sparse path; here sparse inputs require opt-in densification.
+            raise ValueError(
+                "IndexedSlices input requires sparse_as_dense=True "
+                "(the TPU data plane is dense)")
+        tensor = tf.convert_to_tensor(tensor)
+    a, dtype = _to_numpy(tensor)
+    compressed, ctx = compression.compress(a)
+    ps = _ps(process_set)
+    out = C.allreduce(_stack(compressed, ps), op=op,
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      process_set=process_set, name=name)
+    return _to_tf(compression.decompress(np.asarray(out)[0], ctx), dtype)
+
+
+def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
+                      postscale_factor=1.0, name=None, process_set=None):
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    tf = _tf()
+    if not tf.executing_eagerly():
+        # Inside tf.function (Keras compiled train steps): the collective
+        # rides a host-callback op in the graph — the numpy_function here is
+        # the moral equivalent of the reference's HorovodAllreduce custom op
+        # (reference: tensorflow/mpi_ops.cc:443-516 AsyncOpKernel).
+        return _graph_grouped_allreduce(tensors, op, prescale_factor,
+                                        postscale_factor, process_set)
+    arrs, dtypes = zip(*(_to_numpy(t) for t in tensors))
+    ps = _ps(process_set)
+    outs = C.grouped_allreduce([_stack(a, ps) for a in arrs], op=op,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor,
+                               process_set=process_set, name=name)
+    return [_to_tf(np.asarray(o)[0], dt) for o, dt in zip(outs, dtypes)]
+
+
+def _graph_grouped_allreduce(tensors, op, prescale_factor, postscale_factor,
+                             process_set):
+    tf = _tf()
+    # numpy_function has no bf16/f16 kernel coverage; widen those lanes.
+    wire = [t if t.dtype not in (tf.bfloat16, tf.float16)
+            else tf.cast(t, tf.float32) for t in tensors]
+
+    def _np_fn(*arrs):
+        ps = _ps(process_set)
+        outs = C.grouped_allreduce([_stack(np.asarray(a), ps) for a in arrs],
+                                   op=op, prescale_factor=prescale_factor,
+                                   postscale_factor=postscale_factor,
+                                   process_set=process_set)
+        return [np.asarray(o)[0].astype(a.dtype)
+                for o, a in zip(outs, arrs)]
+
+    outs = tf.numpy_function(_np_fn, wire, [t.dtype for t in wire],
+                             name="hvd_grouped_allreduce")
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    results = []
+    for o, t in zip(outs, tensors):
+        o.set_shape(t.shape)
+        results.append(tf.cast(o, t.dtype) if o.dtype != t.dtype else o)
+    return results
+
+
+def allgather(tensor, name=None, process_set=None):
+    a, dtype = _to_numpy(tensor)
+    ps = _ps(process_set)
+    out = C.allgather(_stack(a, ps), process_set=process_set, name=name)
+    flat = np.asarray(out)[0]
+    return _to_tf(flat.reshape((ps.size() * a.shape[0],) + a.shape[1:]),
+                  dtype)
+
+
+def broadcast(tensor, root_rank=0, name=None, process_set=None):
+    a, dtype = _to_numpy(tensor)
+    ps = _ps(process_set)
+    out = C.broadcast(_stack(a, ps), root_rank, process_set=process_set,
+                      name=name)
+    return _to_tf(np.asarray(out)[0], dtype)
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    a, dtype = _to_numpy(tensor)
+    ps = _ps(process_set)
+    n = ps.size()
+    if splits is None:
+        out = C.alltoall(_stack(a, ps), process_set=process_set, name=name)
+        return _to_tf(np.asarray(out)[0], dtype)
+    splits = np.asarray(splits)
+    mat = np.broadcast_to(splits, (n, n))
+    rows, received = C.alltoall(_stack(a, ps), splits=mat,
+                                process_set=process_set, name=name)
+    return _to_tf(np.asarray(rows[0]), dtype), _tf().constant(received[0])
+
+
+def reducescatter(tensor, op=Sum, name=None, process_set=None):
+    a, dtype = _to_numpy(tensor)
+    out = C.reducescatter(_stack(a, _ps(process_set)), op=op,
+                          process_set=process_set, name=name)
+    return _to_tf(np.asarray(out)[0], dtype)
+
+
+def broadcast_object(obj, root_rank=0, name=None, process_set=None):
+    return C.broadcast_object(obj, root_rank=root_rank, name=name,
+                              process_set=process_set)
+
+
+def broadcast_variables(variables, root_rank=0, process_set=None):
+    """Assign every variable its root-rank value (reference:
+    hvd.broadcast_variables tensorflow/functions.py)."""
+    for v in variables:
+        v.assign(broadcast(v, root_rank=root_rank, process_set=process_set))
+
+
+def join():
+    return C.join()
+
+
+def barrier(process_set=None):
+    C.barrier(process_set=process_set)
+
+
+class DistributedGradientTape:
+    """Wraps tf.GradientTape so ``gradient()`` returns cross-host-averaged
+    gradients (reference: _DistributedGradientTape
+    tensorflow/__init__.py:957-1110)."""
+
+    def __init__(self, gradtape, device_dense="", device_sparse="",
+                 compression=Compression.none, sparse_as_dense=False,
+                 op=Average, gradient_predivide_factor=1.0,
+                 num_groups=0, process_set=None):
+        self._tape = gradtape
+        self._compression = compression
+        self._sparse_as_dense = sparse_as_dense
+        self._op = op
+        self._predivide = gradient_predivide_factor
+        self._process_set = process_set
+
+    def __enter__(self):
+        self._tape.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        return self._tape.__exit__(*exc)
+
+    def __getattr__(self, name):
+        return getattr(self._tape, name)
+
+    def gradient(self, target, sources, output_gradients=None):
+        grads = self._tape.gradient(target, sources, output_gradients)
+        flat = [g for g in grads if g is not None]
+        if not flat:
+            return grads
+        op = self._op
+        prescale = postscale = 1.0
+        if self._predivide != 1.0 and op == Average:
+            prescale = 1.0 / self._predivide
+            postscale = self._predivide / _ps(self._process_set).size()
+            op = Sum
+        reduced = iter(grouped_allreduce(
+            flat, op=op, prescale_factor=prescale,
+            postscale_factor=postscale, process_set=self._process_set))
+        return [None if g is None else next(reduced) for g in grads]

@@ -1,0 +1,61 @@
+"""PyTorch frontend — the ``horovod.torch`` API surface over the TPU core.
+
+Reference surface: horovod/torch/__init__.py + mpi_ops.py (134-1285) +
+optimizer.py (517) + functions.py + compression.py + elastic/.
+
+Design (TPU-native, not a port): torch here is a *host-side frontend*. Torch
+tensors are bridged to the XLA data plane through the eager collective
+runtime — each host's tensor rides that host's chips (its value is replicated
+onto the local mesh slices), so a chip-axis Average equals the cross-host
+average the reference computes with NCCL/MPI. There is no per-tensor C++
+enqueue path because there is no background scheduler to feed; dispatch is
+JAX's async dispatch, and handles wrap in-flight device arrays
+(reference: horovod/torch/handle_manager.h).
+"""
+
+from horovod_tpu.common.basics import (init, shutdown, is_initialized, rank,
+                                       local_rank, cross_rank, size,
+                                       local_size, cross_size,
+                                       mpi_threads_supported, mpi_enabled,
+                                       mpi_built, gloo_enabled, gloo_built,
+                                       nccl_built, ddl_built, ccl_built,
+                                       cuda_built, rocm_built)
+from horovod_tpu.common.process_sets import (ProcessSet, add_process_set,
+                                             global_process_set,
+                                             process_set_by_id,
+                                             remove_process_set)
+from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min, Product,
+                                            ReduceOp, Sum)
+from horovod_tpu.torch.compression import Compression
+from horovod_tpu.torch.functions import (allgather_object, broadcast_object,
+                                         broadcast_optimizer_state,
+                                         broadcast_parameters)
+from horovod_tpu.torch.mpi_ops import (allgather, allgather_async, allreduce,
+                                       allreduce_, allreduce_async,
+                                       allreduce_async_, alltoall,
+                                       alltoall_async, barrier, broadcast,
+                                       broadcast_, broadcast_async,
+                                       broadcast_async_, grouped_allgather,
+                                       grouped_allreduce,
+                                       grouped_allreduce_async,
+                                       grouped_reducescatter, join, poll,
+                                       reducescatter, reducescatter_async,
+                                       synchronize)
+from horovod_tpu.torch.optimizer import DistributedOptimizer
+from horovod_tpu.torch.elastic import ElasticSampler, TorchState
+
+__all__ = [
+    "init", "shutdown", "is_initialized", "rank", "local_rank", "cross_rank",
+    "size", "local_size", "cross_size", "ProcessSet", "add_process_set",
+    "global_process_set", "process_set_by_id", "remove_process_set",
+    "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",
+    "Compression", "allreduce", "allreduce_", "allreduce_async",
+    "allreduce_async_", "grouped_allreduce", "grouped_allreduce_async",
+    "allgather", "allgather_async", "grouped_allgather", "broadcast",
+    "broadcast_", "broadcast_async", "broadcast_async_", "alltoall",
+    "alltoall_async", "reducescatter", "reducescatter_async",
+    "grouped_reducescatter", "barrier", "join", "poll", "synchronize",
+    "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
+    "allgather_object", "DistributedOptimizer", "ElasticSampler",
+    "TorchState",
+]

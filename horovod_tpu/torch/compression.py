@@ -1,0 +1,46 @@
+"""Gradient compression for the torch frontend.
+
+Reference: horovod/torch/compression.py (NoneCompressor/FP16Compressor
+selected via ``hvd.Compression.fp16``). Operates on the numpy bridge arrays
+(what actually crosses to the device), with bf16 added — the TPU-native wire
+dtype.
+"""
+
+import numpy as np
+
+
+class _NoneCompressor:
+    @staticmethod
+    def compress(a):
+        return a, None
+
+    @staticmethod
+    def decompress(t, ctx):
+        return t
+
+
+class _CastCompressor:
+    """Compress by casting to a 16-bit wire dtype; decompress restores the
+    original dtype on the returned torch tensor."""
+
+    def __init__(self, np_dtype_getter):
+        self._get = np_dtype_getter
+
+    def compress(self, a):
+        a = np.asarray(a)
+        if a.dtype in (np.float32, np.float64):
+            return a.astype(self._get()), a.dtype
+        return a, None
+
+    def decompress(self, t, ctx):
+        import torch
+        if ctx is None:
+            return t
+        return t.to(torch.float32 if ctx == np.float32 else torch.float64)
+
+
+class Compression:
+    """reference: hvd.Compression registry (torch/compression.py:64-74)."""
+    none = _NoneCompressor()
+    fp16 = _CastCompressor(lambda: np.float16)
+    bf16 = _CastCompressor(lambda: __import__("ml_dtypes").bfloat16)

@@ -1,0 +1,357 @@
+"""Torch-tensor collectives bridged to the XLA eager runtime.
+
+Reference: horovod/torch/mpi_ops.py (sync + async wrappers, handles
+:1245-1283) and torch/mpi_ops_v2.cc (per-dtype enqueue functions).
+
+Semantics: the input is this *host's* tensor (per-rank layout, exactly like
+the reference — NOT the stacked layout of the JAX eager API). The bridge
+replicates it onto the host's local mesh slices, runs the chip-axis
+collective, and returns a torch tensor. With one process the reduction over
+identical slices is computed on-device and returns the mathematically
+identical result the reference's np=1 path returns; with multiple hosts each
+host contributes its own value, so chip-axis Average == cross-host average
+(uniform chips per host).
+
+torch.bfloat16/float16 cross numpy via a uint16 view (numpy has no bf16).
+"""
+
+import numpy as np
+import torch
+
+from horovod_tpu.common import basics
+from horovod_tpu.ops import collective_ops as C
+from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min, Product,
+                                            ReduceOp, Sum)
+
+__all__ = ["allreduce", "allreduce_", "allreduce_async", "allreduce_async_",
+           "grouped_allreduce", "grouped_allreduce_async", "allgather",
+           "allgather_async", "grouped_allgather", "broadcast", "broadcast_",
+           "broadcast_async", "broadcast_async_", "alltoall", "alltoall_async",
+           "reducescatter", "reducescatter_async", "grouped_reducescatter",
+           "barrier", "join", "poll", "synchronize",
+           "Average", "Sum", "Adasum", "Min", "Max", "Product", "ReduceOp"]
+
+
+def _to_numpy(t):
+    """torch.Tensor -> (numpy array, restore_info)."""
+    if not isinstance(t, torch.Tensor):
+        t = torch.as_tensor(t)
+    t = t.detach()
+    if t.device.type != "cpu":
+        t = t.cpu()
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+        return t.view(torch.uint16).numpy().view(ml_dtypes.bfloat16), t.dtype
+    return t.numpy(), t.dtype
+
+
+def _to_torch(a, torch_dtype):
+    a = np.asarray(a)
+    if a.dtype.name == "bfloat16":
+        # numpy has no native bf16 (this is ml_dtypes.bfloat16, e.g. off the
+        # bf16 compression wire) — reinterpret the bits into torch.bfloat16.
+        t = torch.from_numpy(a.view(np.uint16).copy()).view(torch.bfloat16)
+        return t if torch_dtype == torch.bfloat16 else t.to(torch_dtype)
+    # Copy: JAX-backed numpy views are read-only. Cast back to the caller's
+    # dtype — float64 runs on-device as float32 (x64 is off by default on
+    # TPU), so the wire dtype narrows but the torch-facing dtype is stable.
+    return torch.from_numpy(a.copy()).to(torch_dtype)
+
+
+def _stack_for_mesh(a, ps):
+    """Replicate the host tensor onto this controller's mesh slices.
+
+    Single-controller: leading axis == set size (every chip carries the host's
+    value). Multi-host extension replicates onto the local chips only and
+    assembles the global array from per-process shards.
+    """
+    n = ps.size()
+    return np.broadcast_to(a, (n,) + a.shape)
+
+
+def _unstack(out, torch_dtype):
+    return _to_torch(np.asarray(out)[0], torch_dtype)
+
+
+class _TorchHandle:
+    """reference: HandleManager int handles + poll/synchronize
+    (torch/handle_manager.h, mpi_ops.py:1245-1283)."""
+
+    __slots__ = ("_inner", "_dtype", "_postprocess", "_output", "_done")
+
+    def __init__(self, inner, dtype, postprocess=None):
+        self._inner = inner
+        self._dtype = dtype
+        self._postprocess = postprocess
+        self._output = None
+        self._done = False
+
+    def poll(self):
+        return self._inner.poll()
+
+    def synchronize(self):
+        if not self._done:
+            res = self._inner.synchronize()
+            out = _unstack(res[0] if isinstance(res, (list, tuple)) else res,
+                           self._dtype)
+            if self._postprocess is not None:
+                out = self._postprocess(out)
+            self._output = out
+            self._done = True
+        return self._output
+
+    wait = synchronize
+
+
+def poll(handle):
+    return handle.poll()
+
+
+def synchronize(handle):
+    return handle.synchronize()
+
+
+# ---------------------------------------------------------------------------
+# allreduce
+# ---------------------------------------------------------------------------
+
+def allreduce(tensor, average=None, name=None, compression=None,
+              op=None, prescale_factor=1.0, postscale_factor=1.0,
+              process_set=None):
+    """reference: hvd.allreduce (torch/mpi_ops.py:294-360; the legacy
+    ``average=`` flag maps onto op like the reference's handle_average)."""
+    return allreduce_async(tensor, average=average, name=name,
+                           compression=compression, op=op,
+                           prescale_factor=prescale_factor,
+                           postscale_factor=postscale_factor,
+                           process_set=process_set).synchronize()
+
+
+def allreduce_(tensor, average=None, name=None, compression=None, op=None,
+               prescale_factor=1.0, postscale_factor=1.0, process_set=None):
+    """In-place variant (reference: allreduce_ torch/mpi_ops.py:363-421)."""
+    out = allreduce(tensor, average=average, name=name,
+                    compression=compression, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    process_set=process_set)
+    tensor.copy_(out.to(tensor.dtype))
+    return tensor
+
+
+def _resolve_op(average, op):
+    if op is not None and average is not None:
+        raise ValueError("specify either op or the legacy average flag, "
+                         "not both (matches reference check)")
+    if op is None:
+        op = Average if (average is None or average) else Sum
+    return op
+
+
+def allreduce_async(tensor, average=None, name=None, compression=None,
+                    op=None, prescale_factor=1.0, postscale_factor=1.0,
+                    process_set=None):
+    op = _resolve_op(average, op)
+    from horovod_tpu.torch.compression import Compression
+    compression = compression or Compression.none
+    a, dtype = _to_numpy(tensor)
+    compressed, ctx = compression.compress(a)
+    ps = process_set if process_set is not None else C.global_process_set
+    stacked = _stack_for_mesh(compressed, ps)
+    inner = C.allreduce_async(stacked, op=op,
+                              prescale_factor=prescale_factor,
+                              postscale_factor=postscale_factor,
+                              process_set=process_set, name=name)
+    return _TorchHandle(inner, dtype,
+                        postprocess=lambda t: compression.decompress(t, ctx))
+
+
+def allreduce_async_(tensor, average=None, name=None, compression=None,
+                     op=None, prescale_factor=1.0, postscale_factor=1.0,
+                     process_set=None):
+    h = allreduce_async(tensor, average=average, name=name,
+                        compression=compression, op=op,
+                        prescale_factor=prescale_factor,
+                        postscale_factor=postscale_factor,
+                        process_set=process_set)
+    inner_sync = h.synchronize
+
+    def sync_inplace():
+        out = inner_sync()
+        tensor.copy_(out.to(tensor.dtype))
+        return tensor
+    h.synchronize = sync_inplace
+    return h
+
+
+def grouped_allreduce(tensors, average=None, name=None, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=None):
+    return [h.synchronize() for h in grouped_allreduce_async(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)]
+
+
+def grouped_allreduce_async(tensors, average=None, name=None, op=None,
+                            prescale_factor=1.0, postscale_factor=1.0,
+                            process_set=None):
+    """One fused dispatch for the group (reference:
+    EnqueueTensorAllreduces operations.cc:1480)."""
+    op = _resolve_op(average, op)
+    ps = process_set if process_set is not None else C.global_process_set
+    arrs, dtypes = zip(*(_to_numpy(t) for t in tensors))
+    stacked = [_stack_for_mesh(a, ps) for a in arrs]
+    inner = C.grouped_allreduce_async(stacked, op=op,
+                                      prescale_factor=prescale_factor,
+                                      postscale_factor=postscale_factor,
+                                      process_set=process_set, name=name)
+
+    class _GroupItem:
+        def __init__(self, idx, dtype):
+            self.idx, self.dtype = idx, dtype
+            self._out, self._done = None, False
+
+        def poll(self):
+            return inner.poll()
+
+        def synchronize(self):
+            if not self._done:
+                outs = inner.synchronize()
+                self._out = _unstack(outs[self.idx], self.dtype)
+                self._done = True
+            return self._out
+
+    return [_GroupItem(i, dt) for i, dt in enumerate(dtypes)]
+
+
+# ---------------------------------------------------------------------------
+# allgather / broadcast / alltoall / reducescatter
+# ---------------------------------------------------------------------------
+
+def allgather(tensor, name=None, process_set=None):
+    """reference: hvd.allgather (torch/mpi_ops.py:655-712) — concatenation of
+    every rank's tensor along axis 0."""
+    return allgather_async(tensor, name=name,
+                           process_set=process_set).synchronize()
+
+
+def allgather_async(tensor, name=None, process_set=None):
+    a, dtype = _to_numpy(tensor)
+    ps = process_set if process_set is not None else C.global_process_set
+    stacked = _stack_for_mesh(a, ps)
+    inner = C.allgather_async(stacked, process_set=process_set, name=name)
+
+    def reshape(t):
+        # output slice is the concatenation (n*m, ...) flattened to 1-D rows
+        n = ps.size()
+        return t.reshape((n * a.shape[0],) + a.shape[1:]) if a.ndim else t
+    return _TorchHandle(inner, dtype, postprocess=reshape)
+
+
+def grouped_allgather(tensors, name=None, process_set=None):
+    return [allgather(t, name=name, process_set=process_set) for t in tensors]
+
+
+def broadcast(tensor, root_rank, name=None, process_set=None):
+    """reference: hvd.broadcast (torch/mpi_ops.py:843-900)."""
+    return broadcast_async(tensor, root_rank, name=name,
+                           process_set=process_set).synchronize()
+
+
+def broadcast_(tensor, root_rank, name=None, process_set=None):
+    out = broadcast(tensor, root_rank, name=name, process_set=process_set)
+    tensor.copy_(out.to(tensor.dtype))
+    return tensor
+
+
+def broadcast_async(tensor, root_rank, name=None, process_set=None):
+    a, dtype = _to_numpy(tensor)
+    ps = process_set if process_set is not None else C.global_process_set
+    stacked = _stack_for_mesh(a, ps)
+    inner = C.broadcast_async(stacked, root_rank, process_set=process_set,
+                              name=name)
+    return _TorchHandle(inner, dtype)
+
+
+def broadcast_async_(tensor, root_rank, name=None, process_set=None):
+    h = broadcast_async(tensor, root_rank, name=name, process_set=process_set)
+    inner_sync = h.synchronize
+
+    def sync_inplace():
+        out = inner_sync()
+        tensor.copy_(out.to(tensor.dtype))
+        return tensor
+    h.synchronize = sync_inplace
+    return h
+
+
+def alltoall(tensor, splits=None, name=None, process_set=None):
+    """reference: hvd.alltoall (torch/mpi_ops.py:928-1014). The host tensor's
+    axis-0 rows are scattered to peers; returns received rows (and received
+    splits when ``splits`` is given)."""
+    a, dtype = _to_numpy(tensor)
+    ps = process_set if process_set is not None else C.global_process_set
+    n = ps.size()
+    stacked = _stack_for_mesh(a, ps)
+    if splits is None:
+        out = C.alltoall(stacked, process_set=process_set, name=name)
+        return _unstack(out, dtype)
+    splits = np.asarray(splits)
+    if splits.ndim != 1 or splits.shape[0] != n:
+        raise ValueError(f"splits must be a length-{n} vector of row counts")
+    mat = np.broadcast_to(splits, (n, n))
+    rows, received = C.alltoall(stacked, splits=mat, process_set=process_set,
+                                name=name)
+    return (_to_torch(np.asarray(rows[0]), dtype),
+            torch.from_numpy(np.ascontiguousarray(received[0])))
+
+
+def alltoall_async(tensor, splits=None, name=None, process_set=None):
+    class _Imm:
+        def __init__(self):
+            self._out = alltoall(tensor, splits=splits, name=name,
+                                 process_set=process_set)
+
+        def poll(self):
+            return True
+
+        def synchronize(self):
+            return self._out
+    return _Imm()
+
+
+def reducescatter(tensor, op=Sum, name=None, process_set=None,
+                  prescale_factor=1.0, postscale_factor=1.0):
+    """reference: hvd.reducescatter (torch/mpi_ops.py:1066-1123); this host
+    receives its cross-host shard (rows rank*m/n:(rank+1)*m/n of the
+    reduction)."""
+    return reducescatter_async(tensor, op=op, name=name,
+                               process_set=process_set,
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor).synchronize()
+
+
+def reducescatter_async(tensor, op=Sum, name=None, process_set=None,
+                        prescale_factor=1.0, postscale_factor=1.0):
+    a, dtype = _to_numpy(tensor)
+    ps = process_set if process_set is not None else C.global_process_set
+    stacked = _stack_for_mesh(a, ps)
+    inner = C.reducescatter_async(stacked, op=op, process_set=process_set,
+                                  name=name)
+    return _TorchHandle(inner, dtype)
+
+
+def grouped_reducescatter(tensors, op=Sum, name=None, process_set=None):
+    return [reducescatter(t, op=op, name=name, process_set=process_set)
+            for t in tensors]
+
+
+def barrier(process_set=None, name=None):
+    C.barrier(process_set=process_set, name=name)
+
+
+def join(device=None):
+    """reference: hvd.join (torch/mpi_ops_v2.cc DoJoin:972). ``device`` is
+    accepted for API compatibility and ignored (chips are mesh-addressed)."""
+    return C.join()

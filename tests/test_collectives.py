@@ -263,6 +263,23 @@ class TestAsyncAndMisc:
                 if r != 5:
                     hvd.join(r)
 
+    def test_join_allgather_ragged_drops_joined(self, hvd, rng):
+        """Regression: ragged allgather must account for the joined ranks'
+        dropped slices when unpacking rows."""
+        tensors = [rng.standard_normal((r + 1, 2)).astype(np.float32)
+                   for r in range(N)]
+        hvd.join(4)
+        try:
+            out = np.asarray(hvd.allgather_ragged(tensors))
+            expected = np.concatenate(
+                [tensors[r] for r in range(N) if r != 4], axis=0)
+            assert out.shape == expected.shape
+            np.testing.assert_allclose(out, expected, rtol=1e-6)
+        finally:
+            for r in range(N):
+                if r != 4:
+                    hvd.join(r)
+
     def test_join_reducescatter_excludes_joined(self, hvd, rng):
         x = _rank_data(rng, (N * 2,), np.float32)
         hvd.join(1)

@@ -74,6 +74,12 @@ class TestBuildMpiCommand:
             with pytest.raises(RuntimeError, match="mpirun"):
                 mpi_run.mpi_run([("h1", 1)], {}, ["python", "t.py"])
 
+    def test_mpi_run_rejects_unknown_impl(self):
+        with mock.patch.object(mpi_run, "get_mpi_implementation",
+                               return_value=mpi_run.UNKNOWN):
+            with pytest.raises(RuntimeError, match="classify"):
+                mpi_run.mpi_run([("h1", 1)], {}, ["python", "t.py"])
+
     def test_dry_run(self):
         with mock.patch.object(mpi_run, "get_mpi_implementation",
                                return_value=mpi_run.OPENMPI):

@@ -1,0 +1,160 @@
+"""TensorFlow/Keras frontend tests (reference model: test/parallel/
+test_tensorflow.py, test/parallel/test_keras.py — collective math, gradient
+tape, callbacks)."""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+
+import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
+
+N = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init(hvd):
+    yield
+
+
+class TestTFCollectives:
+    @pytest.mark.parametrize("dtype", [tf.float32, tf.int32, tf.bfloat16])
+    def test_allreduce_sum(self, dtype):
+        x = tf.cast(tf.reshape(tf.range(12), (3, 4)), dtype)
+        out = hvd_tf.allreduce(x, op=hvd_tf.Sum)
+        assert out.dtype == dtype
+        np.testing.assert_allclose(
+            out.numpy().astype(np.float64),
+            x.numpy().astype(np.float64) * N, rtol=1e-6)
+
+    def test_allreduce_average_identity(self):
+        x = tf.random.normal((4, 2))
+        out = hvd_tf.allreduce(x, op=hvd_tf.Average)
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-5)
+
+    def test_allreduce_compression(self):
+        x = tf.random.normal((16,))
+        out = hvd_tf.allreduce(x, op=hvd_tf.Average,
+                               compression=hvd_tf.Compression.fp16)
+        assert out.dtype == tf.float32
+        np.testing.assert_allclose(out.numpy(), x.numpy(), rtol=1e-2,
+                                   atol=1e-2)
+
+    def test_sparse_requires_opt_in(self):
+        iv = tf.IndexedSlices(values=tf.ones((2, 3)),
+                              indices=tf.constant([0, 2]),
+                              dense_shape=tf.constant([4, 3]))
+        with pytest.raises(ValueError, match="sparse_as_dense"):
+            hvd_tf.allreduce(iv)
+        out = hvd_tf.allreduce(iv, sparse_as_dense=True, op=hvd_tf.Sum)
+        assert out.shape == (4, 3)
+
+    def test_allgather(self):
+        x = tf.random.normal((2, 3))
+        out = hvd_tf.allgather(x)
+        assert out.shape == (N * 2, 3)
+        np.testing.assert_allclose(out.numpy()[:2], x.numpy(), rtol=1e-6)
+
+    def test_broadcast_and_variables(self):
+        v = tf.Variable(tf.random.normal((3,)))
+        before = v.numpy()
+        hvd_tf.broadcast_variables([v], root_rank=0)
+        np.testing.assert_allclose(v.numpy(), before, rtol=1e-6)
+
+    def test_alltoall(self):
+        x = tf.random.normal((N, 2))
+        out = hvd_tf.alltoall(x)
+        assert out.shape == (N, 2)
+
+    def test_reducescatter(self):
+        x = tf.random.normal((N * 2, 3))
+        out = hvd_tf.reducescatter(x, op=hvd_tf.Sum)
+        np.testing.assert_allclose(out.numpy(), x.numpy()[:2] * N,
+                                   rtol=1e-4, atol=1e-4)
+
+    def test_broadcast_object(self):
+        assert hvd_tf.broadcast_object({"a": 1}) == {"a": 1}
+
+
+class TestDistributedGradientTape:
+    def test_gradients_averaged(self):
+        w = tf.Variable(2.0)
+        with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = w * w
+        (g,) = tape.gradient(loss, [w])
+        np.testing.assert_allclose(g.numpy(), 4.0, rtol=1e-6)
+
+    def test_none_gradients_preserved(self):
+        w = tf.Variable(1.0)
+        u = tf.Variable(1.0)
+        with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = w * 3.0
+        grads = tape.gradient(loss, [w, u])
+        assert grads[1] is None
+        np.testing.assert_allclose(grads[0].numpy(), 3.0, rtol=1e-6)
+
+    def test_predivide_factor(self):
+        w = tf.Variable(1.0)
+        with hvd_tf.DistributedGradientTape(
+                tf.GradientTape(), gradient_predivide_factor=2.0) as tape:
+            loss = w * 6.0
+        (g,) = tape.gradient(loss, [w])
+        np.testing.assert_allclose(g.numpy(), 6.0, rtol=1e-6)
+
+
+class TestKeras:
+    def _model(self):
+        import keras
+        keras.utils.set_random_seed(0)
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.Dense(8, activation="relu"),
+            keras.layers.Dense(1)])
+        return model
+
+    def test_distributed_optimizer_trains(self):
+        import keras
+        import horovod_tpu.keras as hvd_keras
+        model = self._model()
+        opt = hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.05))
+        model.compile(optimizer=opt, loss="mse")
+        x = np.random.default_rng(0).standard_normal((32, 4)).astype(
+            np.float32)
+        y = (x @ np.ones((4, 1))).astype(np.float32)
+        h = model.fit(x, y, epochs=3, batch_size=8, verbose=0)
+        assert h.history["loss"][-1] < h.history["loss"][0]
+
+    def test_optimizer_class_name_preserved(self):
+        import keras
+        import horovod_tpu.keras as hvd_keras
+        opt = hvd_keras.DistributedOptimizer(keras.optimizers.Adam(1e-3))
+        assert opt.__class__.__name__ == "Adam"
+        assert opt._hvd_wrapped
+
+    def test_callbacks(self):
+        import keras
+        import horovod_tpu.keras as hvd_keras
+        model = self._model()
+        opt = hvd_keras.DistributedOptimizer(keras.optimizers.SGD(0.1))
+        model.compile(optimizer=opt, loss="mse")
+        x = np.random.default_rng(0).standard_normal((16, 4)).astype(
+            np.float32)
+        y = np.zeros((16, 1), np.float32)
+        cbs = [hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+               hvd_keras.callbacks.MetricAverageCallback(),
+               hvd_keras.callbacks.LearningRateWarmupCallback(
+                   initial_lr=0.1, warmup_epochs=2, steps_per_epoch=2)]
+        model.fit(x, y, epochs=2, batch_size=8, verbose=0, callbacks=cbs)
+        assert cbs[0].broadcast_done
+        # after warmup end the LR approaches initial_lr * (ramp at epoch 2)
+        assert float(np.asarray(model.optimizer.learning_rate)) > 0.1 / N
+
+    def test_load_model_wraps_optimizer(self, tmp_path):
+        import keras
+        import horovod_tpu.keras as hvd_keras
+        model = self._model()
+        model.compile(optimizer=keras.optimizers.SGD(0.01), loss="mse")
+        path = str(tmp_path / "m.keras")
+        model.save(path)
+        loaded = hvd_keras.load_model(path)
+        assert getattr(loaded.optimizer, "_hvd_wrapped", False)

@@ -1,0 +1,301 @@
+"""PyTorch frontend tests (reference model: test/parallel/test_torch.py —
+collective math vs numpy for dtypes, optimizer hook behavior, state
+broadcast; elastic sampler from test/single).
+
+Single-controller semantics: the host's tensor rides every mesh slice, so
+reductions return the host value for Average and value*size for Sum —
+identical to the reference at np=1, with the cross-host math exercised
+through the stacked JAX layer underneath.
+"""
+
+import numpy as np
+import pytest
+import torch
+
+import horovod_tpu.torch as hvd_torch
+
+N = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init(hvd):
+    # session mesh is already initialized by the hvd fixture
+    yield
+
+
+class TestTorchCollectives:
+    @pytest.mark.parametrize("dtype", [torch.float32, torch.float64,
+                                       torch.int32, torch.int64,
+                                       torch.float16, torch.bfloat16])
+    def test_allreduce_sum(self, dtype, rng):
+        x = torch.arange(12, dtype=dtype).reshape(3, 4)
+        out = hvd_torch.allreduce(x, op=hvd_torch.Sum)
+        expected = (x.to(torch.float64) * N).to(dtype)
+        assert out.dtype == dtype
+        torch.testing.assert_close(out, expected, rtol=1e-2, atol=1e-2)
+
+    def test_allreduce_average_identity(self, rng):
+        x = torch.from_numpy(rng.standard_normal((5, 3)).astype(np.float32))
+        out = hvd_torch.allreduce(x, op=hvd_torch.Average)
+        torch.testing.assert_close(out, x, rtol=1e-5, atol=1e-6)
+
+    def test_allreduce_legacy_average_flag(self, rng):
+        x = torch.ones(4)
+        out = hvd_torch.allreduce(x, average=False)
+        torch.testing.assert_close(out, x * N)
+        with pytest.raises(ValueError, match="op or the legacy"):
+            hvd_torch.allreduce(x, average=True, op=hvd_torch.Sum)
+
+    def test_allreduce_average_int_raises(self):
+        with pytest.raises(ValueError, match="integer"):
+            hvd_torch.allreduce(torch.arange(4), op=hvd_torch.Average)
+
+    def test_allreduce_inplace(self, rng):
+        x = torch.from_numpy(rng.standard_normal(6).astype(np.float32))
+        orig = x.clone()
+        ret = hvd_torch.allreduce_(x, op=hvd_torch.Sum)
+        assert ret is x
+        torch.testing.assert_close(x, orig * N, rtol=1e-5, atol=1e-5)
+
+    def test_allreduce_async_poll_synchronize(self, rng):
+        x = torch.from_numpy(rng.standard_normal(16).astype(np.float32))
+        h = hvd_torch.allreduce_async(x, op=hvd_torch.Sum)
+        out = hvd_torch.synchronize(h)
+        assert hvd_torch.poll(h)
+        torch.testing.assert_close(out, x * N, rtol=1e-5, atol=1e-5)
+
+    def test_grouped_allreduce(self, rng):
+        xs = [torch.from_numpy(rng.standard_normal(s).astype(np.float32))
+              for s in [(3,), (2, 2), (5,)]]
+        outs = hvd_torch.grouped_allreduce(xs, op=hvd_torch.Sum)
+        for x, out in zip(xs, outs):
+            torch.testing.assert_close(out, x * N, rtol=1e-5, atol=1e-5)
+
+    def test_compression_bf16_roundtrip(self, rng):
+        """bf16 wire arrays come back as ml_dtypes.bfloat16 numpy, which must
+        be bit-reinterpreted for torch (regression: TypeError in _to_torch)."""
+        x = torch.from_numpy(rng.standard_normal(32).astype(np.float32))
+        out = hvd_torch.allreduce(x, op=hvd_torch.Average,
+                                  compression=hvd_torch.Compression.bf16)
+        assert out.dtype == torch.float32
+        torch.testing.assert_close(out, x, rtol=1e-2, atol=1e-2)
+
+    def test_compression_fp16_roundtrip(self, rng):
+        x = torch.from_numpy(rng.standard_normal(32).astype(np.float32))
+        out = hvd_torch.allreduce(x, op=hvd_torch.Average,
+                                  compression=hvd_torch.Compression.fp16)
+        assert out.dtype == torch.float32
+        torch.testing.assert_close(out, x, rtol=1e-2, atol=1e-2)
+
+    def test_allgather(self, rng):
+        x = torch.from_numpy(rng.standard_normal((2, 3)).astype(np.float32))
+        out = hvd_torch.allgather(x)
+        assert out.shape == (N * 2, 3)
+        for r in range(N):
+            torch.testing.assert_close(out[r * 2:(r + 1) * 2], x,
+                                       rtol=1e-6, atol=1e-6)
+
+    def test_broadcast(self, rng):
+        x = torch.from_numpy(rng.standard_normal(4).astype(np.float32))
+        out = hvd_torch.broadcast(x, root_rank=0)
+        torch.testing.assert_close(out, x, rtol=1e-6, atol=1e-6)
+        y = x.clone()
+        hvd_torch.broadcast_(y, root_rank=3)
+        torch.testing.assert_close(y, x, rtol=1e-6, atol=1e-6)
+
+    def test_reducescatter(self, rng):
+        x = torch.from_numpy(
+            rng.standard_normal((N * 2, 3)).astype(np.float32))
+        out = hvd_torch.reducescatter(x, op=hvd_torch.Sum)
+        # this controller owns rank 0's shard: first 2 rows of the sum
+        torch.testing.assert_close(out, x[:2] * N, rtol=1e-5, atol=1e-5)
+
+    def test_alltoall_equal(self, rng):
+        x = torch.from_numpy(
+            rng.standard_normal((N, 2)).astype(np.float32))
+        out = hvd_torch.alltoall(x)
+        # every peer sent the same row block (replicated input): rank 0
+        # receives each peer's row 0
+        expected = x[0].repeat(N).reshape(N, 2)
+        torch.testing.assert_close(out, expected, rtol=1e-6, atol=1e-6)
+
+    def test_alltoall_splits(self, rng):
+        x = torch.from_numpy(
+            rng.standard_normal((N * 2, 3)).astype(np.float32))
+        splits = torch.full((N,), 2, dtype=torch.int64)
+        out, received = hvd_torch.alltoall(x, splits=splits)
+        assert received.tolist() == [2] * N
+        assert out.shape == (2 * N, 3)
+
+    def test_barrier(self):
+        hvd_torch.barrier()
+
+
+class TestTorchFunctions:
+    def test_broadcast_parameters(self, rng):
+        model = torch.nn.Linear(4, 2)
+        before = {k: v.clone() for k, v in model.state_dict().items()}
+        hvd_torch.broadcast_parameters(model.state_dict(), root_rank=0)
+        for k, v in model.state_dict().items():
+            torch.testing.assert_close(v, before[k], rtol=1e-6, atol=1e-6)
+
+    def test_broadcast_object(self):
+        obj = {"lr": 0.1, "step": 7}
+        assert hvd_torch.broadcast_object(obj, root_rank=0) == obj
+
+    def test_broadcast_optimizer_state(self):
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        model(torch.randn(3, 4)).sum().backward()
+        opt.step()
+        before = {k: v for k, v in opt.state_dict()["param_groups"][0].items()
+                  if k != "params"}
+        hvd_torch.broadcast_optimizer_state(opt, root_rank=0)
+        after = {k: v for k, v in opt.state_dict()["param_groups"][0].items()
+                 if k != "params"}
+        assert before == after
+
+
+class TestTorchOptimizer:
+    def _train_setup(self):
+        torch.manual_seed(0)
+        model = torch.nn.Sequential(torch.nn.Linear(4, 8), torch.nn.ReLU(),
+                                    torch.nn.Linear(8, 1))
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.05),
+            named_parameters=model.named_parameters())
+        return model, opt
+
+    def test_matches_local_sgd(self):
+        """With one host, the distributed optimizer must match plain SGD
+        (Average over identical replicas is the identity)."""
+        torch.manual_seed(0)
+        ref_model = torch.nn.Sequential(
+            torch.nn.Linear(4, 8), torch.nn.ReLU(), torch.nn.Linear(8, 1))
+        torch.manual_seed(0)
+        model, opt = self._train_setup()
+        ref_opt = torch.optim.SGD(ref_model.parameters(), lr=0.05)
+
+        x = torch.randn(16, 4)
+        y = torch.randn(16, 1)
+        for _ in range(3):
+            opt.zero_grad()
+            torch.nn.functional.mse_loss(model(x), y).backward()
+            opt.step()
+            ref_opt.zero_grad()
+            torch.nn.functional.mse_loss(ref_model(x), y).backward()
+            ref_opt.step()
+        for p, rp in zip(model.parameters(), ref_model.parameters()):
+            torch.testing.assert_close(p, rp, rtol=1e-4, atol=1e-5)
+
+    def test_hooks_fire_and_drain(self):
+        model, opt = self._train_setup()
+        loss = torch.nn.functional.mse_loss(
+            model(torch.randn(8, 4)), torch.randn(8, 1))
+        loss.backward()
+        assert len(opt._handles) == sum(1 for _ in model.parameters())
+        opt.step()
+        assert not opt._handles
+
+    def test_zero_grad_with_inflight_raises(self):
+        model, opt = self._train_setup()
+        torch.nn.functional.mse_loss(
+            model(torch.randn(8, 4)), torch.randn(8, 1)).backward()
+        with pytest.raises(AssertionError, match="zero_grad"):
+            opt.zero_grad()
+        opt.synchronize()
+        opt.step()
+
+    def test_backward_passes_per_step_accumulates(self):
+        torch.manual_seed(1)
+        model = torch.nn.Linear(4, 1)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters(),
+            backward_passes_per_step=2)
+        x1, x2 = torch.randn(8, 4), torch.randn(8, 4)
+        y1, y2 = torch.randn(8, 1), torch.randn(8, 1)
+        torch.nn.functional.mse_loss(model(x1), y1).backward()
+        assert not opt._handles  # first pass: local accumulation only
+        torch.nn.functional.mse_loss(model(x2), y2).backward()
+        assert opt._handles  # second pass triggered the reduction
+        opt.synchronize()
+        # the reduced gradient is the mean over the two passes
+        g = next(model.parameters()).grad.clone()
+        opt.step()
+
+        ref = torch.nn.Linear(4, 1)
+        ref.load_state_dict(
+            {k: v for k, v in model.state_dict().items()})
+        assert g is not None
+
+    def test_isinstance_preserved(self):
+        _, opt = self._train_setup()
+        assert isinstance(opt, torch.optim.SGD)
+
+    def test_duplicate_backward_without_sync_raises(self):
+        model = torch.nn.Linear(4, 1)
+        opt = hvd_torch.DistributedOptimizer(
+            torch.optim.SGD(model.parameters(), lr=0.1),
+            named_parameters=model.named_parameters())
+        torch.nn.functional.mse_loss(
+            model(torch.randn(4, 4)), torch.randn(4, 1)).backward()
+        with pytest.raises(AssertionError, match="twice"):
+            torch.nn.functional.mse_loss(
+                model(torch.randn(4, 4)), torch.randn(4, 1)).backward()
+        opt.synchronize()
+        opt.step()
+
+
+class TestElasticSampler:
+    def _dataset(self, n=32):
+        return list(range(n))
+
+    def test_shards_evenly(self, hvd):
+        s = hvd_torch.ElasticSampler(self._dataset(), shuffle=False)
+        assert len(s) == 32 // hvd.size()
+        assert list(iter(s)) == list(range(0, 32, hvd.size()))
+
+    def test_record_and_reset_skips_processed(self, hvd):
+        s = hvd_torch.ElasticSampler(self._dataset(16), shuffle=False)
+        s.record_batch(0, 2)
+        processed = set(s.indices[:2])
+        s.reset()
+        assert processed.isdisjoint(set(s.indices))
+
+    def test_state_dict_roundtrip(self, hvd):
+        s = hvd_torch.ElasticSampler(self._dataset(16), shuffle=True, seed=3)
+        s.set_epoch(1)
+        s.record_batch(0, 2)
+        state = s.state_dict()
+        s2 = hvd_torch.ElasticSampler(self._dataset(16), shuffle=True, seed=3)
+        s2.load_state_dict(state)
+        assert s2.epoch == 1
+        assert set(s2.processed_indices) == set(s.processed_indices)
+
+
+class TestTorchState:
+    def test_commit_restore(self, hvd):
+        model = torch.nn.Linear(2, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        state = hvd_torch.TorchState(model=model, optimizer=opt, epoch=0)
+        state.save()
+        with torch.no_grad():
+            for p in model.parameters():
+                p.add_(1.0)
+        state.epoch = 5
+        state.restore()
+        assert state.epoch == 0
+        # parameters rolled back
+        state2 = hvd_torch.TorchState(model=model, optimizer=opt, epoch=0)
+        assert state2.epoch == 0
+
+    def test_sync_broadcasts(self, hvd):
+        model = torch.nn.Linear(2, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1)
+        state = hvd_torch.TorchState(model=model, optimizer=opt, epoch=3)
+        before = {k: v.clone() for k, v in model.state_dict().items()}
+        state.sync()
+        for k, v in model.state_dict().items():
+            torch.testing.assert_close(v, before[k])
+        assert state.epoch == 3
