@@ -1,0 +1,200 @@
+"""Side-car data-processing cluster: CPU workers preprocess batches off the
+training hosts' critical path.
+
+Reference: horovod/tensorflow/data/compute_service.py (TfDataServiceConfig,
+``send_to_data_service``; dispatcher/worker side-car run under horovodrun via
+compute_worker.py) and runner/common/service/compute_service.py (dispatcher
+registration RPC).
+
+TPU-native shape: TPU hosts burn their cores feeding chips, so preprocessing
+moves to separate CPU worker processes. A :class:`DataDispatcher` (on the
+training driver) hands shard assignments to registered
+:class:`DataWorker` s; each worker runs the user's ``dataset_fn(shard,
+num_shards)`` generator and streams pickled batches over TCP to the training
+host, which consumes them through :class:`ComputeServiceDataLoader` — the
+AsyncDataLoader-compatible client. tf.data's dispatcher protocol is replaced
+by the HTTP-KV store already used for rendezvous.
+"""
+
+import dataclasses
+import json
+import pickle
+import queue
+import socket
+import struct
+import threading
+
+from horovod_tpu.runner.http_kv import KVStoreClient, KVStoreServer
+
+_SCOPE = "compute_service"
+
+
+@dataclasses.dataclass(frozen=True)
+class ComputeServiceConfig:
+    """Serializable service endpoint config (reference:
+    TfDataServiceConfig compute_service.py:34-88, incl. write/read via an
+    atomically-renamed JSON file for side-car startup ordering)."""
+
+    kv_addr: str
+    kv_port: int
+    num_workers: int
+    timeout: int = 60
+
+    def to_dict(self):
+        return dataclasses.asdict(self)
+
+    @staticmethod
+    def from_dict(d):
+        return ComputeServiceConfig(**d)
+
+    def write(self, filename):
+        import os
+        import tempfile
+        d = os.path.dirname(filename) or "."
+        with tempfile.NamedTemporaryFile("w", dir=d, delete=False) as w:
+            w.write(json.dumps(self.to_dict()))
+        os.rename(w.name, filename)
+
+    @staticmethod
+    def read(filename, wait_for_file_creation=False):
+        import os
+        import time
+        deadline = time.time() + 120
+        while wait_for_file_creation and not os.path.exists(filename):
+            if time.time() > deadline:
+                raise TimeoutError(f"config file {filename} never appeared")
+            time.sleep(0.2)
+        with open(filename) as r:
+            return ComputeServiceConfig.from_dict(json.loads(r.read()))
+
+
+class DataDispatcher:
+    """Driver-side registry: workers announce their batch-stream endpoints;
+    training clients look them up by shard."""
+
+    def __init__(self, num_workers):
+        self.num_workers = num_workers
+        self._kv = KVStoreServer()
+        self._port = self._kv.start()
+
+    @property
+    def config(self):
+        return ComputeServiceConfig(kv_addr="localhost",
+                                    kv_port=self._port,
+                                    num_workers=self.num_workers)
+
+    def stop(self):
+        self._kv.stop()
+
+
+class DataWorker:
+    """One preprocessing worker: serves ``dataset_fn(shard, num_shards)``
+    batches over TCP (reference: compute_worker.py main loop)."""
+
+    def __init__(self, config, shard, dataset_fn):
+        self.config = config
+        self.shard = shard
+        self.dataset_fn = dataset_fn
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("", 0))
+        self._srv.listen(4)
+        self._thread = None
+        self._stop = threading.Event()
+
+    def start(self):
+        kv = KVStoreClient(self.config.kv_addr, self.config.kv_port)
+        port = self._srv.getsockname()[1]
+        kv.put(_SCOPE, f"worker_{self.shard}",
+               json.dumps({"addr": socket.gethostname(),
+                           "port": port}).encode())
+        self._thread = threading.Thread(target=self._serve, daemon=True)
+        self._thread.start()
+        return port
+
+    def _serve(self):
+        while not self._stop.is_set():
+            try:
+                self._srv.settimeout(0.5)
+                conn, _ = self._srv.accept()
+            except socket.timeout:
+                continue
+            except OSError:
+                return
+            threading.Thread(target=self._stream, args=(conn,),
+                             daemon=True).start()
+
+    def _stream(self, conn):
+        try:
+            for batch in self.dataset_fn(self.shard,
+                                         self.config.num_workers):
+                payload = pickle.dumps(batch, pickle.HIGHEST_PROTOCOL)
+                conn.sendall(struct.pack(">Q", len(payload)) + payload)
+                if self._stop.is_set():
+                    break
+            conn.sendall(struct.pack(">Q", 0))  # end-of-stream
+        except (BrokenPipeError, ConnectionResetError, OSError):
+            pass
+        finally:
+            conn.close()
+
+    def stop(self):
+        self._stop.set()
+        self._srv.close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+
+
+class ComputeServiceDataLoader:
+    """Training-side client: iterates batches streamed from this rank's
+    assigned worker, prefetching into a bounded queue
+    (reference: send_to_data_service + AsyncDataLoaderMixin semantics)."""
+
+    def __init__(self, config, shard, queue_size=8, connect_timeout=30):
+        self.config = config
+        self.shard = shard
+        self.queue_size = queue_size
+        self.connect_timeout = connect_timeout
+
+    def _endpoint(self):
+        import time
+        kv = KVStoreClient(self.config.kv_addr, self.config.kv_port)
+        deadline = time.time() + self.connect_timeout
+        while time.time() < deadline:
+            raw = kv.get(_SCOPE, f"worker_{self.shard}")
+            if raw:
+                info = json.loads(raw.decode())
+                return info["addr"], info["port"]
+            time.sleep(0.2)
+        raise TimeoutError(
+            f"compute-service worker for shard {self.shard} never "
+            f"registered (have {self.config.num_workers} workers started?)")
+
+    def __iter__(self):
+        addr, port = self._endpoint()
+        sock = socket.create_connection((addr, port),
+                                        timeout=self.connect_timeout)
+        q = queue.Queue(maxsize=self.queue_size)
+        _END = object()
+
+        def reader():
+            try:
+                buf = sock.makefile("rb")
+                while True:
+                    header = buf.read(8)
+                    if len(header) < 8:
+                        break
+                    (n,) = struct.unpack(">Q", header)
+                    if n == 0:
+                        break
+                    q.put(pickle.loads(buf.read(n)))
+            finally:
+                q.put(_END)
+                sock.close()
+
+        threading.Thread(target=reader, daemon=True).start()
+        while True:
+            item = q.get()
+            if item is _END:
+                return
+            yield item

@@ -32,30 +32,75 @@ def DistributedOptimizer(optimizer, name=None,
                          backward_passes_per_step=1, process_set=None):
     """Wrap a Keras optimizer so gradients are averaged across hosts inside
     ``apply_gradients`` (reference: hvd.DistributedOptimizer
-    keras/__init__.py:40-130)."""
+    keras/__init__.py:40-130).
+
+    The instance's class is swapped in place (same trick as the reference's
+    dynamic subclass) so already-built optimizer state — restored Adam
+    moments, iteration counts — survives wrapping, e.g. through
+    :func:`load_model`.
+    """
+    import tensorflow as tf
+
     import horovod_tpu.tensorflow as hvd_tf
 
     cls = optimizer.__class__
+    # Accumulation state lives in the closure, NOT as instance attributes:
+    # Keras 3's attribute tracking wraps assigned lists in tracked copies, so
+    # in-place mutations through a local alias would be silently dropped.
+    # Each _Distributed class wraps exactly one optimizer instance.
+    agg = {"acc": None, "count": 0}
 
     class _Distributed(cls):
         _hvd_wrapped = True
+
+        def _hvd_accumulate(self, grads):
+            """Eager local aggregation over backward_passes_per_step calls;
+            returns the averaged gradients on the flush call, else None
+            (reference: tensorflow/gradient_aggregation_eager.py)."""
+            if not tf.executing_eagerly():
+                raise NotImplementedError(
+                    "backward_passes_per_step > 1 requires an eager training "
+                    "loop (model.compile(run_eagerly=True)); inside "
+                    "tf.function use a larger batch instead")
+            if agg["acc"] is None:
+                agg["acc"] = [None] * len(grads)
+                agg["count"] = 0
+            acc = agg["acc"]
+            for i, g in enumerate(grads):
+                if g is not None:
+                    acc[i] = g if acc[i] is None else acc[i] + g
+            agg["count"] += 1
+            if agg["count"] < backward_passes_per_step:
+                return None
+            out = [None if a is None else a / backward_passes_per_step
+                   for a in acc]
+            agg["acc"] = None
+            return out
 
         def apply_gradients(self, grads_and_vars, *args, **kwargs):
             grads_and_vars = list(grads_and_vars)
             grads = [g for g, _ in grads_and_vars]
             variables = [v for _, v in grads_and_vars]
+            if sparse_as_dense:
+                grads = [tf.convert_to_tensor(g)
+                         if isinstance(g, tf.IndexedSlices) else g
+                         for g in grads]
+            if backward_passes_per_step > 1:
+                grads = self._hvd_accumulate(grads)
+                if grads is None:
+                    return None  # mid-accumulation: no variable update
             live = [g for g in grads if g is not None]
             if live:
                 reduced = iter(hvd_tf.grouped_allreduce(
-                    live, op=op, process_set=process_set))
+                    live, op=op, compression=compression,
+                    process_set=process_set))
                 grads = [None if g is None else next(reduced) for g in grads]
             return super().apply_gradients(zip(grads, variables), *args,
                                            **kwargs)
 
     _Distributed.__name__ = cls.__name__
-    cfg = optimizer.get_config()
-    dist = _Distributed.from_config(cfg)
-    return dist
+    optimizer.__class__ = _Distributed
+    return optimizer
 
 
 def load_model(filepath, custom_optimizers=None, custom_objects=None,

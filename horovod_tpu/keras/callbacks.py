@@ -22,7 +22,9 @@ class BroadcastGlobalVariablesCallback(keras.callbacks.Callback):
         if self.broadcast_done:
             return
         import horovod_tpu.tensorflow as hvd_tf
-        hvd_tf.broadcast_variables(self.model.trainable_variables,
+        # All weights, trainable AND non-trainable (BatchNorm moving stats
+        # must sync too — reference broadcasts every global variable).
+        hvd_tf.broadcast_variables(self.model.weights,
                                    root_rank=self.root_rank,
                                    process_set=self.process_set)
         if self.model.optimizer is not None:

@@ -1,0 +1,14 @@
+"""Ray cluster integration (reference: horovod/ray/).
+
+``RayExecutor`` places one worker actor per host (each owns that host's TPU
+chips), wires the rank/coordinator env contract, and runs the training
+function — mirroring horovod/ray/runner.py:168-430 with the TPU process
+model. Gated: importing this package works without ray; constructing an
+executor requires it.
+"""
+
+from horovod_tpu.ray.runner import RayExecutor
+from horovod_tpu.ray.strategy import (placement_bundles, ray_available,
+                                      worker_env)
+
+__all__ = ["RayExecutor", "placement_bundles", "worker_env", "ray_available"]

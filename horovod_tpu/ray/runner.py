@@ -1,0 +1,183 @@
+"""RayExecutor — run horovod_tpu training on a Ray cluster.
+
+Reference: horovod/ray/runner.py (RayExecutor :168-430: create_settings,
+start/run/run_remote/execute/shutdown; worker actors hold the training env
+and a BaseHorovodWorker.execute). TPU model: one actor per host process;
+each actor's worker bootstraps ``jax.distributed`` with the env contract and
+owns all chips Ray scheduled onto its node.
+"""
+
+import os
+import socket
+
+import cloudpickle
+
+from horovod_tpu.ray.strategy import (placement_bundles, ray_available,
+                                      worker_env)
+
+
+class _Settings:
+    """Mini settings object (reference: RayExecutor.create_settings
+    runner.py:211-240)."""
+
+    def __init__(self, timeout_s=30, placement_group_timeout_s=100,
+                 nics=None):
+        self.timeout_s = timeout_s
+        self.placement_group_timeout_s = placement_group_timeout_s
+        self.nics = nics
+
+
+class RayExecutor:
+    """Job class for horovod_tpu + Ray (reference: runner.py:168).
+
+    Example::
+
+        ex = RayExecutor(num_workers=2, cpus_per_worker=2)
+        ex.start()
+        results = ex.run(train_fn, args=(cfg,))
+        ex.shutdown()
+    """
+
+    @classmethod
+    def create_settings(cls, timeout_s=30, placement_group_timeout_s=100,
+                        nics=None, **_compat):
+        return _Settings(timeout_s, placement_group_timeout_s, nics)
+
+    def __init__(self, settings=None, num_workers=None, num_hosts=None,
+                 num_workers_per_host=1, cpus_per_worker=1,
+                 tpus_per_worker=0, use_current_placement_group=True,
+                 env_vars=None):
+        if not ray_available():
+            raise RuntimeError(
+                "RayExecutor requires ray (`pip install ray`); it is not "
+                "bundled with horovod_tpu")
+        self.settings = settings or self.create_settings()
+        self.bundles, self.strategy = placement_bundles(
+            num_hosts=num_hosts, num_workers_per_host=num_workers_per_host,
+            num_workers=num_workers, cpus_per_worker=cpus_per_worker,
+            tpus_per_worker=tpus_per_worker)
+        self.num_workers = len(self.bundles)
+        self.local_size = (num_workers_per_host if num_hosts is not None
+                           else 1)
+        self.cpus_per_worker = cpus_per_worker
+        self.tpus_per_worker = tpus_per_worker
+        self.use_current_placement_group = use_current_placement_group
+        self.env_vars = dict(env_vars or {})
+        self.workers = []
+        self.placement_group = None
+        self._kv = None
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self, extra_env_vars=None):
+        """Create the placement group and worker actors, establish the
+        rendezvous env (reference: runner.py start/_start_executables)."""
+        import ray
+
+        from horovod_tpu.runner.http_kv import KVStoreServer
+
+        env = {**self.env_vars, **(extra_env_vars or {})}
+
+        pg = None
+        if self.use_current_placement_group:
+            pg = ray.util.get_current_placement_group()
+        if pg is None:
+            pg = ray.util.placement_group(self.bundles,
+                                          strategy=self.strategy)
+            ray.get(pg.ready(),
+                    timeout=self.settings.placement_group_timeout_s)
+            self.placement_group = pg
+
+        self._kv = KVStoreServer()
+        kv_port = self._kv.start()
+        coordinator_addr = socket.gethostbyname(socket.gethostname())
+        coordinator_port = _free_port()
+
+        worker_cls = _make_worker_cls(self.cpus_per_worker,
+                                      self.tpus_per_worker)
+        self.workers = []
+        for i in range(self.num_workers):
+            wenv = worker_env(i, self.num_workers, self.local_size,
+                              coordinator_addr, coordinator_port, kv_port,
+                              base_env=env)
+            actor = worker_cls.options(
+                scheduling_strategy=ray.util.scheduling_strategies.
+                PlacementGroupSchedulingStrategy(
+                    placement_group=pg, placement_group_bundle_index=i)
+            ).remote(wenv)
+            self.workers.append(actor)
+        ray.get([w.ready.remote() for w in self.workers],
+                timeout=self.settings.timeout_s)
+
+    def run(self, fn, args=None, kwargs=None):
+        """Run ``fn`` on every worker; returns the list of results ordered by
+        rank (reference: runner.py run :355)."""
+        import ray
+        payload = cloudpickle.dumps((fn, tuple(args or ()),
+                                     dict(kwargs or {})))
+        return ray.get([w.execute_pickled.remote(payload)
+                        for w in self.workers])
+
+    def run_remote(self, fn, args=None, kwargs=None):
+        """Async variant returning object refs (reference: runner.py
+        run_remote :377)."""
+        payload = cloudpickle.dumps((fn, tuple(args or ()),
+                                     dict(kwargs or {})))
+        return [w.execute_pickled.remote(payload) for w in self.workers]
+
+    def execute(self, fn):
+        """Run ``fn(executable)`` on each worker's persistent state
+        (reference: runner.py execute :340)."""
+        import ray
+        return ray.get([w.execute_fn.remote(cloudpickle.dumps(fn))
+                        for w in self.workers])
+
+    def execute_single(self, fn):
+        import ray
+        return ray.get(
+            self.workers[0].execute_fn.remote(cloudpickle.dumps(fn)))
+
+    def shutdown(self):
+        """Kill actors and release the placement group
+        (reference: runner.py shutdown :425)."""
+        import ray
+        for w in self.workers:
+            ray.kill(w)
+        self.workers = []
+        if self.placement_group is not None:
+            ray.util.remove_placement_group(self.placement_group)
+            self.placement_group = None
+        if self._kv is not None:
+            self._kv.stop()
+            self._kv = None
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def _make_worker_cls(cpus_per_worker, tpus_per_worker):
+    """Define the worker actor lazily (ray must be importable)."""
+    import ray
+
+    @ray.remote(num_cpus=cpus_per_worker,
+                resources=({"TPU": tpus_per_worker} if tpus_per_worker
+                           else None))
+    class _HorovodWorker:
+        def __init__(self, env):
+            os.environ.update(env)
+
+        def ready(self):
+            return True
+
+        def execute_pickled(self, payload):
+            fn, args, kwargs = cloudpickle.loads(payload)
+            return fn(*args, **kwargs)
+
+        def execute_fn(self, pickled_fn):
+            fn = cloudpickle.loads(pickled_fn)
+            return fn(self)
+
+    return _HorovodWorker

@@ -1,0 +1,58 @@
+"""Placement and env-contract logic for the Ray executor — pure functions,
+unit-testable without a ray installation.
+
+Reference: horovod/ray/strategy.py (ColocatedStrategy/PGStrategy bundle
+construction) and runner.py env plumbing.
+"""
+
+import importlib.util
+
+
+def ray_available():
+    return importlib.util.find_spec("ray") is not None
+
+
+def placement_bundles(num_hosts=None, num_workers_per_host=None,
+                      num_workers=None, cpus_per_worker=1,
+                      tpus_per_worker=0, colocate=True):
+    """Placement-group bundles: one bundle per *worker process* (= per host
+    in the TPU model, each owning its chips).
+
+    Two API shapes, matching the reference (runner.py:168): explicit
+    ``num_hosts × num_workers_per_host`` (equal spread enforced via STRICT_SPREAD)
+    or flat ``num_workers`` (PACK). Returns (bundles, strategy_string).
+    """
+    if (num_hosts is None) == (num_workers is None):
+        raise ValueError(
+            "specify exactly one of num_hosts(+num_workers_per_host) or "
+            "num_workers (matches reference RayExecutor arg validation)")
+    resources = {"CPU": cpus_per_worker}
+    if tpus_per_worker:
+        resources["TPU"] = tpus_per_worker
+    if num_hosts is not None:
+        per_host = num_workers_per_host or 1
+        bundle = {k: v * per_host for k, v in resources.items()}
+        return [dict(bundle) for _ in range(num_hosts)], "STRICT_SPREAD"
+    strategy = "PACK" if colocate else "SPREAD"
+    return [dict(resources) for _ in range(num_workers)], strategy
+
+
+def worker_env(cross_rank, cross_size, local_size, coordinator_addr,
+               coordinator_port, kv_port, base_env=None):
+    """The rank/coordinator env contract for one worker
+    (reference: runner.py Coordinator.establish_rendezvous +
+    gloo_run.py:66-78 rank env)."""
+    env = dict(base_env or {})
+    env.update({
+        "HOROVOD_CROSS_RANK": str(cross_rank),
+        "HOROVOD_CROSS_SIZE": str(cross_size),
+        "HOROVOD_LOCAL_SIZE": str(local_size),
+        "HOROVOD_SIZE": str(cross_size * local_size),
+        "HOROVOD_RANK": str(cross_rank * local_size),
+        "HOROVOD_LOCAL_RANK": "0",
+        "HOROVOD_COORDINATOR_ADDR": coordinator_addr,
+        "HOROVOD_COORDINATOR_PORT": str(coordinator_port),
+        "HOROVOD_KV_ADDR": coordinator_addr,
+        "HOROVOD_KV_PORT": str(kv_port),
+    })
+    return env

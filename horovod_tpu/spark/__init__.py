@@ -1,0 +1,17 @@
+"""Spark cluster integration (reference: horovod/spark/).
+
+``run()`` launches the training function as a Spark job with ranks assigned
+from partition/host placement (reference: spark/runner.py:200). The
+estimator layer (``TpuEstimator``) implements the Store→Parquet→train→model
+pipeline of the reference's Spark ML estimators (spark/common/estimator.py)
+with a pandas/pyarrow data path, so it also runs without a Spark cluster —
+pyspark is only required for the distributed job backend.
+"""
+
+from horovod_tpu.spark.estimator import TpuEstimator, TpuModel
+from horovod_tpu.spark.runner import run, spark_available
+from horovod_tpu.spark.store import FilesystemStore, LocalStore, Store
+from horovod_tpu.spark.task import assign_ranks
+
+__all__ = ["run", "spark_available", "Store", "LocalStore",
+           "FilesystemStore", "TpuEstimator", "TpuModel", "assign_ranks"]

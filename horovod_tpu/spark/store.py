@@ -1,0 +1,87 @@
+"""Training artifact stores (reference: horovod/spark/common/store.py:38-540:
+Store/LocalStore/HDFSStore/DBFSLocalStore — per-run directories for training
+data, checkpoints, and logs, plus (de)serialization helpers)."""
+
+import os
+import shutil
+import uuid
+
+
+class Store:
+    """Abstract per-run artifact layout."""
+
+    def get_train_data_path(self, idx=None):
+        raise NotImplementedError
+
+    def get_val_data_path(self, idx=None):
+        raise NotImplementedError
+
+    def get_checkpoint_path(self, run_id):
+        raise NotImplementedError
+
+    def get_logs_path(self, run_id):
+        raise NotImplementedError
+
+    def exists(self, path):
+        raise NotImplementedError
+
+    def new_run_id(self):
+        return f"run_{uuid.uuid4().hex[:12]}"
+
+    @staticmethod
+    def create(prefix_path):
+        """Factory mirroring Store.create (reference: store.py:84-96) —
+        filesystem paths only; hdfs:// and dbfs:/ need their own client and
+        raise a clear error here."""
+        if prefix_path.startswith(("hdfs://", "dbfs:/")):
+            raise ValueError(
+                f"{prefix_path}: remote stores require the corresponding "
+                "filesystem client; mount the path locally or subclass "
+                "FilesystemStore")
+        return LocalStore(prefix_path)
+
+
+class FilesystemStore(Store):
+    """Store on a (possibly network-mounted) filesystem path
+    (reference: FilesystemStore store.py:110-320)."""
+
+    def __init__(self, prefix_path, train_path=None, val_path=None,
+                 checkpoint_path=None, logs_path=None):
+        self.prefix_path = prefix_path
+        self._train_path = train_path or os.path.join(
+            prefix_path, "intermediate_train_data")
+        self._val_path = val_path or os.path.join(
+            prefix_path, "intermediate_val_data")
+        self._checkpoint_base = checkpoint_path or os.path.join(
+            prefix_path, "checkpoints")
+        self._logs_base = logs_path or os.path.join(prefix_path, "logs")
+        os.makedirs(prefix_path, exist_ok=True)
+
+    def get_train_data_path(self, idx=None):
+        return self._train_path if idx is None else \
+            f"{self._train_path}.{idx}"
+
+    def get_val_data_path(self, idx=None):
+        return self._val_path if idx is None else f"{self._val_path}.{idx}"
+
+    def get_checkpoint_path(self, run_id):
+        return os.path.join(self._checkpoint_base, run_id)
+
+    def get_logs_path(self, run_id):
+        return os.path.join(self._logs_base, run_id)
+
+    def exists(self, path):
+        return os.path.exists(path)
+
+    def make_dirs(self, path):
+        os.makedirs(path, exist_ok=True)
+
+    def delete(self, path):
+        if os.path.isdir(path):
+            shutil.rmtree(path, ignore_errors=True)
+        elif os.path.exists(path):
+            os.unlink(path)
+
+
+class LocalStore(FilesystemStore):
+    """Local-disk store (reference: LocalStore store.py:322-360)."""

@@ -1,0 +1,37 @@
+"""Rank assignment from Spark task placement — pure logic, no pyspark.
+
+Reference: horovod/spark/runner.py:161-198 — the driver collects each task's
+(partition index, host), then assigns Horovod ranks host-major so local
+ranks are contiguous on a host, mirroring hosts.py get_host_assignments.
+"""
+
+import collections
+
+
+def assign_ranks(task_hosts):
+    """``task_hosts``: list of (task_index, host). Returns
+    {task_index: dict(rank, local_rank, cross_rank, size, local_size,
+    cross_size)}.
+
+    Host order follows first appearance (by lowest task index); within a
+    host, tasks are ordered by task index — deterministic and stable across
+    retries, like the reference's sorted registration order.
+    """
+    by_host = collections.OrderedDict()
+    for idx, host in sorted(task_hosts):
+        by_host.setdefault(host, []).append(idx)
+
+    size = len(task_hosts)
+    cross_size = len(by_host)
+    local_sizes = {h: len(idxs) for h, idxs in by_host.items()}
+
+    out = {}
+    rank = 0
+    for cross_rank, (host, idxs) in enumerate(by_host.items()):
+        for local_rank, idx in enumerate(idxs):
+            out[idx] = dict(rank=rank, local_rank=local_rank,
+                            cross_rank=cross_rank, size=size,
+                            local_size=local_sizes[host],
+                            cross_size=cross_size, host=host)
+            rank += 1
+    return out

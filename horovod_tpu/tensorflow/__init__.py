@@ -132,9 +132,11 @@ def allreduce(tensor, average=None, op=None, prescale_factor=1.0,
 
 
 def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
-                      postscale_factor=1.0, name=None, process_set=None):
+                      postscale_factor=1.0, name=None, process_set=None,
+                      compression=None):
     if op is None:
         op = Average if (average is None or average) else Sum
+    compression = compression or Compression.none
     tf = _tf()
     if not tf.executing_eagerly():
         # Inside tf.function (Keras compiled train steps): the collective
@@ -142,18 +144,21 @@ def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
         # the moral equivalent of the reference's HorovodAllreduce custom op
         # (reference: tensorflow/mpi_ops.cc:443-516 AsyncOpKernel).
         return _graph_grouped_allreduce(tensors, op, prescale_factor,
-                                        postscale_factor, process_set)
+                                        postscale_factor, process_set,
+                                        compression)
     arrs, dtypes = zip(*(_to_numpy(t) for t in tensors))
     ps = _ps(process_set)
-    outs = C.grouped_allreduce([_stack(a, ps) for a in arrs], op=op,
+    wires, ctxs = zip(*(compression.compress(a) for a in arrs))
+    outs = C.grouped_allreduce([_stack(a, ps) for a in wires], op=op,
                                prescale_factor=prescale_factor,
                                postscale_factor=postscale_factor,
                                process_set=process_set, name=name)
-    return [_to_tf(np.asarray(o)[0], dt) for o, dt in zip(outs, dtypes)]
+    return [_to_tf(compression.decompress(np.asarray(o)[0], ctx), dt)
+            for o, ctx, dt in zip(outs, ctxs, dtypes)]
 
 
 def _graph_grouped_allreduce(tensors, op, prescale_factor, postscale_factor,
-                             process_set):
+                             process_set, compression):
     tf = _tf()
     # numpy_function has no bf16/f16 kernel coverage; widen those lanes.
     wire = [t if t.dtype not in (tf.bfloat16, tf.float16)
@@ -161,12 +166,15 @@ def _graph_grouped_allreduce(tensors, op, prescale_factor, postscale_factor,
 
     def _np_fn(*arrs):
         ps = _ps(process_set)
-        outs = C.grouped_allreduce([_stack(np.asarray(a), ps) for a in arrs],
+        compressed, ctxs = zip(*(compression.compress(np.asarray(a))
+                                 for a in arrs))
+        outs = C.grouped_allreduce([_stack(c, ps) for c in compressed],
                                    op=op, prescale_factor=prescale_factor,
                                    postscale_factor=postscale_factor,
                                    process_set=process_set)
-        return [np.asarray(o)[0].astype(a.dtype)
-                for o, a in zip(outs, arrs)]
+        return [np.asarray(compression.decompress(np.asarray(o)[0], ctx))
+                .astype(a.dtype)
+                for o, ctx, a in zip(outs, ctxs, arrs)]
 
     outs = tf.numpy_function(_np_fn, wire, [t.dtype for t in wire],
                              name="hvd_grouped_allreduce")
@@ -264,7 +272,18 @@ class DistributedGradientTape:
         return getattr(self._tape, name)
 
     def gradient(self, target, sources, output_gradients=None):
+        tf = _tf()
         grads = self._tape.gradient(target, sources, output_gradients)
+        if self._sparse_as_dense:
+            grads = [tf.convert_to_tensor(g)
+                     if isinstance(g, tf.IndexedSlices) else g
+                     for g in grads]
+        for g in grads:
+            if isinstance(g, tf.IndexedSlices):
+                raise ValueError(
+                    "IndexedSlices gradient (embedding layer?): pass "
+                    "sparse_as_dense=True to DistributedGradientTape "
+                    "(the TPU data plane is dense)")
         flat = [g for g in grads if g is not None]
         if not flat:
             return grads
@@ -276,5 +295,6 @@ class DistributedGradientTape:
             op = Sum
         reduced = iter(grouped_allreduce(
             flat, op=op, prescale_factor=prescale,
-            postscale_factor=postscale, process_set=self._process_set))
+            postscale_factor=postscale, process_set=self._process_set,
+            compression=self._compression))
         return [None if g is None else next(reduced) for g in grads]

@@ -75,16 +75,22 @@ def _unstack(out, torch_dtype):
 
 class _TorchHandle:
     """reference: HandleManager int handles + poll/synchronize
-    (torch/handle_manager.h, mpi_ops.py:1245-1283)."""
+    (torch/handle_manager.h, mpi_ops.py:1245-1283).
 
-    __slots__ = ("_inner", "_dtype", "_postprocess", "_output", "_done")
+    ``target``: optional tensor the result is copied into (the in-place
+    ``*_async_`` contract); synchronize then returns ``target``.
+    """
 
-    def __init__(self, inner, dtype, postprocess=None):
+    __slots__ = ("_inner", "_dtype", "_postprocess", "_output", "_done",
+                 "_target")
+
+    def __init__(self, inner, dtype, postprocess=None, target=None):
         self._inner = inner
         self._dtype = dtype
         self._postprocess = postprocess
         self._output = None
         self._done = False
+        self._target = target
 
     def poll(self):
         return self._inner.poll()
@@ -96,6 +102,9 @@ class _TorchHandle:
                            self._dtype)
             if self._postprocess is not None:
                 out = self._postprocess(out)
+            if self._target is not None:
+                self._target.copy_(out.to(self._target.dtype))
+                out = self._target
             self._output = out
             self._done = True
         return self._output
@@ -174,13 +183,7 @@ def allreduce_async_(tensor, average=None, name=None, compression=None,
                         prescale_factor=prescale_factor,
                         postscale_factor=postscale_factor,
                         process_set=process_set)
-    inner_sync = h.synchronize
-
-    def sync_inplace():
-        out = inner_sync()
-        tensor.copy_(out.to(tensor.dtype))
-        return tensor
-    h.synchronize = sync_inplace
+    h._target = tensor
     return h
 
 
@@ -276,13 +279,7 @@ def broadcast_async(tensor, root_rank, name=None, process_set=None):
 
 def broadcast_async_(tensor, root_rank, name=None, process_set=None):
     h = broadcast_async(tensor, root_rank, name=name, process_set=process_set)
-    inner_sync = h.synchronize
-
-    def sync_inplace():
-        out = inner_sync()
-        tensor.copy_(out.to(tensor.dtype))
-        return tensor
-    h.synchronize = sync_inplace
+    h._target = tensor
     return h
 
 

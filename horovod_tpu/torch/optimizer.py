@@ -133,6 +133,12 @@ def DistributedOptimizer(optimizer, named_parameters=None,
     """
     cls = type(optimizer.__class__.__name__, (optimizer.__class__,),
                dict(_DistributedOptimizer.__dict__))
-    return cls(optimizer.param_groups, named_parameters, compression,
+    dist = cls(optimizer.param_groups, named_parameters, compression,
                backward_passes_per_step, op, process_set,
                gradient_predivide_factor)
+    # Preserve the wrapped optimizer's per-parameter state (momentum/Adam
+    # buffers, e.g. restored from a checkpoint) — param_groups share the same
+    # parameter objects, so the state transfers keyed as-is.
+    for p, s in optimizer.state.items():
+        dist.state[p] = s
+    return dist

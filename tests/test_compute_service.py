@@ -1,0 +1,79 @@
+"""Compute-service side-car tests (reference model:
+test_compute_worker.py / data service tests in test/parallel)."""
+
+import time
+
+import numpy as np
+import pytest
+
+from horovod_tpu.data.compute_service import (ComputeServiceConfig,
+                                              ComputeServiceDataLoader,
+                                              DataDispatcher, DataWorker)
+
+
+def _dataset_fn(shard, num_shards):
+    for i in range(5):
+        yield {"x": np.full((4, 2), shard * 100 + i, np.float32),
+               "i": i}
+
+
+class TestComputeService:
+    def test_end_to_end_stream(self):
+        dispatcher = DataDispatcher(num_workers=2)
+        workers = []
+        try:
+            cfg = dispatcher.config
+            for shard in range(2):
+                w = DataWorker(cfg, shard, _dataset_fn)
+                w.start()
+                workers.append(w)
+
+            for shard in range(2):
+                loader = ComputeServiceDataLoader(cfg, shard,
+                                                  connect_timeout=10)
+                batches = list(loader)
+                assert len(batches) == 5
+                assert batches[0]["x"][0, 0] == shard * 100
+                assert [b["i"] for b in batches] == list(range(5))
+        finally:
+            for w in workers:
+                w.stop()
+            dispatcher.stop()
+
+    def test_multiple_consumers_same_worker(self):
+        dispatcher = DataDispatcher(num_workers=1)
+        w = DataWorker(dispatcher.config, 0, _dataset_fn)
+        w.start()
+        try:
+            l1 = list(ComputeServiceDataLoader(dispatcher.config, 0))
+            l2 = list(ComputeServiceDataLoader(dispatcher.config, 0))
+            assert len(l1) == len(l2) == 5
+        finally:
+            w.stop()
+            dispatcher.stop()
+
+    def test_missing_worker_times_out(self):
+        dispatcher = DataDispatcher(num_workers=1)
+        try:
+            loader = ComputeServiceDataLoader(dispatcher.config, 0,
+                                              connect_timeout=1)
+            with pytest.raises(TimeoutError, match="never registered"):
+                iter(loader).__next__()
+        finally:
+            dispatcher.stop()
+
+    def test_config_file_roundtrip(self, tmp_path):
+        cfg = ComputeServiceConfig(kv_addr="h", kv_port=1234, num_workers=3)
+        path = str(tmp_path / "svc.json")
+        cfg.write(path)
+        assert ComputeServiceConfig.read(path) == cfg
+
+    def test_config_wait_for_creation(self, tmp_path):
+        import threading
+        cfg = ComputeServiceConfig(kv_addr="h", kv_port=1, num_workers=1)
+        path = str(tmp_path / "late.json")
+        t = threading.Timer(0.3, lambda: cfg.write(path))
+        t.start()
+        got = ComputeServiceConfig.read(path, wait_for_file_creation=True)
+        assert got == cfg
+        t.join()

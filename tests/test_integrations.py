@@ -1,0 +1,158 @@
+"""Ray/Spark integration tests (reference model: test/single/test_ray.py and
+test/integration/test_spark.py — here the pure logic is tested directly and
+the cluster backends are gated, since ray/pyspark are not installed)."""
+
+import numpy as np
+import pytest
+
+from horovod_tpu.ray.strategy import placement_bundles, worker_env
+from horovod_tpu.spark.store import LocalStore, Store
+from horovod_tpu.spark.task import assign_ranks
+
+
+class TestRayPlacement:
+    def test_hosts_shape(self):
+        bundles, strategy = placement_bundles(
+            num_hosts=3, num_workers_per_host=2, cpus_per_worker=4)
+        assert strategy == "STRICT_SPREAD"
+        assert bundles == [{"CPU": 8}] * 3
+
+    def test_flat_workers(self):
+        bundles, strategy = placement_bundles(num_workers=4,
+                                              cpus_per_worker=2)
+        assert strategy == "PACK"
+        assert bundles == [{"CPU": 2}] * 4
+
+    def test_tpu_resources(self):
+        bundles, _ = placement_bundles(num_workers=2, tpus_per_worker=4)
+        assert bundles[0]["TPU"] == 4
+
+    def test_both_apis_rejected(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            placement_bundles(num_hosts=2, num_workers=4)
+        with pytest.raises(ValueError, match="exactly one"):
+            placement_bundles()
+
+    def test_worker_env_contract(self):
+        env = worker_env(1, 4, 8, "10.0.0.1", 5000, 6000,
+                         base_env={"X": "y"})
+        assert env["HOROVOD_CROSS_RANK"] == "1"
+        assert env["HOROVOD_SIZE"] == "32"
+        assert env["HOROVOD_RANK"] == "8"
+        assert env["HOROVOD_COORDINATOR_ADDR"] == "10.0.0.1"
+        assert env["X"] == "y"
+
+    def test_executor_requires_ray(self):
+        from horovod_tpu.ray import RayExecutor
+        with pytest.raises(RuntimeError, match="ray"):
+            RayExecutor(num_workers=2)
+
+
+class TestSparkRankAssignment:
+    def test_host_major_contiguous(self):
+        placement = [(0, "hostA"), (1, "hostB"), (2, "hostA"), (3, "hostB")]
+        ranks = assign_ranks(placement)
+        assert ranks[0]["rank"] == 0 and ranks[0]["local_rank"] == 0
+        assert ranks[2]["rank"] == 1 and ranks[2]["local_rank"] == 1
+        assert ranks[1]["cross_rank"] == 1
+        assert all(r["size"] == 4 and r["cross_size"] == 2
+                   for r in ranks.values())
+
+    def test_deterministic_under_reorder(self):
+        a = assign_ranks([(1, "h2"), (0, "h1"), (2, "h1")])
+        b = assign_ranks([(0, "h1"), (2, "h1"), (1, "h2")])
+        assert a == b
+
+    def test_run_requires_pyspark(self):
+        from horovod_tpu.spark import run
+        with pytest.raises(RuntimeError, match="pyspark"):
+            run(lambda: None, num_proc=2)
+
+
+class TestSparkStore:
+    def test_local_store_layout(self, tmp_path):
+        store = LocalStore(str(tmp_path / "art"))
+        assert store.get_train_data_path().startswith(str(tmp_path))
+        assert store.get_train_data_path(2).endswith(".2")
+        ckpt = store.get_checkpoint_path("run_x")
+        assert "run_x" in ckpt
+        store.make_dirs(ckpt)
+        assert store.exists(ckpt)
+        store.delete(ckpt)
+        assert not store.exists(ckpt)
+
+    def test_factory_rejects_remote(self):
+        with pytest.raises(ValueError, match="hdfs"):
+            Store.create("hdfs://nn/path")
+        assert isinstance(Store.create("/tmp/x"), LocalStore)
+
+    def test_run_ids_unique(self, tmp_path):
+        store = LocalStore(str(tmp_path))
+        assert store.new_run_id() != store.new_run_id()
+
+
+class TestEstimator:
+    def test_fit_transform_roundtrip(self, hvd, tmp_path):
+        import flax.linen as nn
+        import jax.numpy as jnp
+        import optax
+        import pandas as pd
+
+        from horovod_tpu.spark import LocalStore, TpuEstimator
+
+        class MLP(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                x = nn.Dense(16)(x)
+                x = nn.relu(x)
+                return nn.Dense(1)(x)[..., 0]
+
+        rng = np.random.default_rng(0)
+        X = rng.standard_normal((256, 4)).astype(np.float32)
+        w = rng.standard_normal(4)
+        y = (X @ w).astype(np.float32)
+        df = pd.DataFrame({f"f{i}": X[:, i] for i in range(4)})
+        df["label"] = y
+
+        est = TpuEstimator(
+            model=MLP(), optimizer=optax.adam(1e-2),
+            loss=lambda pred, lab: jnp.mean((pred - lab) ** 2),
+            feature_cols=[f"f{i}" for i in range(4)], label_cols=["label"],
+            batch_size=4, epochs=5, store=LocalStore(str(tmp_path)), seed=0)
+        model = est.fit(df)
+        assert model.history[-1] < model.history[0]
+
+        out = model.transform(df)
+        assert "label__output" in out.columns
+        mse = float(np.mean((np.asarray(out["label__output"]) - y) ** 2))
+        assert mse < model.history[0]
+
+    def test_resume_from_checkpoint(self, hvd, tmp_path):
+        import flax.linen as nn
+        import jax.numpy as jnp
+        import optax
+        import pandas as pd
+
+        from horovod_tpu.spark import LocalStore, TpuEstimator
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)[..., 0]
+
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((64, 2)).astype(np.float32)
+        y = X[:, 0].astype(np.float32)
+        df = pd.DataFrame({"a": X[:, 0], "b": X[:, 1], "label": y})
+        store = LocalStore(str(tmp_path))
+
+        def make(run_id=None):
+            return TpuEstimator(
+                model=Lin(), optimizer=optax.sgd(0.1),
+                loss=lambda p, l: jnp.mean((p - l) ** 2),
+                feature_cols=["a", "b"], label_cols=["label"],
+                batch_size=4, epochs=1, store=store, run_id=run_id)
+
+        m1 = make(run_id="runA").fit(df)
+        m2 = make(run_id="runA").fit(df)  # resumes from m1's checkpoint
+        assert m2.history[0] <= m1.history[0]

@@ -149,6 +149,69 @@ class TestKeras:
         # after warmup end the LR approaches initial_lr * (ramp at epoch 2)
         assert float(np.asarray(model.optimizer.learning_rate)) > 0.1 / N
 
+    def test_wrap_preserves_built_optimizer_state(self):
+        """Regression: DistributedOptimizer must not rebuild via from_config
+        (which resets iterations/moments)."""
+        import keras
+        import horovod_tpu.keras as hvd_keras
+        model = self._model()
+        opt = keras.optimizers.Adam(1e-3)
+        model.compile(optimizer=opt, loss="mse")
+        x = np.zeros((8, 4), np.float32)
+        y = np.zeros((8, 1), np.float32)
+        model.fit(x, y, epochs=1, batch_size=8, verbose=0)
+        iters_before = int(opt.iterations.numpy())
+        assert iters_before > 0
+        wrapped = hvd_keras.DistributedOptimizer(opt)
+        assert wrapped is opt  # in-place class swap
+        assert int(wrapped.iterations.numpy()) == iters_before
+
+    def test_sparse_as_dense_and_compression_in_tape(self):
+        emb = tf.Variable(tf.random.normal((10, 4)))
+        with hvd_tf.DistributedGradientTape(
+                tf.GradientTape(), sparse_as_dense=True,
+                compression=hvd_tf.Compression.fp16) as tape:
+            looked_up = tf.nn.embedding_lookup(emb, tf.constant([1, 3]))
+            loss = tf.reduce_sum(looked_up)
+        (g,) = tape.gradient(loss, [emb])
+        assert not isinstance(g, tf.IndexedSlices)
+        assert g.shape == (10, 4)
+        # without the opt-in, a clear error
+        with hvd_tf.DistributedGradientTape(tf.GradientTape()) as tape:
+            loss = tf.reduce_sum(tf.nn.embedding_lookup(
+                emb, tf.constant([0])))
+        with pytest.raises(ValueError, match="sparse_as_dense"):
+            tape.gradient(loss, [emb])
+
+    def test_backward_passes_per_step_eager(self):
+        import keras
+        import horovod_tpu.keras as hvd_keras
+        v = tf.Variable(0.0)
+        opt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(1.0), backward_passes_per_step=2)
+        opt.apply_gradients([(tf.constant(1.0), v)])
+        np.testing.assert_allclose(float(v.numpy()), 0.0)  # accumulating
+        opt.apply_gradients([(tf.constant(3.0), v)])
+        np.testing.assert_allclose(float(v.numpy()), -2.0)  # mean grad = 2
+
+    def test_broadcast_callback_includes_nontrainable(self):
+        import keras
+        import horovod_tpu.keras as hvd_keras
+        model = keras.Sequential([
+            keras.layers.Input((4,)),
+            keras.layers.BatchNormalization(),
+            keras.layers.Dense(1)])
+        model.compile(optimizer=keras.optimizers.SGD(0.1), loss="mse")
+        cb = hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0)
+        cb.set_model(model)
+        nontrainable_before = [w.numpy().copy()
+                               for w in model.non_trainable_weights]
+        cb.on_batch_begin(0)
+        assert cb.broadcast_done
+        for w, before in zip(model.non_trainable_weights,
+                             nontrainable_before):
+            np.testing.assert_allclose(w.numpy(), before, rtol=1e-6)
+
     def test_load_model_wraps_optimizer(self, tmp_path):
         import keras
         import horovod_tpu.keras as hvd_keras

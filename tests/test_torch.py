@@ -64,6 +64,22 @@ class TestTorchCollectives:
         assert hvd_torch.poll(h)
         torch.testing.assert_close(out, x * N, rtol=1e-5, atol=1e-5)
 
+    def test_allreduce_async_inplace(self, rng):
+        """Regression: __slots__ made the in-place async handles crash."""
+        x = torch.from_numpy(rng.standard_normal(8).astype(np.float32))
+        orig = x.clone()
+        h = hvd_torch.allreduce_async_(x, op=hvd_torch.Sum)
+        out = h.synchronize()
+        assert out is x
+        torch.testing.assert_close(x, orig * N, rtol=1e-5, atol=1e-5)
+
+    def test_broadcast_async_inplace(self, rng):
+        x = torch.from_numpy(rng.standard_normal(4).astype(np.float32))
+        orig = x.clone()
+        h = hvd_torch.broadcast_async_(x, root_rank=0)
+        assert h.synchronize() is x
+        torch.testing.assert_close(x, orig, rtol=1e-6, atol=1e-6)
+
     def test_grouped_allreduce(self, rng):
         xs = [torch.from_numpy(rng.standard_normal(s).astype(np.float32))
               for s in [(3,), (2, 2), (5,)]]
@@ -228,6 +244,22 @@ class TestTorchOptimizer:
         ref.load_state_dict(
             {k: v for k, v in model.state_dict().items()})
         assert g is not None
+
+    def test_wrapping_preserves_optimizer_state(self):
+        """Regression: wrapping a checkpointed optimizer must keep its
+        momentum/Adam buffers."""
+        model = torch.nn.Linear(4, 2)
+        opt = torch.optim.SGD(model.parameters(), lr=0.1, momentum=0.9)
+        model(torch.randn(3, 4)).sum().backward()
+        opt.step()
+        assert len(opt.state) > 0
+        before = {p: s["momentum_buffer"].clone()
+                  for p, s in opt.state.items()}
+        dist = hvd_torch.DistributedOptimizer(
+            opt, named_parameters=model.named_parameters())
+        assert len(dist.state) == len(before)
+        for p, buf in before.items():
+            torch.testing.assert_close(dist.state[p]["momentum_buffer"], buf)
 
     def test_isinstance_preserved(self):
         _, opt = self._train_setup()
