@@ -79,7 +79,9 @@ class DataDispatcher:
 
     @property
     def config(self):
-        return ComputeServiceConfig(kv_addr="localhost",
+        # Must be reachable from side-car workers on OTHER hosts — the whole
+        # point of the service — so never "localhost".
+        return ComputeServiceConfig(kv_addr=socket.gethostname(),
                                     kv_port=self._port,
                                     num_workers=self.num_workers)
 
@@ -176,25 +178,42 @@ class ComputeServiceDataLoader:
                                         timeout=self.connect_timeout)
         q = queue.Queue(maxsize=self.queue_size)
         _END = object()
+        abandoned = threading.Event()
 
         def reader():
             try:
                 buf = sock.makefile("rb")
-                while True:
+                while not abandoned.is_set():
                     header = buf.read(8)
                     if len(header) < 8:
                         break
                     (n,) = struct.unpack(">Q", header)
                     if n == 0:
                         break
-                    q.put(pickle.loads(buf.read(n)))
+                    item = pickle.loads(buf.read(n))
+                    # Bounded put that aborts if the consumer walked away —
+                    # otherwise an early `break` in the training loop leaks
+                    # this thread and the socket forever.
+                    while not abandoned.is_set():
+                        try:
+                            q.put(item, timeout=0.5)
+                            break
+                        except queue.Full:
+                            continue
             finally:
-                q.put(_END)
+                try:
+                    q.put_nowait(_END)
+                except queue.Full:
+                    pass
                 sock.close()
 
         threading.Thread(target=reader, daemon=True).start()
-        while True:
-            item = q.get()
-            if item is _END:
-                return
-            yield item
+        try:
+            while True:
+                item = q.get()
+                if item is _END:
+                    return
+                yield item
+        finally:
+            # Runs on exhaustion AND on generator close (early break/del).
+            abandoned.set()

@@ -1,3 +1,7 @@
 from horovod_tpu.elastic.state import (  # noqa: F401
     State, ObjectState, TpuState, run,
 )
+from horovod_tpu.elastic.worker import (  # noqa: F401
+    HostUpdateListener, attach_listener, mark_new_rank_ready,
+    read_new_rank_ready,
+)

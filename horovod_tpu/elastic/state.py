@@ -154,11 +154,19 @@ def run(func):
     """
 
     def wrapper(state, *args, **kwargs):
+        from horovod_tpu.elastic.worker import (mark_new_rank_ready,
+                                                read_new_rank_ready)
         reset_required = False
         skip_sync = False
         while True:
             if reset_required:
                 _reset(state)
+            # Fork-parity scale-up barrier: announce this worker and wait
+            # until the whole membership is up before the state broadcast
+            # (reference: horovod_mark_new_rank_ready handshake,
+            # operations.cc:1264-1305). No-op outside elastic launches.
+            mark_new_rank_ready()
+            read_new_rank_ready()
             if not skip_sync:
                 state.sync()
             skip_sync = False

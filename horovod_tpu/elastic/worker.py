@@ -31,6 +31,61 @@ class HostUpdateListener:
         self._seen = self._current()
 
 
+def _kv_client():
+    addr = os.environ.get("HOROVOD_KV_ADDR")
+    port = os.environ.get("HOROVOD_KV_PORT")
+    if not (addr and port):
+        return None
+    return KVStoreClient(addr, int(port))
+
+
+def mark_new_rank_ready():
+    """Signal that this (possibly newly added) worker is up and initialized
+    for the current membership version.
+
+    Reference: the fork's ``horovod_mark_new_rank_ready`` C API
+    (operations.cc:1264-1305) — a newly spawned rank marks itself ready so
+    existing ranks don't start collectives that include it prematurely. Here
+    the mark is a KV write keyed by (membership version, host rank).
+    No-op outside an elastic launch.
+    """
+    client = _kv_client()
+    if client is None or not os.environ.get("HOROVOD_ELASTIC"):
+        return
+    version = (client.get("elastic", "version") or b"0").decode()
+    cross_rank = os.environ.get("HOROVOD_CROSS_RANK", "0")
+    client.put(f"new_rank_ready/{version}", cross_rank, b"1")
+
+
+def read_new_rank_ready(timeout=600):
+    """Block until every worker of the current membership version has marked
+    itself ready; returns True when the world is complete.
+
+    Reference: the fork's ``horovod_read_new_rank_ready`` +
+    ``ProcessSetTable::CheckNewRankReady`` (process_set.h:142-145,
+    operations.cc:780-786). Returns immediately outside an elastic launch.
+    """
+    client = _kv_client()
+    if client is None or not os.environ.get("HOROVOD_ELASTIC"):
+        return True
+    version = (client.get("elastic", "version") or b"0").decode()
+    nhosts = int(client.get("elastic", "nhosts") or
+                 os.environ.get("HOROVOD_CROSS_SIZE", "1"))
+    import time
+    deadline = time.time() + timeout
+    seen = set()  # ready marks are monotonic: never re-poll a seen rank
+    while time.time() < deadline:
+        for i in range(nhosts):
+            if i not in seen and client.get(
+                    f"new_rank_ready/{version}", str(i)) is not None:
+                seen.add(i)
+        if len(seen) >= nhosts:
+            return True
+        time.sleep(0.1)
+    raise TimeoutError(
+        f"only part of membership v{version} marked ready within {timeout}s")
+
+
 def attach_listener(state):
     """Attach a KV listener to an elastic State when launched by hvdrun
     (no-op outside an elastic launch)."""

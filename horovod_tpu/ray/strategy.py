@@ -14,13 +14,16 @@ def ray_available():
 
 def placement_bundles(num_hosts=None, num_workers_per_host=None,
                       num_workers=None, cpus_per_worker=1,
-                      tpus_per_worker=0, colocate=True):
+                      tpus_per_worker=0):
     """Placement-group bundles: one bundle per *worker process* (= per host
     in the TPU model, each owning its chips).
 
     Two API shapes, matching the reference (runner.py:168): explicit
-    ``num_hosts × num_workers_per_host`` (equal spread enforced via STRICT_SPREAD)
-    or flat ``num_workers`` (PACK). Returns (bundles, strategy_string).
+    ``num_hosts × num_workers_per_host`` or flat ``num_workers``. Returns
+    (bundles, strategy_string). Both use STRICT_SPREAD: the env contract
+    gives every worker LOCAL_RANK=0 / sole ownership of its node's chips,
+    so colocating two workers on one node (the reference's PACK default,
+    valid for one-process-per-GPU) would double-grab devices here.
     """
     if (num_hosts is None) == (num_workers is None):
         raise ValueError(
@@ -33,8 +36,7 @@ def placement_bundles(num_hosts=None, num_workers_per_host=None,
         per_host = num_workers_per_host or 1
         bundle = {k: v * per_host for k, v in resources.items()}
         return [dict(bundle) for _ in range(num_hosts)], "STRICT_SPREAD"
-    strategy = "PACK" if colocate else "SPREAD"
-    return [dict(resources) for _ in range(num_workers)], strategy
+    return [dict(resources) for _ in range(num_workers)], "STRICT_SPREAD"
 
 
 def worker_env(cross_rank, cross_size, local_size, coordinator_addr,

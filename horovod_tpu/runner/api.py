@@ -1,21 +1,39 @@
-"""In-process ``run()`` API.
+"""In-process ``run()`` / ``run_elastic()`` APIs.
 
 Reference: horovod/runner/__init__.py:95-247 — ``horovod.run(func, np=…)``
 cloudpickles ``func`` and launches it on every rank, returning the per-rank
-results.
+results; with elastic args it routes through ``gloo_run_elastic``
+(runner/launch.py:689).
 
 TPU adaptation: with one process per host, ``func`` executes once per host;
-results are collected through the KV store and returned host-major. On a
-single host this degenerates to "init and call" with zero serialization.
+the pickled function ships through the launcher's KV store (no shared
+filesystem needed) and results are collected back through it, host-major. On
+a single host this degenerates to "init and call" with zero serialization.
 """
 
 import os
 import sys
-import tempfile
 
 import cloudpickle
 
 from horovod_tpu.runner import launch as launch_mod
+
+_TASK_CMD = [sys.executable, "-m", "horovod_tpu.runner.task", "kv:func/pickle"]
+
+
+def _harvester(out):
+    def harvest(kv):
+        # Workers PUT pickled results into the KV store keyed by
+        # cross_rank — reachable from remote hosts, unlike a local tmpdir
+        # (reference: run collects per-rank results, runner/__init__.py).
+        idx = 0
+        while True:
+            v = kv.get("results", str(idx))
+            if v is None:
+                break
+            out[idx] = cloudpickle.loads(v)
+            idx += 1
+    return harvest
 
 
 def run(func, args=(), kwargs=None, np=None, hosts=None, hostfile=None,
@@ -32,53 +50,35 @@ def run(func, args=(), kwargs=None, np=None, hosts=None, hostfile=None,
         hvd.init()
         return [func(*args, **kwargs)]
 
-    with tempfile.TemporaryDirectory(prefix="hvdtpu_run_") as tmp:
-        fn_path = os.path.join(tmp, "func.pkl")
-        with open(fn_path, "wb") as f:
-            cloudpickle.dump((func, args, kwargs), f)
+    payload = cloudpickle.dumps((func, args, kwargs))
+    argv = []
+    if np:
+        argv += ["-np", str(np)]
+    if hosts:
+        argv += ["-H", hosts]
+    if hostfile:
+        argv += ["--hostfile", hostfile]
+    if ssh_port:
+        argv += ["--ssh-port", str(ssh_port)]
+    if ssh_identity_file:
+        argv += ["--ssh-identity-file", ssh_identity_file]
+    if verbose:
+        argv += ["--verbose"]
+    argv += _TASK_CMD
 
-        argv = []
-        if np:
-            argv += ["-np", str(np)]
-        if hosts:
-            argv += ["-H", hosts]
-        if hostfile:
-            argv += ["--hostfile", hostfile]
-        if ssh_port:
-            argv += ["--ssh-port", str(ssh_port)]
-        if ssh_identity_file:
-            argv += ["--ssh-identity-file", ssh_identity_file]
-        if verbose:
-            argv += ["--verbose"]
-        argv += [sys.executable, "-m", "horovod_tpu.runner.task", fn_path]
-
-        parsed = launch_mod.parse_args(argv)
-        harvested = {}
-
-        def harvest(kv):
-            # Workers PUT pickled results into the KV store keyed by
-            # cross_rank — reachable from remote hosts, unlike a local
-            # tmpdir (reference: run collects per-rank results,
-            # runner/__init__.py).
-            idx = 0
-            while True:
-                v = kv.get("results", str(idx))
-                if v is None:
-                    break
-                harvested[idx] = cloudpickle.loads(v)
-                idx += 1
-
-        rc = launch_mod._run_static(parsed, harvest=harvest)
-        if rc != 0:
-            raise RuntimeError(f"hvdrun failed with exit code {rc}")
-        n_hosts = len(set(
-            s.hostname for s in _assignments_for(parsed)))
-        missing = [i for i in range(n_hosts) if i not in harvested]
-        if missing:
-            raise RuntimeError(
-                f"run() completed but results from host indices {missing} "
-                f"were not reported")
-        return [harvested[i] for i in range(n_hosts)]
+    parsed = launch_mod.parse_args(argv)
+    harvested = {}
+    rc = launch_mod._run_static(parsed, harvest=_harvester(harvested),
+                                kv_preload={("func", "pickle"): payload})
+    if rc != 0:
+        raise RuntimeError(f"hvdrun failed with exit code {rc}")
+    n_hosts = len(set(s.hostname for s in _assignments_for(parsed)))
+    missing = [i for i in range(n_hosts) if i not in harvested]
+    if missing:
+        raise RuntimeError(
+            f"run() completed but results from host indices {missing} "
+            f"were not reported")
+    return [harvested[i] for i in range(n_hosts)]
 
 
 def _assignments_for(parsed_args):
@@ -89,13 +89,44 @@ def _assignments_for(parsed_args):
 
 
 def run_elastic(func, args=(), kwargs=None, min_np=1, max_np=None,
-                host_discovery_script=None, reset_limit=None, verbose=False):
-    """Elastic variant (reference: horovod.run with elastic args +
-    gloo_run_elastic)."""
+                host_discovery_script=None, slots_per_host=None,
+                reset_limit=None, start_timeout=600, verbose=False):
+    """Elastic variant (reference: horovod.run with elastic args routing to
+    launch.py:689 ``_run_elastic`` → gloo_run_elastic).
+
+    ``func`` re-executes from scratch on every membership change (whole
+    process restart — the TPU equivalent of re-rendezvous; see
+    runner/elastic/driver.py); use ``horovod_tpu.elastic.TpuState`` +
+    durable checkpoints inside ``func`` to carry state across restarts.
+    Returns the per-host results of the final (surviving) assignment.
+    """
     kwargs = kwargs or {}
     if host_discovery_script is None:
-        # Single-host elastic degenerates to plain run
+        # Single-host elastic degenerates to plain run.
         return run(func, args, kwargs)
-    raise NotImplementedError(
-        "multi-host elastic run() API lands with the elastic driver CLI; "
-        "use `hvdrun --min-np/--max-np --host-discovery-script` meanwhile")
+
+    from horovod_tpu.runner.elastic.driver import run_elastic_driver
+
+    payload = cloudpickle.dumps((func, args, kwargs))
+    argv = ["--min-np", str(min_np)]
+    if max_np:
+        argv += ["--max-np", str(max_np)]
+    argv += ["--host-discovery-script", host_discovery_script]
+    if slots_per_host:
+        argv += ["--slots-per-host", str(slots_per_host)]
+    if reset_limit is not None:
+        argv += ["--reset-limit", str(reset_limit)]
+    argv += ["--start-timeout", str(start_timeout)]
+    if verbose:
+        argv += ["--verbose"]
+    argv += _TASK_CMD
+
+    parsed = launch_mod.parse_args(argv)
+    harvested = {}
+    rc = run_elastic_driver(parsed, harvest=_harvester(harvested),
+                            kv_preload={("func", "pickle"): payload})
+    if rc != 0:
+        raise RuntimeError(f"elastic run failed with exit code {rc}")
+    if not harvested:
+        raise RuntimeError("elastic run completed but no results reported")
+    return [harvested[i] for i in sorted(harvested)]

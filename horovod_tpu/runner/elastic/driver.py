@@ -145,8 +145,12 @@ class ElasticDriver:
                 self.update_host_assignments(hosts)
 
 
-def run_elastic_driver(args):
-    """CLI glue for ``hvdrun --min-np … --host-discovery-script …``."""
+def run_elastic_driver(args, kv_preload=None, harvest=None):
+    """CLI glue for ``hvdrun --min-np … --host-discovery-script …``.
+
+    ``kv_preload`` seeds the KV store before workers start (e.g. the pickled
+    function for the ``run_elastic()`` API); ``harvest(kv)`` runs after a
+    successful job to collect worker-reported results."""
     import socket
 
     from horovod_tpu.runner.elastic.discovery import (FixedHosts,
@@ -166,6 +170,8 @@ def run_elastic_driver(args):
 
     kv = KVStoreServer()
     kv_port = kv.start()
+    for (scope, key), value in (kv_preload or {}).items():
+        kv.put(scope, key, value)
     coordinator_addr = socket.gethostname()
     state = {"workers": {}, "done": threading.Event(), "rc": 0,
              "version": 0, "lock": threading.Lock()}
@@ -179,9 +185,15 @@ def run_elastic_driver(args):
             state["workers"].clear()
         for w in old:
             w.terminate()
-        kv.put("elastic", "version", str(version).encode())
         coordinator_port = _free_port()
         by_host = host_assignment_by_host(assignment)
+        # Results from a superseded membership must not leak into the final
+        # harvest (they reflect a different world size / data sharding).
+        kv.delete("results")
+        # nhosts must land before the version bump: workers key their
+        # new-rank-ready barrier off the version they observe.
+        kv.put("elastic", "nhosts", str(len(by_host)).encode())
+        kv.put("elastic", "version", str(version).encode())
         for host, slots in by_host.items():
             env = build_worker_env({"HOROVOD_ELASTIC": "1"}, slots,
                                    coordinator_addr, coordinator_port,
@@ -224,6 +236,8 @@ def run_elastic_driver(args):
         driver.wait_for_available_slots(args.min_np or 1,
                                         timeout=args.start_timeout)
         state["done"].wait()
+        if state["rc"] == 0 and harvest is not None:
+            harvest(kv)
         return state["rc"]
     finally:
         driver.stop()

@@ -100,6 +100,13 @@ class KVStoreServer:
         with self._httpd.lock:
             self._httpd.store.setdefault(scope, {})[key] = value
 
+    def delete(self, scope, key=None):
+        with self._httpd.lock:
+            if key is None:
+                self._httpd.store.pop(scope, None)
+            else:
+                self._httpd.store.get(scope, {}).pop(key, None)
+
 
 class KVStoreClient:
     """reference: http_client.py read_data_from_kvstore/put_data_into_kvstore."""

@@ -233,9 +233,11 @@ def _run_static_mpi(args, launcher, extra_env=None):
         kv.stop()
 
 
-def _run_static(args, extra_env=None, harvest=None):
+def _run_static(args, extra_env=None, harvest=None, kv_preload=None):
     slot_infos, by_host, coordinator_addr, coordinator_port, kv, kv_port = \
         _start_rendezvous(args)
+    for (scope, key), value in (kv_preload or {}).items():
+        kv.put(scope, key, value)
 
     workers = []
     try:

@@ -10,10 +10,23 @@ import sys
 import cloudpickle
 
 
+def _load_payload(spec):
+    """``kv:scope/key`` fetches the pickled function from the launcher's KV
+    store (works without a shared filesystem — reference ships the pickle
+    over its driver/task socket RPC, runner/run_task.py); anything else is a
+    local path."""
+    if spec.startswith("kv:"):
+        from horovod_tpu.runner.http_kv import KVStoreClient
+        scope, key = spec[3:].split("/", 1)
+        client = KVStoreClient(os.environ["HOROVOD_KV_ADDR"],
+                               int(os.environ["HOROVOD_KV_PORT"]))
+        return client.wait_for(scope, key, timeout=60)
+    with open(spec, "rb") as f:
+        return f.read()
+
+
 def main():
-    fn_path = sys.argv[1]
-    with open(fn_path, "rb") as f:
-        func, args, kwargs = cloudpickle.load(f)
+    func, args, kwargs = cloudpickle.loads(_load_payload(sys.argv[1]))
 
     # Site hooks may force a platform via jax.config at interpreter start,
     # overriding JAX_PLATFORMS; re-assert the launcher's env choice.

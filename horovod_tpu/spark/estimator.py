@@ -58,13 +58,12 @@ class TpuEstimator:
         """DataFrame → Parquet in the store → numpy arrays (the reference
         writes Parquet for petastorm readers; we read it back with pyarrow —
         same durability contract, TPU-friendly dense batches)."""
-        import pandas as pd
-
         pdf = _to_pandas(df)
         path = self.store.get_train_data_path()
         self.store.make_dirs(os.path.dirname(path) or ".")
+        # Written for durability (resume / remote trainers); the in-memory
+        # frame is already the exact data, so no read-back round trip.
         pdf.to_parquet(path + ".parquet")
-        pdf = pd.read_parquet(path + ".parquet")
         X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
                       for c in self.feature_cols], axis=-1)
         if X.ndim > 2 and X.shape[-1] == 1:

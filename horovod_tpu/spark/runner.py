@@ -37,13 +37,6 @@ def run(fn, args=(), kwargs=None, num_proc=None, extra_env=None,
     num_proc = num_proc or max(sc.defaultParallelism, 1)
     kwargs = dict(kwargs or {})
 
-    # Phase 1: discover task placement (which executor host runs which
-    # partition) — the reference's task-service registration round.
-    placement = sc.parallelize(range(num_proc), num_proc) \
-        .mapPartitionsWithIndex(
-            lambda idx, _: [(idx, socket.gethostname())]).collect()
-    ranks = assign_ranks(placement)
-
     driver_addr = socket.gethostbyname(socket.gethostname())
     from horovod_tpu.runner.http_kv import KVStoreServer
     kv = KVStoreServer()
@@ -52,8 +45,21 @@ def run(fn, args=(), kwargs=None, num_proc=None, extra_env=None,
     payload = cloudpickle.dumps((fn, tuple(args), kwargs))
     base_env = dict(extra_env or {})
 
-    def _task(idx, _it):
-        info = ranks[idx]
+    def _task(_it):
+        # Placement discovery and execution MUST happen inside the same
+        # Spark job: scheduling a second job can place partitions on
+        # different hosts, leaving env ranks that contradict physical
+        # placement. Barrier mode runs all tasks concurrently (like the
+        # reference's long-running task services, spark/runner.py:49-130)
+        # and allGather gives every task the full (partition, host) map.
+        import json as _json
+        from pyspark import BarrierTaskContext
+        ctx = BarrierTaskContext.get()
+        idx = ctx.partitionId()
+        gathered = ctx.allGather(
+            _json.dumps([idx, socket.gethostname()]))
+        placement = [tuple(_json.loads(s)) for s in gathered]
+        info = assign_ranks(placement)[idx]
         env = dict(base_env)
         env.update({
             "HOROVOD_RANK": str(info["rank"]),
@@ -73,7 +79,7 @@ def run(fn, args=(), kwargs=None, num_proc=None, extra_env=None,
 
     try:
         results = sc.parallelize(range(num_proc), num_proc) \
-            .mapPartitionsWithIndex(_task).collect()
+            .barrier().mapPartitions(_task).collect()
     finally:
         kv.stop()
     return [r for _, r in sorted(results)]

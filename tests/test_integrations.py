@@ -20,7 +20,9 @@ class TestRayPlacement:
     def test_flat_workers(self):
         bundles, strategy = placement_bundles(num_workers=4,
                                               cpus_per_worker=2)
-        assert strategy == "PACK"
+        # One worker per node always: the env contract gives each worker
+        # LOCAL_RANK=0 / sole chip ownership, so PACK would double-grab.
+        assert strategy == "STRICT_SPREAD"
         assert bundles == [{"CPU": 2}] * 4
 
     def test_tpu_resources(self):

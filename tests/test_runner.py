@@ -138,6 +138,25 @@ class TestRunApi:
         results = run(fn, args=("ok",), hosts="localhost:1,127.0.0.1:1")
         assert results == [("ok", 0, 2), ("ok", 1, 2)]
 
+    def test_run_elastic_multihost(self, hvd, tmp_path):
+        """Multi-host elastic run(): a discovery script supplies the host
+        set; results are harvested from the final assignment (reference
+        tier-3: elastic_common.py launches real elastic jobs on
+        localhost)."""
+        from horovod_tpu.runner import run_elastic
+
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
+        script.chmod(0o755)
+
+        def fn(tag):
+            import horovod_tpu as h
+            return (tag, h.cross_rank(), h.process_count())
+
+        results = run_elastic(fn, args=("el",), min_np=2,
+                              host_discovery_script=str(script))
+        assert results == [("el", 0, 2), ("el", 1, 2)]
+
 
 class TestElasticDriver:
     """In-process simulation with synthetic host sets
@@ -249,6 +268,39 @@ class TestElasticState:
         s.commit()
         assert train(s) == 1  # restored to committed value
         assert calls["n"] == 2
+
+    def test_new_rank_ready_handshake(self, hvd, monkeypatch):
+        """Fork-parity scale-up barrier (reference:
+        horovod_mark_new_rank_ready / horovod_read_new_rank_ready,
+        operations.cc:1264-1305): readers block until every host of the
+        membership version has marked itself ready."""
+        import pytest
+        from horovod_tpu.elastic import (mark_new_rank_ready,
+                                         read_new_rank_ready)
+        from horovod_tpu.runner.http_kv import KVStoreServer
+
+        # Outside an elastic launch: trivially ready.
+        assert read_new_rank_ready() is True
+
+        srv = KVStoreServer()
+        port = srv.start()
+        try:
+            monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+            monkeypatch.setenv("HOROVOD_KV_ADDR", "localhost")
+            monkeypatch.setenv("HOROVOD_KV_PORT", str(port))
+            srv.put("elastic", "version", b"3")
+            srv.put("elastic", "nhosts", b"2")
+
+            monkeypatch.setenv("HOROVOD_CROSS_RANK", "0")
+            mark_new_rank_ready()
+            with pytest.raises(TimeoutError):
+                read_new_rank_ready(timeout=0.5)  # host 1 still missing
+
+            monkeypatch.setenv("HOROVOD_CROSS_RANK", "1")
+            mark_new_rank_ready()
+            assert read_new_rank_ready(timeout=5) is True
+        finally:
+            srv.stop()
 
 
 class TestHostDiscoveryScript:
