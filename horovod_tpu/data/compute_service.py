@@ -176,11 +176,27 @@ class ComputeServiceDataLoader:
         addr, port = self._endpoint()
         sock = socket.create_connection((addr, port),
                                         timeout=self.connect_timeout)
+        # The connect timeout must not govern reads: a dataset_fn may
+        # legitimately take longer than it between batches, and an inherited
+        # per-recv timeout would masquerade as end-of-stream.
+        sock.settimeout(None)
         q = queue.Queue(maxsize=self.queue_size)
         _END = object()
         abandoned = threading.Event()
 
+        def put_bounded(item):
+            # Bounded put that aborts if the consumer walked away —
+            # otherwise an early `break` in the training loop leaks
+            # this thread and the socket forever.
+            while not abandoned.is_set():
+                try:
+                    q.put(item, timeout=0.5)
+                    return
+                except queue.Full:
+                    continue
+
         def reader():
+            final = _END
             try:
                 buf = sock.makefile("rb")
                 while not abandoned.is_set():
@@ -190,21 +206,16 @@ class ComputeServiceDataLoader:
                     (n,) = struct.unpack(">Q", header)
                     if n == 0:
                         break
-                    item = pickle.loads(buf.read(n))
-                    # Bounded put that aborts if the consumer walked away —
-                    # otherwise an early `break` in the training loop leaks
-                    # this thread and the socket forever.
-                    while not abandoned.is_set():
-                        try:
-                            q.put(item, timeout=0.5)
-                            break
-                        except queue.Full:
-                            continue
+                    put_bounded(pickle.loads(buf.read(n)))
+            except Exception as e:  # noqa: BLE001
+                # A transport error is NOT end-of-stream: surface it so the
+                # training loop fails loudly instead of silently truncating
+                # the epoch. (If the consumer abandoned us, the shutdown()
+                # below caused this error — swallow it.)
+                if not abandoned.is_set():
+                    final = e
             finally:
-                try:
-                    q.put_nowait(_END)
-                except queue.Full:
-                    pass
+                put_bounded(final)
                 sock.close()
 
         threading.Thread(target=reader, daemon=True).start()
@@ -213,7 +224,18 @@ class ComputeServiceDataLoader:
                 item = q.get()
                 if item is _END:
                     return
+                if isinstance(item, Exception):
+                    raise RuntimeError(
+                        "compute-service stream failed mid-epoch") from item
                 yield item
         finally:
             # Runs on exhaustion AND on generator close (early break/del).
             abandoned.set()
+            # The reader may be parked inside buf.read() where the abandoned
+            # flag is never polled; shutdown() (unlike close()) reliably
+            # wakes a blocked recv, and leaves the fd for the reader alone
+            # to close — no fd-reuse race with other threads.
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass

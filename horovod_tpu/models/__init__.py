@@ -1,3 +1,6 @@
 from horovod_tpu.models.resnet import ResNet, ResNet18, ResNet34, ResNet50, ResNet101, ResNet152  # noqa: F401
 from horovod_tpu.models.bert import BertConfig, BertModel, BertForPreTraining  # noqa: F401
 from horovod_tpu.models.mlp import MLP  # noqa: F401
+from horovod_tpu.models.gpt import (  # noqa: F401
+    GPT, GPTConfig, GPTEmbed, GPTHead, GPTMoEBlock,
+)

@@ -1,0 +1,126 @@
+"""GPT-style decoder-only LM, built from the framework's parallel layers.
+
+The reference framework has no model zoo of its own (its examples/ tree is
+absent from the snapshot, SURVEY.md intro) — models here exist to exercise and
+benchmark the distributed machinery. This one is the composite-parallelism
+flagship: tensor-parallel attention/MLP blocks (parallel/tp.py), optional
+expert-parallel MoE FFN (parallel/moe.py), and a shape-invariant block design
+so the same blocks pipeline over a ``pp`` axis (parallel/pp.py).
+
+TPU-first choices: bf16 activations with fp32 params/logits, fused QKV, static
+causal mask, no data-dependent control flow.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from horovod_tpu.parallel.moe import MoEMlp
+from horovod_tpu.parallel.tp import TPTransformerBlock
+
+
+@dataclasses.dataclass(frozen=True)
+class GPTConfig:
+    vocab_size: int = 50304
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    max_position_embeddings: int = 1024
+    num_experts: int = 0            # 0 -> dense MLP blocks only
+    moe_k: int = 1
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.float32
+    tp_axis: Optional[str] = "tp"   # None -> no tensor parallelism
+    ep_axis: Optional[str] = "ep"   # axis carrying the experts (often = dp)
+
+    @staticmethod
+    def tiny(**kw):
+        """For tests / dry runs."""
+        base = dict(vocab_size=256, hidden_size=64, num_layers=4, num_heads=4,
+                    intermediate_size=128, max_position_embeddings=64)
+        base.update(kw)
+        return GPTConfig(**base)
+
+
+class GPTEmbed(nn.Module):
+    """Token + learned position embeddings (replicated params)."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, input_ids):
+        c = self.config
+        L = input_ids.shape[-1]
+        tok = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                       name="tok_emb")(input_ids)
+        pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                         (c.max_position_embeddings, c.hidden_size),
+                         jnp.float32)
+        return tok + jnp.asarray(pos[:L], c.dtype)[None]
+
+
+class GPTHead(nn.Module):
+    """Final LayerNorm + language-model head (fp32 logits)."""
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_f")(x)
+        return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")(x)
+
+
+class GPTMoEBlock(nn.Module):
+    """Pre-LN block: TP causal attention + expert-parallel MoE FFN.
+
+    Returns only the hidden state (shape-invariant, pipelineable); the MoE
+    load-balance loss is accumulated in the ``"losses"`` collection via
+    ``Module.sow`` so callers fetch it with ``mutable=["losses"]``.
+    """
+    config: GPTConfig
+
+    @nn.compact
+    def __call__(self, x):
+        from horovod_tpu.parallel.tp import TPSelfAttention
+        c = self.config
+        a = TPSelfAttention(c.num_heads, c.hidden_size, dtype=c.dtype,
+                            axis_name=c.tp_axis, causal=True,
+                            name="attention")(
+                                nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x))
+        x = x + a
+        h, aux = MoEMlp(c.num_experts, c.hidden_size, c.intermediate_size,
+                        k=c.moe_k, capacity_factor=c.capacity_factor,
+                        dtype=c.dtype, axis_name=c.ep_axis, name="moe")(
+                            nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x))
+        self.sow("losses", "moe_aux", aux)
+        return x + h
+
+
+class GPT(nn.Module):
+    """Full (non-pipelined) model: embed -> blocks -> head.
+
+    Blocks are dense TP blocks, with MoE blocks interleaved every
+    ``moe_every``-th layer when ``config.num_experts > 0``. For pipeline
+    parallelism, compose :class:`GPTEmbed` / block modules / :class:`GPTHead`
+    yourself via :func:`horovod_tpu.parallel.pp.pipeline` (see
+    ``parallel/composite.py``).
+    """
+    config: GPTConfig
+    moe_every: int = 2
+
+    @nn.compact
+    def __call__(self, input_ids):
+        c = self.config
+        x = GPTEmbed(c, name="embed")(input_ids)
+        for i in range(c.num_layers):
+            if c.num_experts and i % self.moe_every == self.moe_every - 1:
+                x = GPTMoEBlock(c, name=f"layer_{i}")(x)
+            else:
+                x = TPTransformerBlock(
+                    c.num_heads, c.hidden_size, c.intermediate_size,
+                    dtype=c.dtype, axis_name=c.tp_axis, causal=True,
+                    name=f"layer_{i}")(x)
+        return GPTHead(c, name="head")(x)

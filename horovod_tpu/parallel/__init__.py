@@ -7,3 +7,14 @@ from horovod_tpu.parallel.strategies import (  # noqa: F401
 from horovod_tpu.parallel.sequence import (  # noqa: F401
     local_attention, ring_attention, ulysses_attention,
 )
+from horovod_tpu.parallel.tp import (  # noqa: F401
+    ColumnParallelDense, RowParallelDense, TPMlp, TPSelfAttention,
+    TPTransformerBlock,
+)
+from horovod_tpu.parallel.pp import (  # noqa: F401
+    pipeline, split_microbatches, stack_stage_params,
+)
+from horovod_tpu.parallel.moe import MoEMlp  # noqa: F401
+from horovod_tpu.parallel.composite import (  # noqa: F401
+    CompositeGPT, build_mesh3d,
+)

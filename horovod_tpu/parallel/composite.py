@@ -1,0 +1,255 @@
+"""Composite parallelism: dp x pp x tp (with ep riding dp) in ONE XLA program.
+
+The reference scales one way — data parallelism over whole-replica gradients
+(SURVEY.md §2.6). This module is the TPU-native generalization: a 3-D device
+mesh ``(dp, pp, tp)`` where
+
+- **dp** carries the batch; replicated-parameter gradients are reduced over
+  it automatically by AD (see below),
+- **pp** carries pipeline stages (parallel/pp.py ppermute schedule),
+- **tp** carries Megatron-sharded attention/MLP weights (parallel/tp.py),
+- **ep** rides the dp axis: MoE expert weights are sharded over dp and
+  dispatched with all_to_all (parallel/moe.py), DeepSpeed-MoE style.
+
+Gradient semantics come from ``shard_map``'s varying-manual-axes (VMA) type
+system rather than hand-written reductions: parameters enter typed by their
+PartitionSpec (replicated leaves axis-invariant, sharded leaves varying), and
+the transpose of the implicit invariant->varying promotions inserts exactly
+the reductions Megatron/DeepSpeed hand-code — psum over dp for replicated
+weights, psum over tp for LayerNorms feeding sharded matmuls, *no* reduction
+for tp-sharded or expert weights. The collectives the reference implements as
+NCCL calls (reference: horovod/common/ops/nccl_operations.cc) appear here as
+AD-inserted XLA collectives scheduled on the ICI torus.
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.parallel.moe import MoEMlp
+from horovod_tpu.parallel.pp import pipeline
+from horovod_tpu.parallel.tp import TPTransformerBlock
+
+DP_AXIS, PPL_AXIS, TP_AXIS = "dp", "pp", "tp"
+
+
+def build_mesh3d(dp: int, pp: int, tp: int, devices=None) -> Mesh:
+    """A (dp, pp, tp) mesh. Axis order puts tp innermost so tensor-parallel
+    psums ride the fastest ICI links, pipeline hops the next, and dp (which
+    communicates least often per step) the outermost — the standard layout
+    recommendation for TPU pods."""
+    if devices is None:
+        devices = jax.devices()
+    n = dp * pp * tp
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n], dtype=object).reshape(dp, pp, tp)
+    return Mesh(arr, (DP_AXIS, PPL_AXIS, TP_AXIS))
+
+
+def _spec_axes(spec):
+    axes = []
+    for part in spec:
+        if part is None:
+            continue
+        axes.extend(part if isinstance(part, tuple) else (part,))
+    return axes
+
+
+def _pvary_to(tree, specs):
+    """Promote each leaf to varying over exactly the axes its spec mentions
+    (so values produced inside shard_map type-check against out_specs: e.g.
+    LayerNorm ones-init is constant — invariant — but lives in the
+    pp-stacked tree, so it must be pvaried over pp)."""
+
+    def f(x, spec):
+        vma = getattr(jax.typeof(x), "vma", ())
+        for a in _spec_axes(spec):
+            if a not in vma:
+                x = lax.pcast(x, a, to="varying")
+        return x
+
+    return jax.tree_util.tree_map(f, tree, specs)
+
+
+def _stage_leaf_spec(path_str: str) -> P:
+    """PartitionSpec for one pp-stacked TPTransformerBlock leaf (leading dim
+    is the stacked-layer dim -> 'pp'; tp placement per Megatron layout)."""
+    if path_str.endswith("shard/kernel"):
+        if "qkv" in path_str or "/in/" in path_str:
+            return P(PPL_AXIS, None, TP_AXIS)      # column-parallel
+        return P(PPL_AXIS, TP_AXIS, None)          # row-parallel
+    if path_str.endswith("shard/bias"):
+        return P(PPL_AXIS, TP_AXIS)                # column-parallel bias
+    return P(PPL_AXIS)                             # LN / row bias: replicated
+
+
+def _path_str(path) -> str:
+    return "/".join(getattr(k, "key", str(k)) for k in path)
+
+
+@dataclasses.dataclass
+class CompositeGPT:
+    """A pipelined, tensor-parallel, (optionally) MoE GPT training setup.
+
+    Architecture: embed -> shared MoE FFN (residual, experts over dp) ->
+    pipeline of TP transformer blocks over pp -> head. Use
+    :meth:`init` then :meth:`make_train_step`; the returned step maps
+    ``(params, opt_state, ids) -> (params, opt_state, loss)`` with ``ids``
+    sharded over dp and all shardings as in :meth:`param_specs`.
+    """
+    config: Any                     # a horovod_tpu.models.gpt.GPTConfig
+    mesh: Mesh
+    optimizer: Any
+    n_micro: int = 4
+    aux_weight: float = 0.01
+
+    def __post_init__(self):
+        # Imported here: models.gpt uses parallel.tp/moe, so a module-level
+        # import would be circular through the package __init__.
+        from horovod_tpu.models.gpt import GPTEmbed, GPTHead
+        c = self.config
+        for ax in (DP_AXIS, PPL_AXIS, TP_AXIS):
+            if ax not in self.mesh.shape:
+                raise ValueError(f"mesh must have axis {ax!r}")
+        self.pp = self.mesh.shape[PPL_AXIS]
+        if c.num_layers % self.pp != 0:
+            raise ValueError(
+                f"{c.num_layers} layers not divisible by pp={self.pp}")
+        self.layers_per_stage = c.num_layers // self.pp
+        self.embed = GPTEmbed(c)
+        self.head = GPTHead(c)
+        self.block = TPTransformerBlock(
+            c.num_heads, c.hidden_size, c.intermediate_size, dtype=c.dtype,
+            axis_name=TP_AXIS, causal=True)
+        self.moe = None
+        if c.num_experts:
+            self.moe = MoEMlp(c.num_experts, c.hidden_size,
+                              c.intermediate_size, k=c.moe_k,
+                              capacity_factor=c.capacity_factor,
+                              dtype=c.dtype, axis_name=DP_AXIS)
+
+    # ---- shardings ----
+
+    def param_specs(self, params_shape):
+        """Spec tree matching the params pytree (by key path)."""
+
+        def spec(path, _leaf):
+            s = _path_str(path)
+            if s.startswith("stages/"):
+                return _stage_leaf_spec(s)
+            if s.startswith("moe/") and ("w_in" in s or "w_out" in s):
+                return P(DP_AXIS)                  # experts sharded over dp
+            return P()                             # replicated
+
+        return jax.tree_util.tree_map_with_path(spec, params_shape)
+
+    # ---- init ----
+
+    def _init_local(self, rng, ids):
+        """Runs inside shard_map: build this rank's local parameter shards."""
+        stage = lax.axis_index(PPL_AXIS)
+        p_embed = self.embed.init(jax.random.fold_in(rng, 0), ids)["params"]
+        x = self.embed.apply({"params": p_embed}, ids)
+        params = {"embed": p_embed}
+        if self.moe is not None:
+            params["moe"] = self.moe.init(
+                jax.random.fold_in(rng, 1), x)["params"]
+        rng_blocks = jax.random.fold_in(rng, 2)
+        per_layer = [
+            self.block.init(
+                jax.random.fold_in(rng_blocks,
+                                   stage * self.layers_per_stage + i),
+                x)["params"]
+            for i in range(self.layers_per_stage)
+        ]
+        params["stages"] = jax.tree_util.tree_map(
+            lambda *ls: jnp.stack(ls), *per_layer)
+        params["head"] = self.head.init(jax.random.fold_in(rng, 3),
+                                        x)["params"]
+        return params
+
+    def init(self, rng, sample_ids):
+        """Initialize sharded params + optimizer state on the mesh.
+
+        ``sample_ids``: a (global_batch, seq_len) int32 array (contents
+        irrelevant); returns ``(params, opt_state, specs)`` where ``specs``
+        is ``(param_specs, opt_specs)``.
+        """
+        ids_spec = P(DP_AXIS)
+
+        # Structure-only pass (specs are keyed by tree paths, not shapes);
+        # check_vma off since the throwaway out_specs are all-replicated.
+        shapes = jax.eval_shape(
+            jax.shard_map(self._init_local, mesh=self.mesh,
+                          in_specs=(P(), ids_spec), out_specs=P(),
+                          check_vma=False),
+            rng, sample_ids)
+        param_specs = self.param_specs(shapes)
+
+        params = jax.jit(jax.shard_map(
+            lambda r, i: _pvary_to(self._init_local(r, i), param_specs),
+            mesh=self.mesh, in_specs=(P(), ids_spec),
+            out_specs=param_specs))(rng, sample_ids)
+
+        opt_shape = jax.eval_shape(self.optimizer.init, params)
+        opt_specs = optax.tree_map_params(
+            self.optimizer, lambda _, s: s, opt_shape, param_specs,
+            transform_non_params=lambda _: P())
+        opt_state = jax.jit(jax.shard_map(
+            lambda p: _pvary_to(self.optimizer.init(p), opt_specs),
+            mesh=self.mesh, in_specs=(param_specs,), out_specs=opt_specs))(
+                params)
+        return params, opt_state, (param_specs, opt_specs)
+
+    # ---- training ----
+
+    def _loss_local(self, params, ids):
+        c = self.config
+        x = self.embed.apply({"params": params["embed"]}, ids)
+        aux = jnp.zeros((), jnp.float32)
+        if self.moe is not None:
+            h, aux = self.moe.apply({"params": params["moe"]}, x)
+            x = x + h
+        B, L = ids.shape
+        if B % self.n_micro != 0:
+            raise ValueError(
+                f"local batch {B} not divisible by n_micro={self.n_micro}")
+        mbs = x.reshape(self.n_micro, B // self.n_micro, L, c.hidden_size)
+
+        def layer_fn(p, h):
+            return self.block.apply({"params": p}, h)
+
+        y = pipeline(layer_fn, params["stages"], mbs, PPL_AXIS)
+        y = y.reshape(B, L, c.hidden_size)
+        logits = self.head.apply({"params": params["head"]}, y)
+        loss = optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], ids[:, 1:]).mean()
+        loss = loss + self.aux_weight * aux
+        # Mean over the data-parallel axis; AD's transpose of this pmean +
+        # the invariant->varying promotions yields the dp gradient allreduce.
+        return lax.pmean(loss, DP_AXIS)
+
+    def make_train_step(self, specs, donate=True):
+        param_specs, opt_specs = specs
+
+        def step(params, opt_state, ids):
+            loss, grads = jax.value_and_grad(self._loss_local)(params, ids)
+            updates, opt_state = self.optimizer.update(grads, opt_state,
+                                                       params)
+            params = optax.apply_updates(params, updates)
+            return params, opt_state, loss
+
+        sharded = jax.shard_map(
+            step, mesh=self.mesh,
+            in_specs=(param_specs, opt_specs, P(DP_AXIS)),
+            out_specs=(param_specs, opt_specs, P()))
+        return jax.jit(sharded,
+                       donate_argnums=(0, 1) if donate else ())

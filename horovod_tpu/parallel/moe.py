@@ -1,0 +1,149 @@
+"""Expert parallelism: switch-style Mixture-of-Experts with AllToAll dispatch.
+
+The reference exposes AllToAll with negotiated uneven splits as a raw
+primitive (reference: horovod/common/operations.cc:1930 EnqueueTensorAlltoall,
+collective_operations.h:199-268) — the exact communication pattern MoE
+dispatch needs — but ships no MoE layer (SURVEY.md §2.6: EP absent as a
+strategy). This module builds the strategy TPU-first:
+
+- **Static shapes**: capacity-based dispatch (Switch Transformer style).
+  Every expert receives exactly ``capacity`` token slots per source shard;
+  overflow tokens are dropped (their residual path passes through). No
+  dynamic shapes, so the whole layer jits into one XLA program and the
+  dispatch einsums run on the MXU.
+- **EP over a mesh axis**: experts are sharded across ``ep``; two
+  ``lax.all_to_all``s over ICI move token slots to their expert's shard and
+  back — the MoE realization of the reference's alltoall primitive.
+- **Router**: top-1 (switch) or top-2 gating with the standard
+  load-balancing auxiliary loss (fraction-of-tokens x mean-probability).
+
+Call (and init) inside ``shard_map`` with the ``ep`` axis bound; outside an
+axis context the layer degrades to ep=1 (all experts local), which is the
+correctness oracle used in tests.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.parallel.tp import axis_size_or_1, shard_init
+
+EP_AXIS = "ep"
+
+
+def _router(x, probs, k: int, capacity: int):
+    """Compute dispatch/combine tensors for top-k capacity routing.
+
+    Args:
+      x: (T, d) local tokens.  probs: (T, E) router probabilities.
+    Returns:
+      dispatch (T, E, C) one-hot, combine (T, E, C) gated weights, aux loss.
+    """
+    T, E = probs.shape
+    gate_vals, expert_idx = lax.top_k(probs, k)           # (T, k)
+    # Renormalize the selected gates so they sum to 1 per token (top-2 case).
+    gate_vals = gate_vals / jnp.maximum(
+        jnp.sum(gate_vals, -1, keepdims=True), 1e-9)
+
+    dispatch = jnp.zeros((T, E, capacity), probs.dtype)
+    combine = jnp.zeros((T, E, capacity), probs.dtype)
+    # Process the k choices in priority order; capacity positions are
+    # assigned first-come-first-served in token order per expert.
+    used = jnp.zeros((E,), jnp.int32)
+    for j in range(k):
+        e = expert_idx[:, j]                               # (T,)
+        onehot = jax.nn.one_hot(e, E, dtype=jnp.int32)     # (T, E)
+        # Position of each token within its expert's queue for this choice.
+        pos_in_e = jnp.cumsum(onehot, axis=0) - onehot + used[None, :]
+        pos = jnp.sum(pos_in_e * onehot, -1)               # (T,)
+        keep = pos < capacity
+        slot = jax.nn.one_hot(jnp.where(keep, pos, capacity), capacity,
+                              dtype=probs.dtype)           # (T, C), 0 if drop
+        d_j = jax.nn.one_hot(e, E, dtype=probs.dtype)[..., None] \
+            * slot[:, None, :]                             # (T, E, C)
+        dispatch = dispatch + d_j
+        combine = combine + d_j * gate_vals[:, j, None, None]
+        used = used + jnp.sum(onehot * keep[:, None].astype(jnp.int32), 0)
+
+    # Load-balancing loss (Switch Transformer eq. 4): E * sum_e f_e * P_e,
+    # computed on the top-1 assignment.
+    top1 = jax.nn.one_hot(expert_idx[:, 0], E, dtype=probs.dtype)
+    f = jnp.mean(top1, axis=0)
+    P = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(f * P)
+    return dispatch, combine, aux
+
+
+class MoEMlp(nn.Module):
+    """Expert-parallel MoE feed-forward layer (drop-in for a dense MLP).
+
+    ``num_experts`` is global; each ep shard owns ``num_experts / ep``
+    experts' weights. Returns ``(y, aux_loss)``.
+    """
+    num_experts: int
+    hidden_size: int
+    intermediate_size: int
+    k: int = 1
+    capacity_factor: float = 2.0
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = EP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        n = axis_size_or_1(self.axis_name)
+        E, d, f = self.num_experts, self.hidden_size, self.intermediate_size
+        if E % n != 0:
+            raise ValueError(f"num_experts {E} not divisible by ep={n}")
+        e_local = E // n
+        orig_shape = x.shape
+        xt = x.reshape(-1, d)                              # (T, d)
+        T = xt.shape[0]
+        capacity = max(1, int(self.capacity_factor * self.k * T / E))
+
+        # Router in fp32 for stable softmax.
+        logits = nn.Dense(E, use_bias=False, dtype=jnp.float32,
+                          name="router")(xt.astype(jnp.float32))
+        probs = jax.nn.softmax(logits, axis=-1)
+        dispatch, combine, aux = _router(xt, probs, self.k, capacity)
+
+        # (T, E, C) x (T, d) -> (E, C, d): expert-major token slots.
+        slots = jnp.einsum("tec,td->ecd", dispatch.astype(self.dtype),
+                           xt.astype(self.dtype))
+
+        if n > 1:
+            # Send each expert block to its owner shard; receive all source
+            # shards' slots for OUR local experts: (E, C, d) -> (e_local,
+            # n*C, d), source-major along the slot axis. Tiled all_to_all is
+            # a pure inter-device transpose — no reshapes, clean transpose
+            # rule for AD.
+            slots = lax.all_to_all(slots, self.axis_name, split_axis=0,
+                                   concat_axis=1, tiled=True)
+        else:
+            slots = slots.reshape(e_local, capacity, d)
+
+        # Each ep shard draws its own experts; the router above stays
+        # replicated (axis-invariant) under the same init rng.
+        w_in = self.param("w_in",
+                          shard_init(nn.initializers.lecun_normal(),
+                                     self.axis_name),
+                          (e_local, d, f), jnp.float32)
+        w_out = self.param("w_out",
+                           shard_init(nn.initializers.lecun_normal(),
+                                      self.axis_name),
+                           (e_local, f, d), jnp.float32)
+        h = jnp.einsum("ecd,edf->ecf", slots,
+                       jnp.asarray(w_in, self.dtype))
+        h = nn.gelu(h)
+        y = jnp.einsum("ecf,efd->ecd", h, jnp.asarray(w_out, self.dtype))
+
+        if n > 1:
+            # Inverse transpose: source-major slots go back to their source
+            # shard, restoring the expert-major (E, C, d) layout.
+            y = lax.all_to_all(y, self.axis_name, split_axis=1,
+                               concat_axis=0, tiled=True)
+
+        out = jnp.einsum("tec,ecd->td", combine.astype(self.dtype), y)
+        return out.reshape(orig_shape), aux

@@ -1,0 +1,117 @@
+"""Pipeline parallelism: GPipe-style microbatch schedule over a mesh axis.
+
+New capability relative to the reference (which is data-parallel only,
+SURVEY.md §2.6); built TPU-first: the stage-to-stage handoff is a
+``lax.ppermute`` hop to the ICI neighbour, the schedule is a ``lax.scan``
+with static trip count (so the whole pipeline is ONE compiled XLA program,
+reverse-mode differentiable — ppermute's transpose is the reverse ppermute),
+and per-stage compute is a ``lax.scan`` over that stage's stacked layer
+parameters.
+
+SPMD formulation: every rank runs the same program; rank p of the ``pp``
+axis holds the parameters of stage p (leaves stacked ``(layers_per_stage,
+...)``, the global array being ``(pp * layers_per_stage, ...)`` sharded on
+the leading dim). Microbatches are replicated over the pp axis; stage 0
+selects its scheduled microbatch by index, the last stage's outputs are
+broadcast back with one masked psum.
+"""
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+PP_AXIS = "pp"
+
+
+def stage_apply(layer_fn: Callable, stage_params, x):
+    """Apply this stage's stacked layers sequentially: ``layer_fn(p_i, x)``
+    scanned over the leading (layer) dim of ``stage_params``."""
+
+    def body(h, p):
+        return layer_fn(p, h), None
+
+    out, _ = lax.scan(body, x, stage_params)
+    return out
+
+
+def pipeline(layer_fn: Callable, stage_params, microbatches,
+             axis_name: str = PP_AXIS):
+    """Run ``microbatches`` through the pipeline; returns stacked outputs.
+
+    Args:
+      layer_fn: ``(layer_params, x) -> y`` for ONE layer (same pytree
+        structure per layer). Shapes of x and y must match (a transformer
+        block), since the inter-stage buffer is shape-invariant.
+      stage_params: this rank's stage parameters, leaves stacked
+        ``(layers_per_stage, ...)``.
+      microbatches: ``(n_micro, mb, ...)`` — identical (replicated) on every
+        pp rank.
+      axis_name: the pipeline mesh axis.
+
+    Returns:
+      ``(n_micro, mb, ...)`` outputs of the last stage, replicated on every
+      pp rank (one masked psum).
+
+    Schedule: tick t computes microbatch ``t - stage`` at ``stage`` (valid
+    when ``0 <= t - stage < n_micro``), then shifts activations one hop
+    forward; ``n_micro + n_stages - 1`` ticks drain the pipeline. Bubble
+    fraction is ``(S-1)/(T+S-1)`` — pick ``n_micro >= 4*S`` for real runs.
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    fwd = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    from horovod_tpu.ops.in_jit import mark_varying
+
+    state = mark_varying(jnp.zeros_like(microbatches[0]), axis_name)
+    outputs = mark_varying(jnp.zeros_like(microbatches), axis_name)
+
+    def tick(carry, t):
+        state, outputs = carry
+        mb_idx = t - stage
+        # Stage 0 ingests its scheduled microbatch; later stages consume the
+        # activation received on the previous hop.
+        x_in = jnp.where(stage == 0,
+                         microbatches[jnp.clip(mb_idx, 0, n_micro - 1)],
+                         state)
+        y = stage_apply(layer_fn, stage_params, x_in)
+        # The last stage retires microbatch mb_idx at this tick.
+        retire = (stage == n_stages - 1) & (mb_idx >= 0) & (mb_idx < n_micro)
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs, jnp.where(retire, y, outputs[jnp.clip(mb_idx, 0,
+                                                           n_micro - 1)]),
+            jnp.clip(mb_idx, 0, n_micro - 1), 0)
+        state = lax.ppermute(y, axis_name, fwd)
+        return (state, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (state, outputs),
+                               jnp.arange(n_micro + n_stages - 1))
+    # Broadcast the last stage's outputs to every rank.
+    return lax.psum(jnp.where(stage == n_stages - 1, outputs, 0.0), axis_name)
+
+
+def split_microbatches(batch, n_micro: int):
+    """``(B, ...) -> (n_micro, B / n_micro, ...)``."""
+
+    def split(x):
+        if x.shape[0] % n_micro != 0:
+            raise ValueError(
+                f"batch {x.shape[0]} not divisible by n_micro={n_micro}")
+        return x.reshape((n_micro, x.shape[0] // n_micro) + x.shape[1:])
+
+    return jax.tree_util.tree_map(split, batch)
+
+
+def stack_stage_params(per_layer_params, n_stages: int, axis_name=PP_AXIS):
+    """Host-side helper: stack a list of per-layer param pytrees into the
+    global ``(n_layers, ...)`` arrays to shard over the pp axis (spec
+    ``P('pp')`` on the leading dim)."""
+    n_layers = len(per_layer_params)
+    if n_layers % n_stages != 0:
+        raise ValueError(
+            f"{n_layers} layers not divisible by {n_stages} stages")
+    return jax.tree_util.tree_map(
+        lambda *leaves: jnp.stack(leaves), *per_layer_params)

@@ -1,0 +1,217 @@
+"""Tensor (model) parallelism: Megatron-style sharded transformer layers.
+
+The reference is data-parallel only (SURVEY.md §2.6: the only request types
+are whole-tensor collectives, message.h:61-70) — TP is *new* capability this
+framework adds, built from the same primitive the reference exposes as
+``allreduce`` (reference: horovod/common/operations.cc:1480
+EnqueueTensorAllreduces): a weight matrix is split across the ``tp`` mesh
+axis, each chip computes its shard's contribution on the MXU, and one
+``lax.psum`` over ICI restores the full activation.
+
+Layout follows the Megatron pairing so each attention/MLP block needs exactly
+ONE collective on the forward pass (and one on backward, psum's transpose):
+
+- **column-parallel** linear: weight split on the *output* dim; no comm in
+  forward (activations come out shard-local), gradient w.r.t. input is
+  reduced by AD's transpose of the downstream row-parallel psum.
+- **row-parallel** linear: weight split on the *input* dim, consuming the
+  column-parallel layer's sharded activations; one ``psum`` completes the
+  matmul. Bias is added *after* the psum so it is applied once.
+
+All modules are flax and size their parameters by the *local* shard: call
+(and init) them inside ``shard_map`` with the ``tp`` axis bound. Outside the
+axis context they degrade to the dense layer (tp=1), so the same module
+definition doubles as the single-chip reference.
+"""
+
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+TP_AXIS = "tp"
+
+
+def axis_size_or_1(axis_name) -> int:
+    """Size of ``axis_name`` when bound in the current trace, else 1."""
+    if axis_name is None:
+        return 1
+    try:
+        return lax.axis_size(axis_name)
+    except NameError:
+        return 1
+
+
+def tp_shard_rng(rng, axis_name=TP_AXIS):
+    """Fold the tp coordinate into an init rng so each shard draws distinct
+    weights (a sharded weight is one logical matrix, not n copies)."""
+    if axis_size_or_1(axis_name) == 1:
+        return rng
+    return jax.random.fold_in(rng, lax.axis_index(axis_name))
+
+
+def shard_init(base_init, axis_name):
+    """Wrap a flax initializer so each shard of a weight draws distinct
+    values from ONE logical rng (the shard coordinate is folded in here, not
+    by the caller). Keeping the fold inside the initializer lets a module mix
+    sharded weights with replicated ones (LayerNorm, biases) under a single
+    init rng — the replicated params stay axis-invariant, which the VMA
+    (varying-manual-axes) type system verifies under ``shard_map``."""
+
+    def init(rng, shape, dtype=jnp.float32):
+        if axis_size_or_1(axis_name) > 1:
+            rng = jax.random.fold_in(rng, lax.axis_index(axis_name))
+        return base_init(rng, shape, dtype)
+
+    return init
+
+
+class ColumnParallelDense(nn.Module):
+    """Linear layer with the weight split along the output dimension.
+
+    ``features`` is the GLOBAL output width; each tp shard holds
+    ``features / tp`` columns and produces the matching activation shard.
+    """
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        n = axis_size_or_1(self.axis_name)
+        if self.features % n != 0:
+            raise ValueError(
+                f"features {self.features} not divisible by tp={n}")
+        return nn.Dense(
+            self.features // n, use_bias=self.use_bias, dtype=self.dtype,
+            kernel_init=shard_init(nn.initializers.lecun_normal(),
+                                   self.axis_name),
+            bias_init=shard_init(nn.initializers.zeros, self.axis_name),
+            name="shard")(x)
+
+
+class RowParallelDense(nn.Module):
+    """Linear layer with the weight split along the input dimension.
+
+    Consumes activations sharded on the last dim (a column-parallel output);
+    the partial products are summed with one ``psum`` over the tp axis, then
+    the (replicated) bias is added once.
+    """
+    features: int
+    use_bias: bool = True
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        y = nn.Dense(
+            self.features, use_bias=False, dtype=self.dtype,
+            kernel_init=shard_init(nn.initializers.lecun_normal(),
+                                   self.axis_name),
+            name="shard")(x)
+        if axis_size_or_1(self.axis_name) > 1:
+            y = lax.psum(y, self.axis_name)
+        if self.use_bias:
+            bias = self.param("bias", nn.initializers.zeros,
+                              (self.features,), jnp.float32)
+            y = y + jnp.asarray(bias, self.dtype)
+        return y
+
+
+class TPSelfAttention(nn.Module):
+    """Multi-head attention with heads sharded over the tp axis.
+
+    Fused QKV projection is column-parallel (each shard owns
+    ``num_heads / tp`` heads — one large MXU matmul per shard), the output
+    projection is row-parallel: exactly one psum per attention block.
+    """
+    num_heads: int
+    hidden_size: int
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = TP_AXIS
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        n = axis_size_or_1(self.axis_name)
+        if self.num_heads % n != 0:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by tp={n}")
+        local_heads = self.num_heads // n
+        head_dim = self.hidden_size // self.num_heads
+
+        # Column-parallel fused QKV: shard s's local output is
+        # [q_s | k_s | v_s] for its heads [s*local_heads, (s+1)*local_heads),
+        # i.e. the global logical weight is the head-blocked interleaving of
+        # the shards — one large MXU matmul per shard.
+        qkv = ColumnParallelDense(3 * self.hidden_size, dtype=self.dtype,
+                                  axis_name=self.axis_name, name="qkv")(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (local_heads, head_dim))
+
+        q, k, v = heads(q), heads(k), heads(v)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+        if self.causal:
+            Lq, Lk = q.shape[1], k.shape[1]
+            cmask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
+            scores = jnp.where(cmask[None, None], scores,
+                               jnp.asarray(-1e9, scores.dtype))
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :], scores,
+                               jnp.asarray(-1e9, scores.dtype))
+        probs = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
+        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        out = out.reshape(out.shape[:-2] + (local_heads * head_dim,))
+        return RowParallelDense(self.hidden_size, dtype=self.dtype,
+                                axis_name=self.axis_name, name="out")(out)
+
+
+class TPMlp(nn.Module):
+    """Transformer MLP: column-parallel expansion, gelu, row-parallel
+    contraction — one psum per MLP block."""
+    intermediate_size: int
+    hidden_size: int
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = TP_AXIS
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(self.intermediate_size, dtype=self.dtype,
+                                axis_name=self.axis_name, name="in")(x)
+        h = nn.gelu(h)
+        return RowParallelDense(self.hidden_size, dtype=self.dtype,
+                                axis_name=self.axis_name, name="out")(h)
+
+
+class TPTransformerBlock(nn.Module):
+    """Pre-LN transformer block with TP attention + TP MLP (2 psums total).
+
+    LayerNorm parameters are replicated across tp; their gradients are made
+    consistent by the data-parallel gradient reduction exactly as in
+    Megatron.
+    """
+    num_heads: int
+    hidden_size: int
+    intermediate_size: int
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = TP_AXIS
+    causal: bool = False
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        a = TPSelfAttention(self.num_heads, self.hidden_size,
+                            dtype=self.dtype, axis_name=self.axis_name,
+                            causal=self.causal, name="attention")(
+                                nn.LayerNorm(dtype=self.dtype,
+                                             name="ln_attn")(x), mask)
+        x = x + a
+        h = TPMlp(self.intermediate_size, self.hidden_size, dtype=self.dtype,
+                  axis_name=self.axis_name, name="mlp")(
+                      nn.LayerNorm(dtype=self.dtype, name="ln_mlp")(x))
+        return x + h

@@ -123,10 +123,34 @@ def run_elastic(func, args=(), kwargs=None, min_np=1, max_np=None,
 
     parsed = launch_mod.parse_args(argv)
     harvested = {}
-    rc = run_elastic_driver(parsed, harvest=_harvester(harvested),
+    expected = {}
+
+    def harvest(kv):
+        # The elastic driver records the final world's host count under
+        # elastic/nhosts; use it so a gap in the results scope raises
+        # instead of silently truncating to the contiguous prefix.
+        raw = kv.get("elastic", "nhosts")
+        if raw is None:
+            _harvester(harvested)(kv)
+            return
+        expected["n"] = int(raw.decode()
+                            if isinstance(raw, bytes) else raw)
+        for i in range(expected["n"]):
+            v = kv.get("results", str(i))
+            if v is not None:
+                harvested[i] = cloudpickle.loads(v)
+
+    rc = run_elastic_driver(parsed, harvest=harvest,
                             kv_preload={("func", "pickle"): payload})
     if rc != 0:
         raise RuntimeError(f"elastic run failed with exit code {rc}")
-    if not harvested:
+    n = expected.get("n")
+    if n is not None:
+        missing = [i for i in range(n) if i not in harvested]
+        if missing:
+            raise RuntimeError(
+                f"elastic run completed but results from host indices "
+                f"{missing} were not reported")
+    elif not harvested:
         raise RuntimeError("elastic run completed but no results reported")
     return [harvested[i] for i in sorted(harvested)]

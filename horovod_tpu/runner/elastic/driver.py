@@ -183,6 +183,9 @@ def run_elastic_driver(args, kv_preload=None, harvest=None):
             state["version"] = version
             old = list(state["workers"].values())
             state["workers"].clear()
+        # terminate() blocks until each superseded worker is reaped, so no
+        # old process can write results/mark itself ready after the KV reset
+        # below.
         for w in old:
             w.terminate()
         coordinator_port = _free_port()
@@ -236,6 +239,10 @@ def run_elastic_driver(args, kv_preload=None, harvest=None):
         driver.wait_for_available_slots(args.min_np or 1,
                                         timeout=args.start_timeout)
         state["done"].wait()
+        # Halt discovery BEFORE harvesting: a membership change landing in
+        # this window would call spawn(), whose kv.delete("results") wipes
+        # the finished run's results mid-harvest.
+        driver.stop()
         if state["rc"] == 0 and harvest is not None:
             harvest(kv)
         return state["rc"]

@@ -56,12 +56,15 @@ class WorkerProcess:
         return rc
 
     def terminate(self):
+        """Stop the worker and reap it; returns only once the process is
+        gone, so callers may safely reset shared state afterwards."""
         if self.proc.poll() is None:
             self.proc.terminate()
             try:
                 self.proc.wait(5)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
+                self.proc.wait()
 
 
 def wait_for_any_failure_or_all_success(workers):
