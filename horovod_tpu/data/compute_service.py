@@ -202,7 +202,12 @@ class ComputeServiceDataLoader:
                 while not abandoned.is_set():
                     header = buf.read(8)
                     if len(header) < 8:
-                        break
+                        # The protocol ends with an explicit n==0 terminator;
+                        # a bare FIN / short header means the worker died
+                        # mid-stream — surface it, don't end the epoch.
+                        raise EOFError(
+                            "compute-service connection closed without "
+                            "end-of-stream sentinel")
                     (n,) = struct.unpack(">Q", header)
                     if n == 0:
                         break

@@ -35,6 +35,7 @@ class GPTConfig:
     dtype: Any = jnp.float32
     tp_axis: Optional[str] = "tp"   # None -> no tensor parallelism
     ep_axis: Optional[str] = "ep"   # axis carrying the experts (often = dp)
+    use_flash: bool = False         # Pallas flash attention (ops/pallas)
 
     @staticmethod
     def tiny(**kw):
@@ -88,7 +89,7 @@ class GPTMoEBlock(nn.Module):
         c = self.config
         a = TPSelfAttention(c.num_heads, c.hidden_size, dtype=c.dtype,
                             axis_name=c.tp_axis, causal=True,
-                            name="attention")(
+                            use_flash=c.use_flash, name="attention")(
                                 nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x))
         x = x + a
         h, aux = MoEMlp(c.num_experts, c.hidden_size, c.intermediate_size,
@@ -122,5 +123,5 @@ class GPT(nn.Module):
                 x = TPTransformerBlock(
                     c.num_heads, c.hidden_size, c.intermediate_size,
                     dtype=c.dtype, axis_name=c.tp_axis, causal=True,
-                    name=f"layer_{i}")(x)
+                    use_flash=c.use_flash, name=f"layer_{i}")(x)
         return GPTHead(c, name="head")(x)

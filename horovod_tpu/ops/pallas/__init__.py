@@ -1,0 +1,14 @@
+"""Pallas TPU kernels for the framework's hot ops.
+
+The reference keeps its device hot loops in hand-written CUDA
+(reference: horovod/common/ops/cuda/cuda_kernels.cu — batched fused memcpy
+and buffer-scale kernels; horovod/common/ops/adasum/adasum.h — AVX'd dot
+product/norm math). The TPU equivalents live here as Pallas kernels: they
+compile through Mosaic onto the MXU/VPU and run in interpret mode on CPU for
+tests.
+"""
+
+from horovod_tpu.ops.pallas.flash_attention import flash_attention  # noqa: F401
+from horovod_tpu.ops.pallas.kernels import (  # noqa: F401
+    adasum_combine_pallas, scale_buffer, scale_buffers,
+)

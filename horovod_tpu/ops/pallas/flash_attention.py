@@ -1,0 +1,199 @@
+"""Flash attention as a Pallas TPU kernel.
+
+The hot op of every transformer in the model zoo (models/bert.py,
+models/gpt.py, parallel/tp.py). Tiled online-softmax attention: for each
+query block the kernel streams key/value blocks through VMEM, keeping the
+running max/denominator in registers — O(L) memory instead of materializing
+the (L, L) score matrix, and every matmul lands on the MXU as a
+(block_q x D) @ (D x block_k) tile.
+
+The reference framework has no attention code (SURVEY.md §5.7 — Horovod
+operates below the model level); this kernel is part of the TPU build's
+model-level capability, in the spirit of the reference's hand-written CUDA
+hot loops (reference: horovod/common/ops/cuda/cuda_kernels.cu).
+
+Backward pass: custom VJP using the saved per-row logsumexp. The backward is
+currently a (blockwise-correct but unfused) jnp implementation that
+rematerializes scores — O(L^2) transient memory in the backward only; fuse it
+into a second kernel if profiles demand.
+
+On CPU (tests, no TPU) the kernel runs through the Pallas interpreter;
+shapes whose sequence length has no aligned block size fall back to plain
+attention.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+try:  # TPU-specific bits are absent on CPU-only installs
+    from jax.experimental.pallas import tpu as pltpu
+except ImportError:  # pragma: no cover
+    pltpu = None
+
+NEG_INF = -1e30  # finite big-negative: avoids inf-inf NaNs in the masking
+
+
+def _interpret():
+    return jax.default_backend() != "tpu"
+
+
+def _pick_block(length, cap=128):
+    for b in (cap, 64, 32, 16, 8):
+        if length % b == 0:
+            return b
+    return None
+
+
+def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, sm_scale, causal,
+               block_q, block_k, q_offset):
+    qi = pl.program_id(1)
+    q = q_ref[0].astype(jnp.float32) * sm_scale            # (BQ, D)
+    n_k = k_ref.shape[1] // block_k
+
+    m0 = jnp.full((block_q,), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q,), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    # End-aligned causal convention (tril with k = Lk - Lq), matching
+    # local_attention and the backward pass: query row i may attend keys
+    # <= i + (Lk - Lq). q_offset = Lk - Lq.
+    q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0)
+
+    def body(j, carry):
+        m, l, acc = carry
+        kb = k_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        vb = v_ref[0, pl.ds(j * block_k, block_k), :].astype(jnp.float32)
+        s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
+                                preferred_element_type=jnp.float32)
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
+        corr = jnp.exp(m - m_new)
+        p = jnp.exp(s - m_new[:, None])
+        # Rows where every score is masked would give exp(0)=1; zero them.
+        p = jnp.where(s > NEG_INF * 0.5, p, 0.0)
+        l_new = l * corr + jnp.sum(p, axis=-1)
+        acc_new = acc * corr[:, None] + jax.lax.dot_general(
+            p, vb, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Blocks entirely above the diagonal contribute nothing: bound the
+        # sweep at the last block overlapping this query block's rows.
+        n_k_eff = jnp.minimum(
+            n_k, pl.cdiv(q_offset + (qi + 1) * block_q, block_k))
+    else:
+        n_k_eff = n_k
+    m, l, acc = jax.lax.fori_loop(0, n_k_eff, body, (m0, l0, acc0))
+
+    l_safe = jnp.maximum(l, 1e-30)
+    o_ref[0] = (acc / l_safe[:, None]).astype(o_ref.dtype)
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k):
+    """(BH, Lq, D) x (BH, Lk, D)^2 -> (o, lse)."""
+    bh, lq, d = q.shape
+    lk = k.shape[1]
+    grid = (bh, lq // block_q)
+    kernel = functools.partial(_fa_kernel, sm_scale=sm_scale, causal=causal,
+                               block_q=block_q, block_k=block_k,
+                               q_offset=lk - lq)
+    # Inside a VMA-checked shard_map the outputs must declare how they vary
+    # over the mesh (they vary exactly like the operands).
+    vma = frozenset().union(*(getattr(jax.typeof(t), "vma", frozenset())
+                              for t in (q, k, v)))
+    return pl.pallas_call(
+        kernel,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, lk, d), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, block_q), lambda b, i: (b, i)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, lq, d), q.dtype, vma=vma),
+            jax.ShapeDtypeStruct((bh, lq), jnp.float32, vma=vma),
+        ],
+        interpret=_interpret(),
+    )(q, k, v)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k):
+    o, _ = _fa_forward(q, k, v, causal, sm_scale, block_q, block_k)
+    return o
+
+
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
+    o, lse = _fa_forward(q, k, v, causal, sm_scale, block_q, block_k)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+    q, k, v, o, lse = res
+    qf, kf, vf, of, dof = (t.astype(jnp.float32) for t in (q, k, v, o, do))
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * sm_scale
+    if causal:
+        lq, lk = s.shape[1], s.shape[2]
+        mask = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
+        s = jnp.where(mask[None], s, NEG_INF)
+    p = jnp.exp(s - lse[..., None])                       # uses saved lse
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    delta = jnp.sum(dof * of, axis=-1)                    # (BH, Lq)
+    ds = p * (dp - delta[..., None]) * sm_scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_flash.defvjp(_flash_fwd, _flash_bwd)
+
+
+def flash_attention(q, k, v, causal=False, sm_scale=None):
+    """Tiled attention over (B, L, H, D) tensors (the layout used throughout
+    this codebase, e.g. parallel/sequence.py).
+
+    Falls back to :func:`horovod_tpu.parallel.sequence.local_attention` (the
+    codebase's correctness oracle, same end-aligned causal convention) when
+    the sequence lengths admit no aligned block size; semantics are identical
+    either way.
+    """
+    b, lq, h, d = q.shape
+    lk = k.shape[1]
+    if sm_scale is None:
+        sm_scale = 1.0 / (d ** 0.5)
+
+    def to3(t):
+        return jnp.moveaxis(t, 2, 1).reshape(t.shape[0] * h, t.shape[1], d)
+
+    def from3(t):
+        return jnp.moveaxis(t.reshape(b, h, lq, d), 1, 2)
+
+    block_q = _pick_block(lq)
+    block_k = _pick_block(lk)
+    # Interpret mode (CPU tests) lowers the kernel body to ordinary JAX ops,
+    # whose internal dynamic_slices the shard_map VMA checker rejects when
+    # the operands are device-varying; the plain path is bit-compatible
+    # there. On TPU the compiled kernel is opaque to the checker.
+    vma = frozenset().union(*(getattr(jax.typeof(t), "vma", frozenset())
+                              for t in (q, k, v)))
+    if block_q is None or block_k is None or (_interpret() and vma):
+        from horovod_tpu.parallel.sequence import local_attention
+        # local_attention scales by 1/sqrt(D); fold any custom scale into q.
+        q_adj = q if sm_scale == 1.0 / (d ** 0.5) \
+            else q * (sm_scale * d ** 0.5)
+        return local_attention(q_adj, k, v, causal=causal)
+    return from3(_flash(to3(q), to3(k), to3(v), causal, sm_scale,
+                        block_q, block_k))

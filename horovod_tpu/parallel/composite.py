@@ -128,7 +128,8 @@ class CompositeGPT:
         self.head = GPTHead(c)
         self.block = TPTransformerBlock(
             c.num_heads, c.hidden_size, c.intermediate_size, dtype=c.dtype,
-            axis_name=TP_AXIS, causal=True)
+            axis_name=TP_AXIS, causal=True,
+            use_flash=getattr(c, "use_flash", False))
         self.moe = None
         if c.num_experts:
             self.moe = MoEMlp(c.num_experts, c.hidden_size,

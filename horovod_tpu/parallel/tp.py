@@ -134,6 +134,7 @@ class TPSelfAttention(nn.Module):
     dtype: Any = jnp.float32
     axis_name: Optional[str] = TP_AXIS
     causal: bool = False
+    use_flash: bool = False   # tiled Pallas attention (ops/pallas)
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -156,17 +157,21 @@ class TPSelfAttention(nn.Module):
             return t.reshape(t.shape[:-1] + (local_heads, head_dim))
 
         q, k, v = heads(q), heads(k), heads(v)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-        if self.causal:
-            Lq, Lk = q.shape[1], k.shape[1]
-            cmask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
-            scores = jnp.where(cmask[None, None], scores,
-                               jnp.asarray(-1e9, scores.dtype))
-        if mask is not None:
-            scores = jnp.where(mask[:, None, None, :], scores,
-                               jnp.asarray(-1e9, scores.dtype))
-        probs = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if self.use_flash and mask is None:
+            from horovod_tpu.ops.pallas import flash_attention
+            out = flash_attention(q, k, v, causal=self.causal)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+            if self.causal:
+                Lq, Lk = q.shape[1], k.shape[1]
+                cmask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
+                scores = jnp.where(cmask[None, None], scores,
+                                   jnp.asarray(-1e9, scores.dtype))
+            if mask is not None:
+                scores = jnp.where(mask[:, None, None, :], scores,
+                                   jnp.asarray(-1e9, scores.dtype))
+            probs = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = out.reshape(out.shape[:-2] + (local_heads * head_dim,))
         return RowParallelDense(self.hidden_size, dtype=self.dtype,
                                 axis_name=self.axis_name, name="out")(out)
@@ -202,12 +207,14 @@ class TPTransformerBlock(nn.Module):
     dtype: Any = jnp.float32
     axis_name: Optional[str] = TP_AXIS
     causal: bool = False
+    use_flash: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None):
         a = TPSelfAttention(self.num_heads, self.hidden_size,
                             dtype=self.dtype, axis_name=self.axis_name,
-                            causal=self.causal, name="attention")(
+                            causal=self.causal, use_flash=self.use_flash,
+                            name="attention")(
                                 nn.LayerNorm(dtype=self.dtype,
                                              name="ln_attn")(x), mask)
         x = x + a
