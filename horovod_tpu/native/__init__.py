@@ -66,6 +66,25 @@ def _bind(lib):
         fn = getattr(lib, name)
         fn.restype = None
         fn.argtypes = argtypes
+    i64 = ctypes.c_int64
+    i64p = ctypes.POINTER(ctypes.c_int64)
+    for name, restype, argtypes in [
+        ("hvd_sched_create", i64, [i64, i64]),
+        ("hvd_sched_destroy", None, [i64]),
+        ("hvd_sched_set_threshold", None, [i64, i64]),
+        ("hvd_sched_enqueue", ctypes.c_int32, [i64, i64, i64, i64]),
+        ("hvd_sched_pending", i64, [i64]),
+        ("hvd_sched_flush", i64, [i64, i64p, i64p, i64]),
+        ("hvd_cache_lookup", i64, [i64, i64]),
+        ("hvd_cache_hits", i64, [i64]),
+        ("hvd_cache_size", i64, [i64]),
+        ("hvd_group_register", i64, [i64, i64p, i64]),
+        ("hvd_group_of", i64, [i64, i64]),
+        ("hvd_group_deregister", None, [i64, i64]),
+    ]:
+        fn = getattr(lib, name)
+        fn.restype = restype
+        fn.argtypes = argtypes
     return lib
 
 
@@ -81,9 +100,33 @@ def get_lib():
         try:
             _lib = _bind(ctypes.CDLL(_LIB_PATH))
             hvd_logging.debug("loaded native runtime %s", _LIB_PATH)
-        except OSError as e:  # pragma: no cover
-            hvd_logging.warning("failed to load native runtime: %s", e)
+        except (OSError, AttributeError) as e:
+            # AttributeError: a stale prebuilt .so missing newer symbols.
+            # Rebuild, then load under a unique path — dlopen caches by path
+            # string, so reloading _LIB_PATH would return the stale handle.
+            hvd_logging.debug("native runtime stale/unloadable (%s); "
+                              "rebuilding", e)
             _lib = None
+            if _build():
+                import shutil
+                import tempfile
+                fd, tmppath = tempfile.mkstemp(suffix=".so",
+                                               prefix="libhvdtpu.reload.")
+                os.close(fd)
+                try:
+                    shutil.copy2(_LIB_PATH, tmppath)
+                    _lib = _bind(ctypes.CDLL(tmppath))
+                    hvd_logging.debug("reloaded native runtime via %s",
+                                      tmppath)
+                except (OSError, AttributeError) as e2:  # pragma: no cover
+                    hvd_logging.warning(
+                        "failed to load native runtime: %s", e2)
+                    _lib = None
+                finally:
+                    try:
+                        os.unlink(tmppath)  # handle stays valid on Linux
+                    except OSError:
+                        pass
         return _lib
 
 
@@ -203,3 +246,72 @@ class NativeTimeline:
         if self._handle:
             self._lib.hvd_timeline_close(self._handle)
             self._handle = 0
+
+
+class BucketScheduler:
+    """Native bucketing scheduler + LRU response cache + group table
+    (reference: the C++ cycle-loop bucket assembly operations.cc:747-853,
+    response_cache.h:45, group_table.h). Raises when the native runtime is
+    unavailable — callers fall back to the Python path."""
+
+    def __init__(self, threshold_bytes, cache_capacity=1024):
+        self._lib = _require_lib()
+        self._h = self._lib.hvd_sched_create(int(threshold_bytes),
+                                             int(cache_capacity))
+
+    def set_threshold(self, threshold_bytes):
+        self._lib.hvd_sched_set_threshold(self._h, int(threshold_bytes))
+
+    def enqueue(self, tensor_id, key_hash, nbytes):
+        """True when the accumulated bytes crossed the threshold."""
+        return bool(self._lib.hvd_sched_enqueue(
+            self._h, int(tensor_id), int(key_hash), int(nbytes)))
+
+    def pending(self):
+        return int(self._lib.hvd_sched_pending(self._h))
+
+    def flush(self):
+        """-> dict tensor_id -> bucket_id (enqueue order preserved)."""
+        import numpy as np
+        n = self.pending()
+        if n == 0:
+            return {}
+        tids = np.empty(n, np.int64)
+        bids = np.empty(n, np.int64)
+        nb = self._lib.hvd_sched_flush(
+            self._h, _as_ptr(tids, ctypes.c_int64),
+            _as_ptr(bids, ctypes.c_int64), n)
+        if nb < 0:  # pragma: no cover - cap == pending() by construction
+            raise RuntimeError("scheduler flush capacity mismatch")
+        return dict(zip(tids.tolist(), bids.tolist()))
+
+    def cache_lookup(self, signature):
+        """Stable slot id on hit, -1 on miss (inserted)."""
+        return int(self._lib.hvd_cache_lookup(self._h, int(signature)))
+
+    def cache_stats(self):
+        return {"hits": int(self._lib.hvd_cache_hits(self._h)),
+                "size": int(self._lib.hvd_cache_size(self._h))}
+
+    def register_group(self, tensor_ids):
+        import numpy as np
+        ids = np.asarray(list(tensor_ids), np.int64)
+        return int(self._lib.hvd_group_register(
+            self._h, _as_ptr(ids, ctypes.c_int64), ids.size))
+
+    def group_of(self, tensor_id):
+        return int(self._lib.hvd_group_of(self._h, int(tensor_id)))
+
+    def deregister_group(self, group_id):
+        self._lib.hvd_group_deregister(self._h, int(group_id))
+
+    def close(self):
+        if getattr(self, "_h", 0):
+            self._lib.hvd_sched_destroy(self._h)
+            self._h = 0
+
+    def __del__(self):  # best-effort; close() is the real API
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -38,4 +38,28 @@ void hvd_bf16_accumulate(const uint16_t* src, uint16_t* dst, int64_t n);
 void hvd_adasum_combine(const float* a, const float* b, float* out,
                         int64_t n);
 
+// ---- bucketing scheduler / response cache / group table ----
+// (reference: operations.cc:747-853 cycle bucket assembly,
+//  response_cache.h:45 LRU, group_table.h)
+int64_t hvd_sched_create(int64_t threshold_bytes, int64_t cache_capacity);
+void hvd_sched_destroy(int64_t handle);
+void hvd_sched_set_threshold(int64_t handle, int64_t threshold_bytes);
+// Returns 1 when accumulated pending bytes crossed the threshold.
+int32_t hvd_sched_enqueue(int64_t handle, int64_t tensor_id,
+                          int64_t key_hash, int64_t nbytes);
+int64_t hvd_sched_pending(int64_t handle);
+// Fills tensor_ids/bucket_ids (cap entries available); returns bucket count
+// or -1 if cap too small. Clears the pending queue.
+int64_t hvd_sched_flush(int64_t handle, int64_t* tensor_ids,
+                        int64_t* bucket_ids, int64_t cap);
+// LRU cache: hit -> stable slot id (>=0) and recency refresh; miss -> -1
+// (inserted, evicting LRU at capacity).
+int64_t hvd_cache_lookup(int64_t handle, int64_t signature);
+int64_t hvd_cache_hits(int64_t handle);
+int64_t hvd_cache_size(int64_t handle);
+int64_t hvd_group_register(int64_t handle, const int64_t* tensor_ids,
+                           int64_t n);
+int64_t hvd_group_of(int64_t handle, int64_t tensor_id);
+void hvd_group_deregister(int64_t handle, int64_t group_id);
+
 }  // extern "C"

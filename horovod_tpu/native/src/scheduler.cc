@@ -1,0 +1,220 @@
+// Native bucketing scheduler: the host-side analog of the reference's
+// fusion/cycle machinery (reference: horovod/common/operations.cc:747-853
+// RunLoopOnce bucket assembly, fusion_buffer_manager.h threshold accounting,
+// response_cache.h:45 LRU of negotiated responses, group_table.h grouped
+// collectives). On TPU the data plane is XLA; what remains hot on the host
+// is the per-step bookkeeping for thousands of enqueued gradients — done
+// here in C++ behind the ctypes API in api.h.
+
+#include <cstdint>
+#include <list>
+#include <mutex>
+#include <unordered_map>
+#include <vector>
+
+namespace {
+
+struct Pending {
+  int64_t tensor_id;
+  int64_t key_hash;   // bucket compatibility key (op/dtype/scales)
+  int64_t nbytes;
+};
+
+struct Scheduler {
+  std::mutex mu;
+  int64_t threshold;
+  int64_t cache_capacity;
+  std::vector<Pending> pending;
+  int64_t pending_bytes = 0;
+
+  // Response cache: signature -> stable slot id, LRU eviction.
+  std::unordered_map<int64_t, std::pair<int64_t, std::list<int64_t>::iterator>>
+      cache;               // sig -> (slot, lru iterator)
+  std::list<int64_t> lru;  // most-recent at front, holds signatures
+  int64_t next_slot = 0;
+  int64_t hits = 0;
+
+  // Group table: tensor_id -> group id.
+  std::unordered_map<int64_t, int64_t> group_of;
+  std::unordered_map<int64_t, std::vector<int64_t>> groups;
+  int64_t next_group = 0;
+};
+
+std::mutex g_mu;
+std::unordered_map<int64_t, Scheduler*> g_registry;
+int64_t g_next_handle = 1;
+
+Scheduler* get(int64_t h) {
+  std::lock_guard<std::mutex> l(g_mu);
+  auto it = g_registry.find(h);
+  return it == g_registry.end() ? nullptr : it->second;
+}
+
+}  // namespace
+
+extern "C" {
+
+int64_t hvd_sched_create(int64_t threshold_bytes, int64_t cache_capacity) {
+  auto* s = new Scheduler();
+  s->threshold = threshold_bytes > 0 ? threshold_bytes : (64ll << 20);
+  s->cache_capacity = cache_capacity > 0 ? cache_capacity : 1024;
+  std::lock_guard<std::mutex> l(g_mu);
+  int64_t h = g_next_handle++;
+  g_registry[h] = s;
+  return h;
+}
+
+void hvd_sched_destroy(int64_t h) {
+  Scheduler* s = nullptr;
+  {
+    std::lock_guard<std::mutex> l(g_mu);
+    auto it = g_registry.find(h);
+    if (it == g_registry.end()) return;
+    s = it->second;
+    g_registry.erase(it);
+  }
+  delete s;
+}
+
+void hvd_sched_set_threshold(int64_t h, int64_t threshold_bytes) {
+  auto* s = get(h);
+  if (!s) return;
+  std::lock_guard<std::mutex> l(s->mu);
+  s->threshold = threshold_bytes;
+}
+
+// Returns 1 when accumulated bytes crossed the threshold (time to flush).
+int32_t hvd_sched_enqueue(int64_t h, int64_t tensor_id, int64_t key_hash,
+                          int64_t nbytes) {
+  auto* s = get(h);
+  if (!s) return 0;
+  std::lock_guard<std::mutex> l(s->mu);
+  s->pending.push_back({tensor_id, key_hash, nbytes});
+  s->pending_bytes += nbytes;
+  return s->pending_bytes >= s->threshold ? 1 : 0;
+}
+
+int64_t hvd_sched_pending(int64_t h) {
+  auto* s = get(h);
+  if (!s) return 0;
+  std::lock_guard<std::mutex> l(s->mu);
+  return static_cast<int64_t>(s->pending.size());
+}
+
+// Assign pending tensors to buckets: same key fuses together, a bucket is
+// closed when it exceeds the threshold (the FuseResponses rule,
+// reference: controller.cc FuseResponses packing up to
+// TensorFusionThresholdBytes). Grouped tensors (group_table) always land in
+// one bucket regardless of size, preserving grouped-collective atomicity.
+// Writes tensor ids (enqueue order) and their bucket ids; returns the number
+// of buckets, or -1 if cap is too small. Clears the pending queue.
+int64_t hvd_sched_flush(int64_t h, int64_t* tensor_ids, int64_t* bucket_ids,
+                        int64_t cap) {
+  auto* s = get(h);
+  if (!s) return 0;
+  std::lock_guard<std::mutex> l(s->mu);
+  const int64_t n = static_cast<int64_t>(s->pending.size());
+  if (n > cap) return -1;
+  // (key or group-key) -> (bucket id, bytes so far)
+  struct Open { int64_t id; int64_t bytes; bool grouped; };
+  std::unordered_map<int64_t, Open> open;
+  int64_t next_bucket = 0;
+  for (int64_t i = 0; i < n; ++i) {
+    const Pending& p = s->pending[i];
+    auto git = s->group_of.find(p.tensor_id);
+    // A group's bucket key derives from the group id ALONE (not the
+    // per-tensor key), so every member of a group lands in one bucket even
+    // when their compatibility keys differ — grouped-collective atomicity.
+    const bool grouped = git != s->group_of.end();
+    const int64_t key = grouped
+        ? static_cast<int64_t>(0x517cc1b727220a95ull ^
+                               (static_cast<uint64_t>(git->second) *
+                                0x2545f4914f6cdd1dull))
+        : p.key_hash;
+    auto it = open.find(key);
+    if (it == open.end()) {
+      open[key] = {next_bucket++, p.nbytes, grouped};
+    } else if (!grouped && it->second.bytes + p.nbytes > s->threshold &&
+               it->second.bytes > 0) {
+      it->second = {next_bucket++, p.nbytes, false};
+    } else {
+      it->second.bytes += p.nbytes;
+    }
+    tensor_ids[i] = p.tensor_id;
+    bucket_ids[i] = open[key].id;
+  }
+  s->pending.clear();
+  s->pending_bytes = 0;
+  return next_bucket;
+}
+
+// LRU response cache keyed by bucket signature. A hit returns the stable
+// slot id (>= 0) and refreshes recency; a miss inserts (evicting the least
+// recently used entry at capacity) and returns -1.
+int64_t hvd_cache_lookup(int64_t h, int64_t signature) {
+  auto* s = get(h);
+  if (!s) return -1;
+  std::lock_guard<std::mutex> l(s->mu);
+  auto it = s->cache.find(signature);
+  if (it != s->cache.end()) {
+    s->lru.erase(it->second.second);
+    s->lru.push_front(signature);
+    it->second.second = s->lru.begin();
+    ++s->hits;
+    return it->second.first;
+  }
+  if (static_cast<int64_t>(s->cache.size()) >= s->cache_capacity) {
+    int64_t victim = s->lru.back();
+    s->lru.pop_back();
+    s->cache.erase(victim);
+  }
+  s->lru.push_front(signature);
+  s->cache[signature] = {s->next_slot++, s->lru.begin()};
+  return -1;
+}
+
+int64_t hvd_cache_hits(int64_t h) {
+  auto* s = get(h);
+  if (!s) return 0;
+  std::lock_guard<std::mutex> l(s->mu);
+  return s->hits;
+}
+
+int64_t hvd_cache_size(int64_t h) {
+  auto* s = get(h);
+  if (!s) return 0;
+  std::lock_guard<std::mutex> l(s->mu);
+  return static_cast<int64_t>(s->cache.size());
+}
+
+// Group table (reference: group_table.h RegisterGroup/DeregisterGroups).
+int64_t hvd_group_register(int64_t h, const int64_t* ids, int64_t n) {
+  auto* s = get(h);
+  if (!s) return -1;
+  std::lock_guard<std::mutex> l(s->mu);
+  int64_t gid = s->next_group++;
+  auto& vec = s->groups[gid];
+  vec.assign(ids, ids + n);
+  for (int64_t i = 0; i < n; ++i) s->group_of[ids[i]] = gid;
+  return gid;
+}
+
+int64_t hvd_group_of(int64_t h, int64_t tensor_id) {
+  auto* s = get(h);
+  if (!s) return -1;
+  std::lock_guard<std::mutex> l(s->mu);
+  auto it = s->group_of.find(tensor_id);
+  return it == s->group_of.end() ? -1 : it->second;
+}
+
+void hvd_group_deregister(int64_t h, int64_t group_id) {
+  auto* s = get(h);
+  if (!s) return;
+  std::lock_guard<std::mutex> l(s->mu);
+  auto it = s->groups.find(group_id);
+  if (it == s->groups.end()) return;
+  for (int64_t id : it->second) s->group_of.erase(id);
+  s->groups.erase(it);
+}
+
+}  // extern "C"

@@ -106,13 +106,38 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
 
 
 class FusionRuntime:
+    # Forwarded to the native scheduler so runtime threshold changes (the
+    # autotuner, tests) affect its flush decision too.
+    @property
+    def threshold(self):
+        return self._threshold
+
+    @threshold.setter
+    def threshold(self, value):
+        self._threshold = value
+        if getattr(self, "_native", None) is not None:
+            self._native.set_threshold(value)
+
     def __init__(self, config):
         self.threshold = config.fusion_threshold
         self.wire_dtype = jnp.dtype(config.wire_dtype).type \
             if config.wire_dtype else None
         self._lock = threading.RLock()
-        self._pending = []  # (tensor, op, prescale, postscale, handle)
+        self._pending = []  # (tid, tensor, op, prescale, postscale, handle)
         self._pending_bytes = 0
+        self._next_tid = 0
+        # Native C++ scheduler for the per-step bookkeeping (bucket assembly,
+        # LRU response-cache stats, group table); Python fallback below is
+        # behavior-identical (reference: the C++ cycle loop/fusion manager,
+        # operations.cc:747-853).
+        self._native = None
+        try:
+            from horovod_tpu import native
+            if native.native_built():
+                self._native = native.BucketScheduler(
+                    self.threshold, config.cache_capacity)
+        except Exception:
+            self._native = None
         self._parameter_manager = None
         if config.autotune:
             from horovod_tpu.autotune import ParameterManager
@@ -130,15 +155,28 @@ class FusionRuntime:
                 warning_secs=config.stall_check_time_seconds,
                 shutdown_secs=config.stall_shutdown_time_seconds)
 
+    def _bucket_key(self, tensor, op, prescale, postscale):
+        dt = jnp.dtype(tensor.dtype) if hasattr(tensor, "dtype") \
+            else np.result_type(tensor)
+        if self.wire_dtype is not None and jnp.issubdtype(dt, jnp.floating):
+            dt = jnp.dtype(self.wire_dtype)
+        return (ReduceOp(op), float(prescale), float(postscale), str(dt))
+
     def enqueue_allreduce(self, tensor, op, prescale, postscale, name=None):
         handle = FusedHandle(self, name)
         with self._lock:
-            self._pending.append((tensor, ReduceOp(op), float(prescale),
+            tid = self._next_tid
+            self._next_tid += 1
+            self._pending.append((tid, tensor, ReduceOp(op), float(prescale),
                                   float(postscale), handle))
             self._pending_bytes += tensor.nbytes
             if self._stall_inspector is not None:
                 self._stall_inspector.record_enqueue(name or "tensor")
-            if self._pending_bytes >= self.threshold:
+            if self._native is not None:
+                key = self._bucket_key(tensor, op, prescale, postscale)
+                if self._native.enqueue(tid, hash(key), tensor.nbytes):
+                    self._flush_locked()
+            elif self._pending_bytes >= self.threshold:
                 self._flush_locked()
         return handle
 
@@ -148,9 +186,23 @@ class FusionRuntime:
 
     def shutdown(self):
         """Flush remaining work and stop background watchdogs."""
-        self.flush_all()
+        with self._lock:
+            # Close the native scheduler under the same lock enqueue holds,
+            # so no thread can be inside hvd_sched_enqueue when the C++
+            # object is destroyed.
+            self._flush_locked()
+            if self._native is not None:
+                self._native.close()
+                self._native = None
         if self._stall_inspector is not None:
             self._stall_inspector.stop()
+
+    def cache_stats(self):
+        """Response-cache statistics from the native scheduler (hits grow as
+        steady-state steps reuse the same bucket signatures)."""
+        if self._native is None:
+            return None
+        return self._native.cache_stats()
 
     def _flush_locked(self):
         if not self._pending:
@@ -166,18 +218,21 @@ class FusionRuntime:
         topo = basics.topology()
         mesh = topo.mesh
         n = topo.size
-        # Bucket by (op, prescale, postscale, effective wire dtype) — tensors
-        # in one bucket share one flat reduction, like responses fused up to
-        # the threshold (reference: controller.h:170 FuseResponses).
-        def _eff(t):
-            dt = jnp.dtype(t.dtype) if hasattr(t, "dtype") else np.result_type(t)
-            if self.wire_dtype is not None and jnp.issubdtype(dt, jnp.floating):
-                return str(jnp.dtype(self.wire_dtype))
-            return str(dt)
-
+        # Bucket assembly: tensors in one bucket share one flat reduction,
+        # like responses fused up to the threshold (reference:
+        # controller.h:170 FuseResponses). The native scheduler assigns
+        # buckets by compatibility key AND closes buckets at the threshold;
+        # the Python fallback groups purely by key.
         buckets = {}
-        for t, op, pre, post, h in pending:
-            buckets.setdefault((op, pre, post, _eff(t)), []).append((t, h))
+        if self._native is not None:
+            assignment = self._native.flush()
+            for tid, t, op, pre, post, h in pending:
+                bid = assignment.get(tid)
+                buckets.setdefault((op, pre, post, bid), []).append((t, h))
+        else:
+            for tid, t, op, pre, post, h in pending:
+                key = self._bucket_key(t, op, pre, post)
+                buckets.setdefault((op, pre, post, key[-1]), []).append((t, h))
         tl = basics.timeline()
         from horovod_tpu.common.process_sets import global_process_set
         from horovod_tpu.ops.collective_ops import _active_mask
@@ -187,6 +242,12 @@ class FusionRuntime:
             tensors = _prepare(tensors, mesh, n, "fused_allreduce")
             shapes = tuple(tuple(t.shape) for t in tensors)
             dtypes = tuple(str(t.dtype) for t in tensors)
+            if self._native is not None:
+                # Steady-state training flushes the same bucket signatures
+                # every step; the native LRU mirrors the reference's
+                # response cache and exposes hit-rate stats (cache_stats()).
+                self._native.cache_lookup(
+                    hash((op, pre, post, shapes, dtypes)))
             prog = _fused_program(mesh, n, op, pre, post, shapes, dtypes,
                                   self.wire_dtype, active_mask)
             if tl is not None:

@@ -148,7 +148,11 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
         lq, lk = s.shape[1], s.shape[2]
         mask = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
         s = jnp.where(mask[None], s, NEG_INF)
-    p = jnp.exp(s - lse[..., None])                       # uses saved lse
+    # Masked entries have s = NEG_INF and a fully-masked row has
+    # lse ~= NEG_INF, where exp(s - lse) would blow up instead of vanishing
+    # — zero them explicitly (the forward kernel does the same).
+    p = jnp.where(s > NEG_INF * 0.5,
+                  jnp.exp(s - lse[..., None]), 0.0)       # uses saved lse
     dv = jnp.einsum("bqk,bqd->bkd", p, dof)
     dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
     delta = jnp.sum(dof * of, axis=-1)                    # (BH, Lq)

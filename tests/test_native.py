@@ -125,3 +125,78 @@ class TestNativeTimeline:
         tl.close()
         evs = json.load(open(path))["traceEvents"]
         assert len(evs) == 1 and evs[0]["cat"] == "ALLREDUCE"
+
+
+class TestBucketScheduler:
+    def _sched(self, threshold=100, cache_capacity=4):
+        from horovod_tpu import native
+        if not native.native_built():
+            pytest.skip("native runtime unavailable")
+        return native.BucketScheduler(threshold, cache_capacity)
+
+    def test_threshold_triggers_flush_signal(self):
+        s = self._sched(threshold=100)
+        assert not s.enqueue(0, 1, 60)
+        assert s.enqueue(1, 1, 60)       # 120 >= 100
+        assert s.pending() == 2
+        s.close()
+
+    def test_same_key_fuses_and_threshold_splits(self):
+        s = self._sched(threshold=100)
+        for tid in range(4):             # same key, 40B each
+            s.enqueue(tid, 7, 40)
+        s.enqueue(4, 9, 10)              # different key
+        m = s.flush()
+        # 40+40 fits; a third 40 would exceed 100 -> buckets of 2 and 2
+        # (pack-until-threshold, reference: FuseResponses); key 9 separate.
+        assert m[0] == m[1]
+        assert m[2] == m[3]
+        assert m[0] != m[2]
+        assert m[4] not in (m[0], m[2])
+        assert s.pending() == 0
+        s.close()
+
+    def test_lru_cache_eviction_and_hits(self):
+        s = self._sched(cache_capacity=2)
+        assert s.cache_lookup(1) == -1
+        assert s.cache_lookup(2) == -1
+        assert s.cache_lookup(1) >= 0        # hit
+        assert s.cache_lookup(3) == -1       # evicts 2 (LRU)
+        assert s.cache_lookup(2) == -1       # was evicted -> miss
+        stats = s.cache_stats()
+        assert stats["hits"] == 1 and stats["size"] == 2
+        s.close()
+
+    def test_group_shares_bucket_despite_keys(self):
+        s = self._sched(threshold=1000)
+        gid = s.register_group([10, 11])
+        assert s.group_of(10) == gid and s.group_of(11) == gid
+        s.enqueue(10, 1, 8)
+        s.enqueue(11, 2, 8)   # different compatibility key, same group
+        s.enqueue(12, 1, 8)
+        m = s.flush()
+        assert m[10] == m[11]
+        assert m[12] != m[10]  # ungrouped tensor keeps its own bucket
+        s.deregister_group(gid)
+        assert s.group_of(10) == -1
+        s.close()
+
+
+class TestFusionNativeIntegration:
+    def test_async_allreduce_uses_native_scheduler(self, hvd, rng):
+        import jax.numpy as jnp
+        from horovod_tpu.ops.fusion import get_runtime
+        n = hvd.size()
+        rt = get_runtime()
+        if rt._native is None:
+            pytest.skip("native scheduler unavailable")
+        before = rt.cache_stats()
+        x = jnp.asarray(rng.standard_normal((n, 16)), jnp.float32)
+        ref = np.asarray(x).sum(0)
+        for _ in range(3):
+            h = hvd.allreduce_async(x, op=hvd.Sum)
+            out = h.synchronize()
+            np.testing.assert_allclose(np.asarray(out)[0], ref, rtol=1e-5)
+        after = rt.cache_stats()
+        # Same signature flushed repeatedly -> native LRU records hits.
+        assert after["hits"] >= before["hits"] + 2

@@ -76,6 +76,23 @@ class TestFlashAttention:
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
 
+    def test_fully_masked_rows_zero_gradients(self, rng):
+        """causal with Lq > Lk: early rows attend nothing; outputs and
+        gradients must be exactly zero, not exp(1e30) garbage."""
+        from horovod_tpu.ops.pallas import flash_attention
+        q, _, _ = _qkv(rng, B=1, L=64, H=2, D=16)
+        _, k, v = _qkv(rng, B=1, L=32, H=2, D=16)
+        out = flash_attention(q, k, v, causal=True)
+        # rows i < Lq - Lk = 32 are fully masked (end-aligned convention)
+        np.testing.assert_array_equal(np.asarray(out)[:, :32], 0.0)
+        g = jax.grad(lambda a, b, c: jnp.sum(
+            flash_attention(a, b, c, causal=True) ** 2),
+            argnums=(0, 1, 2))(q, k, v)
+        for t in g:
+            arr = np.asarray(t)
+            assert np.isfinite(arr).all()
+        np.testing.assert_array_equal(np.asarray(g[0])[:, :32], 0.0)
+
     def test_cross_length_causal_gradients(self, rng):
         from horovod_tpu.ops.pallas import flash_attention
         from horovod_tpu.parallel.sequence import local_attention
