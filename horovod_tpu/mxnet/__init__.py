@@ -1,0 +1,145 @@
+"""MXNet frontend: ``import horovod_tpu.mxnet as hvd``.
+
+Reference surface: horovod/mxnet/__init__.py (DistributedOptimizer:
+gradient-averaging optimizer wrapper, DistributedTrainer: gluon Trainer with
+allreduce'd ``_allreduce_grads``, broadcast_parameters) and
+horovod/mxnet/mpi_ops.py (collectives).
+
+The collective bridge is duck-typed (see mpi_ops.py), so everything except
+:class:`DistributedTrainer` (which subclasses ``gluon.Trainer`` and therefore
+needs a real MXNet install) works without MXNet — mirroring how the
+reference's frontends gate on what is importable.
+"""
+
+from horovod_tpu.common.basics import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
+    local_size, cross_size, mpi_threads_supported, mpi_enabled, mpi_built,
+    gloo_enabled, gloo_built, nccl_built, ddl_built, ccl_built, cuda_built,
+    rocm_built, start_timeline, stop_timeline,
+)
+from horovod_tpu.common.process_sets import (  # noqa: F401
+    ProcessSet, add_process_set, global_process_set, process_set_by_id,
+    remove_process_set,
+)
+from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401
+    Adasum, Average, Max, Min, Product, ReduceOp, Sum,
+    allgather, allreduce, allreduce_, alltoall, barrier, broadcast,
+    broadcast_, grouped_allgather, grouped_allreduce, grouped_reducescatter,
+    reducescatter,
+)
+from horovod_tpu.mxnet import mpi_ops as _ops
+
+
+class DistributedOptimizer:
+    """Optimizer wrapper averaging gradients across ranks before the update
+    (reference: horovod/mxnet/__init__.py DistributedOptimizer).
+
+    Works with any optimizer exposing the MXNet contract
+    ``update(index, weight, grad, state)`` /
+    ``update_multi_precision(...)``; gradients are allreduced (grouped when
+    ``index`` is a list, like the reference's grouped path) before delegating.
+    """
+
+    def __init__(self, optimizer, gradient_predivide_factor=1.0,
+                 num_groups=0, process_set=None):
+        del num_groups  # grouping is signature-level here (fusion runtime)
+        self._optimizer = optimizer
+        self._predivide = float(gradient_predivide_factor)
+        self._process_set = process_set
+
+    def __getattr__(self, item):
+        return getattr(self._optimizer, item)
+
+    def _reduce(self, index, grad):
+        pre = 1.0 / self._predivide if self._predivide != 1.0 else 1.0
+        if isinstance(index, (tuple, list)):
+            return _ops.grouped_allreduce(
+                list(grad), op=Average, prescale_factor=pre,
+                process_set=self._process_set,
+                name=f"grads_{index[0]}")
+        return _ops.allreduce(grad, op=Average, prescale_factor=pre,
+                              process_set=self._process_set,
+                              name=f"grad_{index}")
+
+    def update(self, index, weight, grad, state):
+        self._optimizer.update(index, weight, self._reduce(index, grad),
+                               state)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self._optimizer.update_multi_precision(
+            index, weight, self._reduce(index, grad), state)
+
+    def set_learning_rate(self, lr):
+        self._optimizer.set_learning_rate(lr)
+
+    def set_lr_mult(self, args_lr_mult):
+        self._optimizer.set_lr_mult(args_lr_mult)
+
+    def set_wd_mult(self, args_wd_mult):
+        self._optimizer.set_wd_mult(args_wd_mult)
+
+
+def DistributedTrainer(params, optimizer, optimizer_params=None,
+                       compression=None, gradient_predivide_factor=1.0,
+                       process_set=None):
+    """gluon ``Trainer`` whose ``_allreduce_grads`` averages over ranks
+    (reference: horovod/mxnet/__init__.py DistributedTrainer). Requires a
+    real MXNet install."""
+    try:
+        import mxnet as mx
+    except ImportError as e:
+        raise ImportError(
+            "DistributedTrainer requires MXNet (gluon); for the"
+            " optimizer-level wrapper use DistributedOptimizer") from e
+    del compression  # wire dtype is the runtime's HOROVOD_WIRE_DTYPE knob
+
+    class _Trainer(mx.gluon.Trainer):
+        def __init__(self):
+            super().__init__(params, optimizer,
+                             optimizer_params, kvstore=None)
+            # Scale Trainer's internal batch normalization by world size the
+            # way the reference does (loss is averaged per worker, gradient
+            # average across workers completes the global mean).
+            self._scale /= size()
+
+        def _allreduce_grads(self):
+            pre = (1.0 / gradient_predivide_factor
+                   if gradient_predivide_factor != 1.0 else 1.0)
+            for i, param in enumerate(self._params):
+                if param.grad_req != "null":
+                    for g in param.list_grad():
+                        _ops.allreduce_(g, op=Average, prescale_factor=pre,
+                                        process_set=process_set,
+                                        name=f"param_{i}")
+
+    return _Trainer()
+
+
+def broadcast_parameters(params, root_rank=0, prefix=None):
+    """Broadcast a params collection from ``root_rank``
+    (reference: horovod/mxnet/__init__.py broadcast_parameters). Accepts a
+    dict of NDArray/arrays or a gluon ``ParameterDict``."""
+    if params is None:
+        return
+    if hasattr(params, "items"):
+        items = sorted(params.items())
+    else:
+        raise ValueError("invalid params of type: %s" % type(params))
+    for name, p in items:
+        tag = f"{prefix or ''}{name}"
+        try:
+            tensor = p.data() if hasattr(p, "data") else p  # gluon Parameter
+        except Exception:
+            continue  # deferred-init parameter: nothing to broadcast yet
+        out = _ops.broadcast(tensor, root_rank, name=tag)
+        if hasattr(p, "set_data"):
+            p.set_data(out)
+        else:
+            _ops._copy_into(p, _ops._to_numpy(out))
+
+
+def broadcast_object(obj, root_rank=0, name=None):
+    """Pickle-broadcast an arbitrary object (reference: the per-framework
+    broadcast_object helpers)."""
+    from horovod_tpu.ops.collective_ops import broadcast_object as _bo
+    return _bo(obj, root_rank=root_rank, name=name)

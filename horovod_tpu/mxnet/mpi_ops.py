@@ -1,0 +1,176 @@
+"""MXNet NDArray collectives bridged to the XLA eager runtime.
+
+Reference: horovod/mxnet/mpi_ops.py (sync + async wrappers over the
+``horovod_mxnet_*_async`` C functions, mxnet/mpi_ops.cc:638-705).
+
+Semantics match the torch bridge (horovod_tpu/torch/mpi_ops.py): the input is
+this *host's* tensor, replicated onto the local mesh slices; chip-axis
+reductions return the host value for Average and value*size for Sum. The
+bridge is duck-typed over anything exposing ``asnumpy()`` (real
+``mx.nd.NDArray``, or array-likes in environments without MXNet), so the
+frontend is fully exercisable without an MXNet install.
+"""
+
+import numpy as np
+
+from horovod_tpu.common import basics
+from horovod_tpu.ops import collective_ops as C
+from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min,  # noqa: F401
+                                            Product, ReduceOp, Sum)
+
+__all__ = ["allreduce", "allreduce_", "grouped_allreduce", "allgather",
+           "grouped_allgather", "broadcast", "broadcast_", "alltoall",
+           "reducescatter", "grouped_reducescatter", "barrier",
+           "Average", "Sum", "Adasum", "Min", "Max", "Product", "ReduceOp"]
+
+
+def _mx():
+    try:
+        import mxnet
+        return mxnet
+    except ImportError:
+        return None
+
+
+def _to_numpy(t):
+    if hasattr(t, "asnumpy"):
+        return np.asarray(t.asnumpy())
+    return np.asarray(t)
+
+
+def _like(template, arr):
+    """Rebuild an output in the caller's tensor type (NDArray when MXNet is
+    installed, else numpy)."""
+    arr = np.asarray(arr)
+    mx = _mx()
+    if mx is not None and isinstance(template, mx.nd.NDArray):
+        return mx.nd.array(arr, ctx=template.context, dtype=arr.dtype)
+    return arr
+
+
+def _copy_into(target, arr):
+    """In-place variants: write the result back into the caller's NDArray
+    (duck-typed: both mx.nd.NDArray and numpy support ``t[:] = value``)."""
+    arr = np.asarray(arr)
+    if hasattr(target, "__setitem__"):
+        target[slice(None)] = arr
+        return target
+    return _like(target, arr)
+
+
+def _stack(a, ps):
+    n = ps.size() if ps is not None else basics.size()
+    return np.broadcast_to(a, (n,) + a.shape)
+
+
+def _first(out):
+    return np.asarray(out)[0]
+
+
+def _resolve_op(average, op):
+    if op is not None and average is not None:
+        raise ValueError("The op parameter supersedes average; "
+                         "please provide only one of them.")
+    if op is not None:
+        return op
+    return Average if (average is None or average) else Sum
+
+
+def allreduce(tensor, average=None, name=None, priority=0, op=None,
+              prescale_factor=1.0, postscale_factor=1.0, process_set=None):
+    """reference: hvd.allreduce (mxnet/mpi_ops.py). ``priority`` is accepted
+    for API parity; XLA schedules the compiled program itself."""
+    del priority
+    a = _to_numpy(tensor)
+    out = C.allreduce(_stack(a, process_set), op=_resolve_op(average, op),
+                      prescale_factor=prescale_factor,
+                      postscale_factor=postscale_factor,
+                      process_set=process_set, name=name)
+    return _like(tensor, _first(out))
+
+
+def allreduce_(tensor, average=None, name=None, priority=0, op=None,
+               prescale_factor=1.0, postscale_factor=1.0, process_set=None):
+    out = allreduce(tensor, average=average, name=name, op=op,
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor,
+                    process_set=process_set)
+    return _copy_into(tensor, _to_numpy(out))
+
+
+def grouped_allreduce(tensors, average=None, name=None, priority=0, op=None,
+                      prescale_factor=1.0, postscale_factor=1.0,
+                      process_set=None):
+    del priority
+    arrs = [_stack(_to_numpy(t), process_set) for t in tensors]
+    outs = C.grouped_allreduce(arrs, op=_resolve_op(average, op),
+                               prescale_factor=prescale_factor,
+                               postscale_factor=postscale_factor,
+                               process_set=process_set, name=name)
+    return [_like(t, _first(o)) for t, o in zip(tensors, outs)]
+
+
+def allgather(tensor, name=None, priority=0, process_set=None):
+    del priority
+    a = _to_numpy(tensor)
+    out = C.allgather(_stack(a, process_set), process_set=process_set,
+                      name=name)
+    # Output slice [r] is already the concatenation of every rank's data
+    # (collective_ops.allgather contract).
+    return _like(tensor, _first(out))
+
+
+def grouped_allgather(tensors, name=None, priority=0, process_set=None):
+    return [allgather(t, name=name, process_set=process_set)
+            for t in tensors]
+
+
+def broadcast(tensor, root_rank, name=None, priority=0, process_set=None):
+    del priority
+    a = _to_numpy(tensor)
+    out = C.broadcast(_stack(a, process_set), root_rank=root_rank,
+                      process_set=process_set, name=name)
+    return _like(tensor, _first(out))
+
+
+def broadcast_(tensor, root_rank, name=None, priority=0, process_set=None):
+    out = broadcast(tensor, root_rank, name=name, process_set=process_set)
+    return _copy_into(tensor, _to_numpy(out))
+
+
+def alltoall(tensor, splits=None, name=None, priority=0, process_set=None):
+    """Returns ``(output, received_splits)`` when ``splits`` is given, else
+    just the output — the reference's contract."""
+    del priority
+    a = _to_numpy(tensor)
+    n = (process_set.size() if process_set is not None else
+         basics.size())
+    if splits is not None:
+        # The eager API wants the full (rank, peer) split matrix; every mesh
+        # slice carries this host's replicated tensor, so every row is this
+        # host's split vector.
+        splits = np.broadcast_to(np.asarray(splits), (n, n))
+    res = C.alltoall(_stack(a, process_set), splits=splits,
+                     process_set=process_set, name=name)
+    if splits is None:
+        return _like(tensor, _first(res))
+    out, recv_splits = res
+    return _like(tensor, _first(out)), np.asarray(recv_splits)[0]
+
+
+def reducescatter(tensor, op=Sum, name=None, priority=0, process_set=None):
+    del priority
+    a = _to_numpy(tensor)
+    out = C.reducescatter(_stack(a, process_set), op=op,
+                          process_set=process_set, name=name)
+    return _like(tensor, _first(out))
+
+
+def grouped_reducescatter(tensors, op=Sum, name=None, priority=0,
+                          process_set=None):
+    return [reducescatter(t, op=op, name=name, process_set=process_set)
+            for t in tensors]
+
+
+def barrier(process_set=None):
+    C.barrier(process_set=process_set)

@@ -107,7 +107,11 @@ def get_lib():
             hvd_logging.debug("native runtime stale/unloadable (%s); "
                               "rebuilding", e)
             _lib = None
-            if _build():
+            if not _build():
+                hvd_logging.warning(
+                    "failed to load native runtime (%s) and rebuild is "
+                    "unavailable; using Python fallbacks", e)
+            else:
                 import shutil
                 import tempfile
                 fd, tmppath = tempfile.mkstemp(suffix=".so",

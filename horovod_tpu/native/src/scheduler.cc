@@ -116,7 +116,7 @@ int64_t hvd_sched_flush(int64_t h, int64_t* tensor_ids, int64_t* bucket_ids,
   const int64_t n = static_cast<int64_t>(s->pending.size());
   if (n > cap) return -1;
   // (key or group-key) -> (bucket id, bytes so far)
-  struct Open { int64_t id; int64_t bytes; bool grouped; };
+  struct Open { int64_t id; int64_t bytes; };
   std::unordered_map<int64_t, Open> open;
   int64_t next_bucket = 0;
   for (int64_t i = 0; i < n; ++i) {
@@ -125,6 +125,7 @@ int64_t hvd_sched_flush(int64_t h, int64_t* tensor_ids, int64_t* bucket_ids,
     // A group's bucket key derives from the group id ALONE (not the
     // per-tensor key), so every member of a group lands in one bucket even
     // when their compatibility keys differ — grouped-collective atomicity.
+    // Grouped buckets are also exempt from the threshold split below.
     const bool grouped = git != s->group_of.end();
     const int64_t key = grouped
         ? static_cast<int64_t>(0x517cc1b727220a95ull ^
@@ -133,10 +134,10 @@ int64_t hvd_sched_flush(int64_t h, int64_t* tensor_ids, int64_t* bucket_ids,
         : p.key_hash;
     auto it = open.find(key);
     if (it == open.end()) {
-      open[key] = {next_bucket++, p.nbytes, grouped};
+      open[key] = {next_bucket++, p.nbytes};
     } else if (!grouped && it->second.bytes + p.nbytes > s->threshold &&
                it->second.bytes > 0) {
-      it->second = {next_bucket++, p.nbytes, false};
+      it->second = {next_bucket++, p.nbytes};
     } else {
       it->second.bytes += p.nbytes;
     }

@@ -200,9 +200,10 @@ class FusionRuntime:
     def cache_stats(self):
         """Response-cache statistics from the native scheduler (hits grow as
         steady-state steps reuse the same bucket signatures)."""
-        if self._native is None:
-            return None
-        return self._native.cache_stats()
+        with self._lock:  # shutdown() destroys the native object under it
+            if self._native is None:
+                return None
+            return self._native.cache_stats()
 
     def _flush_locked(self):
         if not self._pending:

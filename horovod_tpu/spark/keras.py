@@ -1,0 +1,116 @@
+"""KerasEstimator: the Spark-ML-style estimator for Keras models.
+
+Reference: horovod/spark/keras/estimator.py:91 (KerasEstimator → Store-backed
+Parquet → remote Keras training with hvd.DistributedOptimizer + callbacks →
+KerasModel for transform).
+
+Gated on a Keras/TensorFlow install (not part of the baked TPU image): the
+class is always importable for API parity, and raises a clear error at
+``fit`` time when Keras is unavailable — the same pattern the reference uses
+for optional framework support.
+"""
+
+import os
+
+import numpy as np
+
+from horovod_tpu.spark.estimator import _to_pandas
+from horovod_tpu.spark.store import LocalStore
+
+
+def _keras():
+    try:
+        import keras
+        return keras
+    except ImportError:
+        try:
+            from tensorflow import keras
+            return keras
+        except ImportError as e:
+            raise ImportError(
+                "KerasEstimator requires keras (or tensorflow.keras); this "
+                "image ships neither — use TpuEstimator (flax) or "
+                "TorchEstimator instead") from e
+
+
+class KerasEstimator:
+    """Train a compiled-or-compilable Keras model from a DataFrame
+    (reference: spark/keras/estimator.py:91)."""
+
+    def __init__(self, model, optimizer, loss, feature_cols, label_cols,
+                 batch_size=32, epochs=1, store=None, run_id=None,
+                 shuffle=True, seed=0, verbose=0):
+        self.model = model
+        self.optimizer = optimizer
+        self.loss = loss
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.batch_size = batch_size
+        self.epochs = epochs
+        self.store = store or LocalStore("./tpu_estimator")
+        self.run_id = run_id
+        self.shuffle = shuffle
+        self.seed = seed
+        self.verbose = verbose
+
+    def fit(self, df):
+        keras = _keras()
+        import horovod_tpu.keras as hvd_keras
+
+        if not hvd_keras.is_initialized():
+            hvd_keras.init()
+
+        pdf = _to_pandas(df)
+        path = self.store.get_train_data_path()
+        self.store.make_dirs(os.path.dirname(path) or ".")
+        pdf.to_parquet(path + ".parquet")
+        X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
+                      for c in self.feature_cols], axis=-1)
+        y = np.stack([np.asarray(pdf[c].tolist())
+                      for c in self.label_cols], axis=-1)
+
+        run_id = self.run_id or self.store.new_run_id()
+        ckpt_dir = self.store.get_checkpoint_path(run_id)
+        self.store.make_dirs(ckpt_dir)
+        ckpt_file = os.path.join(ckpt_dir, "model.keras")
+
+        model = self.model
+        if os.path.exists(ckpt_file):  # resume
+            model = hvd_keras.load_model(ckpt_file)
+        else:
+            opt = hvd_keras.DistributedOptimizer(self.optimizer)
+            model.compile(optimizer=opt, loss=self.loss)
+
+        callbacks = [
+            hvd_keras.callbacks.BroadcastGlobalVariablesCallback(0),
+            hvd_keras.callbacks.MetricAverageCallback(),
+        ]
+        history = model.fit(X, y, batch_size=self.batch_size,
+                            epochs=self.epochs, shuffle=self.shuffle,
+                            verbose=self.verbose, callbacks=callbacks)
+        model.save(ckpt_file)
+        return KerasModel(model, self.feature_cols, self.label_cols,
+                          history=history.history, run_id=run_id)
+
+
+class KerasModel:
+    """Result of ``KerasEstimator.fit`` (reference: KerasModel.transform)."""
+
+    def __init__(self, model, feature_cols, label_cols, history=None,
+                 run_id=None):
+        self.model = model
+        self.feature_cols = list(feature_cols)
+        self.label_cols = list(label_cols)
+        self.history = history or {}
+        self.run_id = run_id
+
+    def transform(self, df):
+        pdf = _to_pandas(df).copy()
+        X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
+                      for c in self.feature_cols], axis=-1)
+        out = np.asarray(self.model.predict(X, verbose=0))
+        if out.ndim == 1:
+            out = out[:, None]
+        for i, c in enumerate(self.label_cols):
+            pdf[f"{c}__output"] = list(out[:, min(i, out.shape[1] - 1)])
+        return pdf

@@ -1,0 +1,61 @@
+"""LightningEstimator: Spark-ML estimator for PyTorch-Lightning modules.
+
+Reference: horovod/spark/lightning/estimator.py (TorchEstimator variant that
+drives a ``LightningModule`` through a Trainer in the remote workers).
+
+Gated on a pytorch-lightning install (not part of the baked TPU image): when
+absent, ``fit`` raises with a pointer to :class:`TorchEstimator`, whose
+training loop covers the same torch models without the Lightning dependency.
+"""
+
+from horovod_tpu.spark.torch import TorchEstimator, TorchModel  # noqa: F401
+
+
+def _lightning():
+    try:
+        import pytorch_lightning as pl
+        return pl
+    except ImportError as e:
+        raise ImportError(
+            "LightningEstimator requires pytorch_lightning; this image does "
+            "not ship it — use TorchEstimator for plain torch modules") from e
+
+
+class LightningEstimator(TorchEstimator):
+    """Train a ``LightningModule`` from a DataFrame. The module must define
+    ``training_step`` and ``configure_optimizers``; its optimizer is wrapped
+    in the distributed optimizer like the reference wires Horovod into the
+    Lightning Trainer (reference: spark/lightning/estimator.py)."""
+
+    def __init__(self, model, feature_cols, label_cols, **kwargs):
+        _lightning()  # fail fast with the clear gating error
+
+        def _opt_factory(params):
+            del params
+            return model.configure_optimizers()
+
+        def _loss(outputs, labels):
+            del outputs, labels
+            raise NotImplementedError  # training_step computes the loss
+
+        super().__init__(model, _opt_factory, _loss, feature_cols,
+                         label_cols, **kwargs)
+
+    def fit(self, df):
+        pl = _lightning()
+        import torch.utils.data as tud
+
+        import horovod_tpu.torch as hvd_torch
+
+        if not hvd_torch.is_initialized():
+            hvd_torch.init()
+        X, y = self._materialize(df)
+        import torch
+        ds = tud.TensorDataset(torch.as_tensor(X), torch.as_tensor(y))
+        loader = tud.DataLoader(ds, batch_size=self.batch_size,
+                                shuffle=self.shuffle)
+        trainer = pl.Trainer(max_epochs=self.epochs, logger=False,
+                             enable_checkpointing=False)
+        trainer.fit(self.model, loader)
+        return TorchModel(self.model, self.feature_cols, self.label_cols,
+                          run_id=self.run_id)

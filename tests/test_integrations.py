@@ -158,3 +158,90 @@ class TestEstimator:
         m1 = make(run_id="runA").fit(df)
         m2 = make(run_id="runA").fit(df)  # resumes from m1's checkpoint
         assert m2.history[0] <= m1.history[0]
+
+
+def _regression_df(rng, n=64):
+    import pandas as pd
+    w = np.asarray([2.0, -1.0], np.float32)
+    X = np.asarray(rng.standard_normal((n, 2)), np.float32)
+    y = X @ w
+    return pd.DataFrame({"f0": X[:, 0], "f1": X[:, 1], "label": y})
+
+
+class TestTorchEstimator:
+    def _df(self, rng, n=64):
+        return _regression_df(rng, n)
+
+    def test_fit_transform_roundtrip(self, hvd, tmp_path, rng):
+        import torch
+
+        from horovod_tpu.spark import LocalStore, TorchEstimator
+
+        model = torch.nn.Linear(2, 1)
+        est = TorchEstimator(
+            model=model,
+            optimizer=lambda ps: torch.optim.SGD(ps, lr=0.1),
+            loss=lambda out, lab: ((out.squeeze(-1) - lab) ** 2).mean(),
+            feature_cols=["f0", "f1"], label_cols=["label"],
+            batch_size=16, epochs=25, store=LocalStore(str(tmp_path)))
+        df = self._df(rng)
+        m = est.fit(df)
+        assert m.history[-1] < m.history[0] * 0.1   # converged
+        out = m.transform(df)
+        pred = np.asarray(out["label__output"].tolist(), np.float32)
+        np.testing.assert_allclose(pred, df["label"].to_numpy(),
+                                   atol=0.3)
+
+    def test_resume_from_checkpoint(self, hvd, tmp_path, rng):
+        import torch
+
+        from horovod_tpu.spark import LocalStore, TorchEstimator
+
+        store = LocalStore(str(tmp_path))
+        df = self._df(rng)
+
+        def make(epochs, model):
+            return TorchEstimator(
+                model=model,
+                optimizer=lambda ps: torch.optim.SGD(ps, lr=0.05),
+                loss=lambda out, lab: ((out.squeeze(-1) - lab) ** 2).mean(),
+                feature_cols=["f0", "f1"], label_cols=["label"],
+                batch_size=16, epochs=epochs, store=store, run_id="r1")
+
+        m1 = make(2, torch.nn.Linear(2, 1)).fit(df)
+        # Second fit resumes at epoch 2 -> only 1 more epoch of history.
+        m2 = make(3, torch.nn.Linear(2, 1)).fit(df)
+        assert len(m1.history) == 2 and len(m2.history) == 1
+
+
+class TestKerasEstimator:
+    def test_fit_transform_roundtrip(self, hvd, tmp_path, rng):
+        keras = pytest.importorskip("keras")
+
+        from horovod_tpu.spark import KerasEstimator, LocalStore
+
+        model = keras.Sequential([keras.layers.Input((2,)),
+                                  keras.layers.Dense(1)])
+        est = KerasEstimator(
+            model=model, optimizer=keras.optimizers.SGD(0.1), loss="mse",
+            feature_cols=["f0", "f1"], label_cols=["label"],
+            batch_size=16, epochs=20, store=LocalStore(str(tmp_path)))
+        df = _regression_df(rng)
+        m = est.fit(df)
+        assert m.history["loss"][-1] < m.history["loss"][0] * 0.1
+        out = m.transform(df)
+        pred = np.asarray(out["label__output"].tolist(), np.float32)
+        np.testing.assert_allclose(pred, df["label"].to_numpy(), atol=0.3)
+
+
+class TestLightningEstimator:
+    def test_gated_without_lightning(self, hvd):
+        try:
+            import pytorch_lightning  # noqa: F401
+            pytest.skip("lightning installed")
+        except ImportError:
+            pass
+        from horovod_tpu.spark import LightningEstimator
+        with pytest.raises(ImportError, match="LightningEstimator requires"):
+            LightningEstimator(model=None, feature_cols=["f"],
+                               label_cols=["l"])

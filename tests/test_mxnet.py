@@ -1,0 +1,165 @@
+"""MXNet frontend (duck-typed bridge — no MXNet install needed)."""
+
+import numpy as np
+import pytest
+
+
+class FakeNDArray:
+    """Minimal mx.nd.NDArray stand-in: asnumpy + in-place writes."""
+
+    def __init__(self, arr):
+        self._a = np.array(arr, np.float32)
+
+    def asnumpy(self):
+        return self._a
+
+    @property
+    def shape(self):
+        return self._a.shape
+
+    def __setitem__(self, k, v):
+        self._a[k] = v.asnumpy() if hasattr(v, "asnumpy") else np.asarray(v)
+
+
+class FakeSGD:
+    """Records update() calls like an mx.optimizer.Optimizer."""
+
+    def __init__(self, lr=0.1):
+        self.lr = lr
+        self.updates = []
+
+    def update(self, index, weight, grad, state):
+        if isinstance(index, (list, tuple)):  # mxnet optimizers accept lists
+            for i, w, g in zip(index, weight, grad):
+                self.update(i, w, g, None)
+            return
+        g = grad if isinstance(grad, np.ndarray) else np.asarray(grad)
+        weight._a -= self.lr * g
+        self.updates.append(index)
+
+    def update_multi_precision(self, index, weight, grad, state):
+        self.update(index, weight, grad, state)
+
+    def set_learning_rate(self, lr):
+        self.lr = lr
+
+
+class TestMxnetOps:
+    def test_allreduce_average_and_sum(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        x = FakeNDArray(rng.standard_normal((4, 3)))
+        out = hvd_mx.allreduce(x)                  # Average
+        np.testing.assert_allclose(out, x.asnumpy(), rtol=1e-5)
+        out = hvd_mx.allreduce(x, op=hvd_mx.Sum)   # value * size
+        np.testing.assert_allclose(out, x.asnumpy() * hvd.size(), rtol=1e-5)
+
+    def test_average_op_conflict(self, hvd):
+        import horovod_tpu.mxnet as hvd_mx
+        with pytest.raises(ValueError, match="supersedes"):
+            hvd_mx.allreduce(FakeNDArray(np.ones(2)), average=True,
+                             op=hvd_mx.Sum)
+
+    def test_allreduce_inplace(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        a = rng.standard_normal((5,))
+        x = FakeNDArray(a)
+        ret = hvd_mx.allreduce_(x, op=hvd_mx.Sum)
+        assert ret is x
+        np.testing.assert_allclose(x.asnumpy(), a * hvd.size(), rtol=1e-5)
+
+    def test_grouped_allreduce(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        xs = [FakeNDArray(rng.standard_normal((3,))) for _ in range(3)]
+        outs = hvd_mx.grouped_allreduce(xs)
+        for x, o in zip(xs, outs):
+            np.testing.assert_allclose(o, x.asnumpy(), rtol=1e-5)
+
+    def test_allgather(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        x = FakeNDArray(rng.standard_normal((2, 3)))
+        out = np.asarray(hvd_mx.allgather(x))
+        assert out.shape == (2 * hvd.size(), 3)
+        np.testing.assert_allclose(out[:2], x.asnumpy(), rtol=1e-6)
+
+    def test_broadcast_and_barrier(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        x = FakeNDArray(rng.standard_normal((4,)))
+        out = hvd_mx.broadcast(x, root_rank=0)
+        np.testing.assert_allclose(out, x.asnumpy(), rtol=1e-6)
+        hvd_mx.barrier()
+
+    def test_alltoall_even_and_splits(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        n = hvd.size()
+        x = FakeNDArray(rng.standard_normal((n, 2)))
+        out = hvd_mx.alltoall(x)
+        assert np.asarray(out).shape == (n, 2)
+        out, recv = hvd_mx.alltoall(x, splits=[1] * n)
+        assert np.asarray(out).shape[0] == n
+        assert list(np.asarray(recv)) == [1] * n
+
+    def test_reducescatter(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        n = hvd.size()
+        x = FakeNDArray(rng.standard_normal((n * 2, 3)))
+        out = np.asarray(hvd_mx.reducescatter(x, op=hvd_mx.Sum))
+        assert out.shape == (2, 3)
+        np.testing.assert_allclose(out, x.asnumpy()[:2] * n, rtol=1e-5)
+
+
+class TestMxnetOptimizer:
+    def test_distributed_optimizer_updates(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        opt = hvd_mx.DistributedOptimizer(FakeSGD(lr=1.0))
+        w = FakeNDArray(np.zeros(3))
+        g = FakeNDArray(np.ones(3))
+        opt.update(0, w, g, None)
+        # Average over identical replicas == g; w = -lr * g
+        np.testing.assert_allclose(w.asnumpy(), -np.ones(3), rtol=1e-5)
+        assert opt._optimizer.updates == [0]
+
+    def test_grouped_update_and_predivide(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        opt = hvd_mx.DistributedOptimizer(FakeSGD(lr=1.0),
+                                          gradient_predivide_factor=2.0)
+        ws = [FakeNDArray(np.zeros(2)) for _ in range(2)]
+        gs = [FakeNDArray(np.full(2, 4.0)) for _ in range(2)]
+        opt.update([0, 1], ws, gs, [None, None])
+        # predivide 2.0 -> grads halved before the average
+        for w in ws:
+            np.testing.assert_allclose(w.asnumpy(), -np.full(2, 2.0),
+                                       rtol=1e-5)
+
+    def test_getattr_passthrough(self, hvd):
+        import horovod_tpu.mxnet as hvd_mx
+        opt = hvd_mx.DistributedOptimizer(FakeSGD(lr=0.5))
+        assert opt.lr == 0.5
+        opt.set_learning_rate(0.25)
+        assert opt._optimizer.lr == 0.25
+
+    def test_trainer_requires_mxnet(self, hvd):
+        import horovod_tpu.mxnet as hvd_mx
+        try:
+            import mxnet  # noqa: F401
+            pytest.skip("mxnet installed")
+        except ImportError:
+            pass
+        with pytest.raises(ImportError, match="DistributedTrainer requires"):
+            hvd_mx.DistributedTrainer({}, "sgd")
+
+
+class TestMxnetBroadcastParameters:
+    def test_dict_of_arrays(self, hvd, rng):
+        import horovod_tpu.mxnet as hvd_mx
+        params = {"a": FakeNDArray(rng.standard_normal((3,))),
+                  "b": FakeNDArray(rng.standard_normal((2, 2)))}
+        want = {k: v.asnumpy().copy() for k, v in params.items()}
+        hvd_mx.broadcast_parameters(params, root_rank=0)
+        for k in params:
+            np.testing.assert_allclose(params[k].asnumpy(), want[k],
+                                       rtol=1e-6)
+
+    def test_broadcast_object(self, hvd):
+        import horovod_tpu.mxnet as hvd_mx
+        obj = {"epoch": 3, "xs": [1, 2, 3]}
+        assert hvd_mx.broadcast_object(obj, root_rank=0) == obj
