@@ -7,8 +7,10 @@ model. Gated: importing this package works without ray; constructing an
 executor requires it.
 """
 
+from horovod_tpu.ray.elastic import RayHostDiscovery, run_elastic
 from horovod_tpu.ray.runner import RayExecutor
 from horovod_tpu.ray.strategy import (placement_bundles, ray_available,
                                       worker_env)
 
-__all__ = ["RayExecutor", "placement_bundles", "worker_env", "ray_available"]
+__all__ = ["RayExecutor", "RayHostDiscovery", "run_elastic",
+           "placement_bundles", "worker_env", "ray_available"]

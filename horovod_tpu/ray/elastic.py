@@ -1,0 +1,90 @@
+"""Elastic horovod_tpu on Ray.
+
+Reference: horovod/ray/elastic_v2.py — ``RayHostDiscovery`` (:40-72) derives
+the available hosts/slots from live Ray cluster state, plugged into the
+elastic driver in place of a discovery script; the elastic adapter then
+spawns/retires workers as nodes come and go.
+"""
+
+from horovod_tpu.runner.elastic.discovery import HostDiscovery
+
+
+class RayHostDiscovery(HostDiscovery):
+    """Host discovery over ``ray.nodes()``
+    (reference: elastic_v2.py:40-72 RayHostDiscovery).
+
+    Args:
+        use_tpu: count ``TPU`` resources as slots (else CPUs).
+        cpus_per_slot / tpus_per_slot: resource units consumed per worker
+            slot on a host.
+    """
+
+    def __init__(self, use_tpu=False, cpus_per_slot=1, tpus_per_slot=1):
+        self.use_tpu = use_tpu
+        self.cpus_per_slot = max(1, int(cpus_per_slot))
+        self.tpus_per_slot = max(1, int(tpus_per_slot))
+
+    def find_available_hosts_and_slots(self):
+        import ray
+
+        hosts = {}
+        for node in ray.nodes():
+            if not node.get("Alive", False):
+                continue
+            resources = node.get("Resources", {}) or {}
+            hostname = node.get("NodeManagerHostname") \
+                or node.get("NodeManagerAddress")
+            if not hostname:
+                continue
+            if self.use_tpu:
+                slots = int(resources.get("TPU", 0)) // self.tpus_per_slot
+            else:
+                slots = int(resources.get("CPU", 0)) // self.cpus_per_slot
+            if slots > 0:
+                hosts[hostname] = slots
+        return hosts
+
+
+def run_elastic(fn, args=(), kwargs=None, min_np=1, max_np=None,
+                reset_limit=None, use_tpu=False, cpus_per_slot=1,
+                tpus_per_slot=1, env_vars=None, start_timeout=600):
+    """Run an elastic job with hosts discovered from the Ray cluster
+    (reference: horovod/ray/elastic.py run_elastic / ElasticRayExecutor).
+
+    ``fn`` should follow the elastic contract (horovod_tpu.elastic.TpuState
+    commit/restore); workers are (re)launched over ssh onto whatever nodes
+    Ray reports alive, via the same elastic driver the CLI uses.
+    ``env_vars`` are forwarded into every worker's environment.
+    """
+    from horovod_tpu.runner import launch as launch_mod
+    from horovod_tpu.runner.api import (_TASK_CMD, _elastic_harvester,
+                                        _validate_elastic_results)
+    from horovod_tpu.runner.elastic.driver import run_elastic_driver
+    import cloudpickle
+
+    discovery = RayHostDiscovery(use_tpu=use_tpu,
+                                 cpus_per_slot=cpus_per_slot,
+                                 tpus_per_slot=tpus_per_slot)
+
+    argv = ["--min-np", str(min_np)]
+    if max_np:
+        argv += ["--max-np", str(max_np)]
+    if reset_limit is not None:
+        argv += ["--reset-limit", str(reset_limit)]
+    argv += ["--start-timeout", str(start_timeout)]
+    # The driver requires a discovery source; pass a placeholder script and
+    # substitute the Ray discovery object below.
+    argv += ["--host-discovery-script", "ray://cluster"]
+    argv += _TASK_CMD
+    parsed = launch_mod.parse_args(argv)
+
+    payload = cloudpickle.dumps((fn, tuple(args), dict(kwargs or {})))
+    harvested = {}
+    expected = {}
+    rc = run_elastic_driver(
+        parsed, harvest=_elastic_harvester(harvested, expected),
+        kv_preload={("func", "pickle"): payload},
+        discovery_override=discovery, extra_env=dict(env_vars or {}))
+    if rc != 0:
+        raise RuntimeError(f"ray elastic run failed with exit code {rc}")
+    return _validate_elastic_results(harvested, expected)

@@ -36,6 +36,39 @@ def _harvester(out):
     return harvest
 
 
+def _elastic_harvester(out, expected):
+    """Elastic harvest: the driver records the final world's host count under
+    elastic/nhosts; use it so a gap in the results scope raises (via
+    :func:`_validate_elastic_results`) instead of silently truncating to the
+    contiguous prefix."""
+
+    def harvest(kv):
+        raw = kv.get("elastic", "nhosts")
+        if raw is None:
+            _harvester(out)(kv)
+            return
+        expected["n"] = int(raw.decode() if isinstance(raw, bytes) else raw)
+        for i in range(expected["n"]):
+            v = kv.get("results", str(i))
+            if v is not None:
+                out[i] = cloudpickle.loads(v)
+
+    return harvest
+
+
+def _validate_elastic_results(harvested, expected):
+    n = expected.get("n")
+    if n is not None:
+        missing = [i for i in range(n) if i not in harvested]
+        if missing:
+            raise RuntimeError(
+                f"elastic run completed but results from host indices "
+                f"{missing} were not reported")
+    elif not harvested:
+        raise RuntimeError("elastic run completed but no results reported")
+    return [harvested[i] for i in sorted(harvested)]
+
+
 def run(func, args=(), kwargs=None, np=None, hosts=None, hostfile=None,
         use_ssh=None, ssh_port=None, ssh_identity_file=None, verbose=False,
         extra_env=None):
@@ -124,33 +157,9 @@ def run_elastic(func, args=(), kwargs=None, min_np=1, max_np=None,
     parsed = launch_mod.parse_args(argv)
     harvested = {}
     expected = {}
-
-    def harvest(kv):
-        # The elastic driver records the final world's host count under
-        # elastic/nhosts; use it so a gap in the results scope raises
-        # instead of silently truncating to the contiguous prefix.
-        raw = kv.get("elastic", "nhosts")
-        if raw is None:
-            _harvester(harvested)(kv)
-            return
-        expected["n"] = int(raw.decode()
-                            if isinstance(raw, bytes) else raw)
-        for i in range(expected["n"]):
-            v = kv.get("results", str(i))
-            if v is not None:
-                harvested[i] = cloudpickle.loads(v)
-
-    rc = run_elastic_driver(parsed, harvest=harvest,
+    rc = run_elastic_driver(parsed,
+                            harvest=_elastic_harvester(harvested, expected),
                             kv_preload={("func", "pickle"): payload})
     if rc != 0:
         raise RuntimeError(f"elastic run failed with exit code {rc}")
-    n = expected.get("n")
-    if n is not None:
-        missing = [i for i in range(n) if i not in harvested]
-        if missing:
-            raise RuntimeError(
-                f"elastic run completed but results from host indices "
-                f"{missing} were not reported")
-    elif not harvested:
-        raise RuntimeError("elastic run completed but no results reported")
-    return [harvested[i] for i in sorted(harvested)]
+    return _validate_elastic_results(harvested, expected)

@@ -145,12 +145,16 @@ class ElasticDriver:
                 self.update_host_assignments(hosts)
 
 
-def run_elastic_driver(args, kv_preload=None, harvest=None):
+def run_elastic_driver(args, kv_preload=None, harvest=None,
+                       discovery_override=None, extra_env=None):
     """CLI glue for ``hvdrun --min-np … --host-discovery-script …``.
 
     ``kv_preload`` seeds the KV store before workers start (e.g. the pickled
     function for the ``run_elastic()`` API); ``harvest(kv)`` runs after a
-    successful job to collect worker-reported results."""
+    successful job to collect worker-reported results;
+    ``discovery_override`` substitutes a :class:`HostDiscovery` object for
+    the script/hosts sources (e.g. Ray cluster discovery,
+    horovod_tpu/ray/elastic.py)."""
     import socket
 
     from horovod_tpu.runner.elastic.discovery import (FixedHosts,
@@ -160,7 +164,9 @@ def run_elastic_driver(args, kv_preload=None, harvest=None):
     from horovod_tpu.runner.launch import _free_port, build_worker_env
     from horovod_tpu.runner.hosts import (host_assignment_by_host, parse_hosts)
 
-    if args.host_discovery_script:
+    if discovery_override is not None:
+        discovery = discovery_override
+    elif args.host_discovery_script:
         discovery = HostDiscoveryScript(args.host_discovery_script,
                                         args.slots_per_host or 1)
     elif args.hosts:
@@ -198,9 +204,9 @@ def run_elastic_driver(args, kv_preload=None, harvest=None):
         kv.put("elastic", "nhosts", str(len(by_host)).encode())
         kv.put("elastic", "version", str(version).encode())
         for host, slots in by_host.items():
-            env = build_worker_env({"HOROVOD_ELASTIC": "1"}, slots,
-                                   coordinator_addr, coordinator_port,
-                                   kv_port, args)
+            env = build_worker_env(
+                {**(extra_env or {}), "HOROVOD_ELASTIC": "1"}, slots,
+                coordinator_addr, coordinator_port, kv_port, args)
             w = WorkerProcess(host, args.command, env, tag=f"{host}@v{version}")
             with state["lock"]:
                 state["workers"][host] = w

@@ -21,6 +21,27 @@ def _to_pandas(df):
     return df
 
 
+def materialize_dataframe(store, df, feature_cols, label_cols):
+    """DataFrame → Parquet in the store → (X, y) numpy arrays — the shared
+    data path of every estimator (the reference writes Parquet for petastorm
+    readers; we read it back with pyarrow — same durability contract,
+    TPU-friendly dense batches)."""
+    pdf = _to_pandas(df)
+    path = store.get_train_data_path()
+    store.make_dirs(os.path.dirname(path) or ".")
+    # Written for durability (resume / remote trainers); the in-memory
+    # frame is already the exact data, so no read-back round trip.
+    pdf.to_parquet(path + ".parquet")
+    X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
+                  for c in feature_cols], axis=-1)
+    if X.ndim > 2 and X.shape[-1] == 1:
+        X = X[..., 0]
+    y = np.stack([np.asarray(pdf[c].tolist()) for c in label_cols], axis=-1)
+    if y.shape[-1] == 1:
+        y = y[..., 0]
+    return X, y
+
+
 class TpuEstimator:
     """Train a flax model from a DataFrame (reference: KerasEstimator
     spark/keras/estimator.py:91 — params mirrored where meaningful).
@@ -55,24 +76,8 @@ class TpuEstimator:
     # -- data -------------------------------------------------------------
 
     def _materialize(self, df):
-        """DataFrame → Parquet in the store → numpy arrays (the reference
-        writes Parquet for petastorm readers; we read it back with pyarrow —
-        same durability contract, TPU-friendly dense batches)."""
-        pdf = _to_pandas(df)
-        path = self.store.get_train_data_path()
-        self.store.make_dirs(os.path.dirname(path) or ".")
-        # Written for durability (resume / remote trainers); the in-memory
-        # frame is already the exact data, so no read-back round trip.
-        pdf.to_parquet(path + ".parquet")
-        X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
-                      for c in self.feature_cols], axis=-1)
-        if X.ndim > 2 and X.shape[-1] == 1:
-            X = X[..., 0]
-        y = np.stack([np.asarray(pdf[c].tolist())
-                      for c in self.label_cols], axis=-1)
-        if y.shape[-1] == 1:
-            y = y[..., 0]
-        return X, y
+        return materialize_dataframe(self.store, df, self.feature_cols,
+                                     self.label_cols)
 
     # -- training ---------------------------------------------------------
 

@@ -14,7 +14,7 @@ import os
 
 import numpy as np
 
-from horovod_tpu.spark.estimator import _to_pandas
+from horovod_tpu.spark.estimator import _to_pandas, materialize_dataframe
 from horovod_tpu.spark.store import LocalStore
 
 
@@ -60,14 +60,8 @@ class KerasEstimator:
         if not hvd_keras.is_initialized():
             hvd_keras.init()
 
-        pdf = _to_pandas(df)
-        path = self.store.get_train_data_path()
-        self.store.make_dirs(os.path.dirname(path) or ".")
-        pdf.to_parquet(path + ".parquet")
-        X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
-                      for c in self.feature_cols], axis=-1)
-        y = np.stack([np.asarray(pdf[c].tolist())
-                      for c in self.label_cols], axis=-1)
+        X, y = materialize_dataframe(self.store, df, self.feature_cols,
+                                     self.label_cols)
 
         run_id = self.run_id or self.store.new_run_id()
         ckpt_dir = self.store.get_checkpoint_path(run_id)

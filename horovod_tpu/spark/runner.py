@@ -89,3 +89,37 @@ def _free_port():
     with socket.socket() as s:
         s.bind(("", 0))
         return s.getsockname()[1]
+
+
+def run_elastic(fn, args=(), kwargs=None, num_proc=None, min_np=1,
+                max_np=None, reset_limit=3, extra_env=None, verbose=True):
+    """Elastic run over Spark (reference: spark/runner.py:312 run_elastic).
+
+    Spark owns task placement, so elasticity is job-level here: on worker
+    failure the barrier job is retried with whatever parallelism the cluster
+    currently offers, clamped to ``[min_np, max_np]``, up to ``reset_limit``
+    resets — the role the reference's elastic driver plays over its
+    long-running Spark task services. ``fn`` should follow the elastic
+    contract (durable checkpoints / TpuState) so retries resume rather than
+    restart.
+    """
+    if not spark_available():
+        raise RuntimeError(
+            "horovod_tpu.spark.run_elastic requires pyspark; install it or "
+            "use horovod_tpu.runner.api.run_elastic directly")
+    from pyspark.sql import SparkSession
+
+    sc = SparkSession.builder.getOrCreate().sparkContext
+    resets = 0
+    last_err = None
+    while resets <= (reset_limit if reset_limit is not None else 3):
+        avail = num_proc or max(sc.defaultParallelism, 1)
+        np_now = max(min_np, min(avail, max_np or avail))
+        try:
+            return run(fn, args=args, kwargs=kwargs, num_proc=np_now,
+                       extra_env=extra_env, verbose=verbose)
+        except Exception as e:  # Py4J wraps worker failures opaquely
+            last_err = e
+            resets += 1
+    raise RuntimeError(
+        f"spark elastic run failed after {resets} resets") from last_err

@@ -16,8 +16,7 @@ import os
 
 import numpy as np
 
-from horovod_tpu.spark.estimator import TpuModel as _BaseModel  # noqa: F401
-from horovod_tpu.spark.estimator import _to_pandas
+from horovod_tpu.spark.estimator import _to_pandas, materialize_dataframe
 from horovod_tpu.spark.store import LocalStore
 
 
@@ -55,17 +54,8 @@ class TorchEstimator:
         self.backward_passes_per_step = backward_passes_per_step
 
     def _materialize(self, df):
-        pdf = _to_pandas(df)
-        path = self.store.get_train_data_path()
-        self.store.make_dirs(os.path.dirname(path) or ".")
-        pdf.to_parquet(path + ".parquet")
-        X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
-                      for c in self.feature_cols], axis=-1)
-        y = np.stack([np.asarray(pdf[c].tolist())
-                      for c in self.label_cols], axis=-1)
-        if y.shape[-1] == 1:
-            y = y[..., 0]
-        return X, y
+        return materialize_dataframe(self.store, df, self.feature_cols,
+                                     self.label_cols)
 
     def fit(self, df):
         import torch

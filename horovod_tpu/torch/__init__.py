@@ -43,6 +43,7 @@ from horovod_tpu.torch.mpi_ops import (allgather, allgather_async, allreduce,
                                        synchronize)
 from horovod_tpu.torch.optimizer import DistributedOptimizer
 from horovod_tpu.torch.elastic import ElasticSampler, TorchState
+from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "local_rank", "cross_rank",
@@ -57,5 +58,5 @@ __all__ = [
     "grouped_reducescatter", "barrier", "join", "poll", "synchronize",
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "DistributedOptimizer", "ElasticSampler",
-    "TorchState",
+    "TorchState", "SyncBatchNorm",
 ]

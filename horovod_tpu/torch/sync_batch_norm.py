@@ -1,0 +1,146 @@
+"""Synchronized BatchNorm for torch models.
+
+Reference: horovod/torch/sync_batch_norm.py (218 LoC — `SyncBatchNorm`
+module whose forward allreduces mean/var/count and whose custom autograd
+backward allreduces the two gradient reduction terms `sum_dy` and
+`sum_dy_xmu`). Same math here, with the collectives riding the XLA eager
+bridge (horovod_tpu/torch/mpi_ops.py) instead of the C++ enqueue path.
+"""
+
+import torch
+from torch.autograd.function import Function
+from torch.nn.modules.batchnorm import _BatchNorm
+
+from horovod_tpu.torch import mpi_ops
+
+
+class SyncBatchNorm(_BatchNorm):
+    """BatchNorm with cross-rank statistics
+    (reference: torch/sync_batch_norm.py SyncBatchNorm).
+
+    During training, batch statistics are averaged over all ranks so small
+    per-rank batches normalize with global statistics; eval uses the running
+    stats as usual.
+    """
+
+    def __init__(self, num_features, eps=1e-5, momentum=0.1, affine=True,
+                 track_running_stats=True, process_set=None):
+        super().__init__(num_features, eps, momentum, affine,
+                         track_running_stats)
+        self.process_set = process_set
+
+    def _check_input_dim(self, input):
+        if input.dim() < 2:
+            raise ValueError(
+                f"expected at least 2D input (got {input.dim()}D input)")
+
+    def forward(self, input):
+        self._check_input_dim(input)
+        momentum = self.momentum
+        if self.training and self.track_running_stats:
+            if self.num_batches_tracked is not None:
+                self.num_batches_tracked.add_(1)
+                if momentum is None:
+                    # Cumulative moving average, the _BatchNorm contract for
+                    # momentum=None.
+                    momentum = 1.0 / float(self.num_batches_tracked)
+
+        if not self.training and self.track_running_stats:
+            return torch.nn.functional.batch_norm(
+                input, self.running_mean, self.running_var, self.weight,
+                self.bias, False, 0.0, self.eps)
+        # Training — or eval without running stats, where _BatchNorm
+        # normalizes with (synced) batch statistics.
+        return _SyncBatchNormFn.apply(
+            input, self.weight, self.bias,
+            self.running_mean if self.training else None,
+            self.running_var if self.training else None,
+            self.eps, momentum, self.process_set)
+
+
+class _SyncBatchNormFn(Function):
+    """reference: torch/sync_batch_norm.py _SyncBatchNorm Function."""
+
+    @staticmethod
+    def forward(ctx, input, weight, bias, running_mean, running_var, eps,
+                momentum, process_set):
+        x = input.contiguous()
+        reduce_dims = [0] + list(range(2, x.dim()))
+        count = x.numel() // x.shape[1]
+
+        local_mean = x.mean(dim=reduce_dims)
+        local_sqmean = (x * x).mean(dim=reduce_dims)
+        # Average over ranks == global moments (equal per-rank counts, the
+        # reference's count-weighted path reduces to this under the bridge's
+        # replicated-host model).
+        stats = torch.cat([local_mean, local_sqmean]).detach()
+        stats = mpi_ops.allreduce(stats, op=mpi_ops.Average,
+                                  process_set=process_set,
+                                  name="sync_batch_norm.stats")
+        mean, sqmean = stats[:x.shape[1]], stats[x.shape[1]:]
+        var = sqmean - mean * mean
+        invstd = torch.rsqrt(var + eps)
+
+        # Distinct samples live per HOST (the bridge replicates a host's
+        # tensor onto its chips), so global sample counts scale by the
+        # number of hosts, not chips.
+        world = max(1, mpi_ops.basics.cross_size())
+        if running_mean is not None:
+            n_total = count * world
+            unbiased = var * (n_total / max(n_total - 1, 1))
+            running_mean.mul_(1 - momentum).add_(momentum * mean.detach())
+            running_var.mul_(1 - momentum).add_(momentum * unbiased.detach())
+
+        shape = [1, -1] + [1] * (x.dim() - 2)
+        xhat = (x - mean.reshape(shape)) * invstd.reshape(shape)
+        out = xhat
+        if weight is not None:
+            out = out * weight.reshape(shape)
+        if bias is not None:
+            out = out + bias.reshape(shape)
+
+        ctx.save_for_backward(x, weight, mean, invstd)
+        ctx.process_set = process_set
+        # Backward divisor: a chip-axis Sum counts each host's contribution
+        # local_size times, so the normalizer is count * chips (not hosts).
+        ctx.n_total = count * (process_set.size() if process_set is not None
+                               else mpi_ops.basics.size())
+        return out
+
+    @staticmethod
+    def backward(ctx, grad_output):
+        x, weight, mean, invstd = ctx.saved_tensors
+        process_set = ctx.process_set
+        g = grad_output.contiguous()
+        reduce_dims = [0] + list(range(2, x.dim()))
+        shape = [1, -1] + [1] * (x.dim() - 2)
+
+        xmu = x - mean.reshape(shape)
+        sum_dy = g.sum(dim=reduce_dims)
+        sum_dy_xmu = (g * xmu).sum(dim=reduce_dims)
+
+        # The two reduction terms are means over the GLOBAL batch
+        # (reference: backward allreduces sum_dy/sum_dy_xmu then divides by
+        # the global count).
+        red = torch.cat([sum_dy, sum_dy_xmu]).detach()
+        red = mpi_ops.allreduce(red, op=mpi_ops.Sum, process_set=process_set,
+                                name="sync_batch_norm.grads")
+        mean_dy = red[:x.shape[1]] / ctx.n_total
+        mean_dy_xmu = red[x.shape[1]:] / ctx.n_total
+
+        gamma = weight if weight is not None else torch.ones_like(mean)
+        grad_input = (
+            g - mean_dy.reshape(shape)
+            - xmu * (invstd * invstd * mean_dy_xmu).reshape(shape)
+        ) * (invstd * gamma).reshape(shape)
+
+        grad_weight = None
+        if weight is not None and ctx.needs_input_grad[1]:
+            grad_weight = (g * xmu * invstd.reshape(shape)).sum(
+                dim=reduce_dims)
+        grad_bias = None
+        if ctx.needs_input_grad[2]:
+            grad_bias = sum_dy
+
+        return grad_input, grad_weight, grad_bias, None, None, None, None, \
+            None

@@ -245,3 +245,37 @@ class TestLightningEstimator:
         with pytest.raises(ImportError, match="LightningEstimator requires"):
             LightningEstimator(model=None, feature_cols=["f"],
                                label_cols=["l"])
+
+
+class TestRayElastic:
+    def test_host_discovery_parses_nodes(self, monkeypatch):
+        from horovod_tpu.ray.elastic import RayHostDiscovery
+
+        class FakeRay:
+            @staticmethod
+            def nodes():
+                return [
+                    {"Alive": True, "NodeManagerHostname": "h1",
+                     "Resources": {"CPU": 4.0}},
+                    {"Alive": True, "NodeManagerHostname": "h2",
+                     "Resources": {"CPU": 2.0, "TPU": 8.0}},
+                    {"Alive": False, "NodeManagerHostname": "h3",
+                     "Resources": {"CPU": 16.0}},
+                    {"Alive": True, "NodeManagerHostname": "h4",
+                     "Resources": {}},
+                ]
+
+        import sys
+        monkeypatch.setitem(sys.modules, "ray", FakeRay)
+        d = RayHostDiscovery(cpus_per_slot=2)
+        assert d.find_available_hosts_and_slots() == {"h1": 2, "h2": 1}
+        d = RayHostDiscovery(use_tpu=True, tpus_per_slot=4)
+        assert d.find_available_hosts_and_slots() == {"h2": 2}
+
+    def test_spark_run_elastic_requires_pyspark(self):
+        import importlib.util
+        if importlib.util.find_spec("pyspark") is not None:
+            pytest.skip("pyspark installed")
+        from horovod_tpu.spark import run_elastic
+        with pytest.raises(RuntimeError, match="requires pyspark"):
+            run_elastic(lambda: None)

@@ -331,3 +331,105 @@ class TestTorchState:
         for k, v in model.state_dict().items():
             torch.testing.assert_close(v, before[k])
         assert state.epoch == 3
+
+
+class TestTorchSyncBatchNorm:
+    def test_forward_matches_batchnorm(self, hvd, rng):
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        x = torch.as_tensor(
+            np.asarray(rng.standard_normal((8, 4, 3)), np.float32))
+        sbn = hvd_torch.SyncBatchNorm(4, momentum=0.1)
+        bn = torch.nn.BatchNorm1d(4, momentum=0.1)
+        sbn.train(); bn.train()
+        out_s = sbn(x)
+        out_b = bn(x)
+        # Single-host bridge: global stats == local stats.
+        np.testing.assert_allclose(out_s.detach().numpy(),
+                                   out_b.detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(sbn.running_mean.numpy(),
+                                   bn.running_mean.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(sbn.running_var.numpy(),
+                                   bn.running_var.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_backward_matches_batchnorm(self, hvd, rng):
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        xa = np.asarray(rng.standard_normal((6, 3, 5)), np.float32)
+        x1 = torch.as_tensor(xa.copy(), dtype=torch.float32).requires_grad_()
+        x2 = torch.as_tensor(xa.copy(), dtype=torch.float32).requires_grad_()
+        sbn = hvd_torch.SyncBatchNorm(3)
+        bn = torch.nn.BatchNorm1d(3)
+        sbn.train(); bn.train()
+        sbn(x1).square().sum().backward()
+        bn(x2).square().sum().backward()
+        np.testing.assert_allclose(x1.grad.numpy(), x2.grad.numpy(),
+                                   rtol=1e-3, atol=1e-4)
+        np.testing.assert_allclose(sbn.weight.grad.numpy(),
+                                   bn.weight.grad.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+        np.testing.assert_allclose(sbn.bias.grad.numpy(),
+                                   bn.bias.grad.numpy(), rtol=1e-3,
+                                   atol=1e-4)
+
+    def test_eval_uses_running_stats(self, hvd, rng):
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        sbn = hvd_torch.SyncBatchNorm(2)
+        x = torch.as_tensor(
+            np.asarray(rng.standard_normal((16, 2)), np.float32))
+        sbn.train(); sbn(x)
+        sbn.eval()
+        y = sbn(x)
+        assert y.shape == x.shape
+        assert int(sbn.num_batches_tracked) == 1
+
+    def test_rejects_1d_input(self, hvd):
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        with pytest.raises(ValueError, match="at least 2D"):
+            hvd_torch.SyncBatchNorm(2)(torch.ones(3))
+
+    def test_momentum_none_cumulative_average(self, hvd, rng):
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        sbn = hvd_torch.SyncBatchNorm(3, momentum=None)
+        bn = torch.nn.BatchNorm1d(3, momentum=None)
+        sbn.train(); bn.train()
+        for _ in range(3):
+            x = torch.as_tensor(
+                np.asarray(rng.standard_normal((10, 3)), np.float32))
+            sbn(x); bn(x)
+        np.testing.assert_allclose(sbn.running_mean.numpy(),
+                                   bn.running_mean.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+        np.testing.assert_allclose(sbn.running_var.numpy(),
+                                   bn.running_var.numpy(), rtol=1e-4,
+                                   atol=1e-5)
+
+    def test_no_running_stats_eval_uses_batch_stats(self, hvd, rng):
+        import torch
+
+        import horovod_tpu.torch as hvd_torch
+
+        sbn = hvd_torch.SyncBatchNorm(2, track_running_stats=False)
+        bn = torch.nn.BatchNorm1d(2, track_running_stats=False)
+        x = torch.as_tensor(
+            np.asarray(rng.standard_normal((12, 2)), np.float32))
+        sbn.eval(); bn.eval()
+        np.testing.assert_allclose(sbn(x).detach().numpy(),
+                                   bn(x).detach().numpy(), rtol=1e-4,
+                                   atol=1e-5)
