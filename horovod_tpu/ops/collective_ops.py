@@ -649,10 +649,27 @@ def allreduce_async(tensor, op=Average, prescale_factor=1.0,
 
 def grouped_allreduce_async(tensors, op=Average, prescale_factor=1.0,
                             postscale_factor=1.0, process_set=None, name=None):
-    out = grouped_allreduce(tensors, op=op, prescale_factor=prescale_factor,
-                            postscale_factor=postscale_factor,
-                            process_set=process_set, name=name)
-    return Handle(out, name)
+    """Async grouped allreduce through the fusion runtime: the group
+    completes atomically and same-signature groups ride ONE fused bucket
+    (reference: grouped enqueue + GroupTable, operations.cc:1480,
+    group_table.h). Process-set groups bypass fusion like allreduce_async."""
+    if process_set is not None and process_set.ranks is not None:
+        out = grouped_allreduce(tensors, op=op,
+                                prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor,
+                                process_set=process_set, name=name)
+        return Handle(out, name)
+    from horovod_tpu.ops.fusion import get_runtime
+    ts = [t if hasattr(t, "ndim") else np.asarray(t) for t in tensors]
+    n = basics.size()
+    for t in ts:
+        _check_stacked(t, n, "grouped_allreduce_async")
+        if op == Average and not _is_float(_dtype_of(t)):
+            raise ValueError(
+                "Average is not supported for integer tensors; use hvd.Sum "
+                "(matches reference torch/mpi_ops.py checks).")
+    return get_runtime().enqueue_grouped_allreduce(
+        ts, op, prescale_factor, postscale_factor, name)
 
 
 def allgather_async(tensor, process_set=None, name=None):

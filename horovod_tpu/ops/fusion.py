@@ -126,6 +126,7 @@ class FusionRuntime:
         self._pending = []  # (tid, tensor, op, prescale, postscale, handle)
         self._pending_bytes = 0
         self._next_tid = 0
+        self._flushed_groups = []  # group ids to deregister after flush
         # Native C++ scheduler for the per-step bookkeeping (bucket assembly,
         # LRU response-cache stats, group table); Python fallback below is
         # behavior-identical (reference: the C++ cycle loop/fusion manager,
@@ -180,6 +181,44 @@ class FusionRuntime:
                 self._flush_locked()
         return handle
 
+    def enqueue_grouped_allreduce(self, tensors, op, prescale, postscale,
+                                  name=None):
+        """Grouped async allreduce: the whole group completes in one flush
+        (reference: grouped collectives complete atomically via the
+        GroupTable, group_table.h). Same-signature groups are additionally
+        registered with the native group table so they share ONE fused
+        bucket regardless of the threshold — the reference fuses only
+        same-dtype responses, so mixed-signature groups are enqueued
+        individually (still atomic: one flush covers all pending buckets)."""
+        handles = [FusedHandle(self, f"{name}.{i}" if name else None)
+                   for i in range(len(tensors))]
+        op = ReduceOp(op)
+        with self._lock:
+            tids = list(range(self._next_tid,
+                              self._next_tid + len(tensors)))
+            self._next_tid += len(tensors)
+            keys = [self._bucket_key(t, op, prescale, postscale)
+                    for t in tensors]
+            if self._native is not None and len(set(keys)) == 1 \
+                    and len(tensors) > 1:
+                self._flushed_groups.append(
+                    self._native.register_group(tids))
+            flush = False
+            for tid, t, key, h in zip(tids, tensors, keys, handles):
+                self._pending.append((tid, t, op, float(prescale),
+                                      float(postscale), h))
+                self._pending_bytes += t.nbytes
+                if self._native is not None:
+                    flush |= self._native.enqueue(tid, hash(key), t.nbytes)
+            if self._stall_inspector is not None:
+                self._stall_inspector.record_enqueue(name or "grouped")
+            if self._native is not None:
+                if flush:
+                    self._flush_locked()
+            elif self._pending_bytes >= self.threshold:
+                self._flush_locked()
+        return GroupedFusedHandle(handles, name)
+
     def flush_all(self):
         with self._lock:
             self._flush_locked()
@@ -227,6 +266,11 @@ class FusionRuntime:
         buckets = {}
         if self._native is not None:
             assignment = self._native.flush()
+            # Groups live exactly one flush (reference: DeregisterGroups
+            # after the grouped response completes).
+            for gid in self._flushed_groups:
+                self._native.deregister_group(gid)
+            self._flushed_groups = []
             for tid, t, op, pre, post, h in pending:
                 bid = assignment.get(tid)
                 buckets.setdefault((op, pre, post, bid), []).append((t, h))
@@ -258,6 +302,24 @@ class FusionRuntime:
                 outs = prog(*tensors)
             for (_, h), o in zip(items, outs):
                 h._set(o)
+
+
+class GroupedFusedHandle:
+    """One handle for a whole grouped enqueue; resolves to the list of
+    reduced tensors (reference: grouped ops return one handle,
+    torch/mpi_ops.py grouped_allreduce_async)."""
+
+    __slots__ = ("_handles", "name")
+
+    def __init__(self, handles, name):
+        self._handles = handles
+        self.name = name
+
+    def poll(self):
+        return all(h.poll() for h in self._handles)
+
+    def synchronize(self):
+        return [h.synchronize() for h in self._handles]
 
 
 def get_runtime():

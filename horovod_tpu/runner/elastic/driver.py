@@ -38,6 +38,7 @@ class ElasticDriver:
         self._shutdown_fn = shutdown_fn or (lambda reason: None)
 
         self._assignment = []          # list[SlotInfo]
+        self._last_hosts = None        # last discovered {host: slots}
         self._host_order = []          # rank-ordered hostnames
         self._version = 0
         self._reset_count = 0
@@ -87,8 +88,12 @@ class ElasticDriver:
             self._shutdown.wait(DISCOVER_INTERVAL_SECS)
 
     def _maybe_update(self, hosts):
-        current = {s.hostname for s in self._assignment}
-        if set(hosts.keys()) == current and self._assignment:
+        # Compare against the last DISCOVERED hosts (per-host slot counts,
+        # not just the host set: resource-based discovery, e.g.
+        # RayHostDiscovery, can resize a host in place). Comparing against
+        # the assignment would churn whenever max_np clamps it below the
+        # available slots.
+        if hosts == self._last_hosts and self._assignment:
             return
         if sum(hosts.values()) < self._min_np:
             hvd_logging.warning(
@@ -100,6 +105,7 @@ class ElasticDriver:
     def update_host_assignments(self, hosts):
         """Recompute SlotInfos, preserving the rank order of surviving hosts
         so their state stays rank-stable (reference: driver.py:240-283)."""
+        self._last_hosts = dict(hosts)
         with self._assignment_cv:
             surviving = [h for h in self._host_order if h in hosts]
             new = [h for h in hosts if h not in surviving]

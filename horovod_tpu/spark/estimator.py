@@ -21,6 +21,18 @@ def _to_pandas(df):
     return df
 
 
+def features_from_dataframe(pdf, feature_cols):
+    """Feature matrix with the estimator family's canonical shape rule: one
+    trailing singleton axis from a single vector-valued column is squeezed.
+    Used by BOTH fit (via :func:`materialize_dataframe`) and every model's
+    ``transform`` so the two always feed the model the same shape."""
+    X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
+                  for c in feature_cols], axis=-1)
+    if X.ndim > 2 and X.shape[-1] == 1:
+        X = X[..., 0]
+    return X
+
+
 def materialize_dataframe(store, df, feature_cols, label_cols):
     """DataFrame → Parquet in the store → (X, y) numpy arrays — the shared
     data path of every estimator (the reference writes Parquet for petastorm
@@ -32,10 +44,7 @@ def materialize_dataframe(store, df, feature_cols, label_cols):
     # Written for durability (resume / remote trainers); the in-memory
     # frame is already the exact data, so no read-back round trip.
     pdf.to_parquet(path + ".parquet")
-    X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
-                  for c in feature_cols], axis=-1)
-    if X.ndim > 2 and X.shape[-1] == 1:
-        X = X[..., 0]
+    X = features_from_dataframe(pdf, feature_cols)
     y = np.stack([np.asarray(pdf[c].tolist()) for c in label_cols], axis=-1)
     if y.shape[-1] == 1:
         y = y[..., 0]
@@ -165,10 +174,7 @@ class TpuModel:
 
     def transform(self, df):
         pdf = _to_pandas(df).copy()
-        X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
-                      for c in self.feature_cols], axis=-1)
-        if X.ndim > 2 and X.shape[-1] == 1:
-            X = X[..., 0]
+        X = features_from_dataframe(pdf, self.feature_cols)
         preds = self.predict(X)
         for j, col in enumerate(self.label_cols):
             pdf[f"{col}__output"] = list(

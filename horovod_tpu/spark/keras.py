@@ -14,7 +14,8 @@ import os
 
 import numpy as np
 
-from horovod_tpu.spark.estimator import _to_pandas, materialize_dataframe
+from horovod_tpu.spark.estimator import (_to_pandas, features_from_dataframe,
+                                         materialize_dataframe)
 from horovod_tpu.spark.store import LocalStore
 
 
@@ -100,8 +101,7 @@ class KerasModel:
 
     def transform(self, df):
         pdf = _to_pandas(df).copy()
-        X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
-                      for c in self.feature_cols], axis=-1)
+        X = features_from_dataframe(pdf, self.feature_cols)
         out = np.asarray(self.model.predict(X, verbose=0))
         if out.ndim == 1:
             out = out[:, None]

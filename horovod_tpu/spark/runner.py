@@ -112,7 +112,9 @@ def run_elastic(fn, args=(), kwargs=None, num_proc=None, min_np=1,
     sc = SparkSession.builder.getOrCreate().sparkContext
     resets = 0
     last_err = None
-    while resets <= (reset_limit if reset_limit is not None else 3):
+    # reset_limit=None means unlimited, matching runner.api.run_elastic and
+    # the elastic driver.
+    while reset_limit is None or resets <= reset_limit:
         avail = num_proc or max(sc.defaultParallelism, 1)
         np_now = max(min_np, min(avail, max_np or avail))
         try:

@@ -16,7 +16,8 @@ import os
 
 import numpy as np
 
-from horovod_tpu.spark.estimator import _to_pandas, materialize_dataframe
+from horovod_tpu.spark.estimator import (_to_pandas, features_from_dataframe,
+                                         materialize_dataframe)
 from horovod_tpu.spark.store import LocalStore
 
 
@@ -131,8 +132,7 @@ class TorchModel:
         import torch
 
         pdf = _to_pandas(df).copy()
-        X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
-                      for c in self.feature_cols], axis=-1)
+        X = features_from_dataframe(pdf, self.feature_cols)
         with torch.no_grad():
             out = self.model(torch.as_tensor(X)).numpy()
         out = np.asarray(out)

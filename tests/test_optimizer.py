@@ -223,3 +223,56 @@ class TestSyncBatchNorm:
         flat = x.reshape(-1, 4)
         expected = (x - flat.mean(0)) / np.sqrt(flat.var(0) + 1e-5)
         np.testing.assert_allclose(out, expected, rtol=1e-3, atol=1e-4)
+
+
+class TestGroupedAsyncFusion:
+    def test_grouped_async_matches_sync(self, hvd, rng):
+        xs = [np.asarray(rng.standard_normal((N, s)), np.float32)
+              for s in (3, 7, 5)]
+        h = hvd.grouped_allreduce_async(xs, op=hvd.Sum)
+        outs = h.synchronize()
+        for x, o in zip(xs, outs):
+            np.testing.assert_allclose(np.asarray(o)[0], x.sum(0), rtol=1e-5)
+
+    def test_group_shares_one_bucket(self, hvd, rng):
+        """Same-signature group must be fused even when the threshold would
+        otherwise split it (the native group table contract)."""
+        from horovod_tpu.ops import fusion
+        from horovod_tpu.ops.fusion import get_runtime
+        rt = get_runtime()
+        if rt._native is None:
+            pytest.skip("native scheduler unavailable")
+        old = rt.threshold
+        rt.threshold = 64   # each tensor alone exceeds half the threshold
+        calls = []
+        orig = fusion._fused_program
+
+        def spy(mesh, n, op, pre, post, shapes, dtypes, wire, mask=None):
+            calls.append(len(shapes))
+            return orig(mesh, n, op, pre, post, shapes, dtypes, wire, mask)
+
+        try:
+            fusion._fused_program = spy
+            xs = [np.asarray(rng.standard_normal((N, 16)), np.float32)
+                  for _ in range(3)]
+            h = hvd.grouped_allreduce_async(xs, op=hvd.Sum)
+            h.synchronize()
+        finally:
+            fusion._fused_program = orig
+            rt.threshold = old
+        # All 3 tensors in ONE fused program despite threshold pressure.
+        assert max(calls) == 3, calls
+
+    def test_mixed_dtype_group_still_atomic(self, hvd, rng):
+        xs = [np.asarray(rng.standard_normal((N, 4)), np.float32),
+              np.asarray(rng.integers(0, 10, (N, 4)), np.int32)]
+        h = hvd.grouped_allreduce_async(xs, op=hvd.Sum)
+        outs = h.synchronize()
+        np.testing.assert_allclose(np.asarray(outs[0])[0], xs[0].sum(0),
+                                   rtol=1e-5)
+        np.testing.assert_array_equal(np.asarray(outs[1])[0], xs[1].sum(0))
+
+    def test_grouped_async_int_average_rejected(self, hvd):
+        with pytest.raises(ValueError, match="Average"):
+            hvd.grouped_allreduce_async(
+                [np.ones((N, 2), np.int32)], op=hvd.Average)
