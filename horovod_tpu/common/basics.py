@@ -152,6 +152,8 @@ def shutdown():
                 hvd_logging.warning("flush on shutdown failed: %s", e)
         if _state.timeline is not None:
             _state.timeline.close()
+        from horovod_tpu.common import negotiation
+        negotiation.reset()
         _state = None
 
 

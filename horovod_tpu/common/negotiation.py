@@ -1,0 +1,102 @@
+"""Host-side metadata negotiation for dynamic-shape collectives.
+
+The reference's controller performs a control-plane exchange before any
+dynamic-shape collective: allgather first-dims are gathered across ranks
+(reference: horovod/common/controller.cc:74 ComputeResponseList tensor-shape
+exchange) and alltoall splits are shared so every rank can size its receive
+buffers (reference: horovod/common/ops/collective_operations.h:199-268).
+
+TPU-native replacement: shapes are static *inside* XLA programs, so the only
+thing that must cross process boundaries is the tiny per-rank size metadata
+needed to build the padded program. That exchange rides the
+``jax.distributed`` coordination service's key-value store — the same
+control plane that bootstrapped the cluster — with a per-tag sequence number
+so repeated calls never collide. Single-process setups short-circuit to a
+local echo.
+
+SPMD contract (same as the reference's enqueue contract): every process must
+call the same negotiations in the same order.
+"""
+
+import json
+import threading
+
+import jax
+
+_counters = {}
+_lock = threading.Lock()
+
+# Timeout for peers to publish their metadata. Generous: a peer may be
+# compiling its previous program.
+_TIMEOUT_MS = 120_000
+
+
+def _client():
+    from jax._src import distributed
+    client = distributed.global_state.client
+    if client is None:
+        raise RuntimeError(
+            "negotiation requires the jax.distributed coordination service; "
+            "was hvd.init() called with a multi-process launcher env?")
+    return client
+
+
+def _next_seq(key):
+    with _lock:
+        seq = _counters.get(key, 0)
+        _counters[key] = seq + 1
+    return seq
+
+
+def reset():
+    """Forget per-tag sequence numbers. Called by ``basics.shutdown()`` so an
+    elastic re-init (which re-rendezvouses against a fresh coordination
+    service) starts every participant back at sequence zero — survivors and
+    replacement workers must agree on the key space."""
+    with _lock:
+        _counters.clear()
+
+
+def exchange(tag, payload, procs=None):
+    """Exchange a small JSON-serializable ``payload`` across processes.
+
+    ``procs``: sorted process indices participating (a process set's owners,
+    see ``collective_ops._mesh_processes``); defaults to every process.
+    Returns the list of payloads ordered by participant. Every participant
+    must call with the same ``tag`` in the same order (SPMD contract);
+    non-participants must not call at all — scoping the exchange to the
+    set's owners keeps them out of the rendezvous entirely.
+    """
+    if procs is None:
+        procs = list(range(jax.process_count()))
+    if len(procs) <= 1:
+        return [payload]
+    me = jax.process_index()
+    if me not in procs:
+        raise RuntimeError(
+            f"process {me} is not a participant of negotiation '{tag}' "
+            f"(participants: {procs})")
+    proc_tag = ",".join(str(p) for p in procs)
+    seq = _next_seq((tag, proc_tag))
+    client = _client()
+    base = f"hvd/neg/{tag}/{proc_tag}/{seq}"
+    client.key_value_set(f"{base}/{me}", json.dumps(payload))
+    out = []
+    for p in procs:
+        if p == me:
+            out.append(payload)
+            continue
+        raw = client.blocking_key_value_get(f"{base}/{p}", _TIMEOUT_MS)
+        out.append(json.loads(raw))
+    return out
+
+
+def exchange_sizes(tag, local_sizes, procs=None):
+    """Exchange per-rank integer size vectors; returns a flat list ordered by
+    global rank (process-major, matching the rank-major device order of
+    :func:`horovod_tpu.common.topology.build_topology`)."""
+    per_proc = exchange(tag, [int(s) for s in local_sizes], procs=procs)
+    flat = []
+    for sizes in per_proc:
+        flat.extend(int(s) for s in sizes)
+    return flat

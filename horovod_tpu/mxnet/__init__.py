@@ -51,13 +51,18 @@ class DistributedOptimizer:
         return getattr(self._optimizer, item)
 
     def _reduce(self, index, grad):
+        # Reference semantics (horovod/mxnet/__init__.py): predivide only
+        # rescales the wire intermediate — 1/f before the reduction, f after —
+        # so the net result is still the plain average.
         pre = 1.0 / self._predivide if self._predivide != 1.0 else 1.0
+        post = self._predivide if self._predivide != 1.0 else 1.0
         if isinstance(index, (tuple, list)):
             return _ops.grouped_allreduce(
                 list(grad), op=Average, prescale_factor=pre,
-                process_set=self._process_set,
+                postscale_factor=post, process_set=self._process_set,
                 name=f"grads_{index[0]}")
         return _ops.allreduce(grad, op=Average, prescale_factor=pre,
+                              postscale_factor=post,
                               process_set=self._process_set,
                               name=f"grad_{index}")
 
@@ -105,10 +110,13 @@ def DistributedTrainer(params, optimizer, optimizer_params=None,
         def _allreduce_grads(self):
             pre = (1.0 / gradient_predivide_factor
                    if gradient_predivide_factor != 1.0 else 1.0)
+            post = (gradient_predivide_factor
+                    if gradient_predivide_factor != 1.0 else 1.0)
             for i, param in enumerate(self._params):
                 if param.grad_req != "null":
                     for g in param.list_grad():
                         _ops.allreduce_(g, op=Average, prescale_factor=pre,
+                                        postscale_factor=post,
                                         process_set=process_set,
                                         name=f"param_{i}")
 

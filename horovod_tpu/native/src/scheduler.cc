@@ -8,6 +8,7 @@
 
 #include <cstdint>
 #include <list>
+#include <memory>
 #include <mutex>
 #include <unordered_map>
 #include <vector>
@@ -41,10 +42,13 @@ struct Scheduler {
 };
 
 std::mutex g_mu;
-std::unordered_map<int64_t, Scheduler*> g_registry;
+std::unordered_map<int64_t, std::shared_ptr<Scheduler>> g_registry;
 int64_t g_next_handle = 1;
 
-Scheduler* get(int64_t h) {
+// Copies the shared_ptr out under g_mu so a concurrent hvd_sched_destroy
+// cannot free the Scheduler between handle lookup and use (the returned
+// reference keeps it alive until the caller's call completes).
+std::shared_ptr<Scheduler> get(int64_t h) {
   std::lock_guard<std::mutex> l(g_mu);
   auto it = g_registry.find(h);
   return it == g_registry.end() ? nullptr : it->second;
@@ -55,29 +59,30 @@ Scheduler* get(int64_t h) {
 extern "C" {
 
 int64_t hvd_sched_create(int64_t threshold_bytes, int64_t cache_capacity) {
-  auto* s = new Scheduler();
+  auto s = std::make_shared<Scheduler>();
   s->threshold = threshold_bytes > 0 ? threshold_bytes : (64ll << 20);
   s->cache_capacity = cache_capacity > 0 ? cache_capacity : 1024;
   std::lock_guard<std::mutex> l(g_mu);
   int64_t h = g_next_handle++;
-  g_registry[h] = s;
+  g_registry[h] = std::move(s);
   return h;
 }
 
 void hvd_sched_destroy(int64_t h) {
-  Scheduler* s = nullptr;
+  // The erased shared_ptr defers deletion until in-flight calls holding a
+  // reference (from get()) drop theirs.
+  std::shared_ptr<Scheduler> s;
   {
     std::lock_guard<std::mutex> l(g_mu);
     auto it = g_registry.find(h);
     if (it == g_registry.end()) return;
-    s = it->second;
+    s = std::move(it->second);
     g_registry.erase(it);
   }
-  delete s;
 }
 
 void hvd_sched_set_threshold(int64_t h, int64_t threshold_bytes) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return;
   std::lock_guard<std::mutex> l(s->mu);
   s->threshold = threshold_bytes;
@@ -86,7 +91,7 @@ void hvd_sched_set_threshold(int64_t h, int64_t threshold_bytes) {
 // Returns 1 when accumulated bytes crossed the threshold (time to flush).
 int32_t hvd_sched_enqueue(int64_t h, int64_t tensor_id, int64_t key_hash,
                           int64_t nbytes) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return 0;
   std::lock_guard<std::mutex> l(s->mu);
   s->pending.push_back({tensor_id, key_hash, nbytes});
@@ -95,7 +100,7 @@ int32_t hvd_sched_enqueue(int64_t h, int64_t tensor_id, int64_t key_hash,
 }
 
 int64_t hvd_sched_pending(int64_t h) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return 0;
   std::lock_guard<std::mutex> l(s->mu);
   return static_cast<int64_t>(s->pending.size());
@@ -110,7 +115,7 @@ int64_t hvd_sched_pending(int64_t h) {
 // of buckets, or -1 if cap is too small. Clears the pending queue.
 int64_t hvd_sched_flush(int64_t h, int64_t* tensor_ids, int64_t* bucket_ids,
                         int64_t cap) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return 0;
   std::lock_guard<std::mutex> l(s->mu);
   const int64_t n = static_cast<int64_t>(s->pending.size());
@@ -153,7 +158,7 @@ int64_t hvd_sched_flush(int64_t h, int64_t* tensor_ids, int64_t* bucket_ids,
 // slot id (>= 0) and refreshes recency; a miss inserts (evicting the least
 // recently used entry at capacity) and returns -1.
 int64_t hvd_cache_lookup(int64_t h, int64_t signature) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return -1;
   std::lock_guard<std::mutex> l(s->mu);
   auto it = s->cache.find(signature);
@@ -175,14 +180,14 @@ int64_t hvd_cache_lookup(int64_t h, int64_t signature) {
 }
 
 int64_t hvd_cache_hits(int64_t h) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return 0;
   std::lock_guard<std::mutex> l(s->mu);
   return s->hits;
 }
 
 int64_t hvd_cache_size(int64_t h) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return 0;
   std::lock_guard<std::mutex> l(s->mu);
   return static_cast<int64_t>(s->cache.size());
@@ -190,7 +195,7 @@ int64_t hvd_cache_size(int64_t h) {
 
 // Group table (reference: group_table.h RegisterGroup/DeregisterGroups).
 int64_t hvd_group_register(int64_t h, const int64_t* ids, int64_t n) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return -1;
   std::lock_guard<std::mutex> l(s->mu);
   int64_t gid = s->next_group++;
@@ -201,7 +206,7 @@ int64_t hvd_group_register(int64_t h, const int64_t* ids, int64_t n) {
 }
 
 int64_t hvd_group_of(int64_t h, int64_t tensor_id) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return -1;
   std::lock_guard<std::mutex> l(s->mu);
   auto it = s->group_of.find(tensor_id);
@@ -209,7 +214,7 @@ int64_t hvd_group_of(int64_t h, int64_t tensor_id) {
 }
 
 void hvd_group_deregister(int64_t h, int64_t group_id) {
-  auto* s = get(h);
+  auto s = get(h);
   if (!s) return;
   std::lock_guard<std::mutex> l(s->mu);
   auto it = s->groups.find(group_id);

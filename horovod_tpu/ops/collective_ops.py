@@ -64,6 +64,38 @@ def _mesh_for(process_set):
     return ps.mesh, ps
 
 
+@functools.lru_cache(maxsize=1024)
+def _local_mesh_info(mesh):
+    """``(spans_processes, local_positions)`` for a mesh: whether it includes
+    devices owned by other processes, and the flat positions of this
+    process's devices within it (rank-major).
+
+    Multi-process eager semantics: each process supplies/receives the
+    **local** slice of the rank-major stack — ``local_positions`` rows —
+    while the compiled program runs over the global mesh (the multi-host
+    contract the reference implements with per-rank buffers + NCCL/Gloo;
+    here the global array is assembled with
+    ``jax.make_array_from_process_local_data``).
+    """
+    devs = list(mesh.devices.flat)
+    me = jax.process_index()
+    local = tuple(i for i, d in enumerate(devs) if d.process_index == me)
+    return len(local) != len(devs), local
+
+
+def _mesh_processes(mesh):
+    """Sorted process indices owning devices of ``mesh`` — the participant
+    list for control-plane negotiations scoped to a process set."""
+    return sorted({d.process_index for d in mesh.devices.flat})
+
+
+def _expected_rows(mesh, n):
+    """Leading-axis size of the eager stacked layout this process must
+    supply: all ``n`` rows single-process, only the local rows otherwise."""
+    multi, local_pos = _local_mesh_info(mesh)
+    return len(local_pos) if multi else n
+
+
 def _check_stacked(x, n, what):
     if x.ndim < 1 or x.shape[0] != n:
         raise TensorShapeMismatchError(
@@ -293,18 +325,54 @@ def _barrier_program(mesh):
 def _prepare(tensors, mesh, n, what):
     """Convert to device arrays sharded rank-major over the mesh.
 
-    A single device_put per tensor (host numpy goes straight to the sharded
-    layout; device arrays just reshard) — the moral analog of the fusion
-    buffer's one-memcpy-in guarantee (reference: fusion_buffer_manager.h:40).
+    Single process: a single device_put per tensor (host numpy goes straight
+    to the sharded layout; device arrays just reshard) — the moral analog of
+    the fusion buffer's one-memcpy-in guarantee
+    (reference: fusion_buffer_manager.h:40).
+
+    Multi-process: each process passes the **local** rows of the rank-major
+    stack (one per chip it owns); the global sharded array is assembled from
+    the per-process pieces without touching non-addressable devices.
     """
     sharding = NamedSharding(mesh, P(HVD_AXIS))
+    multi, local_pos = _local_mesh_info(mesh)
     out = []
     for t in tensors:
         if not hasattr(t, "ndim"):
             t = np.asarray(t)
-        _check_stacked(t, n, what)
-        out.append(jax.device_put(t, sharding))
+        if multi:
+            n_local = len(local_pos)
+            if t.ndim < 1 or t.shape[0] != n_local:
+                raise TensorShapeMismatchError(
+                    f"{what}: multi-process eager collectives take the "
+                    f"local rank-major stack — leading axis {n_local} (one "
+                    f"slice per local chip), got shape {tuple(t.shape)}.")
+            out.append(jax.make_array_from_process_local_data(
+                sharding, np.asarray(t), (n,) + tuple(t.shape[1:])))
+        else:
+            _check_stacked(t, n, what)
+            out.append(jax.device_put(t, sharding))
     return out
+
+
+def _localize(outs, mesh):
+    """Return per-process local results in multi-process mode.
+
+    The compiled program yields global arrays whose shards live on every
+    host; a process can only read its own. Mirroring ``_prepare``'s input
+    contract, each output is narrowed to the local rank-major stack (rows of
+    this process's chips, in rank order).
+    """
+    multi, _ = _local_mesh_info(mesh)
+    if not multi:
+        return outs
+    res = []
+    for o in outs:
+        shards = sorted(o.addressable_shards,
+                        key=lambda s: s.index[0].start or 0)
+        res.append(np.concatenate([np.asarray(s.data) for s in shards],
+                                  axis=0))
+    return res
 
 
 def _signature(tensors):
@@ -347,7 +415,7 @@ def grouped_allreduce(tensors, op=Average, prescale_factor=1.0,
                               float(postscale_factor), shapes, dtypes,
                               active_mask)
     with _timeline_op(name or "grouped_allreduce", "ALLREDUCE"):
-        return list(prog(*tensors))
+        return _localize(list(prog(*tensors)), mesh)
 
 
 def allgather(tensor, process_set=None, name=None):
@@ -372,36 +440,48 @@ def grouped_allgather(tensors, process_set=None, name=None):
     shapes, dtypes = _signature(tensors)
     prog = _allgather_program(mesh, n, shapes, dtypes, _active_mask(ps))
     with _timeline_op(name or "grouped_allgather", "ALLGATHER"):
-        return list(prog(*tensors))
+        return _localize(list(prog(*tensors)), mesh)
 
 
 def allgather_ragged(tensors, process_set=None, name=None):
     """Allgather of per-rank tensors with differing first dims.
 
-    ``tensors`` is a list of ``set_size`` arrays whose shapes agree on all but
-    the first axis. Returns the concatenated array (same value for every rank).
-    This is the dynamic-shape path that needs host-side size negotiation in the
-    reference (reference: controller.cc allgather first-dim exchange,
-    collective_operations.h:137-174); here sizes are static at trace time so
-    each distinct size vector compiles once.
+    ``tensors`` is a list of arrays whose shapes agree on all but the first
+    axis — one per rank (single process) or one per **local** rank
+    (multi-process). Returns the concatenated array (same value for every
+    rank). This is the dynamic-shape path that needs host-side size
+    negotiation in the reference (reference: controller.cc:74 allgather
+    first-dim exchange, collective_operations.h:137-174): multi-process
+    launches exchange the per-rank first dims through the jax.distributed
+    control plane (:mod:`horovod_tpu.common.negotiation`) before building the
+    padded program, so each distinct size vector compiles once everywhere.
     """
     mesh, ps = _mesh_for(process_set)
     n = ps.size()
-    if len(tensors) != n:
+    multi, local_pos = _local_mesh_info(mesh)
+    n_rows = len(local_pos) if multi else n
+    if len(tensors) != n_rows:
         raise TensorShapeMismatchError(
-            f"allgather_ragged needs one tensor per rank ({n}), got {len(tensors)}")
+            f"allgather_ragged needs one tensor per "
+            f"{'local ' if multi else ''}rank ({n_rows}), got {len(tensors)}")
     tensors = [jnp.asarray(t) for t in tensors]
-    sizes = [int(t.shape[0]) for t in tensors]
+    local_sizes = [int(t.shape[0]) for t in tensors]
+    if multi:
+        from horovod_tpu.common import negotiation
+        sizes = negotiation.exchange_sizes("allgather_ragged", local_sizes,
+                                           procs=_mesh_processes(mesh))
+    else:
+        sizes = local_sizes
     max_size = max(sizes)
     padded = jnp.stack([
         jnp.pad(t, [(0, max_size - s)] + [(0, 0)] * (t.ndim - 1))
-        for t, s in zip(tensors, sizes)])
+        for t, s in zip(tensors, local_sizes)])
     gathered = allgather(padded, process_set=process_set, name=name)
     # Joined ranks' slices were dropped by the masked allgather, so the
     # output rows hold n_active blocks, in active-rank order.
     mask = _active_mask(ps)
     active = range(n) if mask is None else np.nonzero(np.array(mask))[0]
-    row0 = gathered[0].reshape(
+    row0 = np.asarray(gathered[0]).reshape(
         (len(list(active)), max_size) + tuple(tensors[0].shape[1:]))
     return jnp.concatenate(
         [row0[i, :sizes[r]] for i, r in enumerate(active)], axis=0)
@@ -439,7 +519,7 @@ def grouped_broadcast(tensors, root_rank, process_set=None, name=None):
     shapes, dtypes = _signature(tensors)
     prog = _broadcast_program(mesh, n, int(root), shapes, dtypes)
     with _timeline_op(name or "grouped_broadcast", "BROADCAST"):
-        return list(prog(*tensors))
+        return _localize(list(prog(*tensors)), mesh)
 
 
 def reducescatter(tensor, op=Sum, prescale_factor=1.0, postscale_factor=1.0,
@@ -470,7 +550,7 @@ def grouped_reducescatter(tensors, op=Sum, prescale_factor=1.0,
                                   float(postscale_factor), shapes, dtypes,
                                   _active_mask(ps))
     with _timeline_op(name or "grouped_reducescatter", "REDUCESCATTER"):
-        return list(prog(*tensors))
+        return _localize(list(prog(*tensors)), mesh)
 
 
 def alltoall(tensor, splits=None, process_set=None, name=None):
@@ -480,6 +560,11 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
     Returns ``(output, received_splits)`` when ``splits`` is given, else output
     — matching the reference (reference: hvd.alltoall torch/mpi_ops.py:928-1014,
     splits negotiation collective_operations.h:199-268).
+
+    Multi-process: ``tensor`` is the local rank-major stack and ``splits`` has
+    one row per **local** rank; the full splits matrix is negotiated through
+    the jax.distributed control plane, playing the role of the reference's
+    cross-rank splits exchange.
     """
     mesh, ps = _mesh_for(process_set)
     n = ps.size()
@@ -489,7 +574,9 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
             "alltoall is not supported while ranks have joined (matches the "
             "reference: JOIN covers allreduce/allgather/broadcast only)")
     t = jnp.asarray(tensor)
-    _check_stacked(t, n, "alltoall")
+    multi, local_pos = _local_mesh_info(mesh)
+    n_rows = len(local_pos) if multi else n
+    _check_stacked(t, n_rows, "alltoall")
     if splits is None:
         if t.ndim < 2 or t.shape[1] % n != 0:
             raise TensorShapeMismatchError(
@@ -499,13 +586,13 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
         shapes, dtypes = _signature([tt])
         prog = _alltoall_program(mesh, n, shapes, dtypes)
         with _timeline_op(name or "alltoall", "ALLTOALL"):
-            return prog(tt)[0]
+            return _localize([prog(tt)[0]], mesh)[0]
 
     splits = np.asarray(splits)
-    if splits.shape != (n, n):
+    if splits.shape != (n_rows, n):
         raise TensorShapeMismatchError(
-            f"splits must be ({n},{n}) [rank, peer] row counts, "
-            f"got {splits.shape}")
+            f"splits must be ({n_rows},{n}) [{'local ' if multi else ''}rank,"
+            f" peer] row counts, got {splits.shape}")
     if (splits < 0).any():
         raise TensorShapeMismatchError("splits must be non-negative")
     row_sums = splits.sum(axis=1)
@@ -518,44 +605,66 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
         raise TensorShapeMismatchError(
             f"alltoall splits for rank {bad} sum to {int(row_sums[bad])} "
             f"but each rank only has {t.shape[1]} rows")
-    # Pad every (rank, peer) block to the max block size, run the dense
-    # AllToAll, then slice out the ragged rows. Static at trace time -> one
-    # compile per distinct splits matrix, mirroring how distinct dynamic
-    # shapes each negotiate once in the reference.
-    block = int(splits.max())
+    if multi:
+        # Host-side splits negotiation (reference:
+        # collective_operations.h:199-268): every process learns the full
+        # [rank, peer] matrix so it can size and slice its receive side.
+        from horovod_tpu.common import negotiation
+        per_proc = negotiation.exchange("alltoall_splits", splits.tolist(),
+                                        procs=_mesh_processes(mesh))
+        full = np.concatenate([np.asarray(s, np.int64) for s in per_proc])
+        if full.shape != (n, n):
+            raise TensorShapeMismatchError(
+                f"negotiated alltoall splits have shape {full.shape}, "
+                f"expected ({n},{n}) — mismatched splits across processes")
+    else:
+        full = splits.astype(np.int64)
+    rows_global = list(local_pos) if multi else list(range(n))
+
+    # Pad every (rank, peer) block to the max block size with ONE gather per
+    # rank row (an index map built host-side), run the dense AllToAll, then
+    # slice the ragged rows back out with one gather each. O(n) device ops
+    # total — not the O(n^2) per-block slicing a naive port would do — and
+    # the index maps are data, so distinct splits matrices reuse the same
+    # compiled programs as long as the padded shape matches.
+    block = max(int(full.max()), 1)
+    m = int(t.shape[1])
     offs = np.concatenate([np.zeros((n, 1), np.int64),
-                           np.cumsum(splits, axis=1)], axis=1)
-    blocks = []
-    for r in range(n):
-        row = [jnp.pad(
-            lax.slice_in_dim(t[r], int(offs[r, p]), int(offs[r, p + 1]), axis=0),
-            [(0, block - int(splits[r, p]))] + [(0, 0)] * (t.ndim - 2))
-            for p in range(n)]
-        blocks.append(jnp.concatenate(row, axis=0))
-    dense = jnp.stack(blocks)  # (n, n*block, ...)
+                           np.cumsum(full, axis=1)], axis=1)
+    j = np.arange(block, dtype=np.int64)
+    # pack_idx[i, p*block + k] = offs[g,p] + k for k < full[g,p], else m
+    # (m indexes the zero sentinel row appended below).
+    pack = offs[:, :-1, None] + j[None, None, :]          # (n, n, block)
+    pack = np.where(j[None, None, :] < full[:, :, None], pack, m)
+    pack_idx = pack.reshape(n, n * block)[rows_global]    # (n_rows, n*block)
+    pad_width = [(0, 0), (0, 1)] + [(0, 0)] * (t.ndim - 2)
+    t_pad = jnp.pad(t, pad_width)
+    dense = jax.vmap(lambda row, idx: row[idx])(t_pad, jnp.asarray(pack_idx))
     (dense,) = _prepare([dense], mesh, n, "alltoall")
     shapes, dtypes = _signature([dense])
     prog = _alltoall_program(mesh, n, shapes, dtypes)
     with _timeline_op(name or "alltoall", "ALLTOALL"):
-        exchanged = prog(dense)[0]
-    received = splits.T  # received_splits[r][p] = rows rank r got from peer p
+        exchanged = _localize([prog(dense)[0]], mesh)[0]
+    received = full.T  # received[r][p] = rows rank r got from peer p
     rows = []
-    for r in range(n):
-        parts = [lax.slice_in_dim(exchanged[r], p * block,
-                                  p * block + int(received[r, p]), axis=0)
-                 for p in range(n)]
-        rows.append(jnp.concatenate(parts, axis=0))
-    return rows, received
+    for i, g in enumerate(rows_global):
+        keep = np.concatenate(
+            [p * block + np.arange(int(received[g, p])) for p in range(n)]
+        ).astype(np.int64)
+        rows.append(exchanged[i][keep])
+    return rows, received[np.asarray(rows_global)]
 
 
 def barrier(process_set=None, name=None):
     """Block until all ranks reach the barrier
     (reference: hvd.barrier operations.cc EnqueueBarrier, message.h BARRIER)."""
     mesh, ps = _mesh_for(process_set)
-    token = jnp.zeros((ps.size(), 1), jnp.int32)
+    multi, local_pos = _local_mesh_info(mesh)
+    rows = len(local_pos) if multi else ps.size()
+    token = np.zeros((rows, 1), np.int32)
     (token,) = _prepare([token], mesh, ps.size(), "barrier")
     with _timeline_op(name or "barrier", "BARRIER"):
-        _barrier_program(mesh)(token).block_until_ready()
+        jax.block_until_ready(_barrier_program(mesh)(token))
 
 
 def _active_mask(ps):
@@ -585,7 +694,21 @@ def join(rank=None):
     count, Min/Max/Product/Adasum exclude it — until every rank has joined,
     at which point the join completes and returns the id of the last rank to
     join (and the join state resets).
+
+    Multi-process semantics: JOIN is a **single-controller** feature. The
+    eager multi-process contract is SPMD (every process dispatches the same
+    programs in the same order), which is incompatible with one process
+    silently dropping out of collectives the way the reference's background
+    negotiation permits; multi-host uneven workloads should pad batches or
+    use the elastic API instead. Calling join() under a multi-process launch
+    raises rather than corrupting state.
     """
+    if jax.process_count() > 1:
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        raise HorovodInternalError(
+            "hvd.join() is single-controller only: multi-process eager "
+            "dispatch is SPMD and cannot drop one process from subsequent "
+            "collectives. Pad uneven batches or use the elastic API.")
     st = basics._get_state()
     if rank is None:
         st.joined_ranks.update(range(basics.size()))
@@ -639,7 +762,8 @@ def allreduce_async(tensor, op=Average, prescale_factor=1.0,
                                 process_set=process_set, name=name), name)
     from horovod_tpu.ops.fusion import get_runtime
     t = tensor if hasattr(tensor, "ndim") else np.asarray(tensor)
-    _check_stacked(t, basics.size(), "allreduce_async")
+    _check_stacked(t, _expected_rows(global_process_set.mesh, basics.size()),
+                   "allreduce_async")
     if op == Average and not _is_float(_dtype_of(t)):
         raise ValueError("Average is not supported for integer tensors; use "
                          "hvd.Sum (matches reference torch/mpi_ops.py checks).")
@@ -661,9 +785,9 @@ def grouped_allreduce_async(tensors, op=Average, prescale_factor=1.0,
         return Handle(out, name)
     from horovod_tpu.ops.fusion import get_runtime
     ts = [t if hasattr(t, "ndim") else np.asarray(t) for t in tensors]
-    n = basics.size()
+    rows = _expected_rows(global_process_set.mesh, basics.size())
     for t in ts:
-        _check_stacked(t, n, "grouped_allreduce_async")
+        _check_stacked(t, rows, "grouped_allreduce_async")
         if op == Average and not _is_float(_dtype_of(t)):
             raise ValueError(
                 "Average is not supported for integer tensors; use hvd.Sum "

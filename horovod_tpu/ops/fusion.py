@@ -28,7 +28,8 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.common import basics
 from horovod_tpu.common.topology import HVD_AXIS
-from horovod_tpu.ops.collective_ops import (ReduceOp, _prepare, _reduce_shard)
+from horovod_tpu.ops.collective_ops import (ReduceOp, _localize, _prepare,
+                                            _reduce_shard)
 
 
 class FusedHandle:
@@ -300,6 +301,9 @@ class FusionRuntime:
                     outs = prog(*tensors)
             else:
                 outs = prog(*tensors)
+            # Multi-process: hand back this process's local rows, matching
+            # the sync ops' contract.
+            outs = _localize(list(outs), mesh)
             for (_, h), o in zip(items, outs):
                 h._set(o)
 

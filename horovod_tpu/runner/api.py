@@ -101,7 +101,8 @@ def run(func, args=(), kwargs=None, np=None, hosts=None, hostfile=None,
 
     parsed = launch_mod.parse_args(argv)
     harvested = {}
-    rc = launch_mod._run_static(parsed, harvest=_harvester(harvested),
+    rc = launch_mod._run_static(parsed, extra_env=extra_env,
+                                harvest=_harvester(harvested),
                                 kv_preload={("func", "pickle"): payload})
     if rc != 0:
         raise RuntimeError(f"hvdrun failed with exit code {rc}")

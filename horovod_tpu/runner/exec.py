@@ -25,7 +25,11 @@ class WorkerProcess:
                  ssh_port=None, ssh_identity_file=None):
         self.hostname = hostname
         self.tag = tag
-        local = hostname in ("localhost", "127.0.0.1", os.uname().nodename) \
+        # Any 127.0.0.0/8 address is this machine (loopback aliases let tests
+        # model N distinct "hosts" locally, like the reference's
+        # localhost-based integration tier).
+        local = (hostname in ("localhost", "::1", os.uname().nodename)
+                 or hostname.startswith("127.")) \
             if use_ssh is None else not use_ssh
         if local:
             full = command

@@ -176,6 +176,16 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
         "HOROVOD_KV_ADDR": coordinator_addr,
         "HOROVOD_KV_PORT": str(kv_port),
     })
+    # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU
+    # device: pin each worker's device count to its slot count so the world
+    # size equals the requested slots regardless of ambient XLA_FLAGS.
+    ambient = {**os.environ, **env}
+    if ambient.get("JAX_PLATFORMS", "").startswith("cpu"):
+        flags = [f for f in ambient.get("XLA_FLAGS", "").split()
+                 if "xla_force_host_platform_device_count" not in f]
+        flags.append("--xla_force_host_platform_device_count="
+                     f"{len(slot_infos_for_host)}")
+        env["XLA_FLAGS"] = " ".join(flags)
     config_parser.set_env_from_args(env, args)
     return env
 

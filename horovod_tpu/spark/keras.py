@@ -10,6 +10,7 @@ class is always importable for API parity, and raises a clear error at
 for optional framework support.
 """
 
+import json
 import os
 
 import numpy as np
@@ -68,10 +69,15 @@ class KerasEstimator:
         ckpt_dir = self.store.get_checkpoint_path(run_id)
         self.store.make_dirs(ckpt_dir)
         ckpt_file = os.path.join(ckpt_dir, "model.keras")
+        meta_file = os.path.join(ckpt_dir, "fit_state.json")
 
         model = self.model
-        if os.path.exists(ckpt_file):  # resume
+        initial_epoch = 0
+        if os.path.exists(ckpt_file):  # resume: train only remaining epochs
             model = hvd_keras.load_model(ckpt_file)
+            if os.path.exists(meta_file):
+                with open(meta_file) as f:
+                    initial_epoch = int(json.load(f).get("epoch", 0))
         else:
             opt = hvd_keras.DistributedOptimizer(self.optimizer)
             model.compile(optimizer=opt, loss=self.loss)
@@ -82,8 +88,11 @@ class KerasEstimator:
         ]
         history = model.fit(X, y, batch_size=self.batch_size,
                             epochs=self.epochs, shuffle=self.shuffle,
+                            initial_epoch=initial_epoch,
                             verbose=self.verbose, callbacks=callbacks)
         model.save(ckpt_file)
+        with open(meta_file, "w") as f:
+            json.dump({"epoch": self.epochs}, f)
         return KerasModel(model, self.feature_cols, self.label_cols,
                           history=history.history, run_id=run_id)
 
@@ -105,6 +114,11 @@ class KerasModel:
         out = np.asarray(self.model.predict(X, verbose=0))
         if out.ndim == 1:
             out = out[:, None]
+        if out.shape[1] != len(self.label_cols):
+            raise ValueError(
+                f"model produced {out.shape[1]} output column(s) but "
+                f"{len(self.label_cols)} label_cols were requested: "
+                f"{self.label_cols}")
         for i, c in enumerate(self.label_cols):
-            pdf[f"{c}__output"] = list(out[:, min(i, out.shape[1] - 1)])
+            pdf[f"{c}__output"] = list(out[:, i])
         return pdf

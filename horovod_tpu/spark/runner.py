@@ -120,8 +120,35 @@ def run_elastic(fn, args=(), kwargs=None, num_proc=None, min_np=1,
         try:
             return run(fn, args=args, kwargs=kwargs, num_proc=np_now,
                        extra_env=extra_env, verbose=verbose)
-        except Exception as e:  # Py4J wraps worker failures opaquely
+        except Exception as e:
+            # Only Spark/Py4J job failures are transient (executor loss,
+            # stage abort); deterministic user-code errors fail fast rather
+            # than re-running the whole job reset_limit times.
+            if not _is_spark_failure(e):
+                raise
             last_err = e
             resets += 1
     raise RuntimeError(
         f"spark elastic run failed after {resets} resets") from last_err
+
+
+def _is_spark_failure(e):
+    """True only for cluster-side failures worth an elastic reset (executor
+    loss, preemption, barrier desync). Deterministic user-code errors — which
+    Spark also surfaces as Py4JJavaError stage failures, with the Python
+    traceback embedded — fail fast instead of burning reset_limit re-runs."""
+    text = f"{type(e).__name__}: {e}"
+    transient = ("ExecutorLostFailure", "Executor lost", "TaskKilled",
+                 "task preempted", "Connection reset", "Connection refused",
+                 "SparkContext was shut down", "BarrierJobSlotsNumberCheck",
+                 "Could not recover from a failed barrier")
+    if any(s in text for s in transient):
+        return True
+    # A stage failure carrying a Python traceback is user code raising
+    # deterministically on the worker — not retryable.
+    if "Traceback (most recent call last)" in text:
+        return False
+    mod = type(e).__module__ or ""
+    # Remaining py4j/pyspark-native failures without an embedded user error
+    # (driver/JVM-side flakiness) stay retryable.
+    return mod.startswith("py4j") or mod.startswith("pyspark")

@@ -138,6 +138,11 @@ class TorchModel:
         out = np.asarray(out)
         if out.ndim == 1:
             out = out[:, None]
+        if out.shape[1] != len(self.label_cols):
+            raise ValueError(
+                f"model produced {out.shape[1]} output column(s) but "
+                f"{len(self.label_cols)} label_cols were requested: "
+                f"{self.label_cols}")
         for i, c in enumerate(self.label_cols):
-            pdf[f"{c}__output"] = list(out[:, min(i, out.shape[1] - 1)])
+            pdf[f"{c}__output"] = list(out[:, i])
         return pdf

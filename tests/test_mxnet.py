@@ -125,9 +125,10 @@ class TestMxnetOptimizer:
         ws = [FakeNDArray(np.zeros(2)) for _ in range(2)]
         gs = [FakeNDArray(np.full(2, 4.0)) for _ in range(2)]
         opt.update([0, 1], ws, gs, [None, None])
-        # predivide 2.0 -> grads halved before the average
+        # predivide rescales the wire intermediate only (1/f pre, f post);
+        # the net result is the plain average, matching the reference.
         for w in ws:
-            np.testing.assert_allclose(w.asnumpy(), -np.full(2, 2.0),
+            np.testing.assert_allclose(w.asnumpy(), -np.full(2, 4.0),
                                        rtol=1e-5)
 
     def test_getattr_passthrough(self, hvd):
