@@ -88,6 +88,10 @@ class RayExecutor:
                     timeout=self.settings.placement_group_timeout_s)
             self.placement_group = pg
 
+        import os
+
+        from horovod_tpu.runner.secret import SECRET_ENV, make_secret_key
+        os.environ.setdefault(SECRET_ENV, make_secret_key())
         self._kv = KVStoreServer()
         kv_port = self._kv.start()
         coordinator_addr = socket.gethostbyname(socket.gethostname())

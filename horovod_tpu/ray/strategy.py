@@ -57,4 +57,9 @@ def worker_env(cross_rank, cross_size, local_size, coordinator_addr,
         "HOROVOD_KV_ADDR": coordinator_addr,
         "HOROVOD_KV_PORT": str(kv_port),
     })
+    import os
+
+    from horovod_tpu.runner.secret import SECRET_ENV
+    if os.environ.get(SECRET_ENV):
+        env[SECRET_ENV] = os.environ[SECRET_ENV]
     return env

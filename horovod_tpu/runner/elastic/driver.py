@@ -180,6 +180,10 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
     else:
         raise ValueError("elastic mode needs --host-discovery-script or -H")
 
+    import os as _os
+
+    from horovod_tpu.runner.secret import SECRET_ENV, make_secret_key
+    _os.environ.setdefault(SECRET_ENV, make_secret_key())
     kv = KVStoreServer()
     kv_port = kv.start()
     for (scope, key), value in (kv_preload or {}).items():

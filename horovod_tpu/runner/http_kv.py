@@ -11,10 +11,16 @@ worker notification, so the server/client pair is kept with the same
 verb/scope protocol.
 """
 
+import os
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib import error as urlerror
 from urllib import request as urlrequest
+
+from horovod_tpu.runner.secret import (SECRET_ENV, check_digest,
+                                       compute_digest)
+
+SIG_HEADER = "X-Hvd-Sig"
 
 
 class _KVHandler(BaseHTTPRequestHandler):
@@ -29,7 +35,25 @@ class _KVHandler(BaseHTTPRequestHandler):
             return None, None
         return parts[0], parts[1]
 
+    def _authenticate(self, body: bytes) -> bool:
+        """Fail-closed HMAC check when the server holds a job secret
+        (reference: network.py:306 — unsigned/mis-signed messages rejected
+        before deserialization)."""
+        secret = self.server.secret
+        if not secret:
+            return True
+        sig = self.headers.get(SIG_HEADER, "")
+        if check_digest(secret, sig, self.command.encode(),
+                        self.path.encode(), body):
+            return True
+        self.send_response(403)
+        self.send_header("Content-Length", "0")
+        self.end_headers()
+        return False
+
     def do_GET(self):
+        if not self._authenticate(b""):
+            return
         scope, key = self._parse()
         store = self.server.store
         with self.server.lock:
@@ -41,13 +65,18 @@ class _KVHandler(BaseHTTPRequestHandler):
             return
         self.send_response(200)
         self.send_header("Content-Length", str(len(value)))
+        if self.server.secret:
+            self.send_header(SIG_HEADER, compute_digest(
+                self.server.secret, b"RESP", self.path.encode(), value))
         self.end_headers()
         self.wfile.write(value)
 
     def do_PUT(self):
-        scope, key = self._parse()
         length = int(self.headers.get("Content-Length", 0))
         value = self.rfile.read(length)
+        if not self._authenticate(value):
+            return
+        scope, key = self._parse()
         with self.server.lock:
             self.server.store.setdefault(scope, {})[key] = value
         self.send_response(200)
@@ -55,6 +84,8 @@ class _KVHandler(BaseHTTPRequestHandler):
         self.end_headers()
 
     def do_DELETE(self):
+        if not self._authenticate(b""):
+            return
         scope, key = self._parse()
         with self.server.lock:
             if key == "*":
@@ -69,10 +100,12 @@ class _KVHandler(BaseHTTPRequestHandler):
 class KVStoreServer:
     """reference: http_server.py KVStoreServer (threaded, scoped KV)."""
 
-    def __init__(self, port=0):
+    def __init__(self, port=0, secret=None):
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
         self._httpd.store = {}
         self._httpd.lock = threading.Lock()
+        self._httpd.secret = secret if secret is not None \
+            else os.environ.get(SECRET_ENV)
         self._thread = None
 
     @property
@@ -109,31 +142,46 @@ class KVStoreServer:
 
 
 class KVStoreClient:
-    """reference: http_client.py read_data_from_kvstore/put_data_into_kvstore."""
+    """reference: http_client.py read_data_from_kvstore/put_data_into_kvstore,
+    with per-job HMAC signing (network.py:306)."""
 
-    def __init__(self, addr, port, timeout=30):
+    def __init__(self, addr, port, timeout=30, secret=None):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
+        self._secret = secret if secret is not None \
+            else os.environ.get(SECRET_ENV)
+
+    def _request(self, method, path, body=None):
+        req = urlrequest.Request(self._base + path, data=body, method=method)
+        if self._secret:
+            req.add_header(SIG_HEADER, compute_digest(
+                self._secret, method.encode(), path.encode(), body or b""))
+        return req
 
     def get(self, scope, key):
+        path = f"/{scope}/{key}"
         try:
-            with urlrequest.urlopen(f"{self._base}/{scope}/{key}",
+            with urlrequest.urlopen(self._request("GET", path),
                                     timeout=self._timeout) as r:
-                return r.read()
+                value = r.read()
+                if self._secret and not check_digest(
+                        self._secret, r.headers.get(SIG_HEADER, ""),
+                        b"RESP", path.encode(), value):
+                    raise PermissionError(
+                        f"unsigned/tampered KV response for {path}")
+                return value
         except urlerror.HTTPError as e:
             if e.code == 404:
                 return None
             raise
 
     def put(self, scope, key, value: bytes):
-        req = urlrequest.Request(f"{self._base}/{scope}/{key}", data=value,
-                                 method="PUT")
+        req = self._request("PUT", f"/{scope}/{key}", value)
         with urlrequest.urlopen(req, timeout=self._timeout):
             pass
 
     def delete(self, scope, key="*"):
-        req = urlrequest.Request(f"{self._base}/{scope}/{key}",
-                                 method="DELETE")
+        req = self._request("DELETE", f"/{scope}/{key}")
         with urlrequest.urlopen(req, timeout=self._timeout):
             pass
 

@@ -29,6 +29,7 @@ from horovod_tpu.runner.hosts import (get_host_assignments,
                                       host_assignment_by_host, parse_host_files,
                                       parse_hosts)
 from horovod_tpu.runner.http_kv import KVStoreServer
+from horovod_tpu.runner.secret import SECRET_ENV, make_secret_key
 
 
 def parse_args(argv=None):
@@ -176,6 +177,8 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
         "HOROVOD_KV_ADDR": coordinator_addr,
         "HOROVOD_KV_PORT": str(kv_port),
     })
+    if os.environ.get(SECRET_ENV):
+        env[SECRET_ENV] = os.environ[SECRET_ENV]
     # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU
     # device: pin each worker's device count to its slot count so the world
     # size equals the requested slots regardless of ambient XLA_FLAGS.
@@ -200,6 +203,9 @@ def _start_rendezvous(args):
     coordinator_addr = socket.gethostname() \
         if len(by_host) > 1 else "localhost"
     coordinator_port = _free_port()
+    # Mint a per-job secret so all KV control-plane traffic is HMAC-signed
+    # (reference: secret.py per-job key + network.py:306 signed messages).
+    os.environ.setdefault(SECRET_ENV, make_secret_key())
     kv = KVStoreServer()
     kv_port = kv.start()
     kv.put("global", "size", str(slot_infos[0].size).encode())
@@ -228,6 +234,8 @@ def _run_static_mpi(args, launcher, extra_env=None):
         "HOROVOD_KV_ADDR": coordinator_addr,
         "HOROVOD_KV_PORT": str(kv_port),
     })
+    if os.environ.get(SECRET_ENV):
+        env[SECRET_ENV] = os.environ[SECRET_ENV]
     config_parser.set_env_from_args(env, args)
     import shlex
     extra = shlex.split(args.mpi_args) if getattr(args, "mpi_args", "") \

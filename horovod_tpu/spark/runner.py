@@ -39,10 +39,13 @@ def run(fn, args=(), kwargs=None, num_proc=None, extra_env=None,
 
     driver_addr = socket.gethostbyname(socket.gethostname())
     from horovod_tpu.runner.http_kv import KVStoreServer
+    from horovod_tpu.runner.secret import SECRET_ENV, make_secret_key
+    os.environ.setdefault(SECRET_ENV, make_secret_key())
     kv = KVStoreServer()
     kv_port = kv.start()
     coordinator_port = _free_port()
     payload = cloudpickle.dumps((fn, tuple(args), kwargs))
+    secret_key = os.environ.get(SECRET_ENV)
     base_env = dict(extra_env or {})
 
     def _task(_it):
@@ -73,6 +76,8 @@ def run(fn, args=(), kwargs=None, num_proc=None, extra_env=None,
             "HOROVOD_KV_ADDR": driver_addr,
             "HOROVOD_KV_PORT": str(kv_port),
         })
+        if secret_key:
+            env[SECRET_ENV] = secret_key
         os.environ.update(env)
         f, a, kw = cloudpickle.loads(payload)
         yield (info["rank"], f(*a, **kw))

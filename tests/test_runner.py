@@ -114,6 +114,93 @@ class TestKVStore:
         finally:
             srv.stop()
 
+    def test_hmac_signed_roundtrip(self):
+        from horovod_tpu.runner.http_kv import KVStoreClient, KVStoreServer
+        from horovod_tpu.runner.secret import make_secret_key
+        secret = make_secret_key()
+        srv = KVStoreServer(secret=secret)
+        port = srv.start()
+        try:
+            cli = KVStoreClient("localhost", port, secret=secret)
+            cli.put("s", "k", b"signed")
+            assert cli.get("s", "k") == b"signed"
+            cli.delete("s", "k")
+            assert cli.get("s", "k") is None
+        finally:
+            srv.stop()
+
+    def test_unsigned_and_tampered_requests_fail_closed(self):
+        """reference: network.py:306 — mis-signed messages are rejected
+        before any state change."""
+        from urllib import error as urlerror
+
+        import pytest
+
+        from horovod_tpu.runner.http_kv import KVStoreClient, KVStoreServer
+        from horovod_tpu.runner.secret import make_secret_key
+        secret = make_secret_key()
+        srv = KVStoreServer(secret=secret)
+        port = srv.start()
+        try:
+            good = KVStoreClient("localhost", port, secret=secret)
+            good.put("s", "k", b"v")
+
+            # No signature at all -> 403, no state change.
+            unsigned = KVStoreClient("localhost", port, secret="")
+            with pytest.raises(urlerror.HTTPError) as e:
+                unsigned.put("s", "k", b"evil")
+            assert e.value.code == 403
+            with pytest.raises(urlerror.HTTPError) as e:
+                unsigned.get("s", "k")
+            assert e.value.code == 403
+            with pytest.raises(urlerror.HTTPError) as e:
+                unsigned.delete("s")
+            assert e.value.code == 403
+
+            # Wrong key -> same rejection.
+            impostor = KVStoreClient("localhost", port,
+                                     secret=make_secret_key())
+            with pytest.raises(urlerror.HTTPError) as e:
+                impostor.put("s", "k", b"evil")
+            assert e.value.code == 403
+
+            # A signature computed for one body does not authorize another
+            # (tamper-in-flight).
+            from urllib import request as urlrequest
+
+            from horovod_tpu.runner.http_kv import SIG_HEADER
+            from horovod_tpu.runner.secret import compute_digest
+            sig = compute_digest(secret, b"PUT", b"/s/k", b"original")
+            req = urlrequest.Request(f"http://localhost:{port}/s/k",
+                                     data=b"tampered", method="PUT")
+            req.add_header(SIG_HEADER, sig)
+            with pytest.raises(urlerror.HTTPError) as e:
+                urlrequest.urlopen(req, timeout=5)
+            assert e.value.code == 403
+
+            assert srv.get("s", "k") == b"v"  # store untouched throughout
+        finally:
+            srv.stop()
+
+    def test_tampered_response_detected(self):
+        """A server that cannot sign (no/forged key) is rejected by a
+        secret-holding client."""
+        import pytest
+
+        from horovod_tpu.runner.http_kv import KVStoreClient, KVStoreServer
+        from horovod_tpu.runner.secret import make_secret_key
+        srv = KVStoreServer(secret="")  # unsigned server
+        port = srv.start()
+        srv.put("s", "k", b"v")
+        try:
+            cli = KVStoreClient("localhost", port, secret=make_secret_key())
+            # Client's signed GET reaches the open server, but the unsigned
+            # response must be refused.
+            with pytest.raises(PermissionError):
+                cli.get("s", "k")
+        finally:
+            srv.stop()
+
 
 class TestRunApi:
     def test_single_host_inprocess(self, hvd):
