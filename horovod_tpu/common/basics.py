@@ -116,10 +116,23 @@ def init(comm=None, process_sets=None, devices=None):
                         _xb._clear_backends()
                 except ImportError:  # pragma: no cover
                     pass
+                kwargs = {}
+                if os.environ.get("HOROVOD_ELASTIC"):
+                    # Elastic membership: a peer dying must surface as a
+                    # recoverable collective error in survivors, not a
+                    # process-fatal coordination abort, and failure
+                    # detection should beat the default 100 s heartbeat
+                    # (reference: NCCL comms marked elastic abort instead
+                    # of hanging, nccl_operations.h:55).
+                    jax.config.update("jax_enable_recoverability", True)
+                    hb = int(os.environ.get(
+                        "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "10"))
+                    kwargs = dict(heartbeat_timeout_seconds=hb,
+                                  shutdown_timeout_seconds=hb)
                 jax.distributed.initialize(
                     coordinator_address=target,
                     num_processes=config.cross_size,
-                    process_id=config.cross_rank)
+                    process_id=config.cross_rank, **kwargs)
 
         topology = build_topology(devices)
         _state = _State(topology, config)
@@ -135,6 +148,41 @@ def init(comm=None, process_sets=None, devices=None):
             "horovod_tpu initialized: size=%d local_size=%d cross_size=%d",
             topology.size, topology.local_size, topology.cross_size)
         atexit.register(shutdown)
+
+
+def teardown_distributed():
+    """Fully dissolve the jax.distributed cluster membership so backends
+    rebuilt afterwards see a single-process world.
+
+    ``jax.distributed.shutdown()`` alone resets the client/service but
+    leaves ``num_processes``/``process_id`` behind, and the CPU/TPU client
+    factories read those at backend creation — without this, a worker
+    shrinking to world size 1 rebuilds a backend that still believes in its
+    dead peers. Used by elastic in-place re-initialization
+    (horovod_tpu/elastic/state.py _reset)."""
+    try:
+        jax.distributed.shutdown()
+    except Exception as e:  # old cluster half-dead: proceed with teardown
+        hvd_logging.warning("jax.distributed shutdown: %s", e)
+    try:
+        from jax._src import distributed as _dist
+        _dist.global_state.process_id = 0
+        _dist.global_state.num_processes = 1
+        _dist.global_state.coordinator_address = None
+    except Exception as e:  # pragma: no cover
+        hvd_logging.warning("distributed state reset: %s", e)
+    try:
+        # The public clear (not xla_bridge._clear_backends): it also clears
+        # the get_backend util.cache and pjit caches — without that,
+        # jax.devices() keeps returning the old multi-process client.
+        from jax.extend.backend import clear_backends
+        clear_backends()
+    except ImportError:  # pragma: no cover
+        pass
+    # Compiled eager collective programs hold the old mesh/devices; drop
+    # them so they rebuild against the new backend.
+    from horovod_tpu.ops import collective_ops as _c
+    _c.clear_program_caches()
 
 
 def shutdown():

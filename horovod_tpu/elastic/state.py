@@ -9,7 +9,10 @@ current state is kept. ``sync()`` broadcasts rank-0's state to all ranks after
 a rendezvous.
 
 TPU adaptation: device arrays are immutable, so ``commit`` is O(1) reference
-capture (no deep copy — the reference must clone mutable torch tensors);
+capture single-controller (no deep copy — the reference must clone mutable
+torch tensors); under an hvdrun elastic launch it is a device→host snapshot
+instead, because membership changes rebuild the XLA backend and device
+buffers do not survive that;
 ``sync`` rides :func:`horovod_tpu.optim.broadcast_parameters` for pytrees and
 ``broadcast_object`` for python attrs. Re-initialization maps to rebuilding
 the mesh from the new host set.
@@ -21,6 +24,13 @@ from horovod_tpu.common import basics
 from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.common.exceptions import (HorovodInternalError,
                                            HostsUpdatedInterrupt)
+
+
+def _elastic_launch():
+    """True under an hvdrun elastic launch, where membership changes can
+    rebuild the XLA backend (committed device buffers would dangle)."""
+    import os
+    return bool(os.environ.get("HOROVOD_ELASTIC"))
 
 
 class State:
@@ -65,12 +75,12 @@ class State:
         a KV version poll)."""
         if self._host_messages is None:
             return
-        if self._host_messages.updated():
-            # Acknowledge before raising so the next commit after recovery
-            # doesn't re-trigger on the same membership version.
-            ack = getattr(self._host_messages, "acknowledge", None)
-            if ack is not None:
-                ack()
+        observed = self._host_messages.poll()
+        if observed is not None:
+            # Acknowledge exactly the observed version before raising so
+            # the next commit after recovery doesn't re-trigger on it — a
+            # bump published in between must still raise later.
+            self._host_messages.acknowledge(observed)
             raise HostsUpdatedInterrupt(skip_sync=False)
 
 
@@ -87,7 +97,15 @@ class ObjectState(State):
     def save(self):
         new_state = {}
         for attr in self._saved_state.keys():
-            new_state[attr] = copy.deepcopy(getattr(self, attr))
+            # deepcopy for python-object semantics; under an elastic launch
+            # additionally device_get so the snapshot lives in host memory —
+            # a membership change tears the XLA backend down and device
+            # buffers with it.
+            snap = copy.deepcopy(getattr(self, attr))
+            if _elastic_launch():
+                import jax
+                snap = jax.device_get(snap)
+            new_state[attr] = snap
         self._saved_state = new_state
 
     def restore(self):
@@ -130,8 +148,16 @@ class TpuState(ObjectState):
             super().__setattr__(name, value)
 
     def save(self):
-        # jax arrays are immutable: capturing references IS a snapshot.
-        self._saved_trees = dict(self._trees)
+        # jax arrays are immutable, so references are a valid O(1) snapshot
+        # single-controller. Under an elastic launch the snapshot must
+        # survive a backend teardown on membership change (reference
+        # semantics: torch handlers clone to a safe copy,
+        # torch/elastic/state.py:154+), so commit to host memory there.
+        if _elastic_launch():
+            import jax
+            self._saved_trees = jax.device_get(dict(self._trees))
+        else:
+            self._saved_trees = dict(self._trees)
         super().save()
 
     def restore(self):
@@ -154,8 +180,10 @@ def run(func):
     """
 
     def wrapper(state, *args, **kwargs):
-        from horovod_tpu.elastic.worker import (mark_new_rank_ready,
-                                                read_new_rank_ready)
+        from horovod_tpu.elastic.worker import (current_version,
+                                                mark_new_rank_ready,
+                                                read_new_rank_ready,
+                                                wait_for_version_change)
         reset_required = False
         skip_sync = False
         while True:
@@ -170,12 +198,19 @@ def run(func):
             if not skip_sync:
                 state.sync()
             skip_sync = False
+            known_version = current_version()
             try:
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 hvd_logging.warning(
                     "collective failure; restoring last committed state")
                 state.restore()
+                # A peer likely died: give the driver time to notice and
+                # publish a shrunk membership before re-rendezvous, else we
+                # would re-init at the old world size and block on the dead
+                # rank (reference: driver notices the exit and republishes,
+                # elastic/driver.py:304+; workers loop on re-rendezvous).
+                wait_for_version_change(known_version)
                 reset_required = True
             except HostsUpdatedInterrupt as e:
                 hvd_logging.info("host set updated; re-initializing")
@@ -183,8 +218,31 @@ def run(func):
                 skip_sync = e.skip_sync
 
     def _reset(state):
+        """In-place re-initialization at the current membership: surviving
+        workers keep their process (and committed state) and rebuild the
+        collective runtime — the reference's shutdown → re-rendezvous →
+        re-init sequence (common/elastic.py:168 run_fn + §3.4 call stack)."""
+        import os
+
+        from horovod_tpu.elastic.worker import refresh_assignment_env
         basics.shutdown()
+        consumed_version = refresh_assignment_env()
+        if consumed_version is None:
+            hvd_logging.info(
+                "host removed from membership; exiting cleanly")
+            raise SystemExit(0)
+        if os.environ.get("HOROVOD_ELASTIC") and \
+                basics._distributed_client_active():
+            # Tear the old cluster down fully: the coordinator/port and the
+            # world size may both have changed, and device buffers from the
+            # old backend are invalid in the new one (commits are host-side
+            # snapshots for exactly this reason).
+            basics.teardown_distributed()
         basics.init()
+        if getattr(state, "_host_messages", None) is not None:
+            # Acknowledge exactly the version this re-init consumed: a bump
+            # published since must still raise at the next commit.
+            state._host_messages.acknowledge(consumed_version)
         state.on_reset()
 
     return wrapper

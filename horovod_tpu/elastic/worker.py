@@ -27,8 +27,19 @@ class HostUpdateListener:
     def updated(self):
         return self._current() != self._seen
 
-    def acknowledge(self):
-        self._seen = self._current()
+    def poll(self):
+        """Return the new version if one was published since the last
+        acknowledge, else None — a single read, so the caller can
+        acknowledge exactly what it observed."""
+        v = self._current()
+        return v if v != self._seen else None
+
+    def acknowledge(self, version=None):
+        """Mark a membership version as consumed. Pass the version actually
+        acted upon — acknowledging a fresh read could swallow a bump
+        published in between, leaving this worker bound to a stale
+        assignment with nothing left to re-trigger the re-init."""
+        self._seen = int(version) if version is not None else self._current()
 
 
 def _kv_client():
@@ -84,6 +95,69 @@ def read_new_rank_ready(timeout=600):
         time.sleep(0.1)
     raise TimeoutError(
         f"only part of membership v{version} marked ready within {timeout}s")
+
+
+def wait_for_version_change(known_version, timeout=30.0, interval=0.2):
+    """Block until the driver publishes a membership version newer than
+    ``known_version``; returns the current version string (which may equal
+    ``known_version`` on timeout — a same-membership retry, the reference's
+    re-rendezvous-at-unchanged-hosts case)."""
+    client = _kv_client()
+    if client is None or not os.environ.get("HOROVOD_ELASTIC"):
+        return known_version
+    import time
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        v = (client.get("elastic", "version") or b"0").decode()
+        if v != str(known_version):
+            return v
+        time.sleep(interval)
+    return str(known_version)
+
+
+def current_version():
+    client = _kv_client()
+    if client is None:
+        return "0"
+    return (client.get("elastic", "version") or b"0").decode()
+
+
+def refresh_assignment_env():
+    """Fetch this host's slot in the current membership from the KV store
+    and update the rank/coordinator env for re-initialization.
+
+    Reference: the elastic rendezvous ``GET /rank_and_size/host:local_rank``
+    that workers hit on re-init (runner/elastic/rendezvous.py:37-42).
+    Returns the membership version string that was consumed (so callers can
+    acknowledge exactly it, not whatever is current by then), or None when
+    this host is no longer a member of the current assignment (the worker
+    should exit; the driver will reap it).  Outside an elastic launch
+    returns "0" without touching anything.
+    """
+    client = _kv_client()
+    if client is None or not os.environ.get("HOROVOD_ELASTIC"):
+        return "0"
+    host = os.environ.get("HOROVOD_HOST_KEY")
+    version = (client.get("elastic", "version") or b"0").decode()
+    if not host:
+        return version
+    row = client.get("assignment", f"{version}/{host}")
+    if row is None:
+        return None
+    import json
+    a = json.loads(row)
+    os.environ.update({
+        "HOROVOD_RANK": str(a["rank"]),
+        "HOROVOD_SIZE": str(a["size"]),
+        "HOROVOD_LOCAL_SIZE": str(a["local_size"]),
+        "HOROVOD_CROSS_RANK": str(a["cross_rank"]),
+        "HOROVOD_CROSS_SIZE": str(a["cross_size"]),
+        "HOROVOD_COORDINATOR_PORT": str(a["coordinator_port"]),
+        # Results written at job end are keyed by the membership version
+        # the worker last initialized under (runner/task.py).
+        "HOROVOD_ELASTIC_INIT_VERSION": version,
+    })
+    return version
 
 
 def attach_listener(state):

@@ -103,12 +103,36 @@ def _check_stacked(x, n, what):
             f"{n} (one slice per rank), got shape {tuple(x.shape)}. ")
 
 
+import contextlib
+
+
+@contextlib.contextmanager
 def _timeline_op(name, op_kind):
+    """Timeline span + failure translation around one eager collective.
+
+    A collective that dies at runtime (peer process gone, transport torn
+    down mid-op) must surface as :class:`HorovodInternalError` so the
+    elastic ``@run`` wrapper can restore the last commit and re-rendezvous
+    (reference: common/exceptions.py — op status callbacks raise
+    HorovodInternalError; nccl_operations.h:70 async error polling)."""
     tl = basics.timeline()
-    if tl is not None:
-        return tl.op_span(name, op_kind)
-    import contextlib
-    return contextlib.nullcontext()
+    span = tl.op_span(name, op_kind) if tl is not None \
+        else contextlib.nullcontext()
+    try:
+        with span:
+            yield
+    except (ValueError, RuntimeError) as e:
+        # Inside the span only the compiled program executes (inputs were
+        # validated before it), so ValueError/RuntimeError here is the XLA
+        # runtime reporting a transport/peer failure (e.g. status UNKNOWN
+        # "Gloo all-reduce failed: Connection closed by peer" maps to
+        # ValueError, coordination-service aborts to JaxRuntimeError).
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        if isinstance(e, HorovodInternalError):
+            raise
+        raise HorovodInternalError(
+            f"collective {name} failed at runtime: "
+            f"{(str(e).splitlines() or [''])[0][:200]}") from e
 
 
 def _is_float(dtype):
@@ -309,6 +333,18 @@ def _alltoall_program(mesh, n, shapes, dtypes):
     return jax.jit(f)
 
 
+def clear_program_caches():
+    """Drop all compiled eager-collective programs (and the mesh/device
+    objects they capture). Needed when the backend is rebuilt — e.g. an
+    elastic membership change (basics.teardown_distributed); the analog of
+    the reference invalidating its response cache on world reconfig
+    (response_cache.h:45, elastic abort path)."""
+    for prog in (_local_mesh_info, _allreduce_program, _allgather_program,
+                 _broadcast_program, _reducescatter_program,
+                 _alltoall_program, _barrier_program):
+        prog.cache_clear()
+
+
 @functools.lru_cache(maxsize=1024)
 def _barrier_program(mesh):
     def body(x):
@@ -443,13 +479,16 @@ def grouped_allgather(tensors, process_set=None, name=None):
         return _localize(list(prog(*tensors)), mesh)
 
 
-def allgather_ragged(tensors, process_set=None, name=None):
+def allgather_ragged(tensors, process_set=None, name=None,
+                     return_sizes=False):
     """Allgather of per-rank tensors with differing first dims.
 
     ``tensors`` is a list of arrays whose shapes agree on all but the first
     axis — one per rank (single process) or one per **local** rank
     (multi-process). Returns the concatenated array (same value for every
-    rank). This is the dynamic-shape path that needs host-side size
+    rank); with ``return_sizes=True`` also the per-block first-dim sizes (in
+    active-rank order), so callers can split the concatenation without
+    re-negotiating. This is the dynamic-shape path that needs host-side size
     negotiation in the reference (reference: controller.cc:74 allgather
     first-dim exchange, collective_operations.h:137-174): multi-process
     launches exchange the per-rank first dims through the jax.distributed
@@ -483,8 +522,11 @@ def allgather_ragged(tensors, process_set=None, name=None):
     active = range(n) if mask is None else np.nonzero(np.array(mask))[0]
     row0 = np.asarray(gathered[0]).reshape(
         (len(list(active)), max_size) + tuple(tensors[0].shape[1:]))
-    return jnp.concatenate(
+    out = jnp.concatenate(
         [row0[i, :sizes[r]] for i, r in enumerate(active)], axis=0)
+    if return_sizes:
+        return out, [sizes[r] for r in active]
+    return out
 
 
 def broadcast(tensor, root_rank, process_set=None, name=None):
@@ -834,27 +876,35 @@ def broadcast_object(obj, root_rank=0, process_set=None, name=None):
     n = ps.size()
     payload = cloudpickle.dumps(obj)
     buf = np.frombuffer(payload, dtype=np.uint8)
-    # Pad all ranks to the root's length (length broadcast first).
-    ln = int(broadcast(jnp.full((n, 1), len(buf), jnp.int32), root_rank,
+    n_rows = _expected_rows(mesh, n)
+    # Pad (or truncate — non-root payloads are discarded anyway) all ranks
+    # to the root's length (length broadcast first).
+    ln = int(broadcast(jnp.full((n_rows, 1), len(buf), jnp.int32), root_rank,
                        process_set=process_set)[0, 0])
-    stacked = jnp.tile(jnp.pad(jnp.asarray(buf), (0, max(0, ln - len(buf))))[None],
-                       (n, 1))
+    row = jnp.pad(jnp.asarray(buf), (0, max(0, ln - len(buf))))[:ln]
+    stacked = jnp.tile(row[None], (n_rows, 1))
     out = broadcast(stacked, root_rank, process_set=process_set, name=name)
     data = bytes(np.asarray(out[0, :ln], np.uint8))
     return cloudpickle.loads(data)
 
 
 def allgather_object(objs, process_set=None, name=None):
-    """Single-controller variant: ``objs`` is the per-rank list of objects."""
+    """Gather every rank's object(s); returns the full per-rank list on
+    every caller. ``objs``: one object per rank (single process) or per
+    local chip (multi-process); the global split sizes come back from the
+    ragged allgather's negotiation."""
     import cloudpickle
     mesh, ps = _mesh_for(process_set)
     n = ps.size()
-    if not isinstance(objs, (list, tuple)) or len(objs) != n:
-        raise ValueError(f"allgather_object expects a list of {n} objects")
+    n_rows = _expected_rows(mesh, n)
+    if not isinstance(objs, (list, tuple)) or len(objs) != n_rows:
+        raise ValueError(
+            f"allgather_object expects a list of {n_rows} objects "
+            f"(one per {'local chip' if n_rows != n else 'rank'})")
     bufs = [np.frombuffer(cloudpickle.dumps(o), dtype=np.uint8) for o in objs]
-    gathered = allgather_ragged([jnp.asarray(b) for b in bufs],
-                                process_set=process_set, name=name)
-    sizes = [len(b) for b in bufs]
+    gathered, sizes = allgather_ragged([jnp.asarray(b) for b in bufs],
+                                       process_set=process_set, name=name,
+                                       return_sizes=True)
     out, off = [], 0
     arr = np.asarray(gathered, np.uint8)
     for s in sizes:

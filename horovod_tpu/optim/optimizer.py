@@ -223,12 +223,15 @@ def broadcast_parameters(params, root_rank=0, process_set=None,
 
     ps = process_set if process_set is not None else global_process_set
     n = ps.size() if ps.ranks is not None else basics.size()
+    # Eager stacked contract: single-process supplies all n rows, a
+    # multi-process member only the rows of its local chips.
+    n_rows = C._expected_rows(ps.mesh, n)
 
     def bcast_leaf(leaf):
         leaf = jnp.asarray(leaf)
         if stacked:
             return C.broadcast(leaf, root_rank, process_set=process_set)
-        tiled = jnp.broadcast_to(leaf[None], (n,) + leaf.shape)
+        tiled = jnp.broadcast_to(leaf[None], (n_rows,) + leaf.shape)
         out = C.broadcast(tiled, root_rank, process_set=process_set)
         return out[0]
 

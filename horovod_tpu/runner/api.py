@@ -48,8 +48,12 @@ def _elastic_harvester(out, expected):
             _harvester(out)(kv)
             return
         expected["n"] = int(raw.decode() if isinstance(raw, bytes) else raw)
+        fv = kv.get("elastic", "version")
+        final_version = (fv.decode() if isinstance(fv, bytes) else fv) or "0"
         for i in range(expected["n"]):
-            v = kv.get("results", str(i))
+            # Results are version-scoped: only the final membership's count
+            # (runner/task.py keys writes by HOROVOD_ELASTIC_INIT_VERSION).
+            v = kv.get("results", f"{final_version}/{i}")
             if v is not None:
                 out[i] = cloudpickle.loads(v)
 
@@ -128,10 +132,11 @@ def run_elastic(func, args=(), kwargs=None, min_np=1, max_np=None,
     """Elastic variant (reference: horovod.run with elastic args routing to
     launch.py:689 ``_run_elastic`` → gloo_run_elastic).
 
-    ``func`` re-executes from scratch on every membership change (whole
-    process restart — the TPU equivalent of re-rendezvous; see
-    runner/elastic/driver.py); use ``horovod_tpu.elastic.TpuState`` +
-    durable checkpoints inside ``func`` to carry state across restarts.
+    Surviving workers keep their process across membership changes and
+    re-initialize in place (``horovod_tpu.elastic.TpuState`` +
+    ``@horovod_tpu.elastic.run`` restore the last commit and resume at the
+    new world size, reference: common/elastic.py run_fn); workers on removed
+    hosts are reaped and workers on added hosts start fresh.
     Returns the per-host results of the final (surviving) assignment.
     """
     kwargs = kwargs or {}

@@ -189,51 +189,125 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
     for (scope, key), value in (kv_preload or {}).items():
         kv.put(scope, key, value)
     coordinator_addr = socket.gethostname()
-    state = {"workers": {}, "done": threading.Event(), "rc": 0,
-             "version": 0, "lock": threading.Lock()}
+    state = {"workers": {}, "slots": {}, "done": threading.Event(), "rc": 0,
+             "version": 0, "completing": False, "lock": threading.Lock(),
+             "spawn_lock": threading.Lock()}
 
     def spawn(assignment, version):
-        with state["lock"]:
-            # Terminations of superseded workers are intentional — their
-            # _watch threads must not report them as host failures.
-            state["version"] = version
-            old = list(state["workers"].values())
-            state["workers"].clear()
-        # terminate() blocks until each superseded worker is reaped, so no
-        # old process can write results/mark itself ready after the KV reset
-        # below.
-        for w in old:
-            w.terminate()
+        """Differential (re)spawn: workers on surviving hosts keep running
+        and re-initialize in place when they observe the version bump
+        (reference: surviving ranks re-rendezvous without restarting,
+        §3.4 / elastic/driver.py:284-302 only spawns NEW slots); workers on
+        removed hosts are terminated; workers on added hosts are started."""
+        # Serialize whole (re)spawns: discovery-thread updates and
+        # worker-crash updates (record_worker_exit from a _watch thread)
+        # can race, and an older version's KV writes landing after a newer
+        # one's would roll the membership backwards.
+        with state["spawn_lock"]:
+            with state["lock"]:
+                if version < state["version"]:
+                    hvd_logging.info(
+                        "dropping superseded spawn v%d (current v%d)",
+                        version, state["version"])
+                    return
+                if state.get("completing"):
+                    # A worker already finished cleanly: rebalancing now
+                    # would wedge the new membership waiting on exited
+                    # peers. Let the remaining workers drain.
+                    hvd_logging.info(
+                        "dropping spawn v%d: job is completing", version)
+                    return
+                state["version"] = version
+            _spawn_locked(assignment, version)
+
+    def _spawn_locked(assignment, version):
+        import json
+
         coordinator_port = _free_port()
         by_host = host_assignment_by_host(assignment)
-        # Results from a superseded membership must not leak into the final
-        # harvest (they reflect a different world size / data sharding).
+        with state["lock"]:
+            # Pop removed hosts first so their _watch threads see them as
+            # stale and don't report the termination as a host failure.
+            # A host whose slot count changed in place cannot re-init
+            # in-process (its XLA local device count was pinned at spawn):
+            # treat it as removed + added.
+            removed = [h for h in list(state["workers"])
+                       if h not in by_host
+                       or state["slots"].get(h) != len(by_host[h])]
+            removed_workers = [state["workers"].pop(h) for h in removed]
+            for h in removed:
+                state["slots"].pop(h, None)
+            survivors = set(state["workers"])
+        # terminate() blocks until each removed worker is reaped, so no
+        # stale process can write results/mark itself ready after the KV
+        # reset below.
+        for w in removed_workers:
+            w.terminate()
+        # Results are version-scoped (a stale write can't pollute the final
+        # harvest); dropping the scope here is garbage collection of
+        # superseded memberships' results. Assignment rows and ready marks
+        # are pruned to the previous + new version — a worker that read the
+        # previous version string just before this bump can still fetch its
+        # row — bounding KV growth under membership churn.
         kv.delete("results")
-        # nhosts must land before the version bump: workers key their
-        # new-rank-ready barrier off the version they observe.
+        keep = (f"{version}/", f"{version - 1}/")
+        for scope in ("assignment", "new_rank_ready"):
+            kv.prune_scope(scope, keep)
+        # Assignment rows and nhosts must land before the version bump:
+        # surviving workers re-rendezvous the moment they observe the bump
+        # (elastic/worker.py refresh_assignment_env), and the
+        # new-rank-ready barrier keys off the observed version.
+        for host, slots in by_host.items():
+            first = slots[0]
+            # Two-segment key (not scope) so HTTP clients — whose paths
+            # parse as /scope/rest-of-path — resolve the same cell.
+            kv.put("assignment", f"{version}/{host}", json.dumps({
+                "rank": first.rank, "size": first.size,
+                "local_size": first.local_size,
+                "cross_rank": first.cross_rank,
+                "cross_size": first.cross_size,
+                "coordinator_port": coordinator_port,
+            }).encode())
         kv.put("elastic", "nhosts", str(len(by_host)).encode())
         kv.put("elastic", "version", str(version).encode())
         for host, slots in by_host.items():
+            if host in survivors:
+                continue  # stays alive; re-inits in place on the bump
             env = build_worker_env(
                 {**(extra_env or {}), "HOROVOD_ELASTIC": "1"}, slots,
                 coordinator_addr, coordinator_port, kv_port, args)
+            env["HOROVOD_HOST_KEY"] = host
+            # Workers key their results by the membership version they run
+            # under (updated in-place on re-init), so a survivor finishing
+            # against a superseded membership can never pollute the final
+            # harvest.
+            env["HOROVOD_ELASTIC_INIT_VERSION"] = str(version)
             w = WorkerProcess(host, args.command, env, tag=f"{host}@v{version}")
             with state["lock"]:
                 state["workers"][host] = w
-            threading.Thread(target=_watch, args=(host, w, version),
+                state["slots"][host] = len(slots)
+            threading.Thread(target=_watch, args=(host, w),
                              daemon=True).start()
 
-    def _watch(host, worker, version):
+    def _watch(host, worker):
         rc = worker.wait()
         with state["lock"]:
-            stale = version != state["version"] \
-                or state["workers"].get(host) is not worker
+            stale = state["workers"].get(host) is not worker
             if not stale:
                 state["workers"].pop(host, None)
-                remaining = bool(state["workers"])
+                if rc == 0:
+                    # A clean finish means the job is winding down: further
+                    # membership bumps must not respawn/rebalance (peers
+                    # that already exited can never re-join a rendezvous).
+                    state["completing"] = True
         if stale:
-            return  # superseded by a newer assignment; expected termination
+            return  # superseded/removed assignment; expected termination
         driver.record_worker_exit(host, rc)
+        # Only after record_worker_exit: a crash may have just respawned a
+        # replacement (blacklist -> reassign -> spawn); a pre-exit snapshot
+        # of the worker table would declare the job dead mid-recovery.
+        with state["lock"]:
+            remaining = bool(state["workers"])
         if not remaining:
             state["rc"] = max(abs(rc or 0), state["rc"])
             state["done"].set()

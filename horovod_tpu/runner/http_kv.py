@@ -140,6 +140,17 @@ class KVStoreServer:
             else:
                 self._httpd.store.get(scope, {}).pop(key, None)
 
+    def prune_scope(self, scope, keep_prefixes):
+        """Drop every key in ``scope`` not starting with one of
+        ``keep_prefixes`` (garbage collection for version-scoped keys)."""
+        with self._httpd.lock:
+            d = self._httpd.store.get(scope)
+            if not d:
+                return
+            for k in [k for k in d
+                      if not any(k.startswith(p) for p in keep_prefixes)]:
+                del d[k]
+
 
 class KVStoreClient:
     """reference: http_client.py read_data_from_kvstore/put_data_into_kvstore,

@@ -43,8 +43,14 @@ def main():
     kv_port = os.environ.get("HOROVOD_KV_PORT")
     if kv_addr and kv_port:
         from horovod_tpu.runner.http_kv import KVStoreClient
+        key = str(hvd.cross_rank())
+        init_version = os.environ.get("HOROVOD_ELASTIC_INIT_VERSION")
+        if os.environ.get("HOROVOD_ELASTIC") and init_version:
+            # Version-scoped so results computed under a superseded
+            # membership are ignored by the harvest (see elastic driver).
+            key = f"{init_version}/{key}"
         KVStoreClient(kv_addr, int(kv_port)).put(
-            "results", str(hvd.cross_rank()), cloudpickle.dumps(result))
+            "results", key, cloudpickle.dumps(result))
     hvd.shutdown()
 
 

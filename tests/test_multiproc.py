@@ -138,6 +138,14 @@ def _battery(tag):
                                rtol=1e-5)
     passed.append("allreduce_async")
 
+    # --- object collectives (pickled, size-negotiated) ---
+    got = hvd.broadcast_object({"from": "proc0", "x": 7}, root_rank=0)
+    assert got == {"from": "proc0", "x": 7}, got
+    objs = hvd.allgather_object([("obj", r, "payload" * (r + 1))
+                                 for r in lr])
+    assert objs == [("obj", r, "payload" * (r + 1)) for r in range(n)], objs
+    passed.append("object_collectives")
+
     # --- barrier ---
     hvd.barrier()
     passed.append("barrier")
@@ -147,7 +155,8 @@ def _battery(tag):
 
 ALL_OPS = ["allreduce", "grouped_allreduce", "broadcast", "allgather",
            "allgather_ragged", "reducescatter", "alltoall",
-           "alltoall_uneven", "allreduce_async", "barrier"]
+           "alltoall_uneven", "allreduce_async", "object_collectives",
+           "barrier"]
 
 
 class TestMultiProcessCollectives:
