@@ -187,19 +187,23 @@ def run(func):
         reset_required = False
         skip_sync = False
         while True:
-            if reset_required:
-                _reset(state)
-            # Fork-parity scale-up barrier: announce this worker and wait
-            # until the whole membership is up before the state broadcast
-            # (reference: horovod_mark_new_rank_ready handshake,
-            # operations.cc:1264-1305). No-op outside elastic launches.
-            mark_new_rank_ready()
-            read_new_rank_ready()
-            if not skip_sync:
-                state.sync()
-            skip_sync = False
             known_version = current_version()
             try:
+                if reset_required:
+                    _reset(state)
+                    reset_required = False
+                # Fork-parity scale-up barrier: announce this worker and
+                # wait until the whole membership is up before the state
+                # broadcast (reference: horovod_mark_new_rank_ready
+                # handshake, operations.cc:1264-1305). Raises
+                # HostsUpdatedInterrupt if membership moves while waiting.
+                # No-op outside elastic launches.
+                mark_new_rank_ready()
+                read_new_rank_ready()
+                if not skip_sync:
+                    state.sync()
+                skip_sync = False
+                known_version = current_version()
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 hvd_logging.warning(

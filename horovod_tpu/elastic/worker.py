@@ -50,9 +50,21 @@ def _kv_client():
     return KVStoreClient(addr, int(port))
 
 
+def _configured_version(client):
+    """The membership version this worker is actually configured for —
+    rank/world env from the spawn or the last in-place re-init. Falling
+    back to a fresh KV read would let a worker configured for v2 join
+    v3's barrier with v2's world view (race: a bump published between
+    refresh_assignment_env and the barrier)."""
+    v = os.environ.get("HOROVOD_ELASTIC_INIT_VERSION")
+    if v is not None:
+        return v
+    return (client.get("elastic", "version") or b"0").decode()
+
+
 def mark_new_rank_ready():
     """Signal that this (possibly newly added) worker is up and initialized
-    for the current membership version.
+    for its configured membership version.
 
     Reference: the fork's ``horovod_mark_new_rank_ready`` C API
     (operations.cc:1264-1305) — a newly spawned rank marks itself ready so
@@ -63,14 +75,19 @@ def mark_new_rank_ready():
     client = _kv_client()
     if client is None or not os.environ.get("HOROVOD_ELASTIC"):
         return
-    version = (client.get("elastic", "version") or b"0").decode()
+    version = _configured_version(client)
     cross_rank = os.environ.get("HOROVOD_CROSS_RANK", "0")
     client.put(f"new_rank_ready/{version}", cross_rank, b"1")
 
 
 def read_new_rank_ready(timeout=600):
-    """Block until every worker of the current membership version has marked
-    itself ready; returns True when the world is complete.
+    """Block until every worker of this worker's membership version has
+    marked itself ready; returns True when the world is complete.
+
+    Raises :class:`HostsUpdatedInterrupt` if the driver publishes a newer
+    membership while waiting — the barrier this worker is waiting on can
+    then never complete, and the elastic ``@run`` wrapper must re-init at
+    the new version instead.
 
     Reference: the fork's ``horovod_read_new_rank_ready`` +
     ``ProcessSetTable::CheckNewRankReady`` (process_set.h:142-145,
@@ -79,7 +96,7 @@ def read_new_rank_ready(timeout=600):
     client = _kv_client()
     if client is None or not os.environ.get("HOROVOD_ELASTIC"):
         return True
-    version = (client.get("elastic", "version") or b"0").decode()
+    version = _configured_version(client)
     nhosts = int(client.get("elastic", "nhosts") or
                  os.environ.get("HOROVOD_CROSS_SIZE", "1"))
     import time
@@ -92,6 +109,10 @@ def read_new_rank_ready(timeout=600):
                 seen.add(i)
         if len(seen) >= nhosts:
             return True
+        current = (client.get("elastic", "version") or b"0").decode()
+        if current != version:
+            from horovod_tpu.common.exceptions import HostsUpdatedInterrupt
+            raise HostsUpdatedInterrupt(skip_sync=False)
         time.sleep(0.1)
     raise TimeoutError(
         f"only part of membership v{version} marked ready within {timeout}s")

@@ -123,16 +123,26 @@ def _timeline_op(name, op_kind):
             yield
     except (ValueError, RuntimeError) as e:
         # Inside the span only the compiled program executes (inputs were
-        # validated before it), so ValueError/RuntimeError here is the XLA
-        # runtime reporting a transport/peer failure (e.g. status UNKNOWN
-        # "Gloo all-reduce failed: Connection closed by peer" maps to
-        # ValueError, coordination-service aborts to JaxRuntimeError).
+        # validated before it). Translate ONLY transport/peer failures to
+        # HorovodInternalError — those are what elastic recovery can fix by
+        # re-rendezvousing (e.g. status UNKNOWN "Gloo all-reduce failed:
+        # Connection closed by peer" maps to ValueError, coordination
+        # aborts to JaxRuntimeError). Deterministic runtime errors (OOM =
+        # RESOURCE_EXHAUSTED, shape/layout issues) must propagate as-is or
+        # the elastic @run wrapper would retry them forever.
         from horovod_tpu.common.exceptions import HorovodInternalError
         if isinstance(e, HorovodInternalError):
             raise
-        raise HorovodInternalError(
-            f"collective {name} failed at runtime: "
-            f"{(str(e).splitlines() or [''])[0][:200]}") from e
+        msg = str(e)
+        transport = any(m in msg for m in (
+            "UNAVAILABLE", "UNKNOWN", "DEADLINE_EXCEEDED", "ABORTED",
+            "CANCELLED", "Gloo", "gloo", "onnection",  # Connection/connection
+            "peer", "heartbeat", "oordination", "socket", "Socket"))
+        if jax.process_count() > 1 and transport:
+            raise HorovodInternalError(
+                f"collective {name} failed at runtime: "
+                f"{(msg.splitlines() or [''])[0][:200]}") from e
+        raise
 
 
 def _is_float(dtype):

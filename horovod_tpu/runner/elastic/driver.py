@@ -210,12 +210,19 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
                         "dropping superseded spawn v%d (current v%d)",
                         version, state["version"])
                     return
-                if state.get("completing"):
-                    # A worker already finished cleanly: rebalancing now
-                    # would wedge the new membership waiting on exited
-                    # peers. Let the remaining workers drain.
-                    hvd_logging.info(
-                        "dropping spawn v%d: job is completing", version)
+                completing = state.get("completing")
+            # The KV marker closes the window between a worker's final
+            # result write and its _watch thread observing the exit
+            # (runner/task.py writes it just before exiting).
+            if completing or kv.get("elastic", "finished"):
+                # A worker already finished cleanly: rebalancing now
+                # would wedge the new membership waiting on exited
+                # peers. Let the remaining workers drain.
+                hvd_logging.info(
+                    "dropping spawn v%d: job is completing", version)
+                return
+            with state["lock"]:
+                if version < state["version"]:
                     return
                 state["version"] = version
             _spawn_locked(assignment, version)

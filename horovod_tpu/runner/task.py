@@ -49,8 +49,14 @@ def main():
             # Version-scoped so results computed under a superseded
             # membership are ignored by the harvest (see elastic driver).
             key = f"{init_version}/{key}"
-        KVStoreClient(kv_addr, int(kv_port)).put(
-            "results", key, cloudpickle.dumps(result))
+        client = KVStoreClient(kv_addr, int(kv_port))
+        client.put("results", key, cloudpickle.dumps(result))
+        if os.environ.get("HOROVOD_ELASTIC"):
+            # Declare the job winding down BEFORE exiting: the driver must
+            # not rebalance on a discovery blip once any worker finished
+            # cleanly (its result would be wiped and the new membership
+            # would wait forever on this exited rank).
+            client.put("elastic", "finished", b"1")
     hvd.shutdown()
 
 

@@ -7,9 +7,11 @@ Design: like the torch frontend, TF here is a host-side frontend over the XLA
 eager runtime — a tf.Tensor is bridged via numpy (TF already yields ml_dtypes
 bfloat16 arrays, so the bf16 wire path is zero-copy in dtype terms), rides the
 host's mesh slices, and the chip-axis collective equals the cross-host
-collective. There is no TF custom-op/kernel registration (reference:
-tensorflow/mpi_ops.cc AsyncOpKernels) because there is no C++ scheduler to
-feed — dispatch is JAX's async dispatch.
+collective. Inside ``tf.function``/graphs every collective rides a
+``tf.numpy_function`` host-callback op (see :func:`_graph_op`) — the moral
+equivalent of the reference's AsyncOpKernel registrations (reference:
+tensorflow/mpi_ops.cc:443-1656) without a C++ scheduler to feed, since
+dispatch is JAX's async dispatch.
 """
 
 import numpy as np
@@ -60,11 +62,63 @@ def _to_tf(a, tf_dtype):
 
 
 def _stack(a, ps):
-    return np.broadcast_to(a, (ps.size(),) + a.shape)
+    # One row per rank this process owns (all of them single-controller,
+    # the local chips multi-process) — the eager stacked contract.
+    n_rows = C._expected_rows(ps.mesh, ps.size())
+    return np.broadcast_to(a, (n_rows,) + a.shape)
 
 
 def _ps(process_set):
     return process_set if process_set is not None else C.global_process_set
+
+
+def _in_graph(tensor):
+    """True when building a tf.function/graph: every input (symbolic
+    tensors, Variables, python/numpy values) must ride the host-callback op
+    — ``.numpy()`` bridging only exists eagerly."""
+    return not _tf().executing_eagerly()
+
+
+def _graph_op(inputs, np_fn, name, out_dtypes=None, out_shapes=None,
+              cast_back=None):
+    """In-graph collective: a ``tf.numpy_function`` host callback around the
+    eager numpy core — the moral equivalent of the reference's
+    ``HorovodAllreduce``/... AsyncOpKernels usable inside graphs
+    (reference: tensorflow/mpi_ops.cc:443-1656).
+
+    Graph-mode contract: the callback runs on the host at graph execution
+    time, ordered by TF's data dependencies; bf16/fp16 lanes are widened to
+    fp32 across the numpy_function boundary (it has no half kernels) and
+    cast back; outputs get static shapes from ``out_shapes`` (None entries
+    stay dynamic). ``out_dtypes``/``cast_back`` default to one output per
+    input with the same (widened / original) dtype — pass them explicitly
+    only when outputs differ from inputs (e.g. alltoall's received splits).
+    """
+    tf = _tf()
+    half = (tf.bfloat16, tf.float16)
+    inputs = [tf.convert_to_tensor(t) for t in inputs]
+    wire = [tf.cast(t, tf.float32) if t.dtype in half else t for t in inputs]
+    if out_dtypes is None:
+        out_dtypes = [w.dtype for w in wire]
+    if cast_back is None:
+        cast_back = [t.dtype for t in inputs]
+    if out_shapes is None:
+        out_shapes = [t.shape for t in inputs]
+
+    def _np(*arrs):
+        return [np.asarray(o) for o in np_fn(*arrs)]
+
+    outs = tf.numpy_function(_np, wire, out_dtypes, name=name)
+    if not isinstance(outs, (list, tuple)):
+        outs = [outs]
+    results = []
+    for i, o in enumerate(outs):
+        if out_shapes[i] is not None:
+            o.set_shape(out_shapes[i])
+        back = cast_back[i] if i < len(cast_back) else None
+        results.append(tf.cast(o, back) if back is not None
+                       and o.dtype != back else o)
+    return results
 
 
 class Compression:
@@ -121,14 +175,22 @@ def allreduce(tensor, average=None, op=None, prescale_factor=1.0,
                 "IndexedSlices input requires sparse_as_dense=True "
                 "(the TPU data plane is dense)")
         tensor = tf.convert_to_tensor(tensor)
+
+    def _np_core(a):
+        compressed, ctx = compression.compress(np.asarray(a))
+        ps = _ps(process_set)
+        out = C.allreduce(_stack(compressed, ps), op=op,
+                          prescale_factor=prescale_factor,
+                          postscale_factor=postscale_factor,
+                          process_set=process_set, name=name)
+        return np.asarray(
+            compression.decompress(np.asarray(out)[0], ctx)).astype(a.dtype)
+
+    if _in_graph(tensor):
+        return _graph_op([tensor], lambda a: [_np_core(a)],
+                         "hvd_allreduce")[0]
     a, dtype = _to_numpy(tensor)
-    compressed, ctx = compression.compress(a)
-    ps = _ps(process_set)
-    out = C.allreduce(_stack(compressed, ps), op=op,
-                      prescale_factor=prescale_factor,
-                      postscale_factor=postscale_factor,
-                      process_set=process_set, name=name)
-    return _to_tf(compression.decompress(np.asarray(out)[0], ctx), dtype)
+    return _to_tf(_np_core(a), dtype)
 
 
 def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
@@ -138,91 +200,130 @@ def grouped_allreduce(tensors, average=None, op=None, prescale_factor=1.0,
         op = Average if (average is None or average) else Sum
     compression = compression or Compression.none
     tf = _tf()
-    if not tf.executing_eagerly():
-        # Inside tf.function (Keras compiled train steps): the collective
-        # rides a host-callback op in the graph — the numpy_function here is
-        # the moral equivalent of the reference's HorovodAllreduce custom op
-        # (reference: tensorflow/mpi_ops.cc:443-516 AsyncOpKernel).
-        return _graph_grouped_allreduce(tensors, op, prescale_factor,
-                                        postscale_factor, process_set,
-                                        compression)
-    arrs, dtypes = zip(*(_to_numpy(t) for t in tensors))
-    ps = _ps(process_set)
-    wires, ctxs = zip(*(compression.compress(a) for a in arrs))
-    outs = C.grouped_allreduce([_stack(a, ps) for a in wires], op=op,
-                               prescale_factor=prescale_factor,
-                               postscale_factor=postscale_factor,
-                               process_set=process_set, name=name)
-    return [_to_tf(compression.decompress(np.asarray(o)[0], ctx), dt)
-            for o, ctx, dt in zip(outs, ctxs, dtypes)]
 
-
-def _graph_grouped_allreduce(tensors, op, prescale_factor, postscale_factor,
-                             process_set, compression):
-    tf = _tf()
-    # numpy_function has no bf16/f16 kernel coverage; widen those lanes.
-    wire = [t if t.dtype not in (tf.bfloat16, tf.float16)
-            else tf.cast(t, tf.float32) for t in tensors]
-
-    def _np_fn(*arrs):
+    def _np_core(*arrs):
         ps = _ps(process_set)
         compressed, ctxs = zip(*(compression.compress(np.asarray(a))
                                  for a in arrs))
         outs = C.grouped_allreduce([_stack(c, ps) for c in compressed],
                                    op=op, prescale_factor=prescale_factor,
                                    postscale_factor=postscale_factor,
-                                   process_set=process_set)
+                                   process_set=process_set, name=name)
         return [np.asarray(compression.decompress(np.asarray(o)[0], ctx))
                 .astype(a.dtype)
                 for o, ctx, a in zip(outs, ctxs, arrs)]
 
-    outs = tf.numpy_function(_np_fn, wire, [t.dtype for t in wire],
-                             name="hvd_grouped_allreduce")
-    if not isinstance(outs, (list, tuple)):
-        outs = [outs]
-    results = []
-    for o, t in zip(outs, tensors):
-        o.set_shape(t.shape)
-        results.append(tf.cast(o, t.dtype) if o.dtype != t.dtype else o)
-    return results
+    if not tf.executing_eagerly():
+        return _graph_op(tensors, _np_core, "hvd_grouped_allreduce")
+    arrs, dtypes = zip(*(_to_numpy(t) for t in tensors))
+    return [_to_tf(o, dt) for o, dt in zip(_np_core(*arrs), dtypes)]
 
 
 def allgather(tensor, name=None, process_set=None):
-    a, dtype = _to_numpy(tensor)
+    tf = _tf()
     ps = _ps(process_set)
-    out = C.allgather(_stack(a, ps), process_set=process_set, name=name)
-    flat = np.asarray(out)[0]
-    return _to_tf(flat.reshape((ps.size() * a.shape[0],) + a.shape[1:]),
-                  dtype)
+    n = ps.size()
+
+    def _np_core(a):
+        a = np.asarray(a)
+        out = C.allgather(_stack(a, ps), process_set=process_set, name=name)
+        flat = np.asarray(out)[0]
+        return flat.reshape((n * a.shape[0],) + a.shape[1:]).astype(a.dtype)
+
+    if _in_graph(tensor):
+        tensor = tf.convert_to_tensor(tensor)
+        d0 = tensor.shape[0] if tensor.shape.rank else None
+        shape = tf.TensorShape(
+            [n * d0 if d0 is not None else None]
+            + list(tensor.shape[1:])) if tensor.shape.rank else None
+        return _graph_op([tensor], lambda a: [_np_core(a)], "hvd_allgather",
+                         out_shapes=[shape])[0]
+    a, dtype = _to_numpy(tensor)
+    return _to_tf(_np_core(a), dtype)
 
 
 def broadcast(tensor, root_rank=0, name=None, process_set=None):
+    tf = _tf()
+
+    def _np_core(a):
+        a = np.asarray(a)
+        out = C.broadcast(_stack(a, _ps(process_set)), root_rank,
+                          process_set=process_set, name=name)
+        return np.asarray(out)[0].astype(a.dtype)
+
+    if _in_graph(tensor):
+        return _graph_op([tensor], lambda a: [_np_core(a)],
+                         "hvd_broadcast")[0]
     a, dtype = _to_numpy(tensor)
-    ps = _ps(process_set)
-    out = C.broadcast(_stack(a, ps), root_rank, process_set=process_set,
-                      name=name)
-    return _to_tf(np.asarray(out)[0], dtype)
+    return _to_tf(_np_core(a), dtype)
 
 
 def alltoall(tensor, splits=None, name=None, process_set=None):
-    a, dtype = _to_numpy(tensor)
+    tf = _tf()
     ps = _ps(process_set)
     n = ps.size()
+
     if splits is None:
-        out = C.alltoall(_stack(a, ps), process_set=process_set, name=name)
-        return _to_tf(np.asarray(out)[0], dtype)
-    splits = np.asarray(splits)
-    mat = np.broadcast_to(splits, (n, n))
-    rows, received = C.alltoall(_stack(a, ps), splits=mat,
-                                process_set=process_set, name=name)
-    return _to_tf(np.asarray(rows[0]), dtype), _tf().constant(received[0])
+        def _np_core(a):
+            a = np.asarray(a)
+            out = C.alltoall(_stack(a, ps), process_set=process_set,
+                             name=name)
+            return np.asarray(out)[0].astype(a.dtype)
+
+        if _in_graph(tensor):
+            return _graph_op([tensor], lambda a: [_np_core(a)],
+                             "hvd_alltoall")[0]
+        a, dtype = _to_numpy(tensor)
+        return _to_tf(_np_core(a), dtype)
+
+    def _np_core2(a, sp):
+        a = np.asarray(a)
+        mat = np.broadcast_to(np.asarray(sp), (n, n))
+        rows, received = C.alltoall(_stack(a, ps), splits=mat,
+                                    process_set=process_set, name=name)
+        return (np.asarray(rows[0]).astype(a.dtype),
+                np.asarray(received[0], np.int64))
+
+    if _in_graph(tensor):
+        tensor = tf.convert_to_tensor(tensor)
+        half = (tf.bfloat16, tf.float16)
+        out_dt = tf.float32 if tensor.dtype in half else tensor.dtype
+        sp = tf.cast(tf.convert_to_tensor(splits), tf.int64)
+        # First dim of the received rows is data-dependent: dynamic.
+        vshape = tf.TensorShape([None] + list(tensor.shape[1:])) \
+            if tensor.shape.rank else None
+        out, received = _graph_op(
+            [tensor, sp], _np_core2, "hvd_alltoall",
+            out_dtypes=[out_dt, tf.int64],
+            out_shapes=[vshape, tf.TensorShape([n])],
+            cast_back=[tensor.dtype, None])
+        return out, received
+    a, dtype = _to_numpy(tensor)
+    vals, received = _np_core2(a, splits)
+    return _to_tf(vals, dtype), tf.constant(received)
 
 
 def reducescatter(tensor, op=Sum, name=None, process_set=None):
+    tf = _tf()
+    ps = _ps(process_set)
+    n = ps.size()
+
+    def _np_core(a):
+        a = np.asarray(a)
+        out = C.reducescatter(_stack(a, ps), op=op,
+                              process_set=process_set, name=name)
+        return np.asarray(out)[0].astype(a.dtype)
+
+    if _in_graph(tensor):
+        tensor = tf.convert_to_tensor(tensor)
+        d0 = tensor.shape[0] if tensor.shape.rank else None
+        shape = tf.TensorShape(
+            [d0 // n if d0 is not None else None]
+            + list(tensor.shape[1:])) if tensor.shape.rank else None
+        return _graph_op([tensor], lambda a: [_np_core(a)],
+                         "hvd_reducescatter", out_shapes=[shape])[0]
     a, dtype = _to_numpy(tensor)
-    out = C.reducescatter(_stack(a, _ps(process_set)), op=op,
-                          process_set=process_set, name=name)
-    return _to_tf(np.asarray(out)[0], dtype)
+    return _to_tf(_np_core(a), dtype)
 
 
 def broadcast_object(obj, root_rank=0, name=None, process_set=None):
