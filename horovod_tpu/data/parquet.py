@@ -1,0 +1,116 @@
+"""Chunked Parquet dataset reader — the petastorm-reader analog.
+
+Reference: the Spark estimators feed training from Store-written Parquet via
+petastorm readers with worker sharding and bounded memory
+(horovod/spark/common/store.py:38-540 + keras/remote.py data path). This
+reader streams record batches from a (possibly partitioned, possibly remote)
+Parquet dataset with pyarrow, shards row groups across workers, and keeps a
+bounded shuffle buffer — the driver never materializes the dataset.
+"""
+
+import numpy as np
+
+
+class ParquetBatchReader:
+    """Stream ``batch_size``-row numpy column dicts from a Parquet dataset.
+
+    Args:
+        path: file or dataset directory (part files).
+        columns: columns to read (None = all).
+        batch_size: rows per yielded batch.
+        shard_rank / shard_count: this worker reads row groups
+            ``shard_rank, shard_rank + shard_count, ...`` — the petastorm
+            ``cur_shard/shard_count`` contract.
+        shuffle: shuffle fragment order and within a bounded buffer.
+        shuffle_buffer: rows held for shuffling (bounds memory).
+        seed: epoch-stable base seed; pass a different ``epoch`` to
+            :meth:`batches` to reshuffle per epoch.
+        filesystem: optional ``pyarrow.fs.FileSystem`` (e.g. the HDFS store's).
+        drop_last: drop the final partial batch (SPMD-friendly static shapes).
+    """
+
+    def __init__(self, path, columns=None, batch_size=32, shard_rank=0,
+                 shard_count=1, shuffle=False, shuffle_buffer=10000, seed=0,
+                 filesystem=None, drop_last=True):
+        import pyarrow.dataset as pads
+        self._ds = pads.dataset(path, format="parquet",
+                                filesystem=filesystem)
+        self.columns = list(columns) if columns is not None else None
+        self.batch_size = batch_size
+        self.shard_rank = shard_rank
+        self.shard_count = shard_count
+        self.shuffle = shuffle
+        self.shuffle_buffer = max(int(shuffle_buffer), batch_size)
+        self.seed = seed
+        self.drop_last = drop_last
+
+    def __len__(self):
+        """Total rows in this worker's shard (metadata scan only)."""
+        return sum(f.count_rows() for f in self._shard_fragments())
+
+    def head(self, n=1):
+        """First ``n`` rows as a column dict — shape/schema probing without
+        reading (or shuffling) a whole buffer of data."""
+        t = self._ds.head(n, columns=self.columns)
+        return {name: self._to_numpy(t.column(j))
+                for j, name in enumerate(t.schema.names)}
+
+    def _shard_fragments(self):
+        """This worker's row-group-level fragments, round-robin sharded
+        (reference contract: petastorm cur_shard/shard_count)."""
+        i = 0
+        for frag in self._ds.get_fragments():
+            for rg in frag.split_by_row_group():
+                if i % self.shard_count == self.shard_rank:
+                    yield rg
+                i += 1
+
+    def batches(self, epoch=0):
+        """Yield dicts of column -> numpy array, ``batch_size`` rows each."""
+        rng = np.random.default_rng((self.seed, epoch)) if self.shuffle \
+            else None
+        frags = list(self._shard_fragments())
+        if rng is not None:
+            rng.shuffle(frags)
+
+        buffer = []      # list of (columns dict) row chunks
+        buffered = 0
+
+        def drain(final=False):
+            nonlocal buffer, buffered
+            if not buffer:
+                return
+            cols = {k: np.concatenate([c[k] for c in buffer])
+                    for k in buffer[0]}
+            n = len(next(iter(cols.values())))
+            order = rng.permutation(n) if rng is not None else np.arange(n)
+            end = n if final else (n // self.batch_size) * self.batch_size
+            for s in range(0, end, self.batch_size):
+                idx = order[s:s + self.batch_size]
+                if len(idx) < self.batch_size and self.drop_last:
+                    break
+                yield {k: v[idx] for k, v in cols.items()}
+            rest = order[end:]
+            if len(rest) and not final:
+                buffer = [{k: v[rest] for k, v in cols.items()}]
+                buffered = len(rest)
+            else:
+                buffer = []
+                buffered = 0
+
+        for frag in frags:
+            for rb in frag.to_batches(columns=self.columns):
+                chunk = {name: self._to_numpy(rb.column(j))
+                         for j, name in enumerate(rb.schema.names)}
+                buffer.append(chunk)
+                buffered += rb.num_rows
+                if buffered >= self.shuffle_buffer:
+                    yield from drain()
+        yield from drain(final=True)
+
+    @staticmethod
+    def _to_numpy(col):
+        a = col.to_numpy(zero_copy_only=False)
+        if a.dtype == object:  # list-valued column -> dense 2-D
+            a = np.stack([np.asarray(v) for v in a])
+        return a

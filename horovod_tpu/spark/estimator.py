@@ -21,34 +21,104 @@ def _to_pandas(df):
     return df
 
 
-def features_from_dataframe(pdf, feature_cols):
-    """Feature matrix with the estimator family's canonical shape rule: one
-    trailing singleton axis from a single vector-valued column is squeezed.
-    Used by BOTH fit (via :func:`materialize_dataframe`) and every model's
-    ``transform`` so the two always feed the model the same shape."""
-    X = np.stack([np.asarray(pdf[c].tolist(), np.float32)
-                  for c in feature_cols], axis=-1)
+def _assemble(cols_to_arrays, cols, dtype=None):
+    """Column dict → dense matrix with the estimator family's canonical
+    shape rule: one trailing singleton axis from a single vector-valued
+    column is squeezed. Shared by fit's streaming batches and every model's
+    ``transform`` so both always feed the model the same shape."""
+    arrs = []
+    for c in cols:
+        a = np.asarray(cols_to_arrays[c])
+        if a.dtype == object:
+            a = np.stack([np.asarray(v) for v in a])
+        arrs.append(a.astype(dtype) if dtype is not None else a)
+    X = np.stack(arrs, axis=-1)
     if X.ndim > 2 and X.shape[-1] == 1:
         X = X[..., 0]
     return X
 
 
-def materialize_dataframe(store, df, feature_cols, label_cols):
-    """DataFrame → Parquet in the store → (X, y) numpy arrays — the shared
-    data path of every estimator (the reference writes Parquet for petastorm
-    readers; we read it back with pyarrow — same durability contract,
-    TPU-friendly dense batches)."""
-    pdf = _to_pandas(df)
-    path = store.get_train_data_path()
-    store.make_dirs(os.path.dirname(path) or ".")
-    # Written for durability (resume / remote trainers); the in-memory
-    # frame is already the exact data, so no read-back round trip.
-    pdf.to_parquet(path + ".parquet")
-    X = features_from_dataframe(pdf, feature_cols)
-    y = np.stack([np.asarray(pdf[c].tolist()) for c in label_cols], axis=-1)
-    if y.shape[-1] == 1:
+def features_from_dataframe(pdf, feature_cols):
+    return _assemble({c: pdf[c].tolist() for c in feature_cols},
+                     feature_cols, np.float32)
+
+
+def batch_features_labels(batch, feature_cols, label_cols):
+    """One streamed reader batch (column dict) → (X, y)."""
+    X = _assemble(batch, feature_cols, np.float32)
+    y = _assemble(batch, label_cols)
+    if y.ndim > 1 and y.shape[-1] == 1:
         y = y[..., 0]
     return X, y
+
+
+def write_dataframe_dataset(store, df, path=None):
+    """DataFrame → partitioned Parquet dataset in the store; returns the
+    dataset path. A Spark DataFrame is written BY THE EXECUTORS
+    (``df.write.parquet``) — the driver never materializes it (reference:
+    Store-backed Parquet for petastorm readers, store.py:38-540); a pandas
+    frame is written in bounded row-group chunks. A string is taken as an
+    already-written dataset path (fit directly on existing Parquet)."""
+    if isinstance(df, str):
+        return df
+    path = path or store.get_train_data_path()
+    if hasattr(df, "write"):  # pyspark: distributed write, no toPandas
+        # Full-URI path (HDFSStore) so executors hit the store's namenode,
+        # not fs.defaultFS.
+        df.write.mode("overwrite").parquet(path)
+        return path
+    import pyarrow as pa
+    import pyarrow.parquet as pq
+    store.delete(path)
+    store.make_dirs(path)
+    chunk = 65536
+    fs = getattr(store, "filesystem", None)
+    strip = getattr(store, "strip_uri", lambda p: p)
+    for part, s in enumerate(range(0, len(df), chunk)):
+        table = pa.Table.from_pandas(df.iloc[s:s + chunk])
+        pq.write_table(table, f"{strip(path)}/part-{part:05d}.parquet",
+                       filesystem=fs)
+    return path
+
+
+def dataset_reader(store, path, columns, batch_size, shuffle=False, seed=0,
+                   drop_last=True):
+    """ParquetBatchReader bound to the store's filesystem (URIs stripped to
+    the form pyarrow fs handles expect)."""
+    from horovod_tpu.data.parquet import ParquetBatchReader
+    strip = getattr(store, "strip_uri", lambda p: p)
+    return ParquetBatchReader(
+        strip(path), columns=list(columns), batch_size=batch_size,
+        shuffle=shuffle, seed=seed, drop_last=drop_last,
+        filesystem=getattr(store, "filesystem", None))
+
+
+def materialize_dataframe(store, df, feature_cols, label_cols):
+    """DataFrame → Parquet dataset in the store → (X, y) numpy arrays.
+
+    Kept for the small-data estimators (Keras/Torch frontends). A pandas
+    frame is used directly after the durability write (no read-back round
+    trip); a Spark frame or dataset path is read back through the chunked
+    reader so the driver never ``toPandas()``'s it — only the final dense
+    (X, y) is driver-resident. For bounded-memory training use
+    :class:`TpuEstimator`'s streaming fit."""
+    path = write_dataframe_dataset(store, df)
+    if not isinstance(df, str) and not hasattr(df, "write"):  # pandas
+        X = _assemble({c: df[c].tolist() for c in feature_cols},
+                      feature_cols, np.float32)
+        y = _assemble({c: df[c].tolist() for c in label_cols}, label_cols)
+        if y.ndim > 1 and y.shape[-1] == 1:
+            y = y[..., 0]
+        return X, y
+    reader = dataset_reader(store, path,
+                            list(feature_cols) + list(label_cols),
+                            batch_size=65536, drop_last=False)
+    Xs, ys = [], []
+    for batch in reader.batches():
+        X, y = batch_features_labels(batch, feature_cols, label_cols)
+        Xs.append(X)
+        ys.append(y)
+    return np.concatenate(Xs), np.concatenate(ys)
 
 
 class TpuEstimator:
@@ -84,15 +154,24 @@ class TpuEstimator:
 
     # -- data -------------------------------------------------------------
 
-    def _materialize(self, df):
-        return materialize_dataframe(self.store, df, self.feature_cols,
-                                     self.label_cols)
+    def _reader(self, path, global_bs):
+        return dataset_reader(self.store, path,
+                              self.feature_cols + self.label_cols,
+                              batch_size=global_bs, shuffle=self.shuffle,
+                              seed=self.seed)
 
     # -- training ---------------------------------------------------------
 
     def fit(self, df):
         """Train and return a :class:`TpuModel`
-        (reference: estimator.py fit :26)."""
+        (reference: estimator.py fit :26).
+
+        ``df`` may be a Spark DataFrame (written to Parquet by the
+        executors), a pandas DataFrame (written in chunks), or a string
+        path to an existing partitioned Parquet dataset. Training streams
+        batches through :class:`~horovod_tpu.data.parquet.ParquetBatchReader`
+        — the driver never holds the full dataset (the petastorm-reader
+        contract, reference: spark/common/store.py:38-540)."""
         import jax
         import jax.numpy as jnp
 
@@ -106,17 +185,42 @@ class TpuEstimator:
         mesh = hvd.global_process_set.mesh
         n = hvd.size()
 
-        X, y = self._materialize(df)
+        data_path = write_dataframe_dataset(self.store, df)
         run_id = self.run_id or self.store.new_run_id()
         ckpt_dir = self.store.get_checkpoint_path(run_id)
         self.store.make_dirs(ckpt_dir)
 
+        # global batches: n shards of batch_size each
+        global_bs = self.batch_size * n
+        reader = self._reader(data_path, global_bs)
+        if len(reader) < global_bs:
+            raise ValueError(
+                f"dataset at {data_path} has fewer than one global batch "
+                f"({global_bs} rows)")
+        # Shape probe: schema/head only, no buffer read or shuffle.
+        X0, _ = batch_features_labels(reader.head(1), self.feature_cols,
+                                      self.label_cols)
+
         params = self.model.init(jax.random.PRNGKey(self.seed),
-                                 jnp.asarray(X[:1]))
+                                 jnp.asarray(X0[:1]))
         opt = DistributedOptimizer(self.optimizer)
         state = TrainState.create(params, opt)
 
-        mgr = CheckpointManager(os.path.abspath(ckpt_dir))
+        # Orbax writes to local disk; a remote store (HDFS) stages through
+        # a local dir and syncs per epoch (pull on resume, push after save)
+        # — same durability contract as the reference's HDFSStore
+        # checkpoints (store.py:402-540).
+        remote = not getattr(self.store, "is_local", True)
+        if remote:
+            import tempfile
+            local_ckpt = os.path.join(tempfile.gettempdir(),
+                                      f"hvd_est_ckpt_{run_id}")
+            if self.store.exists(ckpt_dir) and not os.path.isdir(local_ckpt):
+                os.makedirs(local_ckpt, exist_ok=True)
+                self.store.download_dir(ckpt_dir, local_ckpt)
+        else:
+            local_ckpt = os.path.abspath(ckpt_dir)
+        mgr = CheckpointManager(local_ckpt)
         if mgr.has_checkpoint():
             state = mgr.restore(template=state, mesh=mesh)
 
@@ -127,22 +231,20 @@ class TpuEstimator:
 
         step = make_train_step(loss_fn, opt, mesh)
 
-        # global batches: n shards of batch_size each
-        global_bs = self.batch_size * n
-        rng = np.random.default_rng(self.seed)
         history = []
         start_step = int(jax.device_get(state.step))
         for epoch in range(self.epochs):
-            order = rng.permutation(len(X)) if self.shuffle \
-                else np.arange(len(X))
             losses = []
-            for i in range(0, len(order) - global_bs + 1, global_bs):
-                idx = order[i:i + global_bs]
-                state, loss = step(state, (jnp.asarray(X[idx]),
-                                           jnp.asarray(y[idx])))
+            for batch in reader.batches(epoch=epoch):
+                bx, by = batch_features_labels(batch, self.feature_cols,
+                                               self.label_cols)
+                state, loss = step(state, (jnp.asarray(bx),
+                                           jnp.asarray(by)))
                 losses.append(float(jax.device_get(loss)))
             history.append(float(np.mean(losses)) if losses else float("nan"))
             mgr.save(start_step + epoch + 1, state)
+            if remote:
+                self.store.upload_dir(local_ckpt, ckpt_dir)
         mgr.close()
 
         return TpuModel(model=self.model, params=state.params,

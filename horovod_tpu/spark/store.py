@@ -29,16 +29,15 @@ class Store:
         return f"run_{uuid.uuid4().hex[:12]}"
 
     @staticmethod
-    def create(prefix_path):
-        """Factory mirroring Store.create (reference: store.py:84-96) —
-        filesystem paths only; hdfs:// and dbfs:/ need their own client and
-        raise a clear error here."""
-        if prefix_path.startswith(("hdfs://", "dbfs:/")):
-            raise ValueError(
-                f"{prefix_path}: remote stores require the corresponding "
-                "filesystem client; mount the path locally or subclass "
-                "FilesystemStore")
-        return LocalStore(prefix_path)
+    def create(prefix_path, **kwargs):
+        """Factory mirroring Store.create (reference: store.py:84-96):
+        hdfs:// → :class:`HDFSStore`, dbfs:/ → :class:`DBFSLocalStore`,
+        anything else → :class:`LocalStore`."""
+        if HDFSStore.matches(prefix_path):
+            return HDFSStore(prefix_path, **kwargs)
+        if DBFSLocalStore.matches(prefix_path):
+            return DBFSLocalStore(prefix_path, **kwargs)
+        return LocalStore(prefix_path, **kwargs)
 
 
 class FilesystemStore(Store):
@@ -83,5 +82,138 @@ class FilesystemStore(Store):
             os.unlink(path)
 
 
+    @property
+    def filesystem(self):
+        """``pyarrow.fs.FileSystem`` for dataset readers
+        (:class:`horovod_tpu.data.parquet.ParquetBatchReader`)."""
+        from pyarrow import fs
+        return fs.LocalFileSystem()
+
+    @property
+    def is_local(self):
+        """Whether paths are directly usable with local-filesystem APIs
+        (os/open/orbax). Remote stores stage through a local dir instead."""
+        return True
+
+
 class LocalStore(FilesystemStore):
     """Local-disk store (reference: LocalStore store.py:322-360)."""
+
+
+class DBFSLocalStore(FilesystemStore):
+    """Databricks DBFS store through the local FUSE mount: ``dbfs:/path``
+    resolves to ``/dbfs/path`` (reference: DBFSLocalStore store.py:362-400)."""
+
+    @classmethod
+    def matches(cls, path):
+        return path.startswith("dbfs:/") or path.startswith("/dbfs")
+
+    def __init__(self, prefix_path, **kwargs):
+        if prefix_path.startswith("dbfs:/"):
+            prefix_path = "/dbfs/" + prefix_path[len("dbfs:/"):].lstrip("/")
+        super().__init__(prefix_path, **kwargs)
+
+
+class HDFSStore(Store):
+    """HDFS-backed store via ``pyarrow.fs.HadoopFileSystem`` (reference:
+    HDFSStore store.py:402-540 — per-run train/val/checkpoint/log dirs on
+    HDFS, no driver-side materialization: Spark executors write the Parquet,
+    workers stream it back through the same filesystem handle).
+
+    Requires libhdfs (``ARROW_LIBHDFS_DIR``)/a Hadoop client on the
+    machine; constructing the store without one raises pyarrow's error.
+    """
+
+    FS_PREFIX = "hdfs://"
+
+    @classmethod
+    def matches(cls, path):
+        return path.startswith(cls.FS_PREFIX)
+
+    def __init__(self, prefix_path, host=None, port=None, user=None,
+                 kerb_ticket=None):
+        rest = prefix_path[len(self.FS_PREFIX):] \
+            if prefix_path.startswith(self.FS_PREFIX) else prefix_path
+        netloc, _, self._path = rest.partition("/")
+        self._path = "/" + self._path
+        if netloc and host is None:
+            host, _, p = netloc.partition(":")
+            port = int(p) if p else port
+        from pyarrow import fs
+        self._fs = fs.HadoopFileSystem(
+            host=host or "default", port=port or 0, user=user,
+            kerb_ticket=kerb_ticket)
+        self._netloc = netloc
+        self.prefix_path = prefix_path
+        self._train_path = self._join("intermediate_train_data")
+        self._val_path = self._join("intermediate_val_data")
+        self._checkpoint_base = self._join("checkpoints")
+        self._logs_base = self._join("logs")
+
+    def _join(self, *parts):
+        # Full URIs (authority included) so consumers that resolve paths
+        # through THEIR OWN filesystem config — Spark's df.write.parquet,
+        # pyarrow URI inference — land on this store's namenode, not
+        # whatever fs.defaultFS happens to be.
+        return f"{self.FS_PREFIX}{self._netloc}" + "/".join(
+            [self._path.rstrip("/")] + list(parts))
+
+    def strip_uri(self, path):
+        """hdfs://netloc/p -> /p (the form pyarrow fs handles expect)."""
+        if path.startswith(self.FS_PREFIX):
+            rest = path[len(self.FS_PREFIX):]
+            return "/" + rest.partition("/")[2]
+        return path
+
+    @property
+    def filesystem(self):
+        return self._fs
+
+    @property
+    def is_local(self):
+        return False
+
+    def get_train_data_path(self, idx=None):
+        return self._train_path if idx is None else \
+            f"{self._train_path}.{idx}"
+
+    def get_val_data_path(self, idx=None):
+        return self._val_path if idx is None else f"{self._val_path}.{idx}"
+
+    def get_checkpoint_path(self, run_id):
+        return self._join("checkpoints", run_id)
+
+    def get_logs_path(self, run_id):
+        return self._join("logs", run_id)
+
+    def exists(self, path):
+        from pyarrow import fs
+        return self._fs.get_file_info(
+            self.strip_uri(path)).type != fs.FileType.NotFound
+
+    def make_dirs(self, path):
+        self._fs.create_dir(self.strip_uri(path), recursive=True)
+
+    def delete(self, path):
+        from pyarrow import fs
+        path = self.strip_uri(path)
+        info = self._fs.get_file_info(path)
+        if info.type == fs.FileType.Directory:
+            self._fs.delete_dir(path)
+        elif info.type != fs.FileType.NotFound:
+            self._fs.delete_file(path)
+
+    def download_dir(self, remote_path, local_path):
+        """Copy a store directory tree to local disk (checkpoint pull)."""
+        from pyarrow import fs
+        fs.copy_files(self.strip_uri(remote_path), local_path,
+                      source_filesystem=self._fs,
+                      destination_filesystem=fs.LocalFileSystem())
+
+    def upload_dir(self, local_path, remote_path):
+        """Copy a local directory tree into the store (checkpoint push)."""
+        from pyarrow import fs
+        self.make_dirs(remote_path)
+        fs.copy_files(local_path, self.strip_uri(remote_path),
+                      source_filesystem=fs.LocalFileSystem(),
+                      destination_filesystem=self._fs)

@@ -2,6 +2,8 @@
 test/integration/test_spark.py — here the pure logic is tested directly and
 the cluster backends are gated, since ray/pyspark are not installed)."""
 
+import os
+
 import numpy as np
 import pytest
 
@@ -83,17 +85,152 @@ class TestSparkStore:
         store.delete(ckpt)
         assert not store.exists(ckpt)
 
-    def test_factory_rejects_remote(self):
-        with pytest.raises(ValueError, match="hdfs"):
-            Store.create("hdfs://nn/path")
-        assert isinstance(Store.create("/tmp/x"), LocalStore)
+    def test_factory_dispatch(self, tmp_path):
+        from horovod_tpu.spark.store import DBFSLocalStore, HDFSStore
+        # hdfs:// dispatches to HDFSStore and NEVER silently falls back to
+        # local. Without a Hadoop client the constructor fails loudly; with
+        # one it yields an HDFSStore.
+        try:
+            s = Store.create("hdfs://nn:9000/path")
+        except Exception:
+            pass  # no libhdfs/JVM on this image: loud failure is correct
+        else:
+            assert isinstance(s, HDFSStore)
+        assert isinstance(Store.create(str(tmp_path / "x")), LocalStore)
+        assert DBFSLocalStore.matches("dbfs:/ml/data")
+        assert not DBFSLocalStore.matches("/tmp/x")
+
+    def test_hdfs_store_paths_without_client(self, monkeypatch):
+        """Path/URI layout logic, independent of a live Hadoop client."""
+        from horovod_tpu.spark import store as store_mod
+
+        class _FakeHadoopFS:
+            def __init__(self, **kw):
+                self.kw = kw
+
+        from pyarrow import fs as pafs
+        monkeypatch.setattr(pafs, "HadoopFileSystem", _FakeHadoopFS)
+        s = store_mod.HDFSStore("hdfs://nn:9000/ml/run")
+        assert s._fs.kw["host"] == "nn" and s._fs.kw["port"] == 9000
+        # Full URIs out (Spark writes hit the right namenode)...
+        assert s.get_train_data_path() == \
+            "hdfs://nn:9000/ml/run/intermediate_train_data"
+        assert s.get_checkpoint_path("r1") == \
+            "hdfs://nn:9000/ml/run/checkpoints/r1"
+        # ...stripped back for pyarrow filesystem handles.
+        assert s.strip_uri(s.get_train_data_path()) == \
+            "/ml/run/intermediate_train_data"
+        assert not s.is_local
 
     def test_run_ids_unique(self, tmp_path):
         store = LocalStore(str(tmp_path))
         assert store.new_run_id() != store.new_run_id()
 
 
+class TestParquetBatchReader:
+    """The petastorm-reader analog: bounded memory, worker sharding,
+    shuffle, partitioned datasets (reference: spark/common/store.py data
+    path + keras/remote.py readers)."""
+
+    def _write_dataset(self, path, n=1000, parts=4):
+        import pandas as pd
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+        df = pd.DataFrame({
+            "a": np.arange(n, dtype=np.float32),
+            "b": np.arange(n, dtype=np.int64) * 2,
+        })
+        # Several part files to exercise the partitioned layout.
+        dpath = f"{path}/ds"
+        os.makedirs(dpath, exist_ok=True)
+        for i in range(parts):
+            sl = df.iloc[i * n // parts:(i + 1) * n // parts]
+            pq.write_table(pa.Table.from_pandas(sl),
+                           f"{dpath}/part-{i:05d}.parquet")
+        return dpath
+
+    def test_streams_all_rows_in_order(self, tmp_path):
+        from horovod_tpu.data.parquet import ParquetBatchReader
+        path = self._write_dataset(tmp_path)
+        r = ParquetBatchReader(path, batch_size=64, drop_last=False)
+        rows = np.concatenate([b["a"] for b in r.batches()])
+        assert len(r) == 1000
+        np.testing.assert_array_equal(np.sort(rows), np.arange(1000))
+        for b in r.batches():
+            np.testing.assert_array_equal(b["b"], b["a"].astype(np.int64) * 2)
+
+    def test_drop_last_static_shapes(self, tmp_path):
+        from horovod_tpu.data.parquet import ParquetBatchReader
+        path = self._write_dataset(tmp_path)
+        r = ParquetBatchReader(path, batch_size=64)  # 1000 % 64 != 0
+        sizes = [len(b["a"]) for b in r.batches()]
+        assert set(sizes) == {64}
+
+    def test_sharding_partitions_rows(self, tmp_path):
+        from horovod_tpu.data.parquet import ParquetBatchReader
+        path = self._write_dataset(tmp_path)
+        seen = []
+        for rank in range(2):
+            r = ParquetBatchReader(path, batch_size=50, shard_rank=rank,
+                                   shard_count=2, drop_last=False)
+            seen.append(np.concatenate([b["a"] for b in r.batches()]))
+        union = np.sort(np.concatenate(seen))
+        np.testing.assert_array_equal(union, np.arange(1000))
+        assert not set(seen[0]) & set(seen[1])
+
+    def test_shuffle_is_epoch_dependent_and_complete(self, tmp_path):
+        from horovod_tpu.data.parquet import ParquetBatchReader
+        path = self._write_dataset(tmp_path)
+        r = ParquetBatchReader(path, batch_size=100, shuffle=True,
+                               shuffle_buffer=300, seed=7, drop_last=False)
+        e0 = np.concatenate([b["a"] for b in r.batches(epoch=0)])
+        e0_again = np.concatenate([b["a"] for b in r.batches(epoch=0)])
+        e1 = np.concatenate([b["a"] for b in r.batches(epoch=1)])
+        np.testing.assert_array_equal(np.sort(e0), np.arange(1000))
+        np.testing.assert_array_equal(e0, e0_again)  # deterministic
+        assert not np.array_equal(e0, e1)            # reshuffled
+        assert not np.array_equal(e0, np.arange(1000))  # actually shuffled
+
+
 class TestEstimator:
+    def test_fit_on_existing_parquet_dataset_path(self, hvd, tmp_path):
+        """VERDICT #8 acceptance: fit on a partitioned Parquet dataset
+        without driver-side full materialization (a string path never
+        touches pandas/toPandas)."""
+        import flax.linen as nn
+        import jax.numpy as jnp
+        import optax
+        import pandas as pd
+        import pyarrow as pa
+        import pyarrow.parquet as pq
+
+        from horovod_tpu.spark import LocalStore, TpuEstimator
+
+        rng = np.random.default_rng(1)
+        X = rng.standard_normal((512, 3)).astype(np.float32)
+        w = rng.standard_normal(3)
+        dpath = str(tmp_path / "dataset")
+        os.makedirs(dpath)
+        for i in range(4):  # partitioned: 4 part files
+            sl = slice(i * 128, (i + 1) * 128)
+            df = pd.DataFrame({f"f{j}": X[sl, j] for j in range(3)})
+            df["label"] = (X[sl] @ w).astype(np.float32)
+            pq.write_table(pa.Table.from_pandas(df),
+                           f"{dpath}/part-{i:05d}.parquet")
+
+        class Lin(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(1)(x)[..., 0]
+
+        est = TpuEstimator(
+            model=Lin(), optimizer=optax.adam(5e-2),
+            loss=lambda pred, lab: jnp.mean((pred - lab) ** 2),
+            feature_cols=[f"f{j}" for j in range(3)], label_cols=["label"],
+            batch_size=8, epochs=6, store=LocalStore(str(tmp_path / "store")),
+            seed=0)
+        model = est.fit(dpath)
+        assert model.history[-1] < model.history[0] * 0.5
     def test_fit_transform_roundtrip(self, hvd, tmp_path):
         import flax.linen as nn
         import jax.numpy as jnp

@@ -1,0 +1,131 @@
+"""Performance-regression guards.
+
+The reference's fusion buffer + response cache exist to keep the collective
+count and renegotiation cost constant per step regardless of parameter count
+(reference: fusion_buffer_manager.h:30, response_cache.h:45, the autotune
+knobs' whole purpose, operations.cc:747-853). These tests fail if someone
+breaks bucketing — the symptom would be one collective per parameter in the
+lowered program, or a cold program/response cache every step.
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import pytest
+from jax.sharding import PartitionSpec as P
+
+import horovod_tpu as hvd
+
+N_PARAMS = 100
+
+
+def _count_all_reduce(text):
+    return len(re.findall(r"all_reduce", text))
+
+
+class TestInJitFusionGuards:
+    def test_fused_tree_one_collective_per_dtype_group(self, hvd):
+        """100 mixed-dtype leaves must lower to exactly 2 all_reduce ops
+        (one flat-buffer reduction per wire dtype), not 100."""
+        from horovod_tpu.optim.optimizer import fused_allreduce_tree
+
+        mesh = hvd.global_process_set.mesh
+        tree = {f"w{i}": jnp.ones((7, 3),
+                                  jnp.float32 if i % 2 else jnp.bfloat16)
+                for i in range(N_PARAMS)}
+
+        sm = jax.shard_map(lambda t: fused_allreduce_tree(t, op=hvd.Sum),
+                           mesh=mesh, in_specs=P(), out_specs=P())
+        lowered = jax.jit(sm).lower(tree)
+        n_groups = 2  # bf16 + f32
+        assert _count_all_reduce(lowered.as_text()) == n_groups
+        # XLA may combine further (its own collective-combiner), never split.
+        compiled = lowered.compile().as_text()
+        n_compiled = compiled.count("all-reduce(") \
+            + compiled.count("all-reduce-start(")
+        assert 1 <= n_compiled <= n_groups
+
+    def test_distributed_optimizer_step_collective_count(self, hvd):
+        """A full DistributedOptimizer train step over many parameters must
+        keep a constant collective count (fused grads + loss reduction),
+        not O(n_params)."""
+        import optax
+
+        from horovod_tpu.optim import DistributedOptimizer
+        from horovod_tpu.parallel import TrainState, make_train_step
+
+        mesh = hvd.global_process_set.mesh
+        params = {f"w{i}": jnp.ones((5, 2), jnp.float32)
+                  for i in range(N_PARAMS)}
+
+        def loss_fn(p, batch):
+            acc = 0.0
+            for v in p.values():
+                acc = acc + jnp.sum(v * batch["x"][:5, :2])
+            return acc
+
+        opt = DistributedOptimizer(optax.sgd(0.1))
+        step = make_train_step(loss_fn, opt, mesh, donate=False)
+        state = TrainState.create(params, opt)
+        batch = {"x": jnp.ones((8 * mesh.size, 2), jnp.float32)}
+        lowered = step.lower(state, batch)
+        count = _count_all_reduce(lowered.as_text())
+        # 1 fused gradient buffer (single dtype group) + at most a couple of
+        # scalar loss/metric reductions. 100 would mean fusion is broken.
+        assert 1 <= count <= 4, f"collective count regressed: {count}"
+
+
+class TestEagerFusionCacheGuards:
+    def test_steady_state_hits_program_and_response_cache(self, hvd):
+        """Re-submitting the same tensor set must reuse the compiled fused
+        program (no recompile) and hit the native response cache."""
+        from horovod_tpu.ops import fusion
+
+        rt = fusion.get_runtime()
+        rt.flush_all()
+        n_rows = hvd.size()
+
+        def submit():
+            hs = [hvd.allreduce_async(
+                jnp.ones((n_rows, 4), jnp.float32) * (i + 1), op=hvd.Sum,
+                name=f"guard.{i}") for i in range(50)]
+            for h in hs:
+                h.synchronize()
+
+        submit()  # cold: compiles the fused program(s)
+        progs_after_cold = fusion._fused_program.cache_info()
+        stats_cold = rt.cache_stats()
+
+        submit()  # steady state: same signatures
+        progs_after_warm = fusion._fused_program.cache_info()
+        stats_warm = rt.cache_stats()
+
+        # No new fused programs were compiled on the warm pass...
+        assert progs_after_warm.misses == progs_after_cold.misses, \
+            "steady-state step recompiled its fused program"
+        # ...and the program cache was actually consulted.
+        assert progs_after_warm.hits > progs_after_cold.hits
+        if stats_cold is not None and stats_warm is not None:
+            assert stats_warm["hits"] > stats_cold["hits"], \
+                f"response cache not hit in steady state: {stats_warm}"
+
+    def test_bucketing_stays_sublinear(self, hvd):
+        """50 equal small tensors of one dtype must flush as a handful of
+        buckets (threshold-bounded), not one collective each."""
+        from horovod_tpu.ops import fusion
+
+        rt = fusion.get_runtime()
+        rt.flush_all()
+        before = fusion._fused_program.cache_info().currsize
+        n_rows = hvd.size()
+        hs = [hvd.allreduce_async(jnp.ones((n_rows, 8), jnp.float32),
+                                  op=hvd.Sum, name=f"bucket.{i}")
+              for i in range(50)]
+        for h in hs:
+            h.synchronize()
+        new_programs = fusion._fused_program.cache_info().currsize - before
+        # All 50 share one signature family; a handful of distinct bucket
+        # shapes is fine, one-program-per-tensor is the regression.
+        assert new_programs <= 5, \
+            f"{new_programs} fused programs for 50 identical tensors"
