@@ -40,9 +40,20 @@ class Topology:
     cross_size: int                # number of hosts
     process_index: int             # this process's index (0 in single-controller)
     local_device_ranks: list       # ranks owned by this process
+    num_slices: int = 1            # TPU slices (DCN-connected groups)
+    mesh_dcn: Mesh = None          # ('cross', 'local') = (slice, chips-in-
+    #                                slice) when multi-slice, else None
 
     def rank_of_device(self, device):
         return self.devices.index(device)
+
+    @property
+    def hierarchical_mesh(self):
+        """The mesh the 2-level strategies (torus/hierarchical allreduce,
+        parallel/strategies.py) should run over: slice-boundary factorization
+        when the job spans DCN (the slow link the 2-level schedule exists
+        for), host-boundary otherwise."""
+        return self.mesh_dcn if self.mesh_dcn is not None else self.mesh2d
 
 
 def _sorted_devices(devices):
@@ -52,6 +63,50 @@ def _sorted_devices(devices):
     # assignment (reference: horovod/runner/common/util/hosts.py:100
     # get_host_assignments).
     return sorted(devices, key=lambda d: (d.process_index, d.id))
+
+
+def _slice_id(d):
+    """TPU slice id of a device in a multi-slice (DCN) job, else None.
+    jax renamed slice_index → partition_index; accept both."""
+    for attr in ("slice_index", "partition_index"):
+        v = getattr(d, attr, None)
+        if v is not None:
+            return v
+    return None
+
+
+def _build_dcn_mesh(devices, size):
+    """(slice × chips-per-slice) mesh when the job spans multiple TPU
+    slices — the factorization whose 'cross' axis is the DCN, which is what
+    the 2-level allreduce strategies actually want (reference mapping:
+    SURVEY §5.8; NCCLTorusAllreduce's node boundary ↔ slice boundary).
+
+    ``HOROVOD_MESH_SLICES=k`` overrides/fakes the slice count (virtual-CPU
+    tier testing of the DCN path; also multi-slice setups whose devices
+    don't expose slice ids).
+    """
+    from horovod_tpu.common.config import _env_int
+    forced = _env_int("HOROVOD_MESH_SLICES", 0)
+    if forced:
+        k = forced
+        if k <= 1 or size % k != 0:
+            return 1, None
+        arr = np.array(devices, dtype=object).reshape(k, size // k)
+        return k, Mesh(arr, (CROSS_AXIS, LOCAL_AXIS))
+    sids = [_slice_id(d) for d in devices]
+    if any(s is None for s in sids):
+        return 1, None
+    uniq = sorted(set(sids))
+    k = len(uniq)
+    # Every slice must hold exactly size/k devices: a reshape over unequal
+    # slices would mix slices within a row, silently putting the 'local'
+    # axis across DCN — the opposite of what this mesh exists for.
+    if k <= 1 or any(sids.count(s) != size // k for s in uniq):
+        return max(k, 1), None
+    order = sorted(devices,
+                   key=lambda d: (_slice_id(d), d.process_index, d.id))
+    arr = np.array(order, dtype=object).reshape(k, size // k)
+    return k, Mesh(arr, (CROSS_AXIS, LOCAL_AXIS))
 
 
 def build_topology(devices=None):
@@ -79,6 +134,8 @@ def build_topology(devices=None):
     local_device_ranks = [i for i, d in enumerate(devices)
                           if d.process_index == process_index]
 
+    num_slices, mesh_dcn = _build_dcn_mesh(devices, size)
+
     return Topology(
         devices=devices,
         mesh=mesh,
@@ -88,6 +145,8 @@ def build_topology(devices=None):
         cross_size=cross_size,
         process_index=process_index,
         local_device_ranks=local_device_ranks,
+        num_slices=num_slices,
+        mesh_dcn=mesh_dcn,
     )
 
 

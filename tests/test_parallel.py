@@ -118,3 +118,53 @@ class TestStrategies:
 
         out = np.asarray(self._run2d(hvd, fn, x))
         np.testing.assert_allclose(out[0], x.sum(0), rtol=1e-4)
+
+
+class TestDcnMesh:
+    """Multi-slice (DCN) factorization: the 'cross' axis of the 2-level
+    strategies must sit on the slice boundary when the job spans slices
+    (reference mapping SURVEY §5.8; the fork's torus node boundary)."""
+
+    def test_forced_slices_build_dcn_mesh(self, hvd, rng, monkeypatch):
+        from horovod_tpu.common.topology import build_topology
+        monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+        topo = build_topology()
+        assert topo.num_slices == 2
+        assert topo.mesh_dcn is not None
+        assert topo.mesh_dcn.devices.shape == (2, N // 2)
+        assert topo.hierarchical_mesh is topo.mesh_dcn
+
+        # Torus allreduce over the DCN mesh matches numpy.
+        from horovod_tpu.parallel import allreduce_torus
+        x = np.asarray(rng.standard_normal((N, 6)), np.float32)
+
+        def fn(xl):
+            return allreduce_torus(jnp.squeeze(xl, 0))[None]
+
+        out = np.asarray(jax.jit(jax.shard_map(
+            fn, mesh=topo.hierarchical_mesh, in_specs=P(("cross", "local")),
+            out_specs=P(("cross", "local"))))(x))
+        for r in range(N):
+            np.testing.assert_allclose(out[r], x.sum(0), rtol=1e-4)
+
+    def test_no_slices_falls_back_to_host_mesh(self, hvd):
+        from horovod_tpu.common.topology import build_topology
+        topo = build_topology()
+        assert topo.mesh_dcn is None
+        assert topo.hierarchical_mesh is topo.mesh2d
+
+    def test_slice_id_attr_detection(self):
+        from horovod_tpu.common.topology import _slice_id
+
+        class D1:
+            slice_index = 3
+
+        class D2:
+            partition_index = 5
+
+        class D3:
+            pass
+
+        assert _slice_id(D1()) == 3
+        assert _slice_id(D2()) == 5
+        assert _slice_id(D3()) is None
