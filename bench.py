@@ -58,6 +58,67 @@ def _init_with_retry(hvd, attempts=8, first_delay=5.0):
             delay = min(delay * 2, 60.0)
 
 
+def _bench_bert(hvd):
+    """BERT-Large MLM+NSP fine-tune step, seq 128 (BASELINE tracked config:
+    'BERT-Large fine-tune with tensor fusion'; reference procedure analog of
+    docs/benchmarks.rst real-model mode). Reports sequences/sec/chip."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from horovod_tpu.models.bert import BertConfig, BertForPreTraining
+    from horovod_tpu.optim import DistributedOptimizer
+    from horovod_tpu.parallel import TrainState, make_train_step
+
+    n = hvd.size()
+    mesh = hvd.global_process_set.mesh
+    seq = int(os.environ.get("HVD_BENCH_SEQ", "128"))
+    per_chip = int(os.environ.get("HVD_BENCH_BATCH", "32"))
+    batch = per_chip * n
+    cfg = BertConfig.large()
+    model = BertForPreTraining(cfg)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    labels = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                         jnp.int32)
+    nsp = jnp.asarray(rng.integers(0, 2, (batch,)), jnp.int32)
+
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), ids[:1])
+    _mark("bert init done")
+    opt = DistributedOptimizer(optax.adamw(1e-5))
+
+    def loss_fn(p, b):
+        mlm_logits, nsp_logits = model.apply({"params": p}, b["ids"])
+        mlm = optax.softmax_cross_entropy_with_integer_labels(
+            mlm_logits, b["mlm"]).mean()
+        nsp_l = optax.softmax_cross_entropy_with_integer_labels(
+            nsp_logits, b["nsp"]).mean()
+        return mlm + nsp_l
+
+    step = make_train_step(loss_fn, opt, mesh, donate=True)
+    state = TrainState.create(variables["params"], opt)
+    data = {"ids": ids, "mlm": labels, "nsp": nsp}
+    for i in range(2):
+        state, loss = step(state, data)
+        float(loss)
+        _mark(f"warmup step {i} done")
+    iters = int(os.environ.get("HVD_BENCH_ITERS", "20"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, data)
+    float(loss)
+    dt = time.perf_counter() - t0
+    _mark(f"{iters} timed steps in {dt:.2f}s")
+    seqs_per_sec = batch * iters / dt / n
+    print(json.dumps({
+        "metric": "bert_large_seqs_per_sec_per_chip",
+        "value": round(seqs_per_sec, 2),
+        "unit": "sequences/sec/chip",
+        "vs_baseline": 0.0,  # the reference publishes no absolute BERT number
+    }))
+
+
 def main():
     import horovod_tpu as hvd
     from horovod_tpu.models import ResNet50
@@ -66,6 +127,8 @@ def main():
 
     _init_with_retry(hvd)
     _mark("hvd.init done")
+    if os.environ.get("HVD_BENCH_MODEL", "resnet50") == "bert":
+        return _bench_bert(hvd)
     n = hvd.size()
     mesh = hvd.global_process_set.mesh
 

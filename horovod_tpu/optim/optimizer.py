@@ -13,9 +13,11 @@ run *inside* the jitted, shard_mapped train step. There are no per-parameter
 hooks or async handles — XLA sees every gradient at once, so we implement the
 fusion buffer (reference: fusion_buffer_manager.h) ahead-of-time:
 :func:`fused_allreduce_tree` groups all leaves by dtype, concatenates them
-into flat buffers, and reduces each with a single ICI ``psum`` — one or two
-collectives per step regardless of parameter count, with XLA free to overlap
-them with the backward pass. ``backward_passes_per_step`` maps onto
+into flat buffers capped at ``HOROVOD_FUSION_THRESHOLD`` bytes, and reduces
+each bucket with a single ICI ``psum`` — collectives per step scale with
+total gradient bytes over the threshold (a handful for typical models), not
+with parameter count, and XLA is free to overlap them with the backward
+pass. ``backward_passes_per_step`` maps onto
 ``optax.MultiSteps`` (local accumulation; the allreduce runs only on the
 boundary step, exactly the reference's aggregation semantics).
 """
@@ -40,11 +42,22 @@ def fused_allreduce_tree(tree, op=Average, axis_name=HVD_AXIS,
 
     The in-jit analog of Horovod's tensor fusion: instead of one collective
     per parameter (reference enqueues per-tensor and fuses in the background
-    cycle), we emit one collective per distinct wire dtype.
+    cycle), leaves are packed into flat buckets of up to
+    ``HOROVOD_FUSION_THRESHOLD`` bytes per wire dtype — so the collective
+    count is ``ceil(group_bytes / threshold)`` per dtype group (one for
+    models under the threshold; e.g. BERT-Large's 1.4 GB fp32 gradients at
+    the default 64 MB threshold reduce in ~22 buckets).
     """
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     if not leaves:
         return tree
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.common.exceptions import NotInitializedError
+    try:
+        threshold = basics.config().fusion_threshold
+    except NotInitializedError:
+        threshold = Config().fusion_threshold
     compressed = [compression.compress(jnp.asarray(l)) for l in leaves]
     groups = {}
     for i, (c, _) in enumerate(compressed):
@@ -67,18 +80,41 @@ def fused_allreduce_tree(tree, op=Average, axis_name=HVD_AXIS,
                     process_set=process_set, prescale_factor=prescale_factor,
                     postscale_factor=postscale_factor)
             continue
-        flats = [compressed[i][0].reshape(-1) for i in idxs]
-        buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-        buf = in_jit.allreduce(buf, op=op, axis_name=axis_name,
-                               process_set=process_set,
-                               prescale_factor=prescale_factor,
-                               postscale_factor=postscale_factor)
-        off = 0
+        # Bucket the group at the fusion threshold (reference:
+        # HOROVOD_FUSION_THRESHOLD, fusion_buffer_manager.h:40): one giant
+        # flat buffer both doubles peak gradient memory and — with an
+        # awkward element count (e.g. BERT-Large's 367,480,636 = 4 × a
+        # large prime) — pushes XLA into pathological 2-D re-tilings of
+        # the 1-D vector that OOM on padding.
+        buckets, cur, cur_bytes = [], [], 0
         for i in idxs:
-            sz = compressed[i][0].size
-            out[i] = jax.lax.slice_in_dim(buf, off, off + sz).reshape(
-                compressed[i][0].shape)
-            off += sz
+            nbytes = compressed[i][0].size * dt.itemsize
+            if cur and cur_bytes + nbytes > threshold:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(i)
+            cur_bytes += nbytes
+        buckets.append(cur)
+        for bucket in buckets:
+            flats = [compressed[i][0].reshape(-1) for i in bucket]
+            buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+            total = buf.size
+            # Tile-friendly length (the FUSION_BUFFER_ATOMIC_UNIT move,
+            # common.h:156): without it XLA may factor an odd-length
+            # vector into (huge, 2) and pad the lane dim 64x.
+            pad = (-total) % 1024
+            if pad:
+                buf = jnp.pad(buf, (0, pad))
+            buf = in_jit.allreduce(buf, op=op, axis_name=axis_name,
+                                   process_set=process_set,
+                                   prescale_factor=prescale_factor,
+                                   postscale_factor=postscale_factor)
+            off = 0
+            for i in bucket:
+                sz = compressed[i][0].size
+                out[i] = jax.lax.slice_in_dim(buf, off, off + sz).reshape(
+                    compressed[i][0].shape)
+                off += sz
     out = [compression.decompress(o, ctx)
            for o, (_, ctx) in zip(out, compressed)]
     return jax.tree_util.tree_unflatten(treedef, out)

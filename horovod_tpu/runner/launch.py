@@ -251,6 +251,38 @@ def _run_static_mpi(args, launcher, extra_env=None):
         kv.stop()
 
 
+def _bootstrap_watchdog(kv, expected_cross_ranks, warn_after=45.0):
+    """Diagnose dead launches early: workers register a reachability probe
+    in the KV before collective init (runner/task.py _register_bootstrap,
+    the analog of the reference's NIC probing task_fn.py:23-54). If some
+    never do, warn naming the missing host slots — long before
+    jax.distributed's multi-minute init timeout expires silently."""
+    import threading as _threading
+    import time as _time
+
+    done = _threading.Event()
+
+    def watch():
+        deadline = _time.time() + warn_after
+        missing = set(expected_cross_ranks)
+        while missing and _time.time() < deadline:
+            for r in list(missing):
+                if kv.get("bootstrap", str(r)) is not None:
+                    missing.discard(r)
+            if done.wait(1.0):
+                return  # run finished: a missing probe is moot, not a fault
+        if missing and not done.is_set():
+            hvd_logging.warning(
+                "no bootstrap probe from host slot(s) %s after %.0fs — "
+                "check ssh/network reachability from those hosts to the "
+                "driver (KV port)", sorted(missing), warn_after)
+
+    t = _threading.Thread(target=watch, daemon=True)
+    t.start()
+    t.cancel = done.set
+    return t
+
+
 def _run_static(args, extra_env=None, harvest=None, kv_preload=None):
     slot_infos, by_host, coordinator_addr, coordinator_port, kv, kv_port = \
         _start_rendezvous(args)
@@ -267,9 +299,21 @@ def _run_static(args, extra_env=None, harvest=None, kv_preload=None):
                 host, args.command, env, tag=f"{host}",
                 ssh_port=args.ssh_port,
                 ssh_identity_file=args.ssh_identity_file))
+        expected_slots = [slots[0].cross_rank for slots in by_host.values()]
+        watchdog = _bootstrap_watchdog(kv, expected_slots)
         failures = wait_for_any_failure_or_all_success(workers)
+        watchdog.cancel()
         if failures:
             hvd_logging.error("workers failed: %s", failures)
+            # Immediate reachability diagnosis (the watchdog was cancelled):
+            # slots that never probed in likely couldn't reach the driver.
+            missing = [r for r in expected_slots
+                       if kv.get("bootstrap", str(r)) is None]
+            if missing:
+                hvd_logging.error(
+                    "host slot(s) %s never reached the driver control "
+                    "plane — check ssh/network from those hosts to the "
+                    "driver (KV port)", sorted(missing))
             return 1
         if harvest is not None:
             harvest(kv)

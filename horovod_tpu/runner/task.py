@@ -25,7 +25,43 @@ def _load_payload(spec):
         return f.read()
 
 
+def _register_bootstrap():
+    """Reachability probe: record which address this worker routes to the
+    driver from, keyed by its host slot, BEFORE collective init.
+
+    Reference: task_fn.py:23-54 — tasks probe routable NICs and report
+    their interfaces so the driver can diagnose dead launches early.
+    Here the successful signed KV write IS the routability proof (worker →
+    driver control plane), and the recorded source address tells operators
+    which interface that was. Failures are non-fatal — the probe is a
+    diagnostic, not a gate."""
+    kv_addr = os.environ.get("HOROVOD_KV_ADDR")
+    kv_port = os.environ.get("HOROVOD_KV_PORT")
+    if not (kv_addr and kv_port):
+        return
+    try:
+        import json
+        import socket
+        with socket.socket(socket.AF_INET, socket.SOCK_DGRAM) as s:
+            s.connect((kv_addr, int(kv_port)))
+            src = s.getsockname()[0]
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.runner.http_kv import KVStoreClient
+        # Config.from_env resolves the process index for ALL launch paths
+        # (HOROVOD_CROSS_RANK for ssh; OMPI/PMI/Slurm env for mpirun/jsrun,
+        # which export no per-host HOROVOD rank) — a plain env read here
+        # would collapse every MPI worker onto slot "0".
+        slot = str(Config.from_env().cross_rank)
+        KVStoreClient(kv_addr, int(kv_port)).put(
+            "bootstrap", slot,
+            json.dumps({"hostname": socket.gethostname(), "src_addr": src,
+                        "pid": os.getpid()}).encode())
+    except Exception as e:  # diagnostic only
+        print(f"# bootstrap probe failed (continuing): {e}", file=sys.stderr)
+
+
 def main():
+    _register_bootstrap()
     func, args, kwargs = cloudpickle.loads(_load_payload(sys.argv[1]))
 
     # Site hooks may force a platform via jax.config at interpreter start,

@@ -219,11 +219,49 @@ class TestRunApi:
         from horovod_tpu.runner import run
 
         def fn(tag):
+            import json
+            import os
+
             import horovod_tpu as h
+            from horovod_tpu.runner.http_kv import KVStoreClient
+            # The bootstrap reachability probe (task.py _register_bootstrap,
+            # reference: task_fn.py:23-54 NIC probing) must have landed
+            # before user code runs.
+            cli = KVStoreClient(os.environ["HOROVOD_KV_ADDR"],
+                                int(os.environ["HOROVOD_KV_PORT"]))
+            probe = json.loads(cli.get("bootstrap", str(h.cross_rank())))
+            assert probe["pid"] == os.getpid()
+            assert probe["src_addr"]
             return (tag, h.cross_rank(), h.process_count())
 
         results = run(fn, args=("ok",), hosts="localhost:1,127.0.0.1:1")
         assert results == [("ok", 0, 2), ("ok", 1, 2)]
+
+    def test_bootstrap_watchdog_warns_on_missing_hosts(self):
+        import logging
+
+        from horovod_tpu.common.logging import get_logger
+        from horovod_tpu.runner.http_kv import KVStoreServer
+        from horovod_tpu.runner.launch import _bootstrap_watchdog
+
+        records = []
+
+        class _Capture(logging.Handler):
+            def emit(self, record):
+                records.append(record.getMessage())
+
+        handler = _Capture()
+        get_logger().addHandler(handler)  # hvd logger doesn't propagate
+        srv = KVStoreServer()
+        srv.start()
+        try:
+            srv.put("bootstrap", "0", b"{}")  # slot 0 registered, 1 missing
+            t = _bootstrap_watchdog(srv, [0, 1], warn_after=1.5)
+            t.join(timeout=10)
+            assert any("host slot(s) [1]" in m for m in records), records
+        finally:
+            srv.stop()
+            get_logger().removeHandler(handler)
 
     def test_run_elastic_multihost(self, hvd, tmp_path):
         """Multi-host elastic run(): a discovery script supplies the host
