@@ -1,0 +1,77 @@
+"""Native (flax/optax) data-parallel training — the minimal horovod_tpu
+program (reference analog: examples/tensorflow2/tensorflow2_mnist.py: init,
+wrap optimizer, broadcast, train).
+
+Run single-process (all local chips) or under the launcher:
+    hvdrun -np 2 -H localhost:1,127.0.0.1:1 python flax_mnist.py
+"""
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.optim import DistributedOptimizer, broadcast_parameters
+from horovod_tpu.parallel import TrainState, make_train_step
+
+
+class CNN(nn.Module):
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(16, (3, 3), strides=2)(x)
+        x = nn.relu(x)
+        x = nn.Conv(32, (3, 3), strides=2)(x)
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(128)(x)
+        x = nn.relu(x)
+        return nn.Dense(10)(x)
+
+
+def synthetic_mnist(n, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.standard_normal((n, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, (n,)).astype(np.int32)
+    return x, y
+
+
+def main():
+    hvd.init()
+    mesh = hvd.global_process_set.mesh
+    n = hvd.size()
+    print(f"rank={hvd.rank()} size={n} local_size={hvd.local_size()}")
+
+    model = CNN()
+    params = model.init(jax.random.PRNGKey(0),
+                        jnp.zeros((1, 28, 28, 1)))["params"]
+    # All ranks start identical (reference: hvd.broadcast_parameters /
+    # BroadcastGlobalVariablesHook).
+    params = broadcast_parameters(params, root_rank=0)
+
+    opt = DistributedOptimizer(optax.adam(1e-3))
+    state = TrainState.create(params, opt)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    step = make_train_step(loss_fn, opt, mesh)
+
+    per_chip = 32
+    x, y = synthetic_mnist(per_chip * n * 20)
+    for i in range(20):
+        sl = slice(i * per_chip * n, (i + 1) * per_chip * n)
+        state, loss = step(state, {"x": jnp.asarray(x[sl]),
+                                   "y": jnp.asarray(y[sl])})
+        if i % 5 == 0 and hvd.rank() == 0:
+            print(f"step {i}: loss {float(loss):.4f}")
+    if hvd.rank() == 0:
+        print(f"final loss {float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,37 @@
+"""Keras training with hvd.DistributedOptimizer + callbacks (reference
+analog: examples/keras/keras_mnist.py)."""
+
+import numpy as np
+
+import horovod_tpu.keras as hvd
+
+
+def main():
+    hvd.init()
+    import keras
+
+    rng = np.random.default_rng(0)
+    x = rng.standard_normal((2048, 784)).astype(np.float32)
+    y = rng.integers(0, 10, (2048,)).astype(np.int64)
+
+    model = keras.Sequential([
+        keras.layers.Dense(128, activation="relu"),
+        keras.layers.Dense(10, activation="softmax"),
+    ])
+    opt = hvd.DistributedOptimizer(keras.optimizers.Adam(1e-3 * hvd.size()))
+    model.compile(optimizer=opt,
+                  loss="sparse_categorical_crossentropy",
+                  metrics=["accuracy"])
+
+    callbacks = [
+        hvd.callbacks.BroadcastGlobalVariablesCallback(0),
+        hvd.callbacks.MetricAverageCallback(),
+        hvd.callbacks.LearningRateWarmupCallback(
+            initial_lr=1e-3 * hvd.size(), warmup_epochs=1),
+    ]
+    model.fit(x, y, batch_size=64, epochs=2, callbacks=callbacks,
+              verbose=2 if hvd.rank() == 0 else 0)
+
+
+if __name__ == "__main__":
+    main()

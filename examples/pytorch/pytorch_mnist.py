@@ -1,0 +1,54 @@
+"""PyTorch training loop with the torch frontend (reference analog:
+examples/pytorch/pytorch_mnist.py)."""
+
+import torch
+import torch.nn as nn
+import torch.nn.functional as F
+
+import horovod_tpu.torch as hvd
+
+
+class Net(nn.Module):
+    def __init__(self):
+        super().__init__()
+        self.fc1 = nn.Linear(784, 128)
+        self.fc2 = nn.Linear(128, 10)
+
+    def forward(self, x):
+        return self.fc2(F.relu(self.fc1(x.flatten(1))))
+
+
+def main():
+    hvd.init()
+    torch.manual_seed(hvd.rank())
+
+    x = torch.randn(2048, 1, 28, 28)
+    y = torch.randint(0, 10, (2048,))
+    dataset = torch.utils.data.TensorDataset(x, y)
+    # Shard like the reference's DistributedSampler.
+    sampler = torch.utils.data.distributed.DistributedSampler(
+        dataset, num_replicas=hvd.size(), rank=hvd.rank() % hvd.size())
+    loader = torch.utils.data.DataLoader(dataset, batch_size=64,
+                                         sampler=sampler)
+
+    model = Net()
+    optimizer = torch.optim.Adam(model.parameters(), lr=1e-3)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    model.train()
+    for epoch in range(2):
+        sampler.set_epoch(epoch)
+        for i, (images, labels) in enumerate(loader):
+            optimizer.zero_grad()
+            loss = F.cross_entropy(model(images), labels)
+            loss.backward()
+            optimizer.step()
+            if i % 10 == 0 and hvd.rank() == 0:
+                print(f"epoch {epoch} step {i}: loss {loss.item():.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,53 @@
+"""PyTorch synthetic benchmark over the torch frontend (reference analog:
+examples/pytorch/pytorch_synthetic_benchmark.py)."""
+
+import argparse
+import time
+
+import torch
+import torch.nn as nn
+
+import horovod_tpu.torch as hvd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-iters", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+    torch.manual_seed(0)
+
+    model = nn.Sequential(
+        nn.Conv2d(3, 32, 3), nn.ReLU(), nn.AdaptiveAvgPool2d(1),
+        nn.Flatten(), nn.Linear(32, 10))
+    optimizer = torch.optim.SGD(model.parameters(), lr=0.01)
+    optimizer = hvd.DistributedOptimizer(
+        optimizer, named_parameters=model.named_parameters())
+    hvd.broadcast_parameters(model.state_dict(), root_rank=0)
+    hvd.broadcast_optimizer_state(optimizer, root_rank=0)
+
+    data = torch.randn(args.batch_size, 3, 32, 32)
+    target = torch.randint(0, 10, (args.batch_size,))
+    loss_fn = nn.CrossEntropyLoss()
+
+    def step():
+        optimizer.zero_grad()
+        loss = loss_fn(model(data), target)
+        loss.backward()
+        optimizer.step()
+        return loss
+
+    step()  # warmup
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        loss = step()
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        total = args.batch_size * hvd.size() * args.num_iters / dt
+        print(f"loss {loss.item():.4f}; {total:.1f} img/sec total")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,49 @@
+"""TF2 training with DistributedGradientTape (reference analog:
+examples/tensorflow2/tensorflow2_mnist.py)."""
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    hvd.init()
+
+    rng = np.random.default_rng(hvd.rank())
+    x = rng.standard_normal((2048, 28, 28, 1)).astype(np.float32)
+    y = rng.integers(0, 10, (2048,)).astype(np.int64)
+    dataset = tf.data.Dataset.from_tensor_slices((x, y)) \
+        .shard(hvd.size(), hvd.rank() % max(hvd.size(), 1)) \
+        .shuffle(1024, seed=0).batch(64)
+
+    model = tf.keras.Sequential([
+        tf.keras.layers.Flatten(),
+        tf.keras.layers.Dense(128, activation="relu"),
+        tf.keras.layers.Dense(10),
+    ])
+    loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+    opt = tf.keras.optimizers.Adam(1e-3)
+
+    @tf.function
+    def train_step(images, labels, first_batch):
+        with tf.GradientTape() as tape:
+            loss = loss_obj(labels, model(images, training=True))
+        tape = hvd.DistributedGradientTape(tape)
+        grads = tape.gradient(loss, model.trainable_variables)
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    for i, (images, labels) in enumerate(dataset.take(30)):
+        loss = train_step(images, labels, i == 0)
+        if i == 0:
+            # After the first step created the variables/slots
+            # (reference: broadcast after first gradient application).
+            hvd.broadcast_variables(model.variables, root_rank=0)
+            hvd.broadcast_variables(opt.variables, root_rank=0)
+        if i % 10 == 0 and hvd.rank() == 0:
+            print(f"step {i}: loss {float(loss):.4f}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,56 @@
+"""TF2 synthetic benchmark: a compiled tf.function training step with the
+gradient allreduce INSIDE the graph (reference analog: examples/tensorflow2/
+tensorflow2_synthetic_benchmark.py)."""
+
+import argparse
+import time
+
+import numpy as np
+import tensorflow as tf
+
+import horovod_tpu.tensorflow as hvd
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--batch-size", type=int, default=32)
+    p.add_argument("--num-iters", type=int, default=10)
+    args = p.parse_args()
+
+    hvd.init()
+    model = tf.keras.Sequential([
+        tf.keras.layers.Conv2D(32, 3, activation="relu"),
+        tf.keras.layers.GlobalAveragePooling2D(),
+        tf.keras.layers.Dense(10),
+    ])
+    opt = tf.keras.optimizers.SGD(0.01)
+    data = tf.random.normal((args.batch_size, 32, 32, 3))
+    target = tf.random.uniform((args.batch_size,), 0, 10, tf.int64)
+    loss_obj = tf.keras.losses.SparseCategoricalCrossentropy(from_logits=True)
+
+    @tf.function
+    def step():
+        with tf.GradientTape() as tape:
+            loss = loss_obj(target, model(data, training=True))
+        grads = tape.gradient(loss, model.trainable_variables)
+        # In-graph collective: rides a host-callback op registered by the
+        # frontend (the reference's HorovodAllreduce custom-op analog).
+        grads = [hvd.allreduce(g, op=hvd.Average) for g in grads]
+        opt.apply_gradients(zip(grads, model.trainable_variables))
+        return loss
+
+    step()  # trace/compile + create slots
+    hvd.broadcast_variables(model.variables, root_rank=0)
+    hvd.broadcast_variables(opt.variables, root_rank=0)
+
+    t0 = time.perf_counter()
+    for _ in range(args.num_iters):
+        loss = step()
+    dt = time.perf_counter() - t0
+    if hvd.rank() == 0:
+        total = args.batch_size * hvd.size() * args.num_iters / dt
+        print(f"loss {float(loss):.4f}; {total:.1f} img/sec total")
+
+
+if __name__ == "__main__":
+    main()

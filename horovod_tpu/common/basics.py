@@ -104,18 +104,18 @@ def init(comm=None, process_sets=None, devices=None):
             else:
                 replace = True
             if replace:
-                # Backends created before distributed bootstrap (again,
-                # site hooks) would freeze a single-process view; clear them
+                # Backends created before distributed bootstrap (site
+                # hooks, or a previous smaller world during elastic
+                # scale-up) would freeze a stale topology view; clear them
                 # so they rebuild with the cluster's global topology.
-                try:
-                    from jax._src import xla_bridge as _xb
-                    if _xb.backends_are_initialized():
-                        hvd_logging.warning(
-                            "clearing pre-initialized XLA backends before "
-                            "distributed bootstrap")
-                        _xb._clear_backends()
-                except ImportError:  # pragma: no cover
-                    pass
+                # Failures propagate: continuing with a stale backend is
+                # the exact wedge this block exists to prevent.
+                from jax._src import xla_bridge as _xb
+                if _xb.backends_are_initialized():
+                    hvd_logging.warning(
+                        "clearing pre-initialized XLA backends before "
+                        "distributed bootstrap")
+                    _clear_backends_and_program_caches()
                 kwargs = {}
                 if os.environ.get("HOROVOD_ELASTIC"):
                     # Elastic membership: a peer dying must surface as a
@@ -150,6 +150,23 @@ def init(comm=None, process_sets=None, devices=None):
         atexit.register(shutdown)
 
 
+def _clear_backends_and_program_caches():
+    """Drop every XLA client AND every compiled-program cache that captures
+    mesh/device objects, so everything rebuilds against the next backend.
+
+    Must be the PUBLIC clear (``jax.extend.backend.clear_backends``) — the
+    private ``xla_bridge._clear_backends`` leaves the ``get_backend``
+    util.cache serving the old client, which keeps ``jax.devices()``
+    returning a dead multi-process world after an elastic resize."""
+    from jax.extend.backend import clear_backends
+    clear_backends()
+    from horovod_tpu.ops import collective_ops, fusion
+    collective_ops.clear_program_caches()
+    # Fused eager programs are keyed by Mesh too; stale entries would pin
+    # the torn-down client (and its buffers) for the rest of the job.
+    fusion._fused_program.cache_clear()
+
+
 def teardown_distributed():
     """Fully dissolve the jax.distributed cluster membership so backends
     rebuilt afterwards see a single-process world.
@@ -171,18 +188,7 @@ def teardown_distributed():
         _dist.global_state.coordinator_address = None
     except Exception as e:  # pragma: no cover
         hvd_logging.warning("distributed state reset: %s", e)
-    try:
-        # The public clear (not xla_bridge._clear_backends): it also clears
-        # the get_backend util.cache and pjit caches — without that,
-        # jax.devices() keeps returning the old multi-process client.
-        from jax.extend.backend import clear_backends
-        clear_backends()
-    except ImportError:  # pragma: no cover
-        pass
-    # Compiled eager collective programs hold the old mesh/devices; drop
-    # them so they rebuild against the new backend.
-    from horovod_tpu.ops import collective_ops as _c
-    _c.clear_program_caches()
+    _clear_backends_and_program_caches()
 
 
 def shutdown():

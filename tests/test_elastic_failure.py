@@ -77,3 +77,69 @@ class TestElasticFailureInjection:
         # The per-step world-size log proves the membership transition
         # happened exactly at the restore point (2,2,2 then 1,1,1).
         assert worlds == [2, 2, 2, 1, 1, 1]
+
+    def test_host_added_midrun_scales_up_in_place(self, hvd, tmp_path):
+        """Scale-UP: discovery grows 1 -> 2 hosts mid-training; the
+        surviving worker re-initializes in place at the next commit, the
+        new worker syncs state via the rank-0 broadcast, and training
+        continues at world 2 (reference: elastic_common.py host-add leg)."""
+        from horovod_tpu.runner import run_elastic
+
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho localhost:1\n")
+        script.chmod(0o755)
+
+        total_steps = 8
+
+        def train(script_path, total_steps):
+            import time
+
+            import jax.numpy as jnp
+            import numpy as np
+
+            import horovod_tpu as hvd
+            from horovod_tpu import elastic
+
+            hvd.init()
+            state = elastic.TpuState(trees={"w": jnp.zeros((2,))},
+                                     step=0, worlds=[])
+            elastic.attach_listener(state)
+
+            @elastic.run
+            def loop(state):
+                while state.step < total_steps:
+                    if state.step == 3 and hvd.process_count() == 1:
+                        # Grow the membership, then give the driver time to
+                        # spawn the new host before the next commit checks.
+                        with open(script_path, "w") as f:
+                            f.write("#!/bin/sh\necho localhost:1\n"
+                                    "echo 127.0.0.1:1\n")
+                        time.sleep(3)
+                    g = hvd.allreduce(jnp.ones((1, 2)), op=hvd.Sum)
+                    state.w = state.w + g[0]
+                    state.step += 1
+                    state.worlds.append(hvd.process_count())
+                    state.commit()
+                return (state.step, np.asarray(state.w).tolist(),
+                        list(state.worlds), hvd.cross_rank(),
+                        hvd.process_count())
+
+            return loop(state)
+
+        results = run_elastic(train, args=(str(script), total_steps),
+                              min_np=1, host_discovery_script=str(script))
+
+        assert len(results) == 2  # final world size 2: both hosts report
+        for steps, w, worlds, rank, final_world in results:
+            assert final_world == 2
+            assert steps == total_steps
+        w0 = results[0][1]
+        worlds0 = results[0][2]
+        # Original worker: 4 steps at world 1 (sum=1) then 4 at world 2
+        # (sum=2) -> w = 4*1 + 4*2 = 12. The step-3 iteration ran at world
+        # 1 (the bump is noticed at the commit AFTER the sleep).
+        assert worlds0.count(1) * 1 + worlds0.count(2) * 2 == w0[0]
+        assert worlds0[0] == 1 and worlds0[-1] == 2
+        # New worker starts from the synced state (broadcast from rank 0):
+        # its final w must equal the original worker's.
+        np.testing.assert_allclose(results[1][1], w0)

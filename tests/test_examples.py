@@ -1,0 +1,74 @@
+"""Smoke tier for examples/ — the reference CI runs its examples as smoke
+tests (.buildkite/gen-pipeline.sh:170-253). Each example runs as a real
+subprocess on the virtual CPU mesh with tiny iteration counts."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+EXAMPLES = os.path.join(REPO, "examples")
+
+
+def _run(script, *args, timeout=420, env_extra=None):
+    env = dict(os.environ)
+    env.update({
+        "JAX_PLATFORMS": "cpu",
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
+    })
+    env.update(env_extra or {})
+    r = subprocess.run([sys.executable, os.path.join(EXAMPLES, script),
+                        *args],
+                       capture_output=True, text=True, timeout=timeout,
+                       env=env)
+    assert r.returncode == 0, f"{script} failed:\n{r.stdout}\n{r.stderr}"
+    return r.stdout
+
+
+class TestExamples:
+    def test_flax_mnist(self):
+        out = _run("flax/flax_mnist.py")
+        assert "final loss" in out
+
+    def test_flax_synthetic_benchmark(self):
+        out = _run("flax/flax_synthetic_benchmark.py",
+                   "--batch-size", "2", "--num-iters", "2",
+                   "--num-warmup", "1")
+        assert "Img/sec per chip" in out
+
+    def test_tensorflow2_synthetic_benchmark(self):
+        pytest.importorskip("tensorflow")
+        out = _run("tensorflow/tensorflow2_synthetic_benchmark.py",
+                   "--batch-size", "4", "--num-iters", "2")
+        assert "img/sec total" in out
+
+    def test_tensorflow2_mnist(self):
+        pytest.importorskip("tensorflow")
+        out = _run("tensorflow/tensorflow2_mnist.py")
+        assert "loss" in out
+
+    def test_keras_mnist(self):
+        pytest.importorskip("keras")
+        _run("keras/keras_mnist.py")
+
+    def test_pytorch_synthetic_benchmark(self):
+        pytest.importorskip("torch")
+        out = _run("pytorch/pytorch_synthetic_benchmark.py",
+                   "--batch-size", "4", "--num-iters", "2")
+        assert "img/sec total" in out
+
+    def test_pytorch_mnist(self):
+        pytest.importorskip("torch")
+        out = _run("pytorch/pytorch_mnist.py")
+        assert "loss" in out
+
+    def test_elastic_train(self):
+        out = _run("elastic/elastic_train.py")
+        assert "max error:" in out
+
+    def test_spark_estimator(self):
+        out = _run("spark/spark_estimator.py")
+        assert "transform mse:" in out
