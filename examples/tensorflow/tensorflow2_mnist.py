@@ -26,7 +26,7 @@ def main():
     opt = tf.keras.optimizers.Adam(1e-3)
 
     @tf.function
-    def train_step(images, labels, first_batch):
+    def train_step(images, labels):
         with tf.GradientTape() as tape:
             loss = loss_obj(labels, model(images, training=True))
         tape = hvd.DistributedGradientTape(tape)
@@ -35,7 +35,7 @@ def main():
         return loss
 
     for i, (images, labels) in enumerate(dataset.take(30)):
-        loss = train_step(images, labels, i == 0)
+        loss = train_step(images, labels)
         if i == 0:
             # After the first step created the variables/slots
             # (reference: broadcast after first gradient application).

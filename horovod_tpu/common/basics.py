@@ -160,11 +160,8 @@ def _clear_backends_and_program_caches():
     returning a dead multi-process world after an elastic resize."""
     from jax.extend.backend import clear_backends
     clear_backends()
-    from horovod_tpu.ops import collective_ops, fusion
+    from horovod_tpu.ops import collective_ops
     collective_ops.clear_program_caches()
-    # Fused eager programs are keyed by Mesh too; stale entries would pin
-    # the torn-down client (and its buffers) for the rest of the job.
-    fusion._fused_program.cache_clear()
 
 
 def teardown_distributed():

@@ -1,0 +1,50 @@
+"""Shared helpers mirrored from the reference's ``horovod/common/util.py``.
+
+The reference uses these for extension-loading checks (``check_extension``,
+util.py:87-104), list chunking for explicit allreduce grouping
+(``split_list``), and capability queries (``gpu_available``). On TPU there is
+no per-framework compiled extension — the data plane is XLA — so the checks
+degenerate to honest constant answers, kept so reference code ports without
+edits.
+"""
+
+import math
+
+
+def check_extension(ext_name, ext_env_var=None, pkg_path=None, *args):
+    """The reference verifies the framework's C++ extension was compiled
+    (reference: common/util.py:87-104). The TPU build has a single native
+    runtime shared by every frontend, loaded lazily — nothing to check.
+    Kept for source compatibility; returns None like the reference.
+    """
+    return None
+
+
+def check_installed_version(name=None, version=None, exception=None):
+    """reference: common/util.py:107-121 — warns when the installed horovod
+    version differs from the one the extension was built against. The TPU
+    build is a single wheel; versions cannot diverge."""
+    return None
+
+
+def gpu_available(ext_base_name=None, verbose=False):
+    """reference: common/util.py:124-137 (asks the extension whether it was
+    built with CUDA/ROCm). This build targets TPU: no GPU operations."""
+    return False
+
+
+def split_list(items, num_parts):
+    """Split ``items`` into ``num_parts`` contiguous chunks whose sizes
+    differ by at most one (reference: common/util.py:140-148, used by the
+    ``groups=N`` explicit-grouping path of DistributedOptimizer)."""
+    if num_parts <= 0:
+        raise ValueError(f"num_parts must be positive, got {num_parts}")
+    n = len(items)
+    size = math.ceil(n / num_parts)
+    return [items[i:i + size] for i in range(0, n, size)]
+
+
+def num_rank_is_power_2(num_rank):
+    """True when ``num_rank`` is a power of two (reference:
+    common/util.py:151-160; Adasum's recursive halving-doubling needs it)."""
+    return num_rank != 0 and (num_rank & (num_rank - 1)) == 0

@@ -9,27 +9,62 @@ train step passes through — and allreduces there.
 
 from horovod_tpu.common.basics import (init, shutdown, is_initialized, rank,
                                        local_rank, cross_rank, size,
-                                       local_size, cross_size)
+                                       local_size, cross_size,
+                                       is_homogeneous, mpi_threads_supported,
+                                       mpi_enabled, mpi_built, gloo_enabled,
+                                       gloo_built, nccl_built, ddl_built,
+                                       ccl_built, cuda_built, rocm_built,
+                                       xla_built, ici_built, start_timeline,
+                                       stop_timeline)
+from horovod_tpu.common.process_sets import global_process_set
 from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min, Product,
                                             Sum)
 from horovod_tpu.tensorflow import (Compression, allgather, allreduce,
-                                    broadcast, broadcast_object,
-                                    broadcast_variables)
+                                    alltoall, broadcast, broadcast_object,
+                                    broadcast_variables, reducescatter)
 
 from horovod_tpu.keras import callbacks  # noqa: F401
 
 __all__ = ["init", "shutdown", "is_initialized", "rank", "local_rank",
            "cross_rank", "size", "local_size", "cross_size",
            "Average", "Sum", "Adasum", "Min", "Max", "Product",
-           "Compression", "allreduce", "allgather", "broadcast",
-           "broadcast_object", "broadcast_variables",
-           "DistributedOptimizer", "load_model", "callbacks"]
+           "Compression", "allreduce", "allgather", "broadcast", "alltoall",
+           "reducescatter", "broadcast_object", "broadcast_variables",
+           "broadcast_global_variables", "global_process_set",
+           "DistributedOptimizer", "PartialDistributedOptimizer",
+           "load_model", "callbacks", "elastic",
+           "is_homogeneous", "mpi_threads_supported", "mpi_enabled",
+           "mpi_built", "gloo_enabled", "gloo_built", "nccl_built",
+           "ddl_built", "ccl_built", "cuda_built", "rocm_built", "xla_built",
+           "ici_built", "start_timeline", "stop_timeline"]
+
+
+def __getattr__(name):
+    if name == "elastic":
+        import horovod_tpu.keras.elastic as elastic
+        return elastic
+    raise AttributeError(name)
+
+
+def broadcast_global_variables(root_rank=0):
+    """Broadcast every TF1-style global variable from root (reference:
+    keras/__init__.py broadcast_global_variables). Keras 3 keeps no global
+    collection — eager models should broadcast ``model.variables`` via
+    :func:`broadcast_variables` or the BroadcastGlobalVariablesCallback."""
+    import tensorflow as tf
+    broadcast_variables(tf.compat.v1.global_variables(),
+                        root_rank=root_rank)
 
 
 def DistributedOptimizer(optimizer, name=None,
                          compression=Compression.none,
                          sparse_as_dense=False, op=Average,
-                         backward_passes_per_step=1, process_set=None):
+                         backward_passes_per_step=1,
+                         average_aggregated_gradients=False,
+                         gradient_predivide_factor=1.0,
+                         groups=None, num_groups=0,
+                         process_set=None,
+                         local_layers=None, scale_local_gradients=True):
     """Wrap a Keras optimizer so gradients are averaged across hosts inside
     ``apply_gradients`` (reference: hvd.DistributedOptimizer
     keras/__init__.py:40-130).
@@ -42,6 +77,12 @@ def DistributedOptimizer(optimizer, name=None,
     import tensorflow as tf
 
     import horovod_tpu.tensorflow as hvd_tf
+
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    if num_groups != 0 and groups is None:
+        groups = num_groups
 
     cls = optimizer.__class__
     # Accumulation state lives in the closure, NOT as instance attributes:
@@ -72,8 +113,9 @@ def DistributedOptimizer(optimizer, name=None,
             agg["count"] += 1
             if agg["count"] < backward_passes_per_step:
                 return None
-            out = [None if a is None else a / backward_passes_per_step
-                   for a in acc]
+            scale = (backward_passes_per_step
+                     if average_aggregated_gradients else 1)
+            out = [None if a is None else a / scale for a in acc]
             agg["acc"] = None
             return out
 
@@ -89,18 +131,78 @@ def DistributedOptimizer(optimizer, name=None,
                 grads = self._hvd_accumulate(grads)
                 if grads is None:
                     return None  # mid-accumulation: no variable update
-            live = [g for g in grads if g is not None]
-            if live:
-                reduced = iter(hvd_tf.grouped_allreduce(
-                    live, op=op, compression=compression,
-                    process_set=process_set))
-                grads = [None if g is None else next(reduced) for g in grads]
+            def _key(v):
+                # Keras-3 Variables have no tf ref(); fall back to identity.
+                return v.ref() if hasattr(v, "ref") else id(v)
+
+            local_refs = set()
+            for layer in (local_layers or []):
+                lvars = getattr(layer, "trainable_variables", None)
+                for v in (lvars if lvars is not None else [layer]):
+                    local_refs.add(_key(v))
+            reduce_idx = [i for i, (g, v) in enumerate(zip(grads, variables))
+                          if g is not None and _key(v) not in local_refs]
+            if reduce_idx:
+                op_, prescale, postscale = op, 1.0, 1.0
+                if gradient_predivide_factor != 1.0 and op == Average:
+                    # Split the averaging around the sum (reference:
+                    # gradient_predivide_factor semantics,
+                    # tensorflow/__init__.py:822 docstring).
+                    ps = (process_set if process_set is not None
+                          else hvd_tf.global_process_set)
+                    prescale = 1.0 / gradient_predivide_factor
+                    postscale = gradient_predivide_factor / ps.size()
+                    op_ = Sum
+                if isinstance(groups, int) and groups > 0:
+                    chunks = hvd_tf.split_list(reduce_idx, groups)
+                elif isinstance(groups, (list, tuple)):
+                    by_key = {}
+                    for gi, group in enumerate(groups):
+                        for v in group:
+                            by_key[_key(v)] = gi
+                    chunk_map = {}
+                    for i in reduce_idx:
+                        k = by_key.get(_key(variables[i]), f"solo{i}")
+                        chunk_map.setdefault(k, []).append(i)
+                    chunks = list(chunk_map.values())
+                else:
+                    chunks = [reduce_idx]
+                grads = list(grads)
+                for chunk in chunks:
+                    reduced = hvd_tf.grouped_allreduce(
+                        [grads[i] for i in chunk], op=op_,
+                        prescale_factor=prescale, postscale_factor=postscale,
+                        compression=compression, process_set=process_set)
+                    for i, r in zip(chunk, reduced):
+                        grads[i] = r
+            if local_refs and scale_local_gradients:
+                ps = (process_set if process_set is not None
+                      else hvd_tf.global_process_set)
+                grads = [g / ps.size() if g is not None
+                         and _key(v) in local_refs else g
+                         for g, v in zip(grads, variables)]
             return super().apply_gradients(zip(grads, variables), *args,
                                            **kwargs)
 
     _Distributed.__name__ = cls.__name__
     optimizer.__class__ = _Distributed
     return optimizer
+
+
+def PartialDistributedOptimizer(optimizer, local_layers=None, name=None,
+                                compression=Compression.none,
+                                sparse_as_dense=False, op=Average,
+                                backward_passes_per_step=1, process_set=None,
+                                scale_local_gradients=True):
+    """A DistributedOptimizer whose ``local_layers`` keep worker-local
+    gradients (reference: keras PartialDistributedOptimizer,
+    horovod/keras/__init__.py)."""
+    return DistributedOptimizer(
+        optimizer, name=name, compression=compression,
+        sparse_as_dense=sparse_as_dense, op=op,
+        backward_passes_per_step=backward_passes_per_step,
+        process_set=process_set, local_layers=local_layers,
+        scale_local_gradients=scale_local_gradients)
 
 
 def load_model(filepath, custom_optimizers=None, custom_objects=None,

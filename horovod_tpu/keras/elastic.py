@@ -1,0 +1,60 @@
+"""Elastic state for Keras models (reference: horovod/keras/elastic.py —
+KerasState:24 delegates to TensorFlowKerasState with the keras backend;
+CommitStateCallback/UpdateBatchStateCallback:44-92 commit/track per batch).
+"""
+
+from horovod_tpu.elastic.state import run  # noqa: F401  (re-export)
+from horovod_tpu.tensorflow.elastic import TensorFlowKerasState
+
+
+class KerasState(TensorFlowKerasState):
+    """State of a Keras model + optimizer (reference: keras/elastic.py:24)."""
+
+    def __init__(self, model, optimizer=None, **kwargs):
+        super().__init__(model, optimizer=optimizer, **kwargs)
+
+
+def _make_callback_base():
+    import tensorflow as tf
+    return tf.keras.callbacks.Callback
+
+
+class CommitStateCallback:
+    """Commit the elastic state every ``batches_per_commit`` batches
+    (reference: keras/elastic.py:44-66). Implemented as a factory returning
+    a Keras callback so TF import stays lazy."""
+
+    def __new__(cls, state, batches_per_commit=1):
+        Base = _make_callback_base()
+
+        class _Commit(Base):
+            def __init__(self):
+                super().__init__()
+                self._count = 0
+
+            def on_batch_end(self, batch, logs=None):
+                self._count += 1
+                if self._count % batches_per_commit == 0:
+                    state.commit()
+
+        return _Commit()
+
+
+class UpdateBatchStateCallback:
+    """Track ``state.batch``/``state.epoch`` so a restored worker resumes
+    mid-epoch (reference: keras/elastic.py:69-92)."""
+
+    def __new__(cls, state):
+        Base = _make_callback_base()
+
+        class _Update(Base):
+            def on_epoch_begin(self, epoch, logs=None):
+                state.epoch = epoch
+
+            def on_batch_end(self, batch, logs=None):
+                state.batch = batch
+
+            def on_epoch_end(self, epoch, logs=None):
+                state.batch = 0
+
+        return _Update()

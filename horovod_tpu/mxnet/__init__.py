@@ -21,12 +21,19 @@ from horovod_tpu.common.process_sets import (  # noqa: F401
     ProcessSet, add_process_set, global_process_set, process_set_by_id,
     remove_process_set,
 )
+from horovod_tpu.common.util import (  # noqa: F401
+    check_extension, check_installed_version, gpu_available,
+    num_rank_is_power_2, split_list,
+)
 from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401
     Adasum, Average, Max, Min, Product, ReduceOp, Sum,
-    allgather, allreduce, allreduce_, alltoall, barrier, broadcast,
-    broadcast_, grouped_allgather, grouped_allreduce, grouped_reducescatter,
-    reducescatter,
+    allgather, allgather_object, allreduce, allreduce_, alltoall, barrier,
+    broadcast, broadcast_, grouped_allgather, grouped_allreduce,
+    grouped_allreduce_, grouped_reducescatter, reducescatter,
 )
+# The mxnet bridge is numpy duck-typed, so the TF frontend's numpy
+# compressors serve here too (reference: horovod/mxnet/compression.py).
+from horovod_tpu.tensorflow import Compression  # noqa: F401
 from horovod_tpu.mxnet import mpi_ops as _ops
 
 

@@ -18,7 +18,8 @@ from horovod_tpu.ops import collective_ops as C
 from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min,  # noqa: F401
                                             Product, ReduceOp, Sum)
 
-__all__ = ["allreduce", "allreduce_", "grouped_allreduce", "allgather",
+__all__ = ["allreduce", "allreduce_", "grouped_allreduce",
+           "grouped_allreduce_", "allgather", "allgather_object",
            "grouped_allgather", "broadcast", "broadcast_", "alltoall",
            "reducescatter", "grouped_reducescatter", "barrier",
            "Average", "Sum", "Adasum", "Min", "Max", "Product", "ReduceOp"]
@@ -174,3 +175,27 @@ def grouped_reducescatter(tensors, op=Sum, name=None, priority=0,
 
 def barrier(process_set=None):
     C.barrier(process_set=process_set)
+
+
+def grouped_allreduce_(tensors, average=None, name=None, priority=0, op=None,
+                       prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=None):
+    """In-place grouped allreduce (reference: mxnet/mpi_ops.py
+    grouped_allreduce_)."""
+    outs = grouped_allreduce(tensors, average=average, name=name,
+                             priority=priority, op=op,
+                             prescale_factor=prescale_factor,
+                             postscale_factor=postscale_factor,
+                             process_set=process_set)
+    return [_copy_into(t, _to_numpy(o)) for t, o in zip(tensors, outs)]
+
+
+def allgather_object(obj, name=None, process_set=None):
+    """Gather one picklable object per rank (reference:
+    mxnet/mpi_ops.py allgather_object)."""
+    return C.allgather_object_single(obj, process_set=process_set, name=name)
+
+
+def broadcast_object(obj, root_rank=0, name=None, process_set=None):
+    return C.broadcast_object(obj, root_rank=root_rank, name=name,
+                              process_set=process_set)

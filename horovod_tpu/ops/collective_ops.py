@@ -353,6 +353,10 @@ def clear_program_caches():
                  _broadcast_program, _reducescatter_program,
                  _alltoall_program, _barrier_program):
         prog.cache_clear()
+    # Fused eager programs are keyed by Mesh too; stale entries would pin a
+    # torn-down XLA client (and its buffers) for the rest of the job.
+    from horovod_tpu.ops import fusion
+    fusion._fused_program.cache_clear()
 
 
 @functools.lru_cache(maxsize=1024)
@@ -896,6 +900,17 @@ def broadcast_object(obj, root_rank=0, process_set=None, name=None):
     out = broadcast(stacked, root_rank, process_set=process_set, name=name)
     data = bytes(np.asarray(out[0, :ln], np.uint8))
     return cloudpickle.loads(data)
+
+
+def allgather_object_single(obj, process_set=None, name=None):
+    """Frontend convenience: gather ONE object for this caller — the object
+    stands for each rank this process owns (all of them single-controller,
+    the local chips multi-process). Shared by the torch/tf/mxnet
+    ``allgather_object`` wrappers."""
+    mesh, ps = _mesh_for(process_set)
+    n_rows = _expected_rows(mesh, ps.size())
+    return allgather_object([obj] * n_rows, process_set=process_set,
+                            name=name)
 
 
 def allgather_object(objs, process_set=None, name=None):

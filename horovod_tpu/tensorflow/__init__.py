@@ -18,24 +18,65 @@ import numpy as np
 
 from horovod_tpu.common.basics import (init, shutdown, is_initialized, rank,
                                        local_rank, cross_rank, size,
-                                       local_size, cross_size)
+                                       local_size, cross_size,
+                                       is_homogeneous, mpi_threads_supported,
+                                       mpi_enabled, mpi_built, gloo_enabled,
+                                       gloo_built, nccl_built, ddl_built,
+                                       ccl_built, cuda_built, rocm_built,
+                                       xla_built, ici_built, start_timeline,
+                                       stop_timeline)
+from horovod_tpu.common.exceptions import HorovodInternalError  # noqa: F401
 from horovod_tpu.common.process_sets import (ProcessSet, add_process_set,
                                              global_process_set,
                                              process_set_by_id,
                                              remove_process_set)
+from horovod_tpu.common.util import (check_extension, check_installed_version,
+                                     gpu_available, num_rank_is_power_2,
+                                     split_list)
 from horovod_tpu.ops import collective_ops as C
 from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min, Product,
                                             ReduceOp, Sum)
+from horovod_tpu.tensorflow.util import refs_to_vars, vars_to_refs
+from horovod_tpu.version import __version__
 
 __all__ = [
     "init", "shutdown", "is_initialized", "rank", "local_rank", "cross_rank",
     "size", "local_size", "cross_size", "ProcessSet", "add_process_set",
     "global_process_set", "process_set_by_id", "remove_process_set",
     "ReduceOp", "Average", "Sum", "Adasum", "Min", "Max", "Product",
-    "allreduce", "grouped_allreduce", "allgather", "broadcast", "alltoall",
-    "reducescatter", "broadcast_variables", "broadcast_object",
-    "DistributedGradientTape", "Compression", "join", "barrier",
+    "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
+    "broadcast", "broadcast_", "alltoall",
+    "reducescatter", "grouped_reducescatter", "broadcast_variables",
+    "broadcast_object", "broadcast_object_fn", "allgather_object",
+    "DistributedGradientTape", "PartialDistributedGradientTape",
+    "DistributedOptimizer", "Compression", "join", "barrier",
+    "size_op", "rank_op", "local_size_op", "local_rank_op",
+    "process_set_included_op",
+    "is_homogeneous", "mpi_threads_supported", "mpi_enabled", "mpi_built",
+    "gloo_enabled", "gloo_built", "nccl_built", "ddl_built", "ccl_built",
+    "cuda_built", "rocm_built", "xla_built", "ici_built",
+    "start_timeline", "stop_timeline",
+    "check_extension", "check_installed_version", "gpu_available",
+    "num_rank_is_power_2", "check_num_rank_power_of_2", "split_list",
+    "handle_average_backwards_compatibility", "vars_to_refs", "refs_to_vars",
+    "SyncBatchNormalization", "LocalGradientAggregationHelper", "elastic",
 ]
+
+
+def __getattr__(name):
+    # Lazy heavy symbols (each pulls in TensorFlow on first touch), PEP 562.
+    if name == "SyncBatchNormalization":
+        from horovod_tpu.tensorflow.sync_batch_norm import \
+            SyncBatchNormalization
+        return SyncBatchNormalization
+    if name == "LocalGradientAggregationHelper":
+        from horovod_tpu.tensorflow.gradient_aggregation import \
+            LocalGradientAggregationHelper
+        return LocalGradientAggregationHelper
+    if name == "elastic":
+        import horovod_tpu.tensorflow.elastic as elastic
+        return elastic
+    raise AttributeError(name)
 
 
 def _tf():
@@ -326,9 +367,26 @@ def reducescatter(tensor, op=Sum, name=None, process_set=None):
     return _to_tf(_np_core(a), dtype)
 
 
-def broadcast_object(obj, root_rank=0, name=None, process_set=None):
+def broadcast_object(obj, root_rank=0, session=None, name=None,
+                     process_set=None):
+    # ``session`` kept for reference source compatibility (TF1); unused.
     return C.broadcast_object(obj, root_rank=root_rank, name=name,
                               process_set=process_set)
+
+
+def broadcast_object_fn(root_rank=0, session=None, name=None,
+                        process_set=None):
+    """Return a reusable object-broadcast callable (reference:
+    tensorflow/functions.py broadcast_object_fn — TF1 needed a pre-built op;
+    here it simply closes over the arguments)."""
+    return lambda obj: broadcast_object(obj, root_rank=root_rank, name=name,
+                                        process_set=process_set)
+
+
+def allgather_object(obj, session=None, name=None, process_set=None):
+    """Gather one picklable object per rank; every caller receives the full
+    list (reference: tensorflow/functions.py allgather_object)."""
+    return C.allgather_object_single(obj, process_set=process_set, name=name)
 
 
 def broadcast_variables(variables, root_rank=0, process_set=None):
@@ -346,6 +404,128 @@ def barrier(process_set=None):
     C.barrier(process_set=process_set)
 
 
+def handle_average_backwards_compatibility(op, average):
+    """Resolve the deprecated ``average`` flag against ``op`` (reference:
+    tensorflow/mpi_ops.py handle_average_backwards_compatibility)."""
+    if op is not None:
+        if average is not None:
+            raise ValueError(
+                "The op parameter supersedes average. Please provide only "
+                "the op parameter.")
+        return op
+    return Average if (average is None or average) else Sum
+
+
+def check_num_rank_power_of_2(num_rank):
+    """Adasum's recursive halving-doubling requires a power-of-two world
+    (reference: tensorflow/mpi_ops.py check_num_rank_power_of_2)."""
+    if not num_rank_is_power_2(num_rank):
+        raise ValueError(
+            "Running Adasum with non-power-of-2 ranks is not supported yet.")
+
+
+# --- graph-mode query ops ---------------------------------------------------
+# The reference registers tiny custom kernels (HorovodSize/Rank/..., mpi_ops.
+# cc:1565-1656) so elastic graphs read the LIVE world size at execution time
+# rather than baking a constant in at trace time. Here each is a
+# tf.numpy_function host callback reading the runtime's current answer.
+
+def _query_op(read, name):
+    tf = _tf()
+    out = tf.numpy_function(lambda: np.int32(read()), [], tf.int32, name=name)
+    out.set_shape(())
+    return out
+
+
+def size_op(process_set_id=0, name=None):
+    return _query_op(lambda: process_set_by_id(process_set_id).size(),
+                     name or "HorovodSize")
+
+
+def local_size_op(name=None):
+    return _query_op(local_size, name or "HorovodLocalSize")
+
+
+def rank_op(name=None):
+    return _query_op(rank, name or "HorovodRank")
+
+
+def local_rank_op(name=None):
+    return _query_op(local_rank, name or "HorovodLocalRank")
+
+
+def process_set_included_op(process_set_id=0, name=None):
+    return _query_op(
+        lambda: 1 if process_set_by_id(process_set_id).included() else 0,
+        name or "HorovodProcessSetIncluded")
+
+
+def broadcast_(variables, root_rank=0, name=None, process_set=None):
+    """In-place broadcast: assign every variable its root-rank value and
+    return the variables (reference: HorovodBroadcastInplace,
+    tensorflow/mpi_ops.py broadcast_)."""
+    for v in variables:
+        v.assign(broadcast(v, root_rank=root_rank, name=name,
+                           process_set=process_set))
+    return variables
+
+
+def grouped_allgather(tensors, name=None, process_set=None):
+    """Gather a group of tensors in one fused program (reference:
+    hvd.grouped_allgather tensorflow/mpi_ops.py)."""
+    tf = _tf()
+    ps = _ps(process_set)
+    n = ps.size()
+
+    def _np_core(*arrs):
+        outs = C.grouped_allgather([_stack(np.asarray(a), ps) for a in arrs],
+                                   process_set=process_set, name=name)
+        return [np.asarray(o)[0].reshape((n * a.shape[0],) + a.shape[1:])
+                .astype(np.asarray(a).dtype)
+                for o, a in zip(outs, arrs)]
+
+    if not tf.executing_eagerly():
+        tensors = [tf.convert_to_tensor(t) for t in tensors]
+        shapes = []
+        for t in tensors:
+            d0 = t.shape[0] if t.shape.rank else None
+            shapes.append(tf.TensorShape(
+                [n * d0 if d0 is not None else None] + list(t.shape[1:]))
+                if t.shape.rank else None)
+        return _graph_op(tensors, _np_core, "hvd_grouped_allgather",
+                         out_shapes=shapes)
+    arrs, dtypes = zip(*(_to_numpy(t) for t in tensors))
+    return [_to_tf(o, dt) for o, dt in zip(_np_core(*arrs), dtypes)]
+
+
+def grouped_reducescatter(tensors, op=Sum, name=None, process_set=None):
+    """Reduce-scatter a group of tensors in one fused program (reference:
+    hvd.grouped_reducescatter tensorflow/mpi_ops.py)."""
+    tf = _tf()
+    ps = _ps(process_set)
+    n = ps.size()
+
+    def _np_core(*arrs):
+        outs = C.grouped_reducescatter(
+            [_stack(np.asarray(a), ps) for a in arrs], op=op,
+            process_set=process_set, name=name)
+        return [np.asarray(o)[0].astype(np.asarray(a).dtype)
+                for o, a in zip(outs, arrs)]
+
+    if not tf.executing_eagerly():
+        tensors = [tf.convert_to_tensor(t) for t in tensors]
+        shapes = []
+        for t in tensors:
+            d0 = t.shape[0] if t.shape.rank else None
+            shapes.append(tf.TensorShape(
+                [d0 // n if d0 is not None else None] + list(t.shape[1:]))
+                if t.shape.rank else None)
+        return _graph_op(tensors, _np_core, "hvd_grouped_reducescatter",
+                         out_shapes=shapes)
+    arrs, dtypes = zip(*(_to_numpy(t) for t in tensors))
+    return [_to_tf(o, dt) for o, dt in zip(_np_core(*arrs), dtypes)]
+
+
 class DistributedGradientTape:
     """Wraps tf.GradientTape so ``gradient()`` returns cross-host-averaged
     gradients (reference: _DistributedGradientTape
@@ -354,13 +534,22 @@ class DistributedGradientTape:
     def __init__(self, gradtape, device_dense="", device_sparse="",
                  compression=Compression.none, sparse_as_dense=False,
                  op=Average, gradient_predivide_factor=1.0,
-                 num_groups=0, process_set=None):
+                 num_groups=0, process_set=None,
+                 scale_local_gradients=True):
         self._tape = gradtape
         self._compression = compression
         self._sparse_as_dense = sparse_as_dense
         self._op = op
         self._predivide = gradient_predivide_factor
         self._process_set = process_set
+        self._local_sources = set()
+        self._scale_local_gradients = scale_local_gradients
+
+    def register_local_source(self, source):
+        """Mark a source (tf.Variable) worker-local: its gradient stays
+        local instead of being allreduced (reference:
+        PartialDistributedGradientTape, tensorflow/__init__.py:1110+)."""
+        self._local_sources.add(source.ref())
 
     def __enter__(self):
         self._tape.__enter__()
@@ -385,8 +574,18 @@ class DistributedGradientTape:
                     "IndexedSlices gradient (embedding layer?): pass "
                     "sparse_as_dense=True to DistributedGradientTape "
                     "(the TPU data plane is dense)")
-        flat = [g for g in grads if g is not None]
-        if not flat:
+        src_list = list(sources) if isinstance(sources, (list, tuple)) \
+            else [sources]
+
+        def _is_local(i):
+            if not self._local_sources or i >= len(src_list):
+                return False
+            s = src_list[i]
+            return hasattr(s, "ref") and s.ref() in self._local_sources
+
+        reduce_idx = [i for i, g in enumerate(grads)
+                      if g is not None and not _is_local(i)]
+        if not reduce_idx:
             return grads
         op = self._op
         prescale = postscale = 1.0
@@ -394,8 +593,203 @@ class DistributedGradientTape:
             prescale = 1.0 / self._predivide
             postscale = self._predivide / _ps(self._process_set).size()
             op = Sum
-        reduced = iter(grouped_allreduce(
-            flat, op=op, prescale_factor=prescale,
+        reduced = grouped_allreduce(
+            [grads[i] for i in reduce_idx], op=op, prescale_factor=prescale,
             postscale_factor=postscale, process_set=self._process_set,
-            compression=self._compression))
-        return [None if g is None else next(reduced) for g in grads]
+            compression=self._compression)
+        out = list(grads)
+        for i, r in zip(reduce_idx, reduced):
+            out[i] = r
+        if self._local_sources and self._scale_local_gradients:
+            # Scale worker-local gradients down by the world size so their
+            # magnitude matches the averaged global ones (reference
+            # rationale: pull/3695).
+            n = _ps(self._process_set).size()
+            for i, g in enumerate(out):
+                if g is not None and _is_local(i):
+                    out[i] = g / n
+        return out
+
+
+def PartialDistributedGradientTape(gradtape, local_layers=None, **kwargs):
+    """A DistributedGradientTape that keeps the gradients of ``local_layers``
+    worker-local (reference: tensorflow/__init__.py:1110+ — used for models
+    with rank-specific towers, e.g. embedding shards)."""
+    tape = DistributedGradientTape(gradtape, **kwargs)
+    for layer in (local_layers or []):
+        variables = getattr(layer, "trainable_variables", None)
+        if variables is None:  # a bare variable was passed
+            variables = [layer]
+        for v in variables:
+            tape.register_local_source(v)
+    return tape
+
+
+def _make_allreduce_grads_fn(op, gradient_predivide_factor, compression,
+                             sparse_as_dense, process_set, groups):
+    """Build the grads→reduced-grads function shared by DistributedOptimizer
+    and the aggregation helper (reference: tensorflow/__init__.py
+    _make_allreduce_grads_fn). ``groups``: None, an int (chunk count), or a
+    list of variable lists for explicit grouping — each group rides one
+    fused program."""
+    tf = _tf()
+
+    def fn(grads, variables=None):
+        grads = list(grads)
+        if sparse_as_dense:
+            grads = [tf.convert_to_tensor(g)
+                     if isinstance(g, tf.IndexedSlices) else g for g in grads]
+        live_idx = [i for i, g in enumerate(grads) if g is not None]
+        if not live_idx:
+            return grads
+        op_, prescale, postscale = op, 1.0, 1.0
+        if gradient_predivide_factor != 1.0 and op == Average:
+            prescale = 1.0 / gradient_predivide_factor
+            postscale = gradient_predivide_factor / _ps(process_set).size()
+            op_ = Sum
+
+        if isinstance(groups, int) and groups > 0:
+            chunks = split_list(live_idx, groups)
+        elif isinstance(groups, (list, tuple)) and variables is not None:
+            by_ref = {}
+            for gi, group in enumerate(groups):
+                for v in group:
+                    by_ref[v.ref()] = gi
+            chunks_map = {}
+            for i in live_idx:
+                key = by_ref.get(variables[i].ref(), f"solo{i}")
+                chunks_map.setdefault(key, []).append(i)
+            chunks = list(chunks_map.values())
+        else:
+            chunks = [live_idx]
+
+        out = list(grads)
+        for chunk in chunks:
+            reduced = grouped_allreduce(
+                [grads[i] for i in chunk], op=op_,
+                prescale_factor=prescale, postscale_factor=postscale,
+                process_set=process_set, compression=compression)
+            for i, r in zip(chunk, reduced):
+                out[i] = r
+        return out
+
+    return fn
+
+
+def DistributedOptimizer(optimizer, name=None, use_locking=False,
+                         device_dense="", device_sparse="",
+                         compression=Compression.none,
+                         sparse_as_dense=False, backward_passes_per_step=1,
+                         op=Average, gradient_predivide_factor=1.0,
+                         average_aggregated_gradients=False,
+                         num_groups=0, groups=None, process_set=None,
+                         scale_local_gradients=True):
+    """Wrap an optimizer so gradients are combined across the process set
+    before updates (reference: tensorflow/__init__.py:822 DistributedOptimizer).
+
+    Keras(-3) optimizers route to the Keras frontend's apply_gradients
+    interception; legacy ``tf.compat.v1.train.Optimizer``s get the
+    compute_gradients wrapper with optional local aggregation
+    (backward_passes_per_step) and explicit grouping (``groups``).
+    """
+    tf = _tf()
+    if gradient_predivide_factor != 1.0 and op != Average:
+        raise ValueError(
+            "gradient_predivide_factor not supported with op != Average")
+    if op == Adasum and average_aggregated_gradients:
+        raise ValueError(
+            "Adasum does not support average_aggregated_gradients == True")
+    if num_groups != 0 and groups is None:
+        groups = num_groups
+
+    if not hasattr(optimizer, "compute_gradients"):
+        # Keras-3 / tf.keras optimizer: the class-swap wrapper owns the
+        # apply_gradients choke point.
+        from horovod_tpu import keras as hvd_keras
+        return hvd_keras.DistributedOptimizer(
+            optimizer, name=name, compression=compression,
+            sparse_as_dense=sparse_as_dense, op=op,
+            backward_passes_per_step=backward_passes_per_step,
+            average_aggregated_gradients=average_aggregated_gradients,
+            gradient_predivide_factor=gradient_predivide_factor,
+            groups=groups, process_set=process_set,
+            scale_local_gradients=scale_local_gradients)
+
+    allreduce_grads = _make_allreduce_grads_fn(
+        op, gradient_predivide_factor, compression, sparse_as_dense,
+        process_set, groups)
+
+    class _DistributedOptimizer(tf.compat.v1.train.Optimizer):
+        """Legacy-optimizer wrapper (reference: _DistributedOptimizer,
+        tensorflow/__init__.py:602-725)."""
+
+        def __init__(self):
+            super().__init__(
+                name=name or f"Distributed{type(optimizer).__name__}",
+                use_locking=use_locking)
+            self._optimizer = optimizer
+            self._local_vars = set()
+            self.process_set = _ps(process_set)
+            if backward_passes_per_step > 1:
+                from horovod_tpu.tensorflow.gradient_aggregation import \
+                    LocalGradientAggregationHelper
+                self._agg_helper = LocalGradientAggregationHelper(
+                    backward_passes_per_step, allreduce_grads,
+                    sparse_as_dense=sparse_as_dense,
+                    average_aggregated_gradients=average_aggregated_gradients,
+                    rank=rank(), process_set=process_set,
+                    scale_local_gradients=scale_local_gradients)
+            else:
+                self._agg_helper = None
+
+        def register_local_var(self, var):
+            self._local_vars.add(var.ref())
+            if self._agg_helper:
+                self._agg_helper.register_local_var(var)
+
+        def compute_gradients(self, *args, **kwargs):
+            gradients = self._optimizer.compute_gradients(*args, **kwargs)
+            grads, variables = zip(*gradients)
+            grads, variables = list(grads), list(variables)
+            if self._agg_helper:
+                avg = self._agg_helper.compute_gradients(grads, variables)
+            else:
+                reduce_idx = [i for i, v in enumerate(variables)
+                              if v.ref() not in self._local_vars]
+                reduced = allreduce_grads([grads[i] for i in reduce_idx],
+                                          [variables[i] for i in reduce_idx])
+                avg = list(grads)
+                for i, r in zip(reduce_idx, reduced):
+                    avg[i] = r
+                if scale_local_gradients and self._local_vars:
+                    n = self.process_set.size()
+                    for i, v in enumerate(variables):
+                        if v.ref() in self._local_vars \
+                                and avg[i] is not None:
+                            avg[i] = avg[i] / n
+            return list(zip(avg, variables))
+
+        def apply_gradients(self, grads_and_vars, global_step=None,
+                            name=None):
+            if self._agg_helper:
+                return self._agg_helper.apply_gradients(
+                    lambda: self._optimizer.apply_gradients(
+                        grads_and_vars, global_step=global_step, name=name),
+                    self._optimizer)
+            return self._optimizer.apply_gradients(
+                grads_and_vars, global_step=global_step, name=name)
+
+        def get_slot(self, *args, **kwargs):
+            return self._optimizer.get_slot(*args, **kwargs)
+
+        def get_slot_names(self, *args, **kwargs):
+            return self._optimizer.get_slot_names(*args, **kwargs)
+
+        def variables(self, *args, **kwargs):
+            return self._optimizer.variables(*args, **kwargs)
+
+        def minimize(self, *args, **kwargs):
+            return tf.compat.v1.train.Optimizer.minimize(
+                self, *args, **kwargs)
+
+    return _DistributedOptimizer()

@@ -1,0 +1,87 @@
+"""Elastic state handlers for the TF/Keras frontend.
+
+Reference: horovod/tensorflow/elastic.py (run:31, TensorFlowKerasState:91,
+TensorFlowState:157). Built on the shared elastic machinery
+(:mod:`horovod_tpu.elastic.state`): ``@hvd.elastic.run`` retries the wrapped
+train function across membership changes, restoring the last in-memory
+commit on collective failure. Variable snapshots live in host numpy arrays
+(a membership change can rebuild the XLA backend; device-side copies would
+dangle — same reason the base ``ObjectState`` snapshots host-side under an
+elastic launch).
+"""
+
+from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.elastic.state import run  # noqa: F401  (re-export)
+
+
+def _assign_all(variables, values):
+    for var, val in zip(variables, values):
+        var.assign(val)
+
+
+class TensorFlowState(ObjectState):
+    """State of a flat list of tf.Variables (reference:
+    tensorflow/elastic.py:157-199)."""
+
+    def __init__(self, variables=None, **kwargs):
+        import tensorflow as tf
+        self.variables = list(variables) if variables is not None \
+            else tf.compat.v1.global_variables()
+        self._tf_state = [v.numpy() for v in self.variables]
+        super().__init__(**kwargs)
+
+    def save(self):
+        self._tf_state = [v.numpy() for v in self.variables]
+        super().save()
+
+    def restore(self):
+        _assign_all(self.variables, self._tf_state)
+        super().restore()
+
+    def sync(self):
+        from horovod_tpu.tensorflow import broadcast_variables
+        broadcast_variables(self.variables, root_rank=0)
+        self._tf_state = [v.numpy() for v in self.variables]
+        super().sync()
+
+
+class TensorFlowKerasState(ObjectState):
+    """State of a Keras model + optimizer (reference:
+    tensorflow/elastic.py:91-154)."""
+
+    def __init__(self, model, optimizer=None, backend=None, **kwargs):
+        if hasattr(model, "built") and not model.built:
+            raise ValueError(
+                "Model must be built first. Run `model.build(input_shape)`.")
+        self.model = model
+        self.optimizer = optimizer if optimizer is not None \
+            else model.optimizer
+        self._save_model()
+        super().__init__(**kwargs)
+
+    def _opt_vars(self):
+        v = self.optimizer.variables
+        return list(v() if callable(v) else v)
+
+    def _save_model(self):
+        self._saved_model_state = [v.numpy() for v in self.model.variables]
+        self._saved_opt_state = [v.numpy() for v in self._opt_vars()]
+
+    def _load_model(self):
+        _assign_all(self.model.variables, self._saved_model_state)
+        _assign_all(self._opt_vars(), self._saved_opt_state)
+
+    def save(self):
+        self._save_model()
+        super().save()
+
+    def restore(self):
+        self._load_model()
+        super().restore()
+
+    def sync(self):
+        from horovod_tpu.tensorflow import broadcast_variables
+        broadcast_variables(self.model.variables, root_rank=0)
+        broadcast_variables(self._opt_vars(), root_rank=0)
+        self._save_model()
+        super().sync()

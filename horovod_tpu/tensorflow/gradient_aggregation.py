@@ -1,0 +1,139 @@
+"""Local gradient aggregation for the TF frontend.
+
+Reference: horovod/tensorflow/gradient_aggregation.py
+(LocalGradientAggregationHelper:23 — accumulate gradients in tf.Variables for
+``backward_passes_per_step`` passes, allreduce once per flush, and gate the
+optimizer's apply on the flush step) and gradient_aggregation_eager.py.
+
+TF2 redesign: one implementation serves eager and ``tf.function`` — the
+counter and accumulators are ``tf.Variable``s and the flush decision is a
+``tf.cond``, so the whole step stays graph-compatible (the collective inside
+the cond rides the frontend's host-callback op). The legacy TF1
+variable-scope/LOCAL_VARIABLES plumbing is dropped.
+"""
+
+
+class LocalGradientAggregationHelper:
+    _OPTIMIZER_TYPE_KERAS = "optimizer_type_keras"
+    _OPTIMIZER_TYPE_LEGACY = "optimizer_type_legacy"
+
+    def __init__(self, backward_passes_per_step, allreduce_func,
+                 sparse_as_dense=False, average_aggregated_gradients=False,
+                 rank=0, optimizer_type=_OPTIMIZER_TYPE_LEGACY,
+                 process_set=None, scale_local_gradients=True):
+        if backward_passes_per_step <= 0:
+            raise ValueError("backward_passes_per_step must be > 0")
+        self.backward_passes_per_step = backward_passes_per_step
+        self._allreduce_grads = allreduce_func
+        self.sparse_as_dense = sparse_as_dense
+        self.average_aggregated_gradients = average_aggregated_gradients
+        self.rank = rank
+        self.optimizer_type = optimizer_type
+        self.process_set = process_set
+        self.scale_local_gradients = scale_local_gradients
+        self.counter = None
+        self.locally_aggregated_grads = []
+        self._local_vars = set()
+
+    def register_local_var(self, var):
+        """Mark ``var`` worker-local: its gradient is never allreduced
+        (reference: gradient_aggregation.py:81-88)."""
+        self._local_vars.add(var.ref())
+
+    def _densify(self, grad):
+        import tensorflow as tf
+        if isinstance(grad, tf.IndexedSlices):
+            if not self.sparse_as_dense:
+                raise ValueError(
+                    "IndexedSlices are not supported with "
+                    "backward_passes_per_step > 1 unless sparse_as_dense")
+            return tf.convert_to_tensor(grad)
+        return grad
+
+    def _init_vars(self, grads):
+        import tensorflow as tf
+        if self.counter is not None:
+            return
+        self.counter = tf.Variable(0, dtype=tf.int32, trainable=False,
+                                   name=f"hvd_agg_counter_{self.rank}")
+        for i, g in enumerate(grads):
+            self.locally_aggregated_grads.append(
+                None if g is None else tf.Variable(
+                    tf.zeros_like(g), trainable=False,
+                    name=f"hvd_agg_grad_{self.rank}_{i}"))
+
+    def compute_gradients(self, grads, vars=None):
+        """Accumulate ``grads``; on every ``backward_passes_per_step``-th
+        call return the allreduced aggregate (optionally averaged over the
+        passes), otherwise zeros (apply is gated off those steps anyway) —
+        reference: gradient_aggregation.py:150-240."""
+        import tensorflow as tf
+        grads = [self._densify(g) for g in grads]
+        self._init_vars(grads)
+        vars = list(vars) if vars is not None else [None] * len(grads)
+
+        for acc, g in zip(self.locally_aggregated_grads, grads):
+            if acc is not None and g is not None:
+                acc.assign_add(g)
+        self.counter.assign_add(1)
+
+        def _flush():
+            scale = (1.0 / self.backward_passes_per_step
+                     if self.average_aggregated_gradients else 1.0)
+            dense = [None if a is None else a * scale
+                     for a in self.locally_aggregated_grads]
+            reduce_idx = [i for i, (d, v) in enumerate(zip(dense, vars))
+                          if d is not None
+                          and (v is None or v.ref() not in self._local_vars)]
+            reduced = self._allreduce_grads(
+                [dense[i] for i in reduce_idx],
+                [vars[i] for i in reduce_idx])
+            out = list(dense)
+            for i, r in zip(reduce_idx, reduced):
+                out[i] = r
+            if self._local_vars and self.scale_local_gradients:
+                # Same down-scaling the bpps==1 path applies to local vars
+                # (reference rationale: pull/3695).
+                from horovod_tpu.ops.collective_ops import global_process_set
+                ps = (self.process_set if self.process_set is not None
+                      else global_process_set)
+                n = ps.size()
+                for i, v in enumerate(vars):
+                    if v is not None and v.ref() in self._local_vars \
+                            and out[i] is not None:
+                        out[i] = out[i] / n
+            return [tf.zeros_like(g) if o is None else o
+                    for o, g in zip(out, grads) if g is not None]
+
+        def _hold():
+            return [tf.zeros_like(g) for g in grads if g is not None]
+
+        flushed = tf.cond(
+            tf.equal(self.counter % self.backward_passes_per_step, 0),
+            _flush, _hold)
+        it = iter(flushed)
+        return [None if g is None else next(it) for g in grads]
+
+    def apply_gradients(self, apply_grads_closure, optimizer, *args,
+                        **kwargs):
+        """Run the optimizer's apply only on flush steps, then zero the
+        accumulators (reference: gradient_aggregation.py:242-303)."""
+        import tensorflow as tf
+
+        def _apply():
+            op = apply_grads_closure()
+
+            def _clear():
+                for acc in self.locally_aggregated_grads:
+                    if acc is not None:
+                        acc.assign(tf.zeros_like(acc))
+                return tf.constant(True)
+
+            if op is None:
+                return _clear()
+            with tf.control_dependencies([op] if tf.is_tensor(op) else []):
+                return _clear()
+
+        return tf.cond(
+            tf.equal(self.counter % self.backward_passes_per_step, 0),
+            _apply, lambda: tf.constant(False))

@@ -1,0 +1,94 @@
+"""Cross-worker synchronous batch normalization for Keras/TF.
+
+Reference: horovod/tensorflow/sync_batch_norm.py (SyncBatchNormalization:22 —
+overrides the layer's ``_moments`` to average E[x] and E[x^2] across workers
+with one stacked allreduce). Keras 3 removed the ``_moments`` hook, so this
+implementation overrides ``call`` for the training path and computes the
+group moments itself; inference delegates to the stock layer (moving stats).
+
+The two local statistics ride ONE stacked allreduce — same wire shape as the
+reference — and Var[X] = E[X^2] - E[X]^2 is reconstructed from the group
+means, which is exact for equal per-worker batch sizes (the reference makes
+the same assumption).
+
+TensorFlow is imported lazily (module ``__getattr__``) so importing the
+frontend stays TF-free until a TF symbol is actually touched.
+"""
+
+_cls = None
+
+
+def _build_class():
+    global _cls
+    if _cls is not None:
+        return _cls
+    import tensorflow as tf
+
+    import horovod_tpu.tensorflow as hvd
+
+    class SyncBatchNormalization(tf.keras.layers.BatchNormalization):
+        """Drop-in ``tf.keras.layers.BatchNormalization`` whose training-time
+        moments are averaged over the process set (reference:
+        sync_batch_norm.py:22-53)."""
+
+        def __init__(self, fused=False, process_set=None, **kwargs):
+            if fused in (True, None):
+                raise ValueError(
+                    "SyncBatchNormalization does not support fused=True.")
+            kwargs.setdefault("name", "sync_batch_normalization")
+            kwargs.pop("fused", None)
+            super().__init__(**kwargs)
+            self._process_set = process_set
+
+        def _group_size(self):
+            ps = (self._process_set if self._process_set is not None
+                  else hvd.global_process_set)
+            return ps.size()
+
+        def call(self, inputs, training=None, mask=None):
+            if not training or self._group_size() == 1 or mask is not None:
+                return super().call(inputs, training=training, mask=mask)
+
+            x = tf.cast(inputs, self.compute_dtype)
+            ndim = len(x.shape)
+            axis = self.axis if self.axis >= 0 else ndim + self.axis
+            reduction_axes = [i for i in range(ndim) if i != axis]
+
+            mean = tf.reduce_mean(x, axis=reduction_axes)
+            mean_sq = tf.reduce_mean(tf.square(x), axis=reduction_axes)
+            # One stacked allreduce for both statistics, like the reference.
+            group = hvd.allreduce(tf.stack([mean, mean_sq]), op=hvd.Average,
+                                  process_set=self._process_set)
+            mean, mean_sq = tf.unstack(group)
+            variance = mean_sq - tf.square(mean)
+
+            m = tf.cast(self.momentum, self.moving_mean.dtype)
+            self.moving_mean.assign(
+                self.moving_mean * m
+                + tf.cast(mean, self.moving_mean.dtype) * (1.0 - m))
+            self.moving_variance.assign(
+                self.moving_variance * m
+                + tf.cast(variance, self.moving_variance.dtype) * (1.0 - m))
+
+            shape = [1] * ndim
+            shape[axis] = x.shape[axis]
+
+            def _r(t):
+                return tf.reshape(tf.cast(t, x.dtype), shape)
+
+            out = (x - _r(mean)) * tf.math.rsqrt(
+                _r(variance) + tf.cast(self.epsilon, x.dtype))
+            if self.scale:
+                out = out * _r(self.gamma)
+            if self.center:
+                out = out + _r(self.beta)
+            return tf.cast(out, inputs.dtype)
+
+    _cls = SyncBatchNormalization
+    return _cls
+
+
+def __getattr__(name):
+    if name == "SyncBatchNormalization":
+        return _build_class()
+    raise AttributeError(name)

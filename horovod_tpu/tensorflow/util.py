@@ -1,0 +1,47 @@
+"""TF-specific helpers (reference: horovod/tensorflow/util.py).
+
+``vars_to_refs``/``refs_to_vars`` make tf.Variables hashable for the
+local-variable bookkeeping in DistributedOptimizer/PartialDistributedGradientTape
+(TF2 Variables are unhashable; ``var.ref()`` is the stable key —
+reference: tensorflow/util.py:77-95).
+"""
+
+import functools
+
+
+def _executing_eagerly():
+    import tensorflow as tf
+    return tf.executing_eagerly()
+
+
+def _cache(f):
+    cache = {}
+
+    @functools.wraps(f)
+    def wrapper(*args):
+        key = (args, _executing_eagerly())
+        if key not in cache:
+            cache[key] = f(*args)
+        return cache[key]
+
+    return wrapper
+
+
+def _make_subgraph(f):
+    import tensorflow as tf
+    return tf.function(f)
+
+
+def vars_to_refs(vars_):
+    """Map (nested) tf.Variables to hashable refs (reference:
+    tensorflow/util.py:77-84)."""
+    if isinstance(vars_, (list, tuple)):
+        return type(vars_)(vars_to_refs(v) for v in vars_)
+    return vars_.ref()
+
+
+def refs_to_vars(refs):
+    """Inverse of :func:`vars_to_refs` (reference: tensorflow/util.py:87-95)."""
+    if isinstance(refs, (list, tuple)):
+        return type(refs)(refs_to_vars(r) for r in refs)
+    return refs.deref()

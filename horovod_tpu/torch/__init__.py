@@ -19,14 +19,23 @@ from horovod_tpu.common.basics import (init, shutdown, is_initialized, rank,
                                        mpi_threads_supported, mpi_enabled,
                                        mpi_built, gloo_enabled, gloo_built,
                                        nccl_built, ddl_built, ccl_built,
-                                       cuda_built, rocm_built)
+                                       cuda_built, rocm_built, xla_built,
+                                       ici_built, is_homogeneous,
+                                       start_timeline, stop_timeline)
+from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.common.util import (check_extension, check_installed_version,
+                                     gpu_available, num_rank_is_power_2,
+                                     split_list)
+from horovod_tpu.elastic.worker import (mark_new_rank_ready,
+                                        read_new_rank_ready)
 from horovod_tpu.common.process_sets import (ProcessSet, add_process_set,
                                              global_process_set,
                                              process_set_by_id,
                                              remove_process_set)
 from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min, Product,
                                             ReduceOp, Sum)
-from horovod_tpu.torch.compression import Compression
+from horovod_tpu.torch.compression import (Compression, Compressor,
+                                           FP16Compressor, NoneCompressor)
 from horovod_tpu.torch.functions import (allgather_object, broadcast_object,
                                          broadcast_optimizer_state,
                                          broadcast_parameters)
@@ -36,11 +45,15 @@ from horovod_tpu.torch.mpi_ops import (allgather, allgather_async, allreduce,
                                        alltoall_async, barrier, broadcast,
                                        broadcast_, broadcast_async,
                                        broadcast_async_, grouped_allgather,
-                                       grouped_allreduce,
+                                       grouped_allgather_async,
+                                       grouped_allreduce, grouped_allreduce_,
                                        grouped_allreduce_async,
-                                       grouped_reducescatter, join, poll,
-                                       reducescatter, reducescatter_async,
-                                       synchronize)
+                                       grouped_allreduce_async_,
+                                       grouped_reducescatter,
+                                       grouped_reducescatter_async, join,
+                                       poll, reducescatter,
+                                       reducescatter_async,
+                                       sparse_allreduce_async, synchronize)
 from horovod_tpu.torch.optimizer import DistributedOptimizer
 from horovod_tpu.torch.elastic import ElasticSampler, TorchState
 from horovod_tpu.torch.sync_batch_norm import SyncBatchNorm
@@ -59,4 +72,12 @@ __all__ = [
     "broadcast_parameters", "broadcast_optimizer_state", "broadcast_object",
     "allgather_object", "DistributedOptimizer", "ElasticSampler",
     "TorchState", "SyncBatchNorm",
+    "grouped_allreduce_", "grouped_allreduce_async_",
+    "grouped_allgather_async", "grouped_reducescatter_async",
+    "sparse_allreduce_async", "Compressor", "NoneCompressor",
+    "FP16Compressor", "HorovodInternalError", "check_extension",
+    "check_installed_version", "gpu_available", "num_rank_is_power_2",
+    "split_list", "is_homogeneous", "mpi_threads_supported",
+    "start_timeline", "stop_timeline", "xla_built", "ici_built",
+    "mark_new_rank_ready", "read_new_rank_ready",
 ]

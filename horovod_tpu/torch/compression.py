@@ -44,3 +44,22 @@ class Compression:
     none = _NoneCompressor()
     fp16 = _CastCompressor(lambda: np.float16)
     bf16 = _CastCompressor(lambda: __import__("ml_dtypes").bfloat16)
+
+
+class Compressor:
+    """Abstract compressor interface (reference: torch/compression.py:21-33).
+    Implementations provide ``compress(tensor) -> (tensor, ctx)`` and
+    ``decompress(tensor, ctx) -> tensor``."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+# Reference-parity aliases (reference: torch/compression.py class names).
+NoneCompressor = _NoneCompressor
+FP16Compressor = _CastCompressor

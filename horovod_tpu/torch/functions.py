@@ -36,10 +36,8 @@ def allgather_object(obj, name=None, process_set=None):
     """reference: functions.py:233-260 — returns the list of every rank's
     object. The caller's ``obj`` stands for each rank this process owns
     (all of them single-controller, the local chips multi-process)."""
-    ps = process_set if process_set is not None else C.global_process_set
-    n_rows = C._expected_rows(ps.mesh, ps.size())
-    return C.allgather_object([obj] * n_rows, process_set=process_set,
-                              name=name)
+    return C.allgather_object_single(obj, process_set=process_set,
+                                     name=name)
 
 
 def broadcast_optimizer_state(optimizer, root_rank=0, process_set=None):

@@ -117,6 +117,8 @@ def poll(handle):
 
 
 def synchronize(handle):
+    if callable(handle) and not hasattr(handle, "synchronize"):
+        return handle()  # sparse_allreduce_async returns a bare callable
     return handle.synchronize()
 
 
@@ -352,3 +354,81 @@ def join(device=None):
     """reference: hvd.join (torch/mpi_ops_v2.cc DoJoin:972). ``device`` is
     accepted for API compatibility and ignored (chips are mesh-addressed)."""
     return C.join()
+
+
+class _InplaceGroupItem:
+    """Group-handle item that copies its result into the original tensor on
+    synchronize (reference: grouped_allreduce_async_,
+    torch/mpi_ops.py:515-551)."""
+
+    def __init__(self, item, target):
+        self._item, self._target = item, target
+
+    def poll(self):
+        return self._item.poll()
+
+    def synchronize(self):
+        out = self._item.synchronize()
+        self._target.copy_(out.to(self._target.dtype))
+        return self._target
+
+    wait = synchronize
+
+
+def grouped_allreduce_async_(tensors, average=None, name=None, op=None,
+                             prescale_factor=1.0, postscale_factor=1.0,
+                             process_set=None):
+    items = grouped_allreduce_async(tensors, average=average, name=name,
+                                    op=op, prescale_factor=prescale_factor,
+                                    postscale_factor=postscale_factor,
+                                    process_set=process_set)
+    return [_InplaceGroupItem(it, t) for it, t in zip(items, tensors)]
+
+
+def grouped_allreduce_(tensors, average=None, name=None, op=None,
+                       prescale_factor=1.0, postscale_factor=1.0,
+                       process_set=None):
+    """In-place grouped allreduce (reference: torch/mpi_ops.py:553-589)."""
+    return [h.synchronize() for h in grouped_allreduce_async_(
+        tensors, average=average, name=name, op=op,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor,
+        process_set=process_set)]
+
+
+def grouped_allgather_async(tensors, name=None, process_set=None):
+    return [allgather_async(t, name=name, process_set=process_set)
+            for t in tensors]
+
+
+def grouped_reducescatter_async(tensors, op=Sum, name=None,
+                                process_set=None):
+    return [reducescatter_async(t, op=op, name=name,
+                                process_set=process_set) for t in tensors]
+
+
+def sparse_allreduce_async(tensor, name, op, process_set=None):
+    """Allreduce a ``torch.sparse_coo_tensor`` by allgathering indices and
+    values — duplicate coordinates sum on coalesce, which IS the reduction
+    (reference: torch/mpi_ops.py:591-612). Returns a callable handle like
+    the reference; :func:`synchronize` accepts it too."""
+    import jax.numpy as jnp
+
+    ps = process_set if process_set is not None else C.global_process_set
+    idx, _ = _to_numpy(tensor._indices().transpose(0, 1).contiguous())
+    vals, vdtype = _to_numpy(tensor._values())
+    n_rows = C._expected_rows(ps.mesh, ps.size())
+
+    def handle():
+        g_idx = np.asarray(C.allgather_ragged(
+            [jnp.asarray(idx)] * n_rows, process_set=process_set,
+            name=f"{name}.indices"))
+        g_val = np.asarray(C.allgather_ragged(
+            [jnp.asarray(vals)] * n_rows, process_set=process_set,
+            name=f"{name}.values"))
+        if op == Average:
+            g_val = g_val / ps.size()
+        return torch.sparse_coo_tensor(
+            torch.as_tensor(g_idx.copy()).transpose(0, 1),
+            _to_torch(g_val, vdtype), tensor.size())
+
+    return handle

@@ -1,0 +1,313 @@
+"""API-parity tests for the round-2 frontend surface sweep.
+
+Covers the names the reference exports that were added this round:
+TF DistributedOptimizer / SyncBatchNormalization / graph query ops /
+object collectives / grouped allgather+reducescatter / local-var tapes;
+torch in-place grouped + sparse ops; keras PartialDistributedOptimizer +
+elastic states; mxnet grouped_allreduce_ / allgather_object.
+
+Reference model: test/parallel/test_tensorflow.py (op sweeps),
+test/parallel/test_torch.py (grouped/in-place/sparse),
+test/parallel/test_tensorflow_keras.py.
+"""
+
+import numpy as np
+import pytest
+
+tf = pytest.importorskip("tensorflow")
+import torch  # noqa: E402
+
+import horovod_tpu.tensorflow as hvd_tf  # noqa: E402
+import horovod_tpu.torch as hvd_torch  # noqa: E402
+import horovod_tpu.keras as hvd_keras  # noqa: E402
+import horovod_tpu.mxnet as hvd_mx  # noqa: E402
+
+N = 8
+
+
+@pytest.fixture(scope="module", autouse=True)
+def _init(hvd):
+    yield
+
+
+class TestAPISurface:
+    """Regression guard: every reference public name resolves (the round-1
+    audit found these missing; reference: horovod/{tensorflow,torch,keras,
+    mxnet}/__init__.py module exports)."""
+
+    TF_NAMES = [
+        "DistributedOptimizer", "LocalGradientAggregationHelper",
+        "SyncBatchNormalization", "allgather_object", "broadcast_",
+        "broadcast_object_fn", "ccl_built", "check_extension",
+        "check_num_rank_power_of_2", "cuda_built", "ddl_built", "elastic",
+        "gloo_built", "gloo_enabled", "gpu_available", "grouped_allgather",
+        "grouped_reducescatter", "handle_average_backwards_compatibility",
+        "is_homogeneous", "local_rank_op", "local_size_op", "mpi_built",
+        "mpi_enabled", "mpi_threads_supported", "nccl_built",
+        "process_set_included_op", "rank_op", "refs_to_vars", "rocm_built",
+        "size_op", "split_list", "start_timeline", "stop_timeline",
+        "vars_to_refs", "PartialDistributedGradientTape",
+    ]
+    TORCH_NAMES = [
+        "Compressor", "NoneCompressor", "FP16Compressor",
+        "HorovodInternalError", "check_extension", "check_installed_version",
+        "gpu_available", "grouped_allgather_async", "grouped_allreduce_",
+        "grouped_allreduce_async_", "grouped_reducescatter_async",
+        "is_homogeneous", "num_rank_is_power_2", "read_new_rank_ready",
+        "sparse_allreduce_async", "start_timeline", "stop_timeline",
+    ]
+    KERAS_NAMES = [
+        "PartialDistributedOptimizer", "broadcast_global_variables",
+        "ccl_built", "cuda_built", "ddl_built", "elastic",
+        "global_process_set", "gloo_built", "gloo_enabled", "mpi_built",
+        "mpi_enabled", "mpi_threads_supported", "nccl_built",
+        "reducescatter", "rocm_built", "start_timeline", "stop_timeline",
+    ]
+    MX_NAMES = ["Compression", "allgather_object", "check_extension",
+                "grouped_allreduce_", "split_list"]
+
+    @pytest.mark.parametrize("mod,names", [
+        (hvd_tf, TF_NAMES), (hvd_torch, TORCH_NAMES),
+        (hvd_keras, KERAS_NAMES), (hvd_mx, MX_NAMES)])
+    def test_names_resolve(self, mod, names):
+        missing = [n for n in names if not hasattr(mod, n)]
+        assert not missing, f"{mod.__name__} missing {missing}"
+
+    def test_built_queries_honest(self):
+        assert hvd_tf.xla_built() and hvd_tf.ici_built()
+        assert not (hvd_tf.nccl_built() or hvd_tf.mpi_built()
+                    or hvd_tf.cuda_built() or hvd_tf.rocm_built())
+        assert not hvd_tf.gpu_available()
+
+    def test_util_helpers(self):
+        assert hvd_tf.split_list(list(range(7)), 3) == [
+            [0, 1, 2], [3, 4, 5], [6]]
+        assert hvd_tf.num_rank_is_power_2(8)
+        assert not hvd_tf.num_rank_is_power_2(6)
+        hvd_tf.check_num_rank_power_of_2(4)
+        with pytest.raises(ValueError):
+            hvd_tf.check_num_rank_power_of_2(6)
+        assert hvd_tf.handle_average_backwards_compatibility(
+            None, None) == hvd_tf.Average
+        assert hvd_tf.handle_average_backwards_compatibility(
+            None, False) == hvd_tf.Sum
+        with pytest.raises(ValueError):
+            hvd_tf.handle_average_backwards_compatibility(hvd_tf.Sum, True)
+
+    def test_vars_to_refs_roundtrip(self):
+        v = tf.Variable([1.0])
+        refs = hvd_tf.vars_to_refs([v])
+        assert hvd_tf.refs_to_vars(refs)[0] is v
+
+
+class TestTFNewOps:
+    def test_query_ops_in_tf_function(self):
+        @tf.function
+        def q():
+            return (hvd_tf.size_op(), hvd_tf.rank_op(),
+                    hvd_tf.local_size_op(), hvd_tf.local_rank_op(),
+                    hvd_tf.process_set_included_op())
+
+        s, r, ls, lr, inc = [int(x) for x in q()]
+        assert s == N and r == hvd_tf.rank() and inc == 1
+
+    def test_broadcast_inplace(self):
+        v = tf.Variable(tf.random.normal((4,)))
+        before = v.numpy()
+        (out,) = hvd_tf.broadcast_(a_list := [v], root_rank=0)
+        assert out is v
+        np.testing.assert_allclose(v.numpy(), before, rtol=1e-6)
+
+    def test_grouped_allgather(self):
+        xs = [tf.random.normal((2, 3)), tf.random.normal((1,))]
+        outs = hvd_tf.grouped_allgather(xs)
+        assert outs[0].shape == (N * 2, 3) and outs[1].shape == (N,)
+        np.testing.assert_allclose(outs[0].numpy()[:2], xs[0].numpy(),
+                                   rtol=1e-6)
+
+    def test_grouped_reducescatter(self):
+        xs = [tf.ones((N * 2, 3)), tf.ones((N,))]
+        outs = hvd_tf.grouped_reducescatter(xs, op=hvd_tf.Sum)
+        assert outs[0].shape == (2, 3) and outs[1].shape == (1,)
+        np.testing.assert_allclose(outs[0].numpy(), np.full((2, 3), N),
+                                   rtol=1e-6)
+
+    def test_grouped_in_tf_function(self):
+        @tf.function
+        def fn(a, b):
+            return hvd_tf.grouped_allgather([a, b])
+
+        outs = fn(tf.ones((2, 3)), tf.zeros((1,)))
+        assert outs[0].shape == (N * 2, 3) and outs[1].shape == (N,)
+
+    def test_object_helpers(self):
+        obj = {"rank": hvd_tf.rank(), "x": [1, 2, 3]}
+        assert hvd_tf.broadcast_object_fn(root_rank=0)(obj) == obj
+        gathered = hvd_tf.allgather_object(obj)
+        assert len(gathered) >= 1 and gathered[0] == obj
+
+    def test_sync_batch_norm_matches_local_moments(self):
+        # All ranks see identical data under the single-controller stacked
+        # contract, so the cross-rank moments equal the local ones.
+        sbn = hvd_tf.SyncBatchNormalization(axis=-1, momentum=0.5)
+        x = tf.constant(np.random.default_rng(0).standard_normal(
+            (16, 4)).astype(np.float32))
+        y = sbn(x, training=True)
+        mean = tf.reduce_mean(x, 0)
+        var = tf.math.reduce_variance(x, 0)
+        ref = (x - mean) * tf.math.rsqrt(var + sbn.epsilon)
+        np.testing.assert_allclose(y.numpy(), ref.numpy(), atol=1e-4)
+        # moving stats moved toward the batch stats
+        np.testing.assert_allclose(sbn.moving_mean.numpy(),
+                                   0.5 * mean.numpy(), atol=1e-4)
+
+    def test_sync_batch_norm_rejects_fused(self):
+        with pytest.raises(ValueError):
+            hvd_tf.SyncBatchNormalization(fused=True)
+
+    def test_local_gradient_aggregation_helper(self):
+        calls = []
+
+        def fake_allreduce(grads, variables=None):
+            calls.append(len(grads))
+            return [g * 2.0 for g in grads]
+
+        helper = hvd_tf.LocalGradientAggregationHelper(
+            backward_passes_per_step=2, allreduce_func=fake_allreduce,
+            average_aggregated_gradients=True)
+        g1 = [tf.constant([1.0, 1.0])]
+        out1 = helper.compute_gradients(g1)
+        assert not calls  # first pass: held locally
+        np.testing.assert_allclose(out1[0].numpy(), [0.0, 0.0])
+        out2 = helper.compute_gradients([tf.constant([3.0, 3.0])])
+        assert calls == [1]  # flushed once
+        # (1+3)/2 averaged over passes, then fake-allreduce doubles
+        np.testing.assert_allclose(out2[0].numpy(), [4.0, 4.0])
+        applied = []
+        flag = helper.apply_gradients(lambda: applied.append(1), None)
+        assert bool(flag) and applied == [1]
+
+    def test_legacy_distributed_optimizer(self):
+        opt = hvd_tf.DistributedOptimizer(
+            tf.compat.v1.train.GradientDescentOptimizer(0.5))
+        w = tf.Variable([2.0])
+        with tf.GradientTape() as tape:
+            loss = tf.reduce_sum(w * w)
+        grads = tape.gradient(loss, [w])
+        opt.apply_gradients(zip(grads, [w]))
+        np.testing.assert_allclose(w.numpy(), [0.0], atol=1e-6)
+
+    def test_partial_distributed_gradient_tape(self):
+        local_w = tf.Variable([1.0])
+        global_w = tf.Variable([1.0])
+        with tf.GradientTape() as raw:
+            loss = tf.reduce_sum(local_w * 3.0 + global_w * 5.0)
+        tape = hvd_tf.PartialDistributedGradientTape(
+            raw, local_layers=[local_w], op=hvd_tf.Sum)
+        gl, gg = tape.gradient(loss, [local_w, global_w])
+        # global grad summed across the N identical rows; local grad scaled
+        # down by N (scale_local_gradients default)
+        np.testing.assert_allclose(gg.numpy(), [5.0 * N], rtol=1e-5)
+        np.testing.assert_allclose(gl.numpy(), [3.0 / N], rtol=1e-5)
+
+    def test_tf_elastic_states(self):
+        m = tf.keras.Sequential([tf.keras.Input((3,)),
+                                 tf.keras.layers.Dense(2)])
+        opt = tf.keras.optimizers.SGD(0.1)
+        opt.build(m.trainable_variables)
+        st = hvd_tf.elastic.TensorFlowKerasState(m, opt, batch=0, epoch=0)
+        st.save()
+        w0 = m.variables[0].numpy().copy()
+        m.variables[0].assign(m.variables[0] + 1.0)
+        st.epoch = 5
+        st.restore()
+        np.testing.assert_allclose(m.variables[0].numpy(), w0)
+        assert st.epoch == 0
+        st.sync()  # broadcast from root — values unchanged single-host
+        np.testing.assert_allclose(m.variables[0].numpy(), w0)
+
+        vs = hvd_tf.elastic.TensorFlowState(variables=list(m.variables),
+                                            step=7)
+        vs.save()
+        m.variables[0].assign(m.variables[0] - 2.0)
+        vs.restore()
+        np.testing.assert_allclose(m.variables[0].numpy(), w0)
+
+
+class TestTorchNewOps:
+    def test_grouped_allreduce_inplace(self):
+        ts = [torch.ones(3), torch.full((2, 2), 2.0)]
+        outs = hvd_torch.grouped_allreduce_(ts, op=hvd_torch.Sum)
+        assert outs[0] is ts[0] and outs[1] is ts[1]
+        np.testing.assert_allclose(ts[0].numpy(), np.full(3, float(N)))
+
+    def test_grouped_async_variants(self):
+        hs = hvd_torch.grouped_allgather_async([torch.ones(2)])
+        out = hs[0].synchronize()
+        assert out.shape == (2 * N,)
+        hs = hvd_torch.grouped_reducescatter_async(
+            [torch.ones(N * 2)], op=hvd_torch.Sum)
+        np.testing.assert_allclose(hs[0].synchronize().numpy(),
+                                   np.full(2, float(N)))
+
+    def test_sparse_allreduce(self):
+        dense = torch.zeros(4, 3)
+        dense[0] = 1.0
+        dense[2] = 2.0
+        sp = dense.to_sparse_coo()
+        handle = hvd_torch.sparse_allreduce_async(sp, name="sp",
+                                                  op=hvd_torch.Average)
+        out = hvd_torch.synchronize(handle)
+        assert out.is_sparse
+        # duplicates coalesce-sum: N copies averaged == original
+        np.testing.assert_allclose(out.coalesce().to_dense().numpy(),
+                                   dense.numpy(), rtol=1e-5)
+
+
+class TestKerasNew:
+    def test_partial_distributed_optimizer(self):
+        import keras
+
+        model = keras.Sequential([keras.Input((4,)),
+                                  keras.layers.Dense(3, name="local_d"),
+                                  keras.layers.Dense(1)])
+        local = model.layers[0]
+        opt = hvd_keras.PartialDistributedOptimizer(
+            keras.optimizers.SGD(0.01), local_layers=[local])
+        model.compile(optimizer=opt, loss="mse", run_eagerly=True)
+        x = np.random.default_rng(0).standard_normal((8, 4)).astype("f4")
+        y = np.zeros((8, 1), "f4")
+        l0 = model.evaluate(x, y, verbose=0)
+        model.fit(x, y, epochs=2, verbose=0)
+        assert model.evaluate(x, y, verbose=0) < l0
+
+    def test_keras_elastic_state(self):
+        import keras
+
+        model = keras.Sequential([keras.Input((2,)), keras.layers.Dense(1)])
+        opt = keras.optimizers.SGD(0.1)
+        opt.build(model.trainable_variables)
+        st = hvd_keras.elastic.KerasState(model, opt, batch=3)
+        st.save()
+        w0 = model.variables[0].numpy().copy()
+        model.variables[0].assign(w0 + 1.0)
+        st.restore()
+        np.testing.assert_allclose(model.variables[0].numpy(), w0)
+        cb = hvd_keras.elastic.CommitStateCallback(st, batches_per_commit=2)
+        cb.on_batch_end(0)
+        cb.on_batch_end(1)  # commits
+        cb2 = hvd_keras.elastic.UpdateBatchStateCallback(st)
+        cb2.on_epoch_begin(4)
+        assert st.epoch == 4
+
+
+class TestMXNetNew:
+    def test_grouped_allreduce_inplace(self):
+        ts = [np.ones(3, np.float32), np.full((2,), 2.0, np.float32)]
+        outs = hvd_mx.grouped_allreduce_(ts, op=hvd_mx.Sum)
+        np.testing.assert_allclose(outs[0], np.full(3, float(N)))
+        np.testing.assert_allclose(ts[0], np.full(3, float(N)))
+
+    def test_allgather_object(self):
+        out = hvd_mx.allgather_object({"r": hvd_mx.rank()})
+        assert out[0] == {"r": hvd_mx.rank()}

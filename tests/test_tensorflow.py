@@ -291,6 +291,8 @@ class TestKeras:
             tape.gradient(loss, [emb])
 
     def test_backward_passes_per_step_eager(self):
+        # Reference default: aggregated gradients are SUMMED on the flush
+        # step (average_aggregated_gradients=False).
         import keras
         import horovod_tpu.keras as hvd_keras
         v = tf.Variable(0.0)
@@ -298,6 +300,17 @@ class TestKeras:
             keras.optimizers.SGD(1.0), backward_passes_per_step=2)
         opt.apply_gradients([(tf.constant(1.0), v)])
         np.testing.assert_allclose(float(v.numpy()), 0.0)  # accumulating
+        opt.apply_gradients([(tf.constant(3.0), v)])
+        np.testing.assert_allclose(float(v.numpy()), -4.0)  # sum grad = 4
+
+    def test_backward_passes_per_step_averaged(self):
+        import keras
+        import horovod_tpu.keras as hvd_keras
+        v = tf.Variable(0.0)
+        opt = hvd_keras.DistributedOptimizer(
+            keras.optimizers.SGD(1.0), backward_passes_per_step=2,
+            average_aggregated_gradients=True)
+        opt.apply_gradients([(tf.constant(1.0), v)])
         opt.apply_gradients([(tf.constant(3.0), v)])
         np.testing.assert_allclose(float(v.numpy()), -2.0)  # mean grad = 2
 
