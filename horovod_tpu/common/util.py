@@ -8,8 +8,6 @@ degenerate to honest constant answers, kept so reference code ports without
 edits.
 """
 
-import math
-
 
 def check_extension(ext_name, ext_env_var=None, pkg_path=None, *args):
     """The reference verifies the framework's C++ extension was compiled
@@ -34,14 +32,15 @@ def gpu_available(ext_base_name=None, verbose=False):
 
 
 def split_list(items, num_parts):
-    """Split ``items`` into ``num_parts`` contiguous chunks whose sizes
-    differ by at most one (reference: common/util.py:140-148, used by the
-    ``groups=N`` explicit-grouping path of DistributedOptimizer)."""
+    """Split ``items`` into exactly ``num_parts`` contiguous chunks whose
+    sizes differ by at most one — trailing chunks may be empty (reference:
+    common/util.py:244-249, used by the ``groups=N`` explicit-grouping path
+    of DistributedOptimizer)."""
     if num_parts <= 0:
         raise ValueError(f"num_parts must be positive, got {num_parts}")
-    n = len(items)
-    size = math.ceil(n / num_parts)
-    return [items[i:i + size] for i in range(0, n, size)]
+    d, r = divmod(len(items), num_parts)
+    return [items[i * d + min(i, r):(i + 1) * d + min(i + 1, r)]
+            for i in range(num_parts)]
 
 
 def num_rank_is_power_2(num_rank):

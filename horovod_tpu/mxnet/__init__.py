@@ -28,8 +28,9 @@ from horovod_tpu.common.util import (  # noqa: F401
 from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401
     Adasum, Average, Max, Min, Product, ReduceOp, Sum,
     allgather, allgather_object, allreduce, allreduce_, alltoall, barrier,
-    broadcast, broadcast_, grouped_allgather, grouped_allreduce,
-    grouped_allreduce_, grouped_reducescatter, reducescatter,
+    broadcast, broadcast_, broadcast_object, grouped_allgather,
+    grouped_allreduce, grouped_allreduce_, grouped_reducescatter,
+    reducescatter,
 )
 # The mxnet bridge is numpy duck-typed, so the TF frontend's numpy
 # compressors serve here too (reference: horovod/mxnet/compression.py).
@@ -151,10 +152,3 @@ def broadcast_parameters(params, root_rank=0, prefix=None):
             p.set_data(out)
         else:
             _ops._copy_into(p, _ops._to_numpy(out))
-
-
-def broadcast_object(obj, root_rank=0, name=None):
-    """Pickle-broadcast an arbitrary object (reference: the per-framework
-    broadcast_object helpers)."""
-    from horovod_tpu.ops.collective_ops import broadcast_object as _bo
-    return _bo(obj, root_rank=root_rank, name=name)

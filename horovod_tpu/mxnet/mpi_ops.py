@@ -20,6 +20,7 @@ from horovod_tpu.ops.collective_ops import (Adasum, Average, Max, Min,  # noqa: 
 
 __all__ = ["allreduce", "allreduce_", "grouped_allreduce",
            "grouped_allreduce_", "allgather", "allgather_object",
+           "broadcast_object",
            "grouped_allgather", "broadcast", "broadcast_", "alltoall",
            "reducescatter", "grouped_reducescatter", "barrier",
            "Average", "Sum", "Adasum", "Min", "Max", "Product", "ReduceOp"]

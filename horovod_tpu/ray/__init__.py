@@ -7,7 +7,9 @@ model. Gated: importing this package works without ray; constructing an
 executor requires it.
 """
 
-from horovod_tpu.ray.elastic import RayHostDiscovery, run_elastic
+from horovod_tpu.ray.elastic import (ElasticRayExecutor, RayHostDiscovery,
+                                     run_elastic)
+from horovod_tpu.ray.worker import BaseHorovodWorker
 from horovod_tpu.ray.runner import RayExecutor
 from horovod_tpu.ray.strategy import (placement_bundles, ray_available,
                                       worker_env)

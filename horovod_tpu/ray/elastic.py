@@ -88,3 +88,69 @@ def run_elastic(fn, args=(), kwargs=None, min_np=1, max_np=None,
     if rc != 0:
         raise RuntimeError(f"ray elastic run failed with exit code {rc}")
     return _validate_elastic_results(harvested, expected)
+
+
+class ElasticRayExecutor:
+    """Executor-object API over :func:`run_elastic` (reference:
+    horovod/ray/elastic.py ElasticRayExecutor:150 — create_settings /
+    start / run lifecycle). Kept for source compatibility with reference
+    scripts; new code can call :func:`run_elastic` directly.
+    """
+
+    @staticmethod
+    def create_settings(min_num_proc=1, max_num_proc=None, reset_limit=None,
+                        elastic_timeout=600, timeout_s=30,
+                        ssh_identity_file=None, nics=None, min_np=None,
+                        max_np=None, **kwargs):
+        """Build the settings dict consumed by __init__ (reference:
+        elastic.py:188-246; min_np/max_np are the deprecated spellings)."""
+        import warnings
+        if min_np is not None:
+            min_num_proc = min_np
+            warnings.warn("min_np is deprecated, use min_num_proc",
+                          DeprecationWarning)
+        if max_np is not None:
+            max_num_proc = max_np
+            warnings.warn("max_np is deprecated, use max_num_proc",
+                          DeprecationWarning)
+        return {"min_np": min_num_proc, "max_np": max_num_proc,
+                "reset_limit": reset_limit,
+                "start_timeout": elastic_timeout}
+
+    def __init__(self, settings, use_gpu=False, use_tpu=None,
+                 cpus_per_slot=1, gpus_per_slot=None, tpus_per_slot=1,
+                 env_vars=None, override_discovery=True):
+        if use_tpu is None:
+            # reference scripts say use_gpu; on this build that means the
+            # accelerator resource, i.e. TPU slots.
+            use_tpu = use_gpu
+        self._settings = dict(settings)
+        self._use_tpu = use_tpu
+        self._cpus_per_slot = cpus_per_slot
+        self._tpus_per_slot = tpus_per_slot or gpus_per_slot or 1
+        self._env_vars = dict(env_vars or {})
+        self._started = False
+
+    def start(self):
+        """Validate Ray is up (workers spawn lazily inside :meth:`run`)."""
+        from horovod_tpu.ray.strategy import ray_available
+        if not ray_available():
+            raise RuntimeError("ray is not initialized; call ray.init()")
+        self._started = True
+
+    def run(self, worker_fn, callbacks=None):
+        """Run ``worker_fn`` elastically; returns per-rank results
+        (reference: elastic.py:320-360). ``callbacks`` accepted for API
+        compatibility and invoked with the result list."""
+        if not self._started:
+            self.start()
+        results = run_elastic(
+            worker_fn, min_np=self._settings.get("min_np", 1),
+            max_np=self._settings.get("max_np"),
+            reset_limit=self._settings.get("reset_limit"),
+            use_tpu=self._use_tpu, cpus_per_slot=self._cpus_per_slot,
+            tpus_per_slot=self._tpus_per_slot, env_vars=self._env_vars,
+            start_timeout=self._settings.get("start_timeout", 600))
+        for cb in (callbacks or []):
+            cb(results)
+        return results

@@ -1,0 +1,51 @@
+"""Ray worker actor base (reference: horovod/ray/worker.py BaseHorovodWorker:8
+— an actor that pins the HOROVOD_* env contract, can start a long-lived
+executable object, and executes pickled functions in place).
+
+Used by :class:`horovod_tpu.ray.RayExecutor`'s actor pool; exposed publicly
+so advanced users can subclass it for custom per-worker setup, as with the
+reference.
+"""
+
+import os
+import socket
+
+
+class BaseHorovodWorker:
+    executable = None
+
+    def __init__(self, world_rank=0, world_size=1):
+        os.environ["HOROVOD_HOSTNAME"] = self.hostname()
+        os.environ["HOROVOD_RANK"] = str(world_rank)
+        os.environ["HOROVOD_SIZE"] = str(world_size)
+
+    def node_id(self):
+        import ray
+        return ray.get_runtime_context().get_node_id()
+
+    def hostname(self):
+        return socket.gethostname()
+
+    def get_gpu_ids(self):
+        """CUDA ids for API compatibility — empty on the TPU build."""
+        return []
+
+    def update_env_vars(self, env_vars):
+        os.environ.update({k: str(v) for k, v in env_vars.items()})
+
+    def env_vars(self):
+        return dict(os.environ)
+
+    def start_executable(self, executable_cls=None, executable_args=None,
+                         executable_kwargs=None):
+        """Instantiate a long-lived object whose methods :meth:`execute` can
+        target (reference: worker.py:37-55)."""
+        executable_args = executable_args or []
+        executable_kwargs = executable_kwargs or {}
+        if executable_cls:
+            self.executable = executable_cls(*executable_args,
+                                             **executable_kwargs)
+
+    def execute(self, func):
+        """Run ``func(self.executable)`` in the worker process."""
+        return func(self.executable)

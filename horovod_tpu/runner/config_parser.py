@@ -38,6 +38,16 @@ _ARG_ENV_MAP = [
     ("log_hide_timestamp", "HOROVOD_LOG_HIDE_TIME",
      lambda v: "1" if v else None),
     ("wire_dtype", "HOROVOD_WIRE_DTYPE", str),
+    ("elastic_timeout", "HOROVOD_ELASTIC_TIMEOUT", str),
+    ("gloo_timeout_seconds", "HOROVOD_GLOO_TIMEOUT_SECONDS", str),
+    ("nics", "HOROVOD_NICS", str),
+    ("nics", "HOROVOD_GLOO_IFACE", str),
+    ("num_nccl_streams", "HOROVOD_NUM_NCCL_STREAMS", str),
+    ("thread_affinity", "HOROVOD_THREAD_AFFINITY", str),
+    ("mpi_threads_disable", "HOROVOD_MPI_THREADS_DISABLE",
+     lambda v: "1" if v else None),
+    ("blacklist_cooldown_range", "HOROVOD_BLACKLIST_COOLDOWN_RANGE",
+     lambda v: f"{v[0]},{v[1]}"),
 ]
 
 

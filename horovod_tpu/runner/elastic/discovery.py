@@ -6,6 +6,7 @@ Reference: horovod/runner/elastic/discovery.py — ``HostDiscovery`` interface,
 flapping host isn't immediately reused.
 """
 
+import os
 import subprocess
 import time
 import threading
@@ -64,6 +65,15 @@ class HostState:
         self.blacklisted = False
         self.failures = 0
         self.cooldown_until = 0.0
+        # --blacklist-cooldown-range "base max" (reference:
+        # discovery.py cooldown_range / launch.py flag).
+        rng = os.environ.get("HOROVOD_BLACKLIST_COOLDOWN_RANGE")
+        if rng:
+            try:
+                base, cap = (float(x) for x in rng.split(","))
+                self.COOLDOWN_BASE, self.COOLDOWN_MAX = base, cap
+            except ValueError:
+                pass
 
     def record_failure(self):
         self.failures += 1

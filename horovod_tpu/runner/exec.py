@@ -14,17 +14,39 @@ import threading
 from horovod_tpu.common import logging as hvd_logging
 
 
-def _stream(proc, tag, out):
+def _stream(proc, tag, out, sink=None, prefix_timestamp=False):
     for line in iter(proc.stdout.readline, b""):
-        out.write(f"[{tag}]<stdout> {line.decode(errors='replace')}")
+        text = line.decode(errors="replace")
+        if prefix_timestamp:
+            import datetime
+            text = f"{datetime.datetime.now().isoformat(sep=' ')} {text}"
+        out.write(f"[{tag}]<stdout> {text}")
         out.flush()
+        if sink is not None:
+            sink.write(text)
+            sink.flush()
+    # The reader owns the sink: close only after the pipe is fully drained,
+    # so a slow drain can never race a closed file.
+    if sink is not None:
+        sink.close()
 
 
 class WorkerProcess:
     def __init__(self, hostname, command, env, tag, use_ssh=None,
-                 ssh_port=None, ssh_identity_file=None):
+                 ssh_port=None, ssh_identity_file=None, output_dir=None,
+                 rank=None, prefix_timestamp=False):
         self.hostname = hostname
         self.tag = tag
+        # --output-filename: mirror each worker's merged stdout/stderr to
+        # <dir>/rank.<NN>/stdout (reference: launch.py:332 + gloo_run's
+        # per-rank capture files).
+        self._sink = None
+        if output_dir:
+            sub = os.path.join(output_dir,
+                               f"rank.{rank:02d}" if rank is not None
+                               else f"host.{hostname}")
+            os.makedirs(sub, exist_ok=True)
+            self._sink = open(os.path.join(sub, "stdout"), "a")
         # Any 127.0.0.0/8 address is this machine (loopback aliases let tests
         # model N distinct "hosts" locally, like the reference's
         # localhost-based integration tier).
@@ -51,12 +73,13 @@ class WorkerProcess:
                                      stdout=subprocess.PIPE,
                                      stderr=subprocess.STDOUT)
         self._thread = threading.Thread(
-            target=_stream, args=(self.proc, tag, sys.stdout), daemon=True)
+            target=_stream, args=(self.proc, tag, sys.stdout, self._sink,
+                                  prefix_timestamp), daemon=True)
         self._thread.start()
 
     def wait(self, timeout=None):
         rc = self.proc.wait(timeout)
-        self._thread.join(timeout=2)
+        self._thread.join(timeout=30)
         return rc
 
     def terminate(self):

@@ -58,6 +58,32 @@ def parse_args(argv=None):
                         "allocation with jsrun available, else ssh. "
                         "(reference: horovodrun --gloo/--mpi/... selection, "
                         "launch.py:286-596 + js_run path)")
+    # Launcher-selection aliases (reference: --gloo/--mpi/--jsrun,
+    # launch.py:286-596). "gloo" maps to the native ssh/KV rendezvous path —
+    # the role gloo plays in the reference.
+    p.add_argument("--gloo", action="store_true", dest="use_gloo")
+    p.add_argument("--mpi", action="store_true", dest="use_mpi")
+    p.add_argument("--jsrun", action="store_true", dest="use_jsrun")
+    p.add_argument("--network-interface", "--network-interfaces",
+                   dest="nics",
+                   help="Comma-separated NICs workers may bind/probe on")
+    p.add_argument("--output-filename", dest="output_filename",
+                   help="Mirror each worker's output to "
+                        "<dir>/rank.<NN>/stdout")
+    p.add_argument("--prefix-output-with-timestamp", action="store_true",
+                   dest="prefix_output_with_timestamp")
+    p.add_argument("--tcp", action="store_true", dest="tcp_flag",
+                   help="Accepted for compatibility (TPU data plane is ICI)")
+    p.add_argument("--num-nccl-streams", type=int, dest="num_nccl_streams",
+                   help="Accepted for compatibility; n/a on TPU")
+    p.add_argument("--thread-affinity", type=int, dest="thread_affinity")
+    p.add_argument("--binding-args", dest="binding_args")
+    p.add_argument("--mpi-threads-disable", action="store_true",
+                   dest="mpi_threads_disable", default=None)
+    p.add_argument("--no-mpi-threads-disable", action="store_false",
+                   dest="mpi_threads_disable")
+    p.add_argument("--gloo-timeout-seconds", type=int,
+                   dest="gloo_timeout_seconds")
     p.add_argument("--mpi-args", dest="mpi_args", default="",
                    help="Extra args appended to mpirun/jsrun.")
 
@@ -66,6 +92,12 @@ def parse_args(argv=None):
                         dest="fusion_threshold_mb")
     tuning.add_argument("--cycle-time-ms", type=float, dest="cycle_time_ms")
     tuning.add_argument("--cache-capacity", type=int, dest="cache_capacity")
+    tuning.add_argument("--no-hierarchical-allreduce", action="store_false",
+                        dest="hierarchical_allreduce", default=False)
+    tuning.add_argument("--no-hierarchical-allgather", action="store_false",
+                        dest="hierarchical_allgather", default=False)
+    tuning.add_argument("--no-torus-allreduce", action="store_false",
+                        dest="torus_allreduce", default=False)
     tuning.add_argument("--hierarchical-allreduce", action="store_true",
                         dest="hierarchical_allreduce")
     tuning.add_argument("--hierarchical-allgather", action="store_true",
@@ -79,6 +111,8 @@ def parse_args(argv=None):
 
     autotune = p.add_argument_group("autotune")
     autotune.add_argument("--autotune", action="store_true", dest="autotune")
+    autotune.add_argument("--no-autotune", action="store_false",
+                          dest="autotune")
     autotune.add_argument("--autotune-log-file", dest="autotune_log_file")
     autotune.add_argument("--autotune-warmup-samples", type=int,
                           dest="autotune_warmup_samples")
@@ -91,10 +125,14 @@ def parse_args(argv=None):
 
     timeline = p.add_argument_group("timeline")
     timeline.add_argument("--timeline-filename", dest="timeline_filename")
+    timeline.add_argument("--no-timeline-mark-cycles", action="store_false",
+                          dest="timeline_mark_cycles", default=False)
     timeline.add_argument("--timeline-mark-cycles", action="store_true",
                           dest="timeline_mark_cycles")
 
     stall = p.add_argument_group("stall")
+    stall.add_argument("--stall-check", action="store_false",
+                       dest="no_stall_check", default=False)
     stall.add_argument("--no-stall-check", action="store_true",
                        dest="no_stall_check")
     stall.add_argument("--stall-check-warning-time-seconds", type=float,
@@ -103,8 +141,16 @@ def parse_args(argv=None):
                        dest="stall_check_shutdown_time_seconds")
 
     elastic = p.add_argument_group("elastic")
-    elastic.add_argument("--min-np", type=int, dest="min_np")
-    elastic.add_argument("--max-np", type=int, dest="max_np")
+    elastic.add_argument("--min-np", "--min-num-proc", type=int,
+                         dest="min_np")
+    elastic.add_argument("--max-np", "--max-num-proc", type=int,
+                         dest="max_np")
+    elastic.add_argument("--elastic-timeout", type=int,
+                         dest="elastic_timeout")
+    elastic.add_argument("--blacklist-cooldown-range", nargs=2, type=float,
+                         dest="blacklist_cooldown_range",
+                         help="Base and cap (seconds) of the per-host "
+                              "blacklist exponential cooldown")
     elastic.add_argument("--slots-per-host", type=int, dest="slots_per_host")
     elastic.add_argument("--host-discovery-script",
                          dest="host_discovery_script")
@@ -114,6 +160,10 @@ def parse_args(argv=None):
     logg.add_argument("--log-level", dest="log_level",
                       choices=["trace", "debug", "info", "warning", "error",
                                "fatal"])
+    logg.add_argument("--log-without-timestamp", action="store_true",
+                      dest="log_hide_timestamp")
+    logg.add_argument("--log-with-timestamp", "--no-log-hide-timestamp",
+                      action="store_false", dest="log_hide_timestamp")
     logg.add_argument("--log-hide-timestamp", action="store_true",
                       dest="log_hide_timestamp")
 
@@ -122,6 +172,13 @@ def parse_args(argv=None):
     args = p.parse_args(argv)
     if args.config_file:
         config_parser.parse_config_file(args, args.config_file)
+    # Launcher-selection aliases override --launcher auto-detection.
+    if getattr(args, "use_mpi", False):
+        args.launcher = "mpi"
+    elif getattr(args, "use_jsrun", False):
+        args.launcher = "jsrun"
+    elif getattr(args, "use_gloo", False) and args.launcher == "auto":
+        args.launcher = "ssh"
     return args
 
 
@@ -298,7 +355,11 @@ def _run_static(args, extra_env=None, harvest=None, kv_preload=None):
             workers.append(WorkerProcess(
                 host, args.command, env, tag=f"{host}",
                 ssh_port=args.ssh_port,
-                ssh_identity_file=args.ssh_identity_file))
+                ssh_identity_file=args.ssh_identity_file,
+                output_dir=getattr(args, "output_filename", None),
+                rank=slots[0].rank,
+                prefix_timestamp=getattr(args, "prefix_output_with_timestamp",
+                                         False)))
         expected_slots = [slots[0].cross_rank for slots in by_host.values()]
         watchdog = _bootstrap_watchdog(kv, expected_slots)
         failures = wait_for_any_failure_or_all_success(workers)

@@ -121,7 +121,35 @@ def materialize_dataframe(store, df, feature_cols, label_cols):
     return np.concatenate(Xs), np.concatenate(ys)
 
 
-class TpuEstimator:
+class SparkParamsMixin:
+    """Spark-ML-style ``getFoo()``/``setFoo(v)`` accessors over plain
+    constructor attributes (reference: estimators subclass pyspark
+    ``Params`` with per-param getters/setters, spark/common/params.py).
+    ``setX`` returns ``self`` for chaining, like pyspark."""
+
+    @staticmethod
+    def _camel_to_attr(name):
+        import re
+        return re.sub("(?<!^)(?=[A-Z])", "_", name).lower()
+
+    def __getattr__(self, name):
+        if (name.startswith("get") or name.startswith("set")) \
+                and len(name) > 3 and name[3].isupper():
+            attr = self._camel_to_attr(name[3:])
+            if attr in self.__dict__:
+                if name.startswith("get"):
+                    return lambda: getattr(self, attr)
+
+                def _setter(value):
+                    setattr(self, attr, value)
+                    return self
+
+                return _setter
+        raise AttributeError(
+            f"{type(self).__name__!r} object has no attribute {name!r}")
+
+
+class TpuEstimator(SparkParamsMixin):
     """Train a flax model from a DataFrame (reference: KerasEstimator
     spark/keras/estimator.py:91 — params mirrored where meaningful).
 

@@ -15,7 +15,8 @@ import os
 
 import numpy as np
 
-from horovod_tpu.spark.estimator import (_to_pandas, features_from_dataframe,
+from horovod_tpu.spark.estimator import (SparkParamsMixin, _to_pandas,
+                                         features_from_dataframe,
                                          materialize_dataframe)
 from horovod_tpu.spark.store import LocalStore
 
@@ -35,13 +36,15 @@ def _keras():
                 "TorchEstimator instead") from e
 
 
-class KerasEstimator:
+class KerasEstimator(SparkParamsMixin):
     """Train a compiled-or-compilable Keras model from a DataFrame
     (reference: spark/keras/estimator.py:91)."""
 
     def __init__(self, model, optimizer, loss, feature_cols, label_cols,
                  batch_size=32, epochs=1, store=None, run_id=None,
-                 shuffle=True, seed=0, verbose=0):
+                 shuffle=True, seed=0, verbose=0, custom_objects=None,
+                 checkpoint_callback=None, backend_env=None,
+                 data_module=None):
         self.model = model
         self.optimizer = optimizer
         self.loss = loss
@@ -54,6 +57,11 @@ class KerasEstimator:
         self.shuffle = shuffle
         self.seed = seed
         self.verbose = verbose
+        # reference-parity params (spark/keras/estimator.py:91 Params)
+        self.custom_objects = custom_objects
+        self.checkpoint_callback = checkpoint_callback
+        self.backend_env = dict(backend_env or {})
+        self.data_module = data_module
 
     def fit(self, df):
         keras = _keras()

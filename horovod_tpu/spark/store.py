@@ -217,3 +217,31 @@ class HDFSStore(Store):
         fs.copy_files(local_path, self.strip_uri(remote_path),
                       source_filesystem=fs.LocalFileSystem(),
                       destination_filesystem=self._fs)
+
+
+def split_protocol(path):
+    """Split ``"hdfs://host/p"`` → ``("hdfs", "host/p")``; bare paths give a
+    ``None`` protocol (reference: fsspec.core.split_protocol, used throughout
+    store.py)."""
+    if "://" in path:
+        protocol, rest = path.split("://", 1)
+        return protocol, rest
+    return None, path
+
+
+def is_databricks():
+    """True inside a Databricks runtime (reference:
+    spark/common/util.py is_databricks — env probe)."""
+    return "DATABRICKS_RUNTIME_VERSION" in os.environ
+
+
+def host_hash():
+    """Stable per-host identifier used to key per-host artifact caches
+    (reference: spark/common/util.py host_hash via runner host_hash)."""
+    import hashlib
+    import socket
+    return hashlib.md5(socket.gethostname().encode()).hexdigest()[:12]
+
+
+# Reference-parity alias: the reference renamed its filesystem base class.
+AbstractFilesystemStore = FilesystemStore

@@ -16,12 +16,13 @@ import os
 
 import numpy as np
 
-from horovod_tpu.spark.estimator import (_to_pandas, features_from_dataframe,
+from horovod_tpu.spark.estimator import (SparkParamsMixin,
+                                         _to_pandas, features_from_dataframe,
                                          materialize_dataframe)
 from horovod_tpu.spark.store import LocalStore
 
 
-class TorchEstimator:
+class TorchEstimator(SparkParamsMixin):
     """Train a ``torch.nn.Module`` from a DataFrame
     (reference: spark/torch/estimator.py:92; params mirrored where they are
     meaningful on TPU).

@@ -81,7 +81,9 @@ class TestAPISurface:
 
     def test_util_helpers(self):
         assert hvd_tf.split_list(list(range(7)), 3) == [
-            [0, 1, 2], [3, 4, 5], [6]]
+            [0, 1, 2], [3, 4], [5, 6]]
+        assert hvd_tf.split_list([], 3) == [[], [], []]
+        assert len(hvd_tf.split_list(list(range(6)), 4)) == 4
         assert hvd_tf.num_rank_is_power_2(8)
         assert not hvd_tf.num_rank_is_power_2(6)
         hvd_tf.check_num_rank_power_of_2(4)
@@ -311,3 +313,90 @@ class TestMXNetNew:
     def test_allgather_object(self):
         out = hvd_mx.allgather_object({"r": hvd_mx.rank()})
         assert out[0] == {"r": hvd_mx.rank()}
+
+
+class TestRunnerCLIParity:
+    """Reference horovodrun flags accepted by hvdrun (reference:
+    runner/launch.py:286-596 parse_args)."""
+
+    def test_launcher_aliases(self):
+        from horovod_tpu.runner.launch import parse_args
+        assert parse_args(["--mpi", "cmd"]).launcher == "mpi"
+        assert parse_args(["--jsrun", "cmd"]).launcher == "jsrun"
+        assert parse_args(["--gloo", "cmd"]).launcher == "ssh"
+
+    def test_min_max_num_proc_aliases(self):
+        from horovod_tpu.runner.launch import parse_args
+        a = parse_args(["--min-num-proc", "2", "--max-num-proc", "6", "cmd"])
+        assert a.min_np == 2 and a.max_np == 6
+
+    def test_negative_flags(self):
+        from horovod_tpu.runner.launch import parse_args
+        a = parse_args(["--no-torus-allreduce", "--no-autotune",
+                        "--stall-check", "cmd"])
+        assert a.torus_allreduce is False
+        assert a.autotune is False
+        assert a.no_stall_check is False
+
+    def test_env_mapping_new_flags(self):
+        from horovod_tpu.runner.launch import parse_args
+        from horovod_tpu.runner.config_parser import set_env_from_args
+        a = parse_args(["--network-interface", "eth0,ib0",
+                        "--elastic-timeout", "120",
+                        "--blacklist-cooldown-range", "5", "60", "cmd"])
+        env = set_env_from_args({}, a)
+        assert env["HOROVOD_NICS"] == "eth0,ib0"
+        assert env["HOROVOD_GLOO_IFACE"] == "eth0,ib0"
+        assert env["HOROVOD_ELASTIC_TIMEOUT"] == "120"
+        assert env["HOROVOD_BLACKLIST_COOLDOWN_RANGE"] == "5.0,60.0"
+
+    def test_cooldown_range_honored(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN_RANGE", "3,30")
+        from horovod_tpu.runner.elastic.discovery import HostState
+        st = HostState()
+        assert st.COOLDOWN_BASE == 3.0 and st.COOLDOWN_MAX == 30.0
+
+    def test_output_filename(self, tmp_path):
+        import subprocess, sys, time
+        from horovod_tpu.runner.exec import WorkerProcess
+        w = WorkerProcess("localhost", [sys.executable, "-c",
+                                        "print('hello-rank')"],
+                          {}, tag="t", output_dir=str(tmp_path), rank=3)
+        assert w.wait(30) == 0
+        out = (tmp_path / "rank.03" / "stdout").read_text()
+        assert "hello-rank" in out
+
+
+class TestSparkRayParity:
+    def test_spark_params_mixin(self):
+        from horovod_tpu.spark.keras import KerasEstimator
+        e = KerasEstimator(None, None, "mse", ["x"], ["y"], batch_size=16)
+        assert e.getBatchSize() == 16
+        assert e.setBatchSize(64) is e and e.batch_size == 64
+        assert e.getCustomObjects() is None
+        with pytest.raises(AttributeError):
+            e.getNoSuchParam()
+
+    def test_store_helpers(self):
+        from horovod_tpu.spark.store import (AbstractFilesystemStore,
+                                             FilesystemStore, host_hash,
+                                             is_databricks, split_protocol)
+        assert AbstractFilesystemStore is FilesystemStore
+        assert split_protocol("hdfs://nn/a") == ("hdfs", "nn/a")
+        assert split_protocol("/local/p") == (None, "/local/p")
+        assert isinstance(is_databricks(), bool)
+        assert len(host_hash()) == 12
+
+    def test_ray_exports(self):
+        from horovod_tpu.ray import BaseHorovodWorker, ElasticRayExecutor
+        s = ElasticRayExecutor.create_settings(min_num_proc=2,
+                                               max_num_proc=4)
+        assert s["min_np"] == 2 and s["max_np"] == 4
+        w = BaseHorovodWorker(world_rank=1, world_size=2)
+        assert w.env_vars()["HOROVOD_RANK"] == "1"
+        assert w.get_gpu_ids() == []
+
+    def test_top_level_run_exported(self):
+        import horovod_tpu
+        assert callable(horovod_tpu.run)
+        assert callable(horovod_tpu.run_elastic)
