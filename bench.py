@@ -58,6 +58,34 @@ def _init_with_retry(hvd, attempts=8, first_delay=5.0):
             delay = min(delay * 2, 60.0)
 
 
+def _timed_steps(step, state, data, warmup=2):
+    """Shared timing protocol for every benchmark: `warmup` compiled+synced
+    steps, then HVD_BENCH_ITERS timed steps with one trailing device_get.
+    float(loss) (not block_until_ready, a no-op on the tunnel platform)
+    forces real execution.  Returns (iters, seconds)."""
+    for i in range(warmup):
+        state, loss = step(state, data)
+        float(loss)
+        _mark(f"warmup step {i} done")
+    iters = int(os.environ.get("HVD_BENCH_ITERS", "20"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        state, loss = step(state, data)
+    float(loss)
+    dt = time.perf_counter() - t0
+    _mark(f"{iters} timed steps in {dt:.2f}s")
+    return iters, dt
+
+
+def _emit(metric, value, unit, vs_baseline):
+    print(json.dumps({
+        "metric": metric,
+        "value": value,
+        "unit": unit,
+        "vs_baseline": vs_baseline,
+    }))
+
+
 def _bench_bert(hvd):
     """BERT-Large MLM+NSP fine-tune step, seq 128 (BASELINE tracked config:
     'BERT-Large fine-tune with tensor fusion'; reference procedure analog of
@@ -98,25 +126,50 @@ def _bench_bert(hvd):
 
     step = make_train_step(loss_fn, opt, mesh, donate=True)
     state = TrainState.create(variables["params"], opt)
-    data = {"ids": ids, "mlm": labels, "nsp": nsp}
-    for i in range(2):
-        state, loss = step(state, data)
-        float(loss)
-        _mark(f"warmup step {i} done")
-    iters = int(os.environ.get("HVD_BENCH_ITERS", "20"))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = step(state, data)
-    float(loss)
-    dt = time.perf_counter() - t0
-    _mark(f"{iters} timed steps in {dt:.2f}s")
-    seqs_per_sec = batch * iters / dt / n
-    print(json.dumps({
-        "metric": "bert_large_seqs_per_sec_per_chip",
-        "value": round(seqs_per_sec, 2),
-        "unit": "sequences/sec/chip",
-        "vs_baseline": 0.0,  # the reference publishes no absolute BERT number
-    }))
+    iters, dt = _timed_steps(step, state, {"ids": ids, "mlm": labels,
+                                           "nsp": nsp})
+    # vs_baseline 0.0: the reference publishes no absolute BERT number.
+    _emit("bert_large_seqs_per_sec_per_chip",
+          round(batch * iters / dt / n, 2), "sequences/sec/chip", 0.0)
+
+
+def _bench_gpt(hvd):
+    """GPT-2-small (124M) causal-LM training step, seq 1024 — the long-
+    context/transformer headline alongside ResNet (conv) and BERT (encoder).
+    Reports tokens/sec/chip."""
+    from horovod_tpu.models.gpt import GPT, GPTConfig
+    from horovod_tpu.optim import DistributedOptimizer
+    from horovod_tpu.parallel import TrainState, make_train_step
+
+    n = hvd.size()
+    mesh = hvd.global_process_set.mesh
+    seq = int(os.environ.get("HVD_BENCH_SEQ", "1024"))
+    per_chip = int(os.environ.get("HVD_BENCH_BATCH", "8"))
+    batch = per_chip * n
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, intermediate_size=3072,
+                    max_position_embeddings=seq, dtype=jnp.bfloat16,
+                    tp_axis=None, ep_axis=None)
+    model = GPT(cfg)
+
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), ids[:1])
+    _mark("gpt init done")
+    opt = DistributedOptimizer(optax.adamw(1e-4))
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["ids"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1].astype(jnp.float32), b["ids"][:, 1:]).mean()
+
+    step = make_train_step(loss_fn, opt, mesh, donate=True)
+    state = TrainState.create(variables["params"], opt)
+    iters, dt = _timed_steps(step, state, {"ids": ids})
+    # vs_baseline 0.0: the reference publishes no GPT number.
+    _emit("gpt2_small_tokens_per_sec_per_chip",
+          round(batch * seq * iters / dt / n, 1), "tokens/sec/chip", 0.0)
 
 
 def main():
@@ -127,8 +180,11 @@ def main():
 
     _init_with_retry(hvd)
     _mark("hvd.init done")
-    if os.environ.get("HVD_BENCH_MODEL", "resnet50") == "bert":
+    model_sel = os.environ.get("HVD_BENCH_MODEL", "resnet50")
+    if model_sel == "bert":
         return _bench_bert(hvd)
+    if model_sel == "gpt":
+        return _bench_gpt(hvd)
     n = hvd.size()
     mesh = hvd.global_process_set.mesh
 
@@ -161,31 +217,11 @@ def main():
     step = make_train_step(loss_fn, opt, mesh, has_aux=True, donate=True)
     state = TrainState.create(params, opt, extra=batch_stats)
 
-    data = {"x": images, "y": labels}
-    # warmup (compile). float() is a device_get: unlike block_until_ready it
-    # forces real execution on every backend, including remote-tunnel TPU.
-    for i in range(2):
-        state, loss = step(state, data)
-        float(loss)
-        _mark(f"warmup step {i} done")
-
-    iters = int(os.environ.get("HVD_BENCH_ITERS", "20"))
-    t0 = time.perf_counter()
-    for _ in range(iters):
-        state, loss = step(state, data)
-    float(loss)
-    dt = time.perf_counter() - t0
-    _mark(f"{iters} timed steps in {dt:.2f}s")
-
-    imgs_per_sec = batch * iters / dt
-    per_chip = imgs_per_sec / n
+    iters, dt = _timed_steps(step, state, {"x": images, "y": labels})
+    per_chip = batch * iters / dt / n
     baseline_per_chip = 1656.82 / 16.0
-    print(json.dumps({
-        "metric": "resnet50_images_per_sec_per_chip",
-        "value": round(per_chip, 2),
-        "unit": "images/sec/chip",
-        "vs_baseline": round(per_chip / baseline_per_chip, 3),
-    }))
+    _emit("resnet50_images_per_sec_per_chip", round(per_chip, 2),
+          "images/sec/chip", round(per_chip / baseline_per_chip, 3))
 
 
 if __name__ == "__main__":

@@ -13,6 +13,12 @@ import os
 # jax may already be imported by site customization, so set the config
 # directly as well as the env.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# Scrub the TPU-tunnel trigger so every subprocess tests spawn (examples,
+# multi-process harness, elastic workers) starts as a pure-CPU interpreter.
+# With it set, the site-wide PJRT bootstrap registers the tunnelled TPU
+# plugin at interpreter startup and can block on chip claim contention —
+# tests would then hang before their first line of output.
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
