@@ -4,3 +4,5 @@ from horovod_tpu.models.mlp import MLP  # noqa: F401
 from horovod_tpu.models.gpt import (  # noqa: F401
     GPT, GPTConfig, GPTEmbed, GPTHead, GPTMoEBlock,
 )
+from horovod_tpu.models.vgg import VGG, VGG11, VGG13, VGG16, VGG19  # noqa: F401
+from horovod_tpu.models.inception import InceptionV3  # noqa: F401

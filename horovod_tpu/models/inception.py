@@ -1,0 +1,180 @@
+"""Inception V3 in flax, TPU-first.
+
+Inception V3 is one of the reference's three headline benchmark models
+(reference: docs/benchmarks.rst:12-13 — ~90 % scaling efficiency at 512
+GPUs; tf_cnn_benchmarks procedure of docs/benchmarks.rst:15-64).
+
+Architecture per Szegedy et al. 2015 ("Rethinking the Inception
+Architecture"): factorized 7x7 -> 1x7/7x1 convolutions, grid reductions with
+parallel stride-2 branches, optional auxiliary classifier head.
+
+TPU-first choices: bfloat16 activations with fp32 params/batch-stats,
+channels-last NHWC, branch concat on the minor (channel) axis so XLA keeps
+lane-dim layouts, BN without the conv bias (folded at inference by XLA).
+"""
+
+from functools import partial
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class ConvBN(nn.Module):
+    """conv -> batch-norm -> relu, the Inception basic cell."""
+    filters: int
+    kernel: tuple = (1, 1)
+    strides: tuple = (1, 1)
+    padding: str = "SAME"
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.Conv(self.filters, self.kernel, self.strides,
+                    padding=self.padding, use_bias=False,
+                    dtype=self.dtype)(x)
+        x = nn.BatchNorm(use_running_average=not self.train, momentum=0.9,
+                         epsilon=1e-3, dtype=self.dtype)(x)
+        return nn.relu(x)
+
+
+def _avg_pool_same(x):
+    return nn.avg_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+
+
+class InceptionA(nn.Module):
+    pool_features: int
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, dtype=self.dtype, train=self.train)
+        b1 = c(64)(x)
+        b5 = c(64, (5, 5))(c(48)(x))
+        b3 = c(96, (3, 3))(c(96, (3, 3))(c(64)(x)))
+        bp = c(self.pool_features)(_avg_pool_same(x))
+        return jnp.concatenate([b1, b5, b3, bp], axis=-1)
+
+
+class InceptionB(nn.Module):
+    """Grid reduction 35x35 -> 17x17."""
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, dtype=self.dtype, train=self.train)
+        b3 = c(384, (3, 3), (2, 2), padding="VALID")(x)
+        bd = c(96, (3, 3), (2, 2), padding="VALID")(
+            c(96, (3, 3))(c(64)(x)))
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, bd, bp], axis=-1)
+
+
+class InceptionC(nn.Module):
+    """Factorized 7x7 block at 17x17."""
+    channels_7x7: int
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, dtype=self.dtype, train=self.train)
+        c7 = self.channels_7x7
+        b1 = c(192)(x)
+        b7 = c(192, (7, 1))(c(c7, (1, 7))(c(c7)(x)))
+        bd = c(192, (1, 7))(c(c7, (7, 1))(c(c7, (1, 7))(
+            c(c7, (7, 1))(c(c7)(x)))))
+        bp = c(192)(_avg_pool_same(x))
+        return jnp.concatenate([b1, b7, bd, bp], axis=-1)
+
+
+class InceptionD(nn.Module):
+    """Grid reduction 17x17 -> 8x8."""
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, dtype=self.dtype, train=self.train)
+        b3 = c(320, (3, 3), (2, 2), padding="VALID")(c(192)(x))
+        b7 = c(192, (3, 3), (2, 2), padding="VALID")(
+            c(192, (7, 1))(c(192, (1, 7))(c(192)(x))))
+        bp = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        return jnp.concatenate([b3, b7, bp], axis=-1)
+
+
+class InceptionE(nn.Module):
+    """Expanded-filter-bank block at 8x8."""
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, dtype=self.dtype, train=self.train)
+        b1 = c(320)(x)
+        y = c(384)(x)
+        b3 = jnp.concatenate([c(384, (1, 3))(y), c(384, (3, 1))(y)], axis=-1)
+        z = c(384, (3, 3))(c(448)(x))
+        bd = jnp.concatenate([c(384, (1, 3))(z), c(384, (3, 1))(z)], axis=-1)
+        bp = c(192)(_avg_pool_same(x))
+        return jnp.concatenate([b1, b3, bd, bp], axis=-1)
+
+
+class InceptionAux(nn.Module):
+    """Auxiliary classifier over the 17x17 grid (training regularizer)."""
+    num_classes: int
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        c = partial(ConvBN, dtype=self.dtype, train=self.train)
+        x = nn.avg_pool(x, (5, 5), strides=(3, 3), padding="VALID")
+        x = c(128)(x)
+        x = c(768, (5, 5), padding="VALID")(x)
+        x = jnp.mean(x, axis=(1, 2))
+        return nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+
+
+class InceptionV3(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    aux_logits: bool = False
+    dropout_rate: float = 0.5
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=None):
+        train = self.train if train is None else train
+        c = partial(ConvBN, dtype=self.dtype, train=train)
+        x = x.astype(self.dtype)
+        # Stem: 299x299x3 -> 35x35x192.
+        x = c(32, (3, 3), (2, 2), padding="VALID")(x)
+        x = c(32, (3, 3), padding="VALID")(x)
+        x = c(64, (3, 3))(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        x = c(80)(x)
+        x = c(192, (3, 3), padding="VALID")(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="VALID")
+        # 3 x InceptionA at 35x35.
+        for pf in (32, 64, 64):
+            x = InceptionA(pf, dtype=self.dtype, train=train)(x)
+        x = InceptionB(dtype=self.dtype, train=train)(x)
+        # 4 x InceptionC at 17x17.
+        for c7 in (128, 160, 160, 192):
+            x = InceptionC(c7, dtype=self.dtype, train=train)(x)
+        aux = None
+        if self.aux_logits and train:
+            aux = InceptionAux(self.num_classes, dtype=self.dtype,
+                               train=train)(x)
+        x = InceptionD(dtype=self.dtype, train=train)(x)
+        for _ in range(2):
+            x = InceptionE(dtype=self.dtype, train=train)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(self.dropout_rate, deterministic=not train)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        x = x.astype(jnp.float32)
+        return (x, aux) if aux is not None else x

@@ -1,0 +1,63 @@
+"""VGG family in flax, TPU-first.
+
+VGG-16 is one of the reference's three headline benchmark models (reference:
+docs/benchmarks.rst:12-13 — ~68 % scaling efficiency at 512 GPUs; the
+tf_cnn_benchmarks procedure of docs/benchmarks.rst:15-64).
+
+TPU-first choices: bfloat16 activations with fp32 params (MXU native dtype),
+channels-last NHWC (XLA TPU's preferred conv layout), global-average head by
+default instead of the 7x7x512->4096 flatten (identical conv trunk, far
+smaller all-reduced gradient; ``classic_head=True`` restores the exact
+138M-param original for parity benchmarking).
+"""
+
+from functools import partial
+from typing import Any, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+# (num_convs, filters) per stage; maxpool between stages.
+_CFGS = {
+    11: ((1, 64), (1, 128), (2, 256), (2, 512), (2, 512)),
+    13: ((2, 64), (2, 128), (2, 256), (2, 512), (2, 512)),
+    16: ((2, 64), (2, 128), (3, 256), (3, 512), (3, 512)),
+    19: ((2, 64), (2, 128), (4, 256), (4, 512), (4, 512)),
+}
+
+
+class VGG(nn.Module):
+    stage_cfg: Sequence = _CFGS[16]
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    classic_head: bool = True     # two 4096-wide FC layers, as published
+    dropout_rate: float = 0.5
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x, train=None):
+        train = self.train if train is None else train
+        conv = partial(nn.Conv, kernel_size=(3, 3), padding="SAME",
+                       dtype=self.dtype)
+        x = x.astype(self.dtype)
+        for i, (reps, filters) in enumerate(self.stage_cfg):
+            for j in range(reps):
+                x = nn.relu(conv(filters, name=f"conv{i}_{j}")(x))
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        if self.classic_head:
+            x = x.reshape((x.shape[0], -1))
+            for k in range(2):
+                x = nn.relu(nn.Dense(4096, dtype=self.dtype,
+                                     name=f"fc{k}")(x))
+                x = nn.Dropout(self.dropout_rate,
+                               deterministic=not train)(x)
+        else:
+            x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x.astype(jnp.float32)
+
+
+VGG11 = partial(VGG, stage_cfg=_CFGS[11])
+VGG13 = partial(VGG, stage_cfg=_CFGS[13])
+VGG16 = partial(VGG, stage_cfg=_CFGS[16])
+VGG19 = partial(VGG, stage_cfg=_CFGS[19])
