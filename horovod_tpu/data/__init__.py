@@ -2,3 +2,8 @@ from horovod_tpu.data.data_loader import (  # noqa: F401
     BaseDataLoader, AsyncDataLoaderMixin, ShardedDataLoader,
     prefetch_to_device,
 )
+from horovod_tpu.data.compute_service import (  # noqa: F401
+    ComputeServiceConfig, ComputeServiceDataLoader, DataDispatcher,
+    DataWorker,
+)
+from horovod_tpu.data.parquet import ParquetBatchReader  # noqa: F401

@@ -58,3 +58,18 @@ class UpdateBatchStateCallback:
                 state.batch = 0
 
         return _Update()
+
+
+class UpdateEpochStateCallback:
+    """Track ``state.epoch`` only — for epoch-granular resume where
+    ``initial_epoch=state.epoch`` is passed to ``model.fit`` (reference:
+    keras/elastic.py UpdateEpochStateCallback)."""
+
+    def __new__(cls, state):
+        Base = _make_callback_base()
+
+        class _UpdateEpoch(Base):
+            def on_epoch_end(self, epoch, logs=None):
+                state.epoch = epoch + 1
+
+        return _UpdateEpoch()

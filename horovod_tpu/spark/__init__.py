@@ -12,11 +12,13 @@ from horovod_tpu.spark.estimator import TpuEstimator, TpuModel
 from horovod_tpu.spark.keras import KerasEstimator, KerasModel
 from horovod_tpu.spark.lightning import LightningEstimator
 from horovod_tpu.spark.runner import run, run_elastic, spark_available
-from horovod_tpu.spark.store import FilesystemStore, LocalStore, Store
+from horovod_tpu.spark.store import (DBFSLocalStore, FilesystemStore,
+                                     HDFSStore, LocalStore, Store)
 from horovod_tpu.spark.task import assign_ranks
 from horovod_tpu.spark.torch import TorchEstimator, TorchModel
 
 __all__ = ["run", "run_elastic", "spark_available", "Store", "LocalStore",
-           "FilesystemStore", "TpuEstimator", "TpuModel", "KerasEstimator",
+           "FilesystemStore", "HDFSStore", "DBFSLocalStore",
+           "TpuEstimator", "TpuModel", "KerasEstimator",
            "KerasModel", "TorchEstimator", "TorchModel",
            "LightningEstimator", "assign_ranks"]
