@@ -1,0 +1,88 @@
+"""Runnable data-service worker: the side-car process the launcher starts on
+CPU hosts.
+
+Reference: horovod/tensorflow/data/compute_worker.py — run under the
+launcher as ``horovodrun -np N python -m horovod.tensorflow.data
+.compute_worker /path/config.json``; rank 0 additionally hosts the
+dispatcher and writes the config file the training job reads.
+
+Usage here::
+
+    bin/hvdrun -np 4 -H cpuhost1:2,cpuhost2:2 \
+        python -m horovod_tpu.data.compute_worker \
+        --dataset-fn mypkg.pipeline:batches /shared/compute_service.json
+
+``--dataset-fn module:callable`` names a ``dataset_fn(shard, num_shards)``
+generator importable on every worker host. Rank 0 starts the
+:class:`~horovod_tpu.data.compute_service.DataDispatcher` and atomically
+writes the config file (shared filesystem, same contract as the
+reference); every rank serves its shard with a
+:class:`~horovod_tpu.data.compute_service.DataWorker`. The training job
+reads the config with ``ComputeServiceConfig.read(path,
+wait_for_file_creation=True)`` and consumes batches through
+:class:`~horovod_tpu.data.compute_service.ComputeServiceDataLoader`.
+"""
+
+import argparse
+import importlib
+import os
+import signal
+import sys
+import threading
+
+from horovod_tpu.data.compute_service import (ComputeServiceConfig,
+                                              DataDispatcher, DataWorker)
+
+
+def _load_dataset_fn(spec):
+    if ":" not in spec:
+        raise SystemExit(
+            f"--dataset-fn must be 'module:callable', got {spec!r}")
+    mod, _, name = spec.partition(":")
+    fn = getattr(importlib.import_module(mod), name, None)
+    if fn is None:
+        raise SystemExit(f"{name!r} not found in module {mod!r}")
+    return fn
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        prog="python -m horovod_tpu.data.compute_worker",
+        description=__doc__, formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("configfile",
+                   help="shared path where rank 0 writes the service config")
+    p.add_argument("--dataset-fn", required=True,
+                   help="module:callable naming dataset_fn(shard, num_shards)")
+    p.add_argument("--timeout", type=int, default=60,
+                   help="seconds non-root ranks wait for the config file")
+    args = p.parse_args(argv)
+
+    rank = int(os.environ.get("HOROVOD_RANK", "0"))
+    size = int(os.environ.get("HOROVOD_SIZE", "1"))
+    dataset_fn = _load_dataset_fn(args.dataset_fn)
+
+    dispatcher = None
+    if rank == 0:
+        dispatcher = DataDispatcher(num_workers=size)
+        cfg = dispatcher.config
+        cfg.write(args.configfile)
+    else:
+        cfg = ComputeServiceConfig.read(args.configfile,
+                                        wait_for_file_creation=True)
+
+    worker = DataWorker(cfg, shard=rank, dataset_fn=dataset_fn)
+    worker.start()
+    print(f"# compute worker shard {rank}/{size} serving", flush=True)
+
+    stop = threading.Event()
+    for sig in (signal.SIGTERM, signal.SIGINT):
+        signal.signal(sig, lambda *_: stop.set())
+    stop.wait()
+    worker.stop()
+    if dispatcher is not None:
+        dispatcher.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

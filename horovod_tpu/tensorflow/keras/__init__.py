@@ -1,0 +1,32 @@
+"""``horovod_tpu.tensorflow.keras`` — the tf.keras-flavored Keras frontend.
+
+Reference: horovod/tensorflow/keras/__init__.py (same surface as
+horovod/keras but bound to ``tf.keras``). Here both standalone Keras 3 and
+``tf.keras`` funnel optimizer updates through ``apply_gradients``, so the
+implementation is shared with :mod:`horovod_tpu.keras`; this module keeps
+the reference's import path working.
+"""
+
+from horovod_tpu.keras import (  # noqa: F401
+    init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
+    local_size, cross_size, is_homogeneous, mpi_threads_supported,
+    mpi_enabled, mpi_built, gloo_enabled, gloo_built, nccl_built, ddl_built,
+    ccl_built, cuda_built, rocm_built, xla_built, ici_built, start_timeline,
+    stop_timeline, global_process_set,
+    Adasum, Average, Max, Min, Product, Sum, Compression,
+    allreduce, allgather, broadcast, alltoall, reducescatter,
+    broadcast_object, broadcast_variables, broadcast_global_variables,
+    DistributedOptimizer, PartialDistributedOptimizer, load_model,
+    callbacks,
+)
+
+from horovod_tpu.keras import __all__ as _keras_all
+
+__all__ = list(_keras_all)
+
+
+def __getattr__(name):
+    if name == "elastic":
+        import horovod_tpu.keras.elastic as elastic
+        return elastic
+    raise AttributeError(name)

@@ -77,3 +77,40 @@ class TestComputeService:
         got = ComputeServiceConfig.read(path, wait_for_file_creation=True)
         assert got == cfg
         t.join()
+
+    def test_compute_worker_module_entry(self, tmp_path):
+        """python -m horovod_tpu.data.compute_worker serves batches end to
+        end (reference: compute_worker.py run under horovodrun)."""
+        import os
+        import signal
+        import subprocess
+        import sys
+        import time
+
+        from horovod_tpu.data.compute_service import (
+            ComputeServiceConfig, ComputeServiceDataLoader)
+
+        (tmp_path / "dsmod.py").write_text(
+            "def batches(shard, num_shards):\n"
+            "    for i in range(3):\n"
+            "        yield {'shard': shard, 'i': i}\n")
+        cfgfile = str(tmp_path / "svc.json")
+        env = dict(os.environ)
+        env["PYTHONPATH"] = (str(tmp_path) + os.pathsep +
+                             env.get("PYTHONPATH", ""))
+        env.update({"HOROVOD_RANK": "0", "HOROVOD_SIZE": "1"})
+        proc = subprocess.Popen(
+            [sys.executable, "-m", "horovod_tpu.data.compute_worker",
+             "--dataset-fn", "dsmod:batches", cfgfile],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        try:
+            cfg = ComputeServiceConfig.read(cfgfile,
+                                            wait_for_file_creation=True)
+            loader = ComputeServiceDataLoader(cfg, shard=0)
+            got = list(loader)
+            assert got == [{"shard": 0, "i": i} for i in range(3)]
+            proc.send_signal(signal.SIGTERM)
+            assert proc.wait(timeout=10) == 0
+        finally:
+            if proc.poll() is None:
+                proc.kill()

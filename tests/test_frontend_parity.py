@@ -400,3 +400,24 @@ class TestSparkRayParity:
         import horovod_tpu
         assert callable(horovod_tpu.run)
         assert callable(horovod_tpu.run_elastic)
+
+
+class TestTfKerasAlias:
+    def test_tensorflow_keras_module_surface(self, hvd):
+        """horovod_tpu.tensorflow.keras mirrors horovod_tpu.keras
+        (reference: horovod/tensorflow/keras/__init__.py)."""
+        import horovod_tpu.keras as hk
+        import horovod_tpu.tensorflow.keras as htk
+        for name in ("DistributedOptimizer", "PartialDistributedOptimizer",
+                     "load_model", "broadcast_global_variables", "callbacks",
+                     "allreduce", "Compression", "rank", "size"):
+            assert getattr(htk, name) is getattr(hk, name), name
+        assert htk.elastic is not None
+
+    def test_update_epoch_state_callback(self, hvd):
+        import types
+        import horovod_tpu.keras.elastic as ke
+        st = types.SimpleNamespace(epoch=0)
+        cb = ke.UpdateEpochStateCallback(st)
+        cb.on_epoch_end(4)
+        assert st.epoch == 5
