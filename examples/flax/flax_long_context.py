@@ -1,0 +1,89 @@
+"""Long-context training with sequence parallelism (ring attention).
+
+The sequence axis is sharded across the mesh: each chip holds L/n tokens,
+K/V blocks rotate via ppermute while flash-style online-softmax partials
+accumulate — memory O(L/n) per chip, so context length scales with the mesh
+(reference analog: none — the reference is DP-only; its AllToAll/process-set
+primitives are what SP composes from, SURVEY.md §5.7).
+
+Run it on any mesh, e.g. the virtual CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=4 \
+        python flax_long_context.py --seq-per-chip 128
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax.sharding import Mesh, PartitionSpec as P
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel.sequence import ring_attention
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--seq-per-chip", type=int, default=128)
+    ap.add_argument("--dim", type=int, default=64)
+    ap.add_argument("--heads", type=int, default=4)
+    ap.add_argument("--steps", type=int, default=10)
+    args = ap.parse_args()
+
+    hvd.init()
+    n = hvd.size()
+    devices = hvd.global_process_set.mesh.devices.reshape(-1)
+    mesh = Mesh(devices, ("sp",))
+    seq = args.seq_per_chip * n
+    D, H = args.dim, args.heads
+
+    if hvd.rank() == 0:
+        print(f"mesh: {n} chips, total context {seq} tokens "
+              f"({args.seq_per_chip}/chip)")
+
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((D, 3 * D)) * 0.05, jnp.float32)
+    wo = jnp.asarray(rng.standard_normal((D, D)) * 0.05, jnp.float32)
+    x = jnp.asarray(rng.standard_normal((2, seq, D)), jnp.float32)
+    y = jnp.roll(x, -1, axis=1)  # toy target: predict the next token's embed
+
+    def heads(t):
+        return t.reshape(t.shape[:-1] + (H, D // H))
+
+    def loss_fn(params, xl, yl):
+        w, wo = params
+        q, k, v = jnp.split(xl @ w, 3, axis=-1)
+        o = ring_attention(heads(q), heads(k), heads(v), axis_name="sp",
+                           causal=True)
+        o = o.reshape(o.shape[:2] + (D,)) @ wo
+        # mean over the sharded sequence axis -> pmean across the ring
+        return jax.lax.pmean(jnp.mean((o - yl) ** 2), "sp")
+
+    grad_fn = jax.jit(jax.shard_map(
+        jax.value_and_grad(lambda p, xl, yl: loss_fn(p, xl, yl)),
+        mesh=mesh,
+        in_specs=(P(), P(None, "sp", None), P(None, "sp", None)),
+        out_specs=(P(), P())))
+
+    opt = optax.adam(1e-3)
+    params = (w, wo)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def update(params, opt_state, g):
+        updates, opt_state = opt.update(g, opt_state)
+        return optax.apply_updates(params, updates), opt_state
+
+    for i in range(args.steps):
+        loss, g = grad_fn(params, x, y)
+        params, opt_state = update(params, opt_state, g)
+        if i % 2 == 0 and hvd.rank() == 0:
+            print(f"step {i}: loss {float(loss):.5f}")
+    if hvd.rank() == 0:
+        print(f"final loss {float(loss):.5f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

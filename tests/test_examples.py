@@ -72,3 +72,9 @@ class TestExamples:
     def test_spark_estimator(self):
         out = _run("spark/spark_estimator.py")
         assert "transform mse:" in out
+
+    def test_flax_long_context(self):
+        out = _run("flax/flax_long_context.py", "--seq-per-chip", "16",
+                   "--dim", "16", "--heads", "2", "--steps", "4")
+        assert "final loss" in out
+        assert "total context 32 tokens" in out
