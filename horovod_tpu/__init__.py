@@ -29,7 +29,8 @@ from horovod_tpu.common.exceptions import (  # noqa: F401
 )
 from horovod_tpu.common.process_sets import (  # noqa: F401
     ProcessSet, global_process_set, add_process_set, remove_process_set,
-    process_set_by_id, process_sets,
+    process_set_by_id, process_sets, number_of_process_sets,
+    is_process_set_included,
 )
 from horovod_tpu.ops.collective_ops import (  # noqa: F401
     ReduceOp, Average, Sum, Adasum, Min, Max, Product,

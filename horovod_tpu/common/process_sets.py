@@ -166,3 +166,21 @@ def process_set_by_id(process_set_id):
 def process_sets():
     """id -> ProcessSet mapping (reference: process_sets.py:80-98)."""
     return dict(_table().by_id)
+
+
+def number_of_process_sets():
+    """reference: basics.py _number_of_process_sets (common/elastic.py:22)."""
+    return len(_table().by_id)
+
+
+def is_process_set_included(process_set_id):
+    """True when this process participates in the given set (reference:
+    basics.py:467 _is_process_set_included). With several chips per process
+    (SPMD dispatch, docs/api.md rank semantics) the process is included iff
+    any chip-rank it owns is a member."""
+    ps = process_set_by_id(process_set_id)
+    from horovod_tpu.common import basics
+    local = basics._get_state().topology.local_device_ranks
+    member = set(ps.ranks if ps.ranks is not None
+                 else range(basics.size()))
+    return any(r in member for r in local)

@@ -198,6 +198,26 @@ class Compression:
             return a if ctx is None else np.asarray(a).astype(ctx)
 
 
+class Compressor:
+    """Abstract wire compressor for user subclasses (reference:
+    horovod/tensorflow/compression.py Compressor base)."""
+
+    @staticmethod
+    def compress(tensor):
+        raise NotImplementedError
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        raise NotImplementedError
+
+
+# Reference-named aliases (tensorflow/compression.py NoneCompressor /
+# FP16Compressor; bf16 is the TPU-native addition).
+NoneCompressor = Compression.none
+FP16Compressor = Compression.fp16
+BF16Compressor = Compression.bf16
+
+
 def allreduce(tensor, average=None, op=None, prescale_factor=1.0,
               postscale_factor=1.0, compression=Compression.none,
               sparse_as_dense=False, name=None, process_set=None):

@@ -11,6 +11,8 @@ import copy
 import torch
 
 from horovod_tpu.elastic.state import ObjectState
+from horovod_tpu.elastic.state import run  # noqa: F401  (re-export;
+#   reference: horovod/torch/elastic/__init__.py:23 def run)
 from horovod_tpu.torch.functions import (broadcast_object,
                                          broadcast_optimizer_state,
                                          broadcast_parameters)

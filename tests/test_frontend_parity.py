@@ -421,3 +421,31 @@ class TestTfKerasAlias:
         cb = ke.UpdateEpochStateCallback(st)
         cb.on_epoch_end(4)
         assert st.epoch == 5
+
+
+class TestProcessSetQueries:
+    def test_number_and_included(self, hvd):
+        import horovod_tpu as h
+        n0 = h.number_of_process_sets()
+        ps = h.add_process_set(h.ProcessSet([0, 1]))
+        try:
+            assert h.number_of_process_sets() == n0 + 1
+            # single-controller process owns all chips -> included in both
+            assert h.is_process_set_included(0)
+            assert h.is_process_set_included(ps.process_set_id)
+        finally:
+            h.remove_process_set(ps)
+        assert h.number_of_process_sets() == n0
+
+    def test_torch_elastic_run_reexport(self, hvd):
+        import horovod_tpu.torch.elastic as te
+        from horovod_tpu.elastic.state import run
+        assert te.run is run
+
+    def test_tf_compressor_aliases(self, hvd):
+        import horovod_tpu.tensorflow as htf
+        assert htf.NoneCompressor is htf.Compression.none
+        assert htf.FP16Compressor is htf.Compression.fp16
+        a, ctx = htf.BF16Compressor.compress(
+            np.ones((2, 2), np.float32))
+        assert str(a.dtype) == "bfloat16" and ctx == np.float32
