@@ -31,6 +31,39 @@ def _mark(msg):
           flush=True)
 
 
+def _wait_for_backend(total_wait=240, probe_timeout=75):
+    """Block until the device backend answers, probing from KILLABLE
+    subprocesses.  The round-1/2 failure mode is a *hang* (not an error)
+    inside the first device touch when the tunnelled TPU is unhealthy —
+    in-process retry can't catch that, but a subprocess probe times out
+    cleanly.  Raises RuntimeError (→ parseable failure JSON) if the backend
+    never comes up, instead of letting the driver's outer timeout kill us
+    with no output."""
+    import subprocess
+    deadline = time.time() + total_wait
+    attempt = 0
+    while True:
+        attempt += 1
+        try:
+            r = subprocess.run(
+                [sys.executable, "-c",
+                 "import jax; print(len(jax.devices()))"],
+                capture_output=True, text=True, timeout=probe_timeout)
+            if r.returncode == 0 and r.stdout.strip().isdigit():
+                _mark(f"backend probe ok ({r.stdout.strip()} devices, "
+                      f"attempt {attempt})")
+                return
+            reason = (r.stderr or r.stdout).splitlines()[-1:] or ["?"]
+            _mark(f"backend probe failed rc={r.returncode}: {reason[0][:120]}")
+        except subprocess.TimeoutExpired:
+            _mark(f"backend probe hung >{probe_timeout}s (attempt {attempt})")
+        if time.time() > deadline:
+            raise RuntimeError(
+                f"device backend unreachable after {total_wait}s "
+                f"({attempt} probes) — TPU tunnel down?")
+        time.sleep(min(10.0 * attempt, 30.0))
+
+
 def _init_with_retry(hvd, attempts=8, first_delay=5.0):
     """hvd.init() with bounded retry: the tunnelled TPU backend is
     occasionally transiently UNAVAILABLE at process start (round-1 failure
@@ -252,6 +285,7 @@ def _bench_image(hvd, name):
 def main():
     import horovod_tpu as hvd
 
+    _wait_for_backend()
     _init_with_retry(hvd)
     _mark("hvd.init done")
     model_sel = os.environ.get("HVD_BENCH_MODEL", "resnet50")
