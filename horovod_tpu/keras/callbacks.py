@@ -118,3 +118,21 @@ class LearningRateWarmupCallback(LearningRateScheduleCallback):
                          momentum_correction=momentum_correction,
                          steps_per_epoch=steps_per_epoch)
         self.verbose = verbose
+
+
+class BestModelCheckpoint(keras.callbacks.ModelCheckpoint):
+    """ModelCheckpoint pinned to save-best-only; ``filepath`` may be set
+    after construction by a training harness (reference:
+    keras/callbacks.py:161-186 — the Spark Keras estimator uses it to keep
+    only the best epoch's model)."""
+
+    def __init__(self, monitor="val_loss", verbose=0, mode="auto",
+                 save_freq="epoch", filepath=None):
+        # Keras 3 validates filepath eagerly (must end in .keras); the
+        # reference passes None and lets the estimator fill it in later —
+        # use a placeholder name the harness overwrites via `.filepath`.
+        super().__init__(filepath=filepath or "best_model.keras",
+                         monitor=monitor,
+                         verbose=verbose, save_best_only=True,
+                         save_weights_only=False, mode=mode,
+                         save_freq=save_freq)

@@ -34,7 +34,8 @@ from horovod_tpu.mxnet.mpi_ops import (  # noqa: F401
 )
 # The mxnet bridge is numpy duck-typed, so the TF frontend's numpy
 # compressors serve here too (reference: horovod/mxnet/compression.py).
-from horovod_tpu.tensorflow import Compression  # noqa: F401
+from horovod_tpu.tensorflow import (Compression, Compressor,  # noqa: F401
+                                    FP16Compressor, NoneCompressor)
 from horovod_tpu.mxnet import mpi_ops as _ops
 
 

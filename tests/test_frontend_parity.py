@@ -449,3 +449,17 @@ class TestProcessSetQueries:
         a, ctx = htf.BF16Compressor.compress(
             np.ones((2, 2), np.float32))
         assert str(a.dtype) == "bfloat16" and ctx == np.float32
+
+    def test_best_model_checkpoint(self, hvd):
+        import pytest
+        keras = pytest.importorskip("keras")
+        from horovod_tpu.keras.callbacks import BestModelCheckpoint
+        cb = BestModelCheckpoint(monitor="loss")
+        assert isinstance(cb, keras.callbacks.ModelCheckpoint)
+        assert cb.save_best_only
+
+    def test_mxnet_compressor_aliases(self, hvd):
+        import horovod_tpu.mxnet as m
+        import horovod_tpu.tensorflow as htf
+        assert m.NoneCompressor is htf.Compression.none
+        assert m.FP16Compressor is htf.Compression.fp16
