@@ -193,3 +193,15 @@ class TestMultiProcessSemantics:
 
         results = run(fn, hosts="localhost:1,127.0.0.1:1")
         assert results == ["raised", "raised"]
+
+
+class TestMultiProcessWorldEight:
+    def test_two_processes_four_slots_each(self):
+        """n=8 world across a real process boundary — the VERDICT target for
+        negotiated ragged allgather / uneven alltoall."""
+        results = run(_battery, args=("t8",),
+                      hosts="localhost:4,127.0.0.1:4")
+        assert len(results) == 2
+        for (tag, rank, n, pc, passed), want_rank in zip(results, (0, 4)):
+            assert (tag, rank, n, pc) == ("t8", want_rank, 8, 2)
+            assert passed == ALL_OPS
