@@ -81,6 +81,16 @@ def exchange(tag, payload, procs=None):
     client = _client()
     base = f"hvd/neg/{tag}/{proc_tag}/{seq}"
     client.key_value_set(f"{base}/{me}", json.dumps(payload))
+    # Bound coordinator memory on long jobs: reaching seq s implies this
+    # process completed exchange s-1, which required reading every peer's
+    # s-1 key — so every peer had *started* s-1 and therefore finished s-2.
+    # Nobody can still read an s-2 key: delete our own.
+    if seq >= 2:
+        try:
+            client.key_value_delete(
+                f"hvd/neg/{tag}/{proc_tag}/{seq - 2}/{me}")
+        except Exception:  # deletion is best-effort housekeeping
+            pass
     out = []
     for p in procs:
         if p == me:

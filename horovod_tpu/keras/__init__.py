@@ -154,7 +154,9 @@ def DistributedOptimizer(optimizer, name=None,
                     postscale = gradient_predivide_factor / ps.size()
                     op_ = Sum
                 if isinstance(groups, int) and groups > 0:
-                    chunks = hvd_tf.split_list(reduce_idx, groups)
+                    # Drop empty trailing chunks when groups > len(grads).
+                    chunks = [c for c in hvd_tf.split_list(reduce_idx,
+                                                           groups) if c]
                 elif isinstance(groups, (list, tuple)):
                     by_key = {}
                     for gi, group in enumerate(groups):

@@ -61,8 +61,11 @@ def _copy_into(target, arr):
 
 
 def _stack(a, ps):
-    n = ps.size() if ps is not None else basics.size()
-    return np.broadcast_to(a, (n,) + a.shape)
+    # Local rows only under a multi-process launch (the eager stacked
+    # contract of collective_ops._prepare, docs/api.md).
+    ps = ps if ps is not None else C.global_process_set
+    n_rows = C._expected_rows(ps.mesh, ps.size())
+    return np.broadcast_to(a, (n_rows,) + a.shape)
 
 
 def _first(out):
@@ -147,12 +150,12 @@ def alltoall(tensor, splits=None, name=None, priority=0, process_set=None):
     a = _to_numpy(tensor)
     n = (process_set.size() if process_set is not None else
          basics.size())
+    stacked = _stack(a, process_set)
     if splits is not None:
-        # The eager API wants the full (rank, peer) split matrix; every mesh
-        # slice carries this host's replicated tensor, so every row is this
-        # host's split vector.
-        splits = np.broadcast_to(np.asarray(splits), (n, n))
-    res = C.alltoall(_stack(a, process_set), splits=splits,
+        # One split row per stacked row (this host's replicated tensor on
+        # each mesh slice it owns; local rows only when multi-process).
+        splits = np.broadcast_to(np.asarray(splits), (stacked.shape[0], n))
+    res = C.alltoall(stacked, splits=splits,
                      process_set=process_set, name=name)
     if splits is None:
         return _like(tensor, _first(res))

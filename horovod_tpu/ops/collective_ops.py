@@ -760,8 +760,11 @@ def join(rank=None):
     raises rather than corrupting state.
     """
     if jax.process_count() > 1:
-        from horovod_tpu.common.exceptions import HorovodInternalError
-        raise HorovodInternalError(
+        # Deliberately NOT HorovodInternalError: that is the retryable
+        # collective-failure type the elastic @run wrapper restores-and-
+        # retries, which would loop forever on this deterministic usage
+        # error.
+        raise NotImplementedError(
             "hvd.join() is single-controller only: multi-process eager "
             "dispatch is SPMD and cannot drop one process from subsequent "
             "collectives. Pad uneven batches or use the elastic API.")

@@ -339,8 +339,10 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
 
     def _np_core2(a, sp):
         a = np.asarray(a)
-        mat = np.broadcast_to(np.asarray(sp), (n, n))
-        rows, received = C.alltoall(_stack(a, ps), splits=mat,
+        stacked = _stack(a, ps)
+        # One splits row per stacked row (local rows only multi-process).
+        mat = np.broadcast_to(np.asarray(sp), (stacked.shape[0], n))
+        rows, received = C.alltoall(stacked, splits=mat,
                                     process_set=process_set, name=name)
         return (np.asarray(rows[0]).astype(a.dtype),
                 np.asarray(received[0], np.int64))
@@ -669,7 +671,9 @@ def _make_allreduce_grads_fn(op, gradient_predivide_factor, compression,
             op_ = Sum
 
         if isinstance(groups, int) and groups > 0:
-            chunks = split_list(live_idx, groups)
+            # More groups than live gradients leaves empty trailing chunks —
+            # drop them (grouped_allreduce([]) is an error).
+            chunks = [c for c in split_list(live_idx, groups) if c]
         elif isinstance(groups, (list, tuple)) and variables is not None:
             by_ref = {}
             for gi, group in enumerate(groups):

@@ -61,12 +61,11 @@ def _to_torch(a, torch_dtype):
 def _stack_for_mesh(a, ps):
     """Replicate the host tensor onto this controller's mesh slices.
 
-    Single-controller: leading axis == set size (every chip carries the host's
-    value). Multi-host extension replicates onto the local chips only and
-    assembles the global array from per-process shards.
-    """
-    n = ps.size()
-    return np.broadcast_to(a, (n,) + a.shape)
+    Single-controller: leading axis == set size (every chip carries the
+    host's value). Multi-process: only the local chips' rows — the eager
+    stacked contract of ``collective_ops._prepare`` (docs/api.md)."""
+    n_rows = C._expected_rows(ps.mesh, ps.size())
+    return np.broadcast_to(a, (n_rows,) + a.shape)
 
 
 def _unstack(out, torch_dtype):
@@ -299,7 +298,8 @@ def alltoall(tensor, splits=None, name=None, process_set=None):
     splits = np.asarray(splits)
     if splits.ndim != 1 or splits.shape[0] != n:
         raise ValueError(f"splits must be a length-{n} vector of row counts")
-    mat = np.broadcast_to(splits, (n, n))
+    # One splits row per stacked row (local rows only when multi-process).
+    mat = np.broadcast_to(splits, (stacked.shape[0], n))
     rows, received = C.alltoall(stacked, splits=mat, process_set=process_set,
                                 name=name)
     return (_to_torch(np.asarray(rows[0]), dtype),

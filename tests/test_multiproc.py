@@ -184,10 +184,12 @@ class TestMultiProcessSemantics:
     def test_join_raises_multiprocess(self):
         def fn():
             import horovod_tpu as hvd
-            from horovod_tpu.common.exceptions import HorovodInternalError
+            # NotImplementedError, NOT HorovodInternalError: the elastic
+            # @run wrapper retries the latter, so a deterministic usage
+            # error must use a non-retryable type.
             try:
                 hvd.join()
-            except HorovodInternalError:
+            except NotImplementedError:
                 return "raised"
             return "no-error"
 
@@ -205,3 +207,64 @@ class TestMultiProcessWorldEight:
         for (tag, rank, n, pc, passed), want_rank in zip(results, (0, 4)):
             assert (tag, rank, n, pc) == ("t8", want_rank, 8, 2)
             assert passed == ALL_OPS
+
+
+def _frontend_battery():
+    """Frontend eager ops across a real process boundary: the stacked-rows
+    and splits-matrix contracts (local rows only) for torch/tf/mxnet."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    n = hvd.size()
+    results = []
+
+    # torch frontend
+    import torch
+    import horovod_tpu.torch as ht
+    t = torch.ones(3) * (hvd.rank() + 1)
+    out = ht.allreduce(t, op=ht.Sum)
+    # The host tensor replicates onto each local chip, so the reduction
+    # weights each process's value (its first local rank + 1) by its chip
+    # count; ownership is process-major contiguous.
+    per = n // hvd.process_count()
+    want = float(sum((pr * per + 1) * per
+                     for pr in range(hvd.process_count())))
+    assert torch.allclose(out, torch.full((3,), want)), (out, want)
+    results.append("torch_allreduce")
+
+    # torch alltoall with splits (uniform 1-row splits)
+    send = torch.arange(n * 2, dtype=torch.float32).reshape(n, 2)
+    rows, received = ht.alltoall(send, splits=[1] * n)
+    assert rows.shape == (n, 2)
+    assert received.tolist() == [1] * n
+    results.append("torch_alltoall_splits")
+
+    # mxnet duck-typed frontend (numpy NDArray stand-in)
+    import horovod_tpu.mxnet as hm
+    arr = np.ones((2, 2), np.float32)
+    out = hm.allreduce(arr, op=hm.Sum, name="mx")
+    np.testing.assert_allclose(out, np.full((2, 2), float(n)))
+    o2, rs = hm.alltoall(np.arange(n, dtype=np.float32)[:, None],
+                         splits=[1] * n)
+    assert rs.tolist() == [1] * n
+    results.append("mxnet_ops")
+
+    # tf frontend (eager + splits matrix contract)
+    import tensorflow as tf
+    import horovod_tpu.tensorflow as htf
+    o = htf.allreduce(tf.ones((2,)), op=htf.Sum)
+    np.testing.assert_allclose(o.numpy(), [n, n])
+    vals, rec = htf.alltoall(tf.reshape(
+        tf.range(n * 2, delta=1.0), (n, 2)), splits=[1] * n)
+    assert rec.numpy().tolist() == [1] * n
+    results.append("tf_ops")
+
+    return (hvd.rank(), results)
+
+
+class TestMultiProcessFrontends:
+    def test_frontend_contracts_two_processes(self):
+        results = run(_frontend_battery, hosts="localhost:2,127.0.0.1:2")
+        want = ["torch_allreduce", "torch_alltoall_splits", "mxnet_ops",
+                "tf_ops"]
+        assert [r[1] for r in results] == [want, want]

@@ -341,3 +341,20 @@ class TestKeras:
         model.save(path)
         loaded = hvd_keras.load_model(path)
         assert getattr(loaded.optimizer, "_hvd_wrapped", False)
+
+
+class TestGroupsOversubscribed:
+    def test_more_groups_than_gradients(self, hvd):
+        """groups > live gradients must not crash on empty chunks."""
+        import tensorflow as tf
+        import horovod_tpu.tensorflow as htf
+        vs = [tf.Variable([1.0, 2.0]), tf.Variable([3.0])]
+        grads = [tf.constant([0.5, 0.5]), tf.constant([1.0])]
+        fn = htf._make_allreduce_grads_fn(
+            op=htf.Sum, gradient_predivide_factor=1.0,
+            compression=htf.Compression.none, sparse_as_dense=False,
+            process_set=None, groups=8)
+        out = fn(grads, vs)
+        n = hvd.size()
+        assert [o.numpy().tolist() for o in out] == [
+            [0.5 * n, 0.5 * n], [1.0 * n]]
