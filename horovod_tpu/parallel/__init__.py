@@ -1,5 +1,6 @@
 from horovod_tpu.parallel.dp import (  # noqa: F401
-    make_train_step, make_eval_step, TrainState,
+    make_train_step, make_eval_step, make_zero_train_step, TrainState,
+    ZeroTrainState,
 )
 from horovod_tpu.parallel.strategies import (  # noqa: F401
     allreduce_hierarchical, allreduce_torus,

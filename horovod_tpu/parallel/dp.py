@@ -24,7 +24,9 @@ Two idioms are supported:
 from typing import Any, Callable
 
 import jax
+import jax.flatten_util
 import jax.numpy as jnp
+import numpy as np
 import optax
 from flax import struct
 from jax import lax
@@ -122,3 +124,106 @@ def make_eval_step(eval_fn: Callable, mesh, axis_name=HVD_AXIS,
                             in_specs=(P(), batch_spec), out_specs=P(),
                             check_vma=False)
     return jax.jit(sharded)
+
+
+def make_zero_train_step(loss_fn: Callable, tx, mesh, axis_name=HVD_AXIS,
+                         batch_spec=None, has_aux=False, donate=True,
+                         average=True):
+    """DP train step with ZeRO-1 optimizer-state sharding over the DP axis.
+
+    Beyond reference parity (the reference replicates optimizer state on
+    every worker, like every Horovod job): gradients are REDUCE-SCATTERED
+    instead of all-reduced, each chip updates only its 1/n shard of the
+    (flattened) parameters with its 1/n shard of the optimizer state, and
+    the updated shards are all-gathered back — the same bytes on the wire
+    as an allreduce (RS + AG is how ring allreduce decomposes), but adamw
+    moment memory drops from 2×params to 2×params/n per chip.
+
+    ``tx`` is a plain optax transform (NOT DistributedOptimizer — the
+    reduction is fused into the scatter here). Transforms must be
+    elementwise over the flat parameter vector (sgd/momentum/adam/adamw/
+    rmsprop are; global-norm clipping is not, since a shard-local norm is
+    not the global norm).
+
+    Use ``ZeroTrainState.create(params, tx, mesh)`` for the matching state;
+    ``state.opt_state`` holds flat shard-shaped leaves.
+    """
+    if batch_spec is None:
+        batch_spec = P(axis_name)
+    n = int(np.prod([mesh.shape[a] for a in
+                     (axis_name if isinstance(axis_name, tuple)
+                      else (axis_name,))]))
+
+    def local_step(state, batch):
+        params = in_jit.mark_varying(state.params, axis_name)
+        opt_state = in_jit.mark_varying(state.opt_state, axis_name)
+        extra = in_jit.mark_varying(state.extra, axis_name)
+
+        if has_aux:
+            (loss, aux), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch, extra)
+        else:
+            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            aux = None
+
+        flat_g, _ = jax.flatten_util.ravel_pytree(grads)
+        flat_p, unravel = jax.flatten_util.ravel_pytree(params)
+        pad = (-flat_g.size) % n
+        flat_g = jnp.pad(flat_g, (0, pad))
+        # Fused reduce+shard: this chip receives the reduced shard
+        # [idx*L : (idx+1)*L] of the gradient.
+        g_shard = lax.psum_scatter(flat_g, axis_name, scatter_dimension=0,
+                                   tiled=True)
+        if average:
+            g_shard = g_shard / n
+        shard_len = flat_g.size // n
+        idx = lax.axis_index(axis_name)
+        p_shard = lax.dynamic_slice(jnp.pad(flat_p, (0, pad)),
+                                    (idx * shard_len,), (shard_len,))
+        updates, opt_state = tx.update(g_shard, opt_state, p_shard)
+        p_shard = optax.apply_updates(p_shard, updates)
+        flat_new = lax.all_gather(p_shard, axis_name, tiled=True)
+        params = unravel(flat_new[:flat_p.size])
+
+        loss = lax.pmean(loss, axis_name)
+        if has_aux:
+            aux = jax.tree_util.tree_map(
+                lambda a: lax.pmean(a, axis_name)
+                if jnp.issubdtype(a.dtype, jnp.floating) else a, aux)
+        return state.replace(step=state.step + 1, params=params,
+                             opt_state=opt_state,
+                             extra=aux if has_aux else state.extra), loss
+
+    # opt_state shards stay device-varying across steps: their specs carry
+    # the axis so each chip keeps only its 1/n moments. Vector leaves
+    # (moments) shard; scalar leaves (step counts) replicate.
+    opt_struct = jax.eval_shape(tx.init,
+                                jax.ShapeDtypeStruct((n,), jnp.float32))
+    opt_specs = jax.tree_util.tree_map(
+        lambda x: P(axis_name) if getattr(x, "ndim", 0) >= 1 else P(),
+        opt_struct)
+    state_specs = ZeroTrainState(step=P(), params=P(), opt_state=opt_specs,
+                                 extra=P())
+    sharded = jax.shard_map(
+        local_step, mesh=mesh,
+        in_specs=(state_specs, batch_spec),
+        out_specs=(state_specs, P()), check_vma=False)
+    return jax.jit(sharded, donate_argnums=(0,) if donate else ())
+
+
+class ZeroTrainState(TrainState):
+    """TrainState whose opt_state moment leaves are flat 1/n shards."""
+
+    @classmethod
+    def create(cls, params, tx, mesh, axis_name=HVD_AXIS, extra=None):
+        n = int(np.prod([mesh.shape[a] for a in
+                         (axis_name if isinstance(axis_name, tuple)
+                          else (axis_name,))]))
+        flat, _ = jax.flatten_util.ravel_pytree(params)
+        shard_len = (flat.size + (-flat.size) % n) // n
+        # GLOBAL moment arrays of n * shard_len: the sharded specs of
+        # make_zero_train_step lay 1/n on each chip, so per-chip memory is
+        # moments/n — the ZeRO-1 saving.
+        opt_state = tx.init(jnp.zeros((n * shard_len,), flat.dtype))
+        return cls(step=jnp.zeros((), jnp.int32), params=params,
+                   opt_state=opt_state, extra=extra)
