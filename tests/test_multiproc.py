@@ -268,3 +268,19 @@ class TestMultiProcessFrontends:
         want = ["torch_allreduce", "torch_alltoall_splits", "mxnet_ops",
                 "tf_ops"]
         assert [r[1] for r in results] == [want, want]
+
+
+def _negotiation_churn():
+    """Repeated same-tag exchanges: the lag-2 coordination-key deletion
+    must never remove a key a peer still needs."""
+    import horovod_tpu as hvd
+    out = None
+    for i in range(5):
+        out = hvd.allgather_object([i * 10 + hvd.rank()])
+    return out
+
+
+class TestNegotiationChurn:
+    def test_repeated_exchanges_with_key_gc(self):
+        results = run(_negotiation_churn, hosts="localhost:1,127.0.0.1:1")
+        assert results == [[40, 41], [40, 41]]
