@@ -93,6 +93,11 @@ def init(comm=None, process_sets=None, devices=None):
                 current = _current_coordinator()
                 if current == target:
                     replace = False  # our cluster already bootstrapped
+                    # Reused service ⇒ its KV store may hold the previous
+                    # incarnation's last (un-GC'd) negotiation keys; move
+                    # every participant to a fresh epoch namespace.
+                    from horovod_tpu.common import negotiation
+                    negotiation.bump_epoch()
                 else:
                     # A platform site hook pre-created a distributed client
                     # that doesn't belong to our cluster — replace it.
@@ -133,6 +138,10 @@ def init(comm=None, process_sets=None, devices=None):
                     coordinator_address=target,
                     num_processes=config.cross_size,
                     process_id=config.cross_rank, **kwargs)
+                # Fresh coordination service: empty KV store, epoch 0 for
+                # every participant (incl. replacement elastic workers).
+                from horovod_tpu.common import negotiation
+                negotiation.reset_epoch()
 
         topology = build_topology(devices)
         _state = _State(topology, config)

@@ -25,6 +25,13 @@ import jax
 
 _counters = {}
 _lock = threading.Lock()
+# Epoch namespace for the KV keys: bumped when an init REUSES a live
+# coordination service (its store may still hold the last two undeleted
+# keys per tag from the previous incarnation, see the lag-2 GC in
+# exchange()); reset to 0 when a fresh service is bootstrapped. All
+# processes follow the same init/shutdown sequence (SPMD contract), so
+# epochs stay in lockstep.
+_epoch = 0
 
 # Timeout for peers to publish their metadata. Generous: a peer may be
 # compiling its previous program.
@@ -57,6 +64,24 @@ def reset():
         _counters.clear()
 
 
+def bump_epoch():
+    """Move to a fresh key namespace: the coordination service is being
+    reused across ``hvd.init()`` incarnations and may still hold the
+    previous incarnation's keys."""
+    global _epoch
+    with _lock:
+        _epoch += 1
+
+
+def reset_epoch():
+    """Fresh coordination service bootstrapped: every participant (including
+    replacement elastic workers that never saw earlier epochs) starts at
+    epoch 0 against an empty store."""
+    global _epoch
+    with _lock:
+        _epoch = 0
+
+
 def exchange(tag, payload, procs=None):
     """Exchange a small JSON-serializable ``payload`` across processes.
 
@@ -79,7 +104,7 @@ def exchange(tag, payload, procs=None):
     proc_tag = ",".join(str(p) for p in procs)
     seq = _next_seq((tag, proc_tag))
     client = _client()
-    base = f"hvd/neg/{tag}/{proc_tag}/{seq}"
+    base = f"hvd/neg/e{_epoch}/{tag}/{proc_tag}/{seq}"
     client.key_value_set(f"{base}/{me}", json.dumps(payload))
     # Bound coordinator memory on long jobs: reaching seq s implies this
     # process completed exchange s-1, which required reading every peer's
@@ -88,7 +113,7 @@ def exchange(tag, payload, procs=None):
     if seq >= 2:
         try:
             client.key_value_delete(
-                f"hvd/neg/{tag}/{proc_tag}/{seq - 2}/{me}")
+                f"hvd/neg/e{_epoch}/{tag}/{proc_tag}/{seq - 2}/{me}")
         except Exception:  # deletion is best-effort housekeeping
             pass
     out = []

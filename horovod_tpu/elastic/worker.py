@@ -97,7 +97,10 @@ def read_new_rank_ready(timeout=600):
     if client is None or not os.environ.get("HOROVOD_ELASTIC"):
         return True
     version = _configured_version(client)
-    nhosts = int(client.get("elastic", "nhosts") or
+    # Version-scoped count: pairing v's ready marks with v+1's host count
+    # would release the barrier early on a scale-down.
+    nhosts = int(client.get("elastic", f"nhosts/{version}") or
+                 client.get("elastic", "nhosts") or
                  os.environ.get("HOROVOD_CROSS_SIZE", "1"))
     import time
     deadline = time.time() + timeout

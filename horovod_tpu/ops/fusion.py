@@ -279,7 +279,6 @@ class FusionRuntime:
             for tid, t, op, pre, post, h in pending:
                 key = self._bucket_key(t, op, pre, post)
                 buckets.setdefault((op, pre, post, key[-1]), []).append((t, h))
-        tl = basics.timeline()
         from horovod_tpu.common.process_sets import global_process_set
         from horovod_tpu.ops.collective_ops import _active_mask
         active_mask = _active_mask(global_process_set)
@@ -296,14 +295,17 @@ class FusionRuntime:
                     hash((op, pre, post, shapes, dtypes)))
             prog = _fused_program(mesh, n, op, pre, post, shapes, dtypes,
                                   self.wire_dtype, active_mask)
-            if tl is not None:
-                with tl.op_span(f"fused_allreduce[{len(items)}]", "ALLREDUCE"):
-                    outs = prog(*tensors)
-            else:
+            # _timeline_op supplies BOTH the timeline span and the
+            # transport-failure → HorovodInternalError translation: a peer
+            # dying mid fused collective must be recoverable by the elastic
+            # @run wrapper exactly like the sync ops (the async path is the
+            # DistributedOptimizer hot path).
+            from horovod_tpu.ops.collective_ops import _timeline_op
+            with _timeline_op(f"fused_allreduce[{len(items)}]", "ALLREDUCE"):
                 outs = prog(*tensors)
-            # Multi-process: hand back this process's local rows, matching
-            # the sync ops' contract.
-            outs = _localize(list(outs), mesh)
+                # Multi-process: hand back this process's local rows,
+                # matching the sync ops' contract.
+                outs = _localize(list(outs), mesh)
             for (_, h), o in zip(items, outs):
                 h._set(o)
 

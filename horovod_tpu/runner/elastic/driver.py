@@ -184,6 +184,11 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
 
     from horovod_tpu.runner.secret import SECRET_ENV, make_secret_key
     _os.environ.setdefault(SECRET_ENV, make_secret_key())
+    # Driver-side knobs (HostState cooldowns etc.) read the DRIVER's env;
+    # set_env_from_args otherwise only reaches the per-worker env dicts, so
+    # flags like --blacklist-cooldown-range would silently stay defaulted.
+    from horovod_tpu.runner.config_parser import set_env_from_args
+    set_env_from_args(_os.environ, args)
     kv = KVStoreServer()
     kv_port = kv.start()
     for (scope, key), value in (kv_preload or {}).items():
@@ -251,14 +256,16 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
         for w in removed_workers:
             w.terminate()
         # Results are version-scoped (a stale write can't pollute the final
-        # harvest); dropping the scope here is garbage collection of
-        # superseded memberships' results. Assignment rows and ready marks
-        # are pruned to the previous + new version — a worker that read the
-        # previous version string just before this bump can still fetch its
-        # row — bounding KV growth under membership churn.
-        kv.delete("results")
+        # harvest); pruning here is garbage collection of superseded
+        # memberships' results — NOT a blanket delete: a worker finishing
+        # under the previous version concurrently with this rebalance must
+        # not lose its result row (its finished marker may land between
+        # spawn()'s probe and now). Assignment rows and ready marks are
+        # pruned to the previous + new version likewise — a worker that
+        # read the previous version string just before this bump can still
+        # fetch its row — bounding KV growth under membership churn.
         keep = (f"{version}/", f"{version - 1}/")
-        for scope in ("assignment", "new_rank_ready"):
+        for scope in ("results", "assignment", "new_rank_ready"):
             kv.prune_scope(scope, keep)
         # Assignment rows and nhosts must land before the version bump:
         # surviving workers re-rendezvous the moment they observe the bump
@@ -275,7 +282,22 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
                 "cross_size": first.cross_size,
                 "coordinator_port": coordinator_port,
             }).encode())
+        # Version-scoped host count: a worker configured for version v must
+        # never pair v's ready marks with v+1's count (premature barrier
+        # release on scale-down). The unscoped key stays for the final
+        # harvest (api._elastic_harvester).
+        kv.put("elastic", f"nhosts/{version}", str(len(by_host)).encode())
+        kv.delete("elastic", f"nhosts/{version - 2}")
         kv.put("elastic", "nhosts", str(len(by_host)).encode())
+        # Last-moment finished re-check, atomic with the bump from the
+        # workers' perspective (they only act on the version write): a
+        # worker that completed during this rebalance must not be counted
+        # as a survivor of a membership it will never join — that would
+        # wedge the others at the new-rank barrier.
+        if kv.get("elastic", "finished"):
+            hvd_logging.info(
+                "aborting spawn v%d: job finished during rebalance", version)
+            return
         kv.put("elastic", "version", str(version).encode())
         for host, slots in by_host.items():
             if host in survivors:

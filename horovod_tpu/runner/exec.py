@@ -31,6 +31,33 @@ def _stream(proc, tag, out, sink=None, prefix_timestamp=False):
         sink.close()
 
 
+def build_launch_command(hostname, command, env, local, ssh_port=None,
+                         ssh_identity_file=None):
+    """Returns ``(argv, run_env, secret_env)``. Secrets must NEVER ride the
+    remote argv — /proc/*/cmdline is world-readable on the worker host
+    (the reference likewise keeps its per-job key off the command line:
+    runner/common/util/secret.py travels through the task-service
+    channel) — so env vars whose name contains SECRET are shipped over ssh
+    stdin (``read -r`` before exec) instead of inline ``env`` assignments."""
+    if local:
+        return command, {**os.environ, **env}, {}
+    ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
+    if ssh_port:
+        ssh += ["-p", str(ssh_port)]
+    if ssh_identity_file:
+        ssh += ["-i", ssh_identity_file]
+    secret_env = {k: v for k, v in env.items() if "SECRET" in k}
+    plain = {k: v for k, v in env.items() if "SECRET" not in k}
+    exports = " ".join(f"{k}={shlex.quote(v)}" for k, v in plain.items())
+    reads = "".join(f"read -r {k} && export {k} && "
+                    for k in sorted(secret_env))
+    full = ssh + [hostname,
+                  f"cd {shlex.quote(os.getcwd())} && {reads}"
+                  f"env {exports} "
+                  + " ".join(shlex.quote(c) for c in command)]
+    return full, os.environ.copy(), secret_env
+
+
 class WorkerProcess:
     def __init__(self, hostname, command, env, tag, use_ssh=None,
                  ssh_port=None, ssh_identity_file=None, output_dir=None,
@@ -53,25 +80,17 @@ class WorkerProcess:
         local = (hostname in ("localhost", "::1", os.uname().nodename)
                  or hostname.startswith("127.")) \
             if use_ssh is None else not use_ssh
-        if local:
-            full = command
-            run_env = {**os.environ, **env}
-        else:
-            ssh = ["ssh", "-o", "StrictHostKeyChecking=no"]
-            if ssh_port:
-                ssh += ["-p", str(ssh_port)]
-            if ssh_identity_file:
-                ssh += ["-i", ssh_identity_file]
-            exports = " ".join(f"{k}={shlex.quote(v)}"
-                               for k, v in env.items())
-            full = ssh + [hostname,
-                          f"cd {shlex.quote(os.getcwd())} && env {exports} "
-                          + " ".join(shlex.quote(c) for c in command)]
-            run_env = os.environ.copy()
+        full, run_env, secret_env = build_launch_command(
+            hostname, command, env, local, ssh_port, ssh_identity_file)
         hvd_logging.debug("launching worker %s: %s", tag, full)
-        self.proc = subprocess.Popen(full, env=run_env,
-                                     stdout=subprocess.PIPE,
-                                     stderr=subprocess.STDOUT)
+        self.proc = subprocess.Popen(
+            full, env=run_env,
+            stdin=subprocess.PIPE if secret_env else None,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT)
+        if secret_env:
+            for k in sorted(secret_env):
+                self.proc.stdin.write(secret_env[k].encode() + b"\n")
+            self.proc.stdin.close()
         self._thread = threading.Thread(
             target=_stream, args=(self.proc, tag, sys.stdout, self._sink,
                                   prefix_timestamp), daemon=True)

@@ -59,8 +59,14 @@ class _KVHandler(BaseHTTPRequestHandler):
         with self.server.lock:
             value = store.get(scope, {}).get(key)
         if value is None:
+            # 404 is an actionable signal (elastic workers treat a missing
+            # assignment row as "removed from membership"), so it must be
+            # as tamper-evident as a 200.
             self.send_response(404)
             self.send_header("Content-Length", "0")
+            if self.server.secret:
+                self.send_header(SIG_HEADER, compute_digest(
+                    self.server.secret, b"RESP404", self.path.encode(), b""))
             self.end_headers()
             return
         self.send_response(200)
@@ -183,6 +189,11 @@ class KVStoreClient:
                 return value
         except urlerror.HTTPError as e:
             if e.code == 404:
+                if self._secret and not check_digest(
+                        self._secret, e.headers.get(SIG_HEADER, ""),
+                        b"RESP404", path.encode(), b""):
+                    raise PermissionError(
+                        f"unsigned/tampered KV 404 for {path}") from e
                 return None
             raise
 

@@ -447,3 +447,27 @@ class TestInJit:
                                            rtol=1e-5)
         finally:
             hvd.remove_process_set(ps)
+
+
+class TestAsyncTransportTranslation:
+    def test_fused_flush_translates_transport_errors(self, hvd, monkeypatch):
+        """A peer dying mid fused collective must surface as
+        HorovodInternalError on the async/DistributedOptimizer hot path,
+        exactly like the sync ops, so elastic recovery can engage."""
+        import jax
+        import horovod_tpu.ops.fusion as fusion
+        from horovod_tpu.common.exceptions import HorovodInternalError
+
+        def boom(*a, **k):
+            def prog(*xs):
+                raise ValueError(
+                    "UNAVAILABLE: Gloo all-reduce failed: Connection "
+                    "closed by peer")
+            return prog
+
+        monkeypatch.setattr(fusion, "_fused_program", boom)
+        monkeypatch.setattr(jax, "process_count", lambda: 2)
+        h = hvd.allreduce_async(np.ones((hvd.size(), 2), np.float32),
+                                op=hvd.Sum)
+        with pytest.raises(HorovodInternalError):
+            h.synchronize()
