@@ -56,13 +56,14 @@ class ComputeServiceConfig:
         os.rename(w.name, filename)
 
     @staticmethod
-    def read(filename, wait_for_file_creation=False):
+    def read(filename, wait_for_file_creation=False, timeout=120):
         import os
         import time
-        deadline = time.time() + 120
+        deadline = time.time() + timeout
         while wait_for_file_creation and not os.path.exists(filename):
             if time.time() > deadline:
-                raise TimeoutError(f"config file {filename} never appeared")
+                raise TimeoutError(f"config file {filename} never appeared "
+                                   f"within {timeout}s")
             time.sleep(0.2)
         with open(filename) as r:
             return ComputeServiceConfig.from_dict(json.loads(r.read()))

@@ -68,7 +68,8 @@ def main(argv=None):
         cfg.write(args.configfile)
     else:
         cfg = ComputeServiceConfig.read(args.configfile,
-                                        wait_for_file_creation=True)
+                                        wait_for_file_creation=True,
+                                        timeout=args.timeout)
 
     worker = DataWorker(cfg, shard=rank, dataset_fn=dataset_fn)
     worker.start()

@@ -131,9 +131,7 @@ def DistributedOptimizer(optimizer, name=None,
                 grads = self._hvd_accumulate(grads)
                 if grads is None:
                     return None  # mid-accumulation: no variable update
-            def _key(v):
-                # Keras-3 Variables have no tf ref(); fall back to identity.
-                return v.ref() if hasattr(v, "ref") else id(v)
+            _key = hvd_tf.var_key
 
             local_refs = set()
             for layer in (local_layers or []):

@@ -5,10 +5,11 @@ docs/benchmarks.rst:12-13 — ~68 % scaling efficiency at 512 GPUs; the
 tf_cnn_benchmarks procedure of docs/benchmarks.rst:15-64).
 
 TPU-first choices: bfloat16 activations with fp32 params (MXU native dtype),
-channels-last NHWC (XLA TPU's preferred conv layout), global-average head by
-default instead of the 7x7x512->4096 flatten (identical conv trunk, far
-smaller all-reduced gradient; ``classic_head=True`` restores the exact
-138M-param original for parity benchmarking).
+channels-last NHWC (XLA TPU's preferred conv layout). The default
+``classic_head=True`` keeps the published 7x7x512→4096 flatten head (exact
+138M-param VGG-16, what the reference benchmarks); ``classic_head=False``
+swaps in a global-average head — identical conv trunk with ~120M fewer
+all-reduced parameters — for training-efficiency work.
 """
 
 from functools import partial

@@ -215,8 +215,6 @@ class TpuEstimator(SparkParamsMixin):
 
         data_path = write_dataframe_dataset(self.store, df)
         run_id = self.run_id or self.store.new_run_id()
-        ckpt_dir = self.store.get_checkpoint_path(run_id)
-        self.store.make_dirs(ckpt_dir)
 
         # global batches: n shards of batch_size each
         global_bs = self.batch_size * n
@@ -238,16 +236,8 @@ class TpuEstimator(SparkParamsMixin):
         # a local dir and syncs per epoch (pull on resume, push after save)
         # — same durability contract as the reference's HDFSStore
         # checkpoints (store.py:402-540).
-        remote = not getattr(self.store, "is_local", True)
-        if remote:
-            import tempfile
-            local_ckpt = os.path.join(tempfile.gettempdir(),
-                                      f"hvd_est_ckpt_{run_id}")
-            if self.store.exists(ckpt_dir) and not os.path.isdir(local_ckpt):
-                os.makedirs(local_ckpt, exist_ok=True)
-                self.store.download_dir(ckpt_dir, local_ckpt)
-        else:
-            local_ckpt = os.path.abspath(ckpt_dir)
+        from horovod_tpu.spark.store import stage_checkpoints
+        local_ckpt, sync_ckpt = stage_checkpoints(self.store, run_id)
         mgr = CheckpointManager(local_ckpt)
         if mgr.has_checkpoint():
             state = mgr.restore(template=state, mesh=mesh)
@@ -271,8 +261,7 @@ class TpuEstimator(SparkParamsMixin):
                 losses.append(float(jax.device_get(loss)))
             history.append(float(np.mean(losses)) if losses else float("nan"))
             mgr.save(start_step + epoch + 1, state)
-            if remote:
-                self.store.upload_dir(local_ckpt, ckpt_dir)
+            sync_ckpt()
         mgr.close()
 
         return TpuModel(model=self.model, params=state.params,

@@ -74,10 +74,12 @@ class KerasEstimator(SparkParamsMixin):
                                      self.label_cols)
 
         run_id = self.run_id or self.store.new_run_id()
-        ckpt_dir = self.store.get_checkpoint_path(run_id)
-        self.store.make_dirs(ckpt_dir)
-        ckpt_file = os.path.join(ckpt_dir, "model.keras")
-        meta_file = os.path.join(ckpt_dir, "fit_state.json")
+        # Local staging (remote stores pull existing checkpoints first and
+        # push after save): model.save/open only ever touch local paths.
+        from horovod_tpu.spark.store import stage_checkpoints
+        local_dir, sync_ckpt = stage_checkpoints(self.store, run_id)
+        ckpt_file = os.path.join(local_dir, "model.keras")
+        meta_file = os.path.join(local_dir, "fit_state.json")
 
         model = self.model
         initial_epoch = 0
@@ -101,6 +103,7 @@ class KerasEstimator(SparkParamsMixin):
         model.save(ckpt_file)
         with open(meta_file, "w") as f:
             json.dump({"epoch": self.epochs}, f)
+        sync_ckpt()
         return KerasModel(model, self.feature_cols, self.label_cols,
                           history=history.history, run_id=run_id)
 

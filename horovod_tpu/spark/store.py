@@ -147,8 +147,6 @@ class HDFSStore(Store):
         self.prefix_path = prefix_path
         self._train_path = self._join("intermediate_train_data")
         self._val_path = self._join("intermediate_val_data")
-        self._checkpoint_base = self._join("checkpoints")
-        self._logs_base = self._join("logs")
 
     def _join(self, *parts):
         # Full URIs (authority included) so consumers that resolve paths
@@ -245,3 +243,30 @@ def host_hash():
 
 # Reference-parity alias: the reference renamed its filesystem base class.
 AbstractFilesystemStore = FilesystemStore
+
+
+def stage_checkpoints(store, run_id):
+    """Local checkpoint staging for a run: returns ``(local_dir, sync)``.
+
+    Estimators do file I/O (orbax, model.save, torch.save) against LOCAL
+    paths only; for a remote store (HDFS/DBFS) this stages through a temp
+    dir — existing remote checkpoints are pulled down first (the remote dir
+    is the source of truth: a stale local leftover from an earlier crash
+    must never shadow, then clobber, newer remote state) and ``sync()``
+    pushes the dir back after each save. For local stores ``sync`` is a
+    no-op and the store path is used directly. Reference durability
+    contract: store.py:402-540 HDFSStore checkpoints.
+    """
+    import tempfile
+
+    ckpt_dir = store.get_checkpoint_path(run_id)
+    store.make_dirs(ckpt_dir)
+    if getattr(store, "is_local", True):
+        return os.path.abspath(ckpt_dir), (lambda: None)
+    local = os.path.join(tempfile.gettempdir(), f"hvd_est_ckpt_{run_id}")
+    if os.path.isdir(local):
+        shutil.rmtree(local)
+    os.makedirs(local, exist_ok=True)
+    if store.exists(ckpt_dir):
+        store.download_dir(ckpt_dir, local)
+    return local, (lambda: store.upload_dir(local, ckpt_dir))

@@ -70,9 +70,11 @@ class TorchEstimator(SparkParamsMixin):
 
         X, y = self._materialize(df)
         run_id = self.run_id or self.store.new_run_id()
-        ckpt_dir = self.store.get_checkpoint_path(run_id)
-        self.store.make_dirs(ckpt_dir)
-        ckpt_file = os.path.join(ckpt_dir, "model.pt")
+        # Local staging (remote stores pull existing checkpoints first and
+        # push after each save): torch.load/save only touch local paths.
+        from horovod_tpu.spark.store import stage_checkpoints
+        local_dir, sync_ckpt = stage_checkpoints(self.store, run_id)
+        ckpt_file = os.path.join(local_dir, "model.pt")
 
         model = self.model
         opt = DistributedOptimizer(
@@ -111,6 +113,7 @@ class TorchEstimator(SparkParamsMixin):
             history.append(epoch_loss)
             torch.save({"model": model.state_dict(), "epoch": epoch + 1},
                        ckpt_file)
+            sync_ckpt()
             if self.verbose:
                 print(f"[TorchEstimator] epoch {epoch}: loss={epoch_loss}")
         return TorchModel(model, self.feature_cols, self.label_cols,

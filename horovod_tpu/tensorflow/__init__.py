@@ -113,6 +113,14 @@ def _ps(process_set):
     return process_set if process_set is not None else C.global_process_set
 
 
+def var_key(v):
+    """Hashable identity for a variable: tf Variables expose ``ref()``;
+    Keras-3 backend Variables don't — fall back to object identity. Shared
+    by the tape's local-source bookkeeping and the Keras optimizer's
+    local-layer / groups bookkeeping so they cannot drift."""
+    return v.ref() if hasattr(v, "ref") else id(v)
+
+
 def _in_graph(tensor):
     """True when building a tf.function/graph: every input (symbolic
     tensors, Variables, python/numpy values) must ride the host-callback op
@@ -571,7 +579,7 @@ class DistributedGradientTape:
         """Mark a source (tf.Variable) worker-local: its gradient stays
         local instead of being allreduced (reference:
         PartialDistributedGradientTape, tensorflow/__init__.py:1110+)."""
-        self._local_sources.add(source.ref())
+        self._local_sources.add(var_key(source))
 
     def __enter__(self):
         self._tape.__enter__()
@@ -603,7 +611,7 @@ class DistributedGradientTape:
             if not self._local_sources or i >= len(src_list):
                 return False
             s = src_list[i]
-            return hasattr(s, "ref") and s.ref() in self._local_sources
+            return var_key(s) in self._local_sources
 
         reduce_idx = [i for i, g in enumerate(grads)
                       if g is not None and not _is_local(i)]
@@ -678,10 +686,10 @@ def _make_allreduce_grads_fn(op, gradient_predivide_factor, compression,
             by_ref = {}
             for gi, group in enumerate(groups):
                 for v in group:
-                    by_ref[v.ref()] = gi
+                    by_ref[var_key(v)] = gi
             chunks_map = {}
             for i in live_idx:
-                key = by_ref.get(variables[i].ref(), f"solo{i}")
+                key = by_ref.get(var_key(variables[i]), f"solo{i}")
                 chunks_map.setdefault(key, []).append(i)
             chunks = list(chunks_map.values())
         else:
@@ -767,7 +775,7 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
                 self._agg_helper = None
 
         def register_local_var(self, var):
-            self._local_vars.add(var.ref())
+            self._local_vars.add(var_key(var))
             if self._agg_helper:
                 self._agg_helper.register_local_var(var)
 
@@ -779,7 +787,7 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
                 avg = self._agg_helper.compute_gradients(grads, variables)
             else:
                 reduce_idx = [i for i, v in enumerate(variables)
-                              if v.ref() not in self._local_vars]
+                              if var_key(v) not in self._local_vars]
                 reduced = allreduce_grads([grads[i] for i in reduce_idx],
                                           [variables[i] for i in reduce_idx])
                 avg = list(grads)
@@ -788,7 +796,7 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
                 if scale_local_gradients and self._local_vars:
                     n = self.process_set.size()
                     for i, v in enumerate(variables):
-                        if v.ref() in self._local_vars \
+                        if var_key(v) in self._local_vars \
                                 and avg[i] is not None:
                             avg[i] = avg[i] / n
             return list(zip(avg, variables))

@@ -34,11 +34,13 @@ class LocalGradientAggregationHelper:
         self.counter = None
         self.locally_aggregated_grads = []
         self._local_vars = set()
+        self._flush_pred = None
 
     def register_local_var(self, var):
         """Mark ``var`` worker-local: its gradient is never allreduced
         (reference: gradient_aggregation.py:81-88)."""
-        self._local_vars.add(var.ref())
+        from horovod_tpu.tensorflow import var_key
+        self._local_vars.add(var_key(var))
 
     def _densify(self, grad):
         import tensorflow as tf
@@ -52,15 +54,19 @@ class LocalGradientAggregationHelper:
 
     def _init_vars(self, grads):
         import tensorflow as tf
-        if self.counter is not None:
-            return
-        self.counter = tf.Variable(0, dtype=tf.int32, trainable=False,
-                                   name=f"hvd_agg_counter_{self.rank}")
+        if self.counter is None:
+            self.counter = tf.Variable(0, dtype=tf.int32, trainable=False,
+                                       name=f"hvd_agg_counter_{self.rank}")
+            self.locally_aggregated_grads = [None] * len(grads)
+        # Lazy per-slot accumulators: a variable whose gradient first
+        # appears on a LATER pass (conditionally-active branch) still gets
+        # one — fixing it to None on the first call would silently stop
+        # that variable from ever training.
         for i, g in enumerate(grads):
-            self.locally_aggregated_grads.append(
-                None if g is None else tf.Variable(
+            if g is not None and self.locally_aggregated_grads[i] is None:
+                self.locally_aggregated_grads[i] = tf.Variable(
                     tf.zeros_like(g), trainable=False,
-                    name=f"hvd_agg_grad_{self.rank}_{i}"))
+                    name=f"hvd_agg_grad_{self.rank}_{i}")
 
     def compute_gradients(self, grads, vars=None):
         """Accumulate ``grads``; on every ``backward_passes_per_step``-th
@@ -72,19 +78,25 @@ class LocalGradientAggregationHelper:
         self._init_vars(grads)
         vars = list(vars) if vars is not None else [None] * len(grads)
 
-        for acc, g in zip(self.locally_aggregated_grads, grads):
-            if acc is not None and g is not None:
-                acc.assign_add(g)
-        self.counter.assign_add(1)
+        # Collect the assign ops and gate everything downstream on them:
+        # in a TF1 Session graph an un-depended-on assign_add never runs
+        # (eager executes immediately; tf.function auto-chains stateful
+        # ops; only the legacy graph path needs the explicit edge).
+        updates = [acc.assign_add(g)
+                   for acc, g in zip(self.locally_aggregated_grads, grads)
+                   if acc is not None and g is not None]
+        updates.append(self.counter.assign_add(1))
 
         def _flush():
             scale = (1.0 / self.backward_passes_per_step
                      if self.average_aggregated_gradients else 1.0)
             dense = [None if a is None else a * scale
                      for a in self.locally_aggregated_grads]
+            from horovod_tpu.tensorflow import var_key
             reduce_idx = [i for i, (d, v) in enumerate(zip(dense, vars))
                           if d is not None
-                          and (v is None or v.ref() not in self._local_vars)]
+                          and (v is None
+                               or var_key(v) not in self._local_vars)]
             reduced = self._allreduce_grads(
                 [dense[i] for i in reduce_idx],
                 [vars[i] for i in reduce_idx])
@@ -99,7 +111,8 @@ class LocalGradientAggregationHelper:
                       else global_process_set)
                 n = ps.size()
                 for i, v in enumerate(vars):
-                    if v is not None and v.ref() in self._local_vars \
+                    if v is not None \
+                            and var_key(v) in self._local_vars \
                             and out[i] is not None:
                         out[i] = out[i] / n
             return [tf.zeros_like(g) if o is None else o
@@ -108,9 +121,12 @@ class LocalGradientAggregationHelper:
         def _hold():
             return [tf.zeros_like(g) for g in grads if g is not None]
 
-        flushed = tf.cond(
-            tf.equal(self.counter % self.backward_passes_per_step, 0),
-            _flush, _hold)
+        with tf.control_dependencies(updates):
+            pred = tf.equal(self.counter % self.backward_passes_per_step, 0)
+            # Stashed for apply_gradients: reading the counter fresh there
+            # would not be ordered after the updates in a legacy graph.
+            self._flush_pred = pred
+            flushed = tf.cond(pred, _flush, _hold)
         it = iter(flushed)
         return [None if g is None else next(it) for g in grads]
 
@@ -134,6 +150,7 @@ class LocalGradientAggregationHelper:
             with tf.control_dependencies([op] if tf.is_tensor(op) else []):
                 return _clear()
 
-        return tf.cond(
-            tf.equal(self.counter % self.backward_passes_per_step, 0),
-            _apply, lambda: tf.constant(False))
+        pred = (self._flush_pred if self._flush_pred is not None
+                else tf.equal(self.counter % self.backward_passes_per_step,
+                              0))
+        return tf.cond(pred, _apply, lambda: tf.constant(False))

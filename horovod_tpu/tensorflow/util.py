@@ -6,31 +6,6 @@ local-variable bookkeeping in DistributedOptimizer/PartialDistributedGradientTap
 reference: tensorflow/util.py:77-95).
 """
 
-import functools
-
-
-def _executing_eagerly():
-    import tensorflow as tf
-    return tf.executing_eagerly()
-
-
-def _cache(f):
-    cache = {}
-
-    @functools.wraps(f)
-    def wrapper(*args):
-        key = (args, _executing_eagerly())
-        if key not in cache:
-            cache[key] = f(*args)
-        return cache[key]
-
-    return wrapper
-
-
-def _make_subgraph(f):
-    import tensorflow as tf
-    return tf.function(f)
-
 
 def vars_to_refs(vars_):
     """Map (nested) tf.Variables to hashable refs (reference:

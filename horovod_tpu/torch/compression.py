@@ -61,5 +61,9 @@ class Compressor:
 
 
 # Reference-parity aliases (reference: torch/compression.py class names).
-NoneCompressor = _NoneCompressor
-FP16Compressor = _CastCompressor
+# Bound INSTANCES, not the raw classes: reference code passes these directly
+# as `compression=hvd.FP16Compressor`, so they must be usable as-is
+# (_CastCompressor itself needs a dtype getter at construction).
+NoneCompressor = Compression.none
+FP16Compressor = Compression.fp16
+BF16Compressor = Compression.bf16
