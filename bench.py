@@ -299,16 +299,29 @@ def main():
     return _bench_image(hvd, model_sel)
 
 
+def _failure_metric():
+    """Failure-record metric name for the SELECTED benchmark, so a BERT/GPT
+    failure never reads as a resnet50 regression."""
+    sel = os.environ.get("HVD_BENCH_MODEL", "resnet50")
+    if sel == "bert":
+        return "bert_large_seqs_per_sec_per_chip", "sequences/sec/chip"
+    if sel == "gpt":
+        return "gpt2_small_tokens_per_sec_per_chip", "tokens/sec/chip"
+    name = sel if sel in _IMAGE_MODELS else "resnet50"
+    return f"{name}_images_per_sec_per_chip", "images/sec/chip"
+
+
 if __name__ == "__main__":
     try:
         sys.exit(main())
     except Exception as e:  # noqa: BLE001
         # Emit a parseable failure record so the round is never scored blind.
+        metric, unit = _failure_metric()
         print(json.dumps({
-            "metric": "resnet50_images_per_sec_per_chip",
+            "metric": metric,
             "value": 0.0,
-            "unit": "images/sec/chip",
+            "unit": unit,
             "vs_baseline": 0.0,
-            "error": str(e).splitlines()[0][:200],
+            "error": (str(e).splitlines() or ["?"])[0][:200] or repr(e)[:200],
         }))
         sys.exit(1)

@@ -95,18 +95,22 @@ class ObjectState(State):
         super().__init__(**kwargs)
 
     def save(self):
-        new_state = {}
-        for attr in self._saved_state.keys():
-            # deepcopy for python-object semantics; under an elastic launch
-            # additionally device_get so the snapshot lives in host memory —
-            # a membership change tears the XLA backend down and device
-            # buffers with it.
-            snap = copy.deepcopy(getattr(self, attr))
-            if _elastic_launch():
-                import jax
-                snap = jax.device_get(snap)
-            new_state[attr] = snap
-        self._saved_state = new_state
+        import jax
+
+        def _snap(x):
+            # jax arrays are immutable — a reference IS a snapshot; under
+            # an elastic launch pull to host instead (membership changes
+            # tear the XLA backend and device buffers down). Anything else
+            # (torch tensors, python objects) keeps deepcopy semantics;
+            # device_get must never touch those — __array__ coercion would
+            # silently hand back numpy (or raise on device tensors).
+            if isinstance(x, jax.Array):
+                return jax.device_get(x) if _elastic_launch() else x
+            return copy.deepcopy(x)
+
+        self._saved_state = {
+            attr: jax.tree_util.tree_map(_snap, getattr(self, attr))
+            for attr in self._saved_state.keys()}
 
     def restore(self):
         for attr, value in self._saved_state.items():
@@ -180,14 +184,14 @@ def run(func):
     """
 
     def wrapper(state, *args, **kwargs):
-        from horovod_tpu.elastic.worker import (current_version,
+        from horovod_tpu.elastic.worker import (configured_version,
                                                 mark_new_rank_ready,
                                                 read_new_rank_ready,
                                                 wait_for_version_change)
         reset_required = False
         skip_sync = False
         while True:
-            known_version = current_version()
+            known_version = configured_version()
             try:
                 if reset_required:
                     _reset(state)
@@ -203,7 +207,7 @@ def run(func):
                 if not skip_sync:
                     state.sync()
                 skip_sync = False
-                known_version = current_version()
+                known_version = configured_version()
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
                 hvd_logging.warning(

@@ -59,6 +59,8 @@ def _configured_version(client):
     v = os.environ.get("HOROVOD_ELASTIC_INIT_VERSION")
     if v is not None:
         return v
+    if client is None:
+        return "0"
     return (client.get("elastic", "version") or b"0").decode()
 
 
@@ -97,10 +99,13 @@ def read_new_rank_ready(timeout=600):
     if client is None or not os.environ.get("HOROVOD_ELASTIC"):
         return True
     version = _configured_version(client)
-    # Version-scoped count: pairing v's ready marks with v+1's host count
-    # would release the barrier early on a scale-down.
+    # Version-scoped count: pairing v's ready marks with a NEWER version's
+    # host count would release the barrier early on a scale-down. When the
+    # scoped row is gone (driver GC'd it — we lag 2+ versions behind), this
+    # worker's membership is stale by construction: fall back to its OWN
+    # spawn-time world size (env, same version as `version`), never to the
+    # unscoped latest count.
     nhosts = int(client.get("elastic", f"nhosts/{version}") or
-                 client.get("elastic", "nhosts") or
                  os.environ.get("HOROVOD_CROSS_SIZE", "1"))
     import time
     deadline = time.time() + timeout
@@ -144,6 +149,16 @@ def current_version():
     if client is None:
         return "0"
     return (client.get("elastic", "version") or b"0").decode()
+
+
+def configured_version():
+    """The membership version this worker is RUNNING at (env-first; see
+    :func:`_configured_version`). The recovery loop must key its
+    wait-for-change on this, not on a live KV read — a bump published just
+    after the barrier would otherwise be stored as 'known', and the loop
+    would then wait a full timeout for a version newer than the one it
+    never joined."""
+    return _configured_version(_kv_client())
 
 
 def refresh_assignment_env():

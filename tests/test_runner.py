@@ -414,6 +414,9 @@ class TestElasticState:
             monkeypatch.setenv("HOROVOD_KV_ADDR", "localhost")
             monkeypatch.setenv("HOROVOD_KV_PORT", str(port))
             srv.put("elastic", "version", b"3")
+            # The barrier reads the VERSION-SCOPED count (the driver writes
+            # both; unscoped serves only the final harvest).
+            srv.put("elastic", "nhosts/3", b"2")
             srv.put("elastic", "nhosts", b"2")
 
             monkeypatch.setenv("HOROVOD_CROSS_RANK", "0")

@@ -433,3 +433,20 @@ class TestTorchSyncBatchNorm:
         np.testing.assert_allclose(sbn(x).detach().numpy(),
                                    bn(x).detach().numpy(), rtol=1e-4,
                                    atol=1e-5)
+
+
+class TestElasticSnapshotTypes:
+    def test_save_keeps_torch_tensors_under_elastic(self, hvd, monkeypatch):
+        """device_get must only touch jax arrays: torch attrs keep their
+        type across commit/restore under an elastic launch."""
+        import torch
+        from horovod_tpu.elastic import ObjectState
+        monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+        s = ObjectState(noise=torch.ones(3), step=5)
+        s.save()
+        s.noise = torch.zeros(3)
+        s.step = 9
+        s.restore()
+        assert isinstance(s.noise, torch.Tensor)
+        assert float(s.noise.sum()) == 3.0
+        assert s.step == 5
