@@ -416,3 +416,60 @@ class TestRayElastic:
         from horovod_tpu.spark import run_elastic
         with pytest.raises(RuntimeError, match="requires pyspark"):
             run_elastic(lambda: None)
+
+
+class TestRemoteCheckpointStaging:
+    class _FakeRemoteStore:
+        """Remote-store double: tracks download/upload, refuses direct
+        local I/O on its paths (they are URIs)."""
+
+        is_local = False
+
+        def __init__(self, tmp):
+            self.tmp = tmp
+            self.remote = {}        # path -> marker
+            self.downloads = []
+            self.uploads = []
+
+        def get_checkpoint_path(self, run_id):
+            return f"fake://bucket/ckpt/{run_id}"
+
+        def make_dirs(self, path):
+            self.remote.setdefault(path, "dir")
+
+        def exists(self, path):
+            return path in self.remote and self.remote[path] != "dir"
+
+        def download_dir(self, remote_path, local_path):
+            self.downloads.append((remote_path, local_path))
+
+        def upload_dir(self, local_path, remote_path):
+            self.uploads.append((local_path, remote_path))
+            self.remote[remote_path] = "content"
+
+    def test_stage_checkpoints_remote_roundtrip(self, tmp_path):
+        import os
+        from horovod_tpu.spark.store import stage_checkpoints
+        store = self._FakeRemoteStore(tmp_path)
+        local, sync = stage_checkpoints(store, "runX")
+        assert os.path.isdir(local) and not local.startswith("fake://")
+        assert store.downloads == []      # nothing remote yet
+        sync()
+        assert store.uploads and store.uploads[0][0] == local
+
+        # Second staging: remote now has content AND a stale local dir
+        # exists — it must be refreshed from remote (source of truth).
+        stale_marker = os.path.join(local, "stale.txt")
+        with open(stale_marker, "w") as f:
+            f.write("old")
+        local2, _ = stage_checkpoints(store, "runX")
+        assert local2 == local
+        assert not os.path.exists(stale_marker)   # wiped before download
+        assert store.downloads  # pulled fresh remote state
+
+    def test_stage_checkpoints_local_passthrough(self, tmp_path):
+        from horovod_tpu.spark.store import LocalStore, stage_checkpoints
+        store = LocalStore(str(tmp_path / "store"))
+        local, sync = stage_checkpoints(store, "runY")
+        assert local == store.get_checkpoint_path("runY")
+        sync()  # no-op
