@@ -138,43 +138,19 @@ def DistributedOptimizer(optimizer, name=None,
                 lvars = getattr(layer, "trainable_variables", None)
                 for v in (lvars if lvars is not None else [layer]):
                     local_refs.add(_key(v))
-            reduce_idx = [i for i, (g, v) in enumerate(zip(grads, variables))
-                          if g is not None and _key(v) not in local_refs]
-            if reduce_idx:
-                op_, prescale, postscale = op, 1.0, 1.0
-                if gradient_predivide_factor != 1.0 and op == Average:
-                    # Split the averaging around the sum (reference:
-                    # gradient_predivide_factor semantics,
-                    # tensorflow/__init__.py:822 docstring).
-                    ps = (process_set if process_set is not None
-                          else hvd_tf.global_process_set)
-                    prescale = 1.0 / gradient_predivide_factor
-                    postscale = gradient_predivide_factor / ps.size()
-                    op_ = Sum
-                if isinstance(groups, int) and groups > 0:
-                    # Drop empty trailing chunks when groups > len(grads).
-                    chunks = [c for c in hvd_tf.split_list(reduce_idx,
-                                                           groups) if c]
-                elif isinstance(groups, (list, tuple)):
-                    by_key = {}
-                    for gi, group in enumerate(groups):
-                        for v in group:
-                            by_key[_key(v)] = gi
-                    chunk_map = {}
-                    for i in reduce_idx:
-                        k = by_key.get(_key(variables[i]), f"solo{i}")
-                        chunk_map.setdefault(k, []).append(i)
-                    chunks = list(chunk_map.values())
-                else:
-                    chunks = [reduce_idx]
-                grads = list(grads)
-                for chunk in chunks:
-                    reduced = hvd_tf.grouped_allreduce(
-                        [grads[i] for i in chunk], op=op_,
-                        prescale_factor=prescale, postscale_factor=postscale,
-                        compression=compression, process_set=process_set)
-                    for i, r in zip(chunk, reduced):
-                        grads[i] = r
+            # The predivide-split and groups-chunking machinery is the TF
+            # frontend's _make_allreduce_grads_fn — shared, not duplicated
+            # (it uses the same var_key identity). Local variables are
+            # masked out of the reduction and their gradients reinserted.
+            reduce_fn = hvd_tf._make_allreduce_grads_fn(
+                op=op, gradient_predivide_factor=gradient_predivide_factor,
+                compression=compression, sparse_as_dense=sparse_as_dense,
+                process_set=process_set, groups=groups)
+            masked = [None if _key(v) in local_refs else g
+                      for g, v in zip(grads, variables)]
+            reduced = reduce_fn(masked, variables)
+            grads = [g if _key(v) in local_refs else r
+                     for g, v, r in zip(grads, variables, reduced)]
             if local_refs and scale_local_gradients:
                 ps = (process_set if process_set is not None
                       else hvd_tf.global_process_set)
