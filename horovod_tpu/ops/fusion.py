@@ -6,19 +6,24 @@ memcpy'd into a persistent fusion buffer and reduced with ONE collective, then
 scattered back out; buffer capacity is ``HOROVOD_FUSION_THRESHOLD`` (128 MB)
 and the loop wakes every ``HOROVOD_CYCLE_TIME`` (1 ms).
 
-TPU-native design: there is no background thread and no memcpy staging —
-pending tensors are raveled and concatenated *inside one jitted program* per
-(names, shapes, dtypes, op) signature, reduced with a single ``psum`` on the
-flat buffer, and split back, all fused by XLA. The signature-keyed program
-cache means a steady-state training loop hits the same compiled fused program
-every step (the response-cache fast path, reference: response_cache.h:45).
+TPU-native design: no memcpy staging — pending tensors are raveled and
+concatenated *inside one jitted program* per (names, shapes, dtypes, op)
+signature, reduced with a single ``psum`` on the flat buffer, and split back,
+all fused by XLA. The signature-keyed program cache means a steady-state
+training loop hits the same compiled fused program every step (the
+response-cache fast path, reference: response_cache.h:45).
 
 Flush triggers: pending bytes >= fusion_threshold, an explicit
-``synchronize()`` on any returned handle, or ``flush_all()``.
+``synchronize()``/``poll()`` on any returned handle, ``flush_all()``, or the
+background cycle thread — which is DEBOUNCED (fires after one
+``HOROVOD_CYCLE_TIME`` of enqueue quiescence) so that a burst of hook
+enqueues is never split at arbitrary time boundaries: stable burst → stable
+bucket signature → compiled-program cache hit.
 """
 
 import functools
 import threading
+import time
 
 import jax
 import jax.numpy as jnp
@@ -37,28 +42,40 @@ class FusedHandle:
     bucket it lands in is flushed (reference analog: HandleManager int handle
     + per-entry callback, torch/handle_manager.h)."""
 
-    __slots__ = ("_runtime", "_result", "name")
+    __slots__ = ("_runtime", "_result", "_error", "name")
 
     def __init__(self, runtime, name):
         self._runtime = runtime
         self._result = None
+        self._error = None
         self.name = name
 
     def _set(self, value):
         self._result = value
 
+    def _set_error(self, exc):
+        # Failure delivery for flushes that run on the cycle thread, where
+        # there is no caller to raise to (reference: per-tensor status
+        # callbacks carry the error, operations.cc entry.FinishWithCallback).
+        self._error = exc
+
     def poll(self):
+        if self._error is not None:
+            return True  # "complete": synchronize() will raise it
         if self._result is None:
-            # Polling plays the role of the reference's cycle tick: a pending
-            # bucket is flushed the first time anyone asks about it
-            # (reference: RunLoopOnce wakes every cycle, operations.cc:747).
+            # Polling also acts as a cycle tick: a pending bucket is flushed
+            # the first time anyone asks about it.
             self._runtime.flush_all()
+        if self._error is not None:
+            return True
         return all(o.is_ready() if hasattr(o, "is_ready") else True
                    for o in jax.tree_util.tree_leaves(self._result))
 
     def synchronize(self):
-        if self._result is None:
+        if self._error is None and self._result is None:
             self._runtime.flush_all()
+        if self._error is not None:
+            raise self._error
         jax.block_until_ready(self._result)
         return self._result
 
@@ -126,6 +143,7 @@ class FusionRuntime:
         self._lock = threading.RLock()
         self._pending = []  # (tid, tensor, op, prescale, postscale, handle)
         self._pending_bytes = 0
+        self._last_enqueue = 0.0
         self._next_tid = 0
         self._flushed_groups = []  # group ids to deregister after flush
         # Native C++ scheduler for the per-step bookkeeping (bucket assembly,
@@ -156,6 +174,55 @@ class FusionRuntime:
             self._stall_inspector = StallInspector(
                 warning_secs=config.stall_check_time_seconds,
                 shutdown_secs=config.stall_shutdown_time_seconds)
+        # The cycle loop (reference: RunLoopOnce wakes every
+        # HOROVOD_CYCLE_TIME ms, operations.cc:747-756): without it, async
+        # enqueues below the fusion threshold sit until someone polls —
+        # torch-style grad hooks would get no reduction/backward overlap.
+        self._cycle_stop = threading.Event()
+        self._cycle_pause = False
+        self._cycle_thread = None
+        cycle_s = max(float(config.cycle_time_ms), 0.0) / 1000.0
+        if cycle_s > 0:
+            self._cycle_thread = threading.Thread(
+                target=self._cycle_loop, args=(cycle_s,), daemon=True,
+                name="hvd-fusion-cycle")
+            self._cycle_thread.start()
+
+    def _cycle_loop(self, cycle_s):
+        while not self._cycle_stop.wait(cycle_s):
+            # Debounced: flush only after a full cycle with NO new
+            # enqueues. Flushing mid-burst would split the pending set at
+            # arbitrary time boundaries — different bucket signatures every
+            # step, defeating the compiled-program cache that is this
+            # runtime's steady-state fast path (the guard in
+            # test_perf_guards asserts zero warm-pass compiles).
+            if self._pending and not self._cycle_pause and \
+                    time.perf_counter() - self._last_enqueue >= cycle_s:
+                try:
+                    self.flush_all()
+                except Exception:  # noqa: BLE001
+                    # _flush_locked delivers failures to the affected
+                    # handles; anything escaping here must not kill the
+                    # cycle thread (the reference's background loop
+                    # likewise outlives op failures).
+                    pass
+
+    def cycle_paused(self):
+        """Context manager: suspend time-triggered flushes (threshold and
+        explicit flushes still apply). Lets tests (and bulk submitters that
+        want exactly one bucket) keep the pending-set composition
+        deterministic."""
+        import contextlib
+
+        @contextlib.contextmanager
+        def _ctx():
+            self._cycle_pause = True
+            try:
+                yield
+            finally:
+                self._cycle_pause = False
+
+        return _ctx()
 
     def _bucket_key(self, tensor, op, prescale, postscale):
         dt = jnp.dtype(tensor.dtype) if hasattr(tensor, "dtype") \
@@ -172,6 +239,7 @@ class FusionRuntime:
             self._pending.append((tid, tensor, ReduceOp(op), float(prescale),
                                   float(postscale), handle))
             self._pending_bytes += tensor.nbytes
+            self._last_enqueue = time.perf_counter()
             if self._stall_inspector is not None:
                 self._stall_inspector.record_enqueue(name or "tensor")
             if self._native is not None:
@@ -209,6 +277,7 @@ class FusionRuntime:
                 self._pending.append((tid, t, op, float(prescale),
                                       float(postscale), h))
                 self._pending_bytes += t.nbytes
+                self._last_enqueue = time.perf_counter()
                 if self._native is not None:
                     flush |= self._native.enqueue(tid, hash(key), t.nbytes)
             if self._stall_inspector is not None:
@@ -226,6 +295,10 @@ class FusionRuntime:
 
     def shutdown(self):
         """Flush remaining work and stop background watchdogs."""
+        self._cycle_stop.set()
+        if self._cycle_thread is not None:
+            self._cycle_thread.join(timeout=2)
+            self._cycle_thread = None
         with self._lock:
             # Close the native scheduler under the same lock enqueue holds,
             # so no thread can be inside hvd_sched_enqueue when the C++
@@ -299,13 +372,22 @@ class FusionRuntime:
             # transport-failure → HorovodInternalError translation: a peer
             # dying mid fused collective must be recoverable by the elastic
             # @run wrapper exactly like the sync ops (the async path is the
-            # DistributedOptimizer hot path).
+            # DistributedOptimizer hot path). Failures are delivered to the
+            # bucket's HANDLES (raised at synchronize) rather than raised
+            # here — the flush may be running on the cycle thread, where
+            # there is no caller.
             from horovod_tpu.ops.collective_ops import _timeline_op
-            with _timeline_op(f"fused_allreduce[{len(items)}]", "ALLREDUCE"):
-                outs = prog(*tensors)
-                # Multi-process: hand back this process's local rows,
-                # matching the sync ops' contract.
-                outs = _localize(list(outs), mesh)
+            try:
+                with _timeline_op(f"fused_allreduce[{len(items)}]",
+                                  "ALLREDUCE"):
+                    outs = prog(*tensors)
+                    # Multi-process: hand back this process's local rows,
+                    # matching the sync ops' contract.
+                    outs = _localize(list(outs), mesh)
+            except Exception as e:  # noqa: BLE001
+                for _, h in items:
+                    h._set_error(e)
+                continue
             for (_, h), o in zip(items, outs):
                 h._set(o)
 

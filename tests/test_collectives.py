@@ -471,3 +471,18 @@ class TestAsyncTransportTranslation:
                                 op=hvd.Sum)
         with pytest.raises(HorovodInternalError):
             h.synchronize()
+
+    def test_cycle_thread_flushes_without_poll(self, hvd):
+        """The background cycle loop (HOROVOD_CYCLE_TIME) must flush pending
+        async buckets with NO poll/synchronize — that's what overlaps
+        reduction with ongoing backward compute on the hook path."""
+        import time
+        h = hvd.allreduce_async(np.ones((hvd.size(), 2), np.float32),
+                                op=hvd.Sum)
+        deadline = time.time() + 5.0
+        while h._result is None and h._error is None \
+                and time.time() < deadline:
+            time.sleep(0.01)
+        assert h._result is not None, "cycle thread never flushed"
+        np.testing.assert_allclose(np.asarray(h.synchronize()),
+                                   np.full((hvd.size(), 2), hvd.size()))

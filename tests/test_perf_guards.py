@@ -93,13 +93,17 @@ class TestEagerFusionCacheGuards:
             for h in hs:
                 h.synchronize()
 
-        submit()  # cold: compiles the fused program(s)
-        progs_after_cold = fusion._fused_program.cache_info()
-        stats_cold = rt.cache_stats()
+        # Pause the time-based cycle so burst boundaries (and therefore
+        # bucket signatures) are deterministic — this guard asserts the
+        # program cache, the cycle loop has its own test.
+        with rt.cycle_paused():
+            submit()  # cold: compiles the fused program(s)
+            progs_after_cold = fusion._fused_program.cache_info()
+            stats_cold = rt.cache_stats()
 
-        submit()  # steady state: same signatures
-        progs_after_warm = fusion._fused_program.cache_info()
-        stats_warm = rt.cache_stats()
+            submit()  # steady state: same signatures
+            progs_after_warm = fusion._fused_program.cache_info()
+            stats_warm = rt.cache_stats()
 
         # No new fused programs were compiled on the warm pass...
         assert progs_after_warm.misses == progs_after_cold.misses, \
