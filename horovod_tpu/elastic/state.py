@@ -20,6 +20,8 @@ the mesh from the new host set.
 
 import copy
 
+import jax.numpy as jnp
+
 from horovod_tpu.common import basics
 from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.common.exceptions import (HorovodInternalError,
@@ -98,14 +100,17 @@ class ObjectState(State):
         import jax
 
         def _snap(x):
-            # jax arrays are immutable — a reference IS a snapshot; under
-            # an elastic launch pull to host instead (membership changes
-            # tear the XLA backend and device buffers down). Anything else
-            # (torch tensors, python objects) keeps deepcopy semantics;
+            # jax arrays: immutable, but NOT donation-proof — a reference
+            # would alias a buffer that make_train_step(donate=True)
+            # invalidates on the next step, so snapshot to a fresh device
+            # buffer (host memory under an elastic launch, where membership
+            # changes tear the whole backend down). Anything else (torch
+            # tensors, python objects) keeps deepcopy semantics;
             # device_get must never touch those — __array__ coercion would
             # silently hand back numpy (or raise on device tensors).
             if isinstance(x, jax.Array):
-                return jax.device_get(x) if _elastic_launch() else x
+                return jax.device_get(x) if _elastic_launch() \
+                    else jnp.array(x, copy=True)
             return copy.deepcopy(x)
 
         self._saved_state = {
@@ -128,9 +133,10 @@ class TpuState(ObjectState):
     """Model/optimizer state for JAX training loops.
 
     Tracked pytrees (``params``, ``opt_state``, anything passed as a pytree
-    kwarg) are committed by reference (immutability makes this safe and free)
-    and synced with a fused broadcast — the analog of
-    TorchState(model=..., optimizer=...) (reference: torch/elastic/state.py).
+    kwarg) are committed as fresh device copies (immutability alone is not
+    enough — donated train steps invalidate the old buffers) and synced with
+    a fused broadcast — the analog of TorchState(model=..., optimizer=...)
+    (reference: torch/elastic/state.py).
     """
 
     def __init__(self, trees=None, **kwargs):
@@ -152,16 +158,21 @@ class TpuState(ObjectState):
             super().__setattr__(name, value)
 
     def save(self):
-        # jax arrays are immutable, so references are a valid O(1) snapshot
-        # single-controller. Under an elastic launch the snapshot must
+        # Immutable jax arrays still need a REAL copy: a reference would
+        # alias buffers make_train_step(donate=True) invalidates on the
+        # next step. Under an elastic launch the snapshot must additionally
         # survive a backend teardown on membership change (reference
         # semantics: torch handlers clone to a safe copy,
-        # torch/elastic/state.py:154+), so commit to host memory there.
+        # torch/elastic/state.py:154+), so it goes to host memory there.
+        import jax
+
         if _elastic_launch():
-            import jax
             self._saved_trees = jax.device_get(dict(self._trees))
         else:
-            self._saved_trees = dict(self._trees)
+            self._saved_trees = jax.tree_util.tree_map(
+                lambda x: jnp.array(x, copy=True)
+                if isinstance(x, jax.Array) else copy.deepcopy(x),
+                dict(self._trees))
         super().save()
 
     def restore(self):

@@ -182,7 +182,14 @@ class FusionRuntime:
         self._cycle_pause = False
         self._cycle_thread = None
         cycle_s = max(float(config.cycle_time_ms), 0.0) / 1000.0
-        if cycle_s > 0:
+        # SINGLE-process only: the timer is rank-local wall clock. In a
+        # multi-process job two ranks could split the same enqueue burst at
+        # different points and issue mismatched collectives (the reference
+        # may fuse per-cycle only because its coordinator negotiates the
+        # ready set across ranks first, controller.cc:74). Multi-process
+        # flush triggers stay the SPMD-deterministic ones: threshold,
+        # poll/synchronize, flush_all.
+        if cycle_s > 0 and jax.process_count() <= 1:
             self._cycle_thread = threading.Thread(
                 target=self._cycle_loop, args=(cycle_s,), daemon=True,
                 name="hvd-fusion-cycle")

@@ -282,22 +282,25 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
                 "cross_size": first.cross_size,
                 "coordinator_port": coordinator_port,
             }).encode())
-        # Version-scoped host count: a worker configured for version v must
-        # never pair v's ready marks with v+1's count (premature barrier
-        # release on scale-down). The unscoped key stays for the final
-        # harvest (api._elastic_harvester).
-        kv.put("elastic", f"nhosts/{version}", str(len(by_host)).encode())
-        kv.delete("elastic", f"nhosts/{version - 2}")
-        kv.put("elastic", "nhosts", str(len(by_host)).encode())
         # Last-moment finished re-check, atomic with the bump from the
         # workers' perspective (they only act on the version write): a
         # worker that completed during this rebalance must not be counted
         # as a survivor of a membership it will never join — that would
-        # wedge the others at the new-rank barrier.
+        # wedge the others at the new-rank barrier. The nhosts writes come
+        # AFTER this check: an aborted spawn must not leave the unscoped
+        # count describing a membership that never activated (the final
+        # harvest sizes itself from it).
         if kv.get("elastic", "finished"):
             hvd_logging.info(
                 "aborting spawn v%d: job finished during rebalance", version)
             return
+        # Version-scoped host count: a worker configured for version v must
+        # never pair v's ready marks with v+1's count (premature barrier
+        # release on scale-down). The unscoped key serves the final harvest
+        # (api._elastic_harvester).
+        kv.put("elastic", f"nhosts/{version}", str(len(by_host)).encode())
+        kv.delete("elastic", f"nhosts/{version - 2}")
+        kv.put("elastic", "nhosts", str(len(by_host)).encode())
         kv.put("elastic", "version", str(version).encode())
         for host, slots in by_host.items():
             if host in survivors:

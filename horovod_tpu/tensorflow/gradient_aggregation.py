@@ -61,12 +61,21 @@ class LocalGradientAggregationHelper:
         # Lazy per-slot accumulators: a variable whose gradient first
         # appears on a LATER pass (conditionally-active branch) still gets
         # one — fixing it to None on the first call would silently stop
-        # that variable from ever training.
+        # that variable from ever training. Creation happens under
+        # init_scope so a tf.function RETRACE (the very case where grad
+        # structure changes) may create it too — plain creation inside a
+        # non-first trace is forbidden by tf.function.
         for i, g in enumerate(grads):
             if g is not None and self.locally_aggregated_grads[i] is None:
-                self.locally_aggregated_grads[i] = tf.Variable(
-                    tf.zeros_like(g), trainable=False,
-                    name=f"hvd_agg_grad_{self.rank}_{i}")
+                if g.shape.is_fully_defined():
+                    with tf.init_scope():
+                        self.locally_aggregated_grads[i] = tf.Variable(
+                            tf.zeros(g.shape, g.dtype), trainable=False,
+                            name=f"hvd_agg_grad_{self.rank}_{i}")
+                else:
+                    self.locally_aggregated_grads[i] = tf.Variable(
+                        tf.zeros_like(g), trainable=False,
+                        name=f"hvd_agg_grad_{self.rank}_{i}")
 
     def compute_gradients(self, grads, vars=None):
         """Accumulate ``grads``; on every ``backward_passes_per_step``-th

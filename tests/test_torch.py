@@ -450,3 +450,16 @@ class TestElasticSnapshotTypes:
         assert isinstance(s.noise, torch.Tensor)
         assert float(s.noise.sum()) == 3.0
         assert s.step == 5
+
+    def test_commit_survives_buffer_donation(self, hvd):
+        """A committed snapshot must not alias buffers a donated train step
+        will invalidate (jax arrays are immutable but not donation-proof)."""
+        import jax
+        import jax.numpy as jnp
+        from horovod_tpu.elastic import ObjectState
+        x = jnp.ones((8,))
+        s = ObjectState(w=x)
+        s.save()
+        jax.jit(lambda a: a * 2, donate_argnums=0)(x)  # invalidates x
+        s.restore()
+        np.testing.assert_allclose(np.asarray(s.w), np.ones(8))
