@@ -119,8 +119,13 @@ def _timeline_op(name, op_kind):
     span = tl.op_span(name, op_kind) if tl is not None \
         else contextlib.nullcontext()
     try:
-        with span:
-            yield
+        # TraceAnnotation mirrors the span into jax.profiler XPlane traces,
+        # so device profiles correlate with timeline buckets by name
+        # (SURVEY §5.1: the reference's NVTX ranges around every enqueue,
+        # nvtx_op_range.h).
+        with jax.profiler.TraceAnnotation(f"hvd::{op_kind}::{name}"):
+            with span:
+                yield
     except (ValueError, RuntimeError) as e:
         # Inside the span only the compiled program executes (inputs were
         # validated before it). Translate ONLY transport/peer failures to
