@@ -1,0 +1,81 @@
+"""ZeRO-1 optimizer-state sharding over the DP axis.
+
+Beyond reference parity (Horovod replicates optimizer state on every
+worker): gradients are reduce-scattered, each chip updates its 1/n shard of
+the flattened parameters with its 1/n shard of the adam moments, and the
+updated shards are all-gathered — same wire bytes as an allreduce, n× less
+optimizer memory per chip.
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python flax_zero_optimizer.py
+"""
+
+import argparse
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.parallel import ZeroTrainState, make_zero_train_step
+
+
+class MLP(nn.Module):
+    width: int = 512
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(self.width)(x))
+        x = nn.relu(nn.Dense(self.width)(x))
+        return nn.Dense(10)(x)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--width", type=int, default=512)
+    ap.add_argument("--steps", type=int, default=10)
+    ap.add_argument("--batch-size", type=int, default=16,
+                    help="per-chip batch size")
+    args = ap.parse_args()
+
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.global_process_set.mesh
+
+    model = MLP(width=args.width)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((args.batch_size * n, 32)),
+                    jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (args.batch_size * n,)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    tx = optax.adam(1e-3)
+    step = make_zero_train_step(loss_fn, tx, mesh)
+    state = ZeroTrainState.create(params, tx, mesh)
+
+    n_params = sum(p.size for p in jax.tree_util.tree_leaves(params))
+    moments = [l for l in jax.tree_util.tree_leaves(state.opt_state)
+               if getattr(l, "ndim", 0) == 1]
+    per_chip = sum(m.size for m in moments) // n
+    if hvd.rank() == 0:
+        print(f"params: {n_params:,}; adam moments/chip: {per_chip:,} "
+              f"(replicated would be {2 * n_params:,})")
+
+    for i in range(args.steps):
+        state, loss = step(state, {"x": x, "y": y})
+        if i % 2 == 0 and hvd.rank() == 0:
+            print(f"step {i}: loss {float(loss):.4f}")
+    if hvd.rank() == 0:
+        print(f"final loss {float(loss):.4f}")
+    hvd.shutdown()
+
+
+if __name__ == "__main__":
+    main()

@@ -78,3 +78,9 @@ class TestExamples:
                    "--dim", "16", "--heads", "2", "--steps", "4")
         assert "final loss" in out
         assert "total context 32 tokens" in out
+
+    def test_flax_zero_optimizer(self):
+        out = _run("flax/flax_zero_optimizer.py", "--width", "32",
+                   "--steps", "4", "--batch-size", "4")
+        assert "final loss" in out
+        assert "moments/chip" in out
