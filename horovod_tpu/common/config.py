@@ -78,6 +78,13 @@ class Config:
     stall_check_disable: bool = False
     stall_check_time_seconds: float = 60.0
     stall_shutdown_time_seconds: float = 0.0
+    # Debug: cross-rank verification that every process dispatches the same
+    # eager collective with the same signature, in the same order — turns
+    # SPMD-contract violations (which otherwise hang or corrupt) into
+    # immediate errors. The runtime analog of the reference coordinator's
+    # shape/dtype mismatch checks (controller.h:158-163), extended with
+    # order checking. Costs one tiny KV exchange per collective: debug only.
+    order_check: bool = False
 
     # --- elastic / process sets (reference common.h:139-143) ---
     elastic: bool = False
@@ -129,6 +136,7 @@ class Config:
                                                 c.stall_check_time_seconds)
         c.stall_shutdown_time_seconds = _env_float(
             "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", c.stall_shutdown_time_seconds)
+        c.order_check = _env_bool("HOROVOD_ORDER_CHECK", c.order_check)
         c.elastic = _env_bool("HOROVOD_ELASTIC", c.elastic)
         c.dynamic_process_sets = _env_bool("HOROVOD_DYNAMIC_PROCESS_SETS",
                                            c.dynamic_process_sets)

@@ -377,6 +377,35 @@ def _barrier_program(mesh):
 # Input normalization
 # ----------------------------------------------------------------------------
 
+def _order_check(what, tensors, mesh):
+    """HOROVOD_ORDER_CHECK=1 (debug): verify every process is dispatching
+    THIS op with THIS signature — the runtime cross-rank analog of the
+    reference coordinator's shape/dtype mismatch errors
+    (controller.h:158-163), extended to catch order divergence (which
+    otherwise surfaces as a hang or silent corruption). A rank calling a
+    different number of collectives times out inside the exchange instead
+    of hanging forever."""
+    st = basics._get_state()
+    if not st.config.order_check or jax.process_count() <= 1:
+        return
+    from horovod_tpu.common import negotiation
+    # Leading axis excluded: it is the LOCAL chip count, which legitimately
+    # differs across heterogeneous hosts.
+    sig = [what] + [f"{tuple(getattr(t, 'shape', ()))[1:]}:"
+                    f"{getattr(t, 'dtype', type(t).__name__)}"
+                    for t in tensors]
+    sigs = negotiation.exchange("order_check", sig,
+                                procs=_mesh_processes(mesh))
+    bad = {i: s for i, s in enumerate(sigs) if s != sig}
+    if bad:
+        raise TensorShapeMismatchError(
+            f"collective order/signature mismatch: this process dispatched "
+            f"{sig}, but process(es) {sorted(bad)} dispatched "
+            f"{list(bad.values())[:3]} at the same point in the program — "
+            f"every process must issue the same collectives in the same "
+            f"order (docs/api.md eager multi-process contract).")
+
+
 def _prepare(tensors, mesh, n, what):
     """Convert to device arrays sharded rank-major over the mesh.
 
@@ -389,6 +418,7 @@ def _prepare(tensors, mesh, n, what):
     stack (one per chip it owns); the global sharded array is assembled from
     the per-process pieces without touching non-addressable devices.
     """
+    _order_check(what, tensors, mesh)
     sharding = NamedSharding(mesh, P(HVD_AXIS))
     multi, local_pos = _local_mesh_info(mesh)
     out = []

@@ -284,3 +284,41 @@ class TestNegotiationChurn:
     def test_repeated_exchanges_with_key_gc(self):
         results = run(_negotiation_churn, hosts="localhost:1,127.0.0.1:1")
         assert results == [[40, 41], [40, 41]]
+
+
+def _order_check_worker(diverge):
+    # HOROVOD_ORDER_CHECK rides extra_env: the task bootstrap calls
+    # hvd.init() before the user fn, so in-fn environ tweaks are too late.
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.common.exceptions import TensorShapeMismatchError
+    nl = len(hvd.topology().local_device_ranks)
+    ok = np.asarray(hvd.allreduce(np.ones((nl, 3), np.float32), op=hvd.Sum))
+    assert ok[0, 0] == hvd.size()
+    if not diverge:
+        hvd.allreduce(np.ones((nl, 2), np.float32))
+        return "matched"
+    try:
+        # Rank 0 dispatches allreduce; rank 1 an allgather of a different
+        # trailing shape at the same program point.
+        if hvd.cross_rank() == 0:
+            hvd.allreduce(np.ones((nl, 2), np.float32))
+        else:
+            hvd.allgather(np.ones((nl, 5), np.float32))
+        return "no-error"
+    except TensorShapeMismatchError:
+        return "caught"
+
+
+class TestOrderCheck:
+    def test_matched_order_passes(self):
+        results = run(_order_check_worker, args=(False,),
+                      hosts="localhost:1,127.0.0.1:1",
+                      extra_env={"HOROVOD_ORDER_CHECK": "1"})
+        assert results == ["matched", "matched"]
+
+    def test_diverged_order_raises_on_every_rank(self):
+        results = run(_order_check_worker, args=(True,),
+                      hosts="localhost:1,127.0.0.1:1",
+                      extra_env={"HOROVOD_ORDER_CHECK": "1"})
+        assert results == ["caught", "caught"]
