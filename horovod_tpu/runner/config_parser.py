@@ -34,6 +34,8 @@ _ARG_ENV_MAP = [
      str),
     ("stall_check_shutdown_time_seconds",
      "HOROVOD_STALL_SHUTDOWN_TIME_SECONDS", str),
+    ("order_check", "HOROVOD_ORDER_CHECK",
+     lambda v: "1" if v else None),
     ("log_level", "HOROVOD_LOG_LEVEL", str),
     ("log_hide_timestamp", "HOROVOD_LOG_HIDE_TIME",
      lambda v: "1" if v else None),

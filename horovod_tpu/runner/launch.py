@@ -139,6 +139,12 @@ def parse_args(argv=None):
                        dest="stall_check_warning_time_seconds")
     stall.add_argument("--stall-check-shutdown-time-seconds", type=float,
                        dest="stall_check_shutdown_time_seconds")
+    stall.add_argument("--order-check", action="store_true",
+                       dest="order_check", default=False,
+                       help="debug: cross-check every eager collective's "
+                            "op/shape/dtype signature across ranks before "
+                            "dispatch (catches SPMD order divergence as an "
+                            "error instead of a hang)")
 
     elastic = p.add_argument_group("elastic")
     elastic.add_argument("--min-np", "--min-num-proc", type=int,
