@@ -116,7 +116,7 @@ class TestTFNewOps:
     def test_broadcast_inplace(self):
         v = tf.Variable(tf.random.normal((4,)))
         before = v.numpy()
-        (out,) = hvd_tf.broadcast_(a_list := [v], root_rank=0)
+        (out,) = hvd_tf.broadcast_([v], root_rank=0)
         assert out is v
         np.testing.assert_allclose(v.numpy(), before, rtol=1e-6)
 

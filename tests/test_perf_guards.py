@@ -12,10 +12,7 @@ import re
 
 import jax
 import jax.numpy as jnp
-import pytest
 from jax.sharding import PartitionSpec as P
-
-import horovod_tpu as hvd
 
 N_PARAMS = 100
 
