@@ -7,10 +7,12 @@ over warmup/sample windows; winning parameters are logged and frozen after
 ``bayes_opt_max_samples``.
 
 TPU adaptation: the knobs that still exist are the eager fusion runtime's
-``fusion_threshold`` (bucket bytes) and the wire dtype; jitted steps have no
-cycle loop to tune. Scoring is identical: bytes per second of reduced data
-over a sample window. The manager is wired into
-:class:`horovod_tpu.ops.fusion.FusionRuntime`, which reports each flush.
+``fusion_threshold`` (bucket bytes) and its debounced ``cycle_time_ms``
+(flush quiescence window) — tuned JOINTLY, like the reference's
+threshold+cycle pair; jitted steps have nothing to tune. Scoring is
+identical: bytes per second of reduced data over a sample window. The
+manager is wired into :class:`horovod_tpu.ops.fusion.FusionRuntime`, which
+reports each flush.
 """
 
 import time
@@ -24,26 +26,29 @@ from horovod_tpu.autotune.bayesian_optimization import BayesianOptimization
 class ParameterManager:
     """reference: parameter_manager.h:42-252 ParameterManager."""
 
-    # log2 bounds for fusion threshold: 1 MB .. 256 MB
-    # (reference: NumericParameter fusion threshold 0..64MB log-scaled)
-    _LOG2_LOW = 20.0
-    _LOG2_HIGH = 28.0
+    # log2 bounds: fusion threshold 1 MB .. 256 MB (reference:
+    # NumericParameter fusion threshold log-scaled), cycle/debounce window
+    # 0.25 ms .. 32 ms (reference: cycle time 1..25 ms).
+    _LOG2_THR = (20.0, 28.0)
+    _LOG2_CYC = (-2.0, 5.0)
 
     def __init__(self, warmup_samples=3, steps_per_sample=10,
                  bayes_opt_max_samples=20, gaussian_process_noise=0.8,
-                 log_file=None, initial_threshold=64 * 1024 * 1024):
+                 log_file=None, initial_threshold=64 * 1024 * 1024,
+                 initial_cycle_ms=1.0):
         self._warmup_remaining = warmup_samples
         self._steps_per_sample = steps_per_sample
         self._max_samples = bayes_opt_max_samples
         self._bo = BayesianOptimization(
-            bounds=[[self._LOG2_LOW, self._LOG2_HIGH]],
+            bounds=[list(self._LOG2_THR), list(self._LOG2_CYC)],
             alpha=gaussian_process_noise)
         self._log_file = log_file
         # clamp into tuning bounds (threshold 0 = "fusion disabled" would
         # otherwise poison the GP with -inf)
-        self._current = float(np.clip(
-            np.log2(max(initial_threshold, 1)),
-            self._LOG2_LOW, self._LOG2_HIGH))
+        self._current = np.array([
+            np.clip(np.log2(max(initial_threshold, 1)), *self._LOG2_THR),
+            np.clip(np.log2(max(initial_cycle_ms, 1e-3)), *self._LOG2_CYC),
+        ])
         self._samples = 0
         self._tuning = True
         self._window_bytes = 0
@@ -52,11 +57,16 @@ class ParameterManager:
         self._best = (None, -np.inf)
         if self._log_file:
             with open(self._log_file, "w") as f:
-                f.write("sample,fusion_threshold,score_bytes_per_sec\n")
+                f.write("sample,fusion_threshold,cycle_time_ms,"
+                        "score_bytes_per_sec\n")
 
     @property
     def fusion_threshold(self):
-        return int(2 ** self._current)
+        return int(2 ** self._current[0])
+
+    @property
+    def cycle_time_ms(self):
+        return float(2 ** self._current[1])
 
     @property
     def tuning(self):
@@ -83,24 +93,25 @@ class ParameterManager:
         if self._warmup_remaining > 0:
             # discard warmup windows (reference: warmup_samples)
             self._warmup_remaining -= 1
-            return self.fusion_threshold
+            return self.fusion_threshold, self.cycle_time_ms
 
         self._samples += 1
-        self._bo.add_sample([self._current], score)
+        self._bo.add_sample(self._current, score)
         if score > self._best[1]:
-            self._best = (self._current, score)
+            self._best = (self._current.copy(), score)
         if self._log_file:
             with open(self._log_file, "a") as f:
                 f.write(f"{self._samples},{self.fusion_threshold},"
-                        f"{score:.1f}\n")
+                        f"{self.cycle_time_ms:.3f},{score:.1f}\n")
 
         if self._samples >= self._max_samples:
             # freeze at the best observed configuration
             self._current = self._best[0]
             self._tuning = False
             hvd_logging.info(
-                "autotune converged: fusion_threshold=%d (%.1f MB/s)",
-                self.fusion_threshold, self._best[1] / 1e6)
+                "autotune converged: fusion_threshold=%d cycle=%.2fms "
+                "(%.1f MB/s)", self.fusion_threshold, self.cycle_time_ms,
+                self._best[1] / 1e6)
         else:
-            self._current = float(self._bo.next_sample()[0])
-        return self.fusion_threshold
+            self._current = np.asarray(self._bo.next_sample(), float)
+        return self.fusion_threshold, self.cycle_time_ms

@@ -167,7 +167,8 @@ class FusionRuntime:
                 bayes_opt_max_samples=config.autotune_bayes_opt_max_samples,
                 gaussian_process_noise=config.autotune_gaussian_process_noise,
                 log_file=config.autotune_log_file or None,
-                initial_threshold=config.fusion_threshold)
+                initial_threshold=config.fusion_threshold,
+                initial_cycle_ms=config.cycle_time_ms)
         self._stall_inspector = None
         if not config.stall_check_disable:
             from horovod_tpu.ops.stall_inspector import StallInspector
@@ -181,7 +182,7 @@ class FusionRuntime:
         self._cycle_stop = threading.Event()
         self._cycle_pause = False
         self._cycle_thread = None
-        cycle_s = max(float(config.cycle_time_ms), 0.0) / 1000.0
+        self._cycle_s = max(float(config.cycle_time_ms), 0.0) / 1000.0
         # SINGLE-process only: the timer is rank-local wall clock. In a
         # multi-process job two ranks could split the same enqueue burst at
         # different points and issue mismatched collectives (the reference
@@ -189,14 +190,14 @@ class FusionRuntime:
         # ready set across ranks first, controller.cc:74). Multi-process
         # flush triggers stay the SPMD-deterministic ones: threshold,
         # poll/synchronize, flush_all.
-        if cycle_s > 0 and jax.process_count() <= 1:
+        if self._cycle_s > 0 and jax.process_count() <= 1:
             self._cycle_thread = threading.Thread(
-                target=self._cycle_loop, args=(cycle_s,), daemon=True,
+                target=self._cycle_loop, daemon=True,
                 name="hvd-fusion-cycle")
             self._cycle_thread.start()
 
-    def _cycle_loop(self, cycle_s):
-        while not self._cycle_stop.wait(cycle_s):
+    def _cycle_loop(self):
+        while not self._cycle_stop.wait(self._cycle_s):
             # Debounced: flush only after a full cycle with NO new
             # enqueues. Flushing mid-burst would split the pending set at
             # arbitrary time boundaries — different bucket signatures every
@@ -204,7 +205,8 @@ class FusionRuntime:
             # runtime's steady-state fast path (the guard in
             # test_perf_guards asserts zero warm-pass compiles).
             if self._pending and not self._cycle_pause and \
-                    time.perf_counter() - self._last_enqueue >= cycle_s:
+                    time.perf_counter() - self._last_enqueue >= \
+                    self._cycle_s:
                 try:
                     self.flush_all()
                 except Exception:  # noqa: BLE001
@@ -333,9 +335,11 @@ class FusionRuntime:
         if self._stall_inspector is not None:
             self._stall_inspector.record_flush()
         if self._parameter_manager is not None:
-            new_threshold = self._parameter_manager.record(flushed_bytes)
-            if new_threshold is not None:
-                self.threshold = new_threshold
+            update = self._parameter_manager.record(flushed_bytes)
+            if update is not None:
+                self.threshold, new_cycle_ms = update
+                # Consumed live by the cycle thread on its next wake.
+                self._cycle_s = max(new_cycle_ms, 1e-3) / 1000.0
         topo = basics.topology()
         mesh = topo.mesh
         n = topo.size

@@ -56,6 +56,7 @@ class TestParameterManager:
             seen.add(pm.fusion_threshold)
         assert not pm.tuning
         assert 2 ** 20 <= pm.fusion_threshold <= 2 ** 28
+        assert 0.25 <= pm.cycle_time_ms <= 32.0  # jointly tuned
         assert len(seen) >= 2  # actually explored
 
     def test_autotune_wired_into_fusion(self, hvd, monkeypatch):
