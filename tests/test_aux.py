@@ -73,6 +73,8 @@ class TestParameterManager:
             h = rt.enqueue_allreduce(np.ones((N, 4), np.float32), 1, 1.0, 1.0)
             h.synchronize()
         assert not rt._parameter_manager.tuning
+        # The tuned cycle window reached the runtime (jointly tuned knob).
+        assert 0.25e-3 <= rt._cycle_s <= 32e-3
 
 
 class TestStallInspector:
