@@ -363,8 +363,47 @@ def _train_step_worker():
     return round(losses[-1], 6)
 
 
+def _zero_step_worker():
+    """ZeRO-1 across a real process boundary: reduce-scattered grads and
+    1/n-sharded moments with the mesh spanning two processes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.models import MLP
+    from horovod_tpu.optim import broadcast_parameters
+    from horovod_tpu.parallel import ZeroTrainState, make_zero_train_step
+
+    mesh = hvd.global_process_set.mesh
+    n = hvd.size()
+    model = MLP(features=[8, 4])
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 6)))["params"]
+    params = broadcast_parameters(params, root_rank=0)
+
+    def loss_fn(p, batch):
+        logits = model.apply({"params": p}, batch["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, batch["y"]).mean()
+
+    tx = optax.adam(1e-2)
+    step = make_zero_train_step(loss_fn, tx, mesh, donate=False)
+    state = ZeroTrainState.create(params, tx, mesh)
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((2 * n, 6)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 4, (2 * n,)), jnp.int32)
+    for _ in range(2):
+        state, loss = step(state, {"x": x, "y": y})
+    return round(float(loss), 6)
+
+
 class TestMultiProcessTrainStep:
     def test_dp_train_step_crosses_processes(self):
         results = run(_train_step_worker, hosts="localhost:2,127.0.0.1:2")
         assert len(results) == 2
         assert results[0] == results[1]  # identical replicated updates
+
+    def test_zero_train_step_crosses_processes(self):
+        results = run(_zero_step_worker, hosts="localhost:2,127.0.0.1:2")
+        assert len(results) == 2
+        assert results[0] == results[1]
