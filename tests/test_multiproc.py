@@ -407,3 +407,38 @@ class TestMultiProcessTrainStep:
         results = run(_zero_step_worker, hosts="localhost:2,127.0.0.1:2")
         assert len(results) == 2
         assert results[0] == results[1]
+
+
+def _composite_worker():
+    """dp x pp x tp (+ EP) GPT training step with the 3-D mesh spanning two
+    REAL processes — pipeline hops and TP reductions cross the boundary."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+    import horovod_tpu as hvd
+    from horovod_tpu.models.gpt import GPTConfig
+    from horovod_tpu.parallel.composite import CompositeGPT, build_mesh3d
+
+    dp, pp, tp = 1, 2, 2
+    assert hvd.size() == dp * pp * tp
+    cfg = GPTConfig.tiny(vocab_size=32, hidden_size=16, num_layers=2,
+                         num_heads=2, intermediate_size=32,
+                         max_position_embeddings=8,
+                         num_experts=2 * dp, capacity_factor=4.0)
+    mesh3 = build_mesh3d(dp, pp, tp)
+    comp = CompositeGPT(cfg, mesh3, optax.adam(1e-3), n_micro=2)
+    ids = jnp.asarray(np.random.default_rng(2).integers(
+        0, 32, (2 * dp, 8)), jnp.int32)
+    params, opt_state, specs = comp.init(jax.random.PRNGKey(1), ids)
+    step = comp.make_train_step(specs, donate=False)
+    _, _, loss = step(params, opt_state, ids)
+    assert np.isfinite(float(loss))
+    return round(float(loss), 5)
+
+
+class TestMultiProcessComposite:
+    def test_3d_mesh_spans_processes(self):
+        results = run(_composite_worker, hosts="localhost:2,127.0.0.1:2")
+        assert len(results) == 2
+        assert results[0] == results[1]
