@@ -442,3 +442,44 @@ class TestMultiProcessComposite:
         results = run(_composite_worker, hosts="localhost:2,127.0.0.1:2")
         assert len(results) == 2
         assert results[0] == results[1]
+
+
+def _ring_attention_worker():
+    """Ring attention with the sp ring crossing a real process boundary:
+    K/V blocks ppermute between processes."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel.sequence import ring_attention
+
+    n = hvd.size()
+    devices = hvd.global_process_set.mesh.devices.reshape(-1)
+    mesh = Mesh(devices, ("sp",))
+    D, H = 8, 2
+    rng = np.random.default_rng(0)
+    w = jnp.asarray(rng.standard_normal((D, 3 * D)) * 0.1, jnp.float32)
+    xs = jnp.asarray(rng.standard_normal((1, 4 * n, D)), jnp.float32)
+
+    def heads(t):
+        return t.reshape(t.shape[:-1] + (H, D // H))
+
+    def loss(w, xl):
+        q, k, v = jnp.split(xl @ w, 3, axis=-1)
+        o = ring_attention(heads(q), heads(k), heads(v), axis_name="sp",
+                           causal=True)
+        return jax.lax.pmean(jnp.mean(o.astype(jnp.float32) ** 2), "sp")
+
+    g = jax.jit(jax.shard_map(
+        jax.grad(loss), mesh=mesh,
+        in_specs=(P(), P(None, "sp", None)), out_specs=P()))(w, xs)
+    assert np.isfinite(np.asarray(g)).all()
+    return round(float(np.asarray(g).sum()), 5)
+
+
+class TestMultiProcessSequenceParallel:
+    def test_ring_attention_crosses_processes(self):
+        results = run(_ring_attention_worker, hosts="localhost:2,127.0.0.1:2")
+        assert len(results) == 2
+        assert results[0] == results[1]
