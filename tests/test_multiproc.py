@@ -518,3 +518,40 @@ class TestMultiProcessTorus:
     def test_torus_allreduce_crosses_processes(self):
         results = run(_torus_worker, hosts="localhost:2,127.0.0.1:2")
         assert results == ["ok", "ok"]
+
+
+def _ulysses_worker():
+    """Ulysses all-to-all sequence parallelism with the head scatter
+    crossing a real process boundary."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from jax.sharding import Mesh, PartitionSpec as P
+    import horovod_tpu as hvd
+    from horovod_tpu.parallel.sequence import ulysses_attention
+
+    n = hvd.size()
+    devices = hvd.global_process_set.mesh.devices.reshape(-1)
+    mesh = Mesh(devices, ("sp",))
+    D, H = 8, 4  # heads divisible by n=4
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((1, 4 * n, H, D // H)), jnp.float32)
+    k = jnp.asarray(rng.standard_normal((1, 4 * n, H, D // H)), jnp.float32)
+    v = jnp.asarray(rng.standard_normal((1, 4 * n, H, D // H)), jnp.float32)
+
+    def f(q, k, v):
+        return ulysses_attention(q, k, v, axis_name="sp", causal=True)
+
+    o = jax.jit(jax.shard_map(
+        f, mesh=mesh,
+        in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
+        out_specs=P(None, "sp")))(q, k, v)
+    for shard in o.addressable_shards:
+        assert np.isfinite(np.asarray(shard.data)).all()
+    return "ok"
+
+
+class TestMultiProcessUlysses:
+    def test_ulysses_crosses_processes(self):
+        results = run(_ulysses_worker, hosts="localhost:2,127.0.0.1:2")
+        assert results == ["ok", "ok"]
