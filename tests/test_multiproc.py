@@ -324,40 +324,50 @@ class TestOrderCheck:
         assert results == ["caught", "caught"]
 
 
-def _train_step_worker():
-    """The flagship path — DistributedOptimizer + make_train_step — across
-    a REAL process boundary (the `hvdrun -H a:2,b:2 python train.py` case).
-    Each process feeds the full (host-replicated) global batch; shard_map
-    shards compute; the fused gradient allreduce crosses processes."""
+def _mlp_setup():
+    """Shared worker setup: broadcast-identical MLP params, loss fn, and a
+    host-replicated global batch (the JIT-path input contract)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
     import horovod_tpu as hvd
     from horovod_tpu.models import MLP
-    from horovod_tpu.optim import DistributedOptimizer, broadcast_parameters
-    from horovod_tpu.parallel import TrainState, make_train_step
+    from horovod_tpu.optim import broadcast_parameters
 
     mesh = hvd.global_process_set.mesh
     n = hvd.size()
     model = MLP(features=[8, 4])
     params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 6)))["params"]
     params = broadcast_parameters(params, root_rank=0)
-    opt = DistributedOptimizer(optax.sgd(0.1))
 
     def loss_fn(p, batch):
         logits = model.apply({"params": p}, batch["x"])
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, batch["y"]).mean()
 
+    rng = np.random.default_rng(0)
+    batch = {"x": jnp.asarray(rng.standard_normal((2 * n, 6)), jnp.float32),
+             "y": jnp.asarray(rng.integers(0, 4, (2 * n,)), jnp.int32)}
+    return mesh, params, loss_fn, batch
+
+
+def _train_step_worker():
+    """The flagship path — DistributedOptimizer + make_train_step — across
+    a REAL process boundary (the `hvdrun -H a:2,b:2 python train.py` case).
+    Each process feeds the full (host-replicated) global batch; shard_map
+    shards compute; the fused gradient allreduce crosses processes."""
+    import optax
+    from horovod_tpu.optim import DistributedOptimizer
+    from horovod_tpu.parallel import TrainState, make_train_step
+
+    mesh, params, loss_fn, batch = _mlp_setup()
+    opt = DistributedOptimizer(optax.sgd(0.1))
     step = make_train_step(loss_fn, opt, mesh, donate=False)
     state = TrainState.create(params, opt)
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((2 * n, 6)), jnp.float32)
-    y = jnp.asarray(rng.integers(0, 4, (2 * n,)), jnp.int32)
     losses = []
     for _ in range(3):
-        state, loss = step(state, {"x": x, "y": y})
+        state, loss = step(state, batch)
         losses.append(float(loss))
     assert losses[-1] < losses[0], losses  # actually training
     return round(losses[-1], 6)
@@ -366,34 +376,15 @@ def _train_step_worker():
 def _zero_step_worker():
     """ZeRO-1 across a real process boundary: reduce-scattered grads and
     1/n-sharded moments with the mesh spanning two processes."""
-    import jax
-    import jax.numpy as jnp
-    import numpy as np
     import optax
-    import horovod_tpu as hvd
-    from horovod_tpu.models import MLP
-    from horovod_tpu.optim import broadcast_parameters
     from horovod_tpu.parallel import ZeroTrainState, make_zero_train_step
 
-    mesh = hvd.global_process_set.mesh
-    n = hvd.size()
-    model = MLP(features=[8, 4])
-    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 6)))["params"]
-    params = broadcast_parameters(params, root_rank=0)
-
-    def loss_fn(p, batch):
-        logits = model.apply({"params": p}, batch["x"])
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits, batch["y"]).mean()
-
+    mesh, params, loss_fn, batch = _mlp_setup()
     tx = optax.adam(1e-2)
     step = make_zero_train_step(loss_fn, tx, mesh, donate=False)
     state = ZeroTrainState.create(params, tx, mesh)
-    rng = np.random.default_rng(0)
-    x = jnp.asarray(rng.standard_normal((2 * n, 6)), jnp.float32)
-    y = jnp.asarray(rng.integers(0, 4, (2 * n,)), jnp.int32)
     for _ in range(2):
-        state, loss = step(state, {"x": x, "y": y})
+        state, loss = step(state, batch)
     return round(float(loss), 6)
 
 
@@ -546,8 +537,14 @@ def _ulysses_worker():
         f, mesh=mesh,
         in_specs=(P(None, "sp"), P(None, "sp"), P(None, "sp")),
         out_specs=P(None, "sp")))(q, k, v)
+    # Numeric check: Ulysses is exact, so every addressable shard must
+    # equal the corresponding slice of plain full attention.
+    from horovod_tpu.parallel.sequence import local_attention
+    expect = np.asarray(local_attention(q, k, v, causal=True))
     for shard in o.addressable_shards:
-        assert np.isfinite(np.asarray(shard.data)).all()
+        np.testing.assert_allclose(np.asarray(shard.data),
+                                   expect[shard.index], rtol=1e-4,
+                                   atol=1e-5)
     return "ok"
 
 
