@@ -552,3 +552,28 @@ class TestMultiProcessUlysses:
     def test_ulysses_crosses_processes(self):
         results = run(_ulysses_worker, hosts="localhost:2,127.0.0.1:2")
         assert results == ["ok", "ok"]
+
+
+def _adasum_worker():
+    """Adasum (scale-invariant combine) across a real process boundary,
+    checked against the host-side tree ground truth."""
+    import numpy as np
+    import horovod_tpu as hvd
+    from horovod_tpu.ops.adasum import adasum_tree
+
+    n = hvd.size()
+    lr = hvd.topology().local_device_ranks
+    rows = np.stack([np.arange(1.0, 4.0) * (r + 1) for r in lr]).astype(
+        np.float32)
+    out = np.asarray(hvd.allreduce(rows, op=hvd.Adasum))
+    expect = adasum_tree([np.arange(1.0, 4.0) * (r + 1)
+                          for r in range(n)])
+    for row in out:
+        np.testing.assert_allclose(row, expect, rtol=1e-5)
+    return "ok"
+
+
+class TestMultiProcessAdasum:
+    def test_adasum_crosses_processes(self):
+        results = run(_adasum_worker, hosts="localhost:2,127.0.0.1:2")
+        assert results == ["ok", "ok"]
