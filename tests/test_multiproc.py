@@ -577,3 +577,41 @@ class TestMultiProcessAdasum:
     def test_adasum_crosses_processes(self):
         results = run(_adasum_worker, hosts="localhost:2,127.0.0.1:2")
         assert results == ["ok", "ok"]
+
+
+def _process_set_worker():
+    """Process-set collectives multi-process: a set spanning both processes
+    reduces over its sub-mesh; a set owned by ONE process runs without the
+    other participating (exchange scoped to the set's owners)."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    n = hvd.size()  # 4: 2 procs x 2 slots
+    lr = hvd.topology().local_device_ranks
+    spanning = hvd.add_process_set(hvd.ProcessSet([1, 2]))  # one rank each
+    try:
+        mine = [r for r in lr if r in (1, 2)]
+        if mine:
+            rows = np.stack([np.full((2,), float(r + 1))
+                             for r in mine]).astype(np.float32)
+            out = np.asarray(hvd.allreduce(rows, op=hvd.Sum,
+                                           process_set=spanning))
+            np.testing.assert_allclose(out, np.full((len(mine), 2), 5.0))
+    finally:
+        hvd.remove_process_set(spanning)
+
+    local_only = hvd.add_process_set(hvd.ProcessSet(lr))  # this proc's ranks
+    try:
+        rows = np.stack([np.full((2,), 1.0) for _ in lr]).astype(np.float32)
+        out = np.asarray(hvd.allreduce(rows, op=hvd.Sum,
+                                       process_set=local_only))
+        np.testing.assert_allclose(out, np.full((len(lr), 2), float(len(lr))))
+    finally:
+        hvd.remove_process_set(local_only)
+    return "ok"
+
+
+class TestMultiProcessProcessSets:
+    def test_process_sets_cross_and_local(self):
+        results = run(_process_set_worker, hosts="localhost:2,127.0.0.1:2")
+        assert results == ["ok", "ok"]
