@@ -216,7 +216,7 @@ def _bench_gpt(hvd):
 # 103.55 = 1656.82/16, the reference's one absolute number (ResNet-101,
 # batch 64/GPU); ResNet-50 is benchmarked against it as the tracked config.
 _IMAGE_MODELS = {
-    "resnet50": ("ResNet50", 224, 128, 1656.82 / 16.0),
+    "resnet50": ("ResNet50", 224, 256, 1656.82 / 16.0),
     "resnet101": ("ResNet101", 224, 64, 1656.82 / 16.0),
     "inception3": ("InceptionV3", 299, 64, None),
     "vgg16": ("VGG16", 224, 64, None),

@@ -731,6 +731,10 @@ def DistributedOptimizer(optimizer, name=None, use_locking=False,
     if op == Adasum and average_aggregated_gradients:
         raise ValueError(
             "Adasum does not support average_aggregated_gradients == True")
+    if op == Adasum:
+        # reference: tensorflow/__init__.py:161 — the VHDD combine order is
+        # only defined for power-of-two worlds.
+        check_num_rank_power_of_2(size())
     if num_groups != 0 and groups is None:
         groups = num_groups
 

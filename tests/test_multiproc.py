@@ -586,7 +586,6 @@ def _process_set_worker():
     import numpy as np
     import horovod_tpu as hvd
 
-    n = hvd.size()  # 4: 2 procs x 2 slots
     lr = hvd.topology().local_device_ranks
     spanning = hvd.add_process_set(hvd.ProcessSet([1, 2]))  # one rank each
     try:

@@ -120,11 +120,15 @@ class TestEagerFusionCacheGuards:
         rt.flush_all()
         before = fusion._fused_program.cache_info().currsize
         n_rows = hvd.size()
-        hs = [hvd.allreduce_async(jnp.ones((n_rows, 8), jnp.float32),
-                                  op=hvd.Sum, name=f"bucket.{i}")
-              for i in range(50)]
-        for h in hs:
-            h.synchronize()
+        # Pause the cycle thread so bucket splits are purely
+        # threshold-driven: on a slow/loaded host the debounced cycle can
+        # otherwise flush mid-enqueue, splitting an extra partial bucket.
+        with rt.cycle_paused():
+            hs = [hvd.allreduce_async(jnp.ones((n_rows, 8), jnp.float32),
+                                      op=hvd.Sum, name=f"bucket.{i}")
+                  for i in range(50)]
+            for h in hs:
+                h.synchronize()
         new_programs = fusion._fused_program.cache_info().currsize - before
         # All 50 share one signature family; a handful of distinct bucket
         # shapes is fine, one-program-per-tensor is the regression.
