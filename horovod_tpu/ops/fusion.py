@@ -208,6 +208,13 @@ class FusionRuntime:
                     time.perf_counter() - self._last_enqueue >= \
                     self._cycle_s:
                 try:
+                    # Reference: RunLoopOnce emits a CYCLE_START instant per
+                    # loop when --timeline-mark-cycles is on
+                    # (operations.cc:759-762).
+                    from horovod_tpu.common import basics
+                    tl = basics.timeline()
+                    if tl is not None:
+                        tl.mark_cycle()
                     self.flush_all()
                 except Exception:  # noqa: BLE001
                     # _flush_locked delivers failures to the affected

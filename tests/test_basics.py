@@ -62,3 +62,35 @@ def test_global_process_set(hvd):
     assert gps.process_set_id == 0
     assert gps.size() == 8
     assert gps.rank_list() == list(range(8))
+
+
+def test_timeline_cycle_markers(hvd, tmp_path):
+    """--timeline-mark-cycles parity: the fusion cycle loop emits a CYCLE
+    instant event per debounced flush (reference: RunLoopOnce cycle markers,
+    operations.cc:759-762)."""
+    import json
+    import time
+
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops import fusion
+    import jax.numpy as jnp
+
+    path = str(tmp_path / "cycles.json")
+    basics.start_timeline(path, mark_cycles=True)
+    try:
+        h = hvd.allreduce_async(jnp.ones((hvd.size(), 4), jnp.float32),
+                                op=hvd.Sum, name="cycle.probe")
+        rt = fusion.get_runtime()
+        deadline = time.time() + 10.0
+        # Wait for the cycle thread's debounced flush (not an explicit
+        # flush_all — the marker rides the background path being tested).
+        while rt._pending and time.time() < deadline:
+            time.sleep(0.05)
+        assert not rt._pending, "cycle thread never flushed"
+        h.synchronize()
+    finally:
+        basics.stop_timeline()
+    evs = json.load(open(path))["traceEvents"]
+    cycles = [e for e in evs if e.get("name") == "CYCLE" and e["ph"] == "i"]
+    assert cycles, f"no CYCLE instant events in {len(evs)} trace events"
+    assert any(e.get("cat") == "ALLREDUCE" for e in evs)

@@ -109,6 +109,33 @@ class TestFlashAttention:
             np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                        rtol=2e-3, atol=2e-4)
 
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("lq,lk", [(64, 64), (32, 64), (64, 32)])
+    def test_fused_backward_kernels_match_jnp(self, rng, causal, lq, lk):
+        """The TPU backward kernels (_fa_backward, run here through the
+        interpreter) must reproduce the jnp backward that CPU mode uses —
+        the jnp path is the oracle the kernels are pinned to."""
+        import importlib
+        # the package re-exports the same-named function, shadowing the
+        # submodule attribute — import the module explicitly
+        fa = importlib.import_module(
+            "horovod_tpu.ops.pallas.flash_attention")
+        H, D = 2, 16
+        bq = fa._pick_block(lq)
+        bk = fa._pick_block(lk)
+        q = jnp.asarray(rng.standard_normal((H, lq, D)), np.float32)
+        k = jnp.asarray(rng.standard_normal((H, lk, D)), np.float32)
+        v = jnp.asarray(rng.standard_normal((H, lk, D)), np.float32)
+        do = jnp.asarray(rng.standard_normal((H, lq, D)), np.float32)
+        sm = 1.0 / D ** 0.5
+        o, lse = fa._fa_forward(q, k, v, causal, sm, bq, bk)
+        got = fa._fa_backward(q, k, v, o, lse, do, causal, sm, bq, bk)
+        want = fa._flash_bwd(causal, sm, bq, bk, (q, k, v, o, lse), do)
+        for a, b, nm in zip(got, want, "q k v".split()):
+            np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
+                err_msg=f"d{nm} mismatch (causal={causal})")
+
     def test_tp_attention_flash_flag(self, hvd, rng):
         """TPSelfAttention(use_flash=True) == use_flash=False (same params)."""
         from horovod_tpu.parallel.tp import TPSelfAttention
