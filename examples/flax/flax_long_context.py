@@ -54,8 +54,10 @@ def main():
     def loss_fn(params, xl, yl):
         w, wo = params
         q, k, v = jnp.split(xl @ w, 3, axis=-1)
+        # use_flash: each ring hop runs the Pallas flash block kernels on
+        # TPU (jnp block oracle elsewhere) — same exact math, MXU-tiled.
         o = ring_attention(heads(q), heads(k), heads(v), axis_name="sp",
-                           causal=True)
+                           causal=True, use_flash=True)
         o = o.reshape(o.shape[:2] + (D,)) @ wo
         # mean over the sharded sequence axis -> pmean across the ring
         return jax.lax.pmean(jnp.mean((o - yl) ** 2), "sp")

@@ -379,29 +379,55 @@ def _fa_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
     return dq, dk, dv
 
 
+def _jnp_block_fwd(q3, k3, v3, causal, scale):
+    """jnp oracle for one attention block on (BH, Lq, D): returns
+    (o, lse) with the same contract as the forward kernel (end-aligned
+    causal, per-row logsumexp). Shared by the interpret-mode paths here
+    and the ring hops in parallel/sequence.py."""
+    s = jnp.einsum("bqd,bkd->bqk", q3.astype(jnp.float32),
+                   k3.astype(jnp.float32)) * scale
+    if causal:
+        lq, lk = s.shape[1], s.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool),
+                               k=lk - lq)[None], s, NEG_INF)
+    m = jnp.max(s, axis=-1)
+    p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m[..., None]), 0.0)
+    l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
+    o = (jnp.einsum("bqk,bkd->bqd", p, v3.astype(jnp.float32))
+         / l[..., None]).astype(q3.dtype)
+    return o, m + jnp.log(l)
+
+
+def _jnp_block_bwd(q3, k3, v3, o3, lse, do3, causal, scale):
+    """jnp oracle for the block backward against a given logsumexp: with
+    the block's own lse this is exact flash backward; with a ring-wide lse
+    it yields the hop's contribution to the global gradient."""
+    qf, kf, vf, of, dof = (t.astype(jnp.float32)
+                           for t in (q3, k3, v3, o3, do3))
+    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
+    if causal:
+        lq, lk = s.shape[1], s.shape[2]
+        s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool),
+                               k=lk - lq)[None], s, NEG_INF)
+    # Masked entries have s = NEG_INF and a fully-masked row has
+    # lse ~= NEG_INF, where exp(s - lse) would blow up instead of vanishing
+    # — zero them explicitly (the forward kernel does the same).
+    p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse[..., None]), 0.0)
+    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
+    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
+    delta = jnp.sum(dof * of, axis=-1)                    # (BH, Lq)
+    ds = p * (dp - delta[..., None]) * scale
+    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
+    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
+    return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
+
+
 def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
     q, k, v, o, lse = res
     if not _interpret():
         return _fa_backward(q, k, v, o, lse, do, causal, sm_scale,
                             block_q, block_k)
-    qf, kf, vf, of, dof = (t.astype(jnp.float32) for t in (q, k, v, o, do))
-    s = jnp.einsum("bqd,bkd->bqk", qf, kf) * sm_scale
-    if causal:
-        lq, lk = s.shape[1], s.shape[2]
-        mask = jnp.tril(jnp.ones((lq, lk), bool), k=lk - lq)
-        s = jnp.where(mask[None], s, NEG_INF)
-    # Masked entries have s = NEG_INF and a fully-masked row has
-    # lse ~= NEG_INF, where exp(s - lse) would blow up instead of vanishing
-    # — zero them explicitly (the forward kernel does the same).
-    p = jnp.where(s > NEG_INF * 0.5,
-                  jnp.exp(s - lse[..., None]), 0.0)       # uses saved lse
-    dv = jnp.einsum("bqk,bqd->bkd", p, dof)
-    dp = jnp.einsum("bqd,bkd->bqk", dof, vf)
-    delta = jnp.sum(dof * of, axis=-1)                    # (BH, Lq)
-    ds = p * (dp - delta[..., None]) * sm_scale
-    dq = jnp.einsum("bqk,bkd->bqd", ds, kf)
-    dk = jnp.einsum("bqk,bqd->bkd", ds, qf)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    return _jnp_block_bwd(q, k, v, o, lse, do, causal, sm_scale)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)

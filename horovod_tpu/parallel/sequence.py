@@ -19,6 +19,8 @@ Both are numerically exact (fp32 accumulators, online softmax) and verified
 against full attention in tests.
 """
 
+import functools
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -91,7 +93,137 @@ def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False):
     return gather_heads(oh)
 
 
-def ring_attention(q, k, v, axis_name=SP_AXIS, causal=False):
+def _block_attn_fwd(q3, ks, vs, causal, scale, blocks):
+    """(o_b, lse_b) for one ring hop on (BH, L, D) blocks: the Pallas flash
+    kernel on TPU, the shared jnp block oracle elsewhere (the interpreter
+    can't run the kernel under a VMA-checked shard_map)."""
+    from horovod_tpu.ops.pallas.flash_attention import (_fa_forward,
+                                                        _interpret,
+                                                        _jnp_block_fwd)
+    if blocks is not None and not _interpret():
+        return _fa_forward(q3, ks, vs, causal, scale, *blocks)
+    return _jnp_block_fwd(q3, ks, vs, causal, scale)
+
+
+def _block_attn_bwd(q3, ks, vs, out3, lse, do3, causal, scale, blocks):
+    """Per-hop (dq, dk, dv) against the GLOBAL softmax: p = exp(s - lse)
+    with the ring-wide logsumexp, so summing hop contributions reproduces
+    the exact full-attention gradient."""
+    from horovod_tpu.ops.pallas.flash_attention import (_fa_backward,
+                                                        _interpret,
+                                                        _jnp_block_bwd)
+    if blocks is not None and not _interpret():
+        return _fa_backward(q3, ks, vs, out3, lse, do3, causal, scale,
+                            *blocks)
+    return _jnp_block_bwd(q3, ks, vs, out3, lse, do3, causal, scale)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _ring_flash(q3, k3, v3, causal, axis_name, scale, blocks):
+    out, _ = _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks)
+    return out
+
+
+def _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks):
+    """Ring forward: rotate K/V blocks, run the flash block kernel per hop,
+    combine hop outputs by their logsumexp weights (exact)."""
+    from horovod_tpu.ops.in_jit import mark_varying
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    bh, L, d = q3.shape
+    perm = [(i, (i - 1) % n) for i in range(n)]
+
+    m = jnp.full((bh, L), -1e30, jnp.float32)
+    norm = jnp.zeros((bh, L), jnp.float32)
+    acc = jnp.zeros((bh, L, d), jnp.float32)
+    m, norm, acc = mark_varying((m, norm, acc), axis_name)
+    ks, vs = k3, v3
+    for s in range(n):
+        src = (idx + s) % n
+        if causal and s > 0:
+            # Blocks from ranks ahead of this one are entirely above the
+            # causal diagonal: skip their kernels outright (the per-device
+            # scalar predicate branches locally; no collective inside).
+            o_b, lse_b = lax.cond(
+                src < idx,
+                lambda ks=ks, vs=vs: _block_attn_fwd(
+                    q3, ks, vs, False, scale, blocks),
+                lambda: (q3 * 0,
+                         q3[..., 0].astype(jnp.float32) * 0 - 1e30))
+            visible = (src < idx).astype(jnp.float32)       # whole block
+        else:
+            o_b, lse_b = _block_attn_fwd(q3, ks, vs, causal and s == 0,
+                                         scale, blocks)
+            visible = jnp.float32(1.0)
+        m_new = jnp.maximum(m, jnp.where(visible > 0, lse_b, -1e30))
+        # m_new stays -1e30 only while NO block is visible yet; exp(0)=1
+        # corrections are harmless there because norm/acc are still zero.
+        corr = jnp.exp(m - m_new)
+        w = visible * jnp.exp(jnp.minimum(lse_b - m_new, 0.0))
+        norm = norm * corr + w
+        acc = acc * corr[..., None] + w[..., None] * o_b.astype(jnp.float32)
+        m = m_new
+        if s != n - 1:
+            ks = lax.ppermute(ks, axis_name, perm)
+            vs = lax.ppermute(vs, axis_name, perm)
+    norm_safe = jnp.maximum(norm, 1e-30)
+    out = (acc / norm_safe[..., None]).astype(q3.dtype)
+    lse_tot = m + jnp.log(norm_safe)
+    return out, (q3, k3, v3, out, lse_tot)
+
+
+def _ring_flash_bwd(causal, axis_name, scale, blocks, res, do3):
+    """Ring backward: rotate K/V (and their gradient accumulators) around
+    the ring again; each hop's dk/dv lands home after n-1 rotations."""
+    q3, k3, v3, out3, lse_tot = res
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    perm = [(i, (i - 1) % n) for i in range(n)]
+    from horovod_tpu.ops.in_jit import mark_varying
+
+    dq = jnp.zeros(q3.shape, jnp.float32)
+    dk_rot = jnp.zeros(k3.shape, jnp.float32)
+    dv_rot = jnp.zeros(v3.shape, jnp.float32)
+    dq, dk_rot, dv_rot = mark_varying((dq, dk_rot, dv_rot), axis_name)
+    # Fully-masked rows (possible only without a visible diagonal) carry
+    # lse ~ -1e30; clamp so exp(s - lse) cannot overflow — their hop
+    # contributions are already zeroed by the visibility gate.
+    lse_safe = jnp.where(lse_tot > -1e29, lse_tot, 0.0)
+    ks, vs = k3, v3
+    for s in range(n):
+        src = (idx + s) % n
+        if causal and s > 0:
+            dq_b, dk_b, dv_b = lax.cond(
+                src < idx,
+                lambda ks=ks, vs=vs: _block_attn_bwd(
+                    q3, ks, vs, out3, lse_safe, do3, False, scale, blocks),
+                lambda ks=ks, vs=vs: (q3 * 0, ks * 0, vs * 0))
+            visible = (src < idx).astype(jnp.float32)
+        else:
+            dq_b, dk_b, dv_b = _block_attn_bwd(
+                q3, ks, vs, out3, lse_safe, do3, causal and s == 0, scale,
+                blocks)
+            visible = jnp.float32(1.0)
+        dq = dq + visible * dq_b.astype(jnp.float32)
+        dk_rot = dk_rot + visible * dk_b.astype(jnp.float32)
+        dv_rot = dv_rot + visible * dv_b.astype(jnp.float32)
+        if s != n - 1:
+            ks = lax.ppermute(ks, axis_name, perm)
+            vs = lax.ppermute(vs, axis_name, perm)
+            dk_rot = lax.ppermute(dk_rot, axis_name, perm)
+            dv_rot = lax.ppermute(dv_rot, axis_name, perm)
+    # After n-1 hops the accumulators sit one rotation short of home.
+    dk_home = lax.ppermute(dk_rot, axis_name, perm)
+    dv_home = lax.ppermute(dv_rot, axis_name, perm)
+    return (dq.astype(q3.dtype), dk_home.astype(k3.dtype),
+            dv_home.astype(v3.dtype))
+
+
+_ring_flash.defvjp(_ring_flash_fwd, _ring_flash_bwd)
+
+
+def ring_attention(q, k, v, axis_name=SP_AXIS, causal=False,
+                   use_flash=False):
     """Ring attention with online softmax (Liu et al.; blockwise parallel
     transformers): exact attention over the full sequence with O(L/n) memory
     and K/V rotating over ICI.
@@ -99,9 +231,34 @@ def ring_attention(q, k, v, axis_name=SP_AXIS, causal=False):
     Local shapes (B, L/n, H, D); every chip owns the Q block for its sequence
     shard and receives each K/V block exactly once. Outside the axis context
     (e.g. parameter init) this computes plain local attention.
+
+    ``use_flash=True`` runs each hop's block attention through the Pallas
+    flash kernels (forward AND backward) and combines hops by their
+    logsumexp weights — same exact math, MXU-tiled and O(block) VMEM. On
+    non-TPU backends the hops use an equivalent jnp block kernel, so the
+    path is testable on the virtual CPU mesh.
     """
     if not _axis_bound(axis_name):
+        if use_flash:
+            from horovod_tpu.ops.pallas import flash_attention as _flash_fn
+            return _flash_fn(q, k, v, causal=causal)
         return local_attention(q, k, v, causal=causal)
+    if use_flash:
+        import importlib
+        fa = importlib.import_module(
+            "horovod_tpu.ops.pallas.flash_attention")
+        B, Lq, H, D = q.shape
+        bq, bk = fa._pick_block(Lq), fa._pick_block(k.shape[1])
+        blocks = (bq, bk) if (bq and bk and fa.pltpu is not None) else None
+        scale = 1.0 / np.sqrt(D)
+
+        def to3(t):
+            return jnp.moveaxis(t, 2, 1).reshape(t.shape[0] * H,
+                                                 t.shape[1], D)
+
+        o3 = _ring_flash(to3(q), to3(k), to3(v), causal, axis_name, scale,
+                         blocks)
+        return jnp.moveaxis(o3.reshape(B, H, Lq, D), 1, 2)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     B, Lq, H, D = q.shape
