@@ -60,8 +60,47 @@ class BF16Compressor(_CastCompressor):
     wire_dtype = jnp.bfloat16
 
 
+class Int8Compressor(Compressor):
+    """int8 on the wire (TPU addition beyond the reference's fp16 cast).
+
+    Unlike the cast compressors, int8 cannot ride an ordinary psum (summing
+    n int8s overflows and per-rank scales differ), so compress/decompress
+    are identity markers: the fused-allreduce path detects this compressor
+    and routes the bucket through the two-phase quantized exchange in
+    :func:`horovod_tpu.parallel.strategies.allreduce_int8` (int8
+    reduce-scatter + int8 all-gather, fp32 accumulation; EQuARX-style,
+    arXiv:2506.17615). Lossy: each wire leg adds error ≤ max|x|/254.
+    Combinations the exchange can't express (explicit process sets,
+    non-Sum/Average ops) fall back to the uncompressed collective.
+    """
+
+    _warned = False
+
+    @classmethod
+    def compress(cls, tensor):
+        # Reaching compress() means a code path that does NOT special-case
+        # this compressor is about to run an ordinary full-precision
+        # collective (the fused tree path routes around compress()).
+        # Warn loudly instead of silently dropping the selected feature.
+        if not cls._warned:
+            import warnings
+            warnings.warn(
+                "Compression.int8 only takes effect in the fused jit "
+                "allreduce path (DistributedOptimizer / "
+                "fused_allreduce_tree with op=Sum/Average and no process "
+                "set); this collective runs UNCOMPRESSED.",
+                stacklevel=3)
+            Int8Compressor._warned = True
+        return tensor, None
+
+    @staticmethod
+    def decompress(tensor, ctx):
+        return tensor
+
+
 class Compression:
     """reference: compression.py Compression namespace."""
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
+    int8 = Int8Compressor

@@ -58,12 +58,19 @@ def fused_allreduce_tree(tree, op=Average, axis_name=HVD_AXIS,
         threshold = basics.config().fusion_threshold
     except NotInitializedError:
         threshold = Config().fusion_threshold
-    compressed = [compression.compress(jnp.asarray(l)) for l in leaves]
+    op = ReduceOp(op)
+    int8_route = (compression is Compression.int8 and process_set is None
+                  and op in (Sum, Average))
+    if int8_route:
+        # Quantization happens inside the bucket exchange below; calling
+        # compress() would fire Int8Compressor's not-honored warning.
+        compressed = [(jnp.asarray(l), None) for l in leaves]
+    else:
+        compressed = [compression.compress(jnp.asarray(l)) for l in leaves]
     groups = {}
     for i, (c, _) in enumerate(compressed):
         groups.setdefault(jnp.dtype(c.dtype), []).append(i)
     out = [None] * len(leaves)
-    op = ReduceOp(op)
     for dt, idxs in groups.items():
         if op == Average and not jnp.issubdtype(dt, jnp.floating):
             raise ValueError(
@@ -105,10 +112,22 @@ def fused_allreduce_tree(tree, op=Average, axis_name=HVD_AXIS,
             pad = (-total) % 1024
             if pad:
                 buf = jnp.pad(buf, (0, pad))
-            buf = in_jit.allreduce(buf, op=op, axis_name=axis_name,
-                                   process_set=process_set,
-                                   prescale_factor=prescale_factor,
-                                   postscale_factor=postscale_factor)
+            if int8_route and jnp.issubdtype(dt, jnp.floating):
+                # int8 can't ride a plain psum (overflow + per-rank
+                # scales): route the bucket through the two-phase
+                # quantized exchange (strategies.allreduce_int8).
+                from horovod_tpu.parallel.strategies import allreduce_int8
+                if prescale_factor != 1.0:
+                    buf = buf * jnp.asarray(prescale_factor, buf.dtype)
+                buf = allreduce_int8(buf, axis_name=axis_name,
+                                     average=(op == Average))
+                if postscale_factor != 1.0:
+                    buf = buf * jnp.asarray(postscale_factor, buf.dtype)
+            else:
+                buf = in_jit.allreduce(buf, op=op, axis_name=axis_name,
+                                       process_set=process_set,
+                                       prescale_factor=prescale_factor,
+                                       postscale_factor=postscale_factor)
             off = 0
             for i in bucket:
                 sz = compressed[i][0].size

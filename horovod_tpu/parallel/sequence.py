@@ -64,16 +64,25 @@ def _axis_bound(axis_name):
         return False
 
 
-def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False):
+def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False,
+                      use_flash=False):
     """DeepSpeed-Ulysses-style sequence parallelism.
 
     Inputs are sequence-sharded: local shapes (B, L/n, H, D) with H divisible
     by n. Two AllToAlls re-shard tokens<->heads around a full-sequence local
     attention. Outside the axis context (e.g. parameter init) this computes
     plain local attention.
+
+    ``use_flash=True`` runs the per-head-shard full-sequence attention
+    through the Pallas flash kernels (flash_attention handles its own
+    non-TPU fallback), cutting the O(L²) score materialization.
     """
+    if use_flash:
+        from horovod_tpu.ops.pallas import flash_attention as attn
+    else:
+        attn = local_attention
     if not _axis_bound(axis_name):
-        return local_attention(q, k, v, causal=causal)
+        return attn(q, k, v, causal=causal)
     n = lax.axis_size(axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(f"num heads {q.shape[2]} not divisible by sp={n}")
@@ -89,7 +98,7 @@ def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False):
                               tiled=True)
 
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
-    oh = local_attention(qh, kh, vh, causal=causal)
+    oh = attn(qh, kh, vh, causal=causal)
     return gather_heads(oh)
 
 

@@ -61,3 +61,63 @@ def allreduce_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
         n = lax.axis_size(local_axis) * lax.axis_size(cross_axis)
         out = out / jnp.asarray(n, out.dtype)
     return out
+
+
+def allreduce_int8(x, axis_name="hvd", average=False):
+    """Quantized allreduce: int8 on the wire, fp32 accumulation.
+
+    EQuARX-style (Efficient Quantized AllReduce in XLA, arXiv:2506.17615)
+    two-phase exchange built from XLA collectives — the reference's wire
+    compression stops at fp16 casts (horovod/torch/compression.py); this
+    halves the bytes again:
+
+    1. each rank splits its buffer into n destination shards and quantizes
+       symmetrically to int8 with one fp32 scale per 1024-element block,
+    2. one AllToAll moves int8 shards (+ a tiny fp32 scale AllToAll),
+    3. each rank dequantizes and accumulates its shard in fp32
+       (the reduce-scatter leg, 1 byte/element on the wire),
+    4. the reduced shard is requantized block-wise and AllGathered as int8
+       (+ fp32 scales), then dequantized (the all-gather leg, 1 B/el).
+
+    Total wire traffic ≈ 2 bytes/element vs 4 for a bf16 psum's internal
+    reduce-scatter + all-gather — at the cost of one quantization error per
+    leg, bounded per element by its own 1024-block's max/254 (block scales
+    keep small-magnitude tensors in a mixed fused bucket from rounding
+    to zero).
+
+    Works on any local shape; returns the same shape/dtype as ``x``.
+    """
+    n = lax.axis_size(axis_name)
+    orig_shape, orig_dtype = x.shape, x.dtype
+    flat = x.reshape(-1).astype(jnp.float32)
+    size = flat.size
+    # Block-wise scales (EQuARX's block quantization): one fp32 scale per
+    # 1024 elements, NOT per shard — a fused bucket mixes tensors of very
+    # different magnitudes (embedding vs layernorm grads), and a shard-wide
+    # scale would round the small ones to zero every step. 4 bytes per
+    # 1024 ≈ 0.4 % wire overhead.
+    block = 1024
+    pad = (-size) % (n * block)
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    nb = flat.size // (n * block)                    # blocks per shard
+    blocks = flat.reshape(n, nb, block)              # [dest, block, elem]
+    scale = jnp.maximum(
+        jnp.max(jnp.abs(blocks), axis=2) / 127.0, 1e-30)       # (n, nb)
+    q = jnp.clip(jnp.round(blocks / scale[..., None]),
+                 -127, 127).astype(jnp.int8)
+    # Row d goes to rank d; row r of the result came from rank r.
+    qt = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
+    st = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
+    part = jnp.sum(qt.astype(jnp.float32) * st[..., None],
+                   axis=0)                           # (nb, block) fp32
+    s2 = jnp.maximum(jnp.max(jnp.abs(part), axis=1) / 127.0, 1e-30)  # (nb,)
+    q2 = jnp.clip(jnp.round(part / s2[:, None]), -127, 127).astype(jnp.int8)
+    full_q = lax.all_gather(q2, axis_name, axis=0, tiled=False)  # (n,nb,blk)
+    full_s = lax.all_gather(s2, axis_name, axis=0, tiled=False)  # (n, nb)
+    out = (full_q.astype(jnp.float32) * full_s[..., None]).reshape(-1)
+    if pad:
+        out = out[:-pad]
+    if average:
+        out = out / jnp.asarray(n, out.dtype)
+    return out.reshape(orig_shape).astype(orig_dtype)

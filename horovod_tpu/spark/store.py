@@ -54,7 +54,8 @@ class FilesystemStore(Store):
         self._checkpoint_base = checkpoint_path or os.path.join(
             prefix_path, "checkpoints")
         self._logs_base = logs_path or os.path.join(prefix_path, "logs")
-        os.makedirs(prefix_path, exist_ok=True)
+        # Created lazily (make_dirs at first write): merely CONSTRUCTING an
+        # estimator with the default store must not litter the CWD.
 
     def get_train_data_path(self, idx=None):
         return self._train_path if idx is None else \

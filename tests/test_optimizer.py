@@ -48,6 +48,75 @@ class TestFusedTreeAllreduce:
         np.testing.assert_array_equal(np.asarray(out["step"])[1],
                                       tree["step"].sum(0))
 
+    def test_int8_quantized_allreduce_strategy(self, hvd, rng):
+        """strategies.allreduce_int8: exact within two quantization legs
+        (each bounded by max|x|/254 per element)."""
+        from horovod_tpu.parallel.strategies import allreduce_int8
+        x = np.asarray(rng.standard_normal((N, 515)), np.float32)
+
+        def step(t):
+            return allreduce_int8(t, axis_name="hvd")
+
+        out = np.asarray(_shard_step(hvd, step, 1)(x))
+        exact = x.sum(0, keepdims=True)
+        # leg1 error: sum over N ranks of (max|shard|/254); leg2: max|sum|/254
+        tol = N * np.abs(x).max() / 254 + np.abs(exact).max() / 254 + 1e-6
+        assert np.abs(out[0] - exact[0]).max() <= tol
+        # and it is genuinely close (not garbage): relative agreement
+        np.testing.assert_allclose(out[0], exact[0], rtol=0.2, atol=tol)
+
+    def test_int8_compression_in_fused_tree(self, hvd, rng):
+        """Compression.int8 routes buckets through the quantized exchange;
+        the averaged gradient tracks the exact average within quant error."""
+        from horovod_tpu.optim import fused_allreduce_tree
+        from horovod_tpu.ops.compression import Compression
+        x = np.asarray(rng.standard_normal((N, 257)), np.float32)
+
+        def step(t):
+            return fused_allreduce_tree(t, op=hvd.Average,
+                                        compression=Compression.int8)
+
+        out = np.asarray(_shard_step(hvd, step, 1)(x))
+        assert out.dtype == np.float32
+        np.testing.assert_allclose(out[0], x.mean(0), rtol=0.2, atol=2e-2)
+
+    def test_int8_block_scales_preserve_small_tensors(self, hvd, rng):
+        """Block-wise scales: a tiny-magnitude region bucketed next to a
+        large one must keep gradient signal (a shard-wide scale would
+        round it to zero every step)."""
+        from horovod_tpu.parallel.strategies import allreduce_int8
+        big = np.asarray(rng.standard_normal((N, 4096)), np.float32)
+        small = np.asarray(rng.standard_normal((N, 4096)), np.float32) * 1e-5
+        x = np.concatenate([big, small], axis=1)
+
+        def step(t):
+            return allreduce_int8(t, axis_name="hvd")
+
+        out = np.asarray(_shard_step(hvd, step, 1)(x))[0]
+        exact = x.sum(0)
+        small_err = np.abs(out[4096:] - exact[4096:])
+        # Error bounded by the SMALL region's own block maxima, not big's.
+        bound = N * np.abs(small).max() / 254 +             np.abs(exact[4096:]).max() / 254 + 1e-9
+        assert small_err.max() <= bound, (small_err.max(), bound)
+        # The small region's signal survives (correlation, not zeros).
+        assert np.abs(out[4096:]).sum() > 0.5 * np.abs(exact[4096:]).sum()
+
+    def test_int8_warns_on_unhonored_path(self, hvd):
+        """Any path that cannot quantize must warn, not silently degrade."""
+        import warnings
+        from horovod_tpu.ops.compression import Compression, Int8Compressor
+        Int8Compressor._warned = False
+        with pytest.warns(UserWarning, match="UNCOMPRESSED"):
+            Compression.int8.compress(jnp.ones((4,)))
+        Int8Compressor._warned = False
+        # The honored fused route must NOT warn.
+        from horovod_tpu.optim import fused_allreduce_tree
+        x = np.ones((N, 8), np.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            np.asarray(_shard_step(hvd, lambda t: fused_allreduce_tree(
+                t, op=hvd.Sum, compression=Compression.int8), 1)(x))
+
     def test_compression_roundtrip(self, hvd, rng):
         from horovod_tpu.optim import fused_allreduce_tree
         from horovod_tpu.ops.compression import Compression

@@ -138,3 +138,19 @@ class TestRingFlash:
                               jnp.asarray(v), causal=True)
         np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                    rtol=2e-4, atol=2e-5)
+
+
+class TestUlyssesFlash:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_full_attention(self, hvd, rng, causal):
+        """use_flash routes the head-sharded full-sequence attention through
+        flash_attention (which self-falls-back under the CPU interpreter) —
+        results must equal the plain path."""
+        from horovod_tpu.parallel.sequence import (local_attention,
+                                                   ulysses_attention)
+        q, k, v = _qkv(rng)
+        out = _run_sp(hvd, lambda a, b, c: ulysses_attention(
+            a, b, c, causal=causal, use_flash=True), q, k, v)
+        expected = np.asarray(local_attention(
+            jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
+        np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
