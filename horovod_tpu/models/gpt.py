@@ -36,6 +36,8 @@ class GPTConfig:
     tp_axis: Optional[str] = "tp"   # None -> no tensor parallelism
     ep_axis: Optional[str] = "ep"   # axis carrying the experts (often = dp)
     use_flash: bool = False         # Pallas flash attention (ops/pallas)
+    sp_axis: Optional[str] = None   # sequence parallelism: tokens sharded
+    sp_impl: str = "ring"           # "ring" | "ulysses" (parallel/sequence)
 
     @staticmethod
     def tiny(**kw):
@@ -59,6 +61,25 @@ class GPTEmbed(nn.Module):
         pos = self.param("pos_emb", nn.initializers.normal(0.02),
                          (c.max_position_embeddings, c.hidden_size),
                          jnp.float32)
+        if c.sp_axis is not None:
+            # Sequence-parallel: input_ids carry this chip's token shard;
+            # index the position table at the GLOBAL positions of the shard
+            # (outside the axis, e.g. init, the offset is zero).
+            from horovod_tpu.parallel.tp import axis_size_or_1
+            n_sp = axis_size_or_1(c.sp_axis)
+            if n_sp > 1:
+                import jax
+                if n_sp * L > c.max_position_embeddings:
+                    # dynamic_slice would CLAMP out-of-range shards onto
+                    # the last positions — fail loudly like the unsharded
+                    # path's broadcast error does.
+                    raise ValueError(
+                        f"global sequence {n_sp}x{L} exceeds "
+                        f"max_position_embeddings="
+                        f"{c.max_position_embeddings}")
+                off = jax.lax.axis_index(c.sp_axis) * L
+                sl = jax.lax.dynamic_slice_in_dim(pos, off, L)
+                return tok + jnp.asarray(sl, c.dtype)[None]
         return tok + jnp.asarray(pos[:L], c.dtype)[None]
 
 
@@ -89,7 +110,8 @@ class GPTMoEBlock(nn.Module):
         c = self.config
         a = TPSelfAttention(c.num_heads, c.hidden_size, dtype=c.dtype,
                             axis_name=c.tp_axis, causal=True,
-                            use_flash=c.use_flash, name="attention")(
+                            use_flash=c.use_flash, sp_axis=c.sp_axis,
+                            sp_impl=c.sp_impl, name="attention")(
                                 nn.LayerNorm(dtype=c.dtype, name="ln_attn")(x))
         x = x + a
         h, aux = MoEMlp(c.num_experts, c.hidden_size, c.intermediate_size,
@@ -123,5 +145,6 @@ class GPT(nn.Module):
                 x = TPTransformerBlock(
                     c.num_heads, c.hidden_size, c.intermediate_size,
                     dtype=c.dtype, axis_name=c.tp_axis, causal=True,
-                    use_flash=c.use_flash, name=f"layer_{i}")(x)
+                    use_flash=c.use_flash, sp_axis=c.sp_axis,
+                    sp_impl=c.sp_impl, name=f"layer_{i}")(x)
         return GPTHead(c, name="head")(x)

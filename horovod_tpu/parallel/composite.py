@@ -129,7 +129,9 @@ class CompositeGPT:
         self.block = TPTransformerBlock(
             c.num_heads, c.hidden_size, c.intermediate_size, dtype=c.dtype,
             axis_name=TP_AXIS, causal=True,
-            use_flash=getattr(c, "use_flash", False))
+            use_flash=getattr(c, "use_flash", False),
+            sp_axis=getattr(c, "sp_axis", None),
+            sp_impl=getattr(c, "sp_impl", "ring"))
         self.moe = None
         if c.num_experts:
             self.moe = MoEMlp(c.num_experts, c.hidden_size,

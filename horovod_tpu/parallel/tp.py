@@ -135,6 +135,8 @@ class TPSelfAttention(nn.Module):
     axis_name: Optional[str] = TP_AXIS
     causal: bool = False
     use_flash: bool = False   # tiled Pallas attention (ops/pallas)
+    sp_axis: Optional[str] = None   # sequence-parallel axis (tokens sharded)
+    sp_impl: str = "ring"           # "ring" | "ulysses"
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -157,7 +159,29 @@ class TPSelfAttention(nn.Module):
             return t.reshape(t.shape[:-1] + (local_heads, head_dim))
 
         q, k, v = heads(q), heads(k), heads(v)
-        if self.use_flash and mask is None:
+        if self.sp_axis is not None:
+            # Sequence parallelism: x carries this chip's token shard; the
+            # QKV/out projections are token-local, the attention itself
+            # runs over the sp ring (or Ulysses head exchange). Composes
+            # with tp: heads are already the tp-local subset. Outside the
+            # axis (init) both schemes degrade to local attention.
+            if mask is not None:
+                raise ValueError(
+                    "padding masks are not supported with sp_axis (causal "
+                    "masking is handled inside the sp schemes)")
+            from horovod_tpu.parallel.sequence import (ring_attention,
+                                                       ulysses_attention)
+            if self.sp_impl == "ring":
+                out = ring_attention(q, k, v, axis_name=self.sp_axis,
+                                     causal=self.causal,
+                                     use_flash=self.use_flash)
+            elif self.sp_impl == "ulysses":
+                out = ulysses_attention(q, k, v, axis_name=self.sp_axis,
+                                        causal=self.causal,
+                                        use_flash=self.use_flash)
+            else:
+                raise ValueError(f"unknown sp_impl {self.sp_impl!r}")
+        elif self.use_flash and mask is None:
             from horovod_tpu.ops.pallas import flash_attention
             out = flash_attention(q, k, v, causal=self.causal)
         else:
@@ -208,12 +232,15 @@ class TPTransformerBlock(nn.Module):
     axis_name: Optional[str] = TP_AXIS
     causal: bool = False
     use_flash: bool = False
+    sp_axis: Optional[str] = None
+    sp_impl: str = "ring"
 
     @nn.compact
     def __call__(self, x, mask=None):
         a = TPSelfAttention(self.num_heads, self.hidden_size,
                             dtype=self.dtype, axis_name=self.axis_name,
                             causal=self.causal, use_flash=self.use_flash,
+                            sp_axis=self.sp_axis, sp_impl=self.sp_impl,
                             name="attention")(
                                 nn.LayerNorm(dtype=self.dtype,
                                              name="ln_attn")(x), mask)
