@@ -119,6 +119,14 @@ class CompositeGPT:
         for ax in (DP_AXIS, PPL_AXIS, TP_AXIS):
             if ax not in self.mesh.shape:
                 raise ValueError(f"mesh must have axis {ax!r}")
+        if getattr(c, "sp_axis", None) is not None:
+            # The composite step shards ids over dp only; honoring sp_axis
+            # would need a 4-D mesh and sp-sharded inputs throughout the
+            # pipeline. Refuse loudly rather than half-apply (the embed
+            # would offset positions while attention stayed local).
+            raise NotImplementedError(
+                "CompositeGPT does not support config.sp_axis; use "
+                "GPT(sp_axis=...) for sequence parallelism or unset it")
         self.pp = self.mesh.shape[PPL_AXIS]
         if c.num_layers % self.pp != 0:
             raise ValueError(
@@ -129,9 +137,7 @@ class CompositeGPT:
         self.block = TPTransformerBlock(
             c.num_heads, c.hidden_size, c.intermediate_size, dtype=c.dtype,
             axis_name=TP_AXIS, causal=True,
-            use_flash=getattr(c, "use_flash", False),
-            sp_axis=getattr(c, "sp_axis", None),
-            sp_impl=getattr(c, "sp_impl", "ring"))
+            use_flash=getattr(c, "use_flash", False))
         self.moe = None
         if c.num_experts:
             self.moe = MoEMlp(c.num_experts, c.hidden_size,

@@ -406,3 +406,13 @@ class TestSequenceParallelGPT:
                 lambda p, i: model.apply({"params": p}, i), mesh=mesh,
                 in_specs=(P(), P(None, "hvd")),
                 out_specs=P(None, "hvd", None)))(params, ids)
+
+    def test_composite_rejects_sp_axis(self, hvd):
+        """CompositeGPT can't honor sp; it must refuse, not half-apply."""
+        import jax
+        from horovod_tpu.models.gpt import GPTConfig
+        from horovod_tpu.parallel.composite import CompositeGPT, build_mesh3d
+        import optax
+        cfg = GPTConfig.tiny(sp_axis="sp")
+        with pytest.raises(NotImplementedError, match="sp_axis"):
+            CompositeGPT(cfg, build_mesh3d(2, 2, 2), optax.adam(1e-3))
