@@ -3,7 +3,10 @@ from horovod_tpu.parallel.dp import (  # noqa: F401
     ZeroTrainState,
 )
 from horovod_tpu.parallel.strategies import (  # noqa: F401
-    allreduce_hierarchical, allreduce_torus,
+    allreduce_hierarchical, allreduce_int8, allreduce_torus,
+)
+from horovod_tpu.parallel.fsdp import (  # noqa: F401
+    fsdp_shardings, make_fsdp_train_step, shard_batch, shard_params,
 )
 from horovod_tpu.parallel.sequence import (  # noqa: F401
     local_attention, ring_attention, ulysses_attention,

@@ -388,6 +388,28 @@ def _zero_step_worker():
     return round(float(loss), 6)
 
 
+def _fsdp_step_worker():
+    """FSDP/ZeRO-3 across a real process boundary: params, grads and adam
+    moments sharded over a mesh spanning two processes; GSPMD's gathers
+    and reduce-scatters cross the boundary."""
+    import optax
+    from horovod_tpu.parallel.fsdp import make_fsdp_train_step, shard_batch
+
+    mesh, params, loss_fn, batch = _mlp_setup()
+    tx = optax.adam(1e-2)
+    init_fn, step_fn = make_fsdp_train_step(loss_fn, tx, mesh, min_size=8,
+                                            donate=False)
+    sp, so = init_fn(params)
+    assert not sp["Dense_0"]["kernel"].sharding.is_fully_replicated
+    gbatch = shard_batch(batch, mesh)
+    losses = []
+    for _ in range(3):
+        sp, so, loss = step_fn(sp, so, gbatch)
+        losses.append(float(loss))
+    assert losses[-1] < losses[0], losses
+    return round(losses[-1], 6)
+
+
 class TestMultiProcessTrainStep:
     def test_dp_train_step_crosses_processes(self):
         results = run(_train_step_worker, hosts="localhost:2,127.0.0.1:2")
@@ -396,6 +418,11 @@ class TestMultiProcessTrainStep:
 
     def test_zero_train_step_crosses_processes(self):
         results = run(_zero_step_worker, hosts="localhost:2,127.0.0.1:2")
+        assert len(results) == 2
+        assert results[0] == results[1]
+
+    def test_fsdp_train_step_crosses_processes(self):
+        results = run(_fsdp_step_worker, hosts="localhost:2,127.0.0.1:2")
         assert len(results) == 2
         assert results[0] == results[1]
 
