@@ -25,13 +25,21 @@ from horovod_tpu.common.topology import CROSS_AXIS, LOCAL_AXIS
 
 
 def allreduce_torus(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
-                    average=False, flatten=True):
+                    average=False, flatten=True, cross_compression=None):
     """2-level allreduce: ICI reduce-scatter, DCN shard allreduce, ICI
-    all-gather. Bit-equivalent to a flat allreduce; bandwidth-optimal when the
-    cross link is the bottleneck.
+    all-gather. Bit-equivalent to a flat allreduce (UNLESS
+    ``cross_compression`` is set); bandwidth-optimal when the cross link is
+    the bottleneck.
 
     ``x`` is this chip's local value. Requires ``x.size`` divisible by the
     local axis size when ``flatten`` (pads otherwise).
+
+    ``cross_compression="int8"`` (lossy) quantizes ONLY the cross (DCN) leg
+    via :func:`allreduce_int8` — the ICI reduce-scatter/all-gather stay
+    full precision while the slow inter-slice hop moves ~2 bytes/element
+    (the EQuARX deployment shape: quantize where bandwidth hurts). Shards
+    too small to amortize the int8 exchange's cross_n×1024 block padding
+    fall back to the exact psum (compressing them would move MORE bytes).
     """
     local_n = lax.axis_size(local_axis)
     orig_shape = x.shape
@@ -40,7 +48,19 @@ def allreduce_torus(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
     if pad:
         flat = jnp.pad(flat, (0, pad))
     shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
-    shard = lax.psum(shard, cross_axis)
+    cross_n = lax.axis_size(cross_axis)
+    if cross_compression == "int8" and shard.size >= cross_n * 1024:
+        shard = allreduce_int8(shard, axis_name=cross_axis)
+    elif cross_compression == "int8":
+        # Below one 1024-block per cross rank the padded int8 exchange
+        # would move MORE bytes than the exact fp32 psum — stay exact.
+        shard = lax.psum(shard, cross_axis)
+    elif cross_compression is not None:
+        raise ValueError(
+            f"unknown cross_compression {cross_compression!r}; "
+            "use None or 'int8'")
+    else:
+        shard = lax.psum(shard, cross_axis)
     full = lax.all_gather(shard, local_axis, axis=0, tiled=True)
     if pad:
         full = full[:-pad]
