@@ -211,6 +211,41 @@ def _bench_gpt(hvd):
           round(batch * seq * iters / dt / n, 1), "tokens/sec/chip", 0.0)
 
 
+def _bench_vit(hvd):
+    """ViT-B/16 ImageNet-shape training step, bf16. 196 patches admit no
+    aligned flash block so attention runs the plain XLA path (trivial at
+    this length); the MXU work is the patch/MLP matmuls.
+    Reports images/sec/chip (no reference number exists)."""
+    from horovod_tpu.models import ViT, ViTConfig
+    from horovod_tpu.optim import DistributedOptimizer
+    from horovod_tpu.parallel import TrainState, make_train_step
+
+    n = hvd.size()
+    mesh = hvd.global_process_set.mesh
+    per_chip = int(os.environ.get("HVD_BENCH_BATCH", "128"))
+    batch = per_chip * n
+    cfg = ViTConfig.base(dtype=jnp.bfloat16)
+    model = ViT(cfg)
+    rng = np.random.default_rng(0)
+    images = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),
+                         jnp.bfloat16)
+    labels = jnp.asarray(rng.integers(0, 1000, (batch,)), jnp.int32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), images[:1])
+    _mark("vit init done")
+    opt = DistributedOptimizer(optax.adamw(1e-4))
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]).mean()
+
+    step = make_train_step(loss_fn, opt, mesh, donate=True)
+    state = TrainState.create(variables["params"], opt)
+    iters, dt = _timed_steps(step, state, {"x": images, "y": labels})
+    _emit("vit_b16_images_per_sec_per_chip",
+          round(batch * iters / dt / n, 2), "images/sec/chip", 0.0)
+
+
 # The reference's headline benchmark trio is ResNet-101 / Inception V3 /
 # VGG-16 (reference: docs/benchmarks.rst:12-13,28-42) with ResNet-50 the
 # BASELINE.md tracked flagship.  name -> (model factory kwargs name, image
@@ -284,6 +319,18 @@ def _bench_image(hvd, name):
           round(per_chip / baseline, 3) if baseline else 0.0)
 
 
+# Non-image benchmarks: selector -> (bench fn, metric name, unit). One
+# registry so dispatch and failure records can never disagree.
+_EXTRA_MODELS = {
+    "bert": (_bench_bert, "bert_large_seqs_per_sec_per_chip",
+             "sequences/sec/chip"),
+    "gpt": (_bench_gpt, "gpt2_small_tokens_per_sec_per_chip",
+            "tokens/sec/chip"),
+    "vit": (_bench_vit, "vit_b16_images_per_sec_per_chip",
+            "images/sec/chip"),
+}
+
+
 def main():
     import horovod_tpu as hvd
 
@@ -291,13 +338,12 @@ def main():
     _init_with_retry(hvd)
     _mark("hvd.init done")
     model_sel = os.environ.get("HVD_BENCH_MODEL", "resnet50")
-    if model_sel == "bert":
-        return _bench_bert(hvd)
-    if model_sel == "gpt":
-        return _bench_gpt(hvd)
+    if model_sel in _EXTRA_MODELS:
+        return _EXTRA_MODELS[model_sel][0](hvd)
     if model_sel not in _IMAGE_MODELS:
-        raise ValueError(f"unknown HVD_BENCH_MODEL={model_sel!r}; choose "
-                         f"from {sorted(_IMAGE_MODELS) + ['bert', 'gpt']}")
+        raise ValueError(
+            f"unknown HVD_BENCH_MODEL={model_sel!r}; choose from "
+            f"{sorted(_IMAGE_MODELS) + sorted(_EXTRA_MODELS)}")
     return _bench_image(hvd, model_sel)
 
 
@@ -305,10 +351,8 @@ def _failure_metric():
     """Failure-record metric name for the SELECTED benchmark, so a BERT/GPT
     failure never reads as a resnet50 regression."""
     sel = os.environ.get("HVD_BENCH_MODEL", "resnet50")
-    if sel == "bert":
-        return "bert_large_seqs_per_sec_per_chip", "sequences/sec/chip"
-    if sel == "gpt":
-        return "gpt2_small_tokens_per_sec_per_chip", "tokens/sec/chip"
+    if sel in _EXTRA_MODELS:
+        return _EXTRA_MODELS[sel][1], _EXTRA_MODELS[sel][2]
     name = sel if sel in _IMAGE_MODELS else "resnet50"
     return f"{name}_images_per_sec_per_chip", "images/sec/chip"
 

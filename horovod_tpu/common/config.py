@@ -106,6 +106,21 @@ class Config:
     # Donate fused buffers to XLA (buffer reuse).
     donate_buffers: bool = True
 
+    def __post_init__(self):
+        # Normalize/validate on EVERY construction path (env, CLI, direct):
+        # the fusion runtime CASTS float buffers to this dtype, so an
+        # integer/bogus value would silently destroy gradients (quantized
+        # int8 is a different mechanism: Compression.int8).
+        self.wire_dtype = {"fp16": "float16",
+                           "bf16": "bfloat16"}.get(self.wire_dtype,
+                                                   self.wire_dtype)
+        if self.wire_dtype and self.wire_dtype not in ("float16",
+                                                       "bfloat16"):
+            raise ValueError(
+                f"wire_dtype={self.wire_dtype!r}: only float16/bfloat16 "
+                "cast compression is valid here; for quantized int8 use "
+                "Compression.int8 on the optimizer")
+
     @classmethod
     def from_env(cls):
         c = cls()
@@ -163,5 +178,6 @@ class Config:
                                             c.coordinator_addr)
         c.coordinator_port = _env_int("HOROVOD_COORDINATOR_PORT", c.coordinator_port)
         c.wire_dtype = os.environ.get("HOROVOD_WIRE_DTYPE", c.wire_dtype)
+        c.__post_init__()  # re-normalize after the env override
         c.donate_buffers = _env_bool("HOROVOD_DONATE_BUFFERS", c.donate_buffers)
         return c

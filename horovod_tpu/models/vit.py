@@ -1,0 +1,84 @@
+"""Vision Transformer (ViT) for the image benchmark zoo.
+
+The reference framework ships no model zoo (its examples/ tree is absent
+from the snapshot, SURVEY.md intro); models here exercise and benchmark the
+distributed machinery. ViT rounds out the image family (ResNet/VGG/
+Inception are conv-era; this is the MXU-friendliest image model: one patch
+conv then pure matmuls) and reuses the framework's parallel encoder block —
+``TPTransformerBlock(causal=False)`` — so tensor parallelism and the Pallas
+flash-attention kernels apply to vision the same way they do to GPT/BERT.
+
+TPU-first choices: bf16 activations with fp32 params/logits, NHWC patching
+via one strided conv, learned position embeddings, pre-LN blocks, mean
+pooling (no cls token: one less ragged dimension for the MXU).
+"""
+
+import dataclasses
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from horovod_tpu.parallel.tp import TPTransformerBlock
+
+
+@dataclasses.dataclass(frozen=True)
+class ViTConfig:
+    image_size: int = 224
+    patch_size: int = 16
+    hidden_size: int = 768
+    num_layers: int = 12
+    num_heads: int = 12
+    intermediate_size: int = 3072
+    num_classes: int = 1000
+    dtype: Any = jnp.float32
+    tp_axis: Optional[str] = None   # tensor parallelism over heads/MLP
+    use_flash: bool = False         # Pallas attention (ops/pallas)
+
+    @staticmethod
+    def base(**kw):
+        """ViT-B/16 (86M params)."""
+        return ViTConfig(**kw)
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(image_size=32, patch_size=8, hidden_size=64,
+                    num_layers=2, num_heads=4, intermediate_size=128,
+                    num_classes=10)
+        base.update(kw)
+        return ViTConfig(**base)
+
+
+class ViT(nn.Module):
+    """Patch embed -> encoder blocks -> mean-pool -> linear head."""
+    config: ViTConfig
+
+    @nn.compact
+    def __call__(self, images):
+        c = self.config
+        p = c.patch_size
+        x = nn.Conv(c.hidden_size, (p, p), strides=(p, p), padding="VALID",
+                    dtype=c.dtype, name="patch_embed")(
+                        images.astype(c.dtype))
+        B = x.shape[0]
+        x = x.reshape(B, -1, c.hidden_size)           # (B, n_patches, H)
+        n_tok = x.shape[1]
+        expect = (c.image_size // p) ** 2
+        if n_tok != expect:
+            # A smaller image would silently take the first rows of the 2-D
+            # position grid (wrong geometry) — fail loudly instead.
+            raise ValueError(
+                f"got {n_tok} patches but config.image_size="
+                f"{c.image_size} implies {expect}; resize the input or "
+                "the config")
+        pos = self.param("pos_emb", nn.initializers.normal(0.02),
+                         (expect, c.hidden_size), jnp.float32)
+        x = x + jnp.asarray(pos, c.dtype)[None]
+        for i in range(c.num_layers):
+            x = TPTransformerBlock(
+                c.num_heads, c.hidden_size, c.intermediate_size,
+                dtype=c.dtype, axis_name=c.tp_axis, causal=False,
+                use_flash=c.use_flash, name=f"layer_{i}")(x)
+        x = nn.LayerNorm(dtype=c.dtype, name="ln_f")(x)
+        x = jnp.mean(x.astype(jnp.float32), axis=1)   # mean pool, fp32
+        return nn.Dense(c.num_classes, dtype=jnp.float32, name="head")(x)
