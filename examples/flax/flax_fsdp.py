@@ -1,0 +1,78 @@
+"""FSDP / ZeRO-3 training: params, grads and optimizer state sharded 1/n.
+
+The whole sharding story is per-leaf NamedShardings + one jitted step —
+GSPMD inserts and overlaps the all-gather/reduce-scatter schedule
+(reference analog: none — Horovod replicates parameters on every worker;
+this is the capability ladder's top rung above ZeRO-1, see
+docs/parallelism.md).
+
+Run on any mesh, e.g. the virtual CPU mesh:
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python flax_fsdp.py --steps 20
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import MLP
+from horovod_tpu.parallel import make_fsdp_train_step, shard_batch
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--width", type=int, default=256)
+    ap.add_argument("--steps", type=int, default=20)
+    ap.add_argument("--batch", type=int, default=64)
+    args = ap.parse_args()
+    if args.steps < 1:
+        ap.error("--steps must be >= 1")
+
+    hvd.init()
+    mesh = hvd.global_process_set.mesh
+    n = hvd.size()
+    if args.width % n:
+        # fsdp_spec shards the largest n-divisible dim; an indivisible
+        # width would leave the kernels replicated and defeat the demo.
+        ap.error(f"--width {args.width} must be divisible by the mesh "
+                 f"size ({n} chips)")
+
+    model = MLP(features=[args.width, args.width, 10])
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.standard_normal((args.batch, 32)), jnp.float32)
+    y = jnp.asarray(rng.integers(0, 10, (args.batch,)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), x[:1])["params"]
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["x"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b["y"]).mean()
+
+    init_fn, step_fn = make_fsdp_train_step(
+        loss_fn, optax.adam(1e-3), mesh, min_size=1024, donate=False)
+    params, opt_state = init_fn(params)
+    batch = shard_batch({"x": x, "y": y}, mesh)
+
+    big = params["Dense_1"]["kernel"]
+    per_chip = big.addressable_shards[0].data.size
+    if hvd.rank() == 0:
+        print(f"mesh: {n} chips; Dense_1 kernel {big.size} params, "
+              f"{per_chip}/chip ({'sharded' if per_chip < big.size else 'replicated'})")
+
+    for i in range(args.steps):
+        params, opt_state, loss = step_fn(params, opt_state, batch)
+        if hvd.rank() == 0 and i % 5 == 0:
+            print(f"step {i}: loss {float(loss):.4f}")
+    if hvd.rank() == 0:
+        print(f"final loss {float(loss):.4f}")
+        if n > 1:  # single-device shardings are trivially replicated
+            assert not params["Dense_1"]["kernel"] \
+                .sharding.is_fully_replicated, "FSDP layout lost"
+
+
+if __name__ == "__main__":
+    main()

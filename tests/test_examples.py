@@ -79,6 +79,12 @@ class TestExamples:
         assert "final loss" in out
         assert "total context 32 tokens" in out
 
+    def test_flax_fsdp(self):
+        out = _run("flax/flax_fsdp.py", "--width", "64", "--steps", "6",
+                   "--batch", "8")
+        assert "final loss" in out
+        assert "sharded" in out
+
     def test_flax_zero_optimizer(self):
         out = _run("flax/flax_zero_optimizer.py", "--width", "32",
                    "--steps", "4", "--batch-size", "4")
