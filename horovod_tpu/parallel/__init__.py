@@ -9,7 +9,8 @@ from horovod_tpu.parallel.fsdp import (  # noqa: F401
     fsdp_shardings, make_fsdp_train_step, shard_batch, shard_params,
 )
 from horovod_tpu.parallel.sequence import (  # noqa: F401
-    local_attention, ring_attention, ulysses_attention,
+    local_attention, next_token_labels, ring_attention,
+    ulysses_attention,
 )
 from horovod_tpu.parallel.tp import (  # noqa: F401
     ColumnParallelDense, RowParallelDense, TPMlp, TPSelfAttention,

@@ -102,6 +102,31 @@ def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False,
     return gather_heads(oh)
 
 
+def next_token_labels(ids, axis_name=SP_AXIS, pad_id=-100):
+    """Per-shard next-token labels under sequence sharding.
+
+    With tokens sharded over ``axis_name`` each shard's LAST position's
+    label is the FIRST token of the next shard — a shift inside the local
+    slice silently trains the boundary position on the wrong target. This
+    fetches the boundary token with one ``ppermute``; the final global
+    position gets ``pad_id`` (mask it out of the loss, e.g. optax's
+    ``where=labels != pad_id``). Outside the axis context this is the
+    ordinary global shift.
+
+    ``ids``: (B, L_local) int tokens. Returns same-shape labels.
+    """
+    pad = jnp.full_like(ids[:, :1], pad_id)
+    if not _axis_bound(axis_name):
+        return jnp.concatenate([ids[:, 1:], pad], axis=1)
+    n = lax.axis_size(axis_name)
+    idx = lax.axis_index(axis_name)
+    # rank i receives rank i+1's first token (reverse ring direction).
+    first_next = lax.ppermute(ids[:, :1], axis_name,
+                              [((i + 1) % n, i) for i in range(n)])
+    boundary = jnp.where(idx == n - 1, pad, first_next)
+    return jnp.concatenate([ids[:, 1:], boundary], axis=1)
+
+
 def _block_attn_fwd(q3, ks, vs, causal, scale, blocks):
     """(o_b, lse_b) for one ring hop on (BH, L, D) blocks: the Pallas flash
     kernel on TPU, the shared jnp block oracle elsewhere (the interpreter

@@ -154,3 +154,28 @@ class TestUlyssesFlash:
         expected = np.asarray(local_attention(
             jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), causal=causal))
         np.testing.assert_allclose(out, expected, rtol=2e-4, atol=2e-5)
+
+
+class TestNextTokenLabels:
+    def test_matches_global_shift(self, hvd):
+        """Sharded labels == the global shift re-sharded; boundary tokens
+        come from the NEXT shard, final position padded."""
+        import jax
+        from horovod_tpu.parallel.sequence import next_token_labels
+
+        n = hvd.size()
+        ids = np.arange(2 * 8 * n, dtype=np.int32).reshape(2, 8 * n)
+        mesh = hvd.global_process_set.mesh
+        out = np.asarray(jax.jit(jax.shard_map(
+            lambda t: next_token_labels(t, axis_name="hvd"), mesh=mesh,
+            in_specs=P(None, "hvd"), out_specs=P(None, "hvd")))(ids))
+        expect = np.concatenate(
+            [ids[:, 1:], np.full((2, 1), -100, np.int32)], axis=1)
+        np.testing.assert_array_equal(out, expect)
+
+    def test_unsharded_fallback(self, hvd):
+        from horovod_tpu.parallel.sequence import next_token_labels
+        ids = jnp.arange(12, dtype=jnp.int32).reshape(1, 12)
+        out = np.asarray(next_token_labels(ids))
+        np.testing.assert_array_equal(out[0, :-1], np.arange(1, 12))
+        assert out[0, -1] == -100
