@@ -136,7 +136,12 @@ def _bench_bert(hvd):
     seq = int(os.environ.get("HVD_BENCH_SEQ", "128"))
     per_chip = int(os.environ.get("HVD_BENCH_BATCH", "32"))
     batch = per_chip * n
-    cfg = BertConfig.large()
+    import dataclasses
+    # flash default-on (HVD_BENCH_FLASH=0 for plain): no padding in the
+    # synthetic batch and dropout is off under deterministic apply.
+    cfg = dataclasses.replace(
+        BertConfig.large(),
+        use_flash=os.environ.get("HVD_BENCH_FLASH", "1") == "1")
     model = BertForPreTraining(cfg)
 
     rng = np.random.default_rng(0)

@@ -25,6 +25,8 @@ class BertConfig:
     type_vocab_size: int = 2
     dropout_rate: float = 0.1
     dtype: Any = jnp.bfloat16
+    use_flash: bool = False          # Pallas flash attention (ops/pallas);
+    # engages when no padding mask is given and dropout is off
 
     @staticmethod
     def base():
@@ -59,13 +61,22 @@ class SelfAttention(nn.Module):
             return t.reshape(t.shape[:-1] + (c.num_heads, head_dim))
 
         q, k, v = heads(q), heads(k), heads(v)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-        if mask is not None:
-            big_neg = jnp.asarray(-1e9, scores.dtype)
-            scores = jnp.where(mask[:, None, None, :], scores, big_neg)
-        probs = nn.softmax(scores.astype(jnp.float32)).astype(c.dtype)
-        probs = nn.Dropout(c.dropout_rate)(probs, deterministic=deterministic)
-        out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        if c.use_flash and mask is None and (deterministic
+                                             or c.dropout_rate == 0.0):
+            # Bidirectional flash (tiled online softmax): padding masks and
+            # attention dropout aren't expressible in the kernel, so those
+            # cases keep the plain path below.
+            from horovod_tpu.ops.pallas import flash_attention
+            out = flash_attention(q, k, v, causal=False)
+        else:
+            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+            if mask is not None:
+                big_neg = jnp.asarray(-1e9, scores.dtype)
+                scores = jnp.where(mask[:, None, None, :], scores, big_neg)
+            probs = nn.softmax(scores.astype(jnp.float32)).astype(c.dtype)
+            probs = nn.Dropout(c.dropout_rate)(probs,
+                                               deterministic=deterministic)
+            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
         out = out.reshape(out.shape[:-2] + (c.hidden_size,))
         return nn.Dense(c.hidden_size, dtype=c.dtype, name="out")(out)
 
@@ -96,8 +107,8 @@ class BertModel(nn.Module):
         B, L = input_ids.shape
         if token_type_ids is None:
             token_type_ids = jnp.zeros_like(input_ids)
-        if attention_mask is None:
-            attention_mask = jnp.ones_like(input_ids, dtype=bool)
+        # No synthesized all-ones mask: None means "no padding", which the
+        # attention treats identically and which lets flash engage.
         tok = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
                        name="tok_emb")(input_ids)
         pos = nn.Embed(c.max_position_embeddings, c.hidden_size,
@@ -107,9 +118,11 @@ class BertModel(nn.Module):
                        name="type_emb")(token_type_ids)
         x = nn.LayerNorm(dtype=c.dtype, name="ln_emb")(tok + pos + typ)
         x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
+        mask = None if attention_mask is None \
+            else attention_mask.astype(bool)
         for i in range(c.num_layers):
             x = TransformerBlock(c, name=f"layer_{i}")(
-                x, attention_mask.astype(bool), deterministic)
+                x, mask, deterministic)
         pooled = nn.tanh(nn.Dense(c.hidden_size, dtype=c.dtype,
                                   name="pooler")(x[:, 0]))
         return x, pooled
