@@ -91,6 +91,12 @@ def _init_with_retry(hvd, attempts=8, first_delay=5.0):
             delay = min(delay * 2, 60.0)
 
 
+def _flash_default():
+    """Pallas flash attention default-on for every transformer bench;
+    HVD_BENCH_FLASH=0 opts out to plain XLA attention."""
+    return os.environ.get("HVD_BENCH_FLASH", "1") == "1"
+
+
 def _timed_steps(step, state, data, warmup=2):
     """Shared timing protocol for every benchmark: `warmup` compiled+synced
     steps, then HVD_BENCH_ITERS timed steps with one trailing device_get.
@@ -136,12 +142,9 @@ def _bench_bert(hvd):
     seq = int(os.environ.get("HVD_BENCH_SEQ", "128"))
     per_chip = int(os.environ.get("HVD_BENCH_BATCH", "32"))
     batch = per_chip * n
-    import dataclasses
-    # flash default-on (HVD_BENCH_FLASH=0 for plain): no padding in the
-    # synthetic batch and dropout is off under deterministic apply.
-    cfg = dataclasses.replace(
-        BertConfig.large(),
-        use_flash=os.environ.get("HVD_BENCH_FLASH", "1") == "1")
+    # No padding in the synthetic batch and dropout is off under
+    # deterministic apply, so flash engages.
+    cfg = BertConfig.large(use_flash=_flash_default())
     model = BertForPreTraining(cfg)
 
     rng = np.random.default_rng(0)
@@ -193,7 +196,7 @@ def _bench_gpt(hvd):
                     num_heads=12, intermediate_size=3072,
                     max_position_embeddings=seq, dtype=jnp.bfloat16,
                     tp_axis=None, ep_axis=None,
-                    use_flash=os.environ.get("HVD_BENCH_FLASH", "1") == "1")
+                    use_flash=_flash_default())
     model = GPT(cfg)
 
     rng = np.random.default_rng(0)
@@ -217,9 +220,9 @@ def _bench_gpt(hvd):
 
 
 def _bench_vit(hvd):
-    """ViT-B/16 ImageNet-shape training step, bf16. 196 patches admit no
-    aligned flash block so attention runs the plain XLA path (trivial at
-    this length); the MXU work is the patch/MLP matmuls.
+    """ViT-B/16 ImageNet-shape training step, bf16, flash attention by
+    default (196 patches pad to 256-row blocks inside the kernels;
+    HVD_BENCH_FLASH=0 for plain XLA attention).
     Reports images/sec/chip (no reference number exists)."""
     from horovod_tpu.models import ViT, ViTConfig
     from horovod_tpu.optim import DistributedOptimizer
@@ -229,7 +232,7 @@ def _bench_vit(hvd):
     mesh = hvd.global_process_set.mesh
     per_chip = int(os.environ.get("HVD_BENCH_BATCH", "128"))
     batch = per_chip * n
-    cfg = ViTConfig.base(dtype=jnp.bfloat16)
+    cfg = ViTConfig.base(dtype=jnp.bfloat16, use_flash=_flash_default())
     model = ViT(cfg)
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),

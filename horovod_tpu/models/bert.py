@@ -29,20 +29,24 @@ class BertConfig:
     # engages when no padding mask is given and dropout is off
 
     @staticmethod
-    def base():
-        return BertConfig(hidden_size=768, num_layers=12, num_heads=12,
-                          intermediate_size=3072)
+    def base(**kw):
+        cfg = dict(hidden_size=768, num_layers=12, num_heads=12,
+                   intermediate_size=3072)
+        cfg.update(kw)
+        return BertConfig(**cfg)
 
     @staticmethod
-    def large():
-        return BertConfig()
+    def large(**kw):
+        return BertConfig(**kw)
 
     @staticmethod
-    def tiny():
+    def tiny(**kw):
         """For tests / dry runs."""
-        return BertConfig(vocab_size=1024, hidden_size=128, num_layers=2,
-                          num_heads=4, intermediate_size=256,
-                          max_position_embeddings=128)
+        cfg = dict(vocab_size=1024, hidden_size=128, num_layers=2,
+                   num_heads=4, intermediate_size=256,
+                   max_position_embeddings=128)
+        cfg.update(kw)
+        return BertConfig(**cfg)
 
 
 class SelfAttention(nn.Module):

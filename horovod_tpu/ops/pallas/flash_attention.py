@@ -18,9 +18,10 @@ tiled over key blocks, each recomputing its score tile in VMEM) — O(L)
 memory end to end. Interpret mode (CPU tests) keeps the plain jnp backward,
 which doubles as the numerical oracle for the kernels.
 
-On CPU (tests, no TPU) the kernel runs through the Pallas interpreter;
-shapes whose sequence length has no aligned block size fall back to plain
-attention.
+On CPU (tests, no TPU) the kernel runs through the Pallas interpreter.
+Sequence lengths with no aligned block size are padded to the next block
+multiple with the padding masked inside the kernels (kv_valid), so
+arbitrary lengths run the kernel path.
 """
 
 import functools
@@ -79,9 +80,30 @@ def _pick_chunk(length, block, cap=4096):
     return c
 
 
+def _apply_mask(s, *, causal, masked, q0, k0, kv_valid, block_q, block_k):
+    """Combined causal + key-validity masking for one (BQ, BK) score tile.
+
+    ``masked`` (static) is True when the key axis was padded to a block
+    multiple: keys at global position >= kv_valid are padding and must not
+    receive weight. ``q0``/``k0`` are the tile's global row/key offsets.
+    """
+    if not (causal or masked):
+        return s
+    k_pos = k0 + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+    ok = None
+    if masked:
+        ok = k_pos < kv_valid
+    if causal:
+        q_pos = q0 + jax.lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0)
+        c = q_pos >= k_pos
+        ok = c if ok is None else ok & c
+    return jnp.where(ok, s, NEG_INF)
+
+
 def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
                *, sm_scale, causal, block_q, block_k, k_chunk, q_offset,
-               n_kc):
+               n_kc, kv_valid, masked):
     """One (query-block, key-chunk) grid step of the online softmax.
 
     The key-chunk sweep is the INNERMOST grid dimension; the running
@@ -104,12 +126,12 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     contributes = jnp.asarray(True)
     if causal:
         contributes = q_end >= jc * k_chunk
+    if masked:
+        contributes = contributes & (jc * k_chunk < kv_valid)
 
     @pl.when(contributes)
     def _compute():
         q = q_ref[0].astype(jnp.float32) * sm_scale        # (BQ, D)
-        q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
 
         def body(t, carry):
             m, l, acc = carry
@@ -117,10 +139,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             vb = v_ref[0, pl.ds(t * block_k, block_k), :].astype(jnp.float32)
             s = jax.lax.dot_general(q, kb, (((1,), (1,)), ((), ())),
                                     preferred_element_type=jnp.float32)
-            if causal:
-                k_pos = jc * k_chunk + t * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _apply_mask(s, causal=causal, masked=masked,
+                            q0=q_offset + qi * block_q,
+                            k0=jc * k_chunk + t * block_k,
+                            kv_valid=kv_valid, block_q=block_q,
+                            block_k=block_k)
             m_new = jnp.maximum(m, jnp.max(s, axis=-1))
             corr = jnp.exp(m - m_new)
             p = jnp.exp(s - m_new[:, None])
@@ -137,6 +160,10 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             # Bound the tile sweep at the diagonal within this chunk.
             n_t = jnp.clip(
                 pl.cdiv(q_end + 1 - jc * k_chunk, block_k), 0, n_t)
+        if masked:
+            # ...and at the last VALID key tile.
+            n_t = jnp.clip(
+                pl.cdiv(kv_valid - jc * k_chunk, block_k), 0, n_t)
         m, l, acc = jax.lax.fori_loop(
             0, n_t, body, (m_ref[:, 0], l_ref[:, 0], acc_ref[...]))
         m_ref[...] = m[:, None]
@@ -153,16 +180,27 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         lse_ref[0] = (m_ref[:, 0] + jnp.log(l_safe))[:, None]
 
 
-def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k):
-    """(BH, Lq, D) x (BH, Lk, D)^2 -> (o, lse)."""
+def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                q_offset=None, kv_valid=None):
+    """(BH, Lq, D) x (BH, Lk, D)^2 -> (o, lse).
+
+    ``q_offset``/``kv_valid`` override the end-aligned causal offset and
+    the number of VALID keys when the inputs were padded to block
+    multiples (positions are always in ORIGINAL coordinates)."""
     bh, lq, d = q.shape
     lk = k.shape[1]
+    if q_offset is None:
+        q_offset = lk - lq
+    if kv_valid is None:
+        kv_valid = lk
+    masked = kv_valid < lk
     k_chunk = _pick_chunk(lk, block_k)
     n_kc = lk // k_chunk
     grid = (bh, lq // block_q, n_kc)
     kernel = functools.partial(_fa_kernel, sm_scale=sm_scale, causal=causal,
                                block_q=block_q, block_k=block_k,
-                               k_chunk=k_chunk, q_offset=lk - lq, n_kc=n_kc)
+                               k_chunk=k_chunk, q_offset=q_offset,
+                               n_kc=n_kc, kv_valid=kv_valid, masked=masked)
     # Inside a VMA-checked shard_map the outputs must declare how they vary
     # over the mesh (they vary exactly like the operands).
     vma = frozenset().union(*(getattr(jax.typeof(t), "vma", frozenset())
@@ -191,20 +229,24 @@ def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k):
     return o, lse[..., 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _flash(q, k, v, causal, sm_scale, block_q, block_k):
-    o, _ = _fa_forward(q, k, v, causal, sm_scale, block_q, block_k)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset=None,
+           kv_valid=None):
+    o, _ = _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                       q_offset, kv_valid)
     return o
 
 
-def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k):
-    o, lse = _fa_forward(q, k, v, causal, sm_scale, block_q, block_k)
+def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset=None,
+               kv_valid=None):
+    o, lse = _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
+                         q_offset, kv_valid)
     return o, (q, k, v, o, lse)
 
 
 def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                       dq_ref, acc_ref, *, sm_scale, causal, block_q,
-                      block_k, k_chunk, q_offset, n_kc):
+                      block_k, k_chunk, q_offset, n_kc, kv_valid, masked):
     """dQ pass: (query-block, key-chunk) grid with the dq accumulator in
     scratch across chunks and a register fori sweep within each chunk."""
     qi = pl.program_id(1)
@@ -218,6 +260,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     contributes = jnp.asarray(True)
     if causal:
         contributes = q_end >= jc * k_chunk
+    if masked:
+        contributes = contributes & (jc * k_chunk < kv_valid)
 
     @pl.when(contributes)
     def _compute():
@@ -225,8 +269,6 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         do = do_ref[0].astype(jnp.float32)
         lse = lse_ref[0, :, 0]                             # (BQ,)
         delta = delta_ref[0, :, 0]
-        q_pos = q_offset + qi * block_q + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 0)
 
         def body(t, dq):
             kb = k_ref[0, pl.ds(t * block_k, block_k), :].astype(jnp.float32)
@@ -234,10 +276,11 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jax.lax.dot_general(
                 q, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
-            if causal:
-                k_pos = jc * k_chunk + t * block_k + jax.lax.broadcasted_iota(
-                    jnp.int32, (block_q, block_k), 1)
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _apply_mask(s, causal=causal, masked=masked,
+                            q0=q_offset + qi * block_q,
+                            k0=jc * k_chunk + t * block_k,
+                            kv_valid=kv_valid, block_q=block_q,
+                            block_k=block_k)
             p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - lse[:, None]), 0.0)
             dp = jax.lax.dot_general(do, vb, (((1,), (1,)), ((), ())),
                                      preferred_element_type=jnp.float32)
@@ -250,6 +293,9 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         if causal:
             n_t = jnp.clip(
                 pl.cdiv(q_end + 1 - jc * k_chunk, block_k), 0, n_t)
+        if masked:
+            n_t = jnp.clip(
+                pl.cdiv(kv_valid - jc * k_chunk, block_k), 0, n_t)
         acc_ref[...] = jax.lax.fori_loop(0, n_t, body, acc_ref[...])
 
     @pl.when(jc == n_kc - 1)
@@ -259,7 +305,8 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                        dk_ref, dv_ref, dk_acc, dv_acc, *, sm_scale, causal,
-                       block_q, block_k, q_chunk, q_offset, n_qc):
+                       block_q, block_k, q_chunk, q_offset, n_qc, kv_valid,
+                       masked):
     """dK/dV pass: (key-block, query-chunk) grid; per-key-block accumulators
     in scratch across query chunks, register fori sweep within."""
     ki = pl.program_id(1)
@@ -275,13 +322,14 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         # Query chunks ending above this key block's diagonal contribute
         # nothing: rows i attend keys <= i + q_offset.
         contributes = (q_offset + (jc + 1) * q_chunk - 1) >= ki * block_k
+    if masked:
+        # Entirely-padding key blocks receive zero gradient.
+        contributes = contributes & (ki * block_k < kv_valid)
 
     @pl.when(contributes)
     def _compute():
         kb = k_ref[0].astype(jnp.float32)                  # (BK, D)
         vb = v_ref[0].astype(jnp.float32)
-        k_pos = ki * block_k + jax.lax.broadcasted_iota(
-            jnp.int32, (block_q, block_k), 1)
 
         def body(t, carry):
             dk, dv = carry
@@ -293,11 +341,10 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
             s = jax.lax.dot_general(
                 qb, kb, (((1,), (1,)), ((), ())),
                 preferred_element_type=jnp.float32) * sm_scale
-            if causal:
-                q_pos = q_offset + jc * q_chunk + t * block_q + \
-                    jax.lax.broadcasted_iota(
-                        jnp.int32, (block_q, block_k), 0)
-                s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _apply_mask(s, causal=causal, masked=masked,
+                            q0=q_offset + jc * q_chunk + t * block_q,
+                            k0=ki * block_k, kv_valid=kv_valid,
+                            block_q=block_q, block_k=block_k)
             p = jnp.where(s > NEG_INF * 0.5,
                           jnp.exp(s - lse_b[:, None]), 0.0)
             dv = dv + jax.lax.dot_general(
@@ -329,11 +376,16 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
 
 
-def _fa_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
+def _fa_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
+                 q_offset=None, kv_valid=None):
     """Fused O(L)-memory backward: (dq, dk, dv) via two pallas_calls."""
     bh, lq, d = q.shape
     lk = k.shape[1]
-    q_offset = lk - lq
+    if q_offset is None:
+        q_offset = lk - lq
+    if kv_valid is None:
+        kv_valid = lk
+    masked = kv_valid < lk
     k_chunk = _pick_chunk(lk, block_k)
     q_chunk = _pick_chunk(lq, block_q)
     n_kc = lk // k_chunk
@@ -342,7 +394,8 @@ def _fa_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
                     axis=-1, keepdims=True)                # (BH, Lq, 1)
     lse3 = lse[..., None]                                  # (BH, Lq, 1)
     common = dict(sm_scale=sm_scale, causal=causal, block_q=block_q,
-                  block_k=block_k, q_offset=q_offset)
+                  block_k=block_k, q_offset=q_offset, kv_valid=kv_valid,
+                  masked=masked)
     q_blk = pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0))
     r_blk = pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0))
     kc_swept = pl.BlockSpec((1, k_chunk, d), lambda b, i, j: (b, j, 0))
@@ -379,17 +432,32 @@ def _fa_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k):
     return dq, dk, dv
 
 
-def _jnp_block_fwd(q3, k3, v3, causal, scale):
+def _mask_jnp(s, causal, q_offset, kv_valid):
+    """Full-matrix analog of _apply_mask for the jnp oracles."""
+    lq, lk = s.shape[1], s.shape[2]
+    if q_offset is None:
+        q_offset = lk - lq
+    if kv_valid is None:
+        kv_valid = lk
+    ok = None
+    if kv_valid < lk:
+        ok = (jnp.arange(lk) < kv_valid)[None, :]
+    if causal:
+        c = (q_offset + jnp.arange(lq))[:, None] >= jnp.arange(lk)[None, :]
+        ok = c if ok is None else ok & c
+    if ok is None:
+        return s
+    return jnp.where(ok[None], s, NEG_INF)
+
+
+def _jnp_block_fwd(q3, k3, v3, causal, scale, q_offset=None, kv_valid=None):
     """jnp oracle for one attention block on (BH, Lq, D): returns
     (o, lse) with the same contract as the forward kernel (end-aligned
-    causal, per-row logsumexp). Shared by the interpret-mode paths here
-    and the ring hops in parallel/sequence.py."""
+    causal, per-row logsumexp, optional key-validity bound). Shared by the
+    interpret-mode paths here and the ring hops in parallel/sequence.py."""
     s = jnp.einsum("bqd,bkd->bqk", q3.astype(jnp.float32),
                    k3.astype(jnp.float32)) * scale
-    if causal:
-        lq, lk = s.shape[1], s.shape[2]
-        s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool),
-                               k=lk - lq)[None], s, NEG_INF)
+    s = _mask_jnp(s, causal, q_offset, kv_valid)
     m = jnp.max(s, axis=-1)
     p = jnp.where(s > NEG_INF * 0.5, jnp.exp(s - m[..., None]), 0.0)
     l = jnp.maximum(jnp.sum(p, axis=-1), 1e-30)
@@ -398,17 +466,15 @@ def _jnp_block_fwd(q3, k3, v3, causal, scale):
     return o, m + jnp.log(l)
 
 
-def _jnp_block_bwd(q3, k3, v3, o3, lse, do3, causal, scale):
+def _jnp_block_bwd(q3, k3, v3, o3, lse, do3, causal, scale,
+                   q_offset=None, kv_valid=None):
     """jnp oracle for the block backward against a given logsumexp: with
     the block's own lse this is exact flash backward; with a ring-wide lse
     it yields the hop's contribution to the global gradient."""
     qf, kf, vf, of, dof = (t.astype(jnp.float32)
                            for t in (q3, k3, v3, o3, do3))
     s = jnp.einsum("bqd,bkd->bqk", qf, kf) * scale
-    if causal:
-        lq, lk = s.shape[1], s.shape[2]
-        s = jnp.where(jnp.tril(jnp.ones((lq, lk), bool),
-                               k=lk - lq)[None], s, NEG_INF)
+    s = _mask_jnp(s, causal, q_offset, kv_valid)
     # Masked entries have s = NEG_INF and a fully-masked row has
     # lse ~= NEG_INF, where exp(s - lse) would blow up instead of vanishing
     # — zero them explicitly (the forward kernel does the same).
@@ -422,12 +488,14 @@ def _jnp_block_bwd(q3, k3, v3, o3, lse, do3, causal, scale):
     return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
-def _flash_bwd(causal, sm_scale, block_q, block_k, res, do):
+def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, kv_valid,
+               res, do):
     q, k, v, o, lse = res
     if not _interpret():
         return _fa_backward(q, k, v, o, lse, do, causal, sm_scale,
-                            block_q, block_k)
-    return _jnp_block_bwd(q, k, v, o, lse, do, causal, sm_scale)
+                            block_q, block_k, q_offset, kv_valid)
+    return _jnp_block_bwd(q, k, v, o, lse, do, causal, sm_scale,
+                          q_offset=q_offset, kv_valid=kv_valid)
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -437,36 +505,48 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
     """Tiled attention over (B, L, H, D) tensors (the layout used throughout
     this codebase, e.g. parallel/sequence.py).
 
-    Falls back to :func:`horovod_tpu.parallel.sequence.local_attention` (the
-    codebase's correctness oracle, same end-aligned causal convention) when
-    the sequence lengths admit no aligned block size; semantics are identical
-    either way.
+    Lengths with no aligned block size are PADDED to the next block
+    multiple and the padding masked inside the kernels (``kv_valid``), so
+    arbitrary sequence lengths (e.g. ViT's 196 patches) run the kernels.
+    Falls back to :func:`horovod_tpu.parallel.sequence.local_attention`
+    (the correctness oracle, same end-aligned causal convention) only
+    where the kernels can't run at all (no pltpu; VMA-checked shard_map
+    under the interpreter).
     """
     b, lq, h, d = q.shape
     lk = k.shape[1]
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
 
-    def to3(t):
-        return jnp.moveaxis(t, 2, 1).reshape(t.shape[0] * h, t.shape[1], d)
-
-    def from3(t):
-        return jnp.moveaxis(t.reshape(b, h, lq, d), 1, 2)
-
-    block_q = _pick_block(lq)
-    block_k = _pick_block(lk)
     # Interpret mode (CPU tests) lowers the kernel body to ordinary JAX ops,
     # whose internal dynamic_slices the shard_map VMA checker rejects when
     # the operands are device-varying; the plain path is bit-compatible
     # there. On TPU the compiled kernel is opaque to the checker.
     vma = frozenset().union(*(getattr(jax.typeof(t), "vma", frozenset())
                               for t in (q, k, v)))
-    if block_q is None or block_k is None or pltpu is None \
-            or (_interpret() and vma):
+    if pltpu is None or (_interpret() and vma):
         from horovod_tpu.parallel.sequence import local_attention
         # local_attention scales by 1/sqrt(D); fold any custom scale into q.
         q_adj = q if sm_scale == 1.0 / (d ** 0.5) \
             else q * (sm_scale * d ** 0.5)
         return local_attention(q_adj, k, v, causal=causal)
-    return from3(_flash(to3(q), to3(k), to3(v), causal, sm_scale,
-                        block_q, block_k))
+
+    # Pad only genuinely unaligned lengths (e.g. ViT's 196): aligned ones
+    # keep their unpadded, unmasked kernels (no pad copy, no mask work).
+    pad_q = 0 if _pick_block(lq) else (-lq) % 128
+    pad_k = 0 if _pick_block(lk) else (-lk) % 128
+    lq_p, lk_p = lq + pad_q, lk + pad_k
+
+    def to3(t, pad):
+        t3 = jnp.moveaxis(t, 2, 1).reshape(t.shape[0] * h, t.shape[1], d)
+        if pad:
+            t3 = jnp.pad(t3, ((0, 0), (0, pad), (0, 0)))
+        return t3
+
+    def from3(t):
+        return jnp.moveaxis(t[:, :lq].reshape(b, h, lq, d), 1, 2)
+
+    out = _flash(to3(q, pad_q), to3(k, pad_k), to3(v, pad_k), causal,
+                 sm_scale, _pick_block(lq_p), _pick_block(lk_p),
+                 lk - lq, lk)
+    return from3(out)

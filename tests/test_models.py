@@ -154,13 +154,31 @@ class TestViT:
         from horovod_tpu.models import ViT, ViTConfig
         x = jnp.asarray(np.asarray(
             rng.standard_normal((2, 32, 32, 3)), np.float32))
-        # tiny: 32/8 -> 16 patches; pad-free flash blocks need %8 == 0
+        # tiny: 32/8 -> 16 patches (block-aligned flash)
         plain = ViT(ViTConfig.tiny())
         flash = ViT(ViTConfig.tiny(use_flash=True))
         params = plain.init(jax.random.PRNGKey(0), x)["params"]
         np.testing.assert_allclose(
             np.asarray(plain.apply({"params": params}, x)),
             np.asarray(flash.apply({"params": params}, x)),
+            rtol=2e-4, atol=2e-4)
+
+
+    def test_flash_unaligned_patch_count(self, hvd, rng):
+        """ViT-B/16's real patch count (196) has no aligned block: the
+        kernels pad to 256 and mask — must match plain attention."""
+        from horovod_tpu.models import ViT, ViTConfig
+        kw = dict(image_size=56, patch_size=4, hidden_size=32,
+                  num_layers=1, num_heads=2, intermediate_size=64,
+                  num_classes=4)   # (56/4)^2 = 196 patches
+        x = jnp.asarray(np.asarray(
+            rng.standard_normal((2, 56, 56, 3)), np.float32))
+        plain = ViT(ViTConfig.tiny(**kw))
+        flash = ViT(ViTConfig.tiny(use_flash=True, **kw))
+        params = plain.init(jax.random.PRNGKey(0), x)["params"]
+        np.testing.assert_allclose(
+            np.asarray(flash.apply({"params": params}, x)),
+            np.asarray(plain.apply({"params": params}, x)),
             rtol=2e-4, atol=2e-4)
 
 
