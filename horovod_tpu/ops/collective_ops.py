@@ -32,11 +32,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.common import basics
 from horovod_tpu.common.exceptions import TensorShapeMismatchError
-from horovod_tpu.common.process_sets import ProcessSet, global_process_set
+from horovod_tpu.common.process_sets import global_process_set
 from horovod_tpu.common.topology import HVD_AXIS
 
 

@@ -23,7 +23,7 @@ AD-inserted XLA collectives scheduled on the ICI torus.
 """
 
 import dataclasses
-from typing import Any, Optional
+from typing import Any
 
 import jax
 import jax.numpy as jnp

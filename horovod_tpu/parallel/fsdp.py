@@ -25,10 +25,9 @@ weights per step, which GSPMD overlaps with the previous layer's compute.
 """
 
 import functools
-from typing import Callable, Optional
+from typing import Callable
 
 import jax
-import jax.numpy as jnp
 import numpy as np
 import optax
 from jax.sharding import NamedSharding

@@ -8,7 +8,6 @@ also works without a Spark cluster; with pyspark installed, Spark DataFrames
 are accepted and converted.
 """
 
-import os
 
 import numpy as np
 
