@@ -1,0 +1,82 @@
+"""Autoregressive generation for the causal-LM zoo (GPT).
+
+The reference ships no inference tooling (docs/inference.rst just points at
+graph-stripping scripts); this is the TPU-native serving loop for the
+models this framework trains.
+
+TPU-first choices: the whole decode loop is ONE compiled program — a
+``lax.scan`` over token positions with a fixed-length buffer (static
+shapes; no per-token host round-trips). Each step re-runs the forward on
+the full buffer with positions beyond the current length masked by the
+causal structure itself (tokens are only appended, and causal attention
+ignores the future), so correctness needs no KV-cache bookkeeping; at the
+modest lengths a single chip serves this keeps the MXU busy with large
+batched matmuls. Sampling: greedy or temperature with a jax PRNG key.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4))
+def _generate(model, params, prompt, max_len, temperature, rng):
+    # ``model`` is static: flax modules hash by their dataclass config, so
+    # repeated generate() calls with the same model/max_len/temperature
+    # reuse one compiled program.
+    B, P = prompt.shape
+
+    buf = jnp.zeros((B, max_len), jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+    def step(carry, t):
+        buf, rng = carry
+        logits = model.apply({"params": params}, buf)   # (B, max_len, V)
+        # logits at position t-1 predict token t
+        nxt_logits = jax.lax.dynamic_slice_in_dim(
+            logits, t - 1, 1, axis=1)[:, 0]         # (B, V)
+        if temperature == 0.0:
+            nxt = jnp.argmax(nxt_logits, axis=-1).astype(jnp.int32)
+        else:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(
+                sub, nxt_logits / temperature).astype(jnp.int32)
+        buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t))
+        return (buf, rng), None
+
+    # Positions < P are the prompt: start decoding at P (one forward per
+    # GENERATED token, none wasted re-writing prompt tokens).
+    (buf, _), _ = lax.scan(step, (buf, rng), jnp.arange(P, max_len))
+    return buf
+
+
+def generate(model, params, prompt, max_len, temperature=0.0, rng=None):
+    """Generate up to ``max_len`` total tokens from ``prompt``.
+
+    - ``model``: a causal LM whose ``apply({"params": p}, ids)`` returns
+      next-token logits ``(B, L, V)`` (e.g. :class:`horovod_tpu.models.GPT`
+      with ``max_position_embeddings >= max_len``).
+    - ``prompt``: (B, P) int32 token ids, P <= max_len.
+    - ``temperature``: 0 -> greedy argmax; otherwise categorical sampling
+      (requires ``rng``).
+
+    Returns (B, max_len) int32: the prompt followed by generated tokens.
+    The decode loop is one compiled program; like any jit, it retraces per
+    distinct (model config, max_len, temperature, prompt SHAPE) — pad
+    prompts to a fixed (B, P) for cache reuse across requests.
+    """
+    B, P = prompt.shape
+    if not 1 <= P <= max_len:
+        raise ValueError(
+            f"prompt length {P} must be in [1, max_len={max_len}] "
+            "(position 0 must come from the prompt)")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if temperature != 0.0 and rng is None:
+        raise ValueError("sampling (temperature != 0) requires rng")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    return _generate(model, params, jnp.asarray(prompt, jnp.int32),
+                     int(max_len), float(temperature), rng)

@@ -3,6 +3,7 @@
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 
 
 class TestResNet:
@@ -211,3 +212,49 @@ class TestBertFlash:
                                       np.asarray(seq_mp, np.float32))
         assert not np.allclose(np.asarray(seq_m, np.float32),
                                np.asarray(seq_f, np.float32))
+
+
+class TestGenerate:
+    def test_greedy_matches_manual_loop(self, hvd, rng):
+        """The scanned decode == a python loop of argmax steps."""
+        from horovod_tpu.models import GPT, GPTConfig, generate
+        cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=2,
+                             max_position_embeddings=16)
+        model = GPT(cfg)
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (2, 4)), np.int32))
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        out = np.asarray(generate(model, params, prompt, max_len=10))
+        # manual reference
+        ids = np.array(prompt)
+        for t in range(4, 10):
+            logits = np.asarray(model.apply(
+                {"params": params}, jnp.asarray(ids)))
+            nxt = logits[:, t - 1].argmax(-1).astype(np.int32)
+            ids = np.concatenate([ids, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(out, ids)
+        np.testing.assert_array_equal(out[:, :4], np.array(prompt))
+
+    def test_sampling_reproducible_and_validates(self, hvd, rng):
+        from horovod_tpu.models import GPT, GPTConfig, generate
+        cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=1,
+                             max_position_embeddings=8)
+        model = GPT(cfg)
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (1, 2)), np.int32))
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        key = jax.random.PRNGKey(7)
+        a = np.asarray(generate(model, params, prompt, 6,
+                                temperature=1.0, rng=key))
+        b = np.asarray(generate(model, params, prompt, 6,
+                                temperature=1.0, rng=key))
+        np.testing.assert_array_equal(a, b)
+        with pytest.raises(ValueError, match="requires rng"):
+            generate(model, params, prompt, 6, temperature=1.0)
+        with pytest.raises(ValueError, match="must be in"):
+            generate(model, params, prompt, 1)          # P=2 > max_len=1
+        with pytest.raises(ValueError, match="must be in"):
+            generate(model, params, prompt[:, :0], 6)   # empty prompt
+        with pytest.raises(ValueError, match="temperature"):
+            generate(model, params, prompt, 6, temperature=-1.0,
+                     rng=key)
