@@ -79,6 +79,10 @@ class TestExamples:
         assert "final loss" in out
         assert "total context 32 tokens" in out
 
+    def test_flax_generate(self):
+        out = _run("flax/flax_generate.py", "--steps", "250")
+        assert "decoded sequence matches training target" in out
+
     def test_flax_fsdp(self):
         out = _run("flax/flax_fsdp.py", "--width", "64", "--steps", "6",
                    "--batch", "8")
