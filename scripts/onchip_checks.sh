@@ -1,0 +1,49 @@
+#!/bin/bash
+# On-chip validation queue (see memory: onchip-validation-queue).
+# Run when `python -c "import jax; print(jax.devices())"` answers.
+set -x
+cd "$(dirname "$0")/.."
+
+# 1. flash-ring cond+pallas lowering smoke (1-chip sp mesh, jit-compile)
+python - <<'PY'
+import jax, jax.numpy as jnp, numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from horovod_tpu.parallel.sequence import ring_attention
+mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
+q = jnp.ones((1, 256, 4, 64), jnp.bfloat16)
+f = jax.jit(jax.shard_map(
+    lambda a: ring_attention(a, a, a, axis_name="sp", causal=True,
+                             use_flash=True),
+    mesh=mesh, in_specs=P(None, "sp", None, None),
+    out_specs=P(None, "sp", None, None)))
+print("flash-ring on-chip:", np.asarray(f(q), np.float32).shape)
+PY
+
+# 2. padded flash kernels: ViT bench (196 -> 256 blocks)
+HVD_BENCH_MODEL=vit HVD_BENCH_ITERS=10 python bench.py
+
+# 3. BERT flash vs plain
+HVD_BENCH_MODEL=bert HVD_BENCH_ITERS=10 python bench.py
+HVD_BENCH_MODEL=bert HVD_BENCH_FLASH=0 HVD_BENCH_ITERS=10 python bench.py
+
+# 4. GPT 32k context
+HVD_BENCH_MODEL=gpt HVD_BENCH_SEQ=32768 HVD_BENCH_BATCH=1 \
+    HVD_BENCH_ITERS=3 python bench.py
+
+# 5. int8 allreduce smoke (n=1 degenerate)
+python - <<'PY'
+import jax, jax.numpy as jnp, numpy as np
+from jax.sharding import Mesh, PartitionSpec as P
+from horovod_tpu.parallel import allreduce_int8
+mesh = Mesh(np.array(jax.devices()[:1]), ("hvd",))
+x = jnp.asarray(np.random.default_rng(0).standard_normal(4096), jnp.float32)
+out = jax.jit(jax.shard_map(
+    lambda t: allreduce_int8(t[None])[0], mesh=mesh,
+    in_specs=P(), out_specs=P()))(x)
+err = float(jnp.abs(out - x).max())
+print("int8 on-chip n=1 max err:", err)
+assert err < float(jnp.abs(x).max()) / 100
+PY
+
+# 6. ResNet-50 tracked config re-baseline
+HVD_BENCH_ITERS=20 python bench.py
