@@ -22,6 +22,50 @@ from jax import lax
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3, 4))
+def _generate_cached(decoder, state, prompt, max_len, temperature, rng):
+    """KV-cache decode: ONE token per step through the cache-enabled model
+    (O(1) projections per step; attention reads the filled prefix). Two
+    scans: a prefill pass teacher-forces the prompt into the cache (no
+    sampling, so the PRNG stream aligns with the re-forward path), then
+    the decode pass samples one token per step."""
+    params, cache = state
+    B, P = prompt.shape
+    buf = jnp.zeros((B, max_len), jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+    def feed(cache, tok, t):
+        logits, upd = decoder.apply(
+            {"params": params, "cache": cache}, tok, pos=t,
+            mutable=["cache"])
+        return upd["cache"], logits[:, 0]
+
+    def prefill(cache, t):
+        tok = jax.lax.dynamic_slice_in_dim(prompt, t, 1, axis=1)
+        cache, _ = feed(cache, tok, t)
+        return cache, None
+
+    if P > 1:
+        cache, _ = lax.scan(prefill, cache, jnp.arange(0, P - 1))
+
+    def step(carry, t):
+        buf, cache, rng = carry
+        tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
+        cache, nxt_logits = feed(cache, tok, t)
+        if temperature == 0.0:
+            nxt = jnp.argmax(nxt_logits, axis=-1).astype(jnp.int32)
+        else:
+            rng, sub = jax.random.split(rng)
+            nxt = jax.random.categorical(
+                sub, nxt_logits / temperature).astype(jnp.int32)
+        buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t + 1))
+        return (buf, cache, rng), None
+
+    (buf, _, _), _ = lax.scan(step, (buf, cache, rng),
+                              jnp.arange(P - 1, max_len - 1))
+    return buf
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4))
 def _generate(model, params, prompt, max_len, temperature, rng):
     # ``model`` is static: flax modules hash by their dataclass config, so
     # repeated generate() calls with the same model/max_len/temperature
@@ -52,7 +96,8 @@ def _generate(model, params, prompt, max_len, temperature, rng):
     return buf
 
 
-def generate(model, params, prompt, max_len, temperature=0.0, rng=None):
+def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
+             use_cache=False):
     """Generate up to ``max_len`` total tokens from ``prompt``.
 
     - ``model``: a causal LM whose ``apply({"params": p}, ids)`` returns
@@ -61,6 +106,10 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None):
     - ``prompt``: (B, P) int32 token ids, P <= max_len.
     - ``temperature``: 0 -> greedy argmax; otherwise categorical sampling
       (requires ``rng``).
+    - ``use_cache``: KV-cache decoding — one token per step with O(1)
+      projection work (dense GPT only; ``max_len`` must be within the
+      model's ``max_position_embeddings``). Same outputs as the default
+      full-re-forward path.
 
     Returns (B, max_len) int32: the prompt followed by generated tokens.
     The decode loop is one compiled program; like any jit, it retraces per
@@ -78,5 +127,29 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None):
         raise ValueError("sampling (temperature != 0) requires rng")
     if rng is None:
         rng = jax.random.PRNGKey(0)
-    return _generate(model, params, jnp.asarray(prompt, jnp.int32),
+    prompt = jnp.asarray(prompt, jnp.int32)
+    if use_cache:
+        # KV-cache path: O(1) projection work per token instead of a full
+        # re-forward (dense GPT only; the cache model shares the params
+        # tree).
+        import dataclasses as _dc
+        cap = getattr(getattr(model, "config", None),
+                      "max_position_embeddings", None)
+        if cap is not None and max_len > cap:
+            # dynamic_update_slice would CLAMP out-of-range cache writes
+            # onto the last slot and emit repeating junk — fail loudly.
+            raise ValueError(
+                f"max_len {max_len} exceeds the cache capacity "
+                f"(max_position_embeddings={cap})")
+        decoder = _dc.replace(model, decode=True)
+        # Cache STRUCTURE via eval_shape (no throwaway params, no compute),
+        # then zeros. init() itself would also MUTATE the cache it returns
+        # (idx=1 and a garbage K/V row from its traced forward).
+        shapes = jax.eval_shape(
+            lambda: decoder.init(jax.random.PRNGKey(0), prompt[:, :1],
+                                 pos=0)["cache"])
+        cache = jax.tree.map(lambda t: jnp.zeros(t.shape, t.dtype), shapes)
+        return _generate_cached(decoder, (params, cache), prompt,
+                                int(max_len), float(temperature), rng)
+    return _generate(model, params, prompt,
                      int(max_len), float(temperature), rng)

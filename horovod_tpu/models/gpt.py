@@ -49,18 +49,28 @@ class GPTConfig:
 
 
 class GPTEmbed(nn.Module):
-    """Token + learned position embeddings (replicated params)."""
+    """Token + learned position embeddings (replicated params).
+
+    ``pos`` (decode mode): a traced scalar — the global position of the
+    single token in ``input_ids`` (shape (B, 1)); the table is indexed
+    dynamically instead of by the static prefix.
+    """
     config: GPTConfig
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, pos=None):
         c = self.config
         L = input_ids.shape[-1]
         tok = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
                        name="tok_emb")(input_ids)
-        pos = self.param("pos_emb", nn.initializers.normal(0.02),
-                         (c.max_position_embeddings, c.hidden_size),
-                         jnp.float32)
+        table = self.param("pos_emb", nn.initializers.normal(0.02),
+                           (c.max_position_embeddings, c.hidden_size),
+                           jnp.float32)
+        if pos is not None:
+            import jax
+            sl = jax.lax.dynamic_slice_in_dim(table, pos, 1)   # (1, H)
+            return tok + jnp.asarray(sl, c.dtype)[None]
+        pos = table  # legacy local name for the static paths below
         if c.sp_axis is not None:
             # Sequence-parallel: input_ids carry this chip's token shard;
             # index the position table at the GLOBAL positions of the shard
@@ -133,11 +143,19 @@ class GPT(nn.Module):
     """
     config: GPTConfig
     moe_every: int = 2
+    decode: bool = False   # KV-cache single-token decoding (dense only)
 
     @nn.compact
-    def __call__(self, input_ids):
+    def __call__(self, input_ids, pos=None):
         c = self.config
-        x = GPTEmbed(c, name="embed")(input_ids)
+        if self.decode:
+            if c.num_experts:
+                raise ValueError("decode mode does not support MoE blocks")
+            if pos is None:
+                raise ValueError("decode mode requires pos (the token's "
+                                 "global position)")
+        x = GPTEmbed(c, name="embed")(input_ids,
+                                      pos if self.decode else None)
         for i in range(c.num_layers):
             if c.num_experts and i % self.moe_every == self.moe_every - 1:
                 x = GPTMoEBlock(c, name=f"layer_{i}")(x)
@@ -146,5 +164,7 @@ class GPT(nn.Module):
                     c.num_heads, c.hidden_size, c.intermediate_size,
                     dtype=c.dtype, axis_name=c.tp_axis, causal=True,
                     use_flash=c.use_flash, sp_axis=c.sp_axis,
-                    sp_impl=c.sp_impl, name=f"layer_{i}")(x)
+                    sp_impl=c.sp_impl, decode=self.decode,
+                    cache_len=c.max_position_embeddings,
+                    name=f"layer_{i}")(x)
         return GPTHead(c, name="head")(x)

@@ -137,6 +137,31 @@ class TPSelfAttention(nn.Module):
     use_flash: bool = False   # tiled Pallas attention (ops/pallas)
     sp_axis: Optional[str] = None   # sequence-parallel axis (tokens sharded)
     sp_impl: str = "ring"           # "ring" | "ulysses"
+    decode: bool = False            # KV-cache single-token decoding
+    cache_len: int = 0              # cache capacity when decode=True
+
+    def _decode_attend(self, q, k, v):
+        """Single-token decode against the KV cache (O(1) projections per
+        step, attention against the filled prefix). q/k/v: (B, 1, h, d).
+        Cache variables are created on the first call (B and capacity fix
+        the shapes; flax initializes them lazily under mutable=['cache'])."""
+        B, _, h, d = q.shape
+        L = self.cache_len
+        ck = self.variable("cache", "k", jnp.zeros, (B, L, h, d), q.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, (B, L, h, d), q.dtype)
+        ci = self.variable("cache", "idx",
+                           lambda: jnp.zeros((), jnp.int32))
+        idx = ci.value
+        ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+        cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+        ci.value = idx + 1
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) / np.sqrt(d)
+        # positions beyond the filled prefix are invalid
+        valid = jnp.arange(L) <= idx                  # (L,)
+        scores = jnp.where(valid[None, None, None, :], scores,
+                           jnp.asarray(-1e9, scores.dtype))
+        probs = jax.nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -159,7 +184,18 @@ class TPSelfAttention(nn.Module):
             return t.reshape(t.shape[:-1] + (local_heads, head_dim))
 
         q, k, v = heads(q), heads(k), heads(v)
-        if self.sp_axis is not None:
+        if self.decode:
+            if self.sp_axis is not None or mask is not None:
+                raise ValueError(
+                    "decode mode supports neither sp_axis nor masks")
+            if x.shape[1] != 1:
+                raise ValueError(
+                    f"decode mode feeds ONE token per call, got "
+                    f"{x.shape[1]}")
+            if self.cache_len < 1:
+                raise ValueError("decode=True requires cache_len >= 1")
+            out = self._decode_attend(q, k, v)
+        elif self.sp_axis is not None:
             # Sequence parallelism: x carries this chip's token shard; the
             # QKV/out projections are token-local, the attention itself
             # runs over the sp ring (or Ulysses head exchange). Composes
@@ -234,6 +270,8 @@ class TPTransformerBlock(nn.Module):
     use_flash: bool = False
     sp_axis: Optional[str] = None
     sp_impl: str = "ring"
+    decode: bool = False
+    cache_len: int = 0
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -241,6 +279,7 @@ class TPTransformerBlock(nn.Module):
                             dtype=self.dtype, axis_name=self.axis_name,
                             causal=self.causal, use_flash=self.use_flash,
                             sp_axis=self.sp_axis, sp_impl=self.sp_impl,
+                            decode=self.decode, cache_len=self.cache_len,
                             name="attention")(
                                 nn.LayerNorm(dtype=self.dtype,
                                              name="ln_attn")(x), mask)

@@ -258,3 +258,27 @@ class TestGenerate:
         with pytest.raises(ValueError, match="temperature"):
             generate(model, params, prompt, 6, temperature=-1.0,
                      rng=key)
+
+    def test_kv_cache_matches_full_reforward(self, hvd, rng):
+        """use_cache=True (one token/step against the KV cache) must equal
+        the full-re-forward decode exactly, greedy and sampled."""
+        from horovod_tpu.models import GPT, GPTConfig, generate
+        cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=2,
+                             max_position_embeddings=12)
+        model = GPT(cfg)
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (2, 4)), np.int32))
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        full = np.asarray(generate(model, params, prompt, max_len=12))
+        cached = np.asarray(generate(model, params, prompt, max_len=12,
+                                     use_cache=True))
+        np.testing.assert_array_equal(cached, full)
+        key = jax.random.PRNGKey(3)
+        fs = np.asarray(generate(model, params, prompt, 12,
+                                 temperature=1.0, rng=key))
+        cs = np.asarray(generate(model, params, prompt, 12,
+                                 temperature=1.0, rng=key, use_cache=True))
+        np.testing.assert_array_equal(cs, fs)
+        # capacity overflow fails loudly (clamped writes would emit junk)
+        with pytest.raises(ValueError, match="cache capacity"):
+            generate(model, params, prompt, 16, use_cache=True)
