@@ -21,8 +21,30 @@ import jax.numpy as jnp
 from jax import lax
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4))
-def _generate_cached(decoder, state, prompt, max_len, temperature, rng):
+def _filter_logits(logits, top_k, top_p):
+    """Top-k / nucleus filtering on (B, V) logits (static k/p; no-ops at
+    k=0 / p=1). Masked entries get a large-negative so categorical never
+    picks them."""
+    neg = jnp.asarray(-1e30, logits.dtype)
+    if top_k:
+        k = min(top_k, logits.shape[-1])   # clamp: top_k > V means keep all
+        kth = lax.top_k(logits, k)[0][:, -1][:, None]
+        logits = jnp.where(logits >= kth, logits, neg)
+    if top_p < 1.0:
+        srt = jnp.sort(logits, axis=-1)[:, ::-1]              # descending
+        probs = jax.nn.softmax(srt.astype(jnp.float32), axis=-1)
+        cum = jnp.cumsum(probs, axis=-1)
+        # keep the smallest prefix whose mass reaches top_p (always >= 1)
+        keep = cum - probs < top_p
+        thresh = jnp.min(jnp.where(keep, srt, jnp.inf), axis=-1)[:, None]
+        logits = jnp.where(logits >= thresh.astype(logits.dtype),
+                           logits, neg)
+    return logits
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7))
+def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
+                     top_k, top_p):
     """KV-cache decode: ONE token per step through the cache-enabled model
     (O(1) projections per step; attention reads the filled prefix). Two
     scans: a prefill pass teacher-forces the prompt into the cache (no
@@ -55,8 +77,11 @@ def _generate_cached(decoder, state, prompt, max_len, temperature, rng):
             nxt = jnp.argmax(nxt_logits, axis=-1).astype(jnp.int32)
         else:
             rng, sub = jax.random.split(rng)
+            # temper BEFORE filtering (the standard top-p semantics: the
+            # nucleus is taken from the tempered distribution)
             nxt = jax.random.categorical(
-                sub, nxt_logits / temperature).astype(jnp.int32)
+                sub, _filter_logits(nxt_logits / temperature, top_k,
+                                    top_p)).astype(jnp.int32)
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t + 1))
         return (buf, cache, rng), None
 
@@ -65,8 +90,9 @@ def _generate_cached(decoder, state, prompt, max_len, temperature, rng):
     return buf
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4))
-def _generate(model, params, prompt, max_len, temperature, rng):
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7))
+def _generate(model, params, prompt, max_len, temperature, rng,
+              top_k, top_p):
     # ``model`` is static: flax modules hash by their dataclass config, so
     # repeated generate() calls with the same model/max_len/temperature
     # reuse one compiled program.
@@ -85,8 +111,11 @@ def _generate(model, params, prompt, max_len, temperature, rng):
             nxt = jnp.argmax(nxt_logits, axis=-1).astype(jnp.int32)
         else:
             rng, sub = jax.random.split(rng)
+            # temper BEFORE filtering (the standard top-p semantics: the
+            # nucleus is taken from the tempered distribution)
             nxt = jax.random.categorical(
-                sub, nxt_logits / temperature).astype(jnp.int32)
+                sub, _filter_logits(nxt_logits / temperature, top_k,
+                                    top_p)).astype(jnp.int32)
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t))
         return (buf, rng), None
 
@@ -97,7 +126,7 @@ def _generate(model, params, prompt, max_len, temperature, rng):
 
 
 def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
-             use_cache=False):
+             use_cache=False, top_k=0, top_p=1.0):
     """Generate up to ``max_len`` total tokens from ``prompt``.
 
     - ``model``: a causal LM whose ``apply({"params": p}, ids)`` returns
@@ -106,6 +135,9 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
     - ``prompt``: (B, P) int32 token ids, P <= max_len.
     - ``temperature``: 0 -> greedy argmax; otherwise categorical sampling
       (requires ``rng``).
+    - ``top_k`` / ``top_p``: sampling filters (0 / 1.0 = off): keep only
+      the k highest logits and/or the smallest nucleus of cumulative
+      probability ``top_p`` before the categorical draw.
     - ``use_cache``: KV-cache decoding — one token per step with O(1)
       projection work (dense GPT only; ``max_len`` must be within the
       model's ``max_position_embeddings``). Same outputs as the default
@@ -123,6 +155,9 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
             "(position 0 must come from the prompt)")
     if temperature < 0.0:
         raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(f"need top_k >= 0 and 0 < top_p <= 1, got "
+                         f"top_k={top_k}, top_p={top_p}")
     if temperature != 0.0 and rng is None:
         raise ValueError("sampling (temperature != 0) requires rng")
     if rng is None:
@@ -150,6 +185,8 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
                                  pos=0)["cache"])
         cache = jax.tree.map(lambda t: jnp.zeros(t.shape, t.dtype), shapes)
         return _generate_cached(decoder, (params, cache), prompt,
-                                int(max_len), float(temperature), rng)
+                                int(max_len), float(temperature), rng,
+                                int(top_k), float(top_p))
     return _generate(model, params, prompt,
-                     int(max_len), float(temperature), rng)
+                     int(max_len), float(temperature), rng,
+                     int(top_k), float(top_p))

@@ -282,3 +282,39 @@ class TestGenerate:
         # capacity overflow fails loudly (clamped writes would emit junk)
         with pytest.raises(ValueError, match="cache capacity"):
             generate(model, params, prompt, 16, use_cache=True)
+
+    def test_top_k_one_equals_greedy(self, hvd, rng):
+        """top_k=1 sampling must collapse to argmax — both decode paths."""
+        from horovod_tpu.models import GPT, GPTConfig, generate
+        cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=1,
+                             max_position_embeddings=10)
+        model = GPT(cfg)
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (2, 3)), np.int32))
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        greedy = np.asarray(generate(model, params, prompt, 10))
+        key = jax.random.PRNGKey(5)
+        for cache in (False, True):
+            k1 = np.asarray(generate(model, params, prompt, 10,
+                                     temperature=1.0, rng=key, top_k=1,
+                                     use_cache=cache))
+            np.testing.assert_array_equal(k1, greedy)
+
+    def test_top_p_filter_properties(self, hvd):
+        """_filter_logits: nucleus keeps at least the argmax and masks the
+        tail; top_k keeps exactly k finite entries."""
+        from horovod_tpu.models.generate import _filter_logits
+        logits = jnp.asarray([[3.0, 1.0, 0.0, -1.0, 2.0]])
+        k2 = np.asarray(_filter_logits(logits, 2, 1.0))
+        assert (k2 > -1e29).sum() == 2 and k2[0, 0] == 3.0 and k2[0, 4] == 2.0
+        p_tiny = np.asarray(_filter_logits(logits, 0, 1e-6))
+        assert (p_tiny > -1e29).sum() == 1 and p_tiny[0, 0] == 3.0
+        p_all = np.asarray(_filter_logits(logits, 0, 1.0))
+        np.testing.assert_array_equal(p_all, np.asarray(logits))
+        # top_k beyond the vocab clamps (keep-all) instead of erroring
+        k_big = np.asarray(_filter_logits(logits, 99, 1.0))
+        np.testing.assert_array_equal(k_big, np.asarray(logits))
+        from horovod_tpu.models import GPT, GPTConfig, generate
+        with pytest.raises(ValueError, match="top_k"):
+            generate(GPT(GPTConfig.tiny(tp_axis=None, ep_axis=None)), {},
+                     jnp.zeros((1, 2), jnp.int32), 4, top_p=0.0)
