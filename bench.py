@@ -219,6 +219,42 @@ def _bench_gpt(hvd):
           round(batch * seq * iters / dt / n, 1), "tokens/sec/chip", 0.0)
 
 
+def _bench_llama(hvd):
+    """LLaMA-family causal-LM step (RMSNorm + RoPE + SwiGLU + GQA,
+    models/llama.py) at the ~400M ``LlamaConfig.bench`` shapes, bf16,
+    flash attention by default. Reports tokens/sec/chip (no reference
+    number exists)."""
+    from horovod_tpu.models import Llama, LlamaConfig
+    from horovod_tpu.optim import DistributedOptimizer
+    from horovod_tpu.parallel import TrainState, make_train_step
+
+    n = hvd.size()
+    mesh = hvd.global_process_set.mesh
+    seq = int(os.environ.get("HVD_BENCH_SEQ", "1024"))
+    per_chip = int(os.environ.get("HVD_BENCH_BATCH", "8"))
+    batch = per_chip * n
+    cfg = LlamaConfig.bench(max_position_embeddings=seq, dtype=jnp.bfloat16,
+                            tp_axis=None, use_flash=_flash_default())
+    model = Llama(cfg)
+    rng = np.random.default_rng(0)
+    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), ids[:1])
+    _mark("llama init done")
+    opt = DistributedOptimizer(optax.adamw(1e-4))
+
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b["ids"])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1].astype(jnp.float32), b["ids"][:, 1:]).mean()
+
+    step = make_train_step(loss_fn, opt, mesh, donate=True)
+    state = TrainState.create(variables["params"], opt)
+    iters, dt = _timed_steps(step, state, {"ids": ids})
+    _emit("llama_400m_tokens_per_sec_per_chip",
+          round(batch * seq * iters / dt / n, 1), "tokens/sec/chip", 0.0)
+
+
 def _bench_vit(hvd):
     """ViT-B/16 ImageNet-shape training step, bf16, flash attention by
     default (196 patches pad to 256-row blocks inside the kernels;
@@ -336,6 +372,8 @@ _EXTRA_MODELS = {
             "tokens/sec/chip"),
     "vit": (_bench_vit, "vit_b16_images_per_sec_per_chip",
             "images/sec/chip"),
+    "llama": (_bench_llama, "llama_400m_tokens_per_sec_per_chip",
+              "tokens/sec/chip"),
 }
 
 

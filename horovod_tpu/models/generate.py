@@ -139,8 +139,9 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
       the k highest logits and/or the smallest nucleus of cumulative
       probability ``top_p`` before the categorical draw.
     - ``use_cache``: KV-cache decoding — one token per step with O(1)
-      projection work (dense GPT only; ``max_len`` must be within the
-      model's ``max_position_embeddings``). Same outputs as the default
+      projection work (dense causal LMs: GPT and LLaMA; MoE blocks are
+      unsupported; ``max_len`` must be within the model's
+      ``max_position_embeddings``). Same outputs as the default
       full-re-forward path.
 
     Returns (B, max_len) int32: the prompt followed by generated tokens.
@@ -165,7 +166,7 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
     prompt = jnp.asarray(prompt, jnp.int32)
     if use_cache:
         # KV-cache path: O(1) projection work per token instead of a full
-        # re-forward (dense GPT only; the cache model shares the params
+        # re-forward (dense GPT/LLaMA; the cache model shares the params
         # tree).
         import dataclasses as _dc
         cap = getattr(getattr(model, "config", None),

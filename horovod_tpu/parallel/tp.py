@@ -122,12 +122,41 @@ class RowParallelDense(nn.Module):
         return y
 
 
+def apply_rope(x, positions, theta):
+    """Rotary position embedding (rotate-half pairing), fp32 rotation.
+
+    ``x``: (B, L, h, d) with d even; ``positions``: (L,) int32 GLOBAL token
+    positions (under sequence parallelism pass the shard's global offsets).
+    Rotation is position-absolute, so pre-rotated keys stay correct when a
+    ring/Ulysses scheme later moves them between chips.
+    """
+    d = x.shape[-1]
+    if d % 2:
+        raise ValueError(f"rope needs an even head_dim, got {d}")
+    inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)    # (d/2,)
+    ang = positions.astype(jnp.float32)[:, None] * inv              # (L, d/2)
+    cos = jnp.cos(ang)[None, :, None, :]
+    sin = jnp.sin(ang)[None, :, None, :]
+    x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
+    out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
+                          axis=-1)
+    return out.astype(x.dtype)
+
+
 class TPSelfAttention(nn.Module):
     """Multi-head attention with heads sharded over the tp axis.
 
     Fused QKV projection is column-parallel (each shard owns
     ``num_heads / tp`` heads — one large MXU matmul per shard), the output
     projection is row-parallel: exactly one psum per attention block.
+
+    ``num_kv_heads`` < ``num_heads`` turns on grouped-query attention: the
+    fused projection emits only that many K/V heads (smaller matmul and —
+    the real win — a ``num_heads/num_kv_heads``-times smaller KV cache in
+    decode mode); K/V are broadcast to the query heads at attend time.
+    ``rope_theta`` replaces additive position embeddings with rotary ones
+    applied to Q/K inside the block (global positions are derived from the
+    sp shard index / the decode cache cursor, so RoPE composes with both).
     """
     num_heads: int
     hidden_size: int
@@ -139,63 +168,51 @@ class TPSelfAttention(nn.Module):
     sp_impl: str = "ring"           # "ring" | "ulysses"
     decode: bool = False            # KV-cache single-token decoding
     cache_len: int = 0              # cache capacity when decode=True
+    num_kv_heads: Optional[int] = None   # None -> MHA (= num_heads)
+    rope_theta: Optional[float] = None   # None -> no rotary embedding
+    use_bias: bool = True
 
     def _decode_attend(self, q, k, v):
         """Single-token decode against the KV cache (O(1) projections per
-        step, attention against the filled prefix). q/k/v: (B, 1, h, d).
-        Cache variables are created on the first call (B and capacity fix
-        the shapes; flax initializes them lazily under mutable=['cache'])."""
+        step, attention against the filled prefix). q: (B, 1, h, d),
+        k/v: (B, 1, kv, d) — the cache stores only the kv heads, the GQA
+        serving win. Cache variables are created on the first call (B and
+        capacity fix the shapes; flax initializes them lazily under
+        mutable=['cache'])."""
         B, _, h, d = q.shape
+        kv = k.shape[2]
         L = self.cache_len
-        ck = self.variable("cache", "k", jnp.zeros, (B, L, h, d), q.dtype)
-        cv = self.variable("cache", "v", jnp.zeros, (B, L, h, d), q.dtype)
+        ck = self.variable("cache", "k", jnp.zeros, (B, L, kv, d), q.dtype)
+        cv = self.variable("cache", "v", jnp.zeros, (B, L, kv, d), q.dtype)
         ci = self.variable("cache", "idx",
                            lambda: jnp.zeros((), jnp.int32))
         idx = ci.value
+        if self.rope_theta is not None:
+            pos = idx[None]                            # this token's position
+            q = apply_rope(q, pos, self.rope_theta)
+            k = apply_rope(k, pos, self.rope_theta)    # cache holds rotated K
         ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
         cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
         ci.value = idx + 1
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, ck.value) / np.sqrt(d)
+        keys, vals = ck.value, cv.value
+        # Grouped attend: q heads reshaped to (kv, group) contract directly
+        # against the NARROW cache — no materialized broadcast of K/V to the
+        # query heads, so the GQA cache shrinks bandwidth, not just capacity.
+        g = h // kv
+        qg = q.reshape(B, 1, kv, g, d)
+        scores = jnp.einsum("bqngd,bknd->bngqk", qg, keys) / np.sqrt(d)
         # positions beyond the filled prefix are invalid
         valid = jnp.arange(L) <= idx                  # (L,)
-        scores = jnp.where(valid[None, None, None, :], scores,
+        scores = jnp.where(valid[None, None, None, None, :], scores,
                            jnp.asarray(-1e9, scores.dtype))
         probs = jax.nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, cv.value)
+        out = jnp.einsum("bngqk,bknd->bqngd", probs, vals)
+        return out.reshape(B, 1, h, d)
 
-    @nn.compact
-    def __call__(self, x, mask=None):
-        n = axis_size_or_1(self.axis_name)
-        if self.num_heads % n != 0:
-            raise ValueError(
-                f"num_heads {self.num_heads} not divisible by tp={n}")
-        local_heads = self.num_heads // n
-        head_dim = self.hidden_size // self.num_heads
-
-        # Column-parallel fused QKV: shard s's local output is
-        # [q_s | k_s | v_s] for its heads [s*local_heads, (s+1)*local_heads),
-        # i.e. the global logical weight is the head-blocked interleaving of
-        # the shards — one large MXU matmul per shard.
-        qkv = ColumnParallelDense(3 * self.hidden_size, dtype=self.dtype,
-                                  axis_name=self.axis_name, name="qkv")(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(t.shape[:-1] + (local_heads, head_dim))
-
-        q, k, v = heads(q), heads(k), heads(v)
-        if self.decode:
-            if self.sp_axis is not None or mask is not None:
-                raise ValueError(
-                    "decode mode supports neither sp_axis nor masks")
-            if x.shape[1] != 1:
-                raise ValueError(
-                    f"decode mode feeds ONE token per call, got "
-                    f"{x.shape[1]}")
-            if self.cache_len < 1:
-                raise ValueError("decode=True requires cache_len >= 1")
-            out = self._decode_attend(q, k, v)
-        elif self.sp_axis is not None:
+    def _attend(self, q, k, v, mask, head_dim):
+        """Route full-sequence attention (MHA shapes — kv already broadcast
+        to the query heads): sp ring/Ulysses, Pallas flash, or plain XLA."""
+        if self.sp_axis is not None:
             # Sequence parallelism: x carries this chip's token shard; the
             # QKV/out projections are token-local, the attention itself
             # runs over the sp ring (or Ulysses head exchange). Composes
@@ -208,32 +225,98 @@ class TPSelfAttention(nn.Module):
             from horovod_tpu.parallel.sequence import (ring_attention,
                                                        ulysses_attention)
             if self.sp_impl == "ring":
-                out = ring_attention(q, k, v, axis_name=self.sp_axis,
-                                     causal=self.causal,
-                                     use_flash=self.use_flash)
-            elif self.sp_impl == "ulysses":
-                out = ulysses_attention(q, k, v, axis_name=self.sp_axis,
-                                        causal=self.causal,
-                                        use_flash=self.use_flash)
-            else:
-                raise ValueError(f"unknown sp_impl {self.sp_impl!r}")
-        elif self.use_flash and mask is None:
+                return ring_attention(q, k, v, axis_name=self.sp_axis,
+                                      causal=self.causal,
+                                      use_flash=self.use_flash)
+            if self.sp_impl == "ulysses":
+                return ulysses_attention(q, k, v, axis_name=self.sp_axis,
+                                         causal=self.causal,
+                                         use_flash=self.use_flash)
+            raise ValueError(f"unknown sp_impl {self.sp_impl!r}")
+        if self.use_flash and mask is None:
             from horovod_tpu.ops.pallas import flash_attention
-            out = flash_attention(q, k, v, causal=self.causal)
+            return flash_attention(q, k, v, causal=self.causal)
+        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+        if self.causal:
+            Lq, Lk = q.shape[1], k.shape[1]
+            cmask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
+            scores = jnp.where(cmask[None, None], scores,
+                               jnp.asarray(-1e9, scores.dtype))
+        if mask is not None:
+            scores = jnp.where(mask[:, None, None, :], scores,
+                               jnp.asarray(-1e9, scores.dtype))
+        probs = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
+        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+    @nn.compact
+    def __call__(self, x, mask=None):
+        n = axis_size_or_1(self.axis_name)
+        kv_heads = self.num_kv_heads or self.num_heads
+        if self.num_heads % n != 0 or kv_heads % n != 0:
+            raise ValueError(
+                f"num_heads {self.num_heads} / num_kv_heads {kv_heads} "
+                f"not divisible by tp={n}")
+        if self.num_heads % kv_heads != 0:
+            raise ValueError(
+                f"num_kv_heads {kv_heads} must divide num_heads "
+                f"{self.num_heads}")
+        local_heads = self.num_heads // n
+        local_kv = kv_heads // n
+        head_dim = self.hidden_size // self.num_heads
+
+        # Column-parallel fused QKV: shard s's local output is
+        # [q_s | k_s | v_s] for its heads [s*local_heads, (s+1)*local_heads)
+        # (and the matching kv-head slice), i.e. the global logical weight is
+        # the head-blocked interleaving of the shards — one large MXU matmul
+        # per shard.
+        qkv = ColumnParallelDense(
+            (self.num_heads + 2 * kv_heads) * head_dim, dtype=self.dtype,
+            use_bias=self.use_bias, axis_name=self.axis_name, name="qkv")(x)
+        q, k, v = jnp.split(
+            qkv, [local_heads * head_dim, (local_heads + local_kv) * head_dim],
+            axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (-1, head_dim))
+
+        q, k, v = heads(q), heads(k), heads(v)
+        if self.decode:
+            if self.sp_axis is not None or mask is not None:
+                raise ValueError(
+                    "decode mode supports neither sp_axis nor masks")
+            if x.shape[1] != 1:
+                raise ValueError(
+                    f"decode mode feeds ONE token per call, got "
+                    f"{x.shape[1]}")
+            if self.cache_len < 1:
+                raise ValueError("decode=True requires cache_len >= 1")
+            out = self._decode_attend(q, k, v)   # RoPE + grouped KV inside
         else:
-            scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-            if self.causal:
-                Lq, Lk = q.shape[1], k.shape[1]
-                cmask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
-                scores = jnp.where(cmask[None, None], scores,
-                                   jnp.asarray(-1e9, scores.dtype))
-            if mask is not None:
-                scores = jnp.where(mask[:, None, None, :], scores,
-                                   jnp.asarray(-1e9, scores.dtype))
-            probs = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
-            out = jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+            if self.rope_theta is not None:
+                # Global token positions: under sequence parallelism x holds
+                # this chip's contiguous token shard (same offset math as
+                # GPTEmbed's sp path); otherwise positions are 0..L-1.
+                L = x.shape[-2]
+                off = 0
+                if (self.sp_axis is not None
+                        and axis_size_or_1(self.sp_axis) > 1):
+                    off = lax.axis_index(self.sp_axis) * L
+                positions = off + jnp.arange(L, dtype=jnp.int32)
+                q = apply_rope(q, positions, self.rope_theta)
+                k = apply_rope(k, positions, self.rope_theta)
+            if local_kv != local_heads:
+                # Broadcast kv heads to the query groups: every attend path
+                # (flash / ring / Ulysses / plain einsum) then sees MHA
+                # shapes. RoPE is already applied, so the repeat is a pure
+                # broadcast XLA fuses into the downstream matmul. (Decode
+                # above instead contracts grouped q heads against the
+                # narrow cache.)
+                k = jnp.repeat(k, local_heads // local_kv, axis=2)
+                v = jnp.repeat(v, local_heads // local_kv, axis=2)
+            out = self._attend(q, k, v, mask, head_dim)
         out = out.reshape(out.shape[:-2] + (local_heads * head_dim,))
         return RowParallelDense(self.hidden_size, dtype=self.dtype,
+                                use_bias=self.use_bias,
                                 axis_name=self.axis_name, name="out")(out)
 
 
@@ -251,6 +334,30 @@ class TPMlp(nn.Module):
                                 axis_name=self.axis_name, name="in")(x)
         h = nn.gelu(h)
         return RowParallelDense(self.hidden_size, dtype=self.dtype,
+                                axis_name=self.axis_name, name="out")(h)
+
+
+class TPSwiGLUMlp(nn.Module):
+    """LLaMA-style gated MLP: fused column-parallel gate+up projection
+    (one MXU matmul), ``silu(gate) * up``, row-parallel contraction — still
+    exactly one psum per MLP block. Gate and up interact only elementwise,
+    so sharding both along the intermediate dim keeps every shard
+    self-contained until the row-parallel reduce."""
+    intermediate_size: int
+    hidden_size: int
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = TP_AXIS
+    use_bias: bool = False
+
+    @nn.compact
+    def __call__(self, x):
+        h = ColumnParallelDense(2 * self.intermediate_size, dtype=self.dtype,
+                                use_bias=self.use_bias,
+                                axis_name=self.axis_name, name="gate_up")(x)
+        g, u = jnp.split(h, 2, axis=-1)
+        h = nn.silu(g) * u
+        return RowParallelDense(self.hidden_size, dtype=self.dtype,
+                                use_bias=self.use_bias,
                                 axis_name=self.axis_name, name="out")(h)
 
 

@@ -45,5 +45,8 @@ print("int8 on-chip n=1 max err:", err)
 assert err < float(jnp.abs(x).max()) / 100
 PY
 
-# 6. ResNet-50 tracked config re-baseline
+# 6. LLaMA-400M causal-LM bench (GQA + RoPE + SwiGLU through flash kernels)
+HVD_BENCH_MODEL=llama HVD_BENCH_ITERS=10 python bench.py
+
+# 7. ResNet-50 tracked config re-baseline
 HVD_BENCH_ITERS=20 python bench.py
