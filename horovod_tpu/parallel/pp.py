@@ -93,6 +93,144 @@ def pipeline(layer_fn: Callable, stage_params, microbatches,
     return lax.psum(jnp.where(stage == n_stages - 1, outputs, 0.0), axis_name)
 
 
+def pipeline_1f1b(layer_fn: Callable, head_loss_fn: Callable, stage_params,
+                  head_params, microbatches, targets,
+                  axis_name: str = PP_AXIS):
+    """One-forward-one-backward pipeline TRAINING step in a single scan.
+
+    :func:`pipeline` is forward-only and differentiated by AD: its transpose
+    runs all backwards after all forwards, so residuals for every microbatch
+    (and every layer) stay live — activation memory O(n_micro). This
+    schedule interleaves each microbatch's backward into the same tick
+    lattice (the 1F1B idea, Megatron-style) and recomputes the stage forward
+    inside the backward tick, so only the stage INPUTS of in-flight
+    microbatches are stashed: activation memory O(n_stages), independent of
+    n_micro.
+
+    Schedule (stage s of S, microbatch m of M): forward at tick ``s + m``
+    (exactly :func:`pipeline`'s schedule), backward at tick
+    ``2(S-1) - s + m`` — each stage's backward of m lands one tick after
+    stage s+1's, so gradient hops ride the reverse ring with no extra
+    barriers; the last stage turns a microbatch around (head loss + vjp) in
+    the tick its forward completes. ``M + 2S - 2`` ticks total; at most
+    ``2(S-1-s)+1 <= 2S-1`` microbatches in flight per stage.
+
+    Args:
+      layer_fn: ``(layer_params, x) -> y``, one shape-invariant layer.
+      head_loss_fn: ``(head_params, y, target) -> scalar`` — the last
+        stage's head + loss for ONE microbatch. Traced on every rank
+        (masked off the non-last stages).
+      stage_params: this rank's stage parameters (stacked leading layer dim).
+      head_params: replicated head/loss parameters.
+      microbatches: ``(n_micro, mb, ...)`` inputs, replicated over pp.
+      targets: ``(n_micro, ...)`` per-microbatch targets, replicated.
+      axis_name: the pipeline mesh axis.
+
+    Returns:
+      ``(loss, (d_stage_params, d_head_params, d_microbatches))``: the mean
+      microbatch loss (replicated), this rank's stage-parameter gradients,
+      the head gradients and input gradients (both replicated — chain
+      ``d_microbatches`` through your embedding's vjp), all scaled for the
+      MEAN loss over microbatches.
+    """
+    n_stages = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    n_micro = microbatches.shape[0]
+    fwd_ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+    rev_ring = [(i, (i - 1) % n_stages) for i in range(n_stages)]
+    ssize = min(n_micro, 2 * n_stages - 1)      # stash slots (in-flight max)
+
+    from horovod_tpu.ops.in_jit import mark_varying
+
+    # Head params arrive replicated (axis-UNVARYING). The vjp transpose of
+    # an unvarying->varying broadcast is a psum, so differentiating the head
+    # directly would silently sum every stage's (mostly garbage) head
+    # cotangent each tick. Marking them varying keeps each rank's head
+    # gradient local; the masked psum at the end then selects the last
+    # stage's real accumulation.
+    head_params = jax.tree_util.tree_map(
+        lambda p: mark_varying(p, axis_name), head_params)
+
+    def stage_fwd(p, x):
+        return stage_apply(layer_fn, p, x)
+
+    zeros_mb = mark_varying(jnp.zeros_like(microbatches[0]), axis_name)
+    carry0 = dict(
+        fwd_state=zeros_mb,                       # activation hop buffer
+        bwd_state=zeros_mb,                       # gradient hop buffer
+        stash=mark_varying(
+            jnp.zeros((ssize,) + microbatches.shape[1:],
+                      microbatches.dtype), axis_name),
+        d_mb=mark_varying(jnp.zeros_like(microbatches), axis_name),
+        d_params=jax.tree_util.tree_map(
+            lambda p: mark_varying(jnp.zeros_like(p), axis_name),
+            stage_params),
+        d_head=jax.tree_util.tree_map(
+            lambda p: mark_varying(jnp.zeros_like(p), axis_name),
+            head_params),
+        loss_sum=mark_varying(jnp.zeros((), jnp.float32), axis_name),
+    )
+
+    def tick(c, t):
+        m_f = t - stage                               # fwd microbatch index
+        m_b = t - (2 * (n_stages - 1) - stage)        # bwd microbatch index
+        valid_f = (m_f >= 0) & (m_f < n_micro)
+        valid_b = (m_b >= 0) & (m_b < n_micro)
+        mi_f = jnp.clip(m_f, 0, n_micro - 1)
+        mi_b = jnp.clip(m_b, 0, n_micro - 1)
+
+        # --- forward slot ---
+        x_in = jnp.where(stage == 0, microbatches[mi_f], c["fwd_state"])
+        y = stage_fwd(stage_params, x_in)
+        stash = lax.dynamic_update_index_in_dim(
+            c["stash"],
+            jnp.where(valid_f, x_in, c["stash"][mi_f % ssize]),
+            mi_f % ssize, 0)
+
+        # --- last stage turns the microbatch around this tick ---
+        loss_t, head_pull = jax.vjp(head_loss_fn, head_params, y,
+                                    targets[mi_b])
+        dhead_t, dy_head, _ = head_pull(mark_varying(
+            jnp.asarray(1.0 / n_micro, loss_t.dtype), axis_name))
+
+        # --- backward slot (recompute the stage forward from the stash) ---
+        dy = jnp.where(stage == n_stages - 1, dy_head, c["bwd_state"])
+        x_b = stash[mi_b % ssize]
+        _, stage_pull = jax.vjp(stage_fwd, stage_params, x_b)
+        dparams_t, dx = stage_pull(dy)
+
+        on_head = valid_b & (stage == n_stages - 1)
+        c_next = dict(
+            fwd_state=lax.ppermute(y, axis_name, fwd_ring),
+            bwd_state=lax.ppermute(dx, axis_name, rev_ring),
+            stash=stash,
+            d_mb=lax.dynamic_update_index_in_dim(
+                c["d_mb"],
+                jnp.where(valid_b & (stage == 0), dx, c["d_mb"][mi_b]),
+                mi_b, 0),
+            d_params=jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(valid_b, g,
+                                               jnp.zeros_like(g)),
+                c["d_params"], dparams_t),
+            d_head=jax.tree_util.tree_map(
+                lambda acc, g: acc + jnp.where(on_head, g,
+                                               jnp.zeros_like(g)),
+                c["d_head"], dhead_t),
+            loss_sum=c["loss_sum"]
+            + jnp.where(on_head, loss_t.astype(jnp.float32), 0.0) / n_micro,
+        )
+        return c_next, None
+
+    c, _ = lax.scan(tick, carry0, jnp.arange(n_micro + 2 * n_stages - 2))
+    last = stage == n_stages - 1
+    loss = lax.psum(jnp.where(last, c["loss_sum"], 0.0), axis_name)
+    d_head = jax.tree_util.tree_map(
+        lambda g: lax.psum(jnp.where(last, g, jnp.zeros_like(g)), axis_name),
+        c["d_head"])
+    d_mb = lax.psum(jnp.where(stage == 0, c["d_mb"], 0.0), axis_name)
+    return loss, (c["d_params"], d_head, d_mb)
+
+
 def split_microbatches(batch, n_micro: int):
     """``(B, ...) -> (n_micro, B / n_micro, ...)``."""
 

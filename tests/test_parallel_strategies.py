@@ -177,6 +177,56 @@ class TestPipelineParallel:
                                        np.asarray(ref_grads[k]),
                                        rtol=1e-4, atol=1e-5)
 
+    @pytest.mark.parametrize("n_micro", [2, 8, 16])  # 16 > 2*8-1: the
+    # modular stash-slot reuse actually wraps on the 8-stage mesh
+    def test_1f1b_matches_sequential_autodiff(self, hvd, rng, n_micro):
+        """pipeline_1f1b's hand-scheduled interleaved backward must
+        reproduce the dense model's loss AND every gradient (stage params,
+        head params, microbatch inputs) from plain jax.grad."""
+        from horovod_tpu.parallel.pp import pipeline_1f1b
+        d, n_layers = 6, 16                          # 2 layers per stage
+        params = self._params(rng, n_layers, d)
+        head = {"wh": np.asarray(rng.standard_normal((d, 3)) * 0.5,
+                                 np.float32)}
+        mbs = np.asarray(rng.standard_normal((n_micro, 3, d)), np.float32)
+        tgts = np.asarray(rng.standard_normal((n_micro, 3, 3)), np.float32)
+        mesh = mesh1d("pp")
+        spec = {"w": P("pp"), "b": P("pp")}
+
+        def head_loss(hp, y, t):
+            return jnp.mean((y @ hp["wh"] - t) ** 2)
+
+        loss, (d_stage, d_head, d_mb) = jax.jit(jax.shard_map(
+            lambda p, h, m, t: pipeline_1f1b(
+                self._layer_fn(), head_loss, p, h, m, t, "pp"),
+            mesh=mesh, in_specs=(spec, P(), P(), P()),
+            out_specs=(P(), (spec, P(), P()))))(
+                jax.tree_util.tree_map(jnp.asarray, params),
+                jax.tree_util.tree_map(jnp.asarray, head),
+                jnp.asarray(mbs), jnp.asarray(tgts))
+
+        def seq_loss(p, h, m):
+            outs = jnp.stack([self._sequential(p, m[i])
+                              for i in range(n_micro)])
+            losses = jnp.stack([head_loss(h, outs[i], tgts[i])
+                                for i in range(n_micro)])
+            return jnp.mean(losses)
+
+        ref_loss, ref_grads = jax.value_and_grad(seq_loss, argnums=(0, 1, 2))(
+            jax.tree_util.tree_map(jnp.asarray, params),
+            jax.tree_util.tree_map(jnp.asarray, head), jnp.asarray(mbs))
+        np.testing.assert_allclose(float(loss), float(ref_loss), rtol=1e-5)
+        for k in ("w", "b"):
+            np.testing.assert_allclose(np.asarray(d_stage[k]),
+                                       np.asarray(ref_grads[0][k]),
+                                       rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(d_head["wh"]),
+                                   np.asarray(ref_grads[1]["wh"]),
+                                   rtol=1e-4, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(d_mb),
+                                   np.asarray(ref_grads[2]),
+                                   rtol=1e-4, atol=1e-5)
+
     def test_stack_and_split_helpers(self, hvd):
         from horovod_tpu.parallel.pp import (split_microbatches,
                                              stack_stage_params)
