@@ -105,13 +105,37 @@ class LlamaBlock(nn.Module):
         return x + h
 
 
+class LlamaEmbed(nn.Module):
+    """Token embedding only — no positional table; positions enter via RoPE
+    inside every attention block. ``pos`` is accepted for the decoder
+    interface but carries no embedding work."""
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, input_ids, pos=None):
+        c = self.config
+        return nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                        name="tok_emb")(input_ids)
+
+
+class LlamaHead(nn.Module):
+    """Final RMSNorm + fp32 LM head (bias-free)."""
+    config: LlamaConfig
+
+    @nn.compact
+    def __call__(self, x):
+        c = self.config
+        x = nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype, name="ln_f")(x)
+        return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")(x)
+
+
 class Llama(nn.Module):
     """Full model: token embed -> blocks -> RMSNorm -> fp32 LM head.
 
-    No positional table — positions enter via RoPE inside every attention
-    block (which derives global offsets from the sp shard index or the
-    decode cache cursor), so ``pos`` is accepted for :func:`generate`'s
-    decoder interface but carries no embedding work here.
+    Compose :class:`LlamaEmbed` / :class:`LlamaBlock` / :class:`LlamaHead`
+    yourself for pipeline parallelism (see ``parallel/composite.py``'s
+    ``CompositeLlama``).
     """
     config: LlamaConfig
     decode: bool = False   # KV-cache single-token decoding
@@ -122,10 +146,7 @@ class Llama(nn.Module):
         if self.decode and pos is None:
             raise ValueError("decode mode requires pos (the token's "
                              "global position)")
-        x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
-                     name="tok_emb")(input_ids)
+        x = LlamaEmbed(c, name="embed")(input_ids, pos)
         for i in range(c.num_layers):
             x = LlamaBlock(c, decode=self.decode, name=f"layer_{i}")(x)
-        x = nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype, name="ln_f")(x)
-        return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
-                        name="lm_head")(x)
+        return LlamaHead(c, name="head")(x)

@@ -80,10 +80,10 @@ def _pvary_to(tree, specs):
 
 
 def _stage_leaf_spec(path_str: str) -> P:
-    """PartitionSpec for one pp-stacked TPTransformerBlock leaf (leading dim
+    """PartitionSpec for one pp-stacked transformer-block leaf (leading dim
     is the stacked-layer dim -> 'pp'; tp placement per Megatron layout)."""
     if path_str.endswith("shard/kernel"):
-        if "qkv" in path_str or "/in/" in path_str:
+        if "qkv" in path_str or "/in/" in path_str or "gate_up" in path_str:
             return P(PPL_AXIS, None, TP_AXIS)      # column-parallel
         return P(PPL_AXIS, TP_AXIS, None)          # row-parallel
     if path_str.endswith("shard/bias"):
@@ -96,25 +96,27 @@ def _path_str(path) -> str:
 
 
 @dataclasses.dataclass
-class CompositeGPT:
-    """A pipelined, tensor-parallel, (optionally) MoE GPT training setup.
+class _CompositeLM:
+    """Shared machinery for pipelined, tensor-parallel causal-LM training.
 
-    Architecture: embed -> shared MoE FFN (residual, experts over dp) ->
-    pipeline of TP transformer blocks over pp -> head. Use
+    Architecture: embed -> optional shared MoE FFN (residual, experts over
+    dp) -> pipeline of TP transformer blocks over pp -> head. Use
     :meth:`init` then :meth:`make_train_step`; the returned step maps
     ``(params, opt_state, ids) -> (params, opt_state, loss)`` with ``ids``
     sharded over dp and all shardings as in :meth:`param_specs`.
+    Subclasses implement :meth:`_build_modules` to supply the family's
+    embed/head/block (and optional MoE) modules.
     """
-    config: Any                     # a horovod_tpu.models.gpt.GPTConfig
+    config: Any
     mesh: Mesh
     optimizer: Any
     n_micro: int = 4
     aux_weight: float = 0.01
 
+    def _build_modules(self):
+        raise NotImplementedError
+
     def __post_init__(self):
-        # Imported here: models.gpt uses parallel.tp/moe, so a module-level
-        # import would be circular through the package __init__.
-        from horovod_tpu.models.gpt import GPTEmbed, GPTHead
         c = self.config
         for ax in (DP_AXIS, PPL_AXIS, TP_AXIS):
             if ax not in self.mesh.shape:
@@ -125,25 +127,15 @@ class CompositeGPT:
             # pipeline. Refuse loudly rather than half-apply (the embed
             # would offset positions while attention stayed local).
             raise NotImplementedError(
-                "CompositeGPT does not support config.sp_axis; use "
-                "GPT(sp_axis=...) for sequence parallelism or unset it")
+                f"{type(self).__name__} does not support config.sp_axis; "
+                "use the flat model's sp_axis for sequence parallelism or "
+                "unset it")
         self.pp = self.mesh.shape[PPL_AXIS]
         if c.num_layers % self.pp != 0:
             raise ValueError(
                 f"{c.num_layers} layers not divisible by pp={self.pp}")
         self.layers_per_stage = c.num_layers // self.pp
-        self.embed = GPTEmbed(c)
-        self.head = GPTHead(c)
-        self.block = TPTransformerBlock(
-            c.num_heads, c.hidden_size, c.intermediate_size, dtype=c.dtype,
-            axis_name=TP_AXIS, causal=True,
-            use_flash=getattr(c, "use_flash", False))
-        self.moe = None
-        if c.num_experts:
-            self.moe = MoEMlp(c.num_experts, c.hidden_size,
-                              c.intermediate_size, k=c.moe_k,
-                              capacity_factor=c.capacity_factor,
-                              dtype=c.dtype, axis_name=DP_AXIS)
+        self._build_modules()
 
     # ---- shardings ----
 
@@ -262,3 +254,44 @@ class CompositeGPT:
             out_specs=(param_specs, opt_specs, P()))
         return jax.jit(sharded,
                        donate_argnums=(0, 1) if donate else ())
+
+
+@dataclasses.dataclass
+class CompositeGPT(_CompositeLM):
+    """Pipelined, tensor-parallel, (optionally) MoE GPT (experts over dp)."""
+
+    def _build_modules(self):
+        # Imported here: models.gpt uses parallel.tp/moe, so a module-level
+        # import would be circular through the package __init__.
+        from horovod_tpu.models.gpt import GPTEmbed, GPTHead
+        c = self.config
+        self.embed = GPTEmbed(c)
+        self.head = GPTHead(c)
+        self.block = TPTransformerBlock(
+            c.num_heads, c.hidden_size, c.intermediate_size, dtype=c.dtype,
+            axis_name=TP_AXIS, causal=True,
+            use_flash=getattr(c, "use_flash", False))
+        self.moe = None
+        if c.num_experts:
+            self.moe = MoEMlp(c.num_experts, c.hidden_size,
+                              c.intermediate_size, k=c.moe_k,
+                              capacity_factor=c.capacity_factor,
+                              dtype=c.dtype, axis_name=DP_AXIS)
+
+
+@dataclasses.dataclass
+class CompositeLlama(_CompositeLM):
+    """Pipelined, tensor-parallel LLaMA: the same dp x pp x tp machinery
+    with the family's RMSNorm/RoPE/SwiGLU/GQA blocks (models/llama.py).
+    RoPE needs no per-stage position bookkeeping — every block derives
+    positions locally from its (replicated-over-pp) token window."""
+
+    def _build_modules(self):
+        from horovod_tpu.models.llama import (LlamaBlock, LlamaEmbed,
+                                              LlamaHead)
+        c = dataclasses.replace(self.config, tp_axis=TP_AXIS)
+        self.config = c
+        self.embed = LlamaEmbed(c)
+        self.head = LlamaHead(c)
+        self.block = LlamaBlock(c)
+        self.moe = None
