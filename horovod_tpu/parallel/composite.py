@@ -238,11 +238,73 @@ class _CompositeLM:
         # the invariant->varying promotions yields the dp gradient allreduce.
         return lax.pmean(loss, DP_AXIS)
 
-    def make_train_step(self, specs, donate=True):
+    def _grads_1f1b(self, params, ids):
+        """Loss + grads via the memory-bounded 1F1B schedule
+        (:func:`horovod_tpu.parallel.pp.pipeline_1f1b`): the pipeline
+        computes stage/head/input gradients itself (recompute-based
+        backward, O(pp) activation stash); the embedding chains through the
+        returned input gradients; one explicit dp pmean replaces the dp
+        allreduce that AD's transpose of the gpipe path's pmean-loss would
+        insert."""
+        from horovod_tpu.ops.in_jit import mark_varying
+        from horovod_tpu.parallel.pp import pipeline_1f1b
+        c = self.config
+        if self.moe is not None:
+            raise NotImplementedError(
+                "schedule='1f1b' does not support MoE blocks yet (the aux "
+                "loss and expert grads are outside the pipelined backward)")
+        B, L = ids.shape
+        if B % self.n_micro != 0:
+            raise ValueError(
+                f"local batch {B} not divisible by n_micro={self.n_micro}")
+        # Mark every parameter dp-varying BEFORE the manual vjps: a
+        # dp-invariant parameter consumed by dp-varying data would have its
+        # cotangent dp-psum'd inside each vjp (the transpose of the
+        # invariant->varying promotion) — an all-reduce per pipeline tick
+        # AND a double-count once the explicit dp pmean below runs. Varying
+        # params keep cotangents rank-local; the single pmean then takes
+        # the true dp mean.
+        p_emb, p_stages, p_head = (
+            jax.tree_util.tree_map(lambda p: mark_varying(p, DP_AXIS),
+                                   params[k])
+            for k in ("embed", "stages", "head"))
+        x, embed_vjp = jax.vjp(
+            lambda pe: self.embed.apply({"params": pe}, ids), p_emb)
+        mbs = x.reshape(self.n_micro, B // self.n_micro, L, c.hidden_size)
+        tgts = ids.reshape(self.n_micro, B // self.n_micro, L)
+
+        def layer_fn(p, h):
+            return self.block.apply({"params": p}, h)
+
+        def head_loss(hp, y, t):
+            logits = self.head.apply({"params": hp}, y)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1], t[:, 1:]).mean()
+
+        loss, (d_stages, d_head, d_mb) = pipeline_1f1b(
+            layer_fn, head_loss, p_stages, p_head, mbs, tgts, PPL_AXIS)
+        (d_embed,) = embed_vjp(d_mb.reshape(B, L, c.hidden_size))
+        grads = {"embed": d_embed, "stages": d_stages, "head": d_head}
+        loss = lax.pmean(loss, DP_AXIS)
+        grads = jax.tree_util.tree_map(
+            lambda g: lax.pmean(g, DP_AXIS), grads)
+        return loss, grads
+
+    def make_train_step(self, specs, donate=True, schedule="gpipe"):
+        """Compiled train step. ``schedule``: ``"gpipe"`` differentiates
+        the forward pipeline by AD (residuals for every microbatch stay
+        live); ``"1f1b"`` uses the interleaved recompute schedule —
+        O(pp) activation memory, same gradients."""
         param_specs, opt_specs = specs
+        if schedule not in ("gpipe", "1f1b"):
+            raise ValueError(f"unknown schedule {schedule!r}")
 
         def step(params, opt_state, ids):
-            loss, grads = jax.value_and_grad(self._loss_local)(params, ids)
+            if schedule == "1f1b":
+                loss, grads = self._grads_1f1b(params, ids)
+            else:
+                loss, grads = jax.value_and_grad(self._loss_local)(params,
+                                                                   ids)
             updates, opt_state = self.optimizer.update(grads, opt_state,
                                                        params)
             params = optax.apply_updates(params, updates)

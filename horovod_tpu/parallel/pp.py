@@ -154,21 +154,35 @@ def pipeline_1f1b(layer_fn: Callable, head_loss_fn: Callable, stage_params,
     def stage_fwd(p, x):
         return stage_apply(layer_fn, p, x)
 
-    zeros_mb = mark_varying(jnp.zeros_like(microbatches[0]), axis_name)
+    # Scan carries must enter with the exact varying-axes type their
+    # outputs will have. On a composite mesh the data is varying over more
+    # than the pp axis (dp-sharded batches), and gradient carries
+    # additionally inherit each parameter leaf's own axes (tp-sharded
+    # kernels) — mark every carry leaf over the union it will reach.
+    data_axes = (set(getattr(jax.typeof(microbatches), "vma", ()))
+                 | set(getattr(jax.typeof(targets), "vma", ()))
+                 | {axis_name})
+
+    def mv(x, extra=()):
+        for ax in data_axes | set(extra):
+            x = mark_varying(x, ax)
+        return x
+
+    def grad_carry(params):
+        return jax.tree_util.tree_map(
+            lambda p: mv(jnp.zeros_like(p),
+                         getattr(jax.typeof(p), "vma", ())), params)
+
+    zeros_mb = mv(jnp.zeros_like(microbatches[0]))
     carry0 = dict(
         fwd_state=zeros_mb,                       # activation hop buffer
         bwd_state=zeros_mb,                       # gradient hop buffer
-        stash=mark_varying(
-            jnp.zeros((ssize,) + microbatches.shape[1:],
-                      microbatches.dtype), axis_name),
-        d_mb=mark_varying(jnp.zeros_like(microbatches), axis_name),
-        d_params=jax.tree_util.tree_map(
-            lambda p: mark_varying(jnp.zeros_like(p), axis_name),
-            stage_params),
-        d_head=jax.tree_util.tree_map(
-            lambda p: mark_varying(jnp.zeros_like(p), axis_name),
-            head_params),
-        loss_sum=mark_varying(jnp.zeros((), jnp.float32), axis_name),
+        stash=mv(jnp.zeros((ssize,) + microbatches.shape[1:],
+                           microbatches.dtype)),
+        d_mb=mv(jnp.zeros_like(microbatches)),
+        d_params=grad_carry(stage_params),
+        d_head=grad_carry(head_params),
+        loss_sum=mv(jnp.zeros((), jnp.float32)),
     )
 
     def tick(c, t):
@@ -179,29 +193,49 @@ def pipeline_1f1b(layer_fn: Callable, head_loss_fn: Callable, stage_params,
         mi_f = jnp.clip(m_f, 0, n_micro - 1)
         mi_b = jnp.clip(m_b, 0, n_micro - 1)
 
+        # The forward and backward slots are two data-independent collective
+        # chains (fwd: tp psums -> activation ppermute; bwd: tp psums ->
+        # gradient ppermute). optimization_barrier ties each slot to the
+        # previous one (prior-tick bwd hop -> fwd slot -> fwd hop -> bwd
+        # slot -> bwd hop) so every device issues collectives in one order.
+        # The XLA CPU backend ADDITIONALLY needs
+        # --xla_cpu_enable_concurrency_optimized_scheduler=false — its
+        # optimized thunk scheduler can still reorder collective entry and
+        # deadlock the rendezvous (docs/troubleshooting.md); TPU compiles a
+        # total collective order, where the barriers cost nothing.
+        bwd_in = c["bwd_state"]
+
         # --- forward slot ---
         x_in = jnp.where(stage == 0, microbatches[mi_f], c["fwd_state"])
+        x_in, bwd_in = lax.optimization_barrier((x_in, bwd_in))
         y = stage_fwd(stage_params, x_in)
         stash = lax.dynamic_update_index_in_dim(
             c["stash"],
             jnp.where(valid_f, x_in, c["stash"][mi_f % ssize]),
             mi_f % ssize, 0)
+        fwd_next = lax.ppermute(y, axis_name, fwd_ring)    # activation hop
 
         # --- last stage turns the microbatch around this tick ---
         loss_t, head_pull = jax.vjp(head_loss_fn, head_params, y,
                                     targets[mi_b])
-        dhead_t, dy_head, _ = head_pull(mark_varying(
-            jnp.asarray(1.0 / n_micro, loss_t.dtype), axis_name))
+        # The cotangent's varying-axes type must match loss_t's exactly —
+        # on a composite mesh the loss is varying over more than the pp
+        # axis (e.g. dp-sharded batches).
+        ct = jnp.asarray(1.0 / n_micro, loss_t.dtype)
+        for ax in getattr(jax.typeof(loss_t), "vma", ()):
+            ct = mark_varying(ct, ax)
+        dhead_t, dy_head, _ = head_pull(ct)
 
         # --- backward slot (recompute the stage forward from the stash) ---
-        dy = jnp.where(stage == n_stages - 1, dy_head, c["bwd_state"])
+        dy = jnp.where(stage == n_stages - 1, dy_head, bwd_in)
         x_b = stash[mi_b % ssize]
+        x_b, dy, fwd_next = lax.optimization_barrier((x_b, dy, fwd_next))
         _, stage_pull = jax.vjp(stage_fwd, stage_params, x_b)
         dparams_t, dx = stage_pull(dy)
 
         on_head = valid_b & (stage == n_stages - 1)
         c_next = dict(
-            fwd_state=lax.ppermute(y, axis_name, fwd_ring),
+            fwd_state=fwd_next,
             bwd_state=lax.ppermute(dx, axis_name, rev_ring),
             stash=stash,
             d_mb=lax.dynamic_update_index_in_dim(

@@ -21,8 +21,17 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (
-        _flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_cpu_enable_concurrency_optimized_scheduler" not in _flags:
+    # The CPU thunk scheduler's concurrency optimization can enter
+    # data-independent collectives in different orders on different
+    # virtual devices and deadlock the in-process rendezvous (programs
+    # with parallel collective chains, e.g. the 1F1B pipeline's forward
+    # and backward hops). TPU compiles a total collective order; make the
+    # CPU tier match. See docs/troubleshooting.md.
+    _flags = (_flags
+              + " --xla_cpu_enable_concurrency_optimized_scheduler=false")
+os.environ["XLA_FLAGS"] = _flags
 
 import jax  # noqa: E402
 

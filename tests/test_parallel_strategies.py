@@ -395,6 +395,39 @@ class TestCompositeLlama:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0], losses
 
+    def test_1f1b_schedule_matches_gpipe(self, hvd, rng):
+        """schedule='1f1b' (hand-scheduled recompute backward) must follow
+        the same loss trajectory as the AD-differentiated GPipe schedule —
+        same math, different memory profile. Plain SGD on purpose: it is
+        scale-SENSITIVE, so a gradient off by the dp factor (the
+        invariant-param vjp double-psum failure mode) diverges the
+        trajectories where Adam would mask it."""
+        from horovod_tpu.models import LlamaConfig
+        from horovod_tpu.parallel.composite import (CompositeLlama,
+                                                    build_mesh3d)
+
+        cfg = LlamaConfig.tiny(vocab_size=64, hidden_size=32, num_heads=4,
+                               num_kv_heads=2, num_layers=2,
+                               intermediate_size=64,
+                               max_position_embeddings=16)
+        mesh = build_mesh3d(dp=2, pp=2, tp=2)
+        comp = CompositeLlama(cfg, mesh, optax.sgd(0.1), n_micro=2)
+        ids = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
+        p0, o0, specs = comp.init(jax.random.PRNGKey(0), ids)
+
+        traj = {}
+        for sched in ("gpipe", "1f1b"):
+            step = comp.make_train_step(specs, donate=False,
+                                        schedule=sched)
+            p, o = p0, o0
+            losses = []
+            for _ in range(4):
+                p, o, loss = step(p, o, ids)
+                losses.append(float(loss))
+            traj[sched] = losses
+        np.testing.assert_allclose(traj["1f1b"], traj["gpipe"],
+                                   rtol=1e-4, atol=1e-5)
+
 
 class TestSequenceParallelGPT:
     """GPTConfig(sp_axis=...): the flagship model with native sequence
