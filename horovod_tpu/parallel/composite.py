@@ -212,6 +212,17 @@ class _CompositeLM:
 
     # ---- training ----
 
+    def _layer_fn(self, p, h):
+        return self.block.apply({"params": p}, h)
+
+    def _head_loss(self, head_params, y, ids):
+        """Head + next-token loss over one (micro)batch — the ONE loss
+        definition both schedules use (mean over equal-sized microbatches
+        == the full-batch mean)."""
+        logits = self.head.apply({"params": head_params}, y)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1], ids[:, 1:]).mean()
+
     def _loss_local(self, params, ids):
         c = self.config
         x = self.embed.apply({"params": params["embed"]}, ids)
@@ -225,14 +236,9 @@ class _CompositeLM:
                 f"local batch {B} not divisible by n_micro={self.n_micro}")
         mbs = x.reshape(self.n_micro, B // self.n_micro, L, c.hidden_size)
 
-        def layer_fn(p, h):
-            return self.block.apply({"params": p}, h)
-
-        y = pipeline(layer_fn, params["stages"], mbs, PPL_AXIS)
+        y = pipeline(self._layer_fn, params["stages"], mbs, PPL_AXIS)
         y = y.reshape(B, L, c.hidden_size)
-        logits = self.head.apply({"params": params["head"]}, y)
-        loss = optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1], ids[:, 1:]).mean()
+        loss = self._head_loss(params["head"], y, ids)
         loss = loss + self.aux_weight * aux
         # Mean over the data-parallel axis; AD's transpose of this pmean +
         # the invariant->varying promotions yields the dp gradient allreduce.
@@ -273,16 +279,9 @@ class _CompositeLM:
         mbs = x.reshape(self.n_micro, B // self.n_micro, L, c.hidden_size)
         tgts = ids.reshape(self.n_micro, B // self.n_micro, L)
 
-        def layer_fn(p, h):
-            return self.block.apply({"params": p}, h)
-
-        def head_loss(hp, y, t):
-            logits = self.head.apply({"params": hp}, y)
-            return optax.softmax_cross_entropy_with_integer_labels(
-                logits[:, :-1], t[:, 1:]).mean()
-
         loss, (d_stages, d_head, d_mb) = pipeline_1f1b(
-            layer_fn, head_loss, p_stages, p_head, mbs, tgts, PPL_AXIS)
+            self._layer_fn, self._head_loss, p_stages, p_head, mbs, tgts,
+            PPL_AXIS)
         (d_embed,) = embed_vjp(d_mb.reshape(B, L, c.hidden_size))
         grads = {"embed": d_embed, "stages": d_stages, "head": d_head}
         loss = lax.pmean(loss, DP_AXIS)

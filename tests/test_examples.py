@@ -16,7 +16,9 @@ def _run(script, *args, timeout=420, env_extra=None):
     env = dict(os.environ)
     env.update({
         "JAX_PLATFORMS": "cpu",
-        "XLA_FLAGS": "--xla_force_host_platform_device_count=2",
+        "XLA_FLAGS": ("--xla_force_host_platform_device_count=2"
+                      " --xla_cpu_enable_concurrency_optimized_scheduler"
+                      "=false"),
         "PYTHONPATH": REPO + os.pathsep + env.get("PYTHONPATH", ""),
     })
     env.update(env_extra or {})
@@ -82,6 +84,11 @@ class TestExamples:
     def test_flax_generate(self):
         out = _run("flax/flax_generate.py", "--steps", "250")
         assert "decoded sequence matches training target" in out
+
+    def test_flax_llama(self):
+        out = _run("flax/flax_llama.py", "--steps", "250")
+        assert "decoded sequence matches training target" in out
+        assert "kv cache/layer: 2 of 4 heads" in out
 
     def test_flax_fsdp(self):
         out = _run("flax/flax_fsdp.py", "--width", "64", "--steps", "6",
