@@ -1,0 +1,219 @@
+"""T5-style encoder-decoder: relative position biases, RMSNorm, GEGLU,
+cross-attention.
+
+Completes the transformer triad in the zoo (BERT = encoder-only,
+GPT/LLaMA = decoder-only): the reference framework has no model zoo
+(SURVEY.md intro), so models here exist to exercise the distributed
+machinery — this one adds cross-attention (``parallel/tp.py``
+``TPCrossAttention``) and additive attention biases to the covered
+surface. Follows the T5 1.1 recipe: no absolute positions (bucketed
+relative position biases on self-attention, shared across layers), RMSNorm
+pre-norm, gated-gelu MLP, bias-free projections, untied fp32 LM head.
+
+TPU-first choices as elsewhere: bf16 activations with fp32 params/logits,
+fused projections (QKV / gate+up / KV), static shapes. The relative bias
+is computed once per stack from a static bucket matrix (host-side numpy)
+and one embedding lookup — no per-layer recompute.
+"""
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from horovod_tpu.parallel.tp import (TPCrossAttention, TPSelfAttention,
+                                     TPSwiGLUMlp, axis_size_or_1)
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    vocab_size: int = 32128
+    hidden_size: int = 512
+    num_layers: int = 8            # per stack (encoder AND decoder)
+    num_heads: int = 8
+    intermediate_size: int = 1024
+    num_buckets: int = 32
+    max_distance: int = 128
+    rms_eps: float = 1e-6
+    dtype: Any = jnp.float32
+    tp_axis: Optional[str] = "tp"
+
+    @staticmethod
+    def tiny(**kw):
+        base = dict(vocab_size=256, hidden_size=64, num_layers=2,
+                    num_heads=4, intermediate_size=128, num_buckets=8,
+                    max_distance=16)
+        base.update(kw)
+        return T5Config(**base)
+
+
+def relative_position_buckets(query_len, key_len, num_buckets, max_distance,
+                              bidirectional):
+    """T5's bucketed relative positions, host-side: (Lq, Lk) int32.
+
+    Half the buckets cover exact small offsets, the other half log-spaced
+    offsets out to ``max_distance``; the encoder (bidirectional) splits
+    buckets again by sign. (Reference recipe from the T5 paper — computed
+    with numpy at trace time since shapes are static.)
+    """
+    rel = np.arange(key_len)[None, :] - np.arange(query_len)[:, None]
+    if bidirectional:
+        num_buckets //= 2
+        bucket_offset = (rel > 0).astype(np.int32) * num_buckets
+        rel = np.abs(rel)
+    else:
+        bucket_offset = np.zeros_like(rel)
+        rel = np.maximum(-rel, 0)      # decoder attends to the past only
+    max_exact = num_buckets // 2
+    is_small = rel < max_exact
+    # log-spaced buckets for larger distances
+    large = max_exact + (
+        np.log(np.maximum(rel, 1) / max_exact)
+        / np.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(np.int32)
+    large = np.minimum(large, num_buckets - 1)
+    return (bucket_offset + np.where(is_small, rel, large)).astype(np.int32)
+
+
+class T5RelativeBias(nn.Module):
+    """Learned per-head bias over relative-position buckets, one table per
+    stack (T5 shares it across layers). Heads are sharded over tp: the
+    table stays replicated (small) and the local head slice is taken by
+    tp index, matching TPSelfAttention's head-blocked layout."""
+    config: T5Config
+    bidirectional: bool
+
+    @nn.compact
+    def __call__(self, query_len, key_len):
+        c = self.config
+        table = self.param("rel_bias", nn.initializers.normal(0.1),
+                           (c.num_buckets, c.num_heads), jnp.float32)
+        buckets = relative_position_buckets(
+            query_len, key_len, c.num_buckets, c.max_distance,
+            self.bidirectional)
+        bias = jnp.asarray(table, c.dtype)[jnp.asarray(buckets)]
+        bias = jnp.transpose(bias, (2, 0, 1))          # (heads, Lq, Lk)
+        n = axis_size_or_1(c.tp_axis)
+        if n > 1:
+            local = c.num_heads // n
+            bias = lax.dynamic_slice_in_dim(
+                bias, lax.axis_index(c.tp_axis) * local, local, axis=0)
+        return bias
+
+
+class T5Block(nn.Module):
+    """Pre-RMSNorm block: self-attention (+ relative bias), optional
+    cross-attention (decoder), GEGLU MLP; bias-free."""
+    config: T5Config
+    causal: bool
+    cross: bool
+
+    @nn.compact
+    def __call__(self, x, bias, memory=None, memory_mask=None, mask=None):
+        c = self.config
+        a = TPSelfAttention(
+            c.num_heads, c.hidden_size, dtype=c.dtype, axis_name=c.tp_axis,
+            causal=self.causal, use_bias=False, name="attention")(
+                nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,
+                           name="ln_attn")(x), mask, bias)
+        x = x + a
+        if self.cross:
+            a = TPCrossAttention(
+                c.num_heads, c.hidden_size, dtype=c.dtype,
+                axis_name=c.tp_axis, use_bias=False, name="cross")(
+                    nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,
+                               name="ln_cross")(x), memory, memory_mask)
+            x = x + a
+        h = TPSwiGLUMlp(c.intermediate_size, c.hidden_size, dtype=c.dtype,
+                        axis_name=c.tp_axis, activation="gelu",
+                        name="mlp")(
+                            nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,
+                                       name="ln_mlp")(x))
+        return x + h
+
+
+class T5Encoder(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, input_ids, mask=None):
+        c = self.config
+        x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                     name="tok_emb")(input_ids)
+        L = input_ids.shape[1]
+        bias = T5RelativeBias(c, bidirectional=True, name="rel_bias")(L, L)
+        for i in range(c.num_layers):
+            x = T5Block(c, causal=False, cross=False,
+                        name=f"layer_{i}")(x, bias, mask=mask)
+        return nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,
+                          name="ln_f")(x)
+
+
+class T5Decoder(nn.Module):
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, input_ids, memory, memory_mask=None):
+        c = self.config
+        x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
+                     name="tok_emb")(input_ids)
+        L = input_ids.shape[1]
+        bias = T5RelativeBias(c, bidirectional=False, name="rel_bias")(L, L)
+        for i in range(c.num_layers):
+            x = T5Block(c, causal=True, cross=True, name=f"layer_{i}")(
+                x, bias, memory=memory, memory_mask=memory_mask)
+        x = nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype, name="ln_f")(x)
+        return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
+                        name="lm_head")(x)
+
+
+class T5(nn.Module):
+    """Encoder-decoder LM: ``(src_ids, tgt_ids) -> (B, Lt, V)`` logits.
+
+    ``src_mask``: (B, Ls) True on valid source tokens — masks encoder
+    self-attention AND decoder cross-attention.
+    """
+    config: T5Config
+
+    @nn.compact
+    def __call__(self, src_ids, tgt_ids, src_mask=None):
+        memory = T5Encoder(self.config, name="encoder")(src_ids, src_mask)
+        return T5Decoder(self.config, name="decoder")(
+            tgt_ids, memory, memory_mask=src_mask)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4))
+def _t5_greedy(model, params, src_ids, max_len, bos_id, src_mask):
+    # Module-level jit: flax modules hash by their dataclass config, so
+    # repeated decode calls with the same (config, max_len, bos_id, shapes)
+    # reuse one compiled program.
+    c = model.config
+    memory = T5Encoder(c, name="encoder").apply(
+        {"params": params["encoder"]}, src_ids, src_mask)
+    B = src_ids.shape[0]
+    buf = jnp.full((B, max_len), bos_id, jnp.int32)
+
+    def step(buf, t):
+        logits = T5Decoder(c, name="decoder").apply(
+            {"params": params["decoder"]}, buf, memory,
+            memory_mask=src_mask)
+        nxt = jnp.argmax(logits[:, t - 1], axis=-1).astype(jnp.int32)
+        return lax.dynamic_update_slice(buf, nxt[:, None], (0, t)), None
+
+    buf, _ = lax.scan(step, buf, jnp.arange(1, max_len))
+    return buf
+
+
+def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
+                     src_mask=None):
+    """Greedy seq2seq decoding as one compiled program: encoder runs once,
+    the decoder re-forwards a fixed-length buffer per step (causal
+    structure ignores the not-yet-written tail). Returns (B, max_len)
+    int32 starting with ``bos_id``."""
+    return _t5_greedy(model, params, jnp.asarray(src_ids, jnp.int32),
+                      int(max_len), int(bos_id), src_mask)

@@ -143,6 +143,27 @@ def apply_rope(x, positions, theta):
     return out.astype(x.dtype)
 
 
+def plain_attention(q, k, v, out_dtype, mask=None, bias=None, causal=False):
+    """The ONE plain-XLA attend kernel (scaled scores, optional additive
+    bias, -1e9 causal/key masking, fp32 softmax) shared by self- and
+    cross-attention. q/k/v: (B, L, h, d); ``mask``: (B, Lk) True on valid
+    keys; ``bias``: (h, Lq, Lk) added to scores."""
+    head_dim = q.shape[-1]
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
+    if bias is not None:
+        scores = scores + bias[None].astype(scores.dtype)
+    if causal:
+        Lq, Lk = q.shape[1], k.shape[1]
+        cmask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
+        scores = jnp.where(cmask[None, None], scores,
+                           jnp.asarray(-1e9, scores.dtype))
+    if mask is not None:
+        scores = jnp.where(mask[:, None, None, :], scores,
+                           jnp.asarray(-1e9, scores.dtype))
+    probs = nn.softmax(scores.astype(jnp.float32)).astype(out_dtype)
+    return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+
+
 class TPSelfAttention(nn.Module):
     """Multi-head attention with heads sharded over the tp axis.
 
@@ -209,9 +230,18 @@ class TPSelfAttention(nn.Module):
         out = jnp.einsum("bngqk,bknd->bqngd", probs, vals)
         return out.reshape(B, 1, h, d)
 
-    def _attend(self, q, k, v, mask, head_dim):
+    def _attend(self, q, k, v, mask, head_dim, bias=None):
         """Route full-sequence attention (MHA shapes — kv already broadcast
-        to the query heads): sp ring/Ulysses, Pallas flash, or plain XLA."""
+        to the query heads): sp ring/Ulysses, Pallas flash, or plain XLA.
+        ``bias``: additive (local_heads, Lq, Lk) scores bias (T5-style
+        relative positions) — plain path only. The guard mirrors the
+        dispatch below: flash with a mask falls back to the plain path,
+        where bias IS supported."""
+        if bias is not None and (self.sp_axis is not None
+                                 or (self.use_flash and mask is None)):
+            raise ValueError(
+                "additive attention bias is supported on the plain XLA "
+                "path only (not flash/sp)")
         if self.sp_axis is not None:
             # Sequence parallelism: x carries this chip's token shard; the
             # QKV/out projections are token-local, the attention itself
@@ -236,20 +266,11 @@ class TPSelfAttention(nn.Module):
         if self.use_flash and mask is None:
             from horovod_tpu.ops.pallas import flash_attention
             return flash_attention(q, k, v, causal=self.causal)
-        scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) / np.sqrt(head_dim)
-        if self.causal:
-            Lq, Lk = q.shape[1], k.shape[1]
-            cmask = jnp.tril(jnp.ones((Lq, Lk), bool), k=Lk - Lq)
-            scores = jnp.where(cmask[None, None], scores,
-                               jnp.asarray(-1e9, scores.dtype))
-        if mask is not None:
-            scores = jnp.where(mask[:, None, None, :], scores,
-                               jnp.asarray(-1e9, scores.dtype))
-        probs = nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
-        return jnp.einsum("bhqk,bkhd->bqhd", probs, v)
+        return plain_attention(q, k, v, out_dtype=self.dtype, mask=mask,
+                               bias=bias, causal=self.causal)
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, bias=None):
         n = axis_size_or_1(self.axis_name)
         kv_heads = self.num_kv_heads or self.num_heads
         if self.num_heads % n != 0 or kv_heads % n != 0:
@@ -281,9 +302,11 @@ class TPSelfAttention(nn.Module):
 
         q, k, v = heads(q), heads(k), heads(v)
         if self.decode:
-            if self.sp_axis is not None or mask is not None:
+            if self.sp_axis is not None or mask is not None or \
+                    bias is not None:
                 raise ValueError(
-                    "decode mode supports neither sp_axis nor masks")
+                    "decode mode supports neither sp_axis, masks, nor "
+                    "attention biases")
             if x.shape[1] != 1:
                 raise ValueError(
                     f"decode mode feeds ONE token per call, got "
@@ -313,7 +336,7 @@ class TPSelfAttention(nn.Module):
                 # narrow cache.)
                 k = jnp.repeat(k, local_heads // local_kv, axis=2)
                 v = jnp.repeat(v, local_heads // local_kv, axis=2)
-            out = self._attend(q, k, v, mask, head_dim)
+            out = self._attend(q, k, v, mask, head_dim, bias=bias)
         out = out.reshape(out.shape[:-2] + (local_heads * head_dim,))
         return RowParallelDense(self.hidden_size, dtype=self.dtype,
                                 use_bias=self.use_bias,
@@ -338,27 +361,76 @@ class TPMlp(nn.Module):
 
 
 class TPSwiGLUMlp(nn.Module):
-    """LLaMA-style gated MLP: fused column-parallel gate+up projection
-    (one MXU matmul), ``silu(gate) * up``, row-parallel contraction — still
-    exactly one psum per MLP block. Gate and up interact only elementwise,
-    so sharding both along the intermediate dim keeps every shard
-    self-contained until the row-parallel reduce."""
+    """Gated MLP: fused column-parallel gate+up projection (one MXU
+    matmul), ``act(gate) * up``, row-parallel contraction — still exactly
+    one psum per MLP block. Gate and up interact only elementwise, so
+    sharding both along the intermediate dim keeps every shard
+    self-contained until the row-parallel reduce. ``activation``: "silu"
+    (LLaMA SwiGLU) or "gelu" (T5 1.1 GEGLU)."""
     intermediate_size: int
     hidden_size: int
     dtype: Any = jnp.float32
     axis_name: Optional[str] = TP_AXIS
     use_bias: bool = False
+    activation: str = "silu"
 
     @nn.compact
     def __call__(self, x):
+        acts = {"silu": nn.silu, "gelu": nn.gelu}
+        if self.activation not in acts:
+            raise ValueError(f"unknown activation {self.activation!r}; "
+                             f"choose from {sorted(acts)}")
         h = ColumnParallelDense(2 * self.intermediate_size, dtype=self.dtype,
                                 use_bias=self.use_bias,
                                 axis_name=self.axis_name, name="gate_up")(x)
         g, u = jnp.split(h, 2, axis=-1)
-        h = nn.silu(g) * u
+        h = acts[self.activation](g) * u
         return RowParallelDense(self.hidden_size, dtype=self.dtype,
                                 use_bias=self.use_bias,
                                 axis_name=self.axis_name, name="out")(h)
+
+
+class TPCrossAttention(nn.Module):
+    """Encoder-decoder cross-attention with heads sharded over tp.
+
+    Queries project from the decoder stream ``x`` (column-parallel), keys
+    and values from the encoder ``memory`` (one fused column-parallel
+    matmul); the output projection is row-parallel — one psum per block,
+    exactly like :class:`TPSelfAttention`. ``memory_mask``: (B, Lk) True
+    for valid encoder positions."""
+    num_heads: int
+    hidden_size: int
+    dtype: Any = jnp.float32
+    axis_name: Optional[str] = TP_AXIS
+    use_bias: bool = True
+
+    @nn.compact
+    def __call__(self, x, memory, memory_mask=None):
+        n = axis_size_or_1(self.axis_name)
+        if self.num_heads % n != 0:
+            raise ValueError(
+                f"num_heads {self.num_heads} not divisible by tp={n}")
+        local_heads = self.num_heads // n
+        head_dim = self.hidden_size // self.num_heads
+
+        q = ColumnParallelDense(self.hidden_size, dtype=self.dtype,
+                                use_bias=self.use_bias,
+                                axis_name=self.axis_name, name="q")(x)
+        kv = ColumnParallelDense(2 * self.hidden_size, dtype=self.dtype,
+                                 use_bias=self.use_bias,
+                                 axis_name=self.axis_name, name="kv")(memory)
+        k, v = jnp.split(kv, 2, axis=-1)
+
+        def heads(t):
+            return t.reshape(t.shape[:-1] + (-1, head_dim))
+
+        q, k, v = heads(q), heads(k), heads(v)
+        out = plain_attention(q, k, v, out_dtype=self.dtype,
+                              mask=memory_mask)
+        out = out.reshape(out.shape[:-2] + (local_heads * head_dim,))
+        return RowParallelDense(self.hidden_size, dtype=self.dtype,
+                                use_bias=self.use_bias,
+                                axis_name=self.axis_name, name="out")(out)
 
 
 class TPTransformerBlock(nn.Module):

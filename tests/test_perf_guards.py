@@ -134,3 +134,36 @@ class TestEagerFusionCacheGuards:
         # shapes is fine, one-program-per-tensor is the regression.
         assert new_programs <= 5, \
             f"{new_programs} fused programs for 50 identical tensors"
+
+
+class TestLlamaStepGuards:
+    def test_llama_dp_step_collective_count(self, hvd):
+        """A LLaMA DP train step must lower to a constant number of
+        all-reduces (fused gradient buckets + loss), not O(n_layers) —
+        the same fusion invariant the reference's bucketing buys
+        (reference: operations.cc:747-853)."""
+        import optax
+
+        from horovod_tpu.models import Llama, LlamaConfig
+        from horovod_tpu.optim import DistributedOptimizer
+        from horovod_tpu.parallel import TrainState, make_train_step
+
+        mesh = hvd.global_process_set.mesh
+        cfg = LlamaConfig.tiny(tp_axis=None, num_layers=8)
+        model = Llama(cfg)
+        ids = jnp.zeros((mesh.size, 16), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids[:1])["params"]
+
+        def loss_fn(p, b):
+            lg = model.apply({"params": p}, b["ids"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                lg[:, :-1], b["ids"][:, 1:]).mean()
+
+        opt = DistributedOptimizer(optax.sgd(0.1))
+        step = make_train_step(loss_fn, opt, mesh, donate=False)
+        state = TrainState.create(params, opt)
+        lowered = step.lower(state, {"ids": ids})
+        count = _count_all_reduce(lowered.as_text())
+        # fused fp32 gradient bucket(s) + loss mean; 8 layers x k tensors
+        # each would blow well past this bound if fusion regressed.
+        assert 1 <= count <= 4, f"collective count regressed: {count}"
