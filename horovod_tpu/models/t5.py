@@ -138,13 +138,18 @@ class T5Block(nn.Module):
 
 
 class T5Encoder(nn.Module):
+    """Encoder stack. ``embed``: a shared token embedding passed by the
+    parent :class:`T5` (T5 shares ONE embedding between encoder and
+    decoder); standalone use creates its own."""
     config: T5Config
+    embed: Optional[nn.Module] = None
 
     @nn.compact
     def __call__(self, input_ids, mask=None):
         c = self.config
-        x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
-                     name="tok_emb")(input_ids)
+        emb = self.embed if self.embed is not None else nn.Embed(
+            c.vocab_size, c.hidden_size, dtype=c.dtype, name="tok_emb")
+        x = emb(input_ids)
         L = input_ids.shape[1]
         bias = T5RelativeBias(c, bidirectional=True, name="rel_bias")(L, L)
         for i in range(c.num_layers):
@@ -155,13 +160,16 @@ class T5Encoder(nn.Module):
 
 
 class T5Decoder(nn.Module):
+    """Decoder stack (see :class:`T5Encoder` for ``embed`` sharing)."""
     config: T5Config
+    embed: Optional[nn.Module] = None
 
     @nn.compact
     def __call__(self, input_ids, memory, memory_mask=None):
         c = self.config
-        x = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype,
-                     name="tok_emb")(input_ids)
+        emb = self.embed if self.embed is not None else nn.Embed(
+            c.vocab_size, c.hidden_size, dtype=c.dtype, name="tok_emb")
+        x = emb(input_ids)
         L = input_ids.shape[1]
         bias = T5RelativeBias(c, bidirectional=False, name="rel_bias")(L, L)
         for i in range(c.num_layers):
@@ -176,32 +184,43 @@ class T5(nn.Module):
     """Encoder-decoder LM: ``(src_ids, tgt_ids) -> (B, Lt, V)`` logits.
 
     ``src_mask``: (B, Ls) True on valid source tokens — masks encoder
-    self-attention AND decoder cross-attention.
+    self-attention AND decoder cross-attention. One token embedding is
+    SHARED between the two stacks (the T5 recipe; only the LM head is
+    untied, per T5 1.1): its params live under ``shared`` in the tree.
     """
     config: T5Config
 
-    @nn.compact
+    def setup(self):
+        c = self.config
+        self.shared = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype)
+        self.encoder = T5Encoder(c, embed=self.shared)
+        self.decoder = T5Decoder(c, embed=self.shared)
+
+    def encode(self, src_ids, src_mask=None):
+        return self.encoder(src_ids, src_mask)
+
+    def decode(self, tgt_ids, memory, memory_mask=None):
+        return self.decoder(tgt_ids, memory, memory_mask=memory_mask)
+
     def __call__(self, src_ids, tgt_ids, src_mask=None):
-        memory = T5Encoder(self.config, name="encoder")(src_ids, src_mask)
-        return T5Decoder(self.config, name="decoder")(
-            tgt_ids, memory, memory_mask=src_mask)
+        return self.decode(tgt_ids, self.encode(src_ids, src_mask),
+                           memory_mask=src_mask)
 
 
 @functools.partial(jax.jit, static_argnums=(0, 3, 4))
 def _t5_greedy(model, params, src_ids, max_len, bos_id, src_mask):
     # Module-level jit: flax modules hash by their dataclass config, so
     # repeated decode calls with the same (config, max_len, bos_id, shapes)
-    # reuse one compiled program.
-    c = model.config
-    memory = T5Encoder(c, name="encoder").apply(
-        {"params": params["encoder"]}, src_ids, src_mask)
+    # reuse one compiled program. encode/decode run as methods of the FULL
+    # model so the shared token embedding resolves.
+    memory = model.apply({"params": params}, src_ids, src_mask,
+                         method=T5.encode)
     B = src_ids.shape[0]
     buf = jnp.full((B, max_len), bos_id, jnp.int32)
 
     def step(buf, t):
-        logits = T5Decoder(c, name="decoder").apply(
-            {"params": params["decoder"]}, buf, memory,
-            memory_mask=src_mask)
+        logits = model.apply({"params": params}, buf, memory,
+                             memory_mask=src_mask, method=T5.decode)
         nxt = jnp.argmax(logits[:, t - 1], axis=-1).astype(jnp.int32)
         return lax.dynamic_update_slice(buf, nxt[:, None], (0, t)), None
 

@@ -13,6 +13,8 @@ from horovod_tpu.ray.worker import BaseHorovodWorker
 from horovod_tpu.ray.runner import RayExecutor
 from horovod_tpu.ray.strategy import (placement_bundles, ray_available,
                                       worker_env)
+from horovod_tpu.ray.tune import tune_trainable
 
 __all__ = ["RayExecutor", "RayHostDiscovery", "run_elastic",
-           "placement_bundles", "worker_env", "ray_available"]
+           "placement_bundles", "worker_env", "ray_available",
+           "tune_trainable"]

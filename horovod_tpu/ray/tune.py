@@ -1,0 +1,60 @@
+"""Ray Tune integration: distributed trials over TPU hosts.
+
+The reference documents pairing Horovod with Ray Tune through a
+"distributed trainable" — each Tune trial is itself a multi-worker
+training job (reference: docs/hyperparameter_search.rst; the creator
+function itself ships in Ray, ``ray.tune.integration.horovod``). This is
+the TPU-native analog built on :class:`horovod_tpu.ray.RayExecutor`: one
+trial = one executor fan-out, with the trial config forwarded to every
+worker.
+
+Gated like the rest of the package: importing works without ray,
+constructing a trainable requires it.
+"""
+
+from horovod_tpu.ray.strategy import ray_available
+
+
+def tune_trainable(train_fn, num_workers=1, num_hosts=None,
+                   num_workers_per_host=None, cpus_per_worker=1,
+                   tpus_per_worker=0, executor_env=None):
+    """Wrap ``train_fn(config) -> result`` as a Ray Tune trainable whose
+    every trial runs ``train_fn`` across a :class:`RayExecutor` fan-out.
+
+    ``train_fn`` runs on EVERY worker of the trial with the trial's
+    ``config`` dict; call :func:`horovod_tpu.init` inside as usual. The
+    rank-0 return value is reported to Tune as the trial result (dict
+    results are reported as-is; other values under ``{"result": ...}``).
+
+    Use Tune's ``tune.with_resources``/``PlacementGroupFactory`` knobs for
+    scheduling beyond the executor's own placement. Reference shape:
+    ``DistributedTrainableCreator(fn, num_slots=...)``
+    (docs/hyperparameter_search.rst).
+    """
+    if not ray_available():
+        raise RuntimeError(
+            "horovod_tpu.ray.tune requires ray; pip install 'ray[tune]'")
+    from horovod_tpu.ray import RayExecutor
+
+    def trainable(config):
+        executor = RayExecutor(
+            # exactly one of num_workers / num_hosts may be set
+            # (placement_bundles validates)
+            num_workers=None if num_hosts is not None else num_workers,
+            num_hosts=num_hosts,
+            num_workers_per_host=num_workers_per_host or 1,
+            cpus_per_worker=cpus_per_worker,
+            tpus_per_worker=tpus_per_worker, env_vars=executor_env)
+        try:
+            # start() inside the try: a partially-started executor (e.g.
+            # placement-group timeout) must still release its placement
+            # group / KV server, or failing trials leak cluster resources.
+            executor.start()
+            results = executor.run(train_fn, args=(config,))
+        finally:
+            executor.shutdown()
+        out = results[0]
+        return out if isinstance(out, dict) else {"result": out}
+
+    trainable.__name__ = getattr(train_fn, "__name__", "hvd_trainable")
+    return trainable

@@ -474,3 +474,70 @@ class TestRemoteCheckpointStaging:
         local, sync = stage_checkpoints(store, "runY")
         assert local == store.get_checkpoint_path("runY")
         sync()  # no-op
+
+
+class TestRayTune:
+    def test_tune_trainable_requires_ray(self):
+        from horovod_tpu.ray.tune import tune_trainable
+        with pytest.raises(RuntimeError, match="ray"):
+            tune_trainable(lambda config: None, num_workers=2)
+
+    def _fake_executor(self, calls, results=None, fail_run=False):
+        class FakeExecutor:
+            def __init__(self, **kw):
+                calls.append(("init", kw))
+
+            def start(self):
+                calls.append(("start",))
+
+            def run(self, fn, args=None, kwargs=None):
+                calls.append(("run",))
+                if fail_run:
+                    raise RuntimeError("worker died")
+                return [fn(*args) for _ in range(2)] if results is None \
+                    else results
+
+            def shutdown(self):
+                calls.append(("shutdown",))
+
+        return FakeExecutor
+
+    def test_tune_trainable_happy_path(self, monkeypatch):
+        """One trial = executor start -> run(train_fn, config) ->
+        shutdown; rank-0 dict result reported as-is, scalars wrapped."""
+        import horovod_tpu.ray as hvd_ray
+        import horovod_tpu.ray.tune as tune_mod
+        monkeypatch.setattr(tune_mod, "ray_available", lambda: True)
+        calls = []
+        monkeypatch.setattr(hvd_ray, "RayExecutor",
+                            self._fake_executor(calls))
+        t = tune_mod.tune_trainable(
+            lambda config: {"loss": config["lr"] * 2}, num_hosts=2,
+            cpus_per_worker=3)
+        assert t({"lr": 0.5}) == {"loss": 1.0}
+        assert [c[0] for c in calls] == ["init", "start", "run",
+                                        "shutdown"]
+        kw = calls[0][1]
+        # num_hosts set -> num_workers must be None (executor validation)
+        assert kw["num_hosts"] == 2 and kw["num_workers"] is None
+        assert kw["cpus_per_worker"] == 3
+
+        calls.clear()
+        monkeypatch.setattr(hvd_ray, "RayExecutor",
+                            self._fake_executor(calls, results=[3.5, 0.0]))
+        t = tune_mod.tune_trainable(lambda config: None, num_workers=2)
+        assert t({}) == {"result": 3.5}          # scalar rank-0 wrapped
+
+    def test_tune_trainable_shuts_down_on_failure(self, monkeypatch):
+        """A failing trial must still release the executor (placement
+        group / KV server) — no leaked cluster resources across trials."""
+        import horovod_tpu.ray as hvd_ray
+        import horovod_tpu.ray.tune as tune_mod
+        monkeypatch.setattr(tune_mod, "ray_available", lambda: True)
+        calls = []
+        monkeypatch.setattr(hvd_ray, "RayExecutor",
+                            self._fake_executor(calls, fail_run=True))
+        t = tune_mod.tune_trainable(lambda config: None, num_workers=2)
+        with pytest.raises(RuntimeError, match="worker died"):
+            t({})
+        assert ("shutdown",) in calls
