@@ -174,19 +174,49 @@ def _bench_bert(hvd):
           round(batch * iters / dt / n, 2), "sequences/sec/chip", 0.0)
 
 
-def _bench_gpt(hvd):
-    """GPT-2-small (124M) causal-LM training step, seq 1024 — the long-
-    context/transformer headline alongside ResNet (conv) and BERT (encoder).
-    Reports tokens/sec/chip."""
-    from horovod_tpu.models.gpt import GPT, GPTConfig
+def _bench_lm(hvd, label, metric, model, init_args, batch_dict, loss_fn,
+              tokens_per_step):
+    """Shared scaffold for the LM benches (GPT/LLaMA/T5): jitted init,
+    fused DistributedOptimizer(adamw) step, timed steps, ONE JSON line in
+    tokens/sec/chip. vs_baseline 0.0 throughout: the reference publishes
+    no LM numbers."""
     from horovod_tpu.optim import DistributedOptimizer
     from horovod_tpu.parallel import TrainState, make_train_step
 
     n = hvd.size()
     mesh = hvd.global_process_set.mesh
-    seq = int(os.environ.get("HVD_BENCH_SEQ", "1024"))
-    per_chip = int(os.environ.get("HVD_BENCH_BATCH", "8"))
-    batch = per_chip * n
+    variables = jax.jit(model.init)(jax.random.PRNGKey(0), *init_args)
+    _mark(f"{label} init done")
+    opt = DistributedOptimizer(optax.adamw(1e-4))
+    step = make_train_step(loss_fn, opt, mesh, donate=True)
+    state = TrainState.create(variables["params"], opt)
+    iters, dt = _timed_steps(step, state, batch_dict)
+    _emit(metric, round(tokens_per_step * iters / dt / n, 1),
+          "tokens/sec/chip", 0.0)
+
+
+def _lm_shapes(default_seq, default_batch, n):
+    seq = int(os.environ.get("HVD_BENCH_SEQ", str(default_seq)))
+    per_chip = int(os.environ.get("HVD_BENCH_BATCH", str(default_batch)))
+    return seq, per_chip * n
+
+
+def _next_token_loss(model, key="ids"):
+    def loss_fn(p, b):
+        logits = model.apply({"params": p}, b[key])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits[:, :-1].astype(jnp.float32), b[key][:, 1:]).mean()
+
+    return loss_fn
+
+
+def _bench_gpt(hvd):
+    """GPT-2-small (124M) causal-LM training step, seq 1024 — the long-
+    context/transformer headline alongside ResNet (conv) and BERT (encoder).
+    Reports tokens/sec/chip."""
+    from horovod_tpu.models.gpt import GPT, GPTConfig
+
+    seq, batch = _lm_shapes(1024, 8, hvd.size())
     # Tiled Pallas flash attention (ops/pallas/flash_attention.py) is the
     # default: O(seq) memory and measured faster than plain attention at
     # every context length on v5e (101.7k vs 75.8k tok/s at seq 1024;
@@ -198,25 +228,11 @@ def _bench_gpt(hvd):
                     tp_axis=None, ep_axis=None,
                     use_flash=_flash_default())
     model = GPT(cfg)
-
-    rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
-                      jnp.int32)
-    variables = jax.jit(model.init)(jax.random.PRNGKey(0), ids[:1])
-    _mark("gpt init done")
-    opt = DistributedOptimizer(optax.adamw(1e-4))
-
-    def loss_fn(p, b):
-        logits = model.apply({"params": p}, b["ids"])
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1].astype(jnp.float32), b["ids"][:, 1:]).mean()
-
-    step = make_train_step(loss_fn, opt, mesh, donate=True)
-    state = TrainState.create(variables["params"], opt)
-    iters, dt = _timed_steps(step, state, {"ids": ids})
-    # vs_baseline 0.0: the reference publishes no GPT number.
-    _emit("gpt2_small_tokens_per_sec_per_chip",
-          round(batch * seq * iters / dt / n, 1), "tokens/sec/chip", 0.0)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    _bench_lm(hvd, "gpt", "gpt2_small_tokens_per_sec_per_chip", model,
+              (ids[:1],), {"ids": ids}, _next_token_loss(model),
+              batch * seq)
 
 
 def _bench_llama(hvd):
@@ -225,34 +241,44 @@ def _bench_llama(hvd):
     flash attention by default. Reports tokens/sec/chip (no reference
     number exists)."""
     from horovod_tpu.models import Llama, LlamaConfig
-    from horovod_tpu.optim import DistributedOptimizer
-    from horovod_tpu.parallel import TrainState, make_train_step
 
-    n = hvd.size()
-    mesh = hvd.global_process_set.mesh
-    seq = int(os.environ.get("HVD_BENCH_SEQ", "1024"))
-    per_chip = int(os.environ.get("HVD_BENCH_BATCH", "8"))
-    batch = per_chip * n
+    seq, batch = _lm_shapes(1024, 8, hvd.size())
     cfg = LlamaConfig.bench(max_position_embeddings=seq, dtype=jnp.bfloat16,
                             tp_axis=None, use_flash=_flash_default())
     model = Llama(cfg)
+    ids = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, seq)), jnp.int32)
+    _bench_lm(hvd, "llama", "llama_400m_tokens_per_sec_per_chip", model,
+              (ids[:1],), {"ids": ids}, _next_token_loss(model),
+              batch * seq)
+
+
+def _bench_t5(hvd):
+    """T5-small-shaped encoder-decoder step (relative position biases +
+    cross-attention, models/t5.py), bf16, seq 512->512, adamw, fused
+    allreduce. Reports tokens/sec/chip over decoder tokens (no reference
+    number exists)."""
+    from horovod_tpu.models import T5, T5Config
+
+    seq, batch = _lm_shapes(512, 16, hvd.size())
+    cfg = T5Config(vocab_size=32128, hidden_size=512, num_layers=6,
+                   num_heads=8, intermediate_size=1024,
+                   dtype=jnp.bfloat16, tp_axis=None)
+    model = T5(cfg)
     rng = np.random.default_rng(0)
-    ids = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+    src = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
                       jnp.int32)
-    variables = jax.jit(model.init)(jax.random.PRNGKey(0), ids[:1])
-    _mark("llama init done")
-    opt = DistributedOptimizer(optax.adamw(1e-4))
+    tgt = jnp.asarray(rng.integers(0, cfg.vocab_size, (batch, seq)),
+                      jnp.int32)
 
     def loss_fn(p, b):
-        logits = model.apply({"params": p}, b["ids"])
+        logits = model.apply({"params": p}, b["src"], b["tgt"])
         return optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1].astype(jnp.float32), b["ids"][:, 1:]).mean()
+            logits[:, :-1].astype(jnp.float32), b["tgt"][:, 1:]).mean()
 
-    step = make_train_step(loss_fn, opt, mesh, donate=True)
-    state = TrainState.create(variables["params"], opt)
-    iters, dt = _timed_steps(step, state, {"ids": ids})
-    _emit("llama_400m_tokens_per_sec_per_chip",
-          round(batch * seq * iters / dt / n, 1), "tokens/sec/chip", 0.0)
+    _bench_lm(hvd, "t5", "t5_small_tokens_per_sec_per_chip", model,
+              (src[:1], tgt[:1]), {"src": src, "tgt": tgt}, loss_fn,
+              batch * seq)
 
 
 def _bench_vit(hvd):
@@ -374,6 +400,8 @@ _EXTRA_MODELS = {
             "images/sec/chip"),
     "llama": (_bench_llama, "llama_400m_tokens_per_sec_per_chip",
               "tokens/sec/chip"),
+    "t5": (_bench_t5, "t5_small_tokens_per_sec_per_chip",
+           "tokens/sec/chip"),
 }
 
 

@@ -48,5 +48,8 @@ PY
 # 6. LLaMA-400M causal-LM bench (GQA + RoPE + SwiGLU through flash kernels)
 HVD_BENCH_MODEL=llama HVD_BENCH_ITERS=10 python bench.py
 
+# 6b. T5-small encoder-decoder bench (rel-pos biases + cross-attention)
+HVD_BENCH_MODEL=t5 HVD_BENCH_ITERS=10 python bench.py
+
 # 7. ResNet-50 tracked config re-baseline
 HVD_BENCH_ITERS=20 python bench.py
