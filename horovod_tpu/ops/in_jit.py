@@ -191,3 +191,17 @@ def mark_varying(tree, axis_name=HVD_AXIS):
         return lax.pcast(x, axis_name, to="varying")
 
     return _jax.tree_util.tree_map(mv, tree)
+
+
+def mark_varying_like(tree, ref, axis_name=HVD_AXIS):
+    """Lift every leaf of ``tree`` to device-varying over ``axis_name`` AND
+    every axis ``ref`` (a data operand) is already varying over. Use for
+    scan/loop carries whose steady-state type combines constants with data
+    that may itself be sharded over MORE mesh axes (e.g. a ring-attention
+    accumulator on a dp x pp x sp mesh is varying over all three)."""
+    import jax as _jax
+
+    axes = set(getattr(_jax.typeof(ref), "vma", ())) | {axis_name}
+    for ax in axes:
+        tree = mark_varying(tree, ax)
+    return tree

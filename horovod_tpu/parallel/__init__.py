@@ -21,5 +21,5 @@ from horovod_tpu.parallel.pp import (  # noqa: F401
 )
 from horovod_tpu.parallel.moe import MoEMlp  # noqa: F401
 from horovod_tpu.parallel.composite import (  # noqa: F401
-    CompositeGPT, CompositeLlama, build_mesh3d,
+    CompositeGPT, CompositeLlama, build_mesh3d, build_mesh4d,
 )

@@ -1,4 +1,4 @@
-"""Composite parallelism: dp x pp x tp (with ep riding dp) in ONE XLA program.
+"""Composite parallelism: dp x pp x tp (x sp, with ep riding dp) in ONE XLA program.
 
 The reference scales one way — data parallelism over whole-replica gradients
 (SURVEY.md §2.6). This module is the TPU-native generalization: a 3-D device
@@ -9,7 +9,11 @@ mesh ``(dp, pp, tp)`` where
 - **pp** carries pipeline stages (parallel/pp.py ppermute schedule),
 - **tp** carries Megatron-sharded attention/MLP weights (parallel/tp.py),
 - **ep** rides the dp axis: MoE expert weights are sharded over dp and
-  dispatched with all_to_all (parallel/moe.py), DeepSpeed-MoE style.
+  dispatched with all_to_all (parallel/moe.py), DeepSpeed-MoE style,
+- **sp** (optional, :func:`build_mesh4d` + ``config.sp_axis="sp"``) shards
+  the sequence dim: ring/Ulysses attention inside every block
+  (parallel/sequence.py), global RoPE/position offsets, boundary-correct
+  next-token labels, and an sp-global token mean in the loss.
 
 Gradient semantics come from ``shard_map``'s varying-manual-axes (VMA) type
 system rather than hand-written reductions: parameters enter typed by their
@@ -37,7 +41,7 @@ from horovod_tpu.parallel.moe import MoEMlp
 from horovod_tpu.parallel.pp import pipeline
 from horovod_tpu.parallel.tp import TPTransformerBlock
 
-DP_AXIS, PPL_AXIS, TP_AXIS = "dp", "pp", "tp"
+DP_AXIS, PPL_AXIS, TP_AXIS, SP_AXIS = "dp", "pp", "tp", "sp"
 
 
 def build_mesh3d(dp: int, pp: int, tp: int, devices=None) -> Mesh:
@@ -52,6 +56,19 @@ def build_mesh3d(dp: int, pp: int, tp: int, devices=None) -> Mesh:
         raise ValueError(f"need {n} devices, have {len(devices)}")
     arr = np.array(devices[:n], dtype=object).reshape(dp, pp, tp)
     return Mesh(arr, (DP_AXIS, PPL_AXIS, TP_AXIS))
+
+
+def build_mesh4d(dp: int, pp: int, sp: int, tp: int, devices=None) -> Mesh:
+    """A (dp, pp, sp, tp) mesh for composite training WITH sequence
+    parallelism: tp innermost (per-block psums), then sp (per-block ring /
+    all-to-all hops), then pp (per-microbatch hops), dp outermost."""
+    if devices is None:
+        devices = jax.devices()
+    n = dp * pp * sp * tp
+    if len(devices) < n:
+        raise ValueError(f"need {n} devices, have {len(devices)}")
+    arr = np.array(devices[:n], dtype=object).reshape(dp, pp, sp, tp)
+    return Mesh(arr, (DP_AXIS, PPL_AXIS, SP_AXIS, TP_AXIS))
 
 
 def _spec_axes(spec):
@@ -121,15 +138,16 @@ class _CompositeLM:
         for ax in (DP_AXIS, PPL_AXIS, TP_AXIS):
             if ax not in self.mesh.shape:
                 raise ValueError(f"mesh must have axis {ax!r}")
-        if getattr(c, "sp_axis", None) is not None:
-            # The composite step shards ids over dp only; honoring sp_axis
-            # would need a 4-D mesh and sp-sharded inputs throughout the
-            # pipeline. Refuse loudly rather than half-apply (the embed
-            # would offset positions while attention stayed local).
+        self.sp = getattr(c, "sp_axis", None)
+        if self.sp is not None and (self.sp != SP_AXIS
+                                    or SP_AXIS not in self.mesh.shape):
+            # Half-applied sequence parallelism (embed offsetting positions
+            # while attention stays local, or an unknown axis name) would
+            # silently train wrong — require the 4-D mesh contract.
             raise NotImplementedError(
-                f"{type(self).__name__} does not support config.sp_axis; "
-                "use the flat model's sp_axis for sequence parallelism or "
-                "unset it")
+                f"{type(self).__name__} supports config.sp_axis only as "
+                f"{SP_AXIS!r} over a build_mesh4d mesh (got "
+                f"sp_axis={self.sp!r}, mesh axes {tuple(self.mesh.shape)})")
         self.pp = self.mesh.shape[PPL_AXIS]
         if c.num_layers % self.pp != 0:
             raise ValueError(
@@ -151,6 +169,11 @@ class _CompositeLM:
             return P()                             # replicated
 
         return jax.tree_util.tree_map_with_path(spec, params_shape)
+
+    def _ids_spec(self):
+        """Token batches: batch dim over dp, sequence dim over sp when
+        sequence parallelism is on."""
+        return P(DP_AXIS, SP_AXIS) if self.sp else P(DP_AXIS)
 
     # ---- init ----
 
@@ -184,7 +207,7 @@ class _CompositeLM:
         irrelevant); returns ``(params, opt_state, specs)`` where ``specs``
         is ``(param_specs, opt_specs)``.
         """
-        ids_spec = P(DP_AXIS)
+        ids_spec = self._ids_spec()
 
         # Structure-only pass (specs are keyed by tree paths, not shapes);
         # check_vma off since the throwaway out_specs are all-replicated.
@@ -218,10 +241,30 @@ class _CompositeLM:
     def _head_loss(self, head_params, y, ids):
         """Head + next-token loss over one (micro)batch — the ONE loss
         definition both schedules use (mean over equal-sized microbatches
-        == the full-batch mean)."""
+        == the full-batch mean).
+
+        Labels come from :func:`next_token_labels`: under sequence
+        parallelism each shard's last position's label is the NEXT shard's
+        first token (one ppermute) and the final global position is masked;
+        without sp it degrades to the ordinary shift (identical to the
+        former ``logits[:, :-1]`` vs ``ids[:, 1:]`` mean). The token mean
+        is GLOBAL over sp (psum of sums), so the loss is sp-invariant.
+        """
+        from horovod_tpu.parallel.sequence import next_token_labels
+        from horovod_tpu.parallel.tp import axis_bound
         logits = self.head.apply({"params": head_params}, y)
-        return optax.softmax_cross_entropy_with_integer_labels(
-            logits[:, :-1], ids[:, 1:]).mean()
+        labels = next_token_labels(ids, self.sp)   # None -> plain shift
+        valid = labels != -100
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits.astype(jnp.float32), jnp.where(valid, labels, 0))
+        num = (ce * valid).sum()
+        den = valid.sum().astype(jnp.float32)
+        if self.sp and axis_bound(SP_AXIS):
+            # psum whenever bound — at sp=1 it's a numeric no-op that
+            # still clears the sp-varying type the sharded ids imprinted.
+            num = lax.psum(num, SP_AXIS)
+            den = lax.psum(den, SP_AXIS)
+        return num / den
 
     def _loss_local(self, params, ids):
         c = self.config
@@ -311,7 +354,7 @@ class _CompositeLM:
 
         sharded = jax.shard_map(
             step, mesh=self.mesh,
-            in_specs=(param_specs, opt_specs, P(DP_AXIS)),
+            in_specs=(param_specs, opt_specs, self._ids_spec()),
             out_specs=(param_specs, opt_specs, P()))
         return jax.jit(sharded,
                        donate_argnums=(0, 1) if donate else ())
@@ -331,7 +374,8 @@ class CompositeGPT(_CompositeLM):
         self.block = TPTransformerBlock(
             c.num_heads, c.hidden_size, c.intermediate_size, dtype=c.dtype,
             axis_name=TP_AXIS, causal=True,
-            use_flash=getattr(c, "use_flash", False))
+            use_flash=getattr(c, "use_flash", False),
+            sp_axis=c.sp_axis, sp_impl=getattr(c, "sp_impl", "ring"))
         self.moe = None
         if c.num_experts:
             self.moe = MoEMlp(c.num_experts, c.hidden_size,

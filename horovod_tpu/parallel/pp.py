@@ -155,16 +155,20 @@ def pipeline_1f1b(layer_fn: Callable, head_loss_fn: Callable, stage_params,
         return stage_apply(layer_fn, p, x)
 
     # Scan carries must enter with the exact varying-axes type their
-    # outputs will have. On a composite mesh the data is varying over more
-    # than the pp axis (dp-sharded batches), and gradient carries
-    # additionally inherit each parameter leaf's own axes (tp-sharded
-    # kernels) — mark every carry leaf over the union it will reach.
+    # outputs will have. Activation/gradient-flow carries match the DATA's
+    # axes (dp/sp-sharded batches) plus pp. Parameter-gradient carries
+    # match each PARAM leaf's own axes — a vjp cotangent is varying exactly
+    # over its primal's axes (axes the data varies over but the param does
+    # not get psummed inside the transpose), so marking them with the data
+    # axes would over-promote (e.g. sp) and break the out_specs. The loss
+    # carry takes head_loss_fn's actual output type (it may reduce axes
+    # internally, e.g. an sp-global token mean).
     data_axes = (set(getattr(jax.typeof(microbatches), "vma", ()))
                  | set(getattr(jax.typeof(targets), "vma", ()))
                  | {axis_name})
 
-    def mv(x, extra=()):
-        for ax in data_axes | set(extra):
+    def mv(x, axes):
+        for ax in axes:
             x = mark_varying(x, ax)
         return x
 
@@ -173,16 +177,20 @@ def pipeline_1f1b(layer_fn: Callable, head_loss_fn: Callable, stage_params,
             lambda p: mv(jnp.zeros_like(p),
                          getattr(jax.typeof(p), "vma", ())), params)
 
-    zeros_mb = mv(jnp.zeros_like(microbatches[0]))
+    loss_aval = jax.eval_shape(head_loss_fn, head_params, microbatches[0],
+                               targets[0])
+    loss_axes = set(getattr(loss_aval, "vma", ())) | {axis_name}
+
+    zeros_mb = mv(jnp.zeros_like(microbatches[0]), data_axes)
     carry0 = dict(
         fwd_state=zeros_mb,                       # activation hop buffer
         bwd_state=zeros_mb,                       # gradient hop buffer
         stash=mv(jnp.zeros((ssize,) + microbatches.shape[1:],
-                           microbatches.dtype)),
-        d_mb=mv(jnp.zeros_like(microbatches)),
+                           microbatches.dtype), data_axes),
+        d_mb=mv(jnp.zeros_like(microbatches), data_axes),
         d_params=grad_carry(stage_params),
         d_head=grad_carry(head_params),
-        loss_sum=mv(jnp.zeros((), jnp.float32)),
+        loss_sum=mv(jnp.zeros((), jnp.float32), loss_axes),
     )
 
     def tick(c, t):

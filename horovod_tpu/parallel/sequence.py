@@ -56,12 +56,9 @@ def _axis_bound(axis_name):
     """True when ``axis_name`` is bound in the current trace (i.e. we're
     inside shard_map over it). Lets the attention schemes run un-sharded —
     e.g. during flax ``Module.init`` outside the mesh context — by degrading
-    to local attention."""
-    try:
-        lax.axis_size(axis_name)
-        return True
-    except NameError:
-        return False
+    to local attention. (Shared predicate: parallel/tp.py axis_bound.)"""
+    from horovod_tpu.parallel.tp import axis_bound
+    return axis_bound(axis_name)
 
 
 def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False,
@@ -114,9 +111,12 @@ def next_token_labels(ids, axis_name=SP_AXIS, pad_id=-100):
     ordinary global shift.
 
     ``ids``: (B, L_local) int tokens. Returns same-shape labels.
+    ``axis_name=None`` (tokens not sequence-sharded) always takes the
+    plain-shift path — even when some OTHER mesh axis named like the
+    default happens to be bound.
     """
     pad = jnp.full_like(ids[:, :1], pad_id)
-    if not _axis_bound(axis_name):
+    if axis_name is None or not _axis_bound(axis_name):
         return jnp.concatenate([ids[:, 1:], pad], axis=1)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -161,7 +161,7 @@ def _ring_flash(q3, k3, v3, causal, axis_name, scale, blocks):
 def _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks):
     """Ring forward: rotate K/V blocks, run the flash block kernel per hop,
     combine hop outputs by their logsumexp weights (exact)."""
-    from horovod_tpu.ops.in_jit import mark_varying
+    from horovod_tpu.ops.in_jit import mark_varying_like
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     bh, L, d = q3.shape
@@ -170,7 +170,9 @@ def _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks):
     m = jnp.full((bh, L), -1e30, jnp.float32)
     norm = jnp.zeros((bh, L), jnp.float32)
     acc = jnp.zeros((bh, L, d), jnp.float32)
-    m, norm, acc = mark_varying((m, norm, acc), axis_name)
+    # carry varying over sp AND any axes the data is sharded over (dp/pp
+    # on a composite mesh)
+    m, norm, acc = mark_varying_like((m, norm, acc), q3, axis_name)
     ks, vs = k3, v3
     for s in range(n):
         src = (idx + s) % n
@@ -213,12 +215,13 @@ def _ring_flash_bwd(causal, axis_name, scale, blocks, res, do3):
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
     perm = [(i, (i - 1) % n) for i in range(n)]
-    from horovod_tpu.ops.in_jit import mark_varying
+    from horovod_tpu.ops.in_jit import mark_varying_like
 
     dq = jnp.zeros(q3.shape, jnp.float32)
     dk_rot = jnp.zeros(k3.shape, jnp.float32)
     dv_rot = jnp.zeros(v3.shape, jnp.float32)
-    dq, dk_rot, dv_rot = mark_varying((dq, dk_rot, dv_rot), axis_name)
+    dq, dk_rot, dv_rot = mark_varying_like((dq, dk_rot, dv_rot), q3,
+                                           axis_name)
     # Fully-masked rows (possible only without a visible diagonal) carry
     # lse ~ -1e30; clamp so exp(s - lse) cannot overflow — their hop
     # contributions are already zeroed by the visibility gate.
@@ -327,12 +330,13 @@ def ring_attention(q, k, v, axis_name=SP_AXIS, causal=False,
         vs = lax.ppermute(vs, axis_name, perm)
         return o_new, m_new, l_new, ks, vs
 
-    from horovod_tpu.ops.in_jit import mark_varying
+    from horovod_tpu.ops.in_jit import mark_varying_like
     o = jnp.zeros((B, H, Lq, D), jnp.float32)
     m = jnp.full((B, H, Lq), -jnp.inf, jnp.float32)
     l = jnp.zeros((B, H, Lq), jnp.float32)
     # constants start axis-invariant; the loop carry must be device-varying
-    o, m, l = mark_varying((o, m, l), axis_name)
+    # over sp and any other axes the data is sharded over
+    o, m, l = mark_varying_like((o, m, l), q, axis_name)
     o, m, l, _, _ = lax.fori_loop(0, n, step, (o, m, l, k, v),
                                   unroll=True)
     out = o / jnp.maximum(l, 1e-30)[..., None]              # (B, H, Lq, D)

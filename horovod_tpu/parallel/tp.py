@@ -45,6 +45,21 @@ def axis_size_or_1(axis_name) -> int:
         return 1
 
 
+def axis_bound(axis_name) -> bool:
+    """True when ``axis_name`` is bound in the current trace — even at
+    size 1, where collectives are numeric no-ops but still clear the
+    varying-manual-axes type (a size-1 tp axis on a composite mesh types
+    sharded weights tp-varying; skipping the row-parallel psum would leak
+    that varying-ness into shape-invariant carries)."""
+    if axis_name is None:
+        return False
+    try:
+        lax.axis_size(axis_name)
+        return True
+    except NameError:
+        return False
+
+
 def tp_shard_rng(rng, axis_name=TP_AXIS):
     """Fold the tp coordinate into an init rng so each shard draws distinct
     weights (a sharded weight is one logical matrix, not n copies)."""
@@ -113,7 +128,10 @@ class RowParallelDense(nn.Module):
             kernel_init=shard_init(nn.initializers.lecun_normal(),
                                    self.axis_name),
             name="shard")(x)
-        if axis_size_or_1(self.axis_name) > 1:
+        if axis_bound(self.axis_name):
+            # psum whenever the axis is BOUND — at size 1 it's a numeric
+            # no-op the compiler elides, but it clears the tp-varying VMA
+            # type the sharded kernel imprinted on y.
             y = lax.psum(y, self.axis_name)
         if self.use_bias:
             bias = self.param("bias", nn.initializers.zeros,

@@ -499,6 +499,81 @@ class TestCompositeLlama:
         assert all(np.isfinite(losses))
         assert losses[-1] < losses[0], losses
 
+    def _family(self, name, sp):
+        from horovod_tpu.models import LlamaConfig
+        from horovod_tpu.models.gpt import GPTConfig
+        from horovod_tpu.parallel.composite import (CompositeGPT,
+                                                    CompositeLlama)
+        if name == "llama":
+            cfg = LlamaConfig.tiny(
+                vocab_size=64, hidden_size=32, num_heads=4, num_kv_heads=2,
+                num_layers=2, intermediate_size=64,
+                max_position_embeddings=16, sp_axis=sp)
+            return CompositeLlama, cfg
+        cfg = GPTConfig.tiny(vocab_size=64, hidden_size=32, num_heads=4,
+                             num_layers=2, intermediate_size=64,
+                             max_position_embeddings=16, ep_axis=None,
+                             num_experts=0, sp_axis=sp)
+        return CompositeGPT, cfg
+
+    def _run_traj(self, comp, ids, schedule, steps=4):
+        p, o, specs = comp.init(jax.random.PRNGKey(0), ids)
+        step = comp.make_train_step(specs, donate=False, schedule=schedule)
+        losses = []
+        for _ in range(steps):
+            p, o, loss = step(p, o, ids)
+            losses.append(float(loss))
+        return losses
+
+    @pytest.mark.parametrize("family,schedule", [("llama", "gpipe"),
+                                                 ("llama", "1f1b"),
+                                                 ("gpt", "gpipe")])
+    def test_4d_sp_matches_3d_trajectory(self, hvd, rng, family, schedule):
+        """dp x pp x sp x tp: sequence-sharded composite training must
+        follow the SAME loss trajectory as the 3-D mesh on the same global
+        batch — params init identically (sp never enters init rngs), and
+        the sp-global masked token mean equals the 3-D shifted mean. A
+        merely-local attention bug (sp not wired into the blocks) shows up
+        as a diverging trajectory."""
+        from horovod_tpu.parallel.composite import (build_mesh3d,
+                                                    build_mesh4d)
+
+        ids = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
+        cls4, cfg4 = self._family(family, "sp")
+        cls3, cfg3 = self._family(family, None)
+        l4 = self._run_traj(
+            cls4(cfg4, build_mesh4d(dp=2, pp=2, sp=2, tp=1),
+                 optax.sgd(0.1), n_micro=2), ids, schedule)
+        l3 = self._run_traj(
+            cls3(cfg3, build_mesh3d(dp=4, pp=2, tp=1), optax.sgd(0.1),
+                 n_micro=2), ids, schedule)
+        np.testing.assert_allclose(l4, l3, rtol=1e-4, atol=1e-5)
+
+    @pytest.mark.parametrize("sp_cfg,mesh_sp", [(None, 2), ("sp", 1)])
+    def test_4d_degenerate_axes(self, hvd, rng, sp_cfg, mesh_sp):
+        """Config uniformity corners: an IDLE sp mesh axis with
+        config.sp_axis=None (labels must not ppermute over it), and a
+        bound size-1 sp axis (the loss psum must still clear the
+        sp-varying type)."""
+        from horovod_tpu.parallel.composite import build_mesh4d
+
+        cls, cfg = self._family("llama", sp_cfg)
+        mesh = build_mesh4d(dp=2, pp=2, sp=mesh_sp, tp=8 // (4 * mesh_sp))
+        losses = self._run_traj(cls(cfg, mesh, optax.sgd(0.1), n_micro=2),
+                                jnp.asarray(rng.integers(0, 64, (8, 16)),
+                                            jnp.int32), "gpipe", steps=2)
+        assert all(np.isfinite(losses)) and losses[1] < losses[0]
+
+    def test_sp_axis_requires_4d_mesh(self, hvd):
+        from horovod_tpu.models import LlamaConfig
+        from horovod_tpu.parallel.composite import (CompositeLlama,
+                                                    build_mesh3d)
+        import optax as _optax
+        cfg = LlamaConfig.tiny(sp_axis="sp")
+        with pytest.raises(NotImplementedError, match="build_mesh4d"):
+            CompositeLlama(cfg, build_mesh3d(dp=2, pp=2, tp=2),
+                           _optax.sgd(0.1))
+
     def test_1f1b_schedule_matches_gpipe(self, hvd, rng):
         """schedule='1f1b' (hand-scheduled recompute backward) must follow
         the same loss trajectory as the AD-differentiated GPipe schedule —
@@ -709,15 +784,20 @@ class TestLlamaParallel:
             x))
         np.testing.assert_allclose(y, ref, rtol=2e-3, atol=2e-4)
 
-    @pytest.mark.parametrize("impl", ["ring", "ulysses"])
-    def test_sp_logits_match_unsharded(self, hvd, rng, impl):
+    @pytest.mark.parametrize("impl,flash", [("ring", False),
+                                            ("ulysses", False),
+                                            ("ring", True)])
+    def test_sp_logits_match_unsharded(self, hvd, rng, impl, flash):
         """Token-sharded Llama (RoPE offsets derived from the sp shard
-        index inside each attention block) vs the unsharded model."""
+        index inside each attention block) vs the unsharded model — also
+        through the flash-ring composition (RoPE is position-absolute, so
+        pre-rotated keys stay correct as the ring moves them)."""
         from horovod_tpu.models import Llama, LlamaConfig
 
         kw = dict(tp_axis=None, num_heads=8, num_kv_heads=4, hidden_size=64,
-                  max_position_embeddings=64)
-        cfg_sp = LlamaConfig.tiny(sp_axis="hvd", sp_impl=impl, **kw)
+                  max_position_embeddings=64, num_layers=2 if flash else 4)
+        cfg_sp = LlamaConfig.tiny(sp_axis="hvd", sp_impl=impl,
+                                  use_flash=flash, **kw)
         cfg_local = LlamaConfig.tiny(**kw)
         ids = jnp.asarray(np.asarray(
             rng.integers(0, 256, (2, 64)), np.int32))
