@@ -148,6 +148,15 @@ class _CompositeLM:
                 f"{type(self).__name__} supports config.sp_axis only as "
                 f"{SP_AXIS!r} over a build_mesh4d mesh (got "
                 f"sp_axis={self.sp!r}, mesh axes {tuple(self.mesh.shape)})")
+        if self.sp is not None and getattr(c, "num_experts", 0):
+            # The shared MoE FFN routes/balances over LOCAL token shards
+            # only and its aux loss is per-shard — correct sp-aware expert
+            # dispatch needs a sequence-gathered router. Refuse loudly
+            # rather than surface an opaque trace-time VMA error.
+            raise NotImplementedError(
+                "sp_axis does not compose with MoE blocks yet "
+                "(num_experts > 0): the router and load-balance aux would "
+                "see only local token shards")
         self.pp = self.mesh.shape[PPL_AXIS]
         if c.num_layers % self.pp != 0:
             raise ValueError(

@@ -574,6 +574,19 @@ class TestCompositeLlama:
             CompositeLlama(cfg, build_mesh3d(dp=2, pp=2, tp=2),
                            _optax.sgd(0.1))
 
+    def test_sp_axis_refuses_moe(self, hvd):
+        """MoE routing sees only local token shards under sp — must fail
+        loudly at construction, not with a trace-time VMA error."""
+        from horovod_tpu.models.gpt import GPTConfig
+        from horovod_tpu.parallel.composite import (CompositeGPT,
+                                                    build_mesh4d)
+        import optax as _optax
+        cfg = GPTConfig.tiny(num_experts=2, sp_axis="sp", num_heads=4,
+                             hidden_size=32, intermediate_size=64)
+        with pytest.raises(NotImplementedError, match="MoE"):
+            CompositeGPT(cfg, build_mesh4d(dp=2, pp=2, sp=2, tp=1),
+                         _optax.sgd(0.1))
+
     def test_1f1b_schedule_matches_gpipe(self, hvd, rng):
         """schedule='1f1b' (hand-scheduled recompute backward) must follow
         the same loss trajectory as the AD-differentiated GPipe schedule —
