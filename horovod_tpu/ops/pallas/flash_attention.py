@@ -181,18 +181,31 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
 
 
 def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                q_offset=None, kv_valid=None):
-    """(BH, Lq, D) x (BH, Lk, D)^2 -> (o, lse).
+                q_offset=None, kv_valid=None, heads=None, kv_heads=None):
+    """(B*H, Lq, D) x (B*KV, Lk, D)^2 -> (o, lse).
 
     ``q_offset``/``kv_valid`` override the end-aligned causal offset and
     the number of VALID keys when the inputs were padded to block
-    multiples (positions are always in ORIGINAL coordinates)."""
+    multiples (positions are always in ORIGINAL coordinates).
+
+    Grouped-query attention: with ``kv_heads < heads`` the K/V tensors
+    carry only the grouped heads and the kernel streams each kv head's
+    chunks to its ``heads/kv_heads`` query heads via the BlockSpec index
+    map — no materialized broadcast, 1/g the K/V HBM traffic."""
     bh, lq, d = q.shape
     lk = k.shape[1]
     if q_offset is None:
         q_offset = lk - lq
     if kv_valid is None:
         kv_valid = lk
+    if heads is None or kv_heads is None or heads == kv_heads:
+        def kv_map(b, i, j):
+            return (b, j, 0)
+    else:
+        g = heads // kv_heads
+
+        def kv_map(b, i, j):
+            return ((b // heads) * kv_heads + (b % heads) // g, j, 0)
     masked = kv_valid < lk
     k_chunk = _pick_chunk(lk, block_k)
     n_kc = lk // k_chunk
@@ -210,8 +223,8 @@ def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-            pl.BlockSpec((1, k_chunk, d), lambda b, i, j: (b, j, 0)),
-            pl.BlockSpec((1, k_chunk, d), lambda b, i, j: (b, j, 0)),
+            pl.BlockSpec((1, k_chunk, d), kv_map),
+            pl.BlockSpec((1, k_chunk, d), kv_map),
         ],
         out_specs=[
             pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
@@ -229,18 +242,23 @@ def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
     return o, lse[..., 0]
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def _flash(q, k, v, causal, sm_scale, block_q, block_k, q_offset=None,
-           kv_valid=None):
+           kv_valid=None, heads=None, kv_heads=None):
+    """``heads``/``kv_heads`` (static) turn on grouped-query attention:
+    q carries B*heads rows, k/v only B*kv_heads. The forward streams the
+    NARROW k/v through the kernel (index-mapped, no broadcast); the
+    backward broadcasts once and group-sums dK/dV — forward/serving
+    bandwidth is where GQA pays."""
     o, _ = _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                       q_offset, kv_valid)
+                       q_offset, kv_valid, heads=heads, kv_heads=kv_heads)
     return o
 
 
 def _flash_fwd(q, k, v, causal, sm_scale, block_q, block_k, q_offset=None,
-               kv_valid=None):
+               kv_valid=None, heads=None, kv_heads=None):
     o, lse = _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
-                         q_offset, kv_valid)
+                         q_offset, kv_valid, heads=heads, kv_heads=kv_heads)
     return o, (q, k, v, o, lse)
 
 
@@ -489,13 +507,33 @@ def _jnp_block_bwd(q3, k3, v3, o3, lse, do3, causal, scale,
 
 
 def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, kv_valid,
-               res, do):
+               heads, kv_heads, res, do):
     q, k, v, o, lse = res
+    gqa = heads is not None and kv_heads is not None and heads != kv_heads
+    if gqa:
+        # Broadcast the narrow residual k/v once, run the MHA backward,
+        # then group-sum dK/dV back to the kv heads (the VJP of the
+        # implicit broadcast).
+        g = heads // kv_heads
+        b = q.shape[0] // heads
+        lk, d = k.shape[1], k.shape[2]
+        k = jnp.repeat(k.reshape(b, kv_heads, lk, d), g,
+                       axis=1).reshape(b * heads, lk, d)
+        v = jnp.repeat(v.reshape(b, kv_heads, lk, d), g,
+                       axis=1).reshape(b * heads, lk, d)
     if not _interpret():
-        return _fa_backward(q, k, v, o, lse, do, causal, sm_scale,
-                            block_q, block_k, q_offset, kv_valid)
-    return _jnp_block_bwd(q, k, v, o, lse, do, causal, sm_scale,
-                          q_offset=q_offset, kv_valid=kv_valid)
+        dq, dk, dv = _fa_backward(q, k, v, o, lse, do, causal, sm_scale,
+                                  block_q, block_k, q_offset, kv_valid)
+    else:
+        dq, dk, dv = _jnp_block_bwd(q, k, v, o, lse, do, causal, sm_scale,
+                                    q_offset=q_offset, kv_valid=kv_valid)
+    if gqa:
+        def narrow(t):
+            return t.reshape(b, kv_heads, g, lk, d).sum(axis=2).reshape(
+                b * kv_heads, lk, d).astype(t.dtype)
+
+        dk, dv = narrow(dk), narrow(dv)
+    return dq, dk, dv
 
 
 _flash.defvjp(_flash_fwd, _flash_bwd)
@@ -514,7 +552,10 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
     under the interpreter).
     """
     b, lq, h, d = q.shape
-    lk = k.shape[1]
+    lk, kv = k.shape[1], k.shape[2]
+    if kv != h and (kv == 0 or h % kv):
+        raise ValueError(
+            f"kv heads {kv} must divide query heads {h} (grouped-query)")
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
 
@@ -529,6 +570,9 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
         # local_attention scales by 1/sqrt(D); fold any custom scale into q.
         q_adj = q if sm_scale == 1.0 / (d ** 0.5) \
             else q * (sm_scale * d ** 0.5)
+        if kv != h:
+            k = jnp.repeat(k, h // kv, axis=2)
+            v = jnp.repeat(v, h // kv, axis=2)
         return local_attention(q_adj, k, v, causal=causal)
 
     # Pad only genuinely unaligned lengths (e.g. ViT's 196): aligned ones
@@ -538,7 +582,8 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
     lq_p, lk_p = lq + pad_q, lk + pad_k
 
     def to3(t, pad):
-        t3 = jnp.moveaxis(t, 2, 1).reshape(t.shape[0] * h, t.shape[1], d)
+        nh = t.shape[2]
+        t3 = jnp.moveaxis(t, 2, 1).reshape(t.shape[0] * nh, t.shape[1], d)
         if pad:
             t3 = jnp.pad(t3, ((0, 0), (0, pad), (0, 0)))
         return t3
@@ -546,7 +591,9 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
     def from3(t):
         return jnp.moveaxis(t[:, :lq].reshape(b, h, lq, d), 1, 2)
 
+    # kv != h: grouped-query — the kernels stream the NARROW k/v (1/g the
+    # HBM traffic); no broadcast is materialized on the forward path.
     out = _flash(to3(q, pad_q), to3(k, pad_k), to3(v, pad_k), causal,
                  sm_scale, _pick_block(lq_p), _pick_block(lk_p),
-                 lk - lq, lk)
+                 lk - lq, lk, h, kv)
     return from3(out)

@@ -248,18 +248,26 @@ class TPSelfAttention(nn.Module):
         out = jnp.einsum("bngqk,bknd->bqngd", probs, vals)
         return out.reshape(B, 1, h, d)
 
-    def _attend(self, q, k, v, mask, head_dim, bias=None):
-        """Route full-sequence attention (MHA shapes — kv already broadcast
-        to the query heads): sp ring/Ulysses, Pallas flash, or plain XLA.
-        ``bias``: additive (local_heads, Lq, Lk) scores bias (T5-style
-        relative positions) — plain path only. The guard mirrors the
-        dispatch below: flash with a mask falls back to the plain path,
-        where bias IS supported."""
+    def _attend(self, q, k, v, mask, bias=None):
+        """Route full-sequence attention: sp ring/Ulysses, Pallas flash,
+        or plain XLA. ``k``/``v`` may carry FEWER (grouped) heads than
+        ``q``: the flash kernels stream the narrow tensors natively (no
+        broadcast, 1/g the K/V HBM traffic); the other paths broadcast
+        here. ``bias``: additive (local_heads, Lq, Lk) scores bias
+        (T5-style relative positions) — plain path only. The guard mirrors
+        the dispatch below: flash with a mask falls back to the plain
+        path, where bias IS supported."""
         if bias is not None and (self.sp_axis is not None
                                  or (self.use_flash and mask is None)):
             raise ValueError(
                 "additive attention bias is supported on the plain XLA "
                 "path only (not flash/sp)")
+        g = q.shape[2] // k.shape[2]
+        if g > 1 and not (self.use_flash and mask is None
+                          and self.sp_axis is None):
+            # ring/Ulysses and the plain einsum expect MHA shapes.
+            k = jnp.repeat(k, g, axis=2)
+            v = jnp.repeat(v, g, axis=2)
         if self.sp_axis is not None:
             # Sequence parallelism: x carries this chip's token shard; the
             # QKV/out projections are token-local, the attention itself
@@ -345,16 +353,11 @@ class TPSelfAttention(nn.Module):
                 positions = off + jnp.arange(L, dtype=jnp.int32)
                 q = apply_rope(q, positions, self.rope_theta)
                 k = apply_rope(k, positions, self.rope_theta)
-            if local_kv != local_heads:
-                # Broadcast kv heads to the query groups: every attend path
-                # (flash / ring / Ulysses / plain einsum) then sees MHA
-                # shapes. RoPE is already applied, so the repeat is a pure
-                # broadcast XLA fuses into the downstream matmul. (Decode
-                # above instead contracts grouped q heads against the
-                # narrow cache.)
-                k = jnp.repeat(k, local_heads // local_kv, axis=2)
-                v = jnp.repeat(v, local_heads // local_kv, axis=2)
-            out = self._attend(q, k, v, mask, head_dim, bias=bias)
+            # Grouped kv heads stay NARROW here: _attend broadcasts them
+            # for the paths that need MHA shapes and streams them natively
+            # through the flash kernels. (Decode above instead contracts
+            # grouped q heads against the narrow cache.)
+            out = self._attend(q, k, v, mask, bias=bias)
         out = out.reshape(out.shape[:-2] + (local_heads * head_dim,))
         return RowParallelDense(self.hidden_size, dtype=self.dtype,
                                 use_bias=self.use_bias,

@@ -164,6 +164,46 @@ class TestFlashAttention:
                 np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-5,
                 err_msg=f"d{nm} mismatch (causal={causal})")
 
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("L", [128, 100])
+    def test_gqa_narrow_kv_matches_repeat(self, rng, causal, L):
+        """Grouped-query attention: narrow k/v streamed through the
+        index-mapped kernels (and padded-length masking) must equal the
+        repeat-then-MHA result — forward AND all gradients, with dK/dV
+        group-summed back to the kv heads."""
+        from horovod_tpu.ops.pallas import flash_attention
+        B, H, KV, D = 2, 8, 2, 32
+        q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.float32)
+        k = jnp.asarray(rng.standard_normal((B, L, KV, D)), jnp.float32)
+        v = jnp.asarray(rng.standard_normal((B, L, KV, D)), jnp.float32)
+        out = flash_attention(q, k, v, causal=causal)
+        ref = flash_attention(q, jnp.repeat(k, H // KV, 2),
+                              jnp.repeat(v, H // KV, 2), causal=causal)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                                   rtol=2e-4, atol=2e-5)
+
+        def loss_narrow(q, k, v):
+            return jnp.sum(flash_attention(q, k, v, causal=causal) ** 2)
+
+        def loss_wide(q, k, v):
+            return jnp.sum(flash_attention(
+                q, jnp.repeat(k, H // KV, 2), jnp.repeat(v, H // KV, 2),
+                causal=causal) ** 2)
+
+        gn = jax.grad(loss_narrow, argnums=(0, 1, 2))(q, k, v)
+        gw = jax.grad(loss_wide, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(gn, gw):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-3, atol=2e-4)
+        assert gn[1].shape == (B, L, KV, D)
+
+    def test_gqa_indivisible_heads_raises(self, rng):
+        from horovod_tpu.ops.pallas import flash_attention
+        q = jnp.zeros((1, 128, 4, 32))
+        k = jnp.zeros((1, 128, 3, 32))
+        with pytest.raises(ValueError, match="divide"):
+            flash_attention(q, k, k)
+
     def test_tp_attention_flash_flag(self, hvd, rng):
         """TPSelfAttention(use_flash=True) == use_flash=False (same params)."""
         from horovod_tpu.parallel.tp import TPSelfAttention
