@@ -157,7 +157,7 @@ class TestFlashAttention:
         sm = 1.0 / D ** 0.5
         o, lse = fa._fa_forward(q, k, v, causal, sm, bq, bk)
         got = fa._fa_backward(q, k, v, o, lse, do, causal, sm, bq, bk)
-        want = fa._flash_bwd(causal, sm, bq, bk, None, None,
+        want = fa._flash_bwd(causal, sm, bq, bk, None, None, None, None,
                              (q, k, v, o, lse), do)
         for a, b, nm in zip(got, want, "q k v".split()):
             np.testing.assert_allclose(
