@@ -51,5 +51,24 @@ HVD_BENCH_MODEL=llama HVD_BENCH_ITERS=10 python bench.py
 # 6b. T5-small encoder-decoder bench (rel-pos biases + cross-attention)
 HVD_BENCH_MODEL=t5 HVD_BENCH_ITERS=10 python bench.py
 
+# 6c. GQA-native flash kernels: narrow-KV index maps must lower through
+# Mosaic and match the repeat path on-chip (CPU interpret already passes)
+python - <<'PY'
+import jax, jax.numpy as jnp, numpy as np
+from horovod_tpu.ops.pallas import flash_attention
+rng = np.random.default_rng(0)
+B, L, H, KV, D = 2, 1024, 8, 2, 64
+q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B, L, KV, D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B, L, KV, D)), jnp.bfloat16)
+f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+out = np.asarray(f(q, k, v), np.float32)
+ref = np.asarray(f(q, jnp.repeat(k, H // KV, 2), jnp.repeat(v, H // KV, 2)),
+                 np.float32)
+err = np.abs(out - ref).max()
+print("gqa flash on-chip max err vs repeat:", err)
+assert err < 2e-2
+PY
+
 # 7. ResNet-50 tracked config re-baseline
 HVD_BENCH_ITERS=20 python bench.py
