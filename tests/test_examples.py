@@ -101,3 +101,9 @@ class TestExamples:
                    "--steps", "4", "--batch-size", "4")
         assert "final loss" in out
         assert "moments/chip" in out
+
+    def test_flax_pipeline(self):
+        for sched in ("gpipe", "1f1b"):
+            out = _run("flax/flax_pipeline.py", "--schedule", sched,
+                       "--steps", "6")
+            assert "final loss" in out and f"schedule={sched}" in out
