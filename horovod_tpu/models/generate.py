@@ -21,6 +21,18 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def init_decode_cache(decoder, *args, **kwargs):
+    """Zeroed KV-cache tree for a decode-mode model, STRUCTURE via
+    eval_shape of ``decoder.init(rng, *args, **kwargs)`` — no throwaway
+    params, no compute. init() itself would also MUTATE the cache it
+    returns (cursor advanced past the traced forward plus a garbage K/V
+    row), so callers always start from zeros."""
+    shapes = jax.eval_shape(
+        lambda: decoder.init(jax.random.PRNGKey(0), *args,
+                             **kwargs)["cache"])
+    return jax.tree.map(lambda t: jnp.zeros(t.shape, t.dtype), shapes)
+
+
 def _filter_logits(logits, top_k, top_p):
     """Top-k / nucleus filtering on (B, V) logits (static k/p; no-ops at
     k=0 / p=1). Masked entries get a large-negative so categorical never
@@ -178,13 +190,7 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
                 f"max_len {max_len} exceeds the cache capacity "
                 f"(max_position_embeddings={cap})")
         decoder = _dc.replace(model, decode=True)
-        # Cache STRUCTURE via eval_shape (no throwaway params, no compute),
-        # then zeros. init() itself would also MUTATE the cache it returns
-        # (idx=1 and a garbage K/V row from its traced forward).
-        shapes = jax.eval_shape(
-            lambda: decoder.init(jax.random.PRNGKey(0), prompt[:, :1],
-                                 pos=0)["cache"])
-        cache = jax.tree.map(lambda t: jnp.zeros(t.shape, t.dtype), shapes)
+        cache = init_decode_cache(decoder, prompt[:, :1], pos=0)
         return _generate_cached(decoder, (params, cache), prompt,
                                 int(max_len), float(temperature), rng,
                                 int(top_k), float(top_p))

@@ -39,6 +39,7 @@ class T5Config:
     intermediate_size: int = 1024
     num_buckets: int = 32
     max_distance: int = 128
+    max_decode_len: int = 512       # KV-cache capacity for cached decoding
     rms_eps: float = 1e-6
     dtype: Any = jnp.float32
     tp_axis: Optional[str] = "tp"
@@ -47,9 +48,26 @@ class T5Config:
     def tiny(**kw):
         base = dict(vocab_size=256, hidden_size=64, num_layers=2,
                     num_heads=4, intermediate_size=128, num_buckets=8,
-                    max_distance=16)
+                    max_distance=16, max_decode_len=32)
         base.update(kw)
         return T5Config(**base)
+
+
+def relative_position_buckets_causal_jnp(query_pos, key_positions,
+                                         num_buckets, max_distance):
+    """Traced causal bucketing for ONE query position against a vector of
+    key positions (the decode path: ``query_pos`` is the cache cursor).
+    Matches :func:`relative_position_buckets`'s bidirectional=False
+    branch; future keys (key > query) land in bucket 0 — they are masked
+    by the cache-validity check anyway."""
+    rel = jnp.maximum(query_pos - key_positions, 0)        # distance back
+    max_exact = num_buckets // 2
+    large = max_exact + (
+        jnp.log(jnp.maximum(rel, 1).astype(jnp.float32) / max_exact)
+        / np.log(max_distance / max_exact) * (num_buckets - max_exact)
+    ).astype(jnp.int32)
+    large = jnp.minimum(large, num_buckets - 1)
+    return jnp.where(rel < max_exact, rel, large)
 
 
 def relative_position_buckets(query_len, key_len, num_buckets, max_distance,
@@ -88,16 +106,13 @@ class T5RelativeBias(nn.Module):
     config: T5Config
     bidirectional: bool
 
-    @nn.compact
-    def __call__(self, query_len, key_len):
+    def setup(self):
         c = self.config
-        table = self.param("rel_bias", nn.initializers.normal(0.1),
-                           (c.num_buckets, c.num_heads), jnp.float32)
-        buckets = relative_position_buckets(
-            query_len, key_len, c.num_buckets, c.max_distance,
-            self.bidirectional)
-        bias = jnp.asarray(table, c.dtype)[jnp.asarray(buckets)]
-        bias = jnp.transpose(bias, (2, 0, 1))          # (heads, Lq, Lk)
+        self.table = self.param("rel_bias", nn.initializers.normal(0.1),
+                                (c.num_buckets, c.num_heads), jnp.float32)
+
+    def _local_heads(self, bias):
+        c = self.config
         n = axis_size_or_1(c.tp_axis)
         if n > 1:
             local = c.num_heads // n
@@ -105,20 +120,43 @@ class T5RelativeBias(nn.Module):
                 bias, lax.axis_index(c.tp_axis) * local, local, axis=0)
         return bias
 
+    def __call__(self, query_len, key_len):
+        c = self.config
+        buckets = relative_position_buckets(
+            query_len, key_len, c.num_buckets, c.max_distance,
+            self.bidirectional)
+        bias = jnp.asarray(self.table, c.dtype)[jnp.asarray(buckets)]
+        return self._local_heads(
+            jnp.transpose(bias, (2, 0, 1)))            # (heads, Lq, Lk)
+
+    def decode_bias(self, pos, cache_len):
+        """Bias row for ONE query at traced position ``pos`` against cache
+        slots 0..cache_len-1 (causal stacks only): (local_heads, 1, L)."""
+        c = self.config
+        buckets = relative_position_buckets_causal_jnp(
+            pos, jnp.arange(cache_len), c.num_buckets, c.max_distance)
+        bias = jnp.asarray(self.table, c.dtype)[buckets]   # (L, heads)
+        return self._local_heads(jnp.transpose(bias)[:, None, :])
+
 
 class T5Block(nn.Module):
     """Pre-RMSNorm block: self-attention (+ relative bias), optional
-    cross-attention (decoder), GEGLU MLP; bias-free."""
+    cross-attention (decoder), GEGLU MLP; bias-free. ``decode`` turns the
+    self-attention into KV-cache single-token mode (``bias`` is then this
+    step's relative-position row over the cache); cross-attention stays
+    per-step full-memory — O(Ls d^2), not the O(L^2 d) the cache kills."""
     config: T5Config
     causal: bool
     cross: bool
+    decode: bool = False
 
     @nn.compact
     def __call__(self, x, bias, memory=None, memory_mask=None, mask=None):
         c = self.config
         a = TPSelfAttention(
             c.num_heads, c.hidden_size, dtype=c.dtype, axis_name=c.tp_axis,
-            causal=self.causal, use_bias=False, name="attention")(
+            causal=self.causal, use_bias=False, decode=self.decode,
+            cache_len=c.max_decode_len, name="attention")(
                 nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,
                            name="ln_attn")(x), mask, bias)
         x = x + a
@@ -160,20 +198,31 @@ class T5Encoder(nn.Module):
 
 
 class T5Decoder(nn.Module):
-    """Decoder stack (see :class:`T5Encoder` for ``embed`` sharing)."""
+    """Decoder stack (see :class:`T5Encoder` for ``embed`` sharing).
+    ``decode=True`` feeds ONE token per call at traced position ``pos``
+    through the per-layer KV caches."""
     config: T5Config
     embed: Optional[nn.Module] = None
+    decode: bool = False
 
     @nn.compact
-    def __call__(self, input_ids, memory, memory_mask=None):
+    def __call__(self, input_ids, memory, memory_mask=None, pos=None):
         c = self.config
+        if self.decode and pos is None:
+            raise ValueError("decode mode requires pos (the token's "
+                             "position)")
         emb = self.embed if self.embed is not None else nn.Embed(
             c.vocab_size, c.hidden_size, dtype=c.dtype, name="tok_emb")
         x = emb(input_ids)
-        L = input_ids.shape[1]
-        bias = T5RelativeBias(c, bidirectional=False, name="rel_bias")(L, L)
+        rel = T5RelativeBias(c, bidirectional=False, name="rel_bias")
+        if self.decode:
+            bias = rel.decode_bias(pos, c.max_decode_len)
+        else:
+            L = input_ids.shape[1]
+            bias = rel(L, L)
         for i in range(c.num_layers):
-            x = T5Block(c, causal=True, cross=True, name=f"layer_{i}")(
+            x = T5Block(c, causal=True, cross=True, decode=self.decode,
+                        name=f"layer_{i}")(
                 x, bias, memory=memory, memory_mask=memory_mask)
         x = nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype, name="ln_f")(x)
         return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
@@ -189,18 +238,21 @@ class T5(nn.Module):
     untied, per T5 1.1): its params live under ``shared`` in the tree.
     """
     config: T5Config
+    decode_mode: bool = False   # KV-cache single-token decoding
 
     def setup(self):
         c = self.config
         self.shared = nn.Embed(c.vocab_size, c.hidden_size, dtype=c.dtype)
         self.encoder = T5Encoder(c, embed=self.shared)
-        self.decoder = T5Decoder(c, embed=self.shared)
+        self.decoder = T5Decoder(c, embed=self.shared,
+                                 decode=self.decode_mode)
 
     def encode(self, src_ids, src_mask=None):
         return self.encoder(src_ids, src_mask)
 
-    def decode(self, tgt_ids, memory, memory_mask=None):
-        return self.decoder(tgt_ids, memory, memory_mask=memory_mask)
+    def decode(self, tgt_ids, memory, memory_mask=None, pos=None):
+        return self.decoder(tgt_ids, memory, memory_mask=memory_mask,
+                            pos=pos)
 
     def __call__(self, src_ids, tgt_ids, src_mask=None):
         return self.decode(tgt_ids, self.encode(src_ids, src_mask),
@@ -228,11 +280,58 @@ def _t5_greedy(model, params, src_ids, max_len, bos_id, src_mask):
     return buf
 
 
+@functools.partial(jax.jit, static_argnums=(0, 3, 4))
+def _t5_greedy_cached(decoder_model, state, src_ids, max_len, bos_id,
+                      src_mask):
+    """KV-cache greedy decode: encoder once, then ONE token per step
+    through the decoder's per-layer self-attention caches (O(1) projection
+    work; cross-attention recomputes against the static memory)."""
+    params, cache = state
+    memory = decoder_model.apply({"params": params}, src_ids, src_mask,
+                                 method=T5.encode)
+    B = src_ids.shape[0]
+    buf = jnp.full((B, max_len), bos_id, jnp.int32)
+
+    def step(carry, t):
+        buf, cache = carry
+        tok = lax.dynamic_slice_in_dim(buf, t - 1, 1, axis=1)
+        logits, upd = decoder_model.apply(
+            {"params": params, "cache": cache}, tok, memory,
+            memory_mask=src_mask, pos=t - 1, method=T5.decode,
+            mutable=["cache"])
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t))
+        return (buf, upd["cache"]), None
+
+    (buf, _), _ = lax.scan(step, (buf, cache), jnp.arange(1, max_len))
+    return buf
+
+
 def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
-                     src_mask=None):
-    """Greedy seq2seq decoding as one compiled program: encoder runs once,
-    the decoder re-forwards a fixed-length buffer per step (causal
-    structure ignores the not-yet-written tail). Returns (B, max_len)
-    int32 starting with ``bos_id``."""
-    return _t5_greedy(model, params, jnp.asarray(src_ids, jnp.int32),
-                      int(max_len), int(bos_id), src_mask)
+                     src_mask=None, use_cache=False):
+    """Greedy seq2seq decoding as one compiled program. Default: encoder
+    once, decoder re-forwards a fixed-length buffer per step (causal
+    structure ignores the not-yet-written tail). ``use_cache=True``
+    decodes one token per step through per-layer self-attention KV caches
+    instead (``max_len`` bounded by ``config.max_decode_len``), with
+    identical outputs: the O(L^2) self-attention blowup is gone;
+    cross-attention still projects K/V from the static encoder memory
+    each step (O(Ls d^2) per layer — see :class:`T5Block`). Returns
+    (B, max_len) int32 starting with ``bos_id``."""
+    src_ids = jnp.asarray(src_ids, jnp.int32)
+    if not use_cache:
+        return _t5_greedy(model, params, src_ids, int(max_len), int(bos_id),
+                          src_mask)
+    if max_len > model.config.max_decode_len:
+        raise ValueError(
+            f"max_len {max_len} exceeds the decode cache capacity "
+            f"(max_decode_len={model.config.max_decode_len})")
+    from horovod_tpu.models.generate import init_decode_cache
+    decoder = dataclasses.replace(model, decode_mode=True)
+    cache = init_decode_cache(
+        decoder, jnp.zeros((src_ids.shape[0], 1), jnp.int32),
+        jnp.zeros((src_ids.shape[0], src_ids.shape[1],
+                   model.config.hidden_size), model.config.dtype),
+        pos=0, method=T5.decode)
+    return _t5_greedy_cached(decoder, (params, cache), src_ids,
+                             int(max_len), int(bos_id), src_mask)

@@ -211,13 +211,15 @@ class TPSelfAttention(nn.Module):
     rope_theta: Optional[float] = None   # None -> no rotary embedding
     use_bias: bool = True
 
-    def _decode_attend(self, q, k, v):
+    def _decode_attend(self, q, k, v, bias=None):
         """Single-token decode against the KV cache (O(1) projections per
         step, attention against the filled prefix). q: (B, 1, h, d),
         k/v: (B, 1, kv, d) — the cache stores only the kv heads, the GQA
-        serving win. Cache variables are created on the first call (B and
-        capacity fix the shapes; flax initializes them lazily under
-        mutable=['cache'])."""
+        serving win. ``bias``: (local_heads, 1, cache_len) additive scores
+        bias for THIS step's query position (T5 relative positions,
+        computed by the caller from the cache cursor). Cache variables are
+        created on the first call (B and capacity fix the shapes; flax
+        initializes them lazily under mutable=['cache'])."""
         B, _, h, d = q.shape
         kv = k.shape[2]
         L = self.cache_len
@@ -240,6 +242,9 @@ class TPSelfAttention(nn.Module):
         g = h // kv
         qg = q.reshape(B, 1, kv, g, d)
         scores = jnp.einsum("bqngd,bknd->bngqk", qg, keys) / np.sqrt(d)
+        if bias is not None:
+            scores = scores + bias.reshape(kv, g, 1, L)[None].astype(
+                scores.dtype)
         # positions beyond the filled prefix are invalid
         valid = jnp.arange(L) <= idx                  # (L,)
         scores = jnp.where(valid[None, None, None, None, :], scores,
@@ -328,18 +333,18 @@ class TPSelfAttention(nn.Module):
 
         q, k, v = heads(q), heads(k), heads(v)
         if self.decode:
-            if self.sp_axis is not None or mask is not None or \
-                    bias is not None:
+            if self.sp_axis is not None or mask is not None:
                 raise ValueError(
-                    "decode mode supports neither sp_axis, masks, nor "
-                    "attention biases")
+                    "decode mode supports neither sp_axis nor masks")
             if x.shape[1] != 1:
                 raise ValueError(
                     f"decode mode feeds ONE token per call, got "
                     f"{x.shape[1]}")
             if self.cache_len < 1:
                 raise ValueError("decode=True requires cache_len >= 1")
-            out = self._decode_attend(q, k, v)   # RoPE + grouped KV inside
+            # RoPE + grouped KV handled inside; bias is this step's
+            # relative-position row over the cache
+            out = self._decode_attend(q, k, v, bias=bias)
         else:
             if self.rope_theta is not None:
                 # Global token positions: under sequence parallelism x holds
