@@ -143,16 +143,26 @@ class T5Block(nn.Module):
     """Pre-RMSNorm block: self-attention (+ relative bias), optional
     cross-attention (decoder), GEGLU MLP; bias-free. ``decode`` turns the
     self-attention into KV-cache single-token mode (``bias`` is then this
-    step's relative-position row over the cache); cross-attention stays
-    per-step full-memory — O(Ls d^2), not the O(L^2 d) the cache kills."""
+    step's relative-position row over the cache); pass ``cross_kv`` (from
+    a one-time ``project_kv_only`` pass over the static encoder memory)
+    so decode steps skip the cross K/V projection too."""
     config: T5Config
     causal: bool
     cross: bool
     decode: bool = False
 
-    @nn.compact
-    def __call__(self, x, bias, memory=None, memory_mask=None, mask=None):
+    def _cross_module(self):
         c = self.config
+        return TPCrossAttention(c.num_heads, c.hidden_size, dtype=c.dtype,
+                                axis_name=c.tp_axis, use_bias=False,
+                                name="cross")
+
+    @nn.compact
+    def __call__(self, x, bias, memory=None, memory_mask=None, mask=None,
+                 cross_kv=None, project_kv_only=False):
+        c = self.config
+        if project_kv_only:
+            return self._cross_module()(None, memory, project_only=True)
         a = TPSelfAttention(
             c.num_heads, c.hidden_size, dtype=c.dtype, axis_name=c.tp_axis,
             causal=self.causal, use_bias=False, decode=self.decode,
@@ -161,11 +171,10 @@ class T5Block(nn.Module):
                            name="ln_attn")(x), mask, bias)
         x = x + a
         if self.cross:
-            a = TPCrossAttention(
-                c.num_heads, c.hidden_size, dtype=c.dtype,
-                axis_name=c.tp_axis, use_bias=False, name="cross")(
-                    nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,
-                               name="ln_cross")(x), memory, memory_mask)
+            a = self._cross_module()(
+                nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,
+                           name="ln_cross")(x), memory, memory_mask,
+                cached_kv=cross_kv)
             x = x + a
         h = TPSwiGLUMlp(c.intermediate_size, c.hidden_size, dtype=c.dtype,
                         axis_name=c.tp_axis, activation="gelu",
@@ -206,8 +215,21 @@ class T5Decoder(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, input_ids, memory, memory_mask=None, pos=None):
+    def __call__(self, input_ids, memory, memory_mask=None, pos=None,
+                 cross_kv=None, project_kv_only=False):
         c = self.config
+
+        def block(i):
+            return T5Block(c, causal=True, cross=True, decode=self.decode,
+                           name=f"layer_{i}")
+
+        if project_kv_only:
+            # One fused K/V projection of the static memory per layer —
+            # the decode loop primes these once and feeds them back via
+            # ``cross_kv``.
+            return tuple(block(i)(None, None, memory=memory,
+                                  project_kv_only=True)
+                         for i in range(c.num_layers))
         if self.decode and pos is None:
             raise ValueError("decode mode requires pos (the token's "
                              "position)")
@@ -221,9 +243,8 @@ class T5Decoder(nn.Module):
             L = input_ids.shape[1]
             bias = rel(L, L)
         for i in range(c.num_layers):
-            x = T5Block(c, causal=True, cross=True, decode=self.decode,
-                        name=f"layer_{i}")(
-                x, bias, memory=memory, memory_mask=memory_mask)
+            x = block(i)(x, bias, memory=memory, memory_mask=memory_mask,
+                         cross_kv=None if cross_kv is None else cross_kv[i])
         x = nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype, name="ln_f")(x)
         return nn.Dense(c.vocab_size, use_bias=False, dtype=jnp.float32,
                         name="lm_head")(x)
@@ -250,9 +271,15 @@ class T5(nn.Module):
     def encode(self, src_ids, src_mask=None):
         return self.encoder(src_ids, src_mask)
 
-    def decode(self, tgt_ids, memory, memory_mask=None, pos=None):
+    def decode(self, tgt_ids, memory, memory_mask=None, pos=None,
+               cross_kv=None):
         return self.decoder(tgt_ids, memory, memory_mask=memory_mask,
-                            pos=pos)
+                            pos=pos, cross_kv=cross_kv)
+
+    def project_cross_kv(self, memory):
+        """Per-layer fused cross-attention K/V of the (static) encoder
+        memory — prime once, pass to :meth:`decode` as ``cross_kv``."""
+        return self.decoder(None, memory, project_kv_only=True)
 
     def __call__(self, src_ids, tgt_ids, src_mask=None):
         return self.decode(tgt_ids, self.encode(src_ids, src_mask),
@@ -289,6 +316,10 @@ def _t5_greedy_cached(decoder_model, state, src_ids, max_len, bos_id,
     params, cache = state
     memory = decoder_model.apply({"params": params}, src_ids, src_mask,
                                  method=T5.encode)
+    # Prime the per-layer cross-attention K/V ONCE — the memory is static,
+    # so each decode step skips its projection entirely.
+    cross_kv = decoder_model.apply({"params": params}, memory,
+                                   method=T5.project_cross_kv)
     B = src_ids.shape[0]
     buf = jnp.full((B, max_len), bos_id, jnp.int32)
 
@@ -297,8 +328,8 @@ def _t5_greedy_cached(decoder_model, state, src_ids, max_len, bos_id,
         tok = lax.dynamic_slice_in_dim(buf, t - 1, 1, axis=1)
         logits, upd = decoder_model.apply(
             {"params": params, "cache": cache}, tok, memory,
-            memory_mask=src_mask, pos=t - 1, method=T5.decode,
-            mutable=["cache"])
+            memory_mask=src_mask, pos=t - 1, cross_kv=cross_kv,
+            method=T5.decode, mutable=["cache"])
         nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t))
         return (buf, upd["cache"]), None
@@ -314,10 +345,11 @@ def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
     structure ignores the not-yet-written tail). ``use_cache=True``
     decodes one token per step through per-layer self-attention KV caches
     instead (``max_len`` bounded by ``config.max_decode_len``), with
-    identical outputs: the O(L^2) self-attention blowup is gone;
-    cross-attention still projects K/V from the static encoder memory
-    each step (O(Ls d^2) per layer — see :class:`T5Block`). Returns
-    (B, max_len) int32 starting with ``bos_id``."""
+    identical outputs: the O(L^2) self-attention blowup is gone AND the
+    cross-attention K/V are projected from the static encoder memory
+    exactly once (primed, then fed back per step) — O(1) projection work
+    per generated token. Returns (B, max_len) int32 starting with
+    ``bos_id``."""
     src_ids = jnp.asarray(src_ids, jnp.int32)
     if not use_cache:
         return _t5_greedy(model, params, src_ids, int(max_len), int(bos_id),

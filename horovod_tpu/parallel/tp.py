@@ -430,21 +430,31 @@ class TPCrossAttention(nn.Module):
     axis_name: Optional[str] = TP_AXIS
     use_bias: bool = True
 
+    def _kv_proj(self):
+        return ColumnParallelDense(2 * self.hidden_size, dtype=self.dtype,
+                                   use_bias=self.use_bias,
+                                   axis_name=self.axis_name, name="kv")
+
     @nn.compact
-    def __call__(self, x, memory, memory_mask=None):
+    def __call__(self, x, memory, memory_mask=None, cached_kv=None,
+                 project_only=False):
+        """``project_only=True`` returns the fused K/V projection of
+        ``memory`` (x ignored) — decode loops call it ONCE and feed the
+        result back per step as ``cached_kv``, skipping the per-step
+        O(Ls d^2) projection of a static encoder memory."""
         n = axis_size_or_1(self.axis_name)
         if self.num_heads % n != 0:
             raise ValueError(
                 f"num_heads {self.num_heads} not divisible by tp={n}")
         local_heads = self.num_heads // n
         head_dim = self.hidden_size // self.num_heads
+        if project_only:
+            return self._kv_proj()(memory)
 
         q = ColumnParallelDense(self.hidden_size, dtype=self.dtype,
                                 use_bias=self.use_bias,
                                 axis_name=self.axis_name, name="q")(x)
-        kv = ColumnParallelDense(2 * self.hidden_size, dtype=self.dtype,
-                                 use_bias=self.use_bias,
-                                 axis_name=self.axis_name, name="kv")(memory)
+        kv = cached_kv if cached_kv is not None else self._kv_proj()(memory)
         k, v = jnp.split(kv, 2, axis=-1)
 
         def heads(t):
