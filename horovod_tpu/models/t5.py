@@ -311,8 +311,9 @@ def _t5_greedy(model, params, src_ids, max_len, bos_id, src_mask):
 def _t5_greedy_cached(decoder_model, state, src_ids, max_len, bos_id,
                       src_mask):
     """KV-cache greedy decode: encoder once, then ONE token per step
-    through the decoder's per-layer self-attention caches (O(1) projection
-    work; cross-attention recomputes against the static memory)."""
+    through the decoder's per-layer self-attention caches, with the
+    cross-attention K/V primed from the static memory exactly once —
+    O(1) projection work per generated token."""
     params, cache = state
     memory = decoder_model.apply({"params": params}, src_ids, src_mask,
                                  method=T5.encode)

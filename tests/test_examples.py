@@ -107,3 +107,8 @@ class TestExamples:
             out = _run("flax/flax_pipeline.py", "--schedule", sched,
                        "--steps", "6")
             assert "final loss" in out and f"schedule={sched}" in out
+
+    def test_flax_t5(self):
+        out = _run("flax/flax_t5.py", "--steps", "120", "--use-cache")
+        assert "decode copy accuracy: 100%" in out
+        assert "copied the source back" in out
