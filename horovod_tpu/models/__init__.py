@@ -9,4 +9,4 @@ from horovod_tpu.models.inception import InceptionV3  # noqa: F401
 from horovod_tpu.models.vit import ViT, ViTConfig  # noqa: F401
 from horovod_tpu.models.llama import Llama, LlamaBlock, LlamaConfig  # noqa: F401
 from horovod_tpu.models.t5 import T5, T5Config, t5_greedy_decode  # noqa: F401
-from horovod_tpu.models.generate import generate  # noqa: F401
+from horovod_tpu.models.generate import beam_search, generate  # noqa: F401

@@ -137,6 +137,58 @@ def _generate(model, params, prompt, max_len, temperature, rng,
     return buf
 
 
+@functools.partial(jax.jit, static_argnums=(0, 3, 4))
+def _beam_search(model, params, prompt, max_len, num_beams):
+    B, P = prompt.shape
+    k = num_beams
+    bufs = jnp.zeros((B, k, max_len), jnp.int32)
+    bufs = lax.dynamic_update_slice(
+        bufs, jnp.broadcast_to(prompt[:, None], (B, k, P)), (0, 0, 0))
+    # All beams start identical: only beam 0 may seed the first expansion,
+    # or the top-k would fill with k copies of the same hypothesis.
+    scores = jnp.where(jnp.arange(k) == 0, 0.0, -jnp.inf)
+    scores = jnp.broadcast_to(scores[None], (B, k)).astype(jnp.float32)
+
+    def step(carry, t):
+        bufs, scores = carry
+        logits = model.apply({"params": params},
+                             bufs.reshape(B * k, max_len))
+        logp = jax.nn.log_softmax(
+            logits[:, t - 1].astype(jnp.float32)).reshape(B, k, -1)
+        V = logp.shape[-1]
+        cand = (scores[:, :, None] + logp).reshape(B, k * V)
+        scores, idx = lax.top_k(cand, k)                    # (B, k)
+        beam, tok = idx // V, (idx % V).astype(jnp.int32)
+        bufs = jnp.take_along_axis(bufs, beam[:, :, None], axis=1)
+        bufs = lax.dynamic_update_slice(bufs, tok[:, :, None], (0, 0, t))
+        return (bufs, scores), None
+
+    (bufs, scores), _ = lax.scan(step, (bufs, scores),
+                                 jnp.arange(P, max_len))
+    best = jnp.argmax(scores, axis=1)
+    return (jnp.take_along_axis(bufs, best[:, None, None], axis=1)[:, 0],
+            jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0])
+
+
+def beam_search(model, params, prompt, max_len, num_beams=4):
+    """Beam-search decoding for the causal LMs: ONE compiled program, k
+    hypotheses re-forwarded per step through the same fixed-length-buffer
+    scheme as greedy :func:`generate`. Returns ``(sequences, scores)``:
+    (B, max_len) int32 best hypotheses and their summed token log-probs.
+    ``num_beams=1`` reproduces greedy decoding exactly. (All hypotheses
+    decode to the same fixed length — there is no EOS handling — so a
+    length penalty would not change the ranking and none is offered.)
+    """
+    B, P = prompt.shape
+    if not 1 <= P < max_len:
+        raise ValueError(
+            f"prompt length {P} must be in [1, max_len={max_len})")
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    return _beam_search(model, params, jnp.asarray(prompt, jnp.int32),
+                        int(max_len), int(num_beams))
+
+
 def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
              use_cache=False, top_k=0, top_p=1.0):
     """Generate up to ``max_len`` total tokens from ``prompt``.

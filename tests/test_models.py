@@ -283,6 +283,52 @@ class TestGenerate:
         with pytest.raises(ValueError, match="cache capacity"):
             generate(model, params, prompt, 16, use_cache=True)
 
+    @pytest.mark.parametrize("family", ["gpt", "llama"])
+    def test_beam_search_properties(self, hvd, rng, family):
+        """num_beams=1 must equal greedy exactly; returned scores must be
+        the TRUE summed token log-probs of the returned sequences (checked
+        by independent re-scoring); invalid args fail loudly. (Wider beams
+        are NOT asserted >= greedy — beam search is not monotone in beam
+        width.)"""
+        from horovod_tpu.models import (GPT, GPTConfig, Llama, LlamaConfig,
+                                        beam_search, generate)
+        if family == "gpt":
+            model = GPT(GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                                       num_layers=2,
+                                       max_position_embeddings=10))
+        else:
+            model = Llama(LlamaConfig.tiny(tp_axis=None, num_layers=2,
+                                           max_position_embeddings=10))
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (2, 3)), np.int32))
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        greedy = np.asarray(generate(model, params, prompt, max_len=10))
+        b1, s1 = beam_search(model, params, prompt, max_len=10,
+                             num_beams=1)
+        np.testing.assert_array_equal(np.asarray(b1), greedy)
+        b4, s4 = beam_search(model, params, prompt, max_len=10,
+                             num_beams=4)
+        assert b4.shape == (2, 10)
+        # prompts carry through unchanged
+        np.testing.assert_array_equal(np.asarray(b4[:, :3]),
+                                      np.asarray(prompt))
+        # independent re-score: sum log P(tok_t | prefix) over generated
+        # positions must equal the reported beam score
+        for seqs, scores in ((b1, s1), (b4, s4)):
+            logits = model.apply({"params": params}, seqs)
+            logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+            tok_lp = jnp.take_along_axis(
+                logp[:, :-1], seqs[:, 1:, None].astype(jnp.int32),
+                axis=-1)[..., 0]
+            rescored = tok_lp[:, 2:].sum(axis=1)    # generated tokens only
+            np.testing.assert_allclose(np.asarray(rescored),
+                                       np.asarray(scores), rtol=1e-4,
+                                       atol=1e-4)
+        with pytest.raises(ValueError, match="num_beams"):
+            beam_search(model, params, prompt, max_len=10, num_beams=0)
+        with pytest.raises(ValueError, match="prompt length"):
+            beam_search(model, params, prompt, max_len=3)
+
     def test_top_k_one_equals_greedy(self, hvd, rng):
         """top_k=1 sampling must collapse to argmax — both decode paths."""
         from horovod_tpu.models import GPT, GPTConfig, generate
