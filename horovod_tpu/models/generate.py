@@ -137,6 +137,35 @@ def _generate(model, params, prompt, max_len, temperature, rng,
     return buf
 
 
+def beam_init_scores(B, k):
+    """All beams start identical: only beam 0 may seed the first
+    expansion, or the top-k would fill with k copies of the same
+    hypothesis."""
+    scores = jnp.where(jnp.arange(k) == 0, 0.0, -jnp.inf)
+    return jnp.broadcast_to(scores[None], (B, k)).astype(jnp.float32)
+
+
+def beam_expand(logp, bufs, scores, t):
+    """One beam expansion shared by the causal and seq2seq searches:
+    joint (beam, token) top-k over ``scores + logp``, beams reordered by
+    origin, the chosen tokens written at position ``t``.
+    ``logp``: (B, k, V) next-token log-probs; ``bufs``: (B, k, L)."""
+    B, k, V = logp.shape
+    cand = (scores[:, :, None] + logp).reshape(B, k * V)
+    scores, idx = lax.top_k(cand, k)                    # (B, k)
+    beam, tok = idx // V, (idx % V).astype(jnp.int32)
+    bufs = jnp.take_along_axis(bufs, beam[:, :, None], axis=1)
+    bufs = lax.dynamic_update_slice(bufs, tok[:, :, None], (0, 0, t))
+    return bufs, scores
+
+
+def beam_best(bufs, scores):
+    """Best hypothesis per batch row: ((B, L) sequences, (B,) scores)."""
+    best = jnp.argmax(scores, axis=1)
+    return (jnp.take_along_axis(bufs, best[:, None, None], axis=1)[:, 0],
+            jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0])
+
+
 @functools.partial(jax.jit, static_argnums=(0, 3, 4))
 def _beam_search(model, params, prompt, max_len, num_beams):
     B, P = prompt.shape
@@ -144,10 +173,7 @@ def _beam_search(model, params, prompt, max_len, num_beams):
     bufs = jnp.zeros((B, k, max_len), jnp.int32)
     bufs = lax.dynamic_update_slice(
         bufs, jnp.broadcast_to(prompt[:, None], (B, k, P)), (0, 0, 0))
-    # All beams start identical: only beam 0 may seed the first expansion,
-    # or the top-k would fill with k copies of the same hypothesis.
-    scores = jnp.where(jnp.arange(k) == 0, 0.0, -jnp.inf)
-    scores = jnp.broadcast_to(scores[None], (B, k)).astype(jnp.float32)
+    scores = beam_init_scores(B, k)
 
     def step(carry, t):
         bufs, scores = carry
@@ -155,19 +181,11 @@ def _beam_search(model, params, prompt, max_len, num_beams):
                              bufs.reshape(B * k, max_len))
         logp = jax.nn.log_softmax(
             logits[:, t - 1].astype(jnp.float32)).reshape(B, k, -1)
-        V = logp.shape[-1]
-        cand = (scores[:, :, None] + logp).reshape(B, k * V)
-        scores, idx = lax.top_k(cand, k)                    # (B, k)
-        beam, tok = idx // V, (idx % V).astype(jnp.int32)
-        bufs = jnp.take_along_axis(bufs, beam[:, :, None], axis=1)
-        bufs = lax.dynamic_update_slice(bufs, tok[:, :, None], (0, 0, t))
-        return (bufs, scores), None
+        return beam_expand(logp, bufs, scores, t), None
 
     (bufs, scores), _ = lax.scan(step, (bufs, scores),
                                  jnp.arange(P, max_len))
-    best = jnp.argmax(scores, axis=1)
-    return (jnp.take_along_axis(bufs, best[:, None, None], axis=1)[:, 0],
-            jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0])
+    return beam_best(bufs, scores)
 
 
 def beam_search(model, params, prompt, max_len, num_beams=4):

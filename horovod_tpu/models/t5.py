@@ -339,6 +339,48 @@ def _t5_greedy_cached(decoder_model, state, src_ids, max_len, bos_id,
     return buf
 
 
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5))
+def _t5_beam(model, params, src_ids, max_len, num_beams, bos_id, src_mask):
+    from horovod_tpu.models.generate import (beam_best, beam_expand,
+                                             beam_init_scores)
+    memory = model.apply({"params": params}, src_ids, src_mask,
+                         method=T5.encode)
+    B, k = src_ids.shape[0], num_beams
+    mem_k = jnp.repeat(memory, k, axis=0)
+    mask_k = None if src_mask is None else jnp.repeat(src_mask, k, axis=0)
+    bufs = jnp.full((B, k, max_len), bos_id, jnp.int32)
+    scores = beam_init_scores(B, k)
+
+    def step(carry, t):
+        bufs, scores = carry
+        logits = model.apply({"params": params},
+                             bufs.reshape(B * k, max_len), mem_k,
+                             memory_mask=mask_k, method=T5.decode)
+        logp = jax.nn.log_softmax(
+            logits[:, t - 1].astype(jnp.float32)).reshape(B, k, -1)
+        return beam_expand(logp, bufs, scores, t), None
+
+    (bufs, scores), _ = lax.scan(step, (bufs, scores),
+                                 jnp.arange(1, max_len))
+    return beam_best(bufs, scores)
+
+
+def t5_beam_decode(model, params, src_ids, max_len, num_beams=4, bos_id=0,
+                   src_mask=None):
+    """Beam-search seq2seq decoding: encoder once, then k hypotheses
+    re-forwarded jointly per step (fixed-length buffer; no EOS, so no
+    length penalty — see :func:`horovod_tpu.models.beam_search`). Returns
+    ``(sequences, scores)``: (B, max_len) int32 starting with ``bos_id``
+    and the summed token log-probs. ``num_beams=1`` equals
+    :func:`t5_greedy_decode`."""
+    if num_beams < 1:
+        raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if max_len < 2:
+        raise ValueError(f"max_len must be >= 2, got {max_len}")
+    return _t5_beam(model, params, jnp.asarray(src_ids, jnp.int32),
+                    int(max_len), int(num_beams), int(bos_id), src_mask)
+
+
 def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
                      src_mask=None, use_cache=False):
     """Greedy seq2seq decoding as one compiled program. Default: encoder

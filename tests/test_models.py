@@ -561,3 +561,27 @@ class TestT5:
             t5_greedy_decode(m, params, src,
                              max_len=cfg.max_decode_len + 1,
                              use_cache=True)
+
+    def test_t5_beam_matches_greedy_at_one(self, hvd, rng):
+        """T5 beam search: num_beams=1 equals greedy decode; wider beams
+        return well-formed sequences with finite scores; masked sources
+        respected."""
+        from horovod_tpu.models import (T5, T5Config, t5_beam_decode,
+                                        t5_greedy_decode)
+        cfg = T5Config.tiny(tp_axis=None, num_layers=1)
+        m = T5(cfg)
+        src = jnp.asarray(np.asarray(rng.integers(0, 256, (2, 6)),
+                                     np.int32))
+        mask = jnp.asarray([[True] * 6, [True] * 4 + [False] * 2])
+        params = m.init(jax.random.PRNGKey(0), src, src)["params"]
+        greedy = np.asarray(t5_greedy_decode(m, params, src, max_len=6,
+                                             src_mask=mask))
+        b1, s1 = t5_beam_decode(m, params, src, max_len=6, num_beams=1,
+                                src_mask=mask)
+        np.testing.assert_array_equal(np.asarray(b1), greedy)
+        b4, s4 = t5_beam_decode(m, params, src, max_len=6, num_beams=4,
+                                src_mask=mask)
+        assert b4.shape == (2, 6) and (np.asarray(b4[:, 0]) == 0).all()
+        assert np.isfinite(np.asarray(s4)).all()
+        with pytest.raises(ValueError, match="num_beams"):
+            t5_beam_decode(m, params, src, max_len=6, num_beams=0)
