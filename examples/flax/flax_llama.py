@@ -17,7 +17,7 @@ import numpy as np
 import optax
 from jax import lax
 
-from horovod_tpu.models import Llama, LlamaConfig, generate
+from horovod_tpu.models import Llama, LlamaConfig, beam_search, generate
 
 
 def main():
@@ -61,6 +61,10 @@ def main():
     match = out[0].tolist() == np.asarray(seq)[0].tolist()
     print("decoded sequence matches training target" if match
           else "decode mismatch (undertrained?)")
+    beams, scores = beam_search(model, params, prompt, max_len=12,
+                                num_beams=4)
+    print(f"beam-4 best (log-prob {float(scores[0]):.3f}): "
+          f"{np.asarray(beams)[0].tolist()}")
 
 
 if __name__ == "__main__":
