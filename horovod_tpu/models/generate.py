@@ -137,6 +137,22 @@ def _generate(model, params, prompt, max_len, temperature, rng,
     return buf
 
 
+def _check_position_capacity(model, max_len):
+    """Fail loudly when ``max_len`` exceeds the model's position table.
+
+    Learned position embeddings are fetched with a clamping gather, so an
+    out-of-range decode would silently reuse the last position row and
+    emit plausible-looking junk (the cached path's dynamic_update_slice
+    clamps the same way). Applies to every decode path, not just the
+    cached one."""
+    cap = getattr(getattr(model, "config", None),
+                  "max_position_embeddings", None)
+    if cap is not None and max_len > cap:
+        raise ValueError(
+            f"max_len {max_len} exceeds the model's position capacity "
+            f"(max_position_embeddings={cap})")
+
+
 def beam_init_scores(B, k):
     """All beams start identical: only beam 0 may seed the first
     expansion, or the top-k would fill with k copies of the same
@@ -203,6 +219,7 @@ def beam_search(model, params, prompt, max_len, num_beams=4):
             f"prompt length {P} must be in [1, max_len={max_len})")
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    _check_position_capacity(model, max_len)
     return _beam_search(model, params, jnp.asarray(prompt, jnp.int32),
                         int(max_len), int(num_beams))
 
@@ -246,19 +263,12 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
     if rng is None:
         rng = jax.random.PRNGKey(0)
     prompt = jnp.asarray(prompt, jnp.int32)
+    _check_position_capacity(model, max_len)
     if use_cache:
         # KV-cache path: O(1) projection work per token instead of a full
         # re-forward (dense GPT/LLaMA; the cache model shares the params
         # tree).
         import dataclasses as _dc
-        cap = getattr(getattr(model, "config", None),
-                      "max_position_embeddings", None)
-        if cap is not None and max_len > cap:
-            # dynamic_update_slice would CLAMP out-of-range cache writes
-            # onto the last slot and emit repeating junk — fail loudly.
-            raise ValueError(
-                f"max_len {max_len} exceeds the cache capacity "
-                f"(max_position_embeddings={cap})")
         decoder = _dc.replace(model, decode=True)
         cache = init_decode_cache(decoder, prompt[:, :1], pos=0)
         return _generate_cached(decoder, (params, cache), prompt,

@@ -506,6 +506,23 @@ def _jnp_block_bwd(q3, k3, v3, o3, lse, do3, causal, scale,
     return dq.astype(q3.dtype), dk.astype(k3.dtype), dv.astype(v3.dtype)
 
 
+def gqa_repeat3(t3, b, kv, g):
+    """(B*KV, L, D) -> (B*KV*g, L, D): each kv head's block repeated g
+    times CONTIGUOUSLY, matching the (B*heads, L, D) query row layout the
+    kernels (and their GQA index maps) use."""
+    _, L, D = t3.shape
+    return jnp.repeat(t3.reshape(b, kv, L, D), g, axis=1).reshape(
+        b * kv * g, L, D)
+
+
+def gqa_fold3(t3, b, kv, g):
+    """Group-sum (B*heads, L, D) gradients back onto the narrow kv rows —
+    the VJP of :func:`gqa_repeat3`."""
+    _, L, D = t3.shape
+    return t3.reshape(b, kv, g, L, D).sum(axis=2).reshape(
+        b * kv, L, D).astype(t3.dtype)
+
+
 def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, kv_valid,
                heads, kv_heads, res, do):
     q, k, v, o, lse = res
@@ -516,11 +533,8 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, kv_valid,
         # implicit broadcast).
         g = heads // kv_heads
         b = q.shape[0] // heads
-        lk, d = k.shape[1], k.shape[2]
-        k = jnp.repeat(k.reshape(b, kv_heads, lk, d), g,
-                       axis=1).reshape(b * heads, lk, d)
-        v = jnp.repeat(v.reshape(b, kv_heads, lk, d), g,
-                       axis=1).reshape(b * heads, lk, d)
+        k = gqa_repeat3(k, b, kv_heads, g)
+        v = gqa_repeat3(v, b, kv_heads, g)
     if not _interpret():
         dq, dk, dv = _fa_backward(q, k, v, o, lse, do, causal, sm_scale,
                                   block_q, block_k, q_offset, kv_valid)
@@ -528,11 +542,7 @@ def _flash_bwd(causal, sm_scale, block_q, block_k, q_offset, kv_valid,
         dq, dk, dv = _jnp_block_bwd(q, k, v, o, lse, do, causal, sm_scale,
                                     q_offset=q_offset, kv_valid=kv_valid)
     if gqa:
-        def narrow(t):
-            return t.reshape(b, kv_heads, g, lk, d).sum(axis=2).reshape(
-                b * kv_heads, lk, d).astype(t.dtype)
-
-        dk, dv = narrow(dk), narrow(dv)
+        dk, dv = gqa_fold3(dk, b, kv_heads, g), gqa_fold3(dv, b, kv_heads, g)
     return dq, dk, dv
 
 

@@ -403,8 +403,17 @@ class CompositeLlama(_CompositeLM):
     def _build_modules(self):
         from horovod_tpu.models.llama import (LlamaBlock, LlamaEmbed,
                                               LlamaHead)
+        # The LLaMA blocks read tp_axis from their config (unlike the GPT
+        # path, which takes axis_name directly), so the modules get a
+        # PRIVATE copy pinned to the composite mesh's tp axis — the
+        # caller-visible self.config is never mutated. A conflicting
+        # explicit axis is an error, not a silent rewrite.
+        if self.config.tp_axis not in (None, TP_AXIS):
+            raise ValueError(
+                f"config.tp_axis={self.config.tp_axis!r} conflicts with "
+                f"the composite mesh's tensor-parallel axis {TP_AXIS!r}; "
+                "leave it as None (or set it to the mesh axis)")
         c = dataclasses.replace(self.config, tp_axis=TP_AXIS)
-        self.config = c
         self.embed = LlamaEmbed(c)
         self.head = LlamaHead(c)
         self.block = LlamaBlock(c)

@@ -24,6 +24,28 @@ from jax import lax
 
 PP_AXIS = "pp"
 
+# Cross-device communication primitives: their presence in a sub-program
+# means it cannot run under a cond whose predicate varies over the mesh
+# (subset participation deadlocks the collective rendezvous). Substring
+# match: JAX names variants like psum_invariant / all_gather_invariant.
+_COLLECTIVE_STEMS = ("psum", "pmin", "pmax", "ppermute", "pgather",
+                     "all_gather", "all_to_all", "reduce_scatter")
+
+
+def _jaxpr_has_collectives(jaxpr) -> bool:
+    """Recursively scan a jaxpr (and sub-jaxprs in scan/cond/pjit params)
+    for collective primitives."""
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        if any(stem in name for stem in _COLLECTIVE_STEMS):
+            return True
+        for v in eqn.params.values():
+            for item in (v if isinstance(v, (list, tuple)) else (v,)):
+                sub = getattr(item, "jaxpr", item)
+                if hasattr(sub, "eqns") and _jaxpr_has_collectives(sub):
+                    return True
+    return False
+
 
 def stage_apply(layer_fn: Callable, stage_params, x):
     """Apply this stage's stacked layers sequentially: ``layer_fn(p_i, x)``
@@ -181,6 +203,20 @@ def pipeline_1f1b(layer_fn: Callable, head_loss_fn: Callable, stage_params,
                                targets[0])
     loss_axes = set(getattr(loss_aval, "vma", ())) | {axis_name}
 
+    # Can the head loss + vjp be GATED to the last stage? Only when it
+    # contains no collectives: a psum/ppermute inside a cond whose
+    # predicate varies over pp would be entered by a subset of the
+    # devices XLA's channel rendezvous expects and deadlock the step
+    # (observed on the CPU thunk runtime; the TPU runtime has the same
+    # subset-participation hazard). A collective-free head (the common
+    # case — e.g. a local token-mean cross-entropy) skips the full-vocab
+    # matmul + vjp on the S-1 non-last stages every tick.
+    try:
+        head_gateable = not _jaxpr_has_collectives(jax.make_jaxpr(
+            head_loss_fn)(head_params, microbatches[0], targets[0]).jaxpr)
+    except Exception:
+        head_gateable = False            # conservative: trace quirks -> run
+
     zeros_mb = mv(jnp.zeros_like(microbatches[0]), data_axes)
     carry0 = dict(
         fwd_state=zeros_mb,                       # activation hop buffer
@@ -224,15 +260,40 @@ def pipeline_1f1b(layer_fn: Callable, head_loss_fn: Callable, stage_params,
         fwd_next = lax.ppermute(y, axis_name, fwd_ring)    # activation hop
 
         # --- last stage turns the microbatch around this tick ---
-        loss_t, head_pull = jax.vjp(head_loss_fn, head_params, y,
-                                    targets[mi_b])
-        # The cotangent's varying-axes type must match loss_t's exactly —
-        # on a composite mesh the loss is varying over more than the pp
-        # axis (e.g. dp-sharded batches).
-        ct = jnp.asarray(1.0 / n_micro, loss_t.dtype)
-        for ax in getattr(jax.typeof(loss_t), "vma", ()):
-            ct = mark_varying(ct, ax)
-        dhead_t, dy_head, _ = head_pull(ct)
+        # Only the last stage's result is ever consumed, and at a 32k-128k
+        # vocab the head matmul + its vjp dominate a tick — when the head
+        # is collective-free (head_gateable), gate it behind a cond so the
+        # other S-1 stages skip the work entirely.
+        def head_branch():
+            loss_t, head_pull = jax.vjp(head_loss_fn, head_params, y,
+                                        targets[mi_b])
+            # The cotangent's varying-axes type must match loss_t's
+            # exactly — on a composite mesh the loss is varying over more
+            # than the pp axis (e.g. dp-sharded batches).
+            ct = jnp.asarray(1.0 / n_micro, loss_t.dtype)
+            for ax in getattr(jax.typeof(loss_t), "vma", ()):
+                ct = mark_varying(ct, ax)
+            dhead_t, dy_head, _ = head_pull(ct)
+            return loss_t, dhead_t, dy_head
+
+        def skip_branch():
+            # Zeros with branch-matching varying-axes types: the loss as
+            # eval_shape'd, head cotangents varying like their primals,
+            # dy like the activation.
+            zl = mv(jnp.zeros(loss_aval.shape, loss_aval.dtype),
+                    getattr(loss_aval, "vma", ()))
+            return zl, grad_carry(head_params), mv(jnp.zeros_like(y),
+                                                   data_axes)
+
+        if head_gateable:
+            loss_t, dhead_t, dy_head = lax.cond(
+                stage == n_stages - 1, head_branch, skip_branch)
+        else:
+            # head_loss_fn contains collectives (e.g. an sp-global token
+            # mean): every stage must enter them in lockstep, so the head
+            # runs unmasked everywhere and the on_head masks below select
+            # the last stage's real result.
+            loss_t, dhead_t, dy_head = head_branch()
 
         # --- backward slot (recompute the stage forward from the stash) ---
         dy = jnp.where(stage == n_stages - 1, dy_head, bwd_in)

@@ -40,7 +40,9 @@ def _attention_weights(q, k, scale, mask=None):
 
 def local_attention(q, k, v, causal=False):
     """Plain softmax attention on local (unsharded) tensors; the correctness
-    oracle for the parallel schemes."""
+    oracle for the parallel schemes. ``k``/``v`` may carry fewer (grouped)
+    heads than ``q`` — they are broadcast here, locally."""
+    k, v = broadcast_kv_heads(q, k, v)
     scale = 1.0 / np.sqrt(q.shape[-1])
     mask = None
     if causal:
@@ -50,6 +52,19 @@ def local_attention(q, k, v, causal=False):
     p = jax.nn.softmax(s, axis=-1)
     return jnp.einsum("bhqk,bkhd->bqhd", p, v.astype(jnp.float32)) \
         .astype(q.dtype)
+
+
+def broadcast_kv_heads(q, k, v):
+    """Repeat grouped K/V heads up to the query head count (no-op for MHA).
+    The sp schemes call this as LATE as possible — after the collective
+    exchange — so ring/Ulysses traffic keeps GQA's 1/g bandwidth saving."""
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"kv heads {k.shape[2]} must divide query heads "
+                         f"{q.shape[2]}")
+    g = q.shape[2] // k.shape[2]
+    if g == 1:
+        return k, v
+    return jnp.repeat(k, g, axis=2), jnp.repeat(v, g, axis=2)
 
 
 def _axis_bound(axis_name):
@@ -73,6 +88,11 @@ def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False,
     ``use_flash=True`` runs the per-head-shard full-sequence attention
     through the Pallas flash kernels (flash_attention handles its own
     non-TPU fallback), cutting the O(L²) score materialization.
+
+    ``k``/``v`` may carry fewer (grouped) heads than ``q``: when the kv
+    head count divides the sp degree they ride the all-to-alls NARROW
+    (1/g the exchange bytes) and are broadcast only on the local,
+    post-exchange side; otherwise they are broadcast before the exchange.
     """
     if use_flash:
         from horovod_tpu.ops.pallas import flash_attention as attn
@@ -83,6 +103,10 @@ def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False,
     n = lax.axis_size(axis_name)
     if q.shape[2] % n != 0:
         raise ValueError(f"num heads {q.shape[2]} not divisible by sp={n}")
+    if k.shape[2] % n != 0:
+        # grouped heads don't split over sp — broadcast first (correct,
+        # but loses the narrow exchange; ring SP keeps it at any g)
+        k, v = broadcast_kv_heads(q, k, v)
 
     def scatter_heads(t):
         # (B, L/n, H, D) -> (B, L, H/n, D)
@@ -95,6 +119,8 @@ def ulysses_attention(q, k, v, axis_name=SP_AXIS, causal=False,
                               tiled=True)
 
     qh, kh, vh = scatter_heads(q), scatter_heads(k), scatter_heads(v)
+    # flash streams grouped K/V natively; the jnp oracle broadcasts —
+    # either way the broadcast (if any) happens AFTER the all-to-all.
     oh = attn(qh, kh, vh, causal=causal)
     return gather_heads(oh)
 
@@ -127,40 +153,78 @@ def next_token_labels(ids, axis_name=SP_AXIS, pad_id=-100):
     return jnp.concatenate([ids[:, 1:], boundary], axis=1)
 
 
-def _block_attn_fwd(q3, ks, vs, causal, scale, blocks):
+def _block_attn_fwd(q3, ks, vs, causal, scale, blocks, heads=None,
+                    kv_heads=None):
     """(o_b, lse_b) for one ring hop on (BH, L, D) blocks: the Pallas flash
     kernel on TPU, the shared jnp block oracle elsewhere (the interpreter
-    can't run the kernel under a VMA-checked shard_map)."""
+    can't run the kernel under a VMA-checked shard_map). With
+    ``kv_heads < heads`` the ks/vs blocks stay NARROW (B*KV rows): the
+    kernel streams them via its GQA index maps; the oracle broadcasts
+    locally — either way the ring traffic carried only the narrow blocks."""
     from horovod_tpu.ops.pallas.flash_attention import (_fa_forward,
                                                         _interpret,
-                                                        _jnp_block_fwd)
+                                                        _jnp_block_fwd,
+                                                        gqa_repeat3)
+    gqa = heads is not None and kv_heads is not None and heads != kv_heads
     if blocks is not None and not _interpret():
-        return _fa_forward(q3, ks, vs, causal, scale, *blocks)
+        return _fa_forward(q3, ks, vs, causal, scale, *blocks,
+                           heads=heads if gqa else None,
+                           kv_heads=kv_heads if gqa else None)
+    if gqa:
+        b = q3.shape[0] // heads
+        g = heads // kv_heads
+        ks = gqa_repeat3(ks, b, kv_heads, g)
+        vs = gqa_repeat3(vs, b, kv_heads, g)
     return _jnp_block_fwd(q3, ks, vs, causal, scale)
 
 
-def _block_attn_bwd(q3, ks, vs, out3, lse, do3, causal, scale, blocks):
+def _block_attn_bwd(q3, ks, vs, out3, lse, do3, causal, scale, blocks,
+                    heads=None, kv_heads=None):
     """Per-hop (dq, dk, dv) against the GLOBAL softmax: p = exp(s - lse)
     with the ring-wide logsumexp, so summing hop contributions reproduces
-    the exact full-attention gradient."""
+    the exact full-attention gradient. Under GQA the returned dk/dv are
+    group-summed back onto the NARROW kv rows, so the gradient
+    accumulators rotate narrow too."""
     from horovod_tpu.ops.pallas.flash_attention import (_fa_backward,
                                                         _interpret,
-                                                        _jnp_block_bwd)
+                                                        _jnp_block_bwd,
+                                                        gqa_fold3,
+                                                        gqa_repeat3)
+    gqa = heads is not None and kv_heads is not None and heads != kv_heads
+    if gqa:
+        # The backward kernel is MHA-shaped (like _flash_bwd): broadcast
+        # the narrow hop blocks LOCALLY, group-sum dk/dv back. The ring
+        # still only ever carried the narrow blocks.
+        b = q3.shape[0] // heads
+        g = heads // kv_heads
+        ks = gqa_repeat3(ks, b, kv_heads, g)
+        vs = gqa_repeat3(vs, b, kv_heads, g)
     if blocks is not None and not _interpret():
-        return _fa_backward(q3, ks, vs, out3, lse, do3, causal, scale,
-                            *blocks)
-    return _jnp_block_bwd(q3, ks, vs, out3, lse, do3, causal, scale)
+        dq, dk, dv = _fa_backward(q3, ks, vs, out3, lse, do3, causal,
+                                  scale, *blocks)
+    else:
+        dq, dk, dv = _jnp_block_bwd(q3, ks, vs, out3, lse, do3, causal,
+                                    scale)
+    if gqa:
+        dk = gqa_fold3(dk, b, kv_heads, g)
+        dv = gqa_fold3(dv, b, kv_heads, g)
+    return dq, dk, dv
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
-def _ring_flash(q3, k3, v3, causal, axis_name, scale, blocks):
-    out, _ = _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks)
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def _ring_flash(q3, k3, v3, causal, axis_name, scale, blocks, heads=None,
+                kv_heads=None):
+    out, _ = _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks,
+                             heads, kv_heads)
     return out
 
 
-def _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks):
+def _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks,
+                    heads=None, kv_heads=None):
     """Ring forward: rotate K/V blocks, run the flash block kernel per hop,
-    combine hop outputs by their logsumexp weights (exact)."""
+    combine hop outputs by their logsumexp weights (exact). Under GQA
+    (``kv_heads < heads``) the rotated k3/v3 carry only B*kv_heads rows —
+    1/g the ppermute bytes."""
     from horovod_tpu.ops.in_jit import mark_varying_like
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -183,13 +247,13 @@ def _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks):
             o_b, lse_b = lax.cond(
                 src < idx,
                 lambda ks=ks, vs=vs: _block_attn_fwd(
-                    q3, ks, vs, False, scale, blocks),
+                    q3, ks, vs, False, scale, blocks, heads, kv_heads),
                 lambda: (q3 * 0,
                          q3[..., 0].astype(jnp.float32) * 0 - 1e30))
             visible = (src < idx).astype(jnp.float32)       # whole block
         else:
             o_b, lse_b = _block_attn_fwd(q3, ks, vs, causal and s == 0,
-                                         scale, blocks)
+                                         scale, blocks, heads, kv_heads)
             visible = jnp.float32(1.0)
         m_new = jnp.maximum(m, jnp.where(visible > 0, lse_b, -1e30))
         # m_new stays -1e30 only while NO block is visible yet; exp(0)=1
@@ -208,9 +272,11 @@ def _ring_flash_fwd(q3, k3, v3, causal, axis_name, scale, blocks):
     return out, (q3, k3, v3, out, lse_tot)
 
 
-def _ring_flash_bwd(causal, axis_name, scale, blocks, res, do3):
+def _ring_flash_bwd(causal, axis_name, scale, blocks, heads, kv_heads, res,
+                    do3):
     """Ring backward: rotate K/V (and their gradient accumulators) around
-    the ring again; each hop's dk/dv lands home after n-1 rotations."""
+    the ring again; each hop's dk/dv lands home after n-1 rotations. Under
+    GQA the rotated blocks AND accumulators stay narrow (B*kv_heads rows)."""
     q3, k3, v3, out3, lse_tot = res
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
@@ -233,13 +299,14 @@ def _ring_flash_bwd(causal, axis_name, scale, blocks, res, do3):
             dq_b, dk_b, dv_b = lax.cond(
                 src < idx,
                 lambda ks=ks, vs=vs: _block_attn_bwd(
-                    q3, ks, vs, out3, lse_safe, do3, False, scale, blocks),
+                    q3, ks, vs, out3, lse_safe, do3, False, scale, blocks,
+                    heads, kv_heads),
                 lambda ks=ks, vs=vs: (q3 * 0, ks * 0, vs * 0))
             visible = (src < idx).astype(jnp.float32)
         else:
             dq_b, dk_b, dv_b = _block_attn_bwd(
                 q3, ks, vs, out3, lse_safe, do3, causal and s == 0, scale,
-                blocks)
+                blocks, heads, kv_heads)
             visible = jnp.float32(1.0)
         dq = dq + visible * dq_b.astype(jnp.float32)
         dk_rot = dk_rot + visible * dk_b.astype(jnp.float32)
@@ -274,31 +341,42 @@ def ring_attention(q, k, v, axis_name=SP_AXIS, causal=False,
     logsumexp weights — same exact math, MXU-tiled and O(block) VMEM. On
     non-TPU backends the hops use an equivalent jnp block kernel, so the
     path is testable on the virtual CPU mesh.
+
+    ``k``/``v`` may carry fewer (grouped) heads than ``q``: the narrow
+    tensors rotate the ring directly (1/g the ppermute bytes AND 1/g the
+    resident K/V memory) and are expanded only at the hop kernels — the
+    flash path streams them without materializing the broadcast at all.
     """
+    if q.shape[2] % k.shape[2]:
+        raise ValueError(f"kv heads {k.shape[2]} must divide query heads "
+                         f"{q.shape[2]}")
     if not _axis_bound(axis_name):
         if use_flash:
             from horovod_tpu.ops.pallas import flash_attention as _flash_fn
             return _flash_fn(q, k, v, causal=causal)
         return local_attention(q, k, v, causal=causal)
+    B, Lq, H, D = q.shape
+    KV = k.shape[2]
     if use_flash:
         import importlib
         fa = importlib.import_module(
             "horovod_tpu.ops.pallas.flash_attention")
-        B, Lq, H, D = q.shape
         bq, bk = fa._pick_block(Lq), fa._pick_block(k.shape[1])
         blocks = (bq, bk) if (bq and bk and fa.pltpu is not None) else None
         scale = 1.0 / np.sqrt(D)
 
         def to3(t):
-            return jnp.moveaxis(t, 2, 1).reshape(t.shape[0] * H,
+            h = t.shape[2]
+            return jnp.moveaxis(t, 2, 1).reshape(t.shape[0] * h,
                                                  t.shape[1], D)
 
         o3 = _ring_flash(to3(q), to3(k), to3(v), causal, axis_name, scale,
-                         blocks)
+                         blocks, H if KV != H else None,
+                         KV if KV != H else None)
         return jnp.moveaxis(o3.reshape(B, H, Lq, D), 1, 2)
     n = lax.axis_size(axis_name)
     idx = lax.axis_index(axis_name)
-    B, Lq, H, D = q.shape
+    g = H // KV
     scale = 1.0 / np.sqrt(D)
     qf = q.astype(jnp.float32)
 
@@ -310,8 +388,12 @@ def ring_attention(q, k, v, axis_name=SP_AXIS, causal=False,
     def step(s, carry):
         o, m, l, ks, vs = carry
         src = (idx + s) % n
+        # narrow (grouped) K/V rotate the ring; broadcast only here,
+        # locally, for the einsum
+        ksf, vsf = (jnp.repeat(t, g, axis=2) if g > 1 else t
+                    for t in (ks, vs))
         scores = jnp.einsum("bqhd,bkhd->bhqk", qf,
-                            ks.astype(jnp.float32)) * scale
+                            ksf.astype(jnp.float32)) * scale
         if causal:
             k_pos = src * Lq + jnp.arange(Lq)
             mask = q_pos[:, None] >= k_pos[None, :]        # (Lq, Lk)
@@ -325,7 +407,7 @@ def ring_attention(q, k, v, axis_name=SP_AXIS, causal=False,
         p = jnp.where(jnp.isfinite(scores), p, 0.0)
         l_new = l * corr + jnp.sum(p, axis=-1)
         o_new = o * corr[..., None] \
-            + jnp.einsum("bhqk,bkhd->bhqd", p, vs.astype(jnp.float32))
+            + jnp.einsum("bhqk,bkhd->bhqd", p, vsf.astype(jnp.float32))
         ks = lax.ppermute(ks, axis_name, perm)
         vs = lax.ppermute(vs, axis_name, perm)
         return o_new, m_new, l_new, ks, vs

@@ -257,8 +257,9 @@ class TPSelfAttention(nn.Module):
         """Route full-sequence attention: sp ring/Ulysses, Pallas flash,
         or plain XLA. ``k``/``v`` may carry FEWER (grouped) heads than
         ``q``: the flash kernels stream the narrow tensors natively (no
-        broadcast, 1/g the K/V HBM traffic); the other paths broadcast
-        here. ``bias``: additive (local_heads, Lq, Lk) scores bias
+        broadcast, 1/g the K/V HBM traffic), the sp schemes rotate/exchange
+        them narrow (1/g the collective bytes); only the plain einsum
+        broadcasts here. ``bias``: additive (local_heads, Lq, Lk) scores bias
         (T5-style relative positions) — plain path only. The guard mirrors
         the dispatch below: flash with a mask falls back to the plain
         path, where bias IS supported."""
@@ -268,9 +269,12 @@ class TPSelfAttention(nn.Module):
                 "additive attention bias is supported on the plain XLA "
                 "path only (not flash/sp)")
         g = q.shape[2] // k.shape[2]
-        if g > 1 and not (self.use_flash and mask is None
-                          and self.sp_axis is None):
-            # ring/Ulysses and the plain einsum expect MHA shapes.
+        if g > 1 and self.sp_axis is None and not (self.use_flash
+                                                   and mask is None):
+            # Only the plain einsum needs MHA shapes here. Flash streams
+            # grouped K/V natively, and the sp schemes keep them NARROW
+            # through their collectives (1/g the ring/all-to-all bytes),
+            # broadcasting — if at all — on the far side of the exchange.
             k = jnp.repeat(k, g, axis=2)
             v = jnp.repeat(v, g, axis=2)
         if self.sp_axis is not None:

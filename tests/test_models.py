@@ -279,9 +279,15 @@ class TestGenerate:
         cs = np.asarray(generate(model, params, prompt, 12,
                                  temperature=1.0, rng=key, use_cache=True))
         np.testing.assert_array_equal(cs, fs)
-        # capacity overflow fails loudly (clamped writes would emit junk)
-        with pytest.raises(ValueError, match="cache capacity"):
-            generate(model, params, prompt, 16, use_cache=True)
+        # capacity overflow fails loudly (clamped writes/gathers would
+        # emit junk) — on EVERY decode path, not just the cached one
+        from horovod_tpu.models import beam_search
+        for call in (
+                lambda: generate(model, params, prompt, 16, use_cache=True),
+                lambda: generate(model, params, prompt, 16),
+                lambda: beam_search(model, params, prompt, 16, num_beams=2)):
+            with pytest.raises(ValueError, match="position capacity"):
+                call()
 
     @pytest.mark.parametrize("family", ["gpt", "llama"])
     def test_beam_search_properties(self, hvd, rng, family):

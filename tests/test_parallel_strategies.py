@@ -331,6 +331,35 @@ class TestPipelineParallel:
                                    np.asarray(ref_grads[2]),
                                    rtol=1e-4, atol=1e-5)
 
+    def test_head_collective_detection(self, hvd):
+        """The 1F1B head gate must see collectives anywhere in the head
+        fn — including inside nested scans/conds — since gating a
+        collective to the last stage would deadlock the channel."""
+        from horovod_tpu.parallel.pp import _jaxpr_has_collectives
+
+        def plain(x):
+            return jnp.mean(x ** 2)
+
+        def nested_psum(x):
+            def body(c, t):
+                return c + lax.psum(t, "hvd"), None
+            out, _ = lax.scan(body, 0.0, x)
+            return out
+
+        mesh = mesh1d("hvd")
+        x = np.ones(8, np.float32)
+        assert not _jaxpr_has_collectives(jax.make_jaxpr(plain)(x).jaxpr)
+        got = {}
+
+        def probe(t):
+            got["val"] = _jaxpr_has_collectives(
+                jax.make_jaxpr(nested_psum)(t).jaxpr)
+            return t
+
+        jax.jit(jax.shard_map(probe, mesh=mesh, in_specs=P("hvd"),
+                              out_specs=P("hvd"))).trace(x)
+        assert got["val"]
+
     def test_stack_and_split_helpers(self, hvd):
         from horovod_tpu.parallel.pp import (split_microbatches,
                                              stack_stage_params)
