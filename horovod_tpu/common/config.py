@@ -89,6 +89,15 @@ class Config:
     # --- elastic / process sets (reference common.h:139-143) ---
     elastic: bool = False
     dynamic_process_sets: bool = False
+    # Multi-process JOIN (uneven final batches across hosts, reference
+    # controller.cc:269-327). The reference's background controller
+    # negotiates every collective, which is what lets a joined rank keep
+    # answering with zero contributions; the TPU hot path has no such
+    # negotiation, so JOIN across processes is an opt-in mode: while armed,
+    # every global-set eager collective starts with one tiny KV round
+    # (see ops/collective_ops._join_sync). Single-controller join() needs
+    # no mode flag.
+    join_mode: bool = False
 
     # --- bootstrap (reference gloo_run.py:203-214 env plumbing) ---
     rank: int = 0
@@ -155,6 +164,7 @@ class Config:
         c.elastic = _env_bool("HOROVOD_ELASTIC", c.elastic)
         c.dynamic_process_sets = _env_bool("HOROVOD_DYNAMIC_PROCESS_SETS",
                                            c.dynamic_process_sets)
+        c.join_mode = _env_bool("HOROVOD_JOIN_MODE", c.join_mode)
         c.rank = _env_int("HOROVOD_RANK", c.rank)
         c.local_rank = _env_int("HOROVOD_LOCAL_RANK", c.local_rank)
         c.cross_rank = _env_int("HOROVOD_CROSS_RANK", c.cross_rank)

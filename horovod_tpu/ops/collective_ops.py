@@ -356,7 +356,8 @@ def clear_program_caches():
     (response_cache.h:45, elastic abort path)."""
     for prog in (_local_mesh_info, _allreduce_program, _allgather_program,
                  _broadcast_program, _reducescatter_program,
-                 _alltoall_program, _barrier_program):
+                 _alltoall_program, _barrier_program,
+                 _alltoall_pack_index):
         prog.cache_clear()
     # Fused eager programs are keyed by Mesh too; stale entries would pin a
     # torn-down XLA client (and its buffers) for the rest of the job.
@@ -493,9 +494,12 @@ def grouped_allreduce(tensors, op=Average, prescale_factor=1.0,
             not _is_float(_dtype_of(t)) for t in tensors):
         raise ValueError("Average is not supported for integer tensors; use "
                          "hvd.Sum (matches reference torch/mpi_ops.py checks).")
+    active_mask = _join_sync(ps, mesh, {
+        "kind": "allreduce", "op": int(ReduceOp(op)),
+        "pre": float(prescale_factor), "post": float(postscale_factor),
+        "slices": _slice_desc(tensors, mesh, n, "allreduce")})
     tensors = _prepare(tensors, mesh, n, "allreduce")
     shapes, dtypes = _signature(tensors)
-    active_mask = _active_mask(ps)
     prog = _allreduce_program(mesh, n, ReduceOp(op), float(prescale_factor),
                               float(postscale_factor), shapes, dtypes,
                               active_mask)
@@ -516,20 +520,26 @@ def allgather(tensor, process_set=None, name=None):
 def grouped_allgather(tensors, process_set=None, name=None):
     mesh, ps = _mesh_for(process_set)
     n = ps.size()
-    tensors = _prepare(tensors, mesh, n, "allgather")
-    for t in tensors:
-        if t.ndim < 2:
+    slices = _slice_desc(tensors, mesh, n, "allgather")
+    # Validate BEFORE the join round: an active raising after publishing
+    # its descriptor would leave the joined processes' mirrors launching a
+    # collective nobody else joins (a hang, not an error).
+    for s, _ in slices:
+        if len(s) < 1:
             raise TensorShapeMismatchError(
                 "allgather requires per-rank tensors of rank>=1 "
                 "(stacked input rank>=2)")
+    active_mask = _join_sync(ps, mesh, {"kind": "allgather",
+                                        "slices": slices})
+    tensors = _prepare(tensors, mesh, n, "allgather")
     shapes, dtypes = _signature(tensors)
-    prog = _allgather_program(mesh, n, shapes, dtypes, _active_mask(ps))
+    prog = _allgather_program(mesh, n, shapes, dtypes, active_mask)
     with _timeline_op(name or "grouped_allgather", "ALLGATHER"):
         return _localize(list(prog(*tensors)), mesh)
 
 
 def allgather_ragged(tensors, process_set=None, name=None,
-                     return_sizes=False):
+                     return_sizes=False, _mirror=False):
     """Allgather of per-rank tensors with differing first dims.
 
     ``tensors`` is a list of arrays whose shapes agree on all but the first
@@ -553,6 +563,16 @@ def allgather_ragged(tensors, process_set=None, name=None,
             f"allgather_ragged needs one tensor per "
             f"{'local ' if multi else ''}rank ({n_rows}), got {len(tensors)}")
     tensors = [jnp.asarray(t) for t in tensors]
+    # Armed-mode round BEFORE the size negotiation so active and joined
+    # processes interleave the control plane identically. A joined
+    # process's mirror re-enters this function with zero-row tensors
+    # AFTER its loop already consumed the round (_mirror=True): it starts
+    # at the size exchange, in lockstep with the actives.
+    if not _mirror:
+        _join_sync(ps, mesh, {
+            "kind": "allgather_ragged",
+            "tail": [int(s) for s in tensors[0].shape[1:]],
+            "dtype": str(tensors[0].dtype)})
     local_sizes = [int(t.shape[0]) for t in tensors]
     if multi:
         from horovod_tpu.common import negotiation
@@ -599,7 +619,9 @@ def grouped_broadcast(tensors, root_rank, process_set=None, name=None):
         root = root_rank
     if not (0 <= root < n):
         raise ValueError(f"root_rank {root_rank} out of range [0,{n})")
-    mask = _active_mask(ps)
+    mask = _join_sync(ps, mesh, {"kind": "broadcast", "root": int(root),
+                                 "slices": _slice_desc(tensors, mesh, n,
+                                                       "broadcast")})
     if mask is not None and not mask[root]:
         # Reference errors when the broadcast root has already joined
         # (controller.cc join/root checks) — there is no data to send.
@@ -630,16 +652,22 @@ def grouped_reducescatter(tensors, op=Sum, prescale_factor=1.0,
                           postscale_factor=1.0, process_set=None, name=None):
     mesh, ps = _mesh_for(process_set)
     n = ps.size()
-    tensors = _prepare(tensors, mesh, n, "reducescatter")
-    for t in tensors:
-        if t.ndim < 2 or t.shape[1] % n != 0:
+    slices = _slice_desc(tensors, mesh, n, "reducescatter")
+    # Validate BEFORE the join round (see grouped_allgather).
+    for s, _ in slices:
+        if len(s) < 1 or s[0] % n != 0:
             raise TensorShapeMismatchError(
                 f"reducescatter: per-rank first dim must be divisible by "
-                f"{n}, got {tuple(t.shape[1:])}")
+                f"{n}, got {tuple(s)}")
+    active_mask = _join_sync(ps, mesh, {
+        "kind": "reducescatter", "op": int(ReduceOp(op)),
+        "pre": float(prescale_factor), "post": float(postscale_factor),
+        "slices": slices})
+    tensors = _prepare(tensors, mesh, n, "reducescatter")
     shapes, dtypes = _signature(tensors)
     prog = _reducescatter_program(mesh, n, ReduceOp(op), float(prescale_factor),
                                   float(postscale_factor), shapes, dtypes,
-                                  _active_mask(ps))
+                                  active_mask)
     with _timeline_op(name or "grouped_reducescatter", "REDUCESCATTER"):
         return _localize(list(prog(*tensors)), mesh)
 
@@ -659,7 +687,7 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
     """
     mesh, ps = _mesh_for(process_set)
     n = ps.size()
-    if _active_mask(ps) is not None:
+    if _join_sync(ps, mesh, {"kind": "alltoall"}) is not None:
         from horovod_tpu.common.exceptions import HorovodInternalError
         raise HorovodInternalError(
             "alltoall is not supported while ranks have joined (matches the "
@@ -720,17 +748,11 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
     # compiled programs as long as the padded shape matches.
     block = max(int(full.max()), 1)
     m = int(t.shape[1])
-    offs = np.concatenate([np.zeros((n, 1), np.int64),
-                           np.cumsum(full, axis=1)], axis=1)
-    j = np.arange(block, dtype=np.int64)
-    # pack_idx[i, p*block + k] = offs[g,p] + k for k < full[g,p], else m
-    # (m indexes the zero sentinel row appended below).
-    pack = offs[:, :-1, None] + j[None, None, :]          # (n, n, block)
-    pack = np.where(j[None, None, :] < full[:, :, None], pack, m)
-    pack_idx = pack.reshape(n, n * block)[rows_global]    # (n_rows, n*block)
+    pack_idx = _alltoall_pack_index(full.tobytes(), n, m,
+                                    tuple(rows_global))
     pad_width = [(0, 0), (0, 1)] + [(0, 0)] * (t.ndim - 2)
     t_pad = jnp.pad(t, pad_width)
-    dense = jax.vmap(lambda row, idx: row[idx])(t_pad, jnp.asarray(pack_idx))
+    dense = jax.vmap(lambda row, idx: row[idx])(t_pad, pack_idx)
     (dense,) = _prepare([dense], mesh, n, "alltoall")
     shapes, dtypes = _signature([dense])
     prog = _alltoall_program(mesh, n, shapes, dtypes)
@@ -746,12 +768,33 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
     return rows, received[np.asarray(rows_global)]
 
 
+@functools.lru_cache(maxsize=64)
+def _alltoall_pack_index(full_bytes, n, m, rows_global):
+    """Device-resident pack-index map for the uneven alltoall, cached by
+    (splits matrix, tensor rows, local rows): a repeated splits pattern —
+    the steady state of MoE dispatch — reuses both the host index build
+    (O(n²·block)) and its device upload instead of rebuilding per step
+    (the reference negotiates splits once per response, not per call:
+    collective_operations.h:199-268)."""
+    full = np.frombuffer(full_bytes, np.int64).reshape(n, n)
+    block = max(int(full.max()), 1)
+    offs = np.concatenate([np.zeros((n, 1), np.int64),
+                           np.cumsum(full, axis=1)], axis=1)
+    j = np.arange(block, dtype=np.int64)
+    # pack_idx[i, p*block + k] = offs[g,p] + k for k < full[g,p], else m
+    # (m indexes the zero sentinel row appended by the caller).
+    pack = offs[:, :-1, None] + j[None, None, :]          # (n, n, block)
+    pack = np.where(j[None, None, :] < full[:, :, None], pack, m)
+    return jnp.asarray(pack.reshape(n, n * block)[list(rows_global)])
+
+
 def barrier(process_set=None, name=None):
     """Block until all ranks reach the barrier
     (reference: hvd.barrier operations.cc EnqueueBarrier, message.h BARRIER)."""
     mesh, ps = _mesh_for(process_set)
     multi, local_pos = _local_mesh_info(mesh)
     rows = len(local_pos) if multi else ps.size()
+    _join_sync(ps, mesh, {"kind": "barrier"})
     token = np.zeros((rows, 1), np.int32)
     (token,) = _prepare([token], mesh, ps.size(), "barrier")
     with _timeline_op(name or "barrier", "BARRIER"):
@@ -775,6 +818,212 @@ def _active_mask(ps):
     return tuple(0 if r in st.joined_ranks else 1 for r in ranks)
 
 
+# ----------------------------------------------------------------------------
+# Multi-process JOIN (reference: controller.cc:269-327 joined-size
+# accounting, torch/mpi_ops_v2.cc:972 DoJoin).
+#
+# The reference's background controller negotiates EVERY collective, which
+# is what lets a joined rank keep answering negotiations and contributing
+# zeros until everyone has joined. The TPU hot path deliberately has no
+# per-op negotiation (compiled programs replace it), so JOIN across
+# processes is an armed MODE (HOROVOD_JOIN_MODE=1): while armed, every
+# global-set eager collective opens with one tiny KV "join round" in which
+# each process publishes either the descriptor of the op it is dispatching
+# or the set of ranks it has joined. A joined process sits inside join()
+# mirroring each negotiated descriptor with zero-filled inputs — its chips
+# must still launch the XLA program for the device collective to complete —
+# while the active ranks' programs carry the negotiated active-mask, giving
+# exact reference semantics (Sum-as-zero, Average over n_active, static
+# drop for Min/Max/Prod/Adasum, root-joined error). When a round shows
+# every rank joined, state resets and join() returns the last rank to join.
+# ----------------------------------------------------------------------------
+
+def _join_armed():
+    """Whether the multi-process join protocol is on (armed) — every
+    global-set eager collective then pays one KV round, joined or not."""
+    st = basics._get_state()
+    return st.config.join_mode and jax.process_count() > 1
+
+
+def _join_round(payload):
+    """One protocol round: every process publishes ``{"joined": [...],
+    "desc": ...}`` and reads everyone else's. Returns ``(joined_union,
+    descs)``."""
+    from horovod_tpu.common import negotiation
+    payloads = negotiation.exchange("join_round", payload)
+    joined = set()
+    descs = []
+    for p in payloads:
+        joined.update(int(r) for r in p["joined"])
+        if p.get("desc") is not None:
+            descs.append(p["desc"])
+    st = basics._get_state()
+    st.joined_ranks.clear()
+    st.joined_ranks.update(joined)
+    return joined, descs
+
+
+def _join_sync(ps, mesh, desc):
+    """Pre-dispatch hook for every eager collective: the armed-mode join
+    round (or the plain local mask when not armed). Must run BEFORE any
+    other cross-process interaction of the op (``_prepare``'s order check,
+    size negotiations) so active and mirroring processes interleave their
+    control-plane exchanges in the same order."""
+    if not _join_armed():
+        return _active_mask(ps)
+    if ps.ranks is not None:
+        multi, _ = _local_mesh_info(mesh)
+        if multi:
+            raise NotImplementedError(
+                "HOROVOD_JOIN_MODE supports collectives on the global "
+                "process set (and single-owner subsets) only — a joined "
+                "process cannot mirror ops on meshes it is not "
+                "synchronized with")
+        return _active_mask(ps)
+    st = basics._get_state()
+    _, local_pos = _local_mesh_info(mesh)
+    mine = sorted(st.joined_ranks.intersection(local_pos))
+    joined, descs = _join_round({"joined": mine, "desc": desc})
+    bad = [d for d in descs if d != desc]
+    if bad:
+        raise TensorShapeMismatchError(
+            f"join-mode collective mismatch: this process dispatched "
+            f"{desc}, peer(s) dispatched {bad[:2]} at the same round — "
+            f"every process must issue the same collectives in the same "
+            f"order")
+    if not joined:
+        return None
+    n = ps.size()
+    if len(joined) >= n:
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        raise HorovodInternalError("collective after all ranks joined")
+    return tuple(0 if r in joined else 1 for r in range(n))
+
+
+def _slice_desc(tensors, mesh=None, n=None, what=None):
+    """JSON-able per-tensor (slice-shape, dtype) signature, leading
+    (local-rank) axis excluded. With ``mesh``/``n``/``what`` the stacked
+    leading axis is validated HERE — i.e. before the join round — so a
+    malformed input raises before any descriptor is published (an active
+    raising after publishing would leave joined mirrors launching a
+    collective nobody joins)."""
+    rows = _expected_rows(mesh, n) if mesh is not None else None
+    out = []
+    for t in tensors:
+        if not hasattr(t, "ndim"):
+            t = np.asarray(t)
+        if rows is not None:
+            _check_stacked(t, rows, what)
+        out.append([[int(s) for s in t.shape[1:]], str(_dtype_of(t))])
+    return out
+
+
+def _mirror_dispatch(desc, joined):
+    """Run on a JOINED process: launch the XLA program the active ranks
+    negotiated, feeding zero-filled local rows (the mask makes the math
+    exact; the launch itself is what the device collective needs)."""
+    mesh, ps = _mesh_for(None)
+    n = ps.size()
+    _, local_pos = _local_mesh_info(mesh)
+    rows = len(local_pos)
+    mask = tuple(0 if r in joined else 1 for r in range(n))
+    kind = desc["kind"]
+    if kind == "alltoall":
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        raise HorovodInternalError(
+            "alltoall is not supported while ranks have joined (matches "
+            "the reference: JOIN covers allreduce/allgather/broadcast "
+            "only)")
+    if kind == "allgather_ragged":
+        # Mirror the active sequence exactly: the size negotiation (zero
+        # rows from joined ranks), then the inner public allgather — whose
+        # own join round lines up with the actives' inner round.
+        tail = tuple(desc["tail"])
+        zeros = [jnp.zeros((0,) + tail, desc["dtype"])
+                 for _ in range(rows)]
+        allgather_ragged(zeros, _mirror=True)
+        return
+    if kind == "barrier":
+        token = np.zeros((rows, 1), np.int32)
+        (token,) = _prepare([token], mesh, n, "barrier")
+        with _timeline_op("join_mirror_barrier", "JOIN"):
+            jax.block_until_ready(_barrier_program(mesh)(token))
+        return
+    zeros = [np.zeros([rows] + list(s), np.dtype(d))
+             for s, d in desc["slices"]]
+    tensors = _prepare(zeros, mesh, n, kind)
+    shapes, dtypes = _signature(tensors)
+    if kind == "allreduce":
+        prog = _allreduce_program(mesh, n, ReduceOp(desc["op"]),
+                                  float(desc["pre"]), float(desc["post"]),
+                                  shapes, dtypes, mask)
+    elif kind == "reducescatter":
+        prog = _reducescatter_program(mesh, n, ReduceOp(desc["op"]),
+                                      float(desc["pre"]),
+                                      float(desc["post"]), shapes, dtypes,
+                                      mask)
+    elif kind == "allgather":
+        prog = _allgather_program(mesh, n, shapes, dtypes, mask)
+    elif kind == "broadcast":
+        if not mask[int(desc["root"])]:
+            # The actives raise this after the same round and never launch
+            # a program — raise symmetrically instead of hanging in a
+            # mirror launch nobody joins.
+            from horovod_tpu.common.exceptions import HorovodInternalError
+            raise HorovodInternalError(
+                f"broadcast root_rank {desc['root']} has joined")
+        prog = _broadcast_program(mesh, n, int(desc["root"]), shapes,
+                                  dtypes)
+    else:
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        raise HorovodInternalError(f"join mirror: unknown op kind {kind!r}")
+    with _timeline_op(f"join_mirror_{kind}", "JOIN"):
+        jax.block_until_ready(prog(*tensors))
+
+
+def _join_multiprocess(st, rank):
+    """join() under HOROVOD_JOIN_MODE: publish this process's ranks as
+    joined and service the protocol loop — mirroring every collective the
+    still-active ranks dispatch — until the world has joined. Returns the
+    highest rank of the final round's newly-joined set (all processes
+    compute the same value from the same round sequence)."""
+    mesh = global_process_set.mesh
+    _, local_pos = _local_mesh_info(mesh)
+    my_ranks = sorted(local_pos)
+    if rank is not None:
+        raise ValueError(
+            "multi-process join() takes no rank argument: each process "
+            "joins all the ranks (chips) it owns — call join() from the "
+            "process whose data ran out")
+    n = basics.size()
+    # Every process participates in every round (actives via _join_sync),
+    # so st.joined_ranks here is the union as of the LAST completed round —
+    # the same value every looping process holds as its previous-round
+    # union. Snapshot it BEFORE adding my ranks so the final round's
+    # newly-joined set (which determines the returned last rank) is
+    # computed identically everywhere, including by the last joiner.
+    prev = set(st.joined_ranks)
+    st.joined_ranks.update(my_ranks)
+    while True:
+        joined, descs = _join_round({"joined": my_ranks, "desc": None})
+        if descs:
+            if any(d != descs[0] for d in descs[1:]):
+                raise TensorShapeMismatchError(
+                    f"join-mode collective mismatch among active ranks: "
+                    f"{descs[:3]}")
+            # The round rewrote st.joined_ranks to the union; the mirror's
+            # own nested rounds (ragged) need my ranks marked joined.
+            st.joined_ranks.update(my_ranks)
+            _mirror_dispatch(descs[0], joined)
+            prev = joined
+            continue
+        if len(joined) >= n:
+            newly = joined - prev
+            st.joined_ranks.clear()
+            return max(newly) if newly else n - 1
+        prev = joined
+
+
 def join(rank=None):
     """Signal that ``rank`` (default: every rank this controller owns) has
     exhausted its uneven workload.
@@ -786,24 +1035,33 @@ def join(rank=None):
     at which point the join completes and returns the id of the last rank to
     join (and the join state resets).
 
-    Multi-process semantics: JOIN is a **single-controller** feature. The
-    eager multi-process contract is SPMD (every process dispatches the same
-    programs in the same order), which is incompatible with one process
-    silently dropping out of collectives the way the reference's background
-    negotiation permits; multi-host uneven workloads should pad batches or
-    use the elastic API instead. Calling join() under a multi-process launch
-    raises rather than corrupting state.
+    Multi-process semantics: set ``HOROVOD_JOIN_MODE=1`` on every process.
+    While armed, each global-set eager collective opens with one small KV
+    round (the control-plane cost the reference pays on every collective
+    through its background controller); a process whose data ran out calls
+    ``join()``, which joins ALL the ranks (chips) it owns and services the
+    protocol loop — mirroring the still-active ranks' collectives with
+    zero contributions — until every rank has joined. Without the mode
+    flag, calling join() under a multi-process launch raises rather than
+    corrupting state (a process cannot silently drop out of SPMD
+    dispatch). Process-set-scoped collectives that span processes are not
+    supported while the mode is armed; alltoall raises while ranks are
+    joined (reference: JOIN covers allreduce/allgather/broadcast).
     """
+    st = basics._get_state()
     if jax.process_count() > 1:
+        if st.config.join_mode:
+            return _join_multiprocess(st, rank)
         # Deliberately NOT HorovodInternalError: that is the retryable
         # collective-failure type the elastic @run wrapper restores-and-
         # retries, which would loop forever on this deterministic usage
         # error.
         raise NotImplementedError(
-            "hvd.join() is single-controller only: multi-process eager "
-            "dispatch is SPMD and cannot drop one process from subsequent "
-            "collectives. Pad uneven batches or use the elastic API.")
-    st = basics._get_state()
+            "hvd.join() across processes requires HOROVOD_JOIN_MODE=1 on "
+            "every process (it arms a per-collective negotiation round). "
+            "Without it, multi-process eager dispatch is SPMD and cannot "
+            "drop one process from subsequent collectives — pad uneven "
+            "batches or use the elastic API.")
     if rank is None:
         st.joined_ranks.update(range(basics.size()))
     else:
@@ -850,7 +1108,12 @@ def allreduce_async(tensor, op=Average, prescale_factor=1.0,
     (reference: every async allreduce rides the fusion buffer + cycle loop,
     operations.cc:747-853). Process-set ops bypass fusion (the runtime fuses
     per the global mesh only, like the reference fuses per process set)."""
-    if process_set is not None and process_set.ranks is not None:
+    if (process_set is not None and process_set.ranks is not None) \
+            or _join_armed():
+        # Armed join mode: the fusion runtime's deferred flush cannot open
+        # the per-collective join round at enqueue time (the op set isn't
+        # final until flush), so async falls back to an immediate sync
+        # dispatch — correctness over overlap while the mode is on.
         return Handle(allreduce(tensor, op=op, prescale_factor=prescale_factor,
                                 postscale_factor=postscale_factor,
                                 process_set=process_set, name=name), name)
@@ -870,8 +1133,10 @@ def grouped_allreduce_async(tensors, op=Average, prescale_factor=1.0,
     """Async grouped allreduce through the fusion runtime: the group
     completes atomically and same-signature groups ride ONE fused bucket
     (reference: grouped enqueue + GroupTable, operations.cc:1480,
-    group_table.h). Process-set groups bypass fusion like allreduce_async."""
-    if process_set is not None and process_set.ranks is not None:
+    group_table.h). Process-set groups bypass fusion like allreduce_async;
+    so does armed join mode (see allreduce_async)."""
+    if (process_set is not None and process_set.ranks is not None) \
+            or _join_armed():
         out = grouped_allreduce(tensors, op=op,
                                 prescale_factor=prescale_factor,
                                 postscale_factor=postscale_factor,

@@ -128,6 +128,41 @@ def _in_graph(tensor):
     return not _tf().executing_eagerly()
 
 
+def _check_not_xla_jit(name):
+    """Fail at TRACE time inside ``tf.function(jit_compile=True)``.
+
+    The host-callback bridge cannot appear in an XLA-compiled tf.function
+    (PyFunc has no XLA kernel; TF's own failure is a late, opaque
+    tf2xla-conversion error, and gradients through the callback boundary
+    silently break in that mode). The reference solves this with XLA
+    CustomCalls (reference: tensorflow/xla_mpi_ops.cc:98-120); the
+    TPU-native answer is the in-jit API — run the collective inside YOUR
+    jitted program (horovod_tpu.ops.in_jit, docs/api.md). Detection walks
+    the raw tracing frames for the calling ``tf.function``'s
+    ``_jit_compile`` flag — there is no public trace-time marker (and no
+    ``inspect.stack()``: that materializes source context for hundreds of
+    TF tracing frames on every op build)."""
+    import sys
+    frame = sys._getframe(1)
+    hit = False
+    while frame is not None:
+        if getattr(frame.f_locals.get("self"), "_jit_compile", None):
+            hit = True
+            break
+        frame = frame.f_back
+    del frame
+    if hit:
+        raise NotImplementedError(
+            f"horovod_tpu.tensorflow.{name} cannot run inside "
+            "tf.function(jit_compile=True): the collective rides a "
+            "host callback (tf.numpy_function), which XLA cannot "
+            "compile and whose gradients break under jit_compile. "
+            "Either drop jit_compile=True for the horovod ops, or use "
+            "the in-jit API (horovod_tpu.ops.in_jit) inside a JAX "
+            "program — the TPU-native analog of the reference's XLA "
+            "CustomCall ops (xla_mpi_ops.cc).")
+
+
 def _graph_op(inputs, np_fn, name, out_dtypes=None, out_shapes=None,
               cast_back=None):
     """In-graph collective: a ``tf.numpy_function`` host callback around the
@@ -144,6 +179,7 @@ def _graph_op(inputs, np_fn, name, out_dtypes=None, out_shapes=None,
     only when outputs differ from inputs (e.g. alltoall's received splits).
     """
     tf = _tf()
+    _check_not_xla_jit(name)
     half = (tf.bfloat16, tf.float16)
     inputs = [tf.convert_to_tensor(t) for t in inputs]
     wire = [tf.cast(t, tf.float32) if t.dtype in half else t for t in inputs]
@@ -462,6 +498,7 @@ def check_num_rank_power_of_2(num_rank):
 
 def _query_op(read, name):
     tf = _tf()
+    _check_not_xla_jit(name)
     out = tf.numpy_function(lambda: np.int32(read()), [], tf.int32, name=name)
     out.set_shape(())
     return out

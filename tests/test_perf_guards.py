@@ -12,6 +12,7 @@ import re
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax.sharding import PartitionSpec as P
 
 N_PARAMS = 100
@@ -110,6 +111,33 @@ class TestEagerFusionCacheGuards:
         if stats_cold is not None and stats_warm is not None:
             assert stats_warm["hits"] > stats_cold["hits"], \
                 f"response cache not hit in steady state: {stats_warm}"
+
+    def test_uneven_alltoall_index_map_cached(self, hvd, rng):
+        """A repeated splits matrix (MoE steady state) must reuse the
+        cached pack-index map — no O(n²·block) host rebuild or re-upload
+        per step (reference negotiates splits once per response,
+        collective_operations.h:199-268)."""
+        import horovod_tpu as hvd_api
+        from horovod_tpu.ops import collective_ops as co
+
+        n = hvd_api.size()
+        splits = np.array([[(r + p) % 2 + 1 for p in range(n)]
+                           for r in range(n)])
+        m = int(splits.sum(axis=1).max())
+        send = np.stack([
+            np.pad(100.0 * r + np.arange(splits[r].sum()),
+                   (0, m - splits[r].sum()))
+            for r in range(n)]).astype(np.float32)
+        before = co._alltoall_pack_index.cache_info()
+        hvd_api.alltoall(send, splits=splits)
+        mid = co._alltoall_pack_index.cache_info()
+        for _ in range(3):
+            hvd_api.alltoall(send, splits=splits)
+        after = co._alltoall_pack_index.cache_info()
+        assert mid.misses == before.misses + 1
+        assert after.misses == mid.misses, \
+            "steady-state alltoall rebuilt its pack-index map"
+        assert after.hits >= mid.hits + 3
 
     def test_bucketing_stays_sublinear(self, hvd):
         """50 equal small tensors of one dtype must flush as a handful of

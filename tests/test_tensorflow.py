@@ -182,6 +182,36 @@ class TestTFGraphMode:
         g = step(tf.constant([3.0, 5.0]))
         np.testing.assert_allclose(g.numpy(), [3.0, 5.0], rtol=1e-5)
 
+    def test_jit_compile_rejected_at_trace_time(self):
+        """tf.function(jit_compile=True) + host-callback collectives is a
+        contract violation: XLA cannot compile PyFunc and TF's own failure
+        is a late opaque tf2xla error (the reference routes this through
+        XLA CustomCalls instead, xla_mpi_ops.cc:98-120). The bridge must
+        fail AT TRACE TIME with a message pointing at the in-jit API."""
+        @tf.function(jit_compile=True)
+        def bad(x):
+            return hvd_tf.allreduce(x, op=hvd_tf.Sum)
+
+        with pytest.raises(NotImplementedError,
+                           match=r"jit_compile.*in_jit"):
+            bad(tf.constant([1.0, 2.0]))
+
+        @tf.function(jit_compile=True)
+        def bad_query():
+            return hvd_tf.size_op()
+
+        with pytest.raises(NotImplementedError, match="jit_compile"):
+            bad_query()
+
+        # plain tf.function keeps working after the rejected traces
+        @tf.function
+        def good(x):
+            return hvd_tf.allreduce(x, op=hvd_tf.Sum)
+
+        out = good(tf.constant([1.0, 2.0]))
+        np.testing.assert_allclose(out.numpy(), [N * 1.0, N * 2.0],
+                                   rtol=1e-6)
+
 
 class TestDistributedGradientTape:
     def test_gradients_averaged(self):
