@@ -9,6 +9,13 @@ reductions return the host value for Average and value*size for Sum. The
 bridge is duck-typed over anything exposing ``asnumpy()`` (real
 ``mx.nd.NDArray``, or array-likes in environments without MXNet), so the
 frontend is fully exercisable without an MXNet install.
+
+Parity scope: API-compatible bridge ONLY. The reference additionally
+pushes collectives onto MXNet's async dependency engine
+(mxnet/mpi_ops.cc:638-705) so they order against NDArray reads without
+host syncs; that engine integration has no TPU analog (the data plane is
+XLA) and is explicitly out of scope — calls here are synchronous at the
+bridge boundary (docs/integrations.md "Parity scope").
 """
 
 import numpy as np
