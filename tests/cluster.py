@@ -1,0 +1,160 @@
+"""Session-scoped persistent multi-process clusters for the tier-2 suite.
+
+The reference amortizes process startup by running a whole test file under
+ONE ``horovodrun`` invocation (reference: .buildkite/gen-pipeline.sh:126-149);
+each test here used to pay its own ``run()`` — spawn + jax.distributed
+bootstrap + first-compile — per test (~15-25 s). A :class:`LocalCluster`
+spawns the worker processes once: each worker initializes horovod_tpu, then
+serves cloudpickled jobs from a spool directory until a stop sentinel.
+Tests sharing a (hosts, extra_env) topology reuse the same live cluster via
+the ``shared_cluster`` fixture in conftest.py.
+
+Job error semantics: a worker that raises reports the error and KEEPS
+serving (errors in these tests are deterministic and symmetric across
+ranks, raised before any asymmetric dispatch); the submitting test gets a
+RuntimeError. A wedged cluster surfaces as a TimeoutError on the next
+submit rather than a silent hang.
+"""
+
+import os
+import sys
+import tempfile
+import threading
+import time
+
+import cloudpickle
+
+# Worker processes can't import this module by name; ship the serve loop
+# (and anything else defined here) by value.
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+_POLL_S = 0.02
+
+
+def _serve_jobs(jobs_dir):
+    """Runs inside each spawned worker process (shipped by value)."""
+    import os
+    import time
+
+    import cloudpickle
+
+    import horovod_tpu as hvd
+
+    me = hvd.cross_rank()
+    k = 0
+    while True:
+        path = os.path.join(jobs_dir, f"job_{k}.pkl")
+        while not os.path.exists(path):
+            time.sleep(0.02)
+        with open(path, "rb") as f:
+            fn, args = cloudpickle.loads(f.read())
+        if fn is None:                       # stop sentinel
+            return ("stopped", k)
+        try:
+            res = ("ok", fn(*args))
+        except Exception as e:               # report, keep serving
+            # Exception, NOT BaseException: KeyboardInterrupt/SystemExit
+            # must still kill the worker or Ctrl-C can't stop a session.
+            res = ("err", f"{type(e).__name__}: {e}")
+        tmp = os.path.join(jobs_dir, f".res_{k}_{me}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(cloudpickle.dumps(res))
+        os.replace(tmp, os.path.join(jobs_dir, f"res_{k}_{me}.pkl"))
+        k += 1
+
+
+class LocalCluster:
+    def __init__(self, hosts, extra_env=None):
+        from horovod_tpu.runner import run
+
+        self.hosts = hosts
+        self.n_hosts = len(hosts.split(","))
+        self.dir = tempfile.mkdtemp(prefix="hvd_cluster_")
+        self._next_job = 0
+        self._lock = threading.Lock()
+        self._outcome = {}
+        self.dead = False       # set on timeout: submits must not reuse
+
+        def _launch():
+            try:
+                self._outcome["res"] = run(_serve_jobs, args=(self.dir,),
+                                           hosts=hosts, extra_env=extra_env)
+            except BaseException as e:
+                self._outcome["err"] = e
+
+        self._thread = threading.Thread(target=_launch, daemon=True,
+                                        name=f"cluster-{hosts}")
+        self._thread.start()
+
+    def run(self, fn, args=(), timeout=300):
+        """Dispatch ``fn(*args)`` to every worker; returns results ordered
+        by host (cross_rank) — the same contract as ``runner.run``."""
+        if self.dead:
+            raise RuntimeError(
+                f"cluster {self.hosts} is dead (a previous job timed out)")
+        with self._lock:
+            k = self._next_job
+            self._next_job += 1
+        tmp = os.path.join(self.dir, f".job_{k}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(cloudpickle.dumps((fn, tuple(args))))
+        os.replace(tmp, os.path.join(self.dir, f"job_{k}.pkl"))
+
+        out = [None] * self.n_hosts
+        remaining = set(range(self.n_hosts))
+        errors = []
+        deadline = time.time() + timeout
+        while remaining:
+            if "err" in self._outcome:
+                raise RuntimeError(
+                    f"cluster {self.hosts} died: {self._outcome['err']}")
+            if not self._thread.is_alive() and "res" not in self._outcome:
+                raise RuntimeError(f"cluster {self.hosts} launcher exited")
+            if time.time() > deadline:
+                # Mark dead so later tests fail fast (and the fixture
+                # respawns) instead of each burning its own full timeout.
+                self.dead = True
+                raise TimeoutError(
+                    f"cluster job {k}: no result from host(s) "
+                    f"{sorted(remaining)} within {timeout}s"
+                    + (f"; errors already reported: {errors}" if errors
+                       else ""))
+            for r in list(remaining):
+                p = os.path.join(self.dir, f"res_{k}_{r}.pkl")
+                if os.path.exists(p):
+                    with open(p, "rb") as f:
+                        status, val = cloudpickle.loads(f.read())
+                    remaining.discard(r)
+                    if status == "err":
+                        errors.append((r, val))
+                    else:
+                        out[r] = val
+            if remaining:
+                time.sleep(_POLL_S)
+        if errors:
+            raise RuntimeError(
+                f"cluster job {k} failed on host(s): {errors}")
+        return out
+
+    def stop(self, timeout=60):
+        """Send the stop sentinel, wait for the launch to wind down, and
+        remove the spool directory. Returns False (and reports) when the
+        workers did not exit — leaked processes on a wedged cluster."""
+        import shutil
+
+        with self._lock:
+            k = self._next_job
+            self._next_job += 1
+        tmp = os.path.join(self.dir, f".job_{k}.tmp")
+        with open(tmp, "wb") as f:
+            f.write(cloudpickle.dumps((None, ())))
+        os.replace(tmp, os.path.join(self.dir, f"job_{k}.pkl"))
+        self._thread.join(timeout)
+        if self._thread.is_alive():
+            print(f"# cluster {self.hosts}: workers did not exit within "
+                  f"{timeout}s after the stop sentinel — worker processes "
+                  f"may be leaked (spool kept at {self.dir})",
+                  file=sys.stderr)
+            return False
+        shutil.rmtree(self.dir, ignore_errors=True)
+        return True

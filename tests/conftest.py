@@ -48,6 +48,38 @@ def hvd():
     return hvd
 
 
+_clusters = {}
+
+
+@pytest.fixture(scope="session")
+def shared_cluster():
+    """Factory for persistent multi-process clusters keyed by
+    (hosts, extra_env): tests with the same topology share one spawn +
+    jax.distributed bootstrap (the reference's one-horovodrun-per-file
+    pattern, gen-pipeline.sh:126-149). Torn down at session end."""
+    from cluster import LocalCluster   # tests/ is on sys.path (rootdir)
+
+    def get(hosts, extra_env=None):
+        key = (hosts, tuple(sorted((extra_env or {}).items())))
+        c = _clusters.get(key)
+        if c is not None and c.dead:
+            # A timed-out cluster is wedged: respawn rather than letting
+            # every later same-topology test burn its own full timeout.
+            c.stop(timeout=5)
+            c = None
+        if c is None:
+            c = _clusters[key] = LocalCluster(hosts, extra_env=extra_env)
+        return c
+
+    yield get
+    for c in _clusters.values():
+        try:
+            c.stop()
+        except Exception:
+            pass
+    _clusters.clear()
+
+
 @pytest.fixture()
 def rng():
     return np.random.default_rng(42)

@@ -92,13 +92,13 @@ class TestElasticFailureInjection:
         total_steps = 8
 
         def train(script_path, total_steps):
-            import time
-
             import jax.numpy as jnp
             import numpy as np
 
             import horovod_tpu as hvd
             from horovod_tpu import elastic
+            from horovod_tpu.elastic.worker import (configured_version,
+                                                    wait_for_version_change)
 
             hvd.init()
             state = elastic.TpuState(trees={"w": jnp.zeros((2,))},
@@ -109,12 +109,17 @@ class TestElasticFailureInjection:
             def loop(state):
                 while state.step < total_steps:
                     if state.step == 3 and hvd.process_count() == 1:
-                        # Grow the membership, then give the driver time to
-                        # spawn the new host before the next commit checks.
+                        # Grow the membership, then gate on the driver's
+                        # OBSERVABLE — the membership version it publishes
+                        # after discovering the new host — instead of a
+                        # wall-clock sleep (which flaked on loaded hosts).
+                        known = configured_version()
                         with open(script_path, "w") as f:
                             f.write("#!/bin/sh\necho localhost:1\n"
                                     "echo 127.0.0.1:1\n")
-                        time.sleep(3)
+                        grown = wait_for_version_change(known, timeout=120)
+                        assert grown != known, \
+                            "driver never published the grown membership"
                     g = hvd.allreduce(jnp.ones((1, 2)), op=hvd.Sum)
                     state.w = state.w + g[0]
                     state.step += 1

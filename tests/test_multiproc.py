@@ -18,6 +18,11 @@ import pytest
 
 from horovod_tpu.runner import run
 
+# The dominant 2-process x 2-chip topology rides ONE persistent cluster
+# (see tests/cluster.py + the shared_cluster fixture): each test dispatches
+# its worker fn to the live, already-bootstrapped processes.
+H22 = "localhost:2,127.0.0.1:2"
+
 # Worker processes can't import this test module by name; ship the battery
 # functions by value instead.
 cloudpickle.register_pickle_by_value(sys.modules[__name__])
@@ -160,10 +165,9 @@ ALL_OPS = ["allreduce", "grouped_allreduce", "broadcast", "allgather",
 
 
 class TestMultiProcessCollectives:
-    def test_two_processes_two_slots_each(self):
+    def test_two_processes_two_slots_each(self, shared_cluster):
         """2 processes x 2 chips: every collective crosses the boundary."""
-        results = run(_battery, args=("t2",),
-                      hosts="localhost:2,127.0.0.1:2")
+        results = shared_cluster(H22).run(_battery, args=("t2",))
         assert len(results) == 2
         for (tag, rank, n, pc, passed), want_rank in zip(results, (0, 2)):
             assert (tag, rank, n, pc) == ("t2", want_rank, 4, 2)
@@ -336,8 +340,8 @@ def _frontend_battery():
 
 
 class TestMultiProcessFrontends:
-    def test_frontend_contracts_two_processes(self):
-        results = run(_frontend_battery, hosts="localhost:2,127.0.0.1:2")
+    def test_frontend_contracts_two_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_frontend_battery)
         want = ["torch_allreduce", "torch_alltoall_splits", "mxnet_ops",
                 "tf_ops"]
         assert [r[1] for r in results] == [want, want]
@@ -484,18 +488,18 @@ def _fsdp_step_worker():
 
 
 class TestMultiProcessTrainStep:
-    def test_dp_train_step_crosses_processes(self):
-        results = run(_train_step_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_dp_train_step_crosses_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_train_step_worker)
         assert len(results) == 2
         assert results[0] == results[1]  # identical replicated updates
 
-    def test_zero_train_step_crosses_processes(self):
-        results = run(_zero_step_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_zero_train_step_crosses_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_zero_step_worker)
         assert len(results) == 2
         assert results[0] == results[1]
 
-    def test_fsdp_train_step_crosses_processes(self):
-        results = run(_fsdp_step_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_fsdp_train_step_crosses_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_fsdp_step_worker)
         assert len(results) == 2
         assert results[0] == results[1]
 
@@ -529,8 +533,8 @@ def _composite_worker():
 
 
 class TestMultiProcessComposite:
-    def test_3d_mesh_spans_processes(self):
-        results = run(_composite_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_3d_mesh_spans_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_composite_worker)
         assert len(results) == 2
         assert results[0] == results[1]
 
@@ -613,13 +617,13 @@ def _sp_gpt_worker():
 
 
 class TestMultiProcessSequenceParallel:
-    def test_sp_gpt_crosses_processes(self):
-        results = run(_sp_gpt_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_sp_gpt_crosses_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_sp_gpt_worker)
         assert len(results) == 2
         assert results[0] == results[1]
 
-    def test_ring_attention_crosses_processes(self):
-        results = run(_ring_attention_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_ring_attention_crosses_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_ring_attention_worker)
         assert len(results) == 2
         assert results[0] == results[1]
 
@@ -654,8 +658,8 @@ def _torus_worker():
 
 
 class TestMultiProcessTorus:
-    def test_torus_allreduce_crosses_processes(self):
-        results = run(_torus_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_torus_allreduce_crosses_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_torus_worker)
         assert results == ["ok", "ok"]
 
 
@@ -697,8 +701,8 @@ def _ulysses_worker():
 
 
 class TestMultiProcessUlysses:
-    def test_ulysses_crosses_processes(self):
-        results = run(_ulysses_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_ulysses_crosses_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_ulysses_worker)
         assert results == ["ok", "ok"]
 
 
@@ -722,8 +726,8 @@ def _adasum_worker():
 
 
 class TestMultiProcessAdasum:
-    def test_adasum_crosses_processes(self):
-        results = run(_adasum_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_adasum_crosses_processes(self, shared_cluster):
+        results = shared_cluster(H22).run(_adasum_worker)
         assert results == ["ok", "ok"]
 
 
@@ -759,6 +763,6 @@ def _process_set_worker():
 
 
 class TestMultiProcessProcessSets:
-    def test_process_sets_cross_and_local(self):
-        results = run(_process_set_worker, hosts="localhost:2,127.0.0.1:2")
+    def test_process_sets_cross_and_local(self, shared_cluster):
+        results = shared_cluster(H22).run(_process_set_worker)
         assert results == ["ok", "ok"]
