@@ -42,12 +42,13 @@ class FusedHandle:
     bucket it lands in is flushed (reference analog: HandleManager int handle
     + per-entry callback, torch/handle_manager.h)."""
 
-    __slots__ = ("_runtime", "_result", "_error", "name")
+    __slots__ = ("_runtime", "_result", "_error", "_tid", "name")
 
-    def __init__(self, runtime, name):
+    def __init__(self, runtime, name, tid=None):
         self._runtime = runtime
         self._result = None
         self._error = None
+        self._tid = tid
         self.name = name
 
     def _set(self, value):
@@ -64,16 +65,21 @@ class FusedHandle:
             return True  # "complete": synchronize() will raise it
         if self._result is None:
             # Polling also acts as a cycle tick: a pending bucket is flushed
-            # the first time anyone asks about it.
-            self._runtime.flush_all()
+            # the first time anyone asks about it. poll() must stay
+            # NON-blocking (the overlap idiom is `while not h.poll():
+            # compute()`), so followers only apply already-published
+            # boundaries here — synchronize() is the blocking wait.
+            self._runtime.ensure_flushed(self._tid, block=False)
         if self._error is not None:
             return True
+        if self._result is None:
+            return False
         return all(o.is_ready() if hasattr(o, "is_ready") else True
                    for o in jax.tree_util.tree_leaves(self._result))
 
     def synchronize(self):
         if self._error is None and self._result is None:
-            self._runtime.flush_all()
+            self._runtime.ensure_flushed(self._tid)
         if self._error is not None:
             raise self._error
         jax.block_until_ready(self._result)
@@ -146,6 +152,7 @@ class FusionRuntime:
         self._last_enqueue = 0.0
         self._next_tid = 0
         self._flushed_groups = []  # group ids to deregister after flush
+        self._pending_groups = []  # follower: grouped tids awaiting replay
         # Native C++ scheduler for the per-step bookkeeping (bucket assembly,
         # LRU response-cache stats, group table); Python fallback below is
         # behavior-identical (reference: the C++ cycle loop/fusion manager,
@@ -183,17 +190,47 @@ class FusionRuntime:
         self._cycle_pause = False
         self._cycle_thread = None
         self._cycle_s = max(float(config.cycle_time_ms), 0.0) / 1000.0
-        # SINGLE-process only: the timer is rank-local wall clock. In a
-        # multi-process job two ranks could split the same enqueue burst at
-        # different points and issue mismatched collectives (the reference
-        # may fuse per-cycle only because its coordinator negotiates the
-        # ready set across ranks first, controller.cc:74). Multi-process
-        # flush triggers stay the SPMD-deterministic ones: threshold,
-        # poll/synchronize, flush_all.
-        if self._cycle_s > 0 and jax.process_count() <= 1:
+        # Multi-process flush coordination: a rank-local wall-clock timer
+        # could split the same enqueue burst at different points on
+        # different ranks and issue MISMATCHED collectives. The reference
+        # solves this with its coordinator: rank 0 decides every response
+        # set (controller.cc:74). Same design here — process 0 is the only
+        # process whose triggers (cycle timer, threshold) flush directly;
+        # each of its flushes publishes a BOUNDARY (the last tid flushed)
+        # through the jax.distributed KV, and every other process flushes
+        # exactly the published prefixes in order: its follower thread
+        # applies boundaries as they appear (restoring reduction/backward
+        # overlap for torch-hook training on multi-host), and
+        # poll/synchronize consume boundaries until the asked-for tensor is
+        # covered. SPMD guarantees every process enqueues the same tid
+        # sequence, so a prefix-by-tid is the same tensor set everywhere.
+        self._multi = jax.process_count() > 1
+        self._coord = jax.process_index() == 0
+        self._boundary_seq = 0      # publisher: next seq; follower: next
+        self._boundary_lock = threading.RLock()
+        self._flushed_tid = -1
+        self._publish_queue = None
+        self._publisher_thread = None
+        if not self._multi or self._coord:
+            if self._multi:
+                import queue
+                self._publish_queue = queue.SimpleQueue()
+                self._publisher_thread = threading.Thread(
+                    target=self._publisher_loop, daemon=True,
+                    name="hvd-fusion-publish")
+                self._publisher_thread.start()
+            if self._cycle_s > 0:
+                self._cycle_thread = threading.Thread(
+                    target=self._cycle_loop, daemon=True,
+                    name="hvd-fusion-cycle")
+                self._cycle_thread.start()
+        else:
+            # Followers always run the boundary-consumer thread (even with
+            # the cycle timer disabled: threshold flushes on process 0
+            # publish boundaries that must be applied for overlap).
             self._cycle_thread = threading.Thread(
-                target=self._cycle_loop, daemon=True,
-                name="hvd-fusion-cycle")
+                target=self._follower_loop, daemon=True,
+                name="hvd-fusion-follower")
             self._cycle_thread.start()
 
     def _cycle_loop(self):
@@ -223,6 +260,158 @@ class FusionRuntime:
                     # likewise outlives op failures).
                     pass
 
+    # ---- multi-process flush boundaries (coordinator/follower) ----------
+
+    @staticmethod
+    def _kv_client():
+        from jax._src import distributed
+        return distributed.global_state.client
+
+    @staticmethod
+    def _boundary_key(seq):
+        from horovod_tpu.common import negotiation
+        return f"hvd/fusion/e{negotiation._epoch}/b{seq}"
+
+    # Boundary keys older than this many flushes are GC'd. Unlike
+    # negotiation.exchange's lag-2 (safe there because exchange is a
+    # blocking all-rank rendezvous), boundary publishing is one-way — a
+    # follower that lags further than this would find its next key deleted
+    # and stall. The margin is sized so that any follower actually that far
+    # behind has ALREADY tripped the 120s SPMD-divergence guard in
+    # _apply_ready_boundaries (its consumer thread applies each boundary
+    # within a 300ms window; pause does not suspend it).
+    _BOUNDARY_GC_LAG = 4096
+
+    def _publisher_loop(self):
+        """Coordinator: perform the boundary KV RPCs off the runtime lock
+        (a flush would otherwise hold self._lock — which every gradient-
+        hook enqueue needs — across two control-plane round-trips). The
+        single thread preserves publish order."""
+        while True:
+            item = self._publish_queue.get()
+            if item is None:
+                return
+            seq, last_tid = item
+            try:
+                client = self._kv_client()
+                if client is None:
+                    continue
+                client.key_value_set(self._boundary_key(seq),
+                                     str(int(last_tid)))
+                if seq >= self._BOUNDARY_GC_LAG:
+                    try:
+                        client.key_value_delete(
+                            self._boundary_key(seq - self._BOUNDARY_GC_LAG))
+                    except Exception:
+                        pass
+            except Exception:  # noqa: BLE001 — keep publishing
+                pass
+
+    def _publish_boundary(self, last_tid):
+        """Coordinator: record that tids <= last_tid are flushed, so
+        followers flush the identical prefix. Called under self._lock —
+        only the seq assignment happens here; the RPCs run on the
+        publisher thread."""
+        seq = self._boundary_seq
+        self._boundary_seq += 1
+        self._publish_queue.put((seq, last_tid))
+
+    def _apply_ready_boundaries(self, block_ms):
+        """Follower: consume and apply published boundaries in order;
+        waits up to ``block_ms`` for the FIRST one (later ones drain with a
+        minimal wait). Returns True when at least one was applied. The
+        blocking KV get runs OUTSIDE the locks (concurrent consumers may
+        fetch the same key; the seq re-check under the lock dedupes) so a
+        long blocking window never delays the sync path."""
+        applied = False
+        while True:
+            client = self._kv_client()
+            if client is None:
+                return applied
+            with self._boundary_lock:
+                seq = self._boundary_seq
+            try:
+                raw = client.blocking_key_value_get(
+                    self._boundary_key(seq), max(int(block_ms), 1))
+            except Exception:
+                return applied              # no new boundary yet
+            last_tid = int(raw)
+            with self._boundary_lock:
+                if self._boundary_seq != seq:
+                    block_ms = 1            # another consumer took it
+                    continue
+                # The local enqueue stream may lag the coordinator's:
+                # applying early would flush a SHORTER prefix and misalign
+                # every later collective. Wait for tids <= last_tid (safe:
+                # boundary tids are monotonic and consumed in order, so a
+                # sync-path consumer never waits here for tensors the main
+                # thread hasn't submitted yet — see ensure_flushed).
+                deadline = time.perf_counter() + 120.0
+                while True:
+                    with self._lock:
+                        if self._next_tid > last_tid:
+                            self._boundary_seq += 1
+                            self._flush_locked(up_to=last_tid)
+                            break
+                    if time.perf_counter() > deadline:
+                        raise RuntimeError(
+                            f"fusion boundary {last_tid} published by the "
+                            f"coordinator but this process only enqueued "
+                            f"up to tid {self._next_tid - 1} after 120s — "
+                            f"SPMD enqueue streams diverged")
+                    time.sleep(0.0005)
+            applied = True
+            block_ms = 1
+
+    def _follower_loop(self):
+        # One LONG-blocking KV get per iteration, not a tight poll: the
+        # coordination service blocks server-side until the boundary key
+        # appears (or the window expires), so an idle follower costs a few
+        # RPCs per second while a published boundary is applied within the
+        # window immediately. A cycle_s-paced tight loop here measurably
+        # slowed the whole control plane (it shares the coordination
+        # service with collective bootstrap).
+        # NOTE: _cycle_pause is deliberately ignored here. The pause
+        # contract suspends time-triggered flush DECISIONS — those are the
+        # coordinator's; a follower only mirrors decisions already made,
+        # and suspending that would let coordinator threshold flushes go
+        # unapplied (unbounded pending growth, stalled collectives).
+        while not self._cycle_stop.wait(0.001):
+            try:
+                self._apply_ready_boundaries(block_ms=300)
+            except Exception:  # noqa: BLE001 — must not kill the thread
+                pass
+
+    def ensure_flushed(self, tid=None, block=True):
+        """Make sure the bucket containing ``tid`` has been dispatched.
+        Coordinator / single process: flush everything (the classic
+        poll-as-cycle-tick). Follower: consume coordinator boundaries until
+        the tid is covered — flushing locally on our own trigger would
+        split the burst differently from the coordinator. ``block=False``
+        (the poll() path) applies only already-published boundaries and
+        returns without waiting."""
+        if not self._multi or self._coord:
+            self.flush_all()
+            return
+        if tid is None:
+            tid = self._next_tid - 1
+        if not block:
+            self._apply_ready_boundaries(block_ms=1)
+            return
+        deadline = time.perf_counter() + 120.0
+        while True:
+            with self._lock:
+                if tid <= self._flushed_tid:
+                    return
+            self._apply_ready_boundaries(block_ms=1000)
+            if time.perf_counter() > deadline:
+                from horovod_tpu.common.exceptions import \
+                    HorovodInternalError
+                raise HorovodInternalError(
+                    f"no fusion flush boundary covering tid {tid} arrived "
+                    f"from the coordinator within 120s — did process 0 "
+                    f"dispatch the same async collectives?")
+
     def cycle_paused(self):
         """Context manager: suspend time-triggered flushes (threshold and
         explicit flushes still apply). Lets tests (and bulk submitters that
@@ -248,16 +437,23 @@ class FusionRuntime:
         return (ReduceOp(op), float(prescale), float(postscale), str(dt))
 
     def enqueue_allreduce(self, tensor, op, prescale, postscale, name=None):
-        handle = FusedHandle(self, name)
         with self._lock:
             tid = self._next_tid
             self._next_tid += 1
+            handle = FusedHandle(self, name, tid=tid)
             self._pending.append((tid, tensor, ReduceOp(op), float(prescale),
                                   float(postscale), handle))
             self._pending_bytes += tensor.nbytes
             self._last_enqueue = time.perf_counter()
             if self._stall_inspector is not None:
                 self._stall_inspector.record_enqueue(name or "tensor")
+            if self._multi and not self._coord:
+                # Followers never trigger flushes: the coordinator's
+                # threshold fires at the same enqueue (same byte stream)
+                # and publishes the boundary this process will apply. Its
+                # native scheduler is fed at boundary time (replaying the
+                # exact prefix keeps bucket assembly identical).
+                return handle
             if self._native is not None:
                 key = self._bucket_key(tensor, op, prescale, postscale)
                 if self._native.enqueue(tid, hash(key), tensor.nbytes):
@@ -275,30 +471,40 @@ class FusionRuntime:
         bucket regardless of the threshold — the reference fuses only
         same-dtype responses, so mixed-signature groups are enqueued
         individually (still atomic: one flush covers all pending buckets)."""
-        handles = [FusedHandle(self, f"{name}.{i}" if name else None)
-                   for i in range(len(tensors))]
         op = ReduceOp(op)
         with self._lock:
             tids = list(range(self._next_tid,
                               self._next_tid + len(tensors)))
             self._next_tid += len(tensors)
+            handles = [FusedHandle(self, f"{name}.{i}" if name else None,
+                                   tid=tid)
+                       for i, tid in enumerate(tids)]
             keys = [self._bucket_key(t, op, prescale, postscale)
                     for t in tensors]
+            follower = self._multi and not self._coord
             if self._native is not None and len(set(keys)) == 1 \
                     and len(tensors) > 1:
-                self._flushed_groups.append(
-                    self._native.register_group(tids))
+                if follower:
+                    # registered with the native table at boundary-replay
+                    # time, in the same order the coordinator did
+                    self._pending_groups.append(list(tids))
+                else:
+                    self._flushed_groups.append(
+                        self._native.register_group(tids))
             flush = False
             for tid, t, key, h in zip(tids, tensors, keys, handles):
                 self._pending.append((tid, t, op, float(prescale),
                                       float(postscale), h))
                 self._pending_bytes += t.nbytes
                 self._last_enqueue = time.perf_counter()
-                if self._native is not None:
+                if self._native is not None and not follower:
                     flush |= self._native.enqueue(tid, hash(key), t.nbytes)
             if self._stall_inspector is not None:
                 self._stall_inspector.record_enqueue(name or "grouped")
-            if self._native is not None:
+            if follower:
+                # see enqueue_allreduce: boundaries drive follower flushes
+                pass
+            elif self._native is not None:
                 if flush:
                     self._flush_locked()
             elif self._pending_bytes >= self.threshold:
@@ -306,6 +512,11 @@ class FusionRuntime:
         return GroupedFusedHandle(handles, name)
 
     def flush_all(self):
+        if self._multi and not self._coord:
+            # Followers flush only coordinator-published prefixes; a local
+            # flush would split the burst differently from process 0.
+            self._apply_ready_boundaries(block_ms=1)
+            return
         with self._lock:
             self._flush_locked()
 
@@ -315,14 +526,44 @@ class FusionRuntime:
         if self._cycle_thread is not None:
             self._cycle_thread.join(timeout=2)
             self._cycle_thread = None
+        if self._multi and not self._coord:
+            # Shutdown is SPMD too: the coordinator's shutdown flush
+            # publishes the final boundary — drain it (bounded), then fail
+            # any handle still unresolved rather than dispatching a
+            # mismatched local flush.
+            deadline = time.perf_counter() + 30.0
+            while time.perf_counter() < deadline:
+                with self._lock:
+                    if not self._pending:
+                        break
+                try:
+                    self._apply_ready_boundaries(block_ms=500)
+                except Exception:  # noqa: BLE001
+                    break
         with self._lock:
+            if self._multi and not self._coord:
+                leftover, self._pending = self._pending, []
+                self._pending_bytes = 0
+                for _, _, _, _, _, h in leftover:
+                    from horovod_tpu.common.exceptions import \
+                        HorovodInternalError
+                    h._set_error(HorovodInternalError(
+                        "fusion shutdown: no coordinator boundary covered "
+                        "this tensor"))
+            else:
+                self._flush_locked()
             # Close the native scheduler under the same lock enqueue holds,
             # so no thread can be inside hvd_sched_enqueue when the C++
             # object is destroyed.
-            self._flush_locked()
             if self._native is not None:
                 self._native.close()
                 self._native = None
+        if self._publisher_thread is not None:
+            # Sentinel AFTER the final flush so its boundary reaches the
+            # followers; the join bounds shutdown.
+            self._publish_queue.put(None)
+            self._publisher_thread.join(timeout=10)
+            self._publisher_thread = None
         if self._stall_inspector is not None:
             self._stall_inspector.stop()
 
@@ -334,11 +575,41 @@ class FusionRuntime:
                 return None
             return self._native.cache_stats()
 
-    def _flush_locked(self):
+    def _flush_locked(self, up_to=None):
+        """Dispatch pending tensors. ``up_to`` (follower boundary replay):
+        flush only the prefix with tid <= up_to — the exact set the
+        coordinator flushed when it published that boundary."""
         if not self._pending:
             return
-        pending, self._pending = self._pending, []
-        flushed_bytes, self._pending_bytes = self._pending_bytes, 0
+        if up_to is None:
+            pending, self._pending = self._pending, []
+            flushed_bytes, self._pending_bytes = self._pending_bytes, 0
+        else:
+            pending = [p for p in self._pending if p[0] <= up_to]
+            if not pending:
+                self._flushed_tid = max(self._flushed_tid, int(up_to))
+                return
+            self._pending = [p for p in self._pending if p[0] > up_to]
+            flushed_bytes = sum(p[1].nbytes for p in pending)
+            self._pending_bytes -= flushed_bytes
+        if self._multi and not self._coord and self._native is not None:
+            # Replay the prefix into the native scheduler now (enqueue-time
+            # feeding would leave it holding tids beyond the boundary and
+            # its bucket assembly would diverge from the coordinator's).
+            flushed = {p[0] for p in pending}
+            for gtids in [g for g in self._pending_groups
+                          if g[0] in flushed]:
+                self._flushed_groups.append(
+                    self._native.register_group(gtids))
+            self._pending_groups = [g for g in self._pending_groups
+                                    if g[0] not in flushed]
+            for tid, t, op, pre, post, _ in pending:
+                self._native.enqueue(
+                    tid, hash(self._bucket_key(t, op, pre, post)), t.nbytes)
+        self._flushed_tid = max(self._flushed_tid, pending[-1][0])
+        if self._multi and self._coord:
+            # Tell the followers to flush this exact prefix.
+            self._publish_boundary(pending[-1][0])
         if self._stall_inspector is not None:
             self._stall_inspector.record_flush()
         if self._parameter_manager is not None:
