@@ -2,19 +2,27 @@
 
 Reference: horovod/common/parameter_manager.cc/.h (544+257 LoC) — tunes the
 fusion threshold and cycle time with Bayesian optimization (log2-scaled
-NumericParameter, scored by bytes-reduced-per-second), plus categorical knobs,
-over warmup/sample windows; winning parameters are logged and frozen after
-``bayes_opt_max_samples``.
+NumericParameter, scored by bytes-reduced-per-second), PLUS categorical
+knobs (CategoricalParameter: hierarchical allreduce/allgather, cache
+toggles) swept per category, over warmup/sample windows; winning parameters
+are logged and frozen after ``bayes_opt_max_samples``.
 
-TPU adaptation: the knobs that still exist are the eager fusion runtime's
+TPU adaptation: the numeric knobs are the eager fusion runtime's
 ``fusion_threshold`` (bucket bytes) and its debounced ``cycle_time_ms``
 (flush quiescence window) — tuned JOINTLY, like the reference's
-threshold+cycle pair; jitted steps have nothing to tune. Scoring is
-identical: bytes per second of reduced data over a sample window. The
-manager is wired into :class:`horovod_tpu.ops.fusion.FusionRuntime`, which
-reports each flush.
+threshold+cycle pair; jitted steps have nothing to tune. The categorical
+knobs are the allreduce STRATEGY (flat | hierarchical | torus — the 2-level
+schemes of parallel/strategies.py over the cross×local mesh) and, when the
+user already opted into a 16-bit wire, the WIRE DTYPE (float16 |
+bfloat16). Categories are swept round-robin for ``CAT_PASSES`` windows
+each after warmup (the reference's categorical phase), the best mean
+score wins, then the numeric BO runs. Scoring is identical throughout:
+bytes per second of reduced data over a sample window. The manager is
+wired into :class:`horovod_tpu.ops.fusion.FusionRuntime`, which reports
+each flush and applies returned knob updates.
 """
 
+import itertools
 import time
 
 import numpy as np
@@ -31,11 +39,14 @@ class ParameterManager:
     # 0.25 ms .. 32 ms (reference: cycle time 1..25 ms).
     _LOG2_THR = (20.0, 28.0)
     _LOG2_CYC = (-2.0, 5.0)
+    # sample windows per categorical combo (reference sweeps each category
+    # value across its warmup/sample machinery)
+    CAT_PASSES = 2
 
     def __init__(self, warmup_samples=3, steps_per_sample=10,
                  bayes_opt_max_samples=20, gaussian_process_noise=0.8,
                  log_file=None, initial_threshold=64 * 1024 * 1024,
-                 initial_cycle_ms=1.0):
+                 initial_cycle_ms=1.0, categorical_knobs=None):
         self._warmup_remaining = warmup_samples
         self._steps_per_sample = steps_per_sample
         self._max_samples = bayes_opt_max_samples
@@ -49,6 +60,25 @@ class ParameterManager:
             np.clip(np.log2(max(initial_threshold, 1)), *self._LOG2_THR),
             np.clip(np.log2(max(initial_cycle_ms, 1e-3)), *self._LOG2_CYC),
         ])
+        # categorical phase state: knob name -> ordered choices (first =
+        # the configured/initial value, which is also the tie-break winner)
+        self._cat_knobs = {k: list(v)
+                           for k, v in (categorical_knobs or {}).items()
+                           if len(v) > 1}
+        names = sorted(self._cat_knobs)
+        combos = list(itertools.product(*(self._cat_knobs[n]
+                                          for n in names))) if names else []
+        self._cat_names = names
+        self._cat_queue = [c for c in combos
+                           for _ in range(self.CAT_PASSES)][1:]
+        self._cat_current = combos[0] if combos else ()
+        self._cat_scores = {c: [] for c in combos}
+        self._cat_done = not combos
+        # First window on a new combo includes the combo's program compile
+        # (strategy/wire_dtype are in the fused-program cache key) — its
+        # score would bury every non-incumbent combo. Discard it.
+        self._cat_warmed = None
+        self._window_invalid = False
         self._samples = 0
         self._tuning = True
         self._window_bytes = 0
@@ -58,7 +88,7 @@ class ParameterManager:
         if self._log_file:
             with open(self._log_file, "w") as f:
                 f.write("sample,fusion_threshold,cycle_time_ms,"
-                        "score_bytes_per_sec\n")
+                        "categoricals,score_bytes_per_sec\n")
 
     @property
     def fusion_threshold(self):
@@ -69,12 +99,26 @@ class ParameterManager:
         return float(2 ** self._current[1])
 
     @property
+    def categoricals(self):
+        """Current categorical knob values as ``{name: choice}``."""
+        return dict(zip(self._cat_names, self._cat_current))
+
+    @property
     def tuning(self):
         return self._tuning
 
+    def invalidate_window(self):
+        """The runtime could not apply the configured knobs to the current
+        window (e.g. a join mask or non-linear op forced the flat
+        strategy): its score would misattribute flat timings to the
+        configured combo — discard it when the window closes."""
+        self._window_invalid = True
+
     def record(self, nbytes):
         """Report one flush of ``nbytes`` reduced bytes
-        (reference: ParameterManager::Update per-tensor byte accounting)."""
+        (reference: ParameterManager::Update per-tensor byte accounting).
+        Returns ``(fusion_threshold, cycle_time_ms, categoricals)`` when a
+        sample window closed (the caller applies all three), else None."""
         if not self._tuning:
             return None
         self._window_bytes += nbytes
@@ -83,17 +127,56 @@ class ParameterManager:
             return None
         return self._end_sample()
 
+    def _knobs(self):
+        return self.fusion_threshold, self.cycle_time_ms, self.categoricals
+
     def _end_sample(self):
         elapsed = max(time.perf_counter() - self._window_start, 1e-9)
         score = self._window_bytes / elapsed
         self._window_bytes = 0
         self._window_steps = 0
         self._window_start = time.perf_counter()
+        invalid, self._window_invalid = self._window_invalid, False
 
         if self._warmup_remaining > 0:
             # discard warmup windows (reference: warmup_samples)
             self._warmup_remaining -= 1
-            return self.fusion_threshold, self.cycle_time_ms
+            return self._knobs()
+        if invalid:
+            # knobs weren't actually in effect for this window — measuring
+            # it would poison whichever phase is active
+            return self._knobs()
+
+        if not self._cat_done:
+            # Categorical sweep phase (reference: CategoricalParameter
+            # round-robin before the numeric tuner). Numerics stay at their
+            # initial values so category scores aren't confounded.
+            if self._cat_warmed != self._cat_current:
+                # per-combo compile warmup: discard the first window after
+                # a switch, stay on the combo for its measured passes
+                self._cat_warmed = self._cat_current
+                return self._knobs()
+            self._cat_scores[self._cat_current].append(score)
+            if self._log_file:
+                with open(self._log_file, "a") as f:
+                    f.write(f"cat,{self.fusion_threshold},"
+                            f"{self.cycle_time_ms:.3f},"
+                            f"{'|'.join(map(str, self._cat_current))},"
+                            f"{score:.1f}\n")
+            if self._cat_queue:
+                self._cat_current = self._cat_queue.pop(0)
+            else:
+                # every combo measured CAT_PASSES times: best mean wins
+                # (ties: earliest combo, i.e. the configured default)
+                self._cat_current = max(
+                    self._cat_scores,
+                    key=lambda c: (float(np.mean(self._cat_scores[c])),
+                                   -list(self._cat_scores).index(c)))
+                self._cat_done = True
+                hvd_logging.info(
+                    "autotune categorical phase done: %s",
+                    self.categoricals)
+            return self._knobs()
 
         self._samples += 1
         self._bo.add_sample(self._current, score)
@@ -102,7 +185,9 @@ class ParameterManager:
         if self._log_file:
             with open(self._log_file, "a") as f:
                 f.write(f"{self._samples},{self.fusion_threshold},"
-                        f"{self.cycle_time_ms:.3f},{score:.1f}\n")
+                        f"{self.cycle_time_ms:.3f},"
+                        f"{'|'.join(map(str, self._cat_current))},"
+                        f"{score:.1f}\n")
 
         if self._samples >= self._max_samples:
             # freeze at the best observed configuration
@@ -110,8 +195,8 @@ class ParameterManager:
             self._tuning = False
             hvd_logging.info(
                 "autotune converged: fusion_threshold=%d cycle=%.2fms "
-                "(%.1f MB/s)", self.fusion_threshold, self.cycle_time_ms,
-                self._best[1] / 1e6)
+                "categoricals=%s (%.1f MB/s)", self.fusion_threshold,
+                self.cycle_time_ms, self.categoricals, self._best[1] / 1e6)
         else:
             self._current = np.asarray(self._bo.next_sample(), float)
-        return self.fusion_threshold, self.cycle_time_ms
+        return self._knobs()

@@ -88,12 +88,43 @@ class FusedHandle:
 
 @functools.lru_cache(maxsize=2048)
 def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
-                   wire_dtype, active_mask=None):
+                   wire_dtype, active_mask=None, strategy="flat"):
     """One flat-buffer reduction for a whole bucket. ``active_mask`` carries
     join state so async collectives honor the same joined-rank exclusion as
-    the sync path (reference: joined_size accounting)."""
+    the sync path (reference: joined_size accounting). ``strategy``:
+    "flat" runs the 1-D psum; "hierarchical"/"torus" run the 2-level
+    schemes of parallel/strategies.py — ``mesh`` must then be the
+    (cross, local) mesh2d (the autotuner's categorical knob; reference:
+    HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE)."""
     sizes = [int(np.prod(s[1:])) for s in shapes]
     active = None if active_mask is None else np.array(active_mask)
+    if strategy != "flat":
+        from horovod_tpu.common.topology import CROSS_AXIS, LOCAL_AXIS
+        from horovod_tpu.parallel.strategies import (allreduce_hierarchical,
+                                                     allreduce_torus)
+        spec = P((CROSS_AXIS, LOCAL_AXIS))
+    else:
+        spec = P(HVD_AXIS)
+
+    def reduce_buf(buf):
+        # (flat_len,) chip-local buffer -> reduced buffer
+        if strategy == "torus":
+            out = allreduce_torus(
+                buf * jnp.asarray(prescale, buf.dtype) if prescale != 1.0
+                else buf, average=(op == ReduceOp.AVERAGE))
+        elif strategy == "hierarchical":
+            out = allreduce_hierarchical(
+                buf * jnp.asarray(prescale, buf.dtype) if prescale != 1.0
+                else buf, average=(op == ReduceOp.AVERAGE))
+        else:
+            return _reduce_shard(buf[None], op, n, prescale, postscale,
+                                 HVD_AXIS, active)[0]
+        if postscale != 1.0:
+            out = out * jnp.asarray(postscale, out.dtype)
+        # the cross psum leaves the value cross-invariant; the stacked
+        # out_specs need it typed varying over both mesh axes
+        from horovod_tpu.ops.in_jit import mark_varying
+        return mark_varying(mark_varying(out, CROSS_AXIS), LOCAL_AXIS)
 
     def body(*xs):
         # xs: local slices (1, ...). Flatten each, concat per the bucket
@@ -114,8 +145,7 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
                 f = f.astype(wire_dtype)
             flats.append(f)
         buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-        buf = _reduce_shard(buf[None], op, n, prescale, postscale, HVD_AXIS,
-                            active)[0]
+        buf = reduce_buf(buf)
         outs, off = [], 0
         for x, sz in zip(xs, sizes):
             piece = lax.slice_in_dim(buf, off, off + sz).astype(x.dtype)
@@ -124,8 +154,8 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
         return tuple(outs)
 
     f = jax.shard_map(body, mesh=mesh,
-                      in_specs=tuple(P(HVD_AXIS) for _ in shapes),
-                      out_specs=tuple(P(HVD_AXIS) for _ in shapes))
+                      in_specs=tuple(spec for _ in shapes),
+                      out_specs=tuple(spec for _ in shapes))
     return jax.jit(f)
 
 
@@ -165,9 +195,33 @@ class FusionRuntime:
                     self.threshold, config.cache_capacity)
         except Exception:
             self._native = None
+        # Allreduce strategy for the fused buckets (a tunable categorical;
+        # the config knobs give the initial value — reference common.h:130-132)
+        self.strategy = ("torus" if config.torus_allreduce
+                         else "hierarchical" if config.hierarchical_allreduce
+                         else "flat")
+        self._multi = jax.process_count() > 1
+        self._coord = jax.process_index() == 0
         self._parameter_manager = None
-        if config.autotune:
+        # Autotune decisions are the COORDINATOR's alone under multi-process
+        # launches: strategy/wire_dtype change the compiled program, and
+        # per-process managers scoring with local wall clocks could freeze
+        # different winners — mismatched collectives. Followers adopt the
+        # knobs published with each flush boundary instead.
+        if config.autotune and (not self._multi or self._coord):
             from horovod_tpu.autotune import ParameterManager
+            # Categorical knobs (reference: CategoricalParameter sweep,
+            # parameter_manager.h:42-252): the 2-level allreduce strategy,
+            # and — only when the user already opted into a 16-bit wire —
+            # which 16-bit dtype (never tuned from full precision: that is
+            # a precision policy, not a speed knob).
+            cats = {"strategy": [self.strategy] + [
+                s for s in ("flat", "hierarchical", "torus")
+                if s != self.strategy]}
+            if config.wire_dtype:
+                other = ("bfloat16" if config.wire_dtype == "float16"
+                         else "float16")
+                cats["wire_dtype"] = [config.wire_dtype, other]
             self._parameter_manager = ParameterManager(
                 warmup_samples=config.autotune_warmup_samples,
                 steps_per_sample=config.autotune_steps_per_sample,
@@ -175,7 +229,8 @@ class FusionRuntime:
                 gaussian_process_noise=config.autotune_gaussian_process_noise,
                 log_file=config.autotune_log_file or None,
                 initial_threshold=config.fusion_threshold,
-                initial_cycle_ms=config.cycle_time_ms)
+                initial_cycle_ms=config.cycle_time_ms,
+                categorical_knobs=cats)
         self._stall_inspector = None
         if not config.stall_check_disable:
             from horovod_tpu.ops.stall_inspector import StallInspector
@@ -204,8 +259,6 @@ class FusionRuntime:
         # poll/synchronize consume boundaries until the asked-for tensor is
         # covered. SPMD guarantees every process enqueues the same tid
         # sequence, so a prefix-by-tid is the same tensor set everywhere.
-        self._multi = jax.process_count() > 1
-        self._coord = jax.process_index() == 0
         self._boundary_seq = 0      # publisher: next seq; follower: next
         self._boundary_lock = threading.RLock()
         self._flushed_tid = -1
@@ -291,13 +344,12 @@ class FusionRuntime:
             item = self._publish_queue.get()
             if item is None:
                 return
-            seq, last_tid = item
+            seq, payload = item
             try:
                 client = self._kv_client()
                 if client is None:
                     continue
-                client.key_value_set(self._boundary_key(seq),
-                                     str(int(last_tid)))
+                client.key_value_set(self._boundary_key(seq), payload)
                 if seq >= self._BOUNDARY_GC_LAG:
                     try:
                         client.key_value_delete(
@@ -308,13 +360,17 @@ class FusionRuntime:
                 pass
 
     def _publish_boundary(self, last_tid):
-        """Coordinator: record that tids <= last_tid are flushed, so
-        followers flush the identical prefix. Called under self._lock —
-        only the seq assignment happens here; the RPCs run on the
-        publisher thread."""
+        """Coordinator: record that tids <= last_tid are flushed — and the
+        program-shaping knobs (strategy, wire dtype) in effect for that
+        flush, so followers build the identical programs for the identical
+        prefix. Called under self._lock — only the seq assignment happens
+        here; the RPCs run on the publisher thread."""
+        import json as _json
         seq = self._boundary_seq
         self._boundary_seq += 1
-        self._publish_queue.put((seq, last_tid))
+        wire = jnp.dtype(self.wire_dtype).name if self.wire_dtype else ""
+        self._publish_queue.put((seq, _json.dumps(
+            {"t": int(last_tid), "s": self.strategy, "w": wire})))
 
     def _apply_ready_boundaries(self, block_ms):
         """Follower: consume and apply published boundaries in order;
@@ -335,11 +391,18 @@ class FusionRuntime:
                     self._boundary_key(seq), max(int(block_ms), 1))
             except Exception:
                 return applied              # no new boundary yet
-            last_tid = int(raw)
+            import json as _json
+            payload = _json.loads(raw)
+            last_tid = int(payload["t"])
             with self._boundary_lock:
                 if self._boundary_seq != seq:
                     block_ms = 1            # another consumer took it
                     continue
+                # Adopt the coordinator's program-shaping knobs for this
+                # prefix (its autotuner is the only decision maker).
+                self.strategy = payload.get("s", self.strategy)
+                wire = payload.get("w", "")
+                self.wire_dtype = jnp.dtype(wire).type if wire else None
                 # The local enqueue stream may lag the coordinator's:
                 # applying early would flush a SHORTER prefix and misalign
                 # every later collective. Wait for tids <= last_tid (safe:
@@ -607,17 +670,25 @@ class FusionRuntime:
                 self._native.enqueue(
                     tid, hash(self._bucket_key(t, op, pre, post)), t.nbytes)
         self._flushed_tid = max(self._flushed_tid, pending[-1][0])
+        if self._parameter_manager is not None:
+            # BEFORE the boundary publish: knob updates shape THIS flush's
+            # programs, and the boundary must carry the values the
+            # followers need to build the same programs.
+            update = self._parameter_manager.record(flushed_bytes)
+            if update is not None:
+                self.threshold, new_cycle_ms, cats = update
+                # Consumed live by the cycle thread on its next wake.
+                self._cycle_s = max(new_cycle_ms, 1e-3) / 1000.0
+                if "strategy" in cats:
+                    self.strategy = cats["strategy"]
+                if "wire_dtype" in cats:
+                    self.wire_dtype = jnp.dtype(cats["wire_dtype"]).type
         if self._multi and self._coord:
-            # Tell the followers to flush this exact prefix.
+            # Tell the followers to flush this exact prefix (with the
+            # program-shaping knobs in effect for it).
             self._publish_boundary(pending[-1][0])
         if self._stall_inspector is not None:
             self._stall_inspector.record_flush()
-        if self._parameter_manager is not None:
-            update = self._parameter_manager.record(flushed_bytes)
-            if update is not None:
-                self.threshold, new_cycle_ms = update
-                # Consumed live by the cycle thread on its next wake.
-                self._cycle_s = max(new_cycle_ms, 1e-3) / 1000.0
         topo = basics.topology()
         mesh = topo.mesh
         n = topo.size
@@ -644,6 +715,7 @@ class FusionRuntime:
         from horovod_tpu.common.process_sets import global_process_set
         from horovod_tpu.ops.collective_ops import _active_mask
         active_mask = _active_mask(global_process_set)
+        downgraded = False
         for (op, pre, post, _), items in buckets.items():
             tensors = [i[0] for i in items]
             tensors = _prepare(tensors, mesh, n, "fused_allreduce")
@@ -655,8 +727,19 @@ class FusionRuntime:
                 # response cache and exposes hit-rate stats (cache_stats()).
                 self._native.cache_lookup(
                     hash((op, pre, post, shapes, dtypes)))
-            prog = _fused_program(mesh, n, op, pre, post, shapes, dtypes,
-                                  self.wire_dtype, active_mask)
+            # The 2-level strategies apply to the linear reductions without
+            # a join mask (Sum/Average); everything else stays flat.
+            strategy = self.strategy
+            if strategy != "flat" and (
+                    op not in (ReduceOp.SUM, ReduceOp.AVERAGE)
+                    or active_mask is not None
+                    or getattr(topo, "mesh2d", None) is None):
+                strategy = "flat"
+                downgraded = True
+            prog_mesh = topo.mesh2d if strategy != "flat" else mesh
+            prog = _fused_program(prog_mesh, n, op, pre, post, shapes,
+                                  dtypes, self.wire_dtype, active_mask,
+                                  strategy)
             # _timeline_op supplies BOTH the timeline span and the
             # transport-failure → HorovodInternalError translation: a peer
             # dying mid fused collective must be recoverable by the elastic
@@ -679,6 +762,11 @@ class FusionRuntime:
                 continue
             for (_, h), o in zip(items, outs):
                 h._set(o)
+        if downgraded and self._parameter_manager is not None:
+            # The configured strategy wasn't actually measurable this
+            # window (join mask / non-linear op forced flat) — keep the
+            # sweep from attributing flat timings to it.
+            self._parameter_manager.invalidate_window()
 
 
 class GroupedFusedHandle:

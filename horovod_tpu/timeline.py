@@ -49,10 +49,12 @@ class Timeline:
     def _now_us(self):
         return (time.perf_counter_ns() - self._t0) / 1000.0
 
-    def record(self, name, phase, cat, ts_us, dur_us=None, args=None):
+    def record(self, name, phase, cat, ts_us, dur_us=None, args=None,
+               tid=None):
         if self._closed:
             return
-        tid = threading.get_ident() % 100000
+        if tid is None:
+            tid = threading.get_ident() % 100000
         if self._native is not None:
             self._native.record(name, cat, phase, ts_us, dur_us or 0.0, tid)
             return
@@ -84,6 +86,95 @@ class Timeline:
         the surviving analog of NEGOTIATE_* (reference: timeline.cc)."""
         self.record(f"NEGOTIATE_{op_kind}:{name}", "X", "negotiate",
                     self._now_us() - dur_us, dur_us=dur_us)
+
+    # --- in-jit path (XPlane ingestion) --------------------------------
+    #
+    # The recommended training API (make_train_step / ops.in_jit) is ONE
+    # compiled program: its collectives never pass through the eager
+    # dispatch spans above. jax.profiler sees them — its trace carries the
+    # per-step jitted-function spans, the hvd:: TraceAnnotations, and (on
+    # real accelerator backends) the device lanes with the XLA collective
+    # ops (all-reduce / all-gather / ...). profile() captures such a trace
+    # and merges the relevant events into THIS timeline, rebased onto its
+    # clock — so one chrome://tracing file covers the eager AND in-jit
+    # paths (the reference's timeline only ever sees its enqueue path;
+    # docs/timeline.rst).
+
+    _XPLANE_KEEP = ("all-reduce", "all-gather", "reduce-scatter",
+                    "all-to-all", "collective-permute", "fusion",
+                    "convolution", "dot", "copy", "PjitFunction",
+                    "JitCompiler::Compile", "TpuExecute", "XlaModule",
+                    "FusionCompiler::Compile")
+
+    @contextmanager
+    def profile(self, logdir=None):
+        """Capture a ``jax.profiler`` trace around the enclosed (jitted)
+        steps and ingest its device/dispatch spans into this timeline."""
+        import tempfile
+
+        import jax
+
+        own_dir = logdir is None
+        logdir = logdir or tempfile.mkdtemp(prefix="hvd_xplane_")
+        start_us = self._now_us()
+        try:
+            with jax.profiler.trace(logdir):
+                yield
+            self.ingest_profiler_trace(logdir, reference_us=start_us)
+        finally:
+            if own_dir:
+                import shutil
+                shutil.rmtree(logdir, ignore_errors=True)
+
+    def ingest_profiler_trace(self, logdir, reference_us=None):
+        """Merge a jax.profiler trace directory into this timeline.
+
+        Keeps the ``hvd::`` TraceAnnotations, the per-step jitted-function
+        dispatch spans, and the XLA compile/execute/collective events
+        (device lanes on TPU); drops the Python-interpreter noise. Event
+        timestamps are rebased so the trace's first event lands at
+        ``reference_us`` on this timeline's clock (the clocks differ).
+        Returns the number of events ingested.
+        """
+        import glob
+        import gzip
+
+        paths = sorted(glob.glob(os.path.join(
+            logdir, "plugins", "profile", "*", "*.trace.json.gz")))
+        if not paths:
+            return 0
+        with gzip.open(paths[-1], "rt") as f:
+            trace = json.load(f)
+        events = trace.get("traceEvents", [])
+        lanes = {e["pid"]: e.get("args", {}).get("name", "")
+                 for e in events
+                 if e.get("ph") == "M" and e.get("name") == "process_name"}
+        picked = []
+        for e in events:
+            if e.get("ph") != "X":
+                continue
+            name = str(e.get("name", ""))
+            if name.startswith("$"):        # python interpreter frames
+                continue
+            lane = lanes.get(e.get("pid"), "")
+            device_lane = any(k in lane for k in ("TPU", "GPU", "/device"))
+            if not (name.startswith("hvd::") or device_lane
+                    or any(k in name for k in self._XPLANE_KEEP)):
+                continue
+            picked.append((e, lane, name))
+        if not picked:
+            return 0
+        t_min = min(e.get("ts", 0.0) for e, _, _ in picked)
+        offset = (reference_us if reference_us is not None
+                  else self._now_us()) - t_min
+        for e, lane, name in picked:
+            label = f"{lane}: {name}" if lane else name
+            # stable per-lane tid so chrome://tracing keeps device lanes
+            # visually separate from the host rows
+            tid = (hash((e.get("pid"), e.get("tid"))) % 90000) + 100000
+            self.record(label, "X", "xplane", e.get("ts", 0.0) + offset,
+                        dur_us=float(e.get("dur", 0.0)), tid=tid)
+        return len(picked)
 
     # --- writer --------------------------------------------------------
     def _drain(self):

@@ -72,3 +72,39 @@ PY
 
 # 7. ResNet-50 tracked config re-baseline
 HVD_BENCH_ITERS=20 python bench.py
+
+# 8. Timeline XPlane ingestion: the jitted step's DEVICE lane must show the
+# fused all-reduce span in the merged chrome trace (round-3: in-jit path
+# observability; CPU runs only see host dispatch spans).
+python - <<'PY'
+import json, tempfile
+import jax, jax.numpy as jnp, optax
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.optim import DistributedOptimizer
+from horovod_tpu.parallel import TrainState, make_train_step
+
+hvd.init()
+path = tempfile.mktemp(suffix=".json")
+tl = basics.start_timeline(path)
+mesh = hvd.global_process_set.mesh
+params = {"w": jnp.ones((512, 512), jnp.bfloat16)}
+def loss_fn(p, b):
+    return jnp.mean((b @ p["w"]) ** 2).astype(jnp.float32)
+opt = DistributedOptimizer(optax.sgd(0.1))
+step = make_train_step(loss_fn, opt, mesh, donate=False)
+state = TrainState.create(params, opt)
+batch = jnp.ones((hvd.size() * 8, 512), jnp.bfloat16)
+with tl.profile():
+    for _ in range(3):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+basics.stop_timeline()
+evs = json.load(open(path))["traceEvents"]
+xp = [e for e in evs if e.get("cat") == "xplane"]
+print("xplane events:", len(xp))
+device = [e["name"] for e in xp if "TPU" in e["name"] or "all-reduce" in e["name"]]
+print("device/collective spans:", device[:10])
+assert any("all-reduce" in n or "fusion" in n for n in device), \
+    "no device-side collective spans in the merged timeline"
+PY
