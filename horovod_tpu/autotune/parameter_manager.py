@@ -79,6 +79,7 @@ class ParameterManager:
         # score would bury every non-incumbent combo. Discard it.
         self._cat_warmed = None
         self._window_invalid = False
+        self._invalid_streak = 0
         self._samples = 0
         self._tuning = True
         self._window_bytes = 0
@@ -143,9 +144,22 @@ class ParameterManager:
             self._warmup_remaining -= 1
             return self._knobs()
         if invalid:
-            # knobs weren't actually in effect for this window — measuring
-            # it would poison whichever phase is active
-            return self._knobs()
+            self._invalid_streak += 1
+            if self._invalid_streak < 3:
+                # knobs weren't actually in effect for this window —
+                # measuring it would poison whichever phase is active
+                return self._knobs()
+            # PERSISTENTLY unmeasurable (e.g. every flush downgrades the
+            # 2-level strategy under a join mask): discarding forever
+            # would deadlock the whole tuner. In the sweep, zero-score the
+            # combo so it can never win (ties go to the configured
+            # default); in the numeric phase, score the window as-is —
+            # all windows are equally downgraded, so they stay comparable.
+            self._invalid_streak = 0
+            if not self._cat_done:
+                score = 0.0
+        else:
+            self._invalid_streak = 0
 
         if not self._cat_done:
             # Categorical sweep phase (reference: CategoricalParameter

@@ -77,6 +77,13 @@ class ResNet(nn.Module):
     act: Callable = nn.relu
     sync_batch_norm_axis: str = None  # DP mesh axis for SyncBatchNorm
     train: bool = True
+    # "conv": the classic 7x7 stride-2 stem. "space_to_depth": rearrange
+    # 2x2 pixel blocks into channels first (224x224x3 -> 112x112x12) and
+    # run an equal-receptive-field 4x4 stride-1 conv — the raw image's 3
+    # input channels drive the MXU's 128 input lanes at 3/128 utilization,
+    # which makes the stem a disproportionate share of step time on TPU
+    # (the standard MLPerf-ResNet TPU stem transform).
+    stem: str = "conv"
 
     @nn.compact
     def __call__(self, x, train=None):
@@ -91,8 +98,26 @@ class ResNet(nn.Module):
                            momentum=0.9, epsilon=1e-5, dtype=self.dtype)
 
         x = x.astype(self.dtype)
-        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)],
-                 name="conv_init")(x)
+        if self.stem == "space_to_depth":
+            B, H, W, C = x.shape
+            if H % 2 or W % 2:
+                raise ValueError(
+                    f"space_to_depth stem needs even spatial dims, got "
+                    f"{(H, W)}")
+            # (B, H, W, C) -> (B, H/2, W/2, 4C): each output pixel carries
+            # its 2x2 source block; a 4x4 stride-1 window then spans the
+            # same 8x8 input field as the padded 7x7 stride-2 conv.
+            x = x.reshape(B, H // 2, 2, W // 2, 2, C)
+            x = x.transpose(0, 1, 3, 2, 4, 5).reshape(
+                B, H // 2, W // 2, 4 * C)
+            x = conv(self.num_filters, (4, 4), (1, 1), padding="SAME",
+                     name="conv_init")(x)
+        elif self.stem == "conv":
+            x = conv(self.num_filters, (7, 7), (2, 2),
+                     padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        else:
+            raise ValueError(f"unknown stem {self.stem!r} "
+                             "(use 'conv' or 'space_to_depth')")
         x = norm(name="bn_init")(x)
         x = self.act(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")

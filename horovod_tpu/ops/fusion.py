@@ -359,7 +359,7 @@ class FusionRuntime:
             except Exception:  # noqa: BLE001 — keep publishing
                 pass
 
-    def _publish_boundary(self, last_tid):
+    def _publish_boundary(self, last_tid, strategy, wire_dtype):
         """Coordinator: record that tids <= last_tid are flushed — and the
         program-shaping knobs (strategy, wire dtype) in effect for that
         flush, so followers build the identical programs for the identical
@@ -368,9 +368,9 @@ class FusionRuntime:
         import json as _json
         seq = self._boundary_seq
         self._boundary_seq += 1
-        wire = jnp.dtype(self.wire_dtype).name if self.wire_dtype else ""
+        wire = jnp.dtype(wire_dtype).name if wire_dtype else ""
         self._publish_queue.put((seq, _json.dumps(
-            {"t": int(last_tid), "s": self.strategy, "w": wire})))
+            {"t": int(last_tid), "s": strategy, "w": wire})))
 
     def _apply_ready_boundaries(self, block_ms):
         """Follower: consume and apply published boundaries in order;
@@ -670,28 +670,19 @@ class FusionRuntime:
                 self._native.enqueue(
                     tid, hash(self._bucket_key(t, op, pre, post)), t.nbytes)
         self._flushed_tid = max(self._flushed_tid, pending[-1][0])
-        if self._parameter_manager is not None:
-            # BEFORE the boundary publish: knob updates shape THIS flush's
-            # programs, and the boundary must carry the values the
-            # followers need to build the same programs.
-            update = self._parameter_manager.record(flushed_bytes)
-            if update is not None:
-                self.threshold, new_cycle_ms, cats = update
-                # Consumed live by the cycle thread on its next wake.
-                self._cycle_s = max(new_cycle_ms, 1e-3) / 1000.0
-                if "strategy" in cats:
-                    self.strategy = cats["strategy"]
-                if "wire_dtype" in cats:
-                    self.wire_dtype = jnp.dtype(cats["wire_dtype"]).type
-        if self._multi and self._coord:
-            # Tell the followers to flush this exact prefix (with the
-            # program-shaping knobs in effect for it).
-            self._publish_boundary(pending[-1][0])
         if self._stall_inspector is not None:
             self._stall_inspector.record_flush()
         topo = basics.topology()
         mesh = topo.mesh
         n = topo.size
+        # THIS flush's programs use a snapshot of the knobs; tuner updates
+        # recorded below take effect from the NEXT flush. (The tuner needs
+        # the downgrade verdict — computed from the snapshot during bucket
+        # assembly — BEFORE its window closes, and the boundary published
+        # to followers must carry the values these programs really used.
+        # The one-flush lag on a sweep switch is absorbed by the
+        # ParameterManager's per-combo compile-warmup discard.)
+        strategy_now, wire_now = self.strategy, self.wire_dtype
         # Bucket assembly: tensors in one bucket share one flat reduction,
         # like responses fused up to the threshold (reference:
         # controller.h:170 FuseResponses). The native scheduler assigns
@@ -715,8 +706,42 @@ class FusionRuntime:
         from horovod_tpu.common.process_sets import global_process_set
         from horovod_tpu.ops.collective_ops import _active_mask
         active_mask = _active_mask(global_process_set)
+        # Pass 1: effective strategy per bucket (the 2-level strategies
+        # apply to the linear reductions without a join mask; everything
+        # else stays flat) — the downgrade verdict must reach the tuner
+        # BEFORE its window closes below.
         downgraded = False
+        plan = []
         for (op, pre, post, _), items in buckets.items():
+            strategy = strategy_now
+            if strategy != "flat" and (
+                    op not in (ReduceOp.SUM, ReduceOp.AVERAGE)
+                    or active_mask is not None
+                    or getattr(topo, "mesh2d", None) is None):
+                strategy = "flat"
+                downgraded = True
+            plan.append((op, pre, post, items, strategy))
+        if self._parameter_manager is not None:
+            if downgraded:
+                # Keep the sweep from attributing these flat timings to
+                # the configured 2-level combo.
+                self._parameter_manager.invalidate_window()
+            update = self._parameter_manager.record(flushed_bytes)
+            if update is not None:
+                self.threshold, new_cycle_ms, cats = update
+                # Consumed live by the cycle thread on its next wake; the
+                # strategy/wire knobs take effect from the NEXT flush.
+                self._cycle_s = max(new_cycle_ms, 1e-3) / 1000.0
+                if "strategy" in cats:
+                    self.strategy = cats["strategy"]
+                if "wire_dtype" in cats:
+                    self.wire_dtype = jnp.dtype(cats["wire_dtype"]).type
+        if self._multi and self._coord:
+            # Tell the followers to flush this exact prefix with the
+            # knobs these programs really use (the snapshot).
+            self._publish_boundary(pending[-1][0], strategy_now, wire_now)
+        # Pass 2: build + dispatch.
+        for op, pre, post, items, strategy in plan:
             tensors = [i[0] for i in items]
             tensors = _prepare(tensors, mesh, n, "fused_allreduce")
             shapes = tuple(tuple(t.shape) for t in tensors)
@@ -727,19 +752,9 @@ class FusionRuntime:
                 # response cache and exposes hit-rate stats (cache_stats()).
                 self._native.cache_lookup(
                     hash((op, pre, post, shapes, dtypes)))
-            # The 2-level strategies apply to the linear reductions without
-            # a join mask (Sum/Average); everything else stays flat.
-            strategy = self.strategy
-            if strategy != "flat" and (
-                    op not in (ReduceOp.SUM, ReduceOp.AVERAGE)
-                    or active_mask is not None
-                    or getattr(topo, "mesh2d", None) is None):
-                strategy = "flat"
-                downgraded = True
             prog_mesh = topo.mesh2d if strategy != "flat" else mesh
             prog = _fused_program(prog_mesh, n, op, pre, post, shapes,
-                                  dtypes, self.wire_dtype, active_mask,
-                                  strategy)
+                                  dtypes, wire_now, active_mask, strategy)
             # _timeline_op supplies BOTH the timeline span and the
             # transport-failure → HorovodInternalError translation: a peer
             # dying mid fused collective must be recoverable by the elastic
@@ -762,11 +777,6 @@ class FusionRuntime:
                 continue
             for (_, h), o in zip(items, outs):
                 h._set(o)
-        if downgraded and self._parameter_manager is not None:
-            # The configured strategy wasn't actually measurable this
-            # window (join mask / non-linear op forced flat) — keep the
-            # sweep from attributing flat timings to it.
-            self._parameter_manager.invalidate_window()
 
 
 class GroupedFusedHandle:

@@ -45,8 +45,14 @@ def _interpret():
 def _pick_block(length, cap=1024):
     # 512-row tiles keep the MXU fed far better than 128 (measured on v5e:
     # 32.1k -> 70.5k tok/s on GPT-2 @4k); 1024 overflows scoped VMEM.
+    # HVD_FLASH_BLOCK caps the tile lower for on-chip sweeps (the MFU
+    # tuning loop: sweep 128/256/512 per model without code edits).
+    import os
+    env_cap = os.environ.get("HVD_FLASH_BLOCK")
+    if env_cap:
+        cap = min(cap, int(env_cap))
     for b in (cap, 512, 256, 128, 64, 32, 16, 8):
-        if length % b == 0:
+        if b <= cap and length % b == 0:
             return b
     return None
 

@@ -96,6 +96,24 @@ class TestParameterManager:
                        else 1) << 20)
         assert pm.categoricals["wire_dtype"] == "float16"
 
+    def test_sweep_survives_persistent_downgrade(self):
+        """A combo the runtime can never actually measure (every window
+        invalidated — e.g. a join mask forces flat) must be zero-scored
+        and skipped, not deadlock the tuner; the measurable default
+        wins."""
+        from horovod_tpu.autotune.parameter_manager import ParameterManager
+        pm = ParameterManager(
+            warmup_samples=0, steps_per_sample=1, bayes_opt_max_samples=2,
+            categorical_knobs={"strategy": ["flat", "hierarchical"]})
+        for _ in range(80):
+            if not pm.tuning:
+                break
+            if pm.categoricals["strategy"] != "flat":
+                pm.invalidate_window()
+            pm.record(1 << 20)
+        assert not pm.tuning, "tuner deadlocked on an unmeasurable combo"
+        assert pm.categoricals["strategy"] == "flat"
+
     def test_strategy_program_matches_flat(self, hvd):
         """A fused flush under the 2-level strategies must be numerically
         identical to the flat psum (torus/hierarchical are exact)."""

@@ -27,6 +27,51 @@ class TestResNet:
         # ResNet-50 has ~25.5M params
         assert 25_000_000 < n_params < 26_000_000, n_params
 
+    def test_space_to_depth_stem(self, hvd, rng):
+        """The MLPerf-style TPU stem: same output shapes and trainability
+        as the 7x7 stride-2 conv, but the stem conv sees 12 input channels
+        (4x the MXU input-lane utilization on the raw image)."""
+        import optax
+
+        from horovod_tpu.models import ResNet18
+
+        x = np.asarray(rng.standard_normal((2, 32, 32, 3)), np.float32)
+        logits = {}
+        for stem in ("conv", "space_to_depth"):
+            model = ResNet18(num_classes=10, num_filters=8,
+                             dtype=jnp.float32, train=False, stem=stem)
+            params = model.init(jax.random.PRNGKey(0), x)
+            out = model.apply(params, x)
+            assert out.shape == (2, 10), stem
+            logits[stem] = out
+        # stem conv kernel really is (4, 4, 12, f)
+        k = model.init(jax.random.PRNGKey(0), x)["params"][
+            "conv_init"]["kernel"]
+        assert k.shape == (4, 4, 12, 8)
+        # trains: one SGD step decreases a tiny loss
+        model = ResNet18(num_classes=10, num_filters=8, dtype=jnp.float32,
+                         train=True, stem="space_to_depth")
+        variables = model.init(jax.random.PRNGKey(0), x)
+        y = jnp.asarray(np.asarray(rng.integers(0, 10, (2,)), np.int32))
+
+        def loss_fn(p):
+            out, _ = model.apply(
+                {"params": p, "batch_stats": variables["batch_stats"]}, x,
+                mutable=["batch_stats"])
+            return optax.softmax_cross_entropy_with_integer_labels(
+                out, y).mean()
+
+        l0, g = jax.value_and_grad(loss_fn)(variables["params"])
+        p1 = jax.tree_util.tree_map(lambda p, d: p - 0.1 * d,
+                                    variables["params"], g)
+        assert float(loss_fn(p1)) < float(l0)
+        # odd spatial dims are rejected loudly
+        with pytest.raises(ValueError, match="even spatial"):
+            ResNet18(num_classes=10, num_filters=8, dtype=jnp.float32,
+                     stem="space_to_depth").init(
+                         jax.random.PRNGKey(0),
+                         jnp.zeros((1, 33, 33, 3), jnp.float32))
+
 
 class TestBert:
     def test_tiny_pretraining_forward(self, hvd, rng):
