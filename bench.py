@@ -97,6 +97,13 @@ def _flash_default():
     return os.environ.get("HVD_BENCH_FLASH", "1") == "1"
 
 
+def _remat_default():
+    """HVD_BENCH_REMAT=1: jax.checkpoint every transformer block —
+    activation memory for FLOPs, the knob for bigger per-chip batches
+    (MFU) and longer contexts."""
+    return os.environ.get("HVD_BENCH_REMAT", "0") == "1"
+
+
 # Per-chip peaks for the roofline (TPU v5e: 197 TFLOP/s bf16, 819 GB/s
 # HBM — public spec sheet numbers; the env vars override for other gens).
 _PEAK_TFLOPS = float(os.environ.get("HVD_BENCH_PEAK_TFLOPS", "197"))
@@ -202,7 +209,8 @@ def _bench_bert(hvd):
     batch = per_chip * n
     # No padding in the synthetic batch and dropout is off under
     # deterministic apply, so flash engages.
-    cfg = BertConfig.large(use_flash=_flash_default())
+    cfg = BertConfig.large(use_flash=_flash_default(),
+                           remat=_remat_default())
     model = BertForPreTraining(cfg)
 
     rng = np.random.default_rng(0)
@@ -284,7 +292,7 @@ def _bench_gpt(hvd):
                     num_heads=12, intermediate_size=3072,
                     max_position_embeddings=seq, dtype=jnp.bfloat16,
                     tp_axis=None, ep_axis=None,
-                    use_flash=_flash_default())
+                    use_flash=_flash_default(), remat=_remat_default())
     model = GPT(cfg)
     ids = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, seq)), jnp.int32)
@@ -302,7 +310,8 @@ def _bench_llama(hvd):
 
     seq, batch = _lm_shapes(1024, 8, hvd.size())
     cfg = LlamaConfig.bench(max_position_embeddings=seq, dtype=jnp.bfloat16,
-                            tp_axis=None, use_flash=_flash_default())
+                            tp_axis=None, use_flash=_flash_default(),
+                            remat=_remat_default())
     model = Llama(cfg)
     ids = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, seq)), jnp.int32)
@@ -352,7 +361,8 @@ def _bench_vit(hvd):
     mesh = hvd.global_process_set.mesh
     per_chip = int(os.environ.get("HVD_BENCH_BATCH", "128"))
     batch = per_chip * n
-    cfg = ViTConfig.base(dtype=jnp.bfloat16, use_flash=_flash_default())
+    cfg = ViTConfig.base(dtype=jnp.bfloat16, use_flash=_flash_default(),
+                         remat=_remat_default())
     model = ViT(cfg)
     rng = np.random.default_rng(0)
     images = jnp.asarray(rng.standard_normal((batch, 224, 224, 3)),

@@ -27,6 +27,8 @@ class BertConfig:
     dtype: Any = jnp.bfloat16
     use_flash: bool = False          # Pallas flash attention (ops/pallas);
     # engages when no padding mask is given and dropout is off
+    # jax.checkpoint each block's backward (see GPTConfig.remat)
+    remat: bool = False
 
     @staticmethod
     def base(**kw):
@@ -124,8 +126,14 @@ class BertModel(nn.Module):
         x = nn.Dropout(c.dropout_rate)(x, deterministic=deterministic)
         mask = None if attention_mask is None \
             else attention_mask.astype(bool)
+        # static_argnums: ``deterministic`` is a python bool consumed by
+        # Dropout's control flow — it must not become a tracer under remat
+        # (arg 0 of the transformed fn is the module itself, so
+        # ``deterministic`` — x, mask, deterministic — is argnum 3)
+        block_cls = nn.remat(TransformerBlock, static_argnums=(3,)) \
+            if c.remat else TransformerBlock
         for i in range(c.num_layers):
-            x = TransformerBlock(c, name=f"layer_{i}")(
+            x = block_cls(c, name=f"layer_{i}")(
                 x, mask, deterministic)
         pooled = nn.tanh(nn.Dense(c.hidden_size, dtype=c.dtype,
                                   name="pooler")(x[:, 0]))

@@ -41,6 +41,8 @@ class LlamaConfig:
     use_flash: bool = False         # Pallas flash attention (ops/pallas)
     sp_axis: Optional[str] = None   # sequence parallelism: tokens sharded
     sp_impl: str = "ring"           # "ring" | "ulysses" (parallel/sequence)
+    # jax.checkpoint each block's backward (see GPTConfig.remat)
+    remat: bool = False
 
     @staticmethod
     def tiny(**kw):
@@ -147,6 +149,8 @@ class Llama(nn.Module):
             raise ValueError("decode mode requires pos (the token's "
                              "global position)")
         x = LlamaEmbed(c, name="embed")(input_ids, pos)
+        block_cls = (nn.remat(LlamaBlock) if c.remat and not self.decode
+                     else LlamaBlock)
         for i in range(c.num_layers):
-            x = LlamaBlock(c, decode=self.decode, name=f"layer_{i}")(x)
+            x = block_cls(c, decode=self.decode, name=f"layer_{i}")(x)
         return LlamaHead(c, name="head")(x)

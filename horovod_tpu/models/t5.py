@@ -43,6 +43,8 @@ class T5Config:
     rms_eps: float = 1e-6
     dtype: Any = jnp.float32
     tp_axis: Optional[str] = "tp"
+    # jax.checkpoint each block's backward (see GPTConfig.remat)
+    remat: bool = False
 
     @staticmethod
     def tiny(**kw):
@@ -199,9 +201,10 @@ class T5Encoder(nn.Module):
         x = emb(input_ids)
         L = input_ids.shape[1]
         bias = T5RelativeBias(c, bidirectional=True, name="rel_bias")(L, L)
+        block_cls = nn.remat(T5Block) if c.remat else T5Block
         for i in range(c.num_layers):
-            x = T5Block(c, causal=False, cross=False,
-                        name=f"layer_{i}")(x, bias, mask=mask)
+            x = block_cls(c, causal=False, cross=False,
+                          name=f"layer_{i}")(x, bias, mask=mask)
         return nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,
                           name="ln_f")(x)
 
@@ -220,8 +223,12 @@ class T5Decoder(nn.Module):
         c = self.config
 
         def block(i):
-            return T5Block(c, causal=True, cross=True, decode=self.decode,
-                           name=f"layer_{i}")
+            # remat in training only — the decode/prime paths have no
+            # backward and mutate the cache collection
+            cls = (nn.remat(T5Block) if c.remat and not self.decode
+                   and not project_kv_only else T5Block)
+            return cls(c, causal=True, cross=True, decode=self.decode,
+                       name=f"layer_{i}")
 
         if project_kv_only:
             # One fused K/V projection of the static memory per layer —

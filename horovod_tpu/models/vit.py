@@ -34,6 +34,8 @@ class ViTConfig:
     dtype: Any = jnp.float32
     tp_axis: Optional[str] = None   # tensor parallelism over heads/MLP
     use_flash: bool = False         # Pallas attention (ops/pallas)
+    # jax.checkpoint each block's backward (see GPTConfig.remat)
+    remat: bool = False
 
     @staticmethod
     def base(**kw):
@@ -74,8 +76,10 @@ class ViT(nn.Module):
         pos = self.param("pos_emb", nn.initializers.normal(0.02),
                          (expect, c.hidden_size), jnp.float32)
         x = x + jnp.asarray(pos, c.dtype)[None]
+        block_cls = nn.remat(TPTransformerBlock) if c.remat \
+            else TPTransformerBlock
         for i in range(c.num_layers):
-            x = TPTransformerBlock(
+            x = block_cls(
                 c.num_heads, c.hidden_size, c.intermediate_size,
                 dtype=c.dtype, axis_name=c.tp_axis, causal=False,
                 use_flash=c.use_flash, name=f"layer_{i}")(x)

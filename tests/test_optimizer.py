@@ -316,9 +316,11 @@ class TestGroupedAsyncFusion:
         calls = []
         orig = fusion._fused_program
 
-        def spy(mesh, n, op, pre, post, shapes, dtypes, wire, mask=None):
+        def spy(mesh, n, op, pre, post, shapes, dtypes, wire, mask=None,
+                strategy="flat"):
             calls.append(len(shapes))
-            return orig(mesh, n, op, pre, post, shapes, dtypes, wire, mask)
+            return orig(mesh, n, op, pre, post, shapes, dtypes, wire, mask,
+                        strategy)
 
         try:
             fusion._fused_program = spy
