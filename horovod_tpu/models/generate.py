@@ -54,9 +54,18 @@ def _filter_logits(logits, top_k, top_p):
     return logits
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7))
+def _absorb_eos(nxt, done, eos_id):
+    """Fixed-length EOS semantics: a finished row keeps emitting EOS
+    (padding) and its ``done`` flag latches. ``eos_id=None`` = no EOS."""
+    if eos_id is None:
+        return nxt, done
+    nxt = jnp.where(done, jnp.asarray(eos_id, nxt.dtype), nxt)
+    return nxt, done | (nxt == eos_id)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8))
 def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
-                     top_k, top_p):
+                     top_k, top_p, eos_id=None):
     """KV-cache decode: ONE token per step through the cache-enabled model
     (O(1) projections per step; attention reads the filled prefix). Two
     scans: a prefill pass teacher-forces the prompt into the cache (no
@@ -82,7 +91,7 @@ def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
         cache, _ = lax.scan(prefill, cache, jnp.arange(0, P - 1))
 
     def step(carry, t):
-        buf, cache, rng = carry
+        buf, cache, rng, done = carry
         tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
         cache, nxt_logits = feed(cache, tok, t)
         if temperature == 0.0:
@@ -94,17 +103,19 @@ def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
             nxt = jax.random.categorical(
                 sub, _filter_logits(nxt_logits / temperature, top_k,
                                     top_p)).astype(jnp.int32)
+        nxt, done = _absorb_eos(nxt, done, eos_id)
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t + 1))
-        return (buf, cache, rng), None
+        return (buf, cache, rng, done), None
 
-    (buf, _, _), _ = lax.scan(step, (buf, cache, rng),
-                              jnp.arange(P - 1, max_len - 1))
+    done0 = jnp.zeros((B,), bool)
+    (buf, _, _, _), _ = lax.scan(step, (buf, cache, rng, done0),
+                                 jnp.arange(P - 1, max_len - 1))
     return buf
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7))
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8))
 def _generate(model, params, prompt, max_len, temperature, rng,
-              top_k, top_p):
+              top_k, top_p, eos_id=None):
     # ``model`` is static: flax modules hash by their dataclass config, so
     # repeated generate() calls with the same model/max_len/temperature
     # reuse one compiled program.
@@ -114,7 +125,7 @@ def _generate(model, params, prompt, max_len, temperature, rng,
     buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
     def step(carry, t):
-        buf, rng = carry
+        buf, rng, done = carry
         logits = model.apply({"params": params}, buf)   # (B, max_len, V)
         # logits at position t-1 predict token t
         nxt_logits = jax.lax.dynamic_slice_in_dim(
@@ -128,12 +139,15 @@ def _generate(model, params, prompt, max_len, temperature, rng,
             nxt = jax.random.categorical(
                 sub, _filter_logits(nxt_logits / temperature, top_k,
                                     top_p)).astype(jnp.int32)
+        nxt, done = _absorb_eos(nxt, done, eos_id)
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t))
-        return (buf, rng), None
+        return (buf, rng, done), None
 
     # Positions < P are the prompt: start decoding at P (one forward per
     # GENERATED token, none wasted re-writing prompt tokens).
-    (buf, _), _ = lax.scan(step, (buf, rng), jnp.arange(P, max_len))
+    done0 = jnp.zeros((B,), bool)
+    (buf, _, _), _ = lax.scan(step, (buf, rng, done0),
+                              jnp.arange(P, max_len))
     return buf
 
 
@@ -182,36 +196,103 @@ def beam_best(bufs, scores):
             jnp.take_along_axis(scores, best[:, None], axis=1)[:, 0])
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4))
-def _beam_search(model, params, prompt, max_len, num_beams):
+def beam_step_eos(logp, bufs, scores, fin_bufs, fin_scores, t, prompt_len,
+                  eos_id, length_penalty):
+    """One beam expansion with a TRUE finished-hypothesis pool (fixed
+    shapes: k live + k finished slots), shared by the causal and seq2seq
+    searches.
+
+    Each live beam's finish-now candidate (its score plus the EOS
+    log-prob, GNMT-normalized by generated length including the EOS) is
+    merged into the finished pool by top-k over the 2k candidates, so a
+    completed hypothesis can never be evicted by later live expansions —
+    the property the simpler absorbing-state formulation lacks. Live
+    beams then expand with the EOS column masked out (a live buffer never
+    contains EOS, so prompt tokens can never falsely finish anything)."""
+    B, k, V = logp.shape
+    L = bufs.shape[-1]
+    fin_cand_raw = scores + logp[:, :, eos_id]               # (B, k)
+    gen_len = jnp.maximum(t - prompt_len + 1, 1).astype(jnp.float32)
+    fin_cand = fin_cand_raw / (gen_len ** length_penalty
+                               if length_penalty else 1.0)
+    # the finished buffer: the hypothesis so far, EOS-padded from t on
+    pos = jnp.arange(L)
+    cand_bufs = jnp.where(pos[None, None, :] >= t,
+                          jnp.asarray(eos_id, bufs.dtype), bufs)
+    all_scores = jnp.concatenate([fin_scores, fin_cand], axis=1)  # (B, 2k)
+    all_bufs = jnp.concatenate([fin_bufs, cand_bufs], axis=1)
+    fin_scores, idx = lax.top_k(all_scores, k)
+    fin_bufs = jnp.take_along_axis(all_bufs, idx[:, :, None], axis=1)
+    live_logp = logp.at[:, :, eos_id].set(-jnp.inf)
+    bufs, scores = beam_expand(live_logp, bufs, scores, t)
+    return bufs, scores, fin_bufs, fin_scores
+
+
+def beam_finalize(bufs, scores, fin_bufs, fin_scores, prompt_len, eos_id,
+                  length_penalty):
+    """Best hypothesis per row across the live beams (normalized by the
+    full generated span) AND the finished pool (already normalized at
+    finish time). Without an EOS the pool is empty and this is plain
+    best-of-live selection."""
+    B, k, L = bufs.shape
+    if length_penalty:
+        scores = scores / float(max(L - prompt_len, 1)) ** length_penalty
+    if eos_id is None:
+        return beam_best(bufs, scores)
+    return beam_best(jnp.concatenate([fin_bufs, bufs], axis=1),
+                     jnp.concatenate([fin_scores, scores], axis=1))
+
+
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5, 6))
+def _beam_search(model, params, prompt, max_len, num_beams, eos_id,
+                 length_penalty):
     B, P = prompt.shape
     k = num_beams
     bufs = jnp.zeros((B, k, max_len), jnp.int32)
     bufs = lax.dynamic_update_slice(
         bufs, jnp.broadcast_to(prompt[:, None], (B, k, P)), (0, 0, 0))
     scores = beam_init_scores(B, k)
+    fin_bufs = jnp.zeros_like(bufs)
+    fin_scores = jnp.full((B, k), -jnp.inf, jnp.float32)
 
     def step(carry, t):
-        bufs, scores = carry
+        bufs, scores, fin_bufs, fin_scores = carry
         logits = model.apply({"params": params},
                              bufs.reshape(B * k, max_len))
         logp = jax.nn.log_softmax(
             logits[:, t - 1].astype(jnp.float32)).reshape(B, k, -1)
-        return beam_expand(logp, bufs, scores, t), None
+        if eos_id is None:
+            bufs, scores = beam_expand(logp, bufs, scores, t)
+        else:
+            bufs, scores, fin_bufs, fin_scores = beam_step_eos(
+                logp, bufs, scores, fin_bufs, fin_scores, t, P, eos_id,
+                length_penalty)
+        return (bufs, scores, fin_bufs, fin_scores), None
 
-    (bufs, scores), _ = lax.scan(step, (bufs, scores),
-                                 jnp.arange(P, max_len))
-    return beam_best(bufs, scores)
+    (bufs, scores, fin_bufs, fin_scores), _ = lax.scan(
+        step, (bufs, scores, fin_bufs, fin_scores),
+        jnp.arange(P, max_len))
+    return beam_finalize(bufs, scores, fin_bufs, fin_scores, P, eos_id,
+                         length_penalty)
 
 
-def beam_search(model, params, prompt, max_len, num_beams=4):
+def beam_search(model, params, prompt, max_len, num_beams=4, eos_id=None,
+                length_penalty=0.0):
     """Beam-search decoding for the causal LMs: ONE compiled program, k
     hypotheses re-forwarded per step through the same fixed-length-buffer
     scheme as greedy :func:`generate`. Returns ``(sequences, scores)``:
-    (B, max_len) int32 best hypotheses and their summed token log-probs.
-    ``num_beams=1`` reproduces greedy decoding exactly. (All hypotheses
-    decode to the same fixed length — there is no EOS handling — so a
-    length penalty would not change the ranking and none is offered.)
+    (B, max_len) int32 best hypotheses and their (length-normalized when
+    ``length_penalty>0``) summed token log-probs. ``num_beams=1`` with no
+    EOS reproduces greedy decoding exactly.
+
+    ``eos_id``: a hypothesis that emits it is finished — it moves into a
+    FINISHED pool (k slots, merged by normalized score, never evicted by
+    later live expansions — true finished-set semantics) and pads with
+    ``eos_id``; live beams keep competing with the EOS move excluded, so
+    EOS tokens inside the prompt never count. ``length_penalty``:
+    GNMT-style ``score / gen_len**alpha`` (generated length including
+    the EOS) applied when each hypothesis finishes and to live beams at
+    selection; 0 disables.
     """
     B, P = prompt.shape
     if not 1 <= P < max_len:
@@ -219,13 +300,18 @@ def beam_search(model, params, prompt, max_len, num_beams=4):
             f"prompt length {P} must be in [1, max_len={max_len})")
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
+    if length_penalty < 0:
+        raise ValueError(
+            f"length_penalty must be >= 0, got {length_penalty}")
     _check_position_capacity(model, max_len)
     return _beam_search(model, params, jnp.asarray(prompt, jnp.int32),
-                        int(max_len), int(num_beams))
+                        int(max_len), int(num_beams),
+                        None if eos_id is None else int(eos_id),
+                        float(length_penalty))
 
 
 def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
-             use_cache=False, top_k=0, top_p=1.0):
+             use_cache=False, top_k=0, top_p=1.0, eos_id=None):
     """Generate up to ``max_len`` total tokens from ``prompt``.
 
     - ``model``: a causal LM whose ``apply({"params": p}, ids)`` returns
@@ -242,6 +328,10 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
       unsupported; ``max_len`` must be within the model's
       ``max_position_embeddings``). Same outputs as the default
       full-re-forward path.
+    - ``eos_id``: once a row GENERATES it, the row is finished and pads
+      with ``eos_id`` to ``max_len`` (fixed shapes; slice at the first
+      EOS to recover the variable-length output). EOS tokens inside the
+      prompt do not count.
 
     Returns (B, max_len) int32: the prompt followed by generated tokens.
     The decode loop is one compiled program; like any jit, it retraces per
@@ -273,7 +363,9 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
         cache = init_decode_cache(decoder, prompt[:, :1], pos=0)
         return _generate_cached(decoder, (params, cache), prompt,
                                 int(max_len), float(temperature), rng,
-                                int(top_k), float(top_p))
+                                int(top_k), float(top_p),
+                                None if eos_id is None else int(eos_id))
     return _generate(model, params, prompt,
                      int(max_len), float(temperature), rng,
-                     int(top_k), float(top_p))
+                     int(top_k), float(top_p),
+                     None if eos_id is None else int(eos_id))

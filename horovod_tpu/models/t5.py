@@ -293,34 +293,41 @@ class T5(nn.Module):
                            memory_mask=src_mask)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4))
-def _t5_greedy(model, params, src_ids, max_len, bos_id, src_mask):
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6))
+def _t5_greedy(model, params, src_ids, max_len, bos_id, src_mask,
+               eos_id=None):
     # Module-level jit: flax modules hash by their dataclass config, so
     # repeated decode calls with the same (config, max_len, bos_id, shapes)
     # reuse one compiled program. encode/decode run as methods of the FULL
     # model so the shared token embedding resolves.
+    from horovod_tpu.models.generate import _absorb_eos
     memory = model.apply({"params": params}, src_ids, src_mask,
                          method=T5.encode)
     B = src_ids.shape[0]
     buf = jnp.full((B, max_len), bos_id, jnp.int32)
 
-    def step(buf, t):
+    def step(carry, t):
+        buf, done = carry
         logits = model.apply({"params": params}, buf, memory,
                              memory_mask=src_mask, method=T5.decode)
         nxt = jnp.argmax(logits[:, t - 1], axis=-1).astype(jnp.int32)
-        return lax.dynamic_update_slice(buf, nxt[:, None], (0, t)), None
+        nxt, done = _absorb_eos(nxt, done, eos_id)
+        return (lax.dynamic_update_slice(buf, nxt[:, None], (0, t)),
+                done), None
 
-    buf, _ = lax.scan(step, buf, jnp.arange(1, max_len))
+    (buf, _), _ = lax.scan(step, (buf, jnp.zeros((B,), bool)),
+                           jnp.arange(1, max_len))
     return buf
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4))
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6))
 def _t5_greedy_cached(decoder_model, state, src_ids, max_len, bos_id,
-                      src_mask):
+                      src_mask, eos_id=None):
     """KV-cache greedy decode: encoder once, then ONE token per step
     through the decoder's per-layer self-attention caches, with the
     cross-attention K/V primed from the static memory exactly once —
     O(1) projection work per generated token."""
+    from horovod_tpu.models.generate import _absorb_eos
     params, cache = state
     memory = decoder_model.apply({"params": params}, src_ids, src_mask,
                                  method=T5.encode)
@@ -332,24 +339,28 @@ def _t5_greedy_cached(decoder_model, state, src_ids, max_len, bos_id,
     buf = jnp.full((B, max_len), bos_id, jnp.int32)
 
     def step(carry, t):
-        buf, cache = carry
+        buf, cache, done = carry
         tok = lax.dynamic_slice_in_dim(buf, t - 1, 1, axis=1)
         logits, upd = decoder_model.apply(
             {"params": params, "cache": cache}, tok, memory,
             memory_mask=src_mask, pos=t - 1, cross_kv=cross_kv,
             method=T5.decode, mutable=["cache"])
         nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        nxt, done = _absorb_eos(nxt, done, eos_id)
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t))
-        return (buf, upd["cache"]), None
+        return (buf, upd["cache"], done), None
 
-    (buf, _), _ = lax.scan(step, (buf, cache), jnp.arange(1, max_len))
+    (buf, _, _), _ = lax.scan(step, (buf, cache, jnp.zeros((B,), bool)),
+                              jnp.arange(1, max_len))
     return buf
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5))
-def _t5_beam(model, params, src_ids, max_len, num_beams, bos_id, src_mask):
-    from horovod_tpu.models.generate import (beam_best, beam_expand,
-                                             beam_init_scores)
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5, 7, 8))
+def _t5_beam(model, params, src_ids, max_len, num_beams, bos_id, src_mask,
+             eos_id=None, length_penalty=0.0):
+    from horovod_tpu.models.generate import (beam_expand, beam_finalize,
+                                             beam_init_scores,
+                                             beam_step_eos)
     memory = model.apply({"params": params}, src_ids, src_mask,
                          method=T5.encode)
     B, k = src_ids.shape[0], num_beams
@@ -357,39 +368,56 @@ def _t5_beam(model, params, src_ids, max_len, num_beams, bos_id, src_mask):
     mask_k = None if src_mask is None else jnp.repeat(src_mask, k, axis=0)
     bufs = jnp.full((B, k, max_len), bos_id, jnp.int32)
     scores = beam_init_scores(B, k)
+    fin_bufs = jnp.zeros_like(bufs)
+    fin_scores = jnp.full((B, k), -jnp.inf, jnp.float32)
 
     def step(carry, t):
-        bufs, scores = carry
+        bufs, scores, fin_bufs, fin_scores = carry
         logits = model.apply({"params": params},
                              bufs.reshape(B * k, max_len), mem_k,
                              memory_mask=mask_k, method=T5.decode)
         logp = jax.nn.log_softmax(
             logits[:, t - 1].astype(jnp.float32)).reshape(B, k, -1)
-        return beam_expand(logp, bufs, scores, t), None
+        if eos_id is None:
+            bufs, scores = beam_expand(logp, bufs, scores, t)
+        else:
+            bufs, scores, fin_bufs, fin_scores = beam_step_eos(
+                logp, bufs, scores, fin_bufs, fin_scores, t, 1, eos_id,
+                length_penalty)
+        return (bufs, scores, fin_bufs, fin_scores), None
 
-    (bufs, scores), _ = lax.scan(step, (bufs, scores),
-                                 jnp.arange(1, max_len))
-    return beam_best(bufs, scores)
+    (bufs, scores, fin_bufs, fin_scores), _ = lax.scan(
+        step, (bufs, scores, fin_bufs, fin_scores),
+        jnp.arange(1, max_len))
+    return beam_finalize(bufs, scores, fin_bufs, fin_scores, 1, eos_id,
+                         length_penalty)
 
 
 def t5_beam_decode(model, params, src_ids, max_len, num_beams=4, bos_id=0,
-                   src_mask=None):
+                   src_mask=None, eos_id=None, length_penalty=0.0):
     """Beam-search seq2seq decoding: encoder once, then k hypotheses
-    re-forwarded jointly per step (fixed-length buffer; no EOS, so no
-    length penalty — see :func:`horovod_tpu.models.beam_search`). Returns
+    re-forwarded jointly per step (fixed-length buffer). Returns
     ``(sequences, scores)``: (B, max_len) int32 starting with ``bos_id``
-    and the summed token log-probs. ``num_beams=1`` equals
-    :func:`t5_greedy_decode`."""
+    and the summed token log-probs. ``num_beams=1`` with no EOS equals
+    :func:`t5_greedy_decode`. ``eos_id`` / ``length_penalty``: true
+    finished-pool semantics with GNMT length normalization (see
+    :func:`horovod_tpu.models.beam_search`); ``bos_id == eos_id`` is
+    safe — only the EOS expansion move finishes a hypothesis."""
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
     if max_len < 2:
         raise ValueError(f"max_len must be >= 2, got {max_len}")
+    if length_penalty < 0:
+        raise ValueError(
+            f"length_penalty must be >= 0, got {length_penalty}")
     return _t5_beam(model, params, jnp.asarray(src_ids, jnp.int32),
-                    int(max_len), int(num_beams), int(bos_id), src_mask)
+                    int(max_len), int(num_beams), int(bos_id), src_mask,
+                    None if eos_id is None else int(eos_id),
+                    float(length_penalty))
 
 
 def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
-                     src_mask=None, use_cache=False):
+                     src_mask=None, use_cache=False, eos_id=None):
     """Greedy seq2seq decoding as one compiled program. Default: encoder
     once, decoder re-forwards a fixed-length buffer per step (causal
     structure ignores the not-yet-written tail). ``use_cache=True``
@@ -401,9 +429,10 @@ def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
     per generated token. Returns (B, max_len) int32 starting with
     ``bos_id``."""
     src_ids = jnp.asarray(src_ids, jnp.int32)
+    eos = None if eos_id is None else int(eos_id)
     if not use_cache:
         return _t5_greedy(model, params, src_ids, int(max_len), int(bos_id),
-                          src_mask)
+                          src_mask, eos)
     if max_len > model.config.max_decode_len:
         raise ValueError(
             f"max_len {max_len} exceeds the decode cache capacity "
@@ -416,4 +445,4 @@ def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
                    model.config.hidden_size), model.config.dtype),
         pos=0, method=T5.decode)
     return _t5_greedy_cached(decoder, (params, cache), src_ids,
-                             int(max_len), int(bos_id), src_mask)
+                             int(max_len), int(bos_id), src_mask, eos)

@@ -334,6 +334,129 @@ class TestGenerate:
             with pytest.raises(ValueError, match="position capacity"):
                 call()
 
+    def test_eos_stops_generation(self, hvd):
+        """eos_id semantics on every decode path: generation freezes at
+        the first GENERATED eos and pads with it (fixed shapes); beams
+        freeze their scores; prompt tokens never count as eos."""
+        import flax.linen as nn
+
+        from horovod_tpu.models import beam_search, generate
+
+        class CycleLM(nn.Module):
+            """Deterministically emits (last_token + 1) % vocab."""
+            vocab: int = 8
+
+            @nn.compact
+            def __call__(self, ids):
+                self.param("dummy", nn.initializers.zeros, (1,))
+                return jax.nn.one_hot((ids + 1) % self.vocab,
+                                      self.vocab) * 10.0
+
+        model = CycleLM()
+        prompt = jnp.asarray([[0]], jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        free = np.asarray(generate(model, params, prompt, 6))
+        np.testing.assert_array_equal(free, [[0, 1, 2, 3, 4, 5]])
+        out = np.asarray(generate(model, params, prompt, 6, eos_id=3))
+        np.testing.assert_array_equal(out, [[0, 1, 2, 3, 3, 3]])
+        # prompt CONTAINING the eos id doesn't stop anything
+        p2 = jnp.asarray([[3]], jnp.int32)
+        out = np.asarray(generate(model, params, p2, 4, eos_id=2))
+        np.testing.assert_array_equal(out, [[3, 4, 5, 6]])
+        # beam search: finished hypotheses freeze and pad; the winner
+        # matches greedy; length penalty only normalizes the score
+        seqs, sc = beam_search(model, params, prompt, 6, num_beams=2,
+                               eos_id=3)
+        np.testing.assert_array_equal(np.asarray(seqs), [[0, 1, 2, 3, 3, 3]])
+        seqs_lp, sc_lp = beam_search(model, params, prompt, 6, num_beams=2,
+                                     eos_id=3, length_penalty=1.0)
+        np.testing.assert_array_equal(np.asarray(seqs_lp), np.asarray(seqs))
+        # normalized score = raw / gen_len (3 tokens incl. eos)
+        np.testing.assert_allclose(np.asarray(sc_lp),
+                                   np.asarray(sc) / 3.0, rtol=1e-5)
+
+    def test_finished_beam_survives_better_live_expansions(self, hvd):
+        """True finished-set semantics: a hypothesis that finished early
+        with a mediocre score must still win when every live beam later
+        degrades below it — an absorbing-state beam would have evicted it
+        from the live set and lost it."""
+        import flax.linen as nn
+
+        from horovod_tpu.models import beam_search
+
+        class ScriptLM(nn.Module):
+            """Position-scripted logits: at the first generated position
+            EOS costs ~-3.7 while the best live token costs ~-0.7; every
+            later position costs ~-1.1 per token with EOS ruled out."""
+
+            @nn.compact
+            def __call__(self, ids):
+                self.param("dummy", nn.initializers.zeros, (1,))
+                B, L = ids.shape
+                tbl = jnp.zeros((L, 4))
+                tbl = tbl.at[:, 3].set(-30.0)          # eos awful later
+                tbl = tbl.at[0].set(jnp.array([-30.0, 0.0, -0.1, -3.0]))
+                return jnp.broadcast_to(tbl[None], (B, L, 4))
+
+        model = ScriptLM()
+        prompt = jnp.asarray([[0]], jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        seqs, sc = beam_search(model, params, prompt, 5, num_beams=2,
+                               eos_id=3)
+        # finished at step one: raw ~-3.67 beats the best live ~-3.96
+        np.testing.assert_array_equal(np.asarray(seqs), [[0, 3, 3, 3, 3]])
+        assert -3.8 < float(sc[0]) < -3.5, float(sc[0])
+
+    def test_eos_cached_matches_full_reforward(self, hvd, rng):
+        """use_cache=True must honor eos_id identically to the
+        full-re-forward path on a real model."""
+        from horovod_tpu.models import GPT, GPTConfig, generate
+        cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=2,
+                             max_position_embeddings=12)
+        model = GPT(cfg)
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (2, 3)), np.int32))
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        probe = np.asarray(generate(model, params, prompt, 12))
+        eos = int(probe[0, 5])              # a token greedy WILL emit
+        full = np.asarray(generate(model, params, prompt, 12, eos_id=eos))
+        cached = np.asarray(generate(model, params, prompt, 12,
+                                     eos_id=eos, use_cache=True))
+        np.testing.assert_array_equal(cached, full)
+        row = full[0]
+        first = int(np.argmax(row[3:] == eos)) + 3
+        assert (row[first:] == eos).all()   # padded after the first eos
+
+    def test_t5_eos(self, hvd, rng):
+        """Seq2seq eos: greedy (both paths) pads after the first generated
+        eos; beam rejects eos_id == bos_id loudly."""
+        from horovod_tpu.models import (T5, T5Config, t5_beam_decode,
+                                        t5_greedy_decode)
+        cfg = T5Config.tiny(tp_axis=None)
+        model = T5(cfg)
+        src = jnp.asarray(rng.integers(2, 50, (2, 6)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), src, src[:, :4])["params"]
+        probe = np.asarray(t5_greedy_decode(model, params, src, 10))
+        eos = int(probe[0, 4])
+        if eos == 0:                        # bos collision in the probe
+            eos = int(probe[0, 5]) or 1
+        full = np.asarray(t5_greedy_decode(model, params, src, 10,
+                                           eos_id=eos))
+        cached = np.asarray(t5_greedy_decode(model, params, src, 10,
+                                             eos_id=eos, use_cache=True))
+        np.testing.assert_array_equal(cached, full)
+        row = full[0]
+        hits = np.nonzero(row[1:] == eos)[0]
+        if hits.size:
+            first = int(hits[0]) + 1
+            assert (row[first:] == eos).all()
+        # bos_id == eos_id (both 0) is safe under the finished-pool beam:
+        # only the EOS expansion MOVE finishes a hypothesis
+        seqs, sc = t5_beam_decode(model, params, src, 10, num_beams=2,
+                                  eos_id=0, bos_id=0, length_penalty=1.0)
+        assert np.asarray(seqs).shape == (2, 10)
+        assert np.isfinite(np.asarray(sc)).all()
+
     @pytest.mark.parametrize(
         "family", ["gpt", "gpt_moe", "llama", "bert", "vit", "t5"])
     def test_remat_matches_plain(self, hvd, rng, family):
