@@ -268,6 +268,37 @@ def _lm_shapes(default_seq, default_batch, n):
 
 
 def _next_token_loss(model, key="ids"):
+    """Next-token CE. HVD_BENCH_CHUNKED_XENT=1 switches to the chunked
+    head+loss (optim/losses.py): the (B, L, V) fp32 logits tensor — the
+    single largest HBM term of LM training — never materializes."""
+    if os.environ.get("HVD_BENCH_CHUNKED_XENT", "0") == "1":
+        import functools
+        import math
+
+        from horovod_tpu.models.gpt import GPT, GPTHead
+        from horovod_tpu.models.llama import Llama, LlamaHead
+        from horovod_tpu.optim import next_token_xent_chunked
+        from horovod_tpu.parallel import next_token_labels
+
+        heads = {GPT: GPTHead, Llama: LlamaHead}
+        if type(model) not in heads:
+            raise ValueError(
+                f"HVD_BENCH_CHUNKED_XENT supports {list(heads)}, got "
+                f"{type(model).__name__}")
+        head = heads[type(model)](model.config)
+
+        def loss_fn(p, b):
+            ids = b[key]
+            hidden = model.apply({"params": p}, ids, features_only=True)
+            labels = next_token_labels(ids, axis_name=None)
+            chunk = math.gcd(ids.shape[1], 128) \
+                if ids.shape[1] % 128 else 128
+            return next_token_xent_chunked(
+                functools.partial(head.apply, {"params": p["head"]}),
+                hidden, labels, chunk=chunk)
+
+        return loss_fn
+
     def loss_fn(p, b):
         logits = model.apply({"params": p}, b[key])
         return optax.softmax_cross_entropy_with_integer_labels(

@@ -151,7 +151,13 @@ class GPT(nn.Module):
     decode: bool = False   # KV-cache single-token decoding (dense only)
 
     @nn.compact
-    def __call__(self, input_ids, pos=None):
+    def __call__(self, input_ids, pos=None, features_only=False):
+        """``features_only=True`` (apply-time only) returns the pre-head
+        hidden states ``(B, L, H)`` — feed them to
+        :func:`horovod_tpu.optim.next_token_xent_chunked` with the head
+        bound to ``params["head"]`` so the full (B, L, V) logits tensor
+        never materializes (initialize with the default False so the head
+        params exist)."""
         c = self.config
         if self.decode:
             if c.num_experts:
@@ -179,4 +185,6 @@ class GPT(nn.Module):
                     sp_impl=c.sp_impl, decode=self.decode,
                     cache_len=c.max_position_embeddings,
                     name=f"layer_{i}")(x)
+        if features_only:
+            return x
         return GPTHead(c, name="head")(x)

@@ -143,7 +143,10 @@ class Llama(nn.Module):
     decode: bool = False   # KV-cache single-token decoding
 
     @nn.compact
-    def __call__(self, input_ids, pos=None):
+    def __call__(self, input_ids, pos=None, features_only=False):
+        """``features_only=True``: pre-head hidden states — see
+        :class:`horovod_tpu.models.gpt.GPT` and
+        :func:`horovod_tpu.optim.next_token_xent_chunked`."""
         c = self.config
         if self.decode and pos is None:
             raise ValueError("decode mode requires pos (the token's "
@@ -153,4 +156,6 @@ class Llama(nn.Module):
                      else LlamaBlock)
         for i in range(c.num_layers):
             x = block_cls(c, decode=self.decode, name=f"layer_{i}")(x)
+        if features_only:
+            return x
         return LlamaHead(c, name="head")(x)

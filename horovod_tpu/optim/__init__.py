@@ -1,3 +1,4 @@
+from horovod_tpu.optim.losses import next_token_xent_chunked  # noqa: F401
 from horovod_tpu.optim.optimizer import (  # noqa: F401
     DistributedOptimizer, allreduce_gradients_transform, fused_allreduce_tree,
     distributed_value_and_grad, broadcast_parameters, broadcast_object_tree,

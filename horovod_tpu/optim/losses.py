@@ -1,0 +1,66 @@
+"""Memory-lean loss kernels for the LM training path.
+
+The standard next-token loss materializes the full ``(B, L, V)`` fp32
+logits tensor — at GPT-2 bench shapes (B=8, L=1024, V=50304) that is
+~1.6 GB of HBM written and re-read per step, the single largest memory
+term of LM training and a direct MFU tax. The reference has no model-level
+code at all (SURVEY.md §5.7 — Horovod operates below the model level);
+this is part of the TPU build's model capability, in the same spirit as
+the flash-attention kernels: restructure the computation so the O(L·V)
+intermediate never exists.
+
+:func:`next_token_xent_chunked` scans the sequence in chunks: each chunk
+runs the head projection + softmax cross-entropy on ``(B, chunk, V)``
+and immediately reduces to scalars; ``jax.checkpoint`` on the scan body
+recomputes the chunk's logits in the backward instead of stashing them.
+Peak logits memory drops from O(L·V) to O(chunk·V) in BOTH passes at the
+cost of one extra head matmul per chunk in the backward.
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+
+def next_token_xent_chunked(head_fn, hidden, labels, chunk=128):
+    """Mean softmax cross-entropy of ``head_fn(hidden)`` against
+    ``labels`` without materializing the full logits tensor.
+
+    - ``head_fn``: maps hidden states ``(B, c, H) -> (B, c, V)`` logits —
+      e.g. ``functools.partial(GPTHead(cfg).apply,
+      {"params": params["head"]})`` (the zoo's heads are separate modules
+      bound under ``params["head"]``, so this composes with
+      ``model.apply(..., features_only=True)``).
+    - ``hidden``: ``(B, L, H)`` pre-head states, ``L`` divisible by
+      ``chunk``.
+    - ``labels``: ``(B, L)`` int targets aligned with positions;
+      ``< 0`` (e.g. -100, :func:`parallel.next_token_labels`' pad)
+      excludes a position from the mean.
+
+    Returns the fp32 scalar mean over valid positions — identical (up to
+    reduction order) to computing full logits and averaging, verified by
+    tests down to gradients.
+    """
+    B, L, H = hidden.shape
+    if L % chunk:
+        raise ValueError(f"sequence length {L} not divisible by "
+                         f"chunk={chunk}")
+    n = L // chunk
+    hidden_c = jnp.moveaxis(hidden.reshape(B, n, chunk, H), 1, 0)
+    labels_c = jnp.moveaxis(labels.reshape(B, n, chunk), 1, 0)
+
+    @jax.checkpoint
+    def body(carry, xs):
+        h, y = xs
+        logits = head_fn(h).astype(jnp.float32)     # (B, chunk, V) — only
+        ce = optax.softmax_cross_entropy_with_integer_labels(
+            logits, jnp.maximum(y, 0))
+        valid = (y >= 0).astype(jnp.float32)
+        tot, cnt = carry
+        return (tot + jnp.sum(ce * valid), cnt + jnp.sum(valid)), None
+
+    (tot, cnt), _ = lax.scan(
+        body, (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.float32)),
+        (hidden_c, labels_c))
+    return tot / jnp.maximum(cnt, 1.0)

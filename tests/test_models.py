@@ -457,6 +457,55 @@ class TestGenerate:
         assert np.asarray(seqs).shape == (2, 10)
         assert np.isfinite(np.asarray(sc)).all()
 
+    @pytest.mark.parametrize("family", ["gpt", "llama"])
+    def test_chunked_xent_matches_full_logits(self, hvd, rng, family):
+        """The chunked head+loss (optim/losses.py — no (B, L, V) logits
+        materialization) must match the full-logits loss AND its
+        gradients, including -100 label masking."""
+        import functools
+
+        from horovod_tpu.models import GPT, GPTConfig, Llama, LlamaConfig
+        from horovod_tpu.models.gpt import GPTHead
+        from horovod_tpu.models.llama import LlamaHead
+        from horovod_tpu.optim import next_token_xent_chunked
+
+        if family == "gpt":
+            model = GPT(GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                                       num_layers=2))
+            head = GPTHead(model.config)
+        else:
+            model = Llama(LlamaConfig.tiny(tp_axis=None, num_layers=2))
+            head = LlamaHead(model.config)
+        from horovod_tpu.parallel import next_token_labels
+        ids = jnp.asarray(rng.integers(0, 256, (2, 16)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), ids)["params"]
+        labels = next_token_labels(ids, axis_name=None)
+
+        def full(p):
+            import optax
+            logits = model.apply({"params": p}, ids)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                logits[:, :-1].astype(jnp.float32), ids[:, 1:]).mean()
+
+        def chunked(p):
+            hidden = model.apply({"params": p}, ids, features_only=True)
+            return next_token_xent_chunked(
+                functools.partial(head.apply, {"params": p["head"]}),
+                hidden, labels, chunk=4)
+
+        lf, gf = jax.value_and_grad(full)(params)
+        lc, gc = jax.value_and_grad(chunked)(params)
+        np.testing.assert_allclose(float(lf), float(lc), rtol=1e-5)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=2e-4, atol=1e-6),
+            gf, gc)
+        with pytest.raises(ValueError, match="divisible"):
+            next_token_xent_chunked(
+                functools.partial(head.apply, {"params": params["head"]}),
+                model.apply({"params": params}, ids, features_only=True),
+                labels, chunk=5)
+
     @pytest.mark.parametrize(
         "family", ["gpt", "gpt_moe", "llama", "bert", "vit", "t5"])
     def test_remat_matches_plain(self, hvd, rng, family):
