@@ -108,3 +108,18 @@ print("device/collective spans:", device[:10])
 assert any("all-reduce" in n or "fusion" in n for n in device), \
     "no device-side collective spans in the merged timeline"
 PY
+
+# 9. MFU A/B sweep (round 3 knobs): capture the roofline lines of each run
+# (stderr) next to the JSON; pick winners into the tracked configs.
+# ResNet-50 stem transform:
+HVD_BENCH_ITERS=20 HVD_BENCH_S2D=1 python bench.py
+# GPT-2 @1024: chunked head+loss, remat, flash tile size
+HVD_BENCH_MODEL=gpt HVD_BENCH_ITERS=10 HVD_BENCH_CHUNKED_XENT=1 python bench.py
+HVD_BENCH_MODEL=gpt HVD_BENCH_ITERS=10 HVD_BENCH_REMAT=1 python bench.py
+HVD_BENCH_MODEL=gpt HVD_BENCH_ITERS=10 HVD_FLASH_BLOCK=256 python bench.py
+# GPT long context with everything on (remat + chunked loss let seq/batch grow)
+HVD_BENCH_MODEL=gpt HVD_BENCH_SEQ=8192 HVD_BENCH_BATCH=1 HVD_BENCH_ITERS=5 \
+    HVD_BENCH_REMAT=1 HVD_BENCH_CHUNKED_XENT=1 python bench.py
+# LLaMA with the same pair
+HVD_BENCH_MODEL=llama HVD_BENCH_ITERS=10 HVD_BENCH_CHUNKED_XENT=1 \
+    HVD_BENCH_REMAT=1 python bench.py
