@@ -295,7 +295,21 @@ def _join_worker():
     out = np.asarray(hvd.allreduce(local, op=hvd.Sum))
     np.testing.assert_allclose(out, np.broadcast_to(full.sum(0), (1, 3)),
                                rtol=1e-5)
-    return (r, last)
+    # SECOND join cycle with the roles swapped: the protocol (and its
+    # round counters) must be reusable after a completed join.
+    if r in (0, 2):
+        last2 = hvd.join()
+    else:
+        act2 = [1, 3]
+        full_act2 = np.stack([base + i for i in act2])
+        out = np.asarray(hvd.allreduce(local, op=hvd.Average))
+        np.testing.assert_allclose(
+            out, np.broadcast_to(full_act2.mean(0), (1, 3)), rtol=1e-5)
+        last2 = hvd.join()
+    out = np.asarray(hvd.allreduce(local, op=hvd.Sum))
+    np.testing.assert_allclose(out, np.broadcast_to(full.sum(0), (1, 3)),
+                               rtol=1e-5)
+    return (r, last, last2)
 
 
 class TestMultiProcessJoin:
@@ -306,8 +320,10 @@ class TestMultiProcessJoin:
                       hosts="localhost:1,127.0.0.1:1,127.0.0.2:1,"
                             "127.0.0.3:1",
                       extra_env={"HOROVOD_JOIN_MODE": "1"})
-        # ranks 0 and 2 joined together in the final round -> last = 2
-        assert sorted(results) == [(0, 2), (1, 2), (2, 2), (3, 2)]
+        # cycle 1: ranks 0 and 2 joined together in the final round ->
+        # last = 2; cycle 2 (roles swapped): ranks 1 and 3 -> last = 3
+        assert sorted(results) == [(0, 2, 3), (1, 2, 3), (2, 2, 3),
+                                   (3, 2, 3)]
 
 
 class TestMultiProcessWorldEight:
