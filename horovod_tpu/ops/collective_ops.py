@@ -240,13 +240,22 @@ def _allreduce_program(mesh, n, op, prescale, postscale, shapes, dtypes,
 
 
 @functools.lru_cache(maxsize=4096)
-def _allgather_program(mesh, n, shapes, dtypes, active_mask=None):
+def _allgather_program(mesh, n, shapes, dtypes, active_mask=None,
+                       hierarchical=False):
     """``active_mask``: joined ranks contribute a zero-size slice, i.e. their
     rows are statically dropped from the concatenated output (reference: JOIN
     gives joined ranks zero-size allgather contributions,
-    controller.cc:269-327)."""
+    controller.cc:269-327). ``hierarchical``: 2-level gather over the
+    (cross, local) mesh2d — ``mesh`` must then be it (knob
+    HOROVOD_HIERARCHICAL_ALLGATHER; reference MPIHierarchicalAllgather)."""
     active_idx = None if active_mask is None else \
         np.nonzero(np.array(active_mask))[0]
+    if hierarchical:
+        from horovod_tpu.common.topology import CROSS_AXIS, LOCAL_AXIS
+        from horovod_tpu.parallel.strategies import allgather_hierarchical
+        spec = P((CROSS_AXIS, LOCAL_AXIS))
+    else:
+        spec = P(HVD_AXIS)
 
     def body(*xs):
         out = []
@@ -254,7 +263,12 @@ def _allgather_program(mesh, n, shapes, dtypes, active_mask=None):
             # x: (1, m, ...) local slice; gather along the stacked axis and
             # flatten to the concatenated layout Horovod returns
             # (reference: collective_operations.h:137-174 size/displacement math).
-            g = lax.all_gather(x, HVD_AXIS, axis=0, tiled=True)  # (n, m, ...)
+            if hierarchical:
+                g = allgather_hierarchical(x[0])             # (n, m, ...)
+                from horovod_tpu.ops.in_jit import mark_varying
+                g = mark_varying(mark_varying(g, CROSS_AXIS), LOCAL_AXIS)
+            else:
+                g = lax.all_gather(x, HVD_AXIS, axis=0, tiled=True)
             if active_idx is not None:
                 g = g[active_idx]
             g = g.reshape((1, -1) + g.shape[2:]) if g.ndim > 1 else g
@@ -262,8 +276,8 @@ def _allgather_program(mesh, n, shapes, dtypes, active_mask=None):
         return tuple(out)
 
     f = jax.shard_map(body, mesh=mesh,
-                      in_specs=tuple(P(HVD_AXIS) for _ in shapes),
-                      out_specs=tuple(P(HVD_AXIS) for _ in shapes))
+                      in_specs=tuple(spec for _ in shapes),
+                      out_specs=tuple(spec for _ in shapes))
     return jax.jit(f)
 
 
@@ -533,7 +547,15 @@ def grouped_allgather(tensors, process_set=None, name=None):
                                         "slices": slices})
     tensors = _prepare(tensors, mesh, n, "allgather")
     shapes, dtypes = _signature(tensors)
-    prog = _allgather_program(mesh, n, shapes, dtypes, active_mask)
+    # HOROVOD_HIERARCHICAL_ALLGATHER: 2-level gather over the (cross,
+    # local) mesh — global set only, and the masked (join) variant stays
+    # flat (the static row-drop composes with the 1-D gather).
+    topo = basics.topology()
+    hier = (basics.config().hierarchical_allgather
+            and ps.ranks is None and active_mask is None
+            and getattr(topo, "mesh2d", None) is not None)
+    prog = _allgather_program(topo.mesh2d if hier else mesh, n, shapes,
+                              dtypes, active_mask, hier)
     with _timeline_op(name or "grouped_allgather", "ALLGATHER"):
         return _localize(list(prog(*tensors)), mesh)
 

@@ -71,6 +71,25 @@ def allreduce_torus(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
     return out
 
 
+def allgather_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS):
+    """2-level allgather: gather within each host's chips first, then one
+    cross-host gather of whole host-blocks (reference:
+    MPIHierarchicalAllgather, mpi_operations.cc — node-local gather then
+    cross-node exchange of node blocks; knob
+    HOROVOD_HIERARCHICAL_ALLGATHER common.h:131).
+
+    ``x`` is this chip's local value; returns ``(n_total, *x.shape)`` in
+    global rank-major order (rank = cross * local_size + local, matching
+    :func:`horovod_tpu.common.topology.build_topology`'s layout) — the
+    same value a flat all_gather produces, but the cross link moves one
+    contiguous block per HOST instead of interleaving per-chip messages
+    (the cross axis of mesh2d is the host boundary, like the reference's
+    node boundary)."""
+    loc = lax.all_gather(x, local_axis, axis=0, tiled=False)
+    full = lax.all_gather(loc, cross_axis, axis=0, tiled=False)
+    return full.reshape((-1,) + x.shape)
+
+
 def allreduce_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
                            average=False):
     """Hierarchical 2-phase allreduce: full local reduce then cross reduce.

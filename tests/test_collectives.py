@@ -106,6 +106,22 @@ class TestAllgather:
         out = np.asarray(hvd.allgather_ragged(parts))
         np.testing.assert_allclose(out, np.concatenate(parts, 0), rtol=1e-6)
 
+    def test_hierarchical_matches_flat(self, hvd, rng):
+        """HOROVOD_HIERARCHICAL_ALLGATHER (2-level cross/local gather,
+        reference MPIHierarchicalAllgather) must be value-identical to
+        the flat gather in global rank order."""
+        from horovod_tpu.common import basics
+        x = _rank_data(rng, (3, 2), np.float32)
+        flat = np.asarray(hvd.allgather(x))
+        cfg = basics.config()
+        old = cfg.hierarchical_allgather
+        cfg.hierarchical_allgather = True
+        try:
+            hier = np.asarray(hvd.allgather(x))
+        finally:
+            cfg.hierarchical_allgather = old
+        np.testing.assert_array_equal(hier, flat)
+
 
 class TestBroadcast:
     @pytest.mark.parametrize("root", [0, 3, 7])

@@ -93,6 +93,21 @@ def _battery(tag):
     np.testing.assert_allclose(out, expect, rtol=1e-5)
     passed.append("allgather_ragged")
 
+    # --- hierarchical allgather (cross_size > 1 here: the rank-ordering
+    # property rank = cross*local_size + local is actually exercised,
+    # unlike the single-process CPU tier where cross=1) ---
+    from horovod_tpu.common import basics as _basics
+    cfg = _basics.config()
+    cfg.hierarchical_allgather = True
+    try:
+        out = np.asarray(hvd.allgather(loc2))
+    finally:
+        cfg.hierarchical_allgather = False
+    expect_h = world(lambda r: np.array([r, r + 0.5])).reshape(-1)
+    np.testing.assert_allclose(out, np.broadcast_to(expect_h, (nl, 2 * n)),
+                               rtol=1e-5)
+    passed.append("allgather_hier")
+
     # --- reducescatter ---
     rs_in = rows(lambda r: np.arange(2 * n) + r)   # (nl, 2n)
     out = np.asarray(hvd.reducescatter(rs_in, op=hvd.Sum))  # (nl, 2)
@@ -159,7 +174,7 @@ def _battery(tag):
 
 
 ALL_OPS = ["allreduce", "grouped_allreduce", "broadcast", "allgather",
-           "allgather_ragged", "reducescatter", "alltoall",
+           "allgather_ragged", "allgather_hier", "reducescatter", "alltoall",
            "alltoall_uneven", "allreduce_async", "object_collectives",
            "barrier"]
 
