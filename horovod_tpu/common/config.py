@@ -88,6 +88,11 @@ class Config:
 
     # --- elastic / process sets (reference common.h:139-143) ---
     elastic: bool = False
+    # Accepted for launcher compatibility; NOT a gate here. The reference
+    # requires HOROVOD_DYNAMIC_PROCESS_SETS=1 before add/remove at runtime
+    # because dynamic sets cost it communicator construction; on TPU a
+    # process set is a sub-mesh + compiled-program cache entry, so dynamic
+    # add/remove is always available (process_sets.py).
     dynamic_process_sets: bool = False
     # Multi-process JOIN (uneven final batches across hosts, reference
     # controller.cc:269-327). The reference's background controller

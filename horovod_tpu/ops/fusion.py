@@ -88,7 +88,8 @@ class FusedHandle:
 
 @functools.lru_cache(maxsize=2048)
 def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
-                   wire_dtype, active_mask=None, strategy="flat"):
+                   wire_dtype, active_mask=None, strategy="flat",
+                   donate=()):
     """One flat-buffer reduction for a whole bucket. ``active_mask`` carries
     join state so async collectives honor the same joined-rank exclusion as
     the sync path (reference: joined_size accounting). ``strategy``:
@@ -156,7 +157,11 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
     f = jax.shard_map(body, mesh=mesh,
                       in_specs=tuple(spec for _ in shapes),
                       out_specs=tuple(spec for _ in shapes))
-    return jax.jit(f)
+    # HOROVOD_DONATE_BUFFERS (default on): staged input stacks nobody
+    # reads again are donated per-argument so XLA reuses their HBM for
+    # the outputs (the reference's persistent fusion buffer is likewise
+    # reused across cycles, fusion_buffer_manager.h:40).
+    return jax.jit(f, donate_argnums=tuple(donate))
 
 
 class FusionRuntime:
@@ -176,6 +181,7 @@ class FusionRuntime:
         self.threshold = config.fusion_threshold
         self.wire_dtype = jnp.dtype(config.wire_dtype).type \
             if config.wire_dtype else None
+        self._donate = bool(config.donate_buffers)
         self._lock = threading.RLock()
         self._pending = []  # (tid, tensor, op, prescale, postscale, handle)
         self._pending_bytes = 0
@@ -742,8 +748,15 @@ class FusionRuntime:
             self._publish_boundary(pending[-1][0], strategy_now, wire_now)
         # Pass 2: build + dispatch.
         for op, pre, post, items, strategy in plan:
-            tensors = [i[0] for i in items]
-            tensors = _prepare(tensors, mesh, n, "fused_allreduce")
+            raw = [i[0] for i in items]
+            # Donate per argument, and only inputs staged from the HOST
+            # (numpy/torch/etc. → device_put always copies): a jax.Array
+            # input with a matching sharding may ALIAS the staged buffer,
+            # and donating it would invalidate the caller's array.
+            donate = tuple(i for i, t in enumerate(raw)
+                           if not isinstance(t, jax.Array)) \
+                if self._donate else ()
+            tensors = _prepare(raw, mesh, n, "fused_allreduce")
             shapes = tuple(tuple(t.shape) for t in tensors)
             dtypes = tuple(str(t.dtype) for t in tensors)
             if self._native is not None:
@@ -754,7 +767,8 @@ class FusionRuntime:
                     hash((op, pre, post, shapes, dtypes)))
             prog_mesh = topo.mesh2d if strategy != "flat" else mesh
             prog = _fused_program(prog_mesh, n, op, pre, post, shapes,
-                                  dtypes, wire_now, active_mask, strategy)
+                                  dtypes, wire_now, active_mask, strategy,
+                                  donate)
             # _timeline_op supplies BOTH the timeline span and the
             # transport-failure → HorovodInternalError translation: a peer
             # dying mid fused collective must be recoverable by the elastic

@@ -157,6 +157,51 @@ class TestParameterManager:
         assert rt.strategy in ("flat", "hierarchical", "torus")
 
 
+class TestFusionDonation:
+    def test_jax_array_inputs_survive_host_inputs_donate(self, hvd):
+        """HOROVOD_DONATE_BUFFERS: host-staged inputs donate their staged
+        buffers (per-argument), but a caller-held jax.Array must NEVER be
+        donated — device_put can alias it, and donation would delete the
+        caller's array."""
+        import jax.numpy as jnp
+
+        from horovod_tpu.ops import fusion
+
+        rt = fusion.get_runtime()
+        assert rt._donate        # default on (HOROVOD_DONATE_BUFFERS)
+        n = hvd.size()
+        donated = []
+        orig = fusion._fused_program
+
+        def spy(*args, **kw):
+            donated.append(kw.get("donate", args[10] if len(args) > 10
+                                  else ()))
+            return orig(*args, **kw)
+
+        fusion._fused_program = spy
+        try:
+            with rt.cycle_paused():
+                # mixed bucket: host numpy + caller-held jax.Array
+                keep = jnp.ones((n, 4)) * 3
+                h1 = rt.enqueue_allreduce(np.ones((n, 4), np.float32), 1,
+                                          1.0, 1.0)
+                h2 = rt.enqueue_allreduce(keep, 1, 1.0, 1.0)
+                rt.flush_all()
+                np.testing.assert_allclose(np.asarray(h1.synchronize()),
+                                           np.full((n, 4), n))
+                np.testing.assert_allclose(np.asarray(h2.synchronize()),
+                                           np.full((n, 4), 3.0 * n))
+        finally:
+            fusion._fused_program = orig
+        # the caller's array is still readable (donation would have
+        # deleted its buffer)...
+        assert float(jnp.sum(keep)) == 3.0 * n * 4
+        # ...and the host-staged argument really was donated while the
+        # jax.Array argument was excluded.
+        flat = [d for call in donated for d in call]
+        assert 0 in flat and 1 not in flat, donated
+
+
 class TestTimelineInJit:
     def test_profile_ingests_jitted_step_spans(self, hvd, tmp_path):
         """The recommended (in-jit) training API must be observable: a

@@ -317,10 +317,10 @@ class TestGroupedAsyncFusion:
         orig = fusion._fused_program
 
         def spy(mesh, n, op, pre, post, shapes, dtypes, wire, mask=None,
-                strategy="flat"):
+                strategy="flat", donate=()):
             calls.append(len(shapes))
             return orig(mesh, n, op, pre, post, shapes, dtypes, wire, mask,
-                        strategy)
+                        strategy, donate)
 
         try:
             fusion._fused_program = spy
