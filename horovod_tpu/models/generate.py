@@ -63,6 +63,35 @@ def _absorb_eos(nxt, done, eos_id):
     return nxt, done | (nxt == eos_id)
 
 
+def _decode_feed(decoder, params):
+    """One cached decode step: feed a (B, 1) token at (traced) position
+    ``t``, return the updated cache and next-token logits (B, V)."""
+
+    def feed(cache, tok, t):
+        logits, upd = decoder.apply(
+            {"params": params, "cache": cache}, tok, pos=t,
+            mutable=["cache"])
+        return upd["cache"], logits[:, 0]
+
+    return feed
+
+
+def _prefill_cache(feed, cache, prompt):
+    """Teacher-force tokens 0..P-2 of ``prompt`` into the cache (the last
+    prompt token is the first decode step's input)."""
+    P = prompt.shape[1]
+    if P <= 1:
+        return cache
+
+    def body(cache, t):
+        tok = lax.dynamic_slice_in_dim(prompt, t, 1, axis=1)
+        cache, _ = feed(cache, tok, t)
+        return cache, None
+
+    cache, _ = lax.scan(body, cache, jnp.arange(0, P - 1))
+    return cache
+
+
 @functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8))
 def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
                      top_k, top_p, eos_id=None):
@@ -76,19 +105,8 @@ def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
     buf = jnp.zeros((B, max_len), jnp.int32)
     buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
-    def feed(cache, tok, t):
-        logits, upd = decoder.apply(
-            {"params": params, "cache": cache}, tok, pos=t,
-            mutable=["cache"])
-        return upd["cache"], logits[:, 0]
-
-    def prefill(cache, t):
-        tok = jax.lax.dynamic_slice_in_dim(prompt, t, 1, axis=1)
-        cache, _ = feed(cache, tok, t)
-        return cache, None
-
-    if P > 1:
-        cache, _ = lax.scan(prefill, cache, jnp.arange(0, P - 1))
+    feed = _decode_feed(decoder, params)
+    cache = _prefill_cache(feed, cache, prompt)
 
     def step(carry, t):
         buf, cache, rng, done = carry
@@ -179,14 +197,17 @@ def beam_expand(logp, bufs, scores, t):
     """One beam expansion shared by the causal and seq2seq searches:
     joint (beam, token) top-k over ``scores + logp``, beams reordered by
     origin, the chosen tokens written at position ``t``.
-    ``logp``: (B, k, V) next-token log-probs; ``bufs``: (B, k, L)."""
+    ``logp``: (B, k, V) next-token log-probs; ``bufs``: (B, k, L).
+    Returns ``(bufs, scores, origin)`` — ``origin[b, j]`` is the previous
+    beam index the new beam j continues (the cached search reorders its
+    KV caches by it; the re-forward searches ignore it)."""
     B, k, V = logp.shape
     cand = (scores[:, :, None] + logp).reshape(B, k * V)
     scores, idx = lax.top_k(cand, k)                    # (B, k)
     beam, tok = idx // V, (idx % V).astype(jnp.int32)
     bufs = jnp.take_along_axis(bufs, beam[:, :, None], axis=1)
     bufs = lax.dynamic_update_slice(bufs, tok[:, :, None], (0, 0, t))
-    return bufs, scores
+    return bufs, scores, beam
 
 
 def beam_best(bufs, scores):
@@ -224,8 +245,8 @@ def beam_step_eos(logp, bufs, scores, fin_bufs, fin_scores, t, prompt_len,
     fin_scores, idx = lax.top_k(all_scores, k)
     fin_bufs = jnp.take_along_axis(all_bufs, idx[:, :, None], axis=1)
     live_logp = logp.at[:, :, eos_id].set(-jnp.inf)
-    bufs, scores = beam_expand(live_logp, bufs, scores, t)
-    return bufs, scores, fin_bufs, fin_scores
+    bufs, scores, origin = beam_expand(live_logp, bufs, scores, t)
+    return bufs, scores, fin_bufs, fin_scores, origin
 
 
 def beam_finalize(bufs, scores, fin_bufs, fin_scores, prompt_len, eos_id,
@@ -262,9 +283,9 @@ def _beam_search(model, params, prompt, max_len, num_beams, eos_id,
         logp = jax.nn.log_softmax(
             logits[:, t - 1].astype(jnp.float32)).reshape(B, k, -1)
         if eos_id is None:
-            bufs, scores = beam_expand(logp, bufs, scores, t)
+            bufs, scores, _ = beam_expand(logp, bufs, scores, t)
         else:
-            bufs, scores, fin_bufs, fin_scores = beam_step_eos(
+            bufs, scores, fin_bufs, fin_scores, _ = beam_step_eos(
                 logp, bufs, scores, fin_bufs, fin_scores, t, P, eos_id,
                 length_penalty)
         return (bufs, scores, fin_bufs, fin_scores), None
@@ -276,8 +297,67 @@ def _beam_search(model, params, prompt, max_len, num_beams, eos_id,
                          length_penalty)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5, 6))
+def _beam_search_cached(decoder, state, prompt, max_len, num_beams, eos_id,
+                        length_penalty):
+    """KV-cache beam search: ONE token per step per hypothesis through
+    the decode-mode model; after each expansion the per-layer caches are
+    REORDERED along the (B*k) batch axis by each new beam's origin, so
+    every cache row always holds its hypothesis's own history. The
+    prompt prefills at batch B once (every beam shares it) and the cache
+    rows are repeated to B*k for the decode scan — 1/k the prefill
+    work."""
+    params, cache = state                    # cache leaves at batch B
+    B, P = prompt.shape
+    k = num_beams
+    Bk = B * k
+    bufs = jnp.zeros((B, k, max_len), jnp.int32)
+    bufs = lax.dynamic_update_slice(
+        bufs, jnp.broadcast_to(prompt[:, None], (B, k, P)), (0, 0, 0))
+    scores = beam_init_scores(B, k)
+    fin_bufs = jnp.zeros_like(bufs)
+    fin_scores = jnp.full((B, k), -jnp.inf, jnp.float32)
+
+    feed = _decode_feed(decoder, params)
+    cache = _prefill_cache(feed, cache, prompt)
+    # beam-minor replication: row b*k + j is (batch b, beam j), matching
+    # bufs.reshape(B*k, L); scalar bookkeeping (the cursor) has no batch
+    # axis and is shared.
+    cache = jax.tree_util.tree_map(
+        lambda c: jnp.repeat(c, k, axis=0)
+        if getattr(c, "ndim", 0) >= 1 and c.shape[0] == B else c, cache)
+
+    def step(carry, t):
+        bufs, scores, fin_bufs, fin_scores, cache = carry
+        tok = lax.dynamic_slice_in_dim(bufs.reshape(Bk, max_len), t - 1, 1,
+                                       axis=1)
+        cache, logits = feed(cache, tok, t - 1)
+        logp = jax.nn.log_softmax(
+            logits.astype(jnp.float32)).reshape(B, k, -1)
+        if eos_id is None:
+            bufs, scores, origin = beam_expand(logp, bufs, scores, t)
+        else:
+            bufs, scores, fin_bufs, fin_scores, origin = beam_step_eos(
+                logp, bufs, scores, fin_bufs, fin_scores, t, P, eos_id,
+                length_penalty)
+        flat_origin = (jnp.arange(B)[:, None] * k + origin).reshape(Bk)
+        # Reorder only batch-carrying leaves; scalar bookkeeping (the
+        # cache cursor) is beam-invariant and has no batch axis.
+        cache = jax.tree_util.tree_map(
+            lambda c: jnp.take(c, flat_origin, axis=0)
+            if getattr(c, "ndim", 0) >= 1 and c.shape[0] == Bk else c,
+            cache)
+        return (bufs, scores, fin_bufs, fin_scores, cache), None
+
+    (bufs, scores, fin_bufs, fin_scores, _), _ = lax.scan(
+        step, (bufs, scores, fin_bufs, fin_scores, cache),
+        jnp.arange(P, max_len))
+    return beam_finalize(bufs, scores, fin_bufs, fin_scores, P, eos_id,
+                         length_penalty)
+
+
 def beam_search(model, params, prompt, max_len, num_beams=4, eos_id=None,
-                length_penalty=0.0):
+                length_penalty=0.0, use_cache=False):
     """Beam-search decoding for the causal LMs: ONE compiled program, k
     hypotheses re-forwarded per step through the same fixed-length-buffer
     scheme as greedy :func:`generate`. Returns ``(sequences, scores)``:
@@ -293,6 +373,12 @@ def beam_search(model, params, prompt, max_len, num_beams=4, eos_id=None,
     GNMT-style ``score / gen_len**alpha`` (generated length including
     the EOS) applied when each hypothesis finishes and to live beams at
     selection; 0 disables.
+
+    ``use_cache``: KV-cache beam decode — O(1) projection work per
+    hypothesis per step, with the per-layer caches reordered by beam
+    origin after every expansion (dense GPT/LLaMA, like
+    :func:`generate`'s cached path). Identical outputs to the
+    re-forward search.
     """
     B, P = prompt.shape
     if not 1 <= P < max_len:
@@ -304,10 +390,20 @@ def beam_search(model, params, prompt, max_len, num_beams=4, eos_id=None,
         raise ValueError(
             f"length_penalty must be >= 0, got {length_penalty}")
     _check_position_capacity(model, max_len)
-    return _beam_search(model, params, jnp.asarray(prompt, jnp.int32),
-                        int(max_len), int(num_beams),
-                        None if eos_id is None else int(eos_id),
-                        float(length_penalty))
+    prompt = jnp.asarray(prompt, jnp.int32)
+    eos = None if eos_id is None else int(eos_id)
+    if use_cache:
+        import dataclasses as _dc
+        decoder = _dc.replace(model, decode=True)
+        # batch-B cache: the prompt prefill is shared across beams and
+        # the rows are repeated to B*k inside the search
+        cache = init_decode_cache(decoder, jnp.zeros((B, 1), jnp.int32),
+                                  pos=0)
+        return _beam_search_cached(decoder, (params, cache), prompt,
+                                   int(max_len), int(num_beams), eos,
+                                   float(length_penalty))
+    return _beam_search(model, params, prompt, int(max_len),
+                        int(num_beams), eos, float(length_penalty))
 
 
 def generate(model, params, prompt, max_len, temperature=0.0, rng=None,

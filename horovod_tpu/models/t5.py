@@ -379,9 +379,9 @@ def _t5_beam(model, params, src_ids, max_len, num_beams, bos_id, src_mask,
         logp = jax.nn.log_softmax(
             logits[:, t - 1].astype(jnp.float32)).reshape(B, k, -1)
         if eos_id is None:
-            bufs, scores = beam_expand(logp, bufs, scores, t)
+            bufs, scores, _ = beam_expand(logp, bufs, scores, t)
         else:
-            bufs, scores, fin_bufs, fin_scores = beam_step_eos(
+            bufs, scores, fin_bufs, fin_scores, _ = beam_step_eos(
                 logp, bufs, scores, fin_bufs, fin_scores, t, 1, eos_id,
                 length_penalty)
         return (bufs, scores, fin_bufs, fin_scores), None

@@ -407,6 +407,32 @@ class TestGenerate:
         np.testing.assert_array_equal(np.asarray(seqs), [[0, 3, 3, 3, 3]])
         assert -3.8 < float(sc[0]) < -3.5, float(sc[0])
 
+    @pytest.mark.parametrize("family", ["gpt", "llama"])
+    def test_cached_beam_matches_reforward_beam(self, hvd, rng, family):
+        """use_cache=True beam search (KV caches reordered by beam origin
+        each expansion) must reproduce the re-forward beam search exactly
+        — sequences and scores, with and without EOS/length penalty."""
+        from horovod_tpu.models import (GPT, GPTConfig, Llama, LlamaConfig,
+                                        beam_search)
+        if family == "gpt":
+            model = GPT(GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                                       num_layers=2,
+                                       max_position_embeddings=12))
+        else:
+            model = Llama(LlamaConfig.tiny(tp_axis=None, num_layers=2,
+                                           max_position_embeddings=12))
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (2, 3)), np.int32))
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        for kw in ({}, {"eos_id": 7, "length_penalty": 1.0}):
+            sf, scf = beam_search(model, params, prompt, 10, num_beams=3,
+                                  **kw)
+            sc, scc = beam_search(model, params, prompt, 10, num_beams=3,
+                                  use_cache=True, **kw)
+            np.testing.assert_array_equal(np.asarray(sc), np.asarray(sf))
+            np.testing.assert_allclose(np.asarray(scc), np.asarray(scf),
+                                       rtol=1e-5, err_msg=str(kw))
+
     def test_eos_cached_matches_full_reforward(self, hvd, rng):
         """use_cache=True must honor eos_id identically to the
         full-re-forward path on a real model."""
