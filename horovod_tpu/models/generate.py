@@ -249,6 +249,18 @@ def beam_step_eos(logp, bufs, scores, fin_bufs, fin_scores, t, prompt_len,
     return bufs, scores, fin_bufs, fin_scores, origin
 
 
+def beam_reorder_cache(cache, origin, B, k):
+    """Reorder decode-cache rows so each new beam inherits its ORIGIN
+    beam's history (shared by the causal and seq2seq cached searches).
+    Only batch-carrying leaves (leading dim B*k) are gathered; scalar
+    bookkeeping (the cache cursor) is beam-invariant."""
+    Bk = B * k
+    flat_origin = (jnp.arange(B)[:, None] * k + origin).reshape(Bk)
+    return jax.tree_util.tree_map(
+        lambda c: jnp.take(c, flat_origin, axis=0)
+        if getattr(c, "ndim", 0) >= 1 and c.shape[0] == Bk else c, cache)
+
+
 def beam_finalize(bufs, scores, fin_bufs, fin_scores, prompt_len, eos_id,
                   length_penalty):
     """Best hypothesis per row across the live beams (normalized by the
@@ -340,13 +352,7 @@ def _beam_search_cached(decoder, state, prompt, max_len, num_beams, eos_id,
             bufs, scores, fin_bufs, fin_scores, origin = beam_step_eos(
                 logp, bufs, scores, fin_bufs, fin_scores, t, P, eos_id,
                 length_penalty)
-        flat_origin = (jnp.arange(B)[:, None] * k + origin).reshape(Bk)
-        # Reorder only batch-carrying leaves; scalar bookkeeping (the
-        # cache cursor) is beam-invariant and has no batch axis.
-        cache = jax.tree_util.tree_map(
-            lambda c: jnp.take(c, flat_origin, axis=0)
-            if getattr(c, "ndim", 0) >= 1 and c.shape[0] == Bk else c,
-            cache)
+        cache = beam_reorder_cache(cache, origin, B, k)
         return (bufs, scores, fin_bufs, fin_scores, cache), None
 
     (bufs, scores, fin_bufs, fin_scores, _), _ = lax.scan(

@@ -393,8 +393,69 @@ def _t5_beam(model, params, src_ids, max_len, num_beams, bos_id, src_mask,
                          length_penalty)
 
 
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 5, 7, 8))
+def _t5_beam_cached(decoder_model, state, src_ids, max_len, num_beams,
+                    bos_id, src_mask, eos_id=None, length_penalty=0.0):
+    """KV-cache seq2seq beam search: encoder once at batch B, per-layer
+    cross-attention K/V primed once and repeated per beam, then ONE
+    decoder token per hypothesis per step with the self-attention caches
+    reordered by beam origin after every expansion (the causal analog:
+    generate._beam_search_cached)."""
+    from horovod_tpu.models.generate import (beam_expand, beam_finalize,
+                                             beam_init_scores,
+                                             beam_reorder_cache,
+                                             beam_step_eos)
+    params, cache = state                       # cache leaves at B*k
+    B, k = src_ids.shape[0], num_beams
+    Bk = B * k
+    memory = decoder_model.apply({"params": params}, src_ids, src_mask,
+                                 method=T5.encode)
+    cross_kv = decoder_model.apply({"params": params}, memory,
+                                   method=T5.project_cross_kv)
+    # memory itself is NOT expanded per beam: with cross_kv supplied the
+    # decode path never reads it (tp.py cross-attention uses the cached
+    # K/V); only the mask and the primed K/V need the per-beam batch.
+    mask_k = None if src_mask is None else jnp.repeat(src_mask, k, axis=0)
+    ckv_k = jax.tree_util.tree_map(lambda c: jnp.repeat(c, k, axis=0),
+                                   cross_kv)
+    bufs = jnp.full((B, k, max_len), bos_id, jnp.int32)
+    scores = beam_init_scores(B, k)
+    fin_bufs = jnp.zeros_like(bufs)
+    fin_scores = jnp.full((B, k), -jnp.inf, jnp.float32)
+
+    def feed(cache, tok, t):
+        logits, upd = decoder_model.apply(
+            {"params": params, "cache": cache}, tok, memory,
+            memory_mask=mask_k, pos=t, cross_kv=ckv_k,
+            method=T5.decode, mutable=["cache"])
+        return upd["cache"], logits[:, 0]
+
+    def step(carry, t):
+        bufs, scores, fin_bufs, fin_scores, cache = carry
+        tok = lax.dynamic_slice_in_dim(bufs.reshape(Bk, max_len), t - 1, 1,
+                                       axis=1)
+        cache, logits = feed(cache, tok, t - 1)
+        logp = jax.nn.log_softmax(
+            logits.astype(jnp.float32)).reshape(B, k, -1)
+        if eos_id is None:
+            bufs, scores, origin = beam_expand(logp, bufs, scores, t)
+        else:
+            bufs, scores, fin_bufs, fin_scores, origin = beam_step_eos(
+                logp, bufs, scores, fin_bufs, fin_scores, t, 1, eos_id,
+                length_penalty)
+        cache = beam_reorder_cache(cache, origin, B, k)
+        return (bufs, scores, fin_bufs, fin_scores, cache), None
+
+    (bufs, scores, fin_bufs, fin_scores, _), _ = lax.scan(
+        step, (bufs, scores, fin_bufs, fin_scores, cache),
+        jnp.arange(1, max_len))
+    return beam_finalize(bufs, scores, fin_bufs, fin_scores, 1, eos_id,
+                         length_penalty)
+
+
 def t5_beam_decode(model, params, src_ids, max_len, num_beams=4, bos_id=0,
-                   src_mask=None, eos_id=None, length_penalty=0.0):
+                   src_mask=None, eos_id=None, length_penalty=0.0,
+                   use_cache=False):
     """Beam-search seq2seq decoding: encoder once, then k hypotheses
     re-forwarded jointly per step (fixed-length buffer). Returns
     ``(sequences, scores)``: (B, max_len) int32 starting with ``bos_id``
@@ -402,7 +463,11 @@ def t5_beam_decode(model, params, src_ids, max_len, num_beams=4, bos_id=0,
     :func:`t5_greedy_decode`. ``eos_id`` / ``length_penalty``: true
     finished-pool semantics with GNMT length normalization (see
     :func:`horovod_tpu.models.beam_search`); ``bos_id == eos_id`` is
-    safe — only the EOS expansion move finishes a hypothesis."""
+    safe — only the EOS expansion move finishes a hypothesis.
+    ``use_cache``: KV-cached beam decode (cross-attention K/V primed
+    once, self-attention caches reordered by beam origin per expansion;
+    ``max_len`` bounded by ``config.max_decode_len``) — identical
+    outputs to the re-forward search."""
     if num_beams < 1:
         raise ValueError(f"num_beams must be >= 1, got {num_beams}")
     if max_len < 2:
@@ -410,10 +475,27 @@ def t5_beam_decode(model, params, src_ids, max_len, num_beams=4, bos_id=0,
     if length_penalty < 0:
         raise ValueError(
             f"length_penalty must be >= 0, got {length_penalty}")
-    return _t5_beam(model, params, jnp.asarray(src_ids, jnp.int32),
+    src_ids = jnp.asarray(src_ids, jnp.int32)
+    eos = None if eos_id is None else int(eos_id)
+    if use_cache:
+        if max_len > model.config.max_decode_len:
+            raise ValueError(
+                f"max_len {max_len} exceeds the decode cache capacity "
+                f"(max_decode_len={model.config.max_decode_len})")
+        from horovod_tpu.models.generate import init_decode_cache
+        decoder = dataclasses.replace(model, decode_mode=True)
+        Bk = src_ids.shape[0] * int(num_beams)
+        cache = init_decode_cache(
+            decoder, jnp.zeros((Bk, 1), jnp.int32),
+            jnp.zeros((Bk, src_ids.shape[1], model.config.hidden_size),
+                      model.config.dtype),
+            pos=0, method=T5.decode)
+        return _t5_beam_cached(decoder, (params, cache), src_ids,
+                               int(max_len), int(num_beams), int(bos_id),
+                               src_mask, eos, float(length_penalty))
+    return _t5_beam(model, params, src_ids,
                     int(max_len), int(num_beams), int(bos_id), src_mask,
-                    None if eos_id is None else int(eos_id),
-                    float(length_penalty))
+                    eos, float(length_penalty))
 
 
 def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,

@@ -433,6 +433,27 @@ class TestGenerate:
             np.testing.assert_allclose(np.asarray(scc), np.asarray(scf),
                                        rtol=1e-5, err_msg=str(kw))
 
+    def test_t5_cached_beam_matches_reforward(self, hvd, rng):
+        """Seq2seq cached beam (cross-KV primed once, self-attention
+        caches beam-reordered) must equal the re-forward T5 beam."""
+        from horovod_tpu.models import T5, T5Config, t5_beam_decode
+        cfg = T5Config.tiny(tp_axis=None)
+        model = T5(cfg)
+        src = jnp.asarray(rng.integers(2, 50, (2, 6)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), src,
+                            src[:, :4])["params"]
+        for kw in ({}, {"eos_id": 1, "length_penalty": 1.0}):
+            sf, scf = t5_beam_decode(model, params, src, 9, num_beams=3,
+                                     **kw)
+            sc, scc = t5_beam_decode(model, params, src, 9, num_beams=3,
+                                     use_cache=True, **kw)
+            np.testing.assert_array_equal(np.asarray(sc), np.asarray(sf))
+            np.testing.assert_allclose(np.asarray(scc), np.asarray(scf),
+                                       rtol=1e-4, err_msg=str(kw))
+        with pytest.raises(ValueError, match="cache capacity"):
+            t5_beam_decode(model, params, src, cfg.max_decode_len + 1,
+                           use_cache=True)
+
     def test_eos_cached_matches_full_reforward(self, hvd, rng):
         """use_cache=True must honor eos_id identically to the
         full-re-forward path on a real model."""
