@@ -9,6 +9,6 @@ from horovod_tpu.models.inception import InceptionV3  # noqa: F401
 from horovod_tpu.models.vit import ViT, ViTConfig  # noqa: F401
 from horovod_tpu.models.llama import Llama, LlamaBlock, LlamaConfig  # noqa: F401
 from horovod_tpu.models.t5 import (  # noqa: F401
-    T5, T5Config, t5_beam_decode, t5_greedy_decode,
+    T5, T5Config, t5_beam_decode, t5_generate, t5_greedy_decode,
 )
 from horovod_tpu.models.generate import beam_search, generate  # noqa: F401

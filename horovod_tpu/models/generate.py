@@ -92,6 +92,22 @@ def _prefill_cache(feed, cache, prompt):
     return cache
 
 
+def sample_or_argmax(logits, rng, temperature, top_k, top_p):
+    """Next token from (B, V) logits — THE sampling branch for every
+    decode path (causal and seq2seq): argmax at temperature 0, else a
+    tempered categorical over the top-k / nucleus filtered distribution
+    (temper BEFORE filtering, the standard top-p semantics). Returns
+    ``(token_ids, rng)`` with the key split exactly once per sampled
+    step, so cached and re-forward decodes share one PRNG stream."""
+    if temperature == 0.0:
+        return jnp.argmax(logits, axis=-1).astype(jnp.int32), rng
+    rng, sub = jax.random.split(rng)
+    nxt = jax.random.categorical(
+        sub, _filter_logits(logits / temperature, top_k,
+                            top_p)).astype(jnp.int32)
+    return nxt, rng
+
+
 @functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8))
 def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
                      top_k, top_p, eos_id=None):
@@ -112,15 +128,8 @@ def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
         buf, cache, rng, done = carry
         tok = jax.lax.dynamic_slice_in_dim(buf, t, 1, axis=1)
         cache, nxt_logits = feed(cache, tok, t)
-        if temperature == 0.0:
-            nxt = jnp.argmax(nxt_logits, axis=-1).astype(jnp.int32)
-        else:
-            rng, sub = jax.random.split(rng)
-            # temper BEFORE filtering (the standard top-p semantics: the
-            # nucleus is taken from the tempered distribution)
-            nxt = jax.random.categorical(
-                sub, _filter_logits(nxt_logits / temperature, top_k,
-                                    top_p)).astype(jnp.int32)
+        nxt, rng = sample_or_argmax(nxt_logits, rng, temperature, top_k,
+                                    top_p)
         nxt, done = _absorb_eos(nxt, done, eos_id)
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t + 1))
         return (buf, cache, rng, done), None
@@ -148,15 +157,8 @@ def _generate(model, params, prompt, max_len, temperature, rng,
         # logits at position t-1 predict token t
         nxt_logits = jax.lax.dynamic_slice_in_dim(
             logits, t - 1, 1, axis=1)[:, 0]         # (B, V)
-        if temperature == 0.0:
-            nxt = jnp.argmax(nxt_logits, axis=-1).astype(jnp.int32)
-        else:
-            rng, sub = jax.random.split(rng)
-            # temper BEFORE filtering (the standard top-p semantics: the
-            # nucleus is taken from the tempered distribution)
-            nxt = jax.random.categorical(
-                sub, _filter_logits(nxt_logits / temperature, top_k,
-                                    top_p)).astype(jnp.int32)
+        nxt, rng = sample_or_argmax(nxt_logits, rng, temperature, top_k,
+                                    top_p)
         nxt, done = _absorb_eos(nxt, done, eos_id)
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t))
         return (buf, rng, done), None

@@ -293,9 +293,9 @@ class T5(nn.Module):
                            memory_mask=src_mask)
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6))
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 9, 10))
 def _t5_greedy(model, params, src_ids, max_len, bos_id, src_mask,
-               eos_id=None):
+               eos_id=None, temperature=0.0, rng=None, top_k=0, top_p=1.0):
     # Module-level jit: flax modules hash by their dataclass config, so
     # repeated decode calls with the same (config, max_len, bos_id, shapes)
     # reuse one compiled program. encode/decode run as methods of the FULL
@@ -305,24 +305,29 @@ def _t5_greedy(model, params, src_ids, max_len, bos_id, src_mask,
                          method=T5.encode)
     B = src_ids.shape[0]
     buf = jnp.full((B, max_len), bos_id, jnp.int32)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
 
     def step(carry, t):
-        buf, done = carry
+        buf, done, rng = carry
         logits = model.apply({"params": params}, buf, memory,
                              memory_mask=src_mask, method=T5.decode)
-        nxt = jnp.argmax(logits[:, t - 1], axis=-1).astype(jnp.int32)
+        from horovod_tpu.models.generate import sample_or_argmax
+        nxt, rng = sample_or_argmax(logits[:, t - 1], rng, temperature,
+                                    top_k, top_p)
         nxt, done = _absorb_eos(nxt, done, eos_id)
         return (lax.dynamic_update_slice(buf, nxt[:, None], (0, t)),
-                done), None
+                done, rng), None
 
-    (buf, _), _ = lax.scan(step, (buf, jnp.zeros((B,), bool)),
-                           jnp.arange(1, max_len))
+    (buf, _, _), _ = lax.scan(step, (buf, jnp.zeros((B,), bool), rng),
+                              jnp.arange(1, max_len))
     return buf
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6))
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 9, 10))
 def _t5_greedy_cached(decoder_model, state, src_ids, max_len, bos_id,
-                      src_mask, eos_id=None):
+                      src_mask, eos_id=None, temperature=0.0, rng=None,
+                      top_k=0, top_p=1.0):
     """KV-cache greedy decode: encoder once, then ONE token per step
     through the decoder's per-layer self-attention caches, with the
     cross-attention K/V primed from the static memory exactly once —
@@ -337,21 +342,26 @@ def _t5_greedy_cached(decoder_model, state, src_ids, max_len, bos_id,
                                    method=T5.project_cross_kv)
     B = src_ids.shape[0]
     buf = jnp.full((B, max_len), bos_id, jnp.int32)
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
 
     def step(carry, t):
-        buf, cache, done = carry
+        buf, cache, done, rng = carry
         tok = lax.dynamic_slice_in_dim(buf, t - 1, 1, axis=1)
         logits, upd = decoder_model.apply(
             {"params": params, "cache": cache}, tok, memory,
             memory_mask=src_mask, pos=t - 1, cross_kv=cross_kv,
             method=T5.decode, mutable=["cache"])
-        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        from horovod_tpu.models.generate import sample_or_argmax
+        nxt, rng = sample_or_argmax(logits[:, 0], rng, temperature, top_k,
+                                    top_p)
         nxt, done = _absorb_eos(nxt, done, eos_id)
         buf = lax.dynamic_update_slice(buf, nxt[:, None], (0, t))
-        return (buf, upd["cache"], done), None
+        return (buf, upd["cache"], done, rng), None
 
-    (buf, _, _), _ = lax.scan(step, (buf, cache, jnp.zeros((B,), bool)),
-                              jnp.arange(1, max_len))
+    (buf, _, _, _), _ = lax.scan(
+        step, (buf, cache, jnp.zeros((B,), bool), rng),
+        jnp.arange(1, max_len))
     return buf
 
 
@@ -498,6 +508,26 @@ def t5_beam_decode(model, params, src_ids, max_len, num_beams=4, bos_id=0,
                     eos, float(length_penalty))
 
 
+def t5_generate(model, params, src_ids, max_len, bos_id=0, src_mask=None,
+                use_cache=False, eos_id=None, temperature=0.0, rng=None,
+                top_k=0, top_p=1.0):
+    """Seq2seq decoding with the causal family's sampling controls:
+    ``temperature=0`` is greedy (== :func:`t5_greedy_decode`); otherwise
+    a tempered categorical draw with optional top-k / nucleus filtering
+    (``rng`` required), on either the re-forward or the KV-cached path.
+    ``eos_id`` finishes rows as in :func:`horovod_tpu.models.generate`."""
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(f"need top_k >= 0 and 0 < top_p <= 1, got "
+                         f"top_k={top_k}, top_p={top_p}")
+    if temperature != 0.0 and rng is None:
+        raise ValueError("sampling (temperature != 0) requires rng")
+    return _t5_decode(model, params, src_ids, max_len, bos_id, src_mask,
+                      use_cache, eos_id, float(temperature), rng,
+                      int(top_k), float(top_p))
+
+
 def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
                      src_mask=None, use_cache=False, eos_id=None):
     """Greedy seq2seq decoding as one compiled program. Default: encoder
@@ -509,12 +539,20 @@ def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
     cross-attention K/V are projected from the static encoder memory
     exactly once (primed, then fed back per step) — O(1) projection work
     per generated token. Returns (B, max_len) int32 starting with
-    ``bos_id``."""
+    ``bos_id``. For sampling, see :func:`t5_generate`."""
+    return _t5_decode(model, params, src_ids, max_len, bos_id, src_mask,
+                      use_cache, eos_id, 0.0, None, 0, 1.0)
+
+
+def _t5_decode(model, params, src_ids, max_len, bos_id, src_mask,
+               use_cache, eos_id, temperature, rng, top_k, top_p):
+    """Shared dispatch for the greedy/sampled seq2seq decodes (validation
+    lives in the public wrappers)."""
     src_ids = jnp.asarray(src_ids, jnp.int32)
     eos = None if eos_id is None else int(eos_id)
     if not use_cache:
         return _t5_greedy(model, params, src_ids, int(max_len), int(bos_id),
-                          src_mask, eos)
+                          src_mask, eos, temperature, rng, top_k, top_p)
     if max_len > model.config.max_decode_len:
         raise ValueError(
             f"max_len {max_len} exceeds the decode cache capacity "
@@ -527,4 +565,5 @@ def t5_greedy_decode(model, params, src_ids, max_len, bos_id=0,
                    model.config.hidden_size), model.config.dtype),
         pos=0, method=T5.decode)
     return _t5_greedy_cached(decoder, (params, cache), src_ids,
-                             int(max_len), int(bos_id), src_mask, eos)
+                             int(max_len), int(bos_id), src_mask, eos,
+                             temperature, rng, top_k, top_p)

@@ -433,6 +433,33 @@ class TestGenerate:
             np.testing.assert_allclose(np.asarray(scc), np.asarray(scf),
                                        rtol=1e-5, err_msg=str(kw))
 
+    def test_t5_sampling(self, hvd, rng):
+        """t5_generate: temperature 0 == greedy on both paths; sampled
+        cached decode equals sampled re-forward decode with the same rng
+        (the PRNG streams align); invalid args fail loudly."""
+        from horovod_tpu.models import (T5, T5Config, t5_generate,
+                                        t5_greedy_decode)
+        cfg = T5Config.tiny(tp_axis=None)
+        model = T5(cfg)
+        src = jnp.asarray(rng.integers(2, 50, (2, 6)), jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), src,
+                            src[:, :4])["params"]
+        greedy = np.asarray(t5_greedy_decode(model, params, src, 8))
+        np.testing.assert_array_equal(
+            np.asarray(t5_generate(model, params, src, 8)), greedy)
+        key = jax.random.PRNGKey(5)
+        s_full = np.asarray(t5_generate(model, params, src, 8,
+                                        temperature=1.0, rng=key,
+                                        top_k=8))
+        s_cached = np.asarray(t5_generate(model, params, src, 8,
+                                          temperature=1.0, rng=key,
+                                          top_k=8, use_cache=True))
+        np.testing.assert_array_equal(s_cached, s_full)
+        with pytest.raises(ValueError, match="requires rng"):
+            t5_generate(model, params, src, 8, temperature=0.7)
+        with pytest.raises(ValueError, match="top_k"):
+            t5_generate(model, params, src, 8, top_k=-1)
+
     def test_t5_cached_beam_matches_reforward(self, hvd, rng):
         """Seq2seq cached beam (cross-KV primed once, self-attention
         caches beam-reordered) must equal the re-forward T5 beam."""
