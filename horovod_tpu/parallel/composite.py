@@ -129,6 +129,12 @@ class _CompositeLM:
     optimizer: Any
     n_micro: int = 4
     aux_weight: float = 0.01
+    # jax.checkpoint each pipelined layer under the gpipe schedule: its
+    # AD transpose otherwise stashes every microbatch's every-layer
+    # activations (the reason 1F1B exists); remat bounds that at one
+    # recompute per layer. The 1F1B schedule recomputes by construction
+    # and ignores this flag. Also armed by config.remat (__post_init__).
+    remat: bool = False
 
     def _build_modules(self):
         raise NotImplementedError
@@ -157,6 +163,11 @@ class _CompositeLM:
                 "sp_axis does not compose with MoE blocks yet "
                 "(num_experts > 0): the router and load-balance aux would "
                 "see only local token shards")
+        # One knob, not two: config.remat (the whole-model flag docs/api.md
+        # advertises) arms the trainer too — the composite builds blocks
+        # directly, so the model-level nn.remat wrapping never runs here.
+        if not self.remat:
+            self.remat = bool(getattr(c, "remat", False))
         self.pp = self.mesh.shape[PPL_AXIS]
         if c.num_layers % self.pp != 0:
             raise ValueError(
@@ -288,7 +299,13 @@ class _CompositeLM:
                 f"local batch {B} not divisible by n_micro={self.n_micro}")
         mbs = x.reshape(self.n_micro, B // self.n_micro, L, c.hidden_size)
 
-        y = pipeline(self._layer_fn, params["stages"], mbs, PPL_AXIS)
+        # remat applies HERE only: gpipe's AD transpose stashes every
+        # microbatch's every-layer activations. 1F1B recomputes from its
+        # own input stash by construction — checkpointing its stage_fwd
+        # would just re-run each forward a second time for no memory win.
+        layer = (jax.checkpoint(self._layer_fn) if self.remat
+                 else self._layer_fn)
+        y = pipeline(layer, params["stages"], mbs, PPL_AXIS)
         y = y.reshape(B, L, c.hidden_size)
         loss = self._head_loss(params["head"], y, ids)
         loss = loss + self.aux_weight * aux

@@ -554,6 +554,27 @@ class TestCompositeLlama:
             losses.append(float(loss))
         return losses
 
+    @pytest.mark.parametrize("family", ["gpt", "llama"])
+    def test_composite_remat_matches_plain(self, hvd, rng, family):
+        """remat=True on the composite (gpipe) trainer — jax.checkpoint
+        around each pipelined layer — must not change the trajectory."""
+        from horovod_tpu.parallel.composite import build_mesh3d
+
+        ids = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
+        import dataclasses
+
+        cls, cfg = self._family(family, None)
+        mesh = build_mesh3d(dp=2, pp=2, tp=2)
+        plain = self._run_traj(cls(cfg, mesh, optax.sgd(0.1), n_micro=2),
+                               ids, "gpipe")
+        remat = self._run_traj(cls(cfg, mesh, optax.sgd(0.1), n_micro=2,
+                                   remat=True), ids, "gpipe")
+        np.testing.assert_allclose(remat, plain, rtol=1e-5, atol=1e-6)
+        # config.remat arms the trainer too (one knob, not two)
+        comp = cls(dataclasses.replace(cfg, remat=True), mesh,
+                   optax.sgd(0.1), n_micro=2)
+        assert comp.remat
+
     @pytest.mark.parametrize("family,schedule", [("llama", "gpipe"),
                                                  ("llama", "1f1b"),
                                                  ("gpt", "gpipe")])
