@@ -65,9 +65,12 @@ class CheckpointManager:
     def save(self, step, state, metrics=None, wait=False):
         """Asynchronously persist ``state`` (any pytree of arrays) at
         ``step``; sharding metadata rides along so multi-chip states restore
-        onto the current mesh."""
+        onto the current mesh. Host-local leaves (step counters, replicated
+        scalars) are lifted to the global mesh first — orbax cannot
+        serialize process-local arrays in a multi-host setting."""
         import orbax.checkpoint as ocp
-        self._mngr.save(int(step), args=ocp.args.StandardSave(state),
+        self._mngr.save(int(step),
+                        args=ocp.args.StandardSave(_globalize(state)),
                         metrics=metrics)
         if wait:
             self._mngr.wait_until_finished()
@@ -84,6 +87,7 @@ class CheckpointManager:
         mesh keep their shardings.
         """
         import jax
+        import numpy as np
         import orbax.checkpoint as ocp
         from jax.sharding import NamedSharding, PartitionSpec
 
@@ -102,10 +106,18 @@ class CheckpointManager:
                 mesh = basics.topology().mesh
         if mesh is not None and mesh.devices.size > 1:
             replicated = NamedSharding(mesh, PartitionSpec())
+            multi = jax.process_count() > 1
 
             def place(a):
                 if isinstance(a, jax.Array) and \
                         len(a.sharding.device_set) < mesh.devices.size:
+                    if multi and a.is_fully_addressable:
+                        # each process restored the full (identical) value
+                        # locally; re-assemble — a device_put would need a
+                        # cross-host transfer the CPU/Gloo backend lacks
+                        host = np.asarray(a)
+                        return jax.make_array_from_process_local_data(
+                            replicated, host, host.shape)
                     return jax.device_put(a, replicated)
                 return a
 
@@ -127,12 +139,47 @@ class CheckpointManager:
         self._mngr.close()
 
 
+def _globalize(state):
+    """Lift host-local leaves onto the global mesh for multi-host saves.
+
+    Under a multi-process launch, orbax refuses process-local arrays
+    ("Cannot serialize host local jax.Array in multi-host setting") —
+    exactly what a replicated step counter or optimizer scalar is. Every
+    process holds the same value for such leaves (the SPMD contract), so
+    they are re-assembled as REPLICATED global arrays; leaves already
+    spanning processes (sharded train state) pass through untouched.
+    No-op single-process or before init."""
+    import jax
+    import numpy as np
+
+    from horovod_tpu.common import basics
+    if jax.process_count() <= 1 or not basics.is_initialized():
+        return state
+    from jax.sharding import NamedSharding, PartitionSpec
+    mesh = basics.topology().mesh
+    rep = NamedSharding(mesh, PartitionSpec())
+
+    def lift(a):
+        # Only host-local jax.Arrays trigger orbax's multi-host refusal;
+        # plain numpy leaves are already treated as replicated (written
+        # from the primary) AND lifting them through the device would
+        # silently downcast 64-bit dtypes under x64-disabled JAX.
+        if isinstance(a, jax.Array) and a.is_fully_addressable:
+            host = np.asarray(a)
+            return jax.make_array_from_process_local_data(rep, host,
+                                                          host.shape)
+        return a
+
+    return jax.tree_util.tree_map(lift, state)
+
+
 def save_state(path, state, wait=True):
-    """One-shot save of a pytree (no versioning)."""
+    """One-shot save of a pytree (no versioning); host-local leaves are
+    lifted to the global mesh like :meth:`CheckpointManager.save`."""
     import orbax.checkpoint as ocp
     path = os.path.abspath(path)
     ckptr = ocp.StandardCheckpointer()
-    ckptr.save(path, state, force=True)
+    ckptr.save(path, _globalize(state), force=True)
     if wait:
         ckptr.wait_until_finished()
     ckptr.close()

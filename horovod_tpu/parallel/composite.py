@@ -133,8 +133,9 @@ class _CompositeLM:
     # AD transpose otherwise stashes every microbatch's every-layer
     # activations (the reason 1F1B exists); remat bounds that at one
     # recompute per layer. The 1F1B schedule recomputes by construction
-    # and ignores this flag. Also armed by config.remat (__post_init__).
-    remat: bool = False
+    # and ignores this flag. None (default) inherits config.remat; an
+    # explicit True/False overrides it either way.
+    remat: Any = None
 
     def _build_modules(self):
         raise NotImplementedError
@@ -166,7 +167,8 @@ class _CompositeLM:
         # One knob, not two: config.remat (the whole-model flag docs/api.md
         # advertises) arms the trainer too — the composite builds blocks
         # directly, so the model-level nn.remat wrapping never runs here.
-        if not self.remat:
+        # None means "inherit"; an explicit False stays False.
+        if self.remat is None:
             self.remat = bool(getattr(c, "remat", False))
         self.pp = self.mesh.shape[PPL_AXIS]
         if c.num_layers % self.pp != 0:

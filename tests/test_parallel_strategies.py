@@ -570,10 +570,14 @@ class TestCompositeLlama:
         remat = self._run_traj(cls(cfg, mesh, optax.sgd(0.1), n_micro=2,
                                    remat=True), ids, "gpipe")
         np.testing.assert_allclose(remat, plain, rtol=1e-5, atol=1e-6)
-        # config.remat arms the trainer too (one knob, not two)
+        # config.remat arms the trainer too (one knob, not two)...
         comp = cls(dataclasses.replace(cfg, remat=True), mesh,
                    optax.sgd(0.1), n_micro=2)
         assert comp.remat
+        # ...and an explicit False overrides the inherited True
+        comp = cls(dataclasses.replace(cfg, remat=True), mesh,
+                   optax.sgd(0.1), n_micro=2, remat=False)
+        assert comp.remat is False
 
     @pytest.mark.parametrize("family,schedule", [("llama", "gpipe"),
                                                  ("llama", "1f1b"),
