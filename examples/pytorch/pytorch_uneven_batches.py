@@ -1,0 +1,64 @@
+"""Uneven final batches across hosts via multi-process join().
+
+The reference's canonical JOIN use case (reference:
+horovod/torch/mpi_ops.py DoJoin, controller.cc:269-327 joined_size
+accounting): hosts with different dataset shard sizes train until each
+runs out, calling ``hvd.join()`` when done — the remaining hosts keep
+averaging over the still-active ranks, and everyone resumes in lockstep
+once the last rank joins.
+
+On TPU this needs ``HOROVOD_JOIN_MODE=1`` on every process (it arms one
+small KV round per global-set eager collective; see docs/api.md). The
+script spawns a real 2-process cluster through the runner so it is
+self-contained on a laptop or the CPU tier.
+"""
+
+from horovod_tpu.runner import run
+
+
+def train():
+    import numpy as np
+    import torch
+
+    import horovod_tpu.torch as hvd
+
+    hvd.init()
+    r, n = hvd.rank(), hvd.size()
+
+    torch.manual_seed(0)
+    w = torch.zeros(4, requires_grad=True)
+    opt = torch.optim.SGD([w], lr=0.1)
+    hvd.broadcast_parameters({"w": w}, root_rank=0)
+
+    # Host r owns 3 + 2*r batches — deliberately uneven.
+    n_batches = 3 + 2 * r
+    target = torch.arange(4.0)
+    steps = 0
+    for b in range(n_batches):
+        opt.zero_grad()
+        loss = ((w - target) ** 2).mean() * (1.0 + 0.1 * b)
+        loss.backward()
+        # Average over the ACTIVE ranks only: after a peer joins, the
+        # divisor shrinks automatically (reference joined_size semantics).
+        w.grad = hvd.allreduce(w.grad, op=hvd.Average, name="grad")
+        opt.step()
+        steps += 1
+    last = hvd.join()          # ran out of data: serve the active peers
+    final = hvd.allreduce(w.detach(), op=hvd.Average, name="final")
+    return (r, steps, last, np.asarray(final).round(4).tolist())
+
+
+def main():
+    results = run(train, hosts="localhost:1,127.0.0.1:1",
+                  extra_env={"HOROVOD_JOIN_MODE": "1"})
+    for r, steps, last, final in results:
+        print(f"rank {r}: trained {steps} uneven batches, "
+              f"last rank to join = {last}")
+    finals = [tuple(f) for _, _, _, f in results]
+    assert len(set(finals)) == 1, finals
+    print(f"replicated final weights: {finals[0]}")
+    print("uneven-batch training with join() complete")
+
+
+if __name__ == "__main__":
+    main()

@@ -113,11 +113,8 @@ class CheckpointManager:
                         len(a.sharding.device_set) < mesh.devices.size:
                     if multi and a.is_fully_addressable:
                         # each process restored the full (identical) value
-                        # locally; re-assemble — a device_put would need a
-                        # cross-host transfer the CPU/Gloo backend lacks
-                        host = np.asarray(a)
-                        return jax.make_array_from_process_local_data(
-                            replicated, host, host.shape)
+                        # locally; re-assemble
+                        return _replicate_local(np.asarray(a), replicated)
                     return jax.device_put(a, replicated)
                 return a
 
@@ -139,6 +136,15 @@ class CheckpointManager:
         self._mngr.close()
 
 
+def _replicate_local(host, replicated_sharding):
+    """Re-assemble one host-local value as a replicated global array —
+    every process supplies its (identical) copy, no cross-host transfer
+    (the CPU/Gloo backend has none)."""
+    import jax
+    return jax.make_array_from_process_local_data(replicated_sharding,
+                                                  host, host.shape)
+
+
 def _globalize(state):
     """Lift host-local leaves onto the global mesh for multi-host saves.
 
@@ -148,7 +154,12 @@ def _globalize(state):
     process holds the same value for such leaves (the SPMD contract), so
     they are re-assembled as REPLICATED global arrays; leaves already
     spanning processes (sharded train state) pass through untouched.
-    No-op single-process or before init."""
+    Lifted leaves are digest-checked across processes first: a leaf that
+    legitimately DIFFERS per process (a rank-folded PRNG key, a local
+    metric) must fail loudly here, not be silently stamped with the
+    primary's value. No-op single-process or before init."""
+    import hashlib
+
     import jax
     import numpy as np
 
@@ -159,18 +170,43 @@ def _globalize(state):
     mesh = basics.topology().mesh
     rep = NamedSharding(mesh, PartitionSpec())
 
-    def lift(a):
+    digests = []
+
+    def lift(path, a):
         # Only host-local jax.Arrays trigger orbax's multi-host refusal;
         # plain numpy leaves are already treated as replicated (written
         # from the primary) AND lifting them through the device would
         # silently downcast 64-bit dtypes under x64-disabled JAX.
         if isinstance(a, jax.Array) and a.is_fully_addressable:
             host = np.asarray(a)
-            return jax.make_array_from_process_local_data(rep, host,
-                                                          host.shape)
+            # list, not tuple: the digest exchange is JSON and must
+            # compare equal after the round-trip
+            digests.append([jax.tree_util.keystr(path),
+                            hashlib.md5(host.tobytes()).hexdigest()[:16]])
+            return _replicate_local(host, rep)
         return a
 
-    return jax.tree_util.tree_map(lift, state)
+    out = jax.tree_util.tree_map_with_path(lift, state)
+    if digests:
+        from horovod_tpu.common import negotiation
+        peers = negotiation.exchange("ckpt_digest", digests)
+        for p, other in enumerate(peers):
+            if other != digests:
+                if len(other) != len(digests):
+                    bad = [f"{len(digests)} leaves here vs "
+                           f"{len(other)} on the peer"]
+                else:
+                    bad = [name for (name, d), (oname, od)
+                           in zip(digests, other)
+                           if d != od or name != oname]
+                raise ValueError(
+                    f"checkpoint save: host-local leaves differ between "
+                    f"process {jax.process_index()} and process {p}: "
+                    f"{bad[:5]} — per-process state (rank-folded PRNG "
+                    f"keys, local metrics) cannot be saved as replicated; "
+                    f"shard it over the mesh or exclude it from the "
+                    f"checkpointed tree")
+    return out
 
 
 def save_state(path, state, wait=True):

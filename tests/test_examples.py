@@ -67,6 +67,11 @@ class TestExamples:
         out = _run("pytorch/pytorch_mnist.py")
         assert "loss" in out
 
+    def test_pytorch_uneven_batches_join(self):
+        out = _run("pytorch/pytorch_uneven_batches.py", timeout=600)
+        assert "last rank to join = 1" in out
+        assert "join() complete" in out
+
     def test_elastic_train(self):
         out = _run("elastic/elastic_train.py")
         assert "max error:" in out

@@ -260,12 +260,37 @@ def _checkpoint_worker(ckpt_dir):
     return "ok"
 
 
+def _checkpoint_mismatch_worker(ckpt_dir):
+    """A host-local leaf that DIFFERS across processes (a rank-folded
+    PRNG key, a local metric) must fail the save loudly — silently
+    stamping the primary's value would corrupt resumes."""
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.checkpoint import CheckpointManager
+
+    mngr = CheckpointManager(ckpt_dir)
+    try:
+        mngr.save(1, {"local": jnp.asarray(float(hvd.process_index()))},
+                  wait=True)
+        return "no-error"
+    except ValueError as e:
+        assert "differ between" in str(e), e
+        return "caught"
+
+
 class TestMultiProcessCheckpoint:
     def test_sharded_save_restore_crosses_processes(self, shared_cluster,
                                                     tmp_path):
         c = shared_cluster(H22)
         results = c.run(_checkpoint_worker, args=(str(tmp_path),))
         assert results == ["ok", "ok"]
+
+    def test_per_process_leaf_fails_loudly(self, shared_cluster, tmp_path):
+        c = shared_cluster(H22)
+        results = c.run(_checkpoint_mismatch_worker,
+                        args=(str(tmp_path / "bad"),))
+        assert results == ["caught", "caught"]
 
 
 def _async_cycle_worker():
