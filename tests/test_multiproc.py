@@ -380,6 +380,12 @@ def _join_worker():
         out = np.asarray(hvd.broadcast(local, root_rank=2))
         np.testing.assert_allclose(out, np.broadcast_to(base + 2, (1, 3)),
                                    rtol=1e-5)
+        # async rides the sync bypass while armed (fusion can't open the
+        # join round at enqueue time) — and still masks the joined ranks
+        h = hvd.allreduce_async(local, op=hvd.Sum, name="armed")
+        np.testing.assert_allclose(
+            np.asarray(h.synchronize()),
+            np.broadcast_to(full_act.sum(0), (1, 3)), rtol=1e-5)
         last = hvd.join()
     # Everyone returns the last round's highest newly-joined rank, and the
     # join state has reset: a full-world collective works again.
