@@ -886,11 +886,17 @@ def _join_round(payload):
 
 
 def _join_sync(ps, mesh, desc):
-    """Pre-dispatch hook for every eager collective: the armed-mode join
-    round (or the plain local mask when not armed). Must run BEFORE any
-    other cross-process interaction of the op (``_prepare``'s order check,
-    size negotiations) so active and mirroring processes interleave their
-    control-plane exchanges in the same order."""
+    """Pre-dispatch hook for every eager collective: fence in-flight
+    fused ASYNC work (so sync and async device collectives submit in the
+    same order on every process — see FusionRuntime.fence), then the
+    armed-mode join round (or the plain local mask when not armed). Must
+    run BEFORE any other cross-process interaction of the op
+    (``_prepare``'s order check, size negotiations) so active and
+    mirroring processes interleave their control-plane exchanges in the
+    same order."""
+    st = basics._get_state()
+    if st.fusion is not None:
+        st.fusion.fence()
     if not _join_armed():
         return _active_mask(ps)
     if ps.ranks is not None:
@@ -902,7 +908,6 @@ def _join_sync(ps, mesh, desc):
                 "process cannot mirror ops on meshes it is not "
                 "synchronized with")
         return _active_mask(ps)
-    st = basics._get_state()
     _, local_pos = _local_mesh_info(mesh)
     mine = sorted(st.joined_ranks.intersection(local_pos))
     joined, descs = _join_round({"joined": mine, "desc": desc})

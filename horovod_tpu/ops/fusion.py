@@ -451,6 +451,25 @@ class FusionRuntime:
             except Exception:  # noqa: BLE001 — must not kill the thread
                 pass
 
+    def fence(self):
+        """Order a SYNC eager collective after all in-flight fused async
+        work on EVERY process. Without this, the coordinator submits
+        [fused-flush, sync-op] while a lagging follower submits
+        [sync-op, fused-flush] — mismatched device-collective order, a
+        hang or corruption (the reference avoids the class by routing
+        every collective through one controller queue). Coordinator:
+        flush now (publishing the boundary). Follower: apply boundaries
+        until nothing is pending — the SPMD contract guarantees the
+        coordinator's fence flushed the same pending set, so the covering
+        boundary exists or is in flight. Single-process: device
+        submission order is program order already."""
+        if not self._multi:
+            return
+        # Coordinator: flush_all; follower: drain boundaries until the
+        # last enqueued tid is covered (== pending empty, since fence
+        # runs on the enqueuing thread) — exactly ensure_flushed().
+        self.ensure_flushed()
+
     def ensure_flushed(self, tid=None, block=True):
         """Make sure the bucket containing ``tid`` has been dispatched.
         Coordinator / single process: flush everything (the classic

@@ -320,12 +320,54 @@ def _async_cycle_worker():
     return "ok"
 
 
+def _async_sync_interleave_worker():
+    """Sync eager collectives interleaved with in-flight async enqueues:
+    the sync-op fence must keep the device-collective submission order
+    identical on every process (coordinator: flush-then-sync; followers:
+    apply-boundary-then-sync) — without it the orders can invert on a
+    lagging follower and the job hangs or corrupts."""
+    import time
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    n = hvd.size()
+    nl = len(hvd.topology().local_device_ranks)
+    handles = []
+    for i in range(40):
+        h = hvd.allreduce_async(np.full((nl, 4), float(i), np.float32),
+                                op=hvd.Sum, name=f"s{i}")
+        handles.append((i, h))
+        if i % 9 == 4:
+            time.sleep(0.004)       # let the coordinator's cycle fire
+        if i % 11 == 6:
+            # a SYNC collective lands mid-stream (the hazard case)
+            out = np.asarray(hvd.allreduce(np.ones((nl, 2), np.float32),
+                                           op=hvd.Sum))
+            np.testing.assert_allclose(out, np.full((nl, 2), float(n)),
+                                       rtol=1e-5)
+    for i, h in handles:
+        np.testing.assert_allclose(np.asarray(h.synchronize()),
+                                   np.full((nl, 4), i * n), rtol=1e-5)
+    return "ok"
+
+
 class TestMultiProcessAsyncCycle:
     def test_subthreshold_flush_without_synchronize_world4(self,
                                                            shared_cluster):
         c = shared_cluster("localhost:1,127.0.0.1:1,127.0.0.2:1,"
                            "127.0.0.3:1")
         assert c.run(_async_cycle_worker) == ["ok"] * 4
+
+    def test_sync_interleaved_with_async_world4(self, shared_cluster):
+        c = shared_cluster("localhost:1,127.0.0.1:1,127.0.0.2:1,"
+                           "127.0.0.3:1")
+        assert c.run(_async_sync_interleave_worker) == ["ok"] * 4
+
+    def test_sync_interleaved_with_async_2x2(self, shared_cluster):
+        assert shared_cluster(H22).run(
+            _async_sync_interleave_worker) == ["ok", "ok"]
 
 
 def _join_worker():
