@@ -337,10 +337,18 @@ def ici_built():
 # --- timeline control (reference: horovod_start_timeline, operations.cc:1079) ---
 
 def start_timeline(file_path, mark_cycles=False):
+    """Start (or restart) the Chrome-trace timeline.
+
+    Multi-process: the COORDINATOR (process 0) writes ``file_path``, like
+    the reference's rank-0 timeline writer (timeline.cc); every other
+    process writes ``file_path.p<index>`` — same observability per host
+    without processes clobbering one shared file."""
     st = _get_state()
     from horovod_tpu.timeline import Timeline
     if st.timeline is not None:
         st.timeline.close()
+    if jax.process_count() > 1 and jax.process_index() != 0:
+        file_path = f"{file_path}.p{jax.process_index()}"
     st.timeline = Timeline(file_path, mark_cycles=mark_cycles)
     return st.timeline
 

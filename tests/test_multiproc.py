@@ -260,6 +260,28 @@ def _checkpoint_worker(ckpt_dir):
     return "ok"
 
 
+def _timeline_worker(tl_dir):
+    """Per-process timeline paths under a multi-process launch: the
+    coordinator writes the configured file, others suffix .p<index> —
+    no clobbering one shared file (reference: rank-0 timeline writer)."""
+    import os
+
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import basics
+
+    path = os.path.join(tl_dir, "t.json")
+    basics.start_timeline(path)
+    hvd.allreduce(np.ones((len(hvd.topology().local_device_ranks), 2),
+                          np.float32))
+    basics.stop_timeline()
+    expect = path if hvd.process_index() == 0 \
+        else f"{path}.p{hvd.process_index()}"
+    assert os.path.exists(expect), expect
+    return os.path.basename(expect)
+
+
 def _checkpoint_mismatch_worker(ckpt_dir):
     """A host-local leaf that DIFFERS across processes (a rank-folded
     PRNG key, a local metric) must fail the save loudly — silently
@@ -291,6 +313,11 @@ class TestMultiProcessCheckpoint:
         results = c.run(_checkpoint_mismatch_worker,
                         args=(str(tmp_path / "bad"),))
         assert results == ["caught", "caught"]
+
+    def test_timeline_per_process_paths(self, shared_cluster, tmp_path):
+        c = shared_cluster(H22)
+        results = c.run(_timeline_worker, args=(str(tmp_path),))
+        assert results == ["t.json", "t.json.p1"]
 
 
 def _async_cycle_worker():
