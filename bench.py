@@ -29,6 +29,7 @@ _T0 = time.perf_counter()
 def _mark(msg):
     print(f"# [{time.perf_counter() - _T0:7.1f}s] {msg}", file=sys.stderr,
           flush=True)
+    _watchdog_kick()              # progress resets the inactivity guard
 
 
 def _wait_for_backend(total_wait=240, probe_timeout=75):
@@ -181,7 +182,63 @@ def _timed_steps(step, state, data, warmup=2):
     return iters, dt
 
 
+_WATCHDOG = None
+_WATCHDOG_SECS = None
+
+
+def _emit_failure(metric, unit, error):
+    """The ONE parseable failure-record shape (shared by the watchdog and
+    the __main__ handler so the driver's parser sees one schema)."""
+    print(json.dumps({
+        "metric": metric, "value": 0.0, "unit": unit, "vs_baseline": 0.0,
+        "error": error,
+    }), flush=True)
+
+
+def _arm_watchdog(seconds, metric, unit):
+    """INACTIVITY guard for mid-run hangs: the tunnel can die inside a
+    device get, where no Python exception (or signal handler — the
+    interpreter never regains control) will fire. A daemon timer prints
+    the parseable failure JSON and exits hard. Every progress line
+    (:func:`_mark`) re-arms it, so the deadline bounds silence, not total
+    runtime — long contexts / many iters stay alive as long as they keep
+    marking."""
+    global _WATCHDOG_SECS
+    _WATCHDOG_SECS = (seconds, metric, unit)
+    _watchdog_kick()
+
+
+def _watchdog_kick():
+    import threading
+
+    global _WATCHDOG
+    if _WATCHDOG_SECS is None:
+        return
+    seconds, metric, unit = _WATCHDOG_SECS
+    if _WATCHDOG is not None:
+        _WATCHDOG.cancel()
+
+    def boom():
+        _emit_failure(metric, unit,
+                      f"bench watchdog: no progress for {seconds:.0f}s — "
+                      f"device hang mid-run (tunnel death?)")
+        os._exit(1)
+
+    _WATCHDOG = threading.Timer(seconds, boom)
+    _WATCHDOG.daemon = True
+    _WATCHDOG.start()
+
+
+def _watchdog_cancel():
+    global _WATCHDOG, _WATCHDOG_SECS
+    _WATCHDOG_SECS = None
+    if _WATCHDOG is not None:
+        _WATCHDOG.cancel()
+        _WATCHDOG = None
+
+
 def _emit(metric, value, unit, vs_baseline):
+    _watchdog_cancel()
     print(json.dumps({
         "metric": metric,
         "value": value,
@@ -512,6 +569,9 @@ _EXTRA_MODELS = {
 def main():
     import horovod_tpu as hvd
 
+    metric, unit = _failure_metric()
+    _arm_watchdog(float(os.environ.get("HVD_BENCH_WATCHDOG", "1500")),
+                  metric, unit)
     _wait_for_backend()
     _init_with_retry(hvd)
     _mark("hvd.init done")
@@ -539,13 +599,12 @@ if __name__ == "__main__":
     try:
         sys.exit(main())
     except Exception as e:  # noqa: BLE001
-        # Emit a parseable failure record so the round is never scored blind.
+        # Emit a parseable failure record so the round is never scored
+        # blind (cancel the watchdog FIRST: its boom() racing this print
+        # could interleave two JSON lines or truncate this one).
+        _watchdog_cancel()
         metric, unit = _failure_metric()
-        print(json.dumps({
-            "metric": metric,
-            "value": 0.0,
-            "unit": unit,
-            "vs_baseline": 0.0,
-            "error": (str(e).splitlines() or ["?"])[0][:200] or repr(e)[:200],
-        }))
+        _emit_failure(
+            metric, unit,
+            (str(e).splitlines() or ["?"])[0][:200] or repr(e)[:200])
         sys.exit(1)
