@@ -239,11 +239,18 @@ def _watchdog_cancel():
 
 def _emit(metric, value, unit, vs_baseline):
     _watchdog_cancel()
+    # platform: lets evidence consumers (scripts/evidence_sentinel.py)
+    # reject a silent CPU fallback masquerading as an on-chip number.
+    try:
+        platform = jax.devices()[0].platform
+    except Exception:  # noqa: BLE001
+        platform = "unknown"
     print(json.dumps({
         "metric": metric,
         "value": value,
         "unit": unit,
         "vs_baseline": vs_baseline,
+        "platform": platform,
     }))
 
 

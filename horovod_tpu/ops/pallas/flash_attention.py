@@ -575,6 +575,14 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
     if sm_scale is None:
         sm_scale = 1.0 / (d ** 0.5)
 
+    def plain_fallback():
+        """local_attention with any custom scale folded into q (it scales
+        by 1/sqrt(D) internally, and broadcasts grouped K/V itself)."""
+        from horovod_tpu.parallel.sequence import local_attention
+        q_adj = q if sm_scale == 1.0 / (d ** 0.5) \
+            else q * (sm_scale * d ** 0.5)
+        return local_attention(q_adj, k, v, causal=causal)
+
     # Interpret mode (CPU tests) lowers the kernel body to ordinary JAX ops,
     # whose internal dynamic_slices the shard_map VMA checker rejects when
     # the operands are device-varying; the plain path is bit-compatible
@@ -582,20 +590,28 @@ def flash_attention(q, k, v, causal=False, sm_scale=None):
     vma = frozenset().union(*(getattr(jax.typeof(t), "vma", frozenset())
                               for t in (q, k, v)))
     if pltpu is None or (_interpret() and vma):
-        from horovod_tpu.parallel.sequence import local_attention
-        # local_attention scales by 1/sqrt(D); fold any custom scale into q.
-        q_adj = q if sm_scale == 1.0 / (d ** 0.5) \
-            else q * (sm_scale * d ** 0.5)
-        if kv != h:
-            k = jnp.repeat(k, h // kv, axis=2)
-            v = jnp.repeat(v, h // kv, axis=2)
-        return local_attention(q_adj, k, v, causal=causal)
+        return plain_fallback()
 
     # Pad only genuinely unaligned lengths (e.g. ViT's 196): aligned ones
     # keep their unpadded, unmasked kernels (no pad copy, no mask work).
     pad_q = 0 if _pick_block(lq) else (-lq) % 128
     pad_k = 0 if _pick_block(lk) else (-lk) % 128
     lq_p, lk_p = lq + pad_q, lk + pad_k
+
+    # SAFETY GATE: the padded-kernel path once HUNG on real silicon (ViT
+    # 197->256, >20 min with no progress — undiagnosed; the kv_valid
+    # masking/padded-grid interaction under Mosaic is the prime suspect,
+    # see docs/troubleshooting.md "Padded flash attention"). Until it is
+    # validated on-chip, unaligned lengths on REAL TPU fall back to plain
+    # XLA attention; HVD_FLASH_ALLOW_PADDED=1 re-enables the kernels (the
+    # on-chip validation queue runs exactly that, bounded). Interpret mode
+    # (CPU tests) keeps the padded kernels — they are correct there and
+    # serve as the oracle. Reference analog: CUDA kernels are CI-exercised
+    # on hardware before they ship (horovod/common/ops/cuda/).
+    if (pad_q or pad_k) and not _interpret():
+        import os
+        if os.environ.get("HVD_FLASH_ALLOW_PADDED", "0") != "1":
+            return plain_fallback()
 
     def to3(t, pad):
         nh = t.shape[2]

@@ -1,0 +1,360 @@
+#!/usr/bin/env python
+"""Automated evidence sentinel: catch the next TPU-tunnel-up window.
+
+Three rounds of human-timed benchmark capture produced zero driver-verified
+perf numbers because the tunnelled TPU backend (memory: the 'axon' tunnel)
+goes down for multi-hour stretches and every first device touch HANGS
+rather than erroring.  This sentinel makes capture automatic:
+
+- probes the backend from a KILLABLE subprocess on a loop (bounded
+  ``--probe-timeout``, default 120 s), appending every attempt to a
+  committed probe log (``docs/bench_runs/probe_log.jsonl``) so a round with
+  zero numbers still carries proof the tunnel never answered;
+- the moment a probe succeeds, works through a prioritized queue of
+  evidence configs — the on-chip validation smokes (scripts/onchip/*.py),
+  the tracked benchmark configs (ResNet-50 / BERT / GPT-2 / LLaMA / T5 /
+  ViT), and the MFU A/B sweep (space-to-depth stem, chunked xent, remat,
+  flash tile size, long context) — each run in a bounded subprocess with
+  stdout JSON + roofline stderr captured to ``docs/bench_runs/``;
+- re-probes between configs so a mid-sweep tunnel death stops the sweep
+  cleanly (every completed config is already on disk), and retries failed
+  configs (up to ``MAX_TRIES``) on later windows;
+- path-scoped git commits of ``docs/bench_runs`` after every batch, so
+  evidence survives even if the session ends mid-window.
+
+Run it for the whole session, e.g. in tmux:
+
+    tmux new-session -d -s sentinel 'python scripts/evidence_sentinel.py'
+
+Reference bar this answers: the reference's benchmarks are captured by a
+standing procedure, not ad-hoc runs (reference: docs/benchmarks.rst:15-64).
+
+The sentinel itself never imports jax — a poisoned backend can only hang
+its subprocesses, which it kills.
+"""
+import argparse
+import json
+import os
+import subprocess
+import sys
+import time
+from datetime import datetime, timezone
+from pathlib import Path
+
+REPO = Path(__file__).resolve().parent.parent
+RUNS = REPO / "docs" / "bench_runs"
+PROBE_LOG = RUNS / "probe_log.jsonl"
+STATE = RUNS / "state.json"
+SUMMARY = RUNS / "summary.json"
+MAX_TRIES = 3
+
+# Ordered evidence queue: (name, kind, env-overrides, timeout-seconds).
+# kind "bench" runs `python bench.py`; kind "script" runs the given file.
+# Highest-leverage first so even a short tunnel window yields the headline
+# number, the kernel-lowering smokes, and the undiagnosed-ViT diagnostic.
+CONFIGS = [
+    # -- headline tracked configs (BASELINE.md / docs/benchmarks.md) ------
+    ("resnet50", "bench", {"HVD_BENCH_ITERS": "20"}, 1800),
+    ("bert", "bench", {"HVD_BENCH_MODEL": "bert", "HVD_BENCH_ITERS": "10"},
+     1800),
+    ("gpt", "bench", {"HVD_BENCH_MODEL": "gpt", "HVD_BENCH_ITERS": "10"},
+     1800),
+    # -- kernel lowering smokes (never yet executed on silicon) -----------
+    ("smoke_flash_ring", "script", {}, 900),
+    ("smoke_gqa_flash", "script", {}, 900),
+    # -- the undiagnosed ViT padded-flash hang: tiny bounded diagnostic
+    #    first (memory: onchip-validation-queue), then the padded kernel
+    #    FORCED on (the gated path) to test the hang hypothesis, then the
+    #    full default bench (gate makes the default safe).
+    ("vit_diag", "bench", {"HVD_BENCH_MODEL": "vit", "HVD_BENCH_ITERS": "2",
+                           "HVD_BENCH_BATCH": "16"}, 1200),
+    ("vit_padded_forced", "bench",
+     {"HVD_BENCH_MODEL": "vit", "HVD_BENCH_ITERS": "2",
+      "HVD_BENCH_BATCH": "16", "HVD_FLASH_ALLOW_PADDED": "1"}, 1200),
+    ("vit", "bench", {"HVD_BENCH_MODEL": "vit", "HVD_BENCH_ITERS": "10"},
+     1800),
+    # -- remaining model zoo ----------------------------------------------
+    ("llama", "bench", {"HVD_BENCH_MODEL": "llama",
+                        "HVD_BENCH_ITERS": "10"}, 1800),
+    ("t5", "bench", {"HVD_BENCH_MODEL": "t5", "HVD_BENCH_ITERS": "10"},
+     1800),
+    ("smoke_int8_allreduce", "script", {}, 900),
+    ("smoke_timeline_xplane", "script", {}, 900),
+    # -- A/B references ----------------------------------------------------
+    ("bert_noflash", "bench", {"HVD_BENCH_MODEL": "bert",
+                               "HVD_BENCH_FLASH": "0",
+                               "HVD_BENCH_ITERS": "10"}, 1800),
+    # -- MFU sweep (VERDICT r3 task 3): one window yields the full matrix --
+    ("resnet50_s2d", "bench", {"HVD_BENCH_ITERS": "20",
+                               "HVD_BENCH_S2D": "1"}, 1800),
+    ("resnet50_b128", "bench", {"HVD_BENCH_ITERS": "20",
+                                "HVD_BENCH_BATCH": "128"}, 1800),
+    ("resnet50_b512", "bench", {"HVD_BENCH_ITERS": "20",
+                                "HVD_BENCH_BATCH": "512"}, 1800),
+    ("resnet50_s2d_b512", "bench", {"HVD_BENCH_ITERS": "20",
+                                    "HVD_BENCH_S2D": "1",
+                                    "HVD_BENCH_BATCH": "512"}, 1800),
+    ("gpt_chunked_xent", "bench", {"HVD_BENCH_MODEL": "gpt",
+                                   "HVD_BENCH_ITERS": "10",
+                                   "HVD_BENCH_CHUNKED_XENT": "1"}, 1800),
+    ("gpt_remat", "bench", {"HVD_BENCH_MODEL": "gpt",
+                            "HVD_BENCH_ITERS": "10",
+                            "HVD_BENCH_REMAT": "1"}, 1800),
+    ("gpt_block256", "bench", {"HVD_BENCH_MODEL": "gpt",
+                               "HVD_BENCH_ITERS": "10",
+                               "HVD_FLASH_BLOCK": "256"}, 1800),
+    ("gpt_8k", "bench", {"HVD_BENCH_MODEL": "gpt", "HVD_BENCH_SEQ": "8192",
+                         "HVD_BENCH_BATCH": "1", "HVD_BENCH_ITERS": "5",
+                         "HVD_BENCH_REMAT": "1",
+                         "HVD_BENCH_CHUNKED_XENT": "1"}, 2400),
+    ("gpt_32k", "bench", {"HVD_BENCH_MODEL": "gpt", "HVD_BENCH_SEQ": "32768",
+                          "HVD_BENCH_BATCH": "1", "HVD_BENCH_ITERS": "3",
+                          "HVD_BENCH_REMAT": "1",
+                          "HVD_BENCH_CHUNKED_XENT": "1"}, 2400),
+    ("llama_chunked_remat", "bench",
+     {"HVD_BENCH_MODEL": "llama", "HVD_BENCH_ITERS": "10",
+      "HVD_BENCH_CHUNKED_XENT": "1", "HVD_BENCH_REMAT": "1"}, 1800),
+]
+
+SCRIPTS = {
+    "smoke_flash_ring": "scripts/onchip/flash_ring.py",
+    "smoke_gqa_flash": "scripts/onchip/gqa_flash.py",
+    "smoke_int8_allreduce": "scripts/onchip/int8_allreduce.py",
+    "smoke_timeline_xplane": "scripts/onchip/timeline_xplane.py",
+}
+
+
+def _now():
+    return datetime.now(timezone.utc).isoformat(timespec="seconds")
+
+
+def _log(msg):
+    print(f"[{_now()}] {msg}", flush=True)
+
+
+def _append(path, record):
+    path.parent.mkdir(parents=True, exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
+
+
+def _load_state():
+    if STATE.exists():
+        return json.loads(STATE.read_text())
+    return {"tries": {}, "done": {}}
+
+
+def _save_state(state):
+    STATE.parent.mkdir(parents=True, exist_ok=True)
+    STATE.write_text(json.dumps(state, indent=1, sort_keys=True))
+
+
+def probe(timeout):
+    """One bounded backend probe in a killable subprocess."""
+    t0 = time.time()
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c",
+             "import jax; d=jax.devices(); "
+             "print(len(d), d[0].platform, d[0].device_kind)"],
+            capture_output=True, text=True, timeout=timeout, cwd=REPO)
+        dt = round(time.time() - t0, 1)
+        if r.returncode == 0 and r.stdout.strip():
+            # A CPU fallback answering the probe must NOT count as a
+            # tunnel window — the sweep would burn every config's tries
+            # on CPU and record CPU numbers as evidence.
+            if "tpu" not in r.stdout.lower():
+                return False, dt, f"non-TPU backend: {r.stdout.strip()[:120]}"
+            return True, dt, r.stdout.strip()
+        tail = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["?"]
+        return False, dt, f"rc={r.returncode}: {tail[0][:160]}"
+    except subprocess.TimeoutExpired:
+        return False, round(time.time() - t0, 1), f"hung >{timeout}s (killed)"
+
+
+def _parse_bench_json(stdout):
+    """Last parseable JSON line of a bench run (the driver contract)."""
+    for line in reversed(stdout.strip().splitlines()):
+        line = line.strip()
+        if line.startswith("{"):
+            try:
+                return json.loads(line)
+            except json.JSONDecodeError:
+                continue
+    return None
+
+
+def run_config(name, kind, env_over, timeout):
+    """Run one evidence config bounded; write <name>.json + <name>.log."""
+    env = dict(os.environ)
+    env.update(env_over)
+    if kind == "bench":
+        cmd = [sys.executable, "bench.py"]
+    else:
+        cmd = [sys.executable, SCRIPTS[name]]
+    _log(f"running {name} ({' '.join(f'{k}={v}' for k, v in env_over.items())}"
+         f") timeout={timeout}s")
+    t0 = time.time()
+    try:
+        r = subprocess.run(cmd, capture_output=True, text=True,
+                           timeout=timeout, env=env, cwd=REPO)
+        rc, out, err = r.returncode, r.stdout, r.stderr
+        timed_out = False
+    except subprocess.TimeoutExpired as e:
+        rc, timed_out = -1, True
+        out = (e.stdout or b"").decode() if isinstance(e.stdout, bytes) \
+            else (e.stdout or "")
+        err = (e.stderr or b"").decode() if isinstance(e.stderr, bytes) \
+            else (e.stderr or "")
+    dt = round(time.time() - t0, 1)
+    parsed = _parse_bench_json(out) if kind == "bench" else None
+    # Evidence bar: a bench config only counts when it measured on REAL
+    # TPU (bench.py stamps `platform`; the smoke scripts assert it
+    # themselves) — a silent CPU fallback mid-window must not mark a
+    # config done or commit a CPU number as on-chip evidence.
+    ok = (parsed is not None and parsed.get("value", 0) > 0
+          and "error" not in parsed
+          and parsed.get("platform") == "tpu") if kind == "bench" \
+        else (rc == 0 and not timed_out)
+    record = {
+        "name": name, "ts": _now(), "ok": ok, "rc": rc,
+        "timed_out": timed_out, "seconds": dt, "env": env_over,
+        "result": parsed if kind == "bench" else {"stdout_tail":
+                                                  out.strip()[-500:]},
+    }
+    (RUNS / f"{name}.json").write_text(json.dumps(record, indent=1))
+    (RUNS / f"{name}.log").write_text(
+        f"# cmd: {' '.join(cmd)}\n# env: {json.dumps(env_over)}\n"
+        f"# rc={rc} timed_out={timed_out} seconds={dt}\n"
+        f"# ---- stdout ----\n{out}\n# ---- stderr ----\n{err}\n")
+    _log(f"{name}: {'OK' if ok else 'FAILED'} rc={rc} "
+         f"timed_out={timed_out} in {dt}s "
+         f"{json.dumps(parsed) if parsed else ''}")
+    return ok, record
+
+
+def _update_summary():
+    rows = {}
+    for f in sorted(RUNS.glob("*.json")):
+        if f.name in ("state.json", "summary.json"):
+            continue
+        try:
+            rows[f.stem] = json.loads(f.read_text())
+        except json.JSONDecodeError:
+            continue
+    SUMMARY.write_text(json.dumps(
+        {"updated": _now(), "runs": rows}, indent=1, sort_keys=True))
+
+
+def _git_commit():
+    """Path-scoped commit of the evidence dir only; racing the builder's
+    own commits is tolerated (index.lock errors are logged + skipped)."""
+    try:
+        subprocess.run(["git", "add", "docs/bench_runs"], cwd=REPO,
+                       capture_output=True, timeout=60)
+        r = subprocess.run(
+            ["git", "commit", "-m",
+             "Evidence sentinel: captured bench/onchip runs",
+             "--", "docs/bench_runs"],
+            cwd=REPO, capture_output=True, text=True, timeout=60)
+        _log(f"git commit rc={r.returncode}: "
+             f"{(r.stdout or r.stderr).strip().splitlines()[-1:]}")
+    except Exception as e:  # noqa: BLE001 — evidence files are already on disk
+        _log(f"git commit failed: {e}")
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--interval", type=float, default=600,
+                    help="seconds between probes while the tunnel is down")
+    ap.add_argument("--probe-timeout", type=float, default=120)
+    ap.add_argument("--once", action="store_true",
+                    help="one probe (+ sweep if up), then exit")
+    args = ap.parse_args()
+
+    RUNS.mkdir(parents=True, exist_ok=True)
+    # Single-instance guard: two sentinels would race state.json and run
+    # concurrent benches on the one chip (contended, invalid numbers).
+    # The flock is held for the process lifetime; released by the kernel
+    # on any exit.
+    import fcntl
+    lock_f = open(RUNS / "sentinel.lock", "w")
+    try:
+        fcntl.flock(lock_f, fcntl.LOCK_EX | fcntl.LOCK_NB)
+    except OSError:
+        _log("another sentinel instance holds the lock; exiting")
+        return 2
+    lock_f.write(str(os.getpid()))
+    lock_f.flush()
+    _log(f"sentinel up: {len(CONFIGS)} configs queued, probe every "
+         f"{args.interval:.0f}s (timeout {args.probe_timeout:.0f}s)")
+    n_probes = 0
+    while True:
+        ok, dt, detail = probe(args.probe_timeout)
+        n_probes += 1
+        _append(PROBE_LOG, {"ts": _now(), "ok": ok, "seconds": dt,
+                            "detail": detail})
+        _log(f"probe: {'UP' if ok else 'down'} ({dt}s) {detail}")
+        if not ok and n_probes % 6 == 0:
+            # Commit the probe log on the DOWN path too: a round where the
+            # tunnel never answers must still carry committed proof of the
+            # bounded attempts (the whole point of the log).
+            _git_commit()
+        if ok:
+            state = _load_state()
+            ran_any = False
+            for name, kind, env_over, timeout in CONFIGS:
+                if state["done"].get(name):
+                    continue
+                if state["tries"].get(name, 0) >= MAX_TRIES:
+                    continue
+                # Re-probe between configs: a mid-sweep tunnel death should
+                # stop the sweep cleanly, not burn MAX_TRIES on every
+                # remaining config.
+                if ran_any:
+                    up, pdt, pdetail = probe(min(args.probe_timeout, 90))
+                    _append(PROBE_LOG, {"ts": _now(), "ok": up,
+                                        "seconds": pdt, "detail": pdetail,
+                                        "mid_sweep": True})
+                    if not up:
+                        _log("tunnel died mid-sweep; pausing queue")
+                        break
+                state["tries"][name] = state["tries"].get(name, 0) + 1
+                _save_state(state)
+                cfg_ok, _rec = run_config(name, kind, env_over, timeout)
+                ran_any = True
+                if cfg_ok:
+                    state["done"][name] = _now()
+                else:
+                    # Refund the try when the tunnel itself died during
+                    # the run — a config longer than a short tunnel
+                    # window must not get exhausted without one fair run.
+                    up, pdt, pdetail = probe(min(args.probe_timeout, 90))
+                    _append(PROBE_LOG, {"ts": _now(), "ok": up,
+                                        "seconds": pdt, "detail": pdetail,
+                                        "post_failure": True})
+                    if not up:
+                        state["tries"][name] -= 1
+                        _save_state(state)
+                        _update_summary()
+                        _git_commit()
+                        _log(f"tunnel down after {name} failed; try "
+                             "refunded, pausing queue")
+                        break
+                _save_state(state)
+                _update_summary()
+                _git_commit()
+            pending = [n for n, *_ in CONFIGS
+                       if not state["done"].get(n)
+                       and state["tries"].get(n, 0) < MAX_TRIES]
+            _log(f"sweep pass complete; pending={pending}")
+            if not pending:
+                _log("all configs captured (or exhausted); probing slowly "
+                     "to keep the log alive")
+        if args.once:
+            return 0 if ok else 1
+        time.sleep(args.interval)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

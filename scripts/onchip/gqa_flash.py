@@ -1,0 +1,29 @@
+"""On-chip smoke: GQA-native flash kernels (narrow-KV BlockSpec index maps).
+
+Queue item 6c of scripts/onchip_checks.sh — the narrow-KV index maps must
+lower through Mosaic and match the repeat-KV path on-chip.  CPU interpret
+already passes.
+"""
+
+# On-chip evidence only: a silent CPU fallback would run the Pallas
+# interpreter (or plain XLA) and validate nothing on silicon.
+import jax  # noqa: E402
+assert jax.devices()[0].platform == "tpu", \
+    f"not on TPU (got {jax.devices()[0].platform}); refusing to record"
+import jax.numpy as jnp
+import numpy as np
+
+from horovod_tpu.ops.pallas import flash_attention
+
+rng = np.random.default_rng(0)
+B, L, H, KV, D = 2, 1024, 8, 2, 64
+q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.bfloat16)
+k = jnp.asarray(rng.standard_normal((B, L, KV, D)), jnp.bfloat16)
+v = jnp.asarray(rng.standard_normal((B, L, KV, D)), jnp.bfloat16)
+f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+out = np.asarray(f(q, k, v), np.float32)
+ref = np.asarray(f(q, jnp.repeat(k, H // KV, 2), jnp.repeat(v, H // KV, 2)),
+                 np.float32)
+err = np.abs(out - ref).max()
+print("gqa flash on-chip max err vs repeat:", err)
+assert err < 2e-2

@@ -1,0 +1,51 @@
+"""On-chip smoke: timeline XPlane ingestion shows DEVICE collective spans.
+
+Queue item 8 of scripts/onchip_checks.sh — on real TPU the merged chrome
+trace must carry device-lane spans for the fused all-reduce (CPU runs only
+see host dispatch spans).
+"""
+
+# On-chip evidence only: a silent CPU fallback would run the Pallas
+# interpreter (or plain XLA) and validate nothing on silicon.
+import jax  # noqa: E402
+assert jax.devices()[0].platform == "tpu", \
+    f"not on TPU (got {jax.devices()[0].platform}); refusing to record"
+import json
+import tempfile
+
+import jax.numpy as jnp
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.common import basics
+from horovod_tpu.optim import DistributedOptimizer
+from horovod_tpu.parallel import TrainState, make_train_step
+
+hvd.init()
+path = tempfile.mktemp(suffix=".json")
+tl = basics.start_timeline(path)
+mesh = hvd.global_process_set.mesh
+params = {"w": jnp.ones((512, 512), jnp.bfloat16)}
+
+
+def loss_fn(p, b):
+    return jnp.mean((b @ p["w"]) ** 2).astype(jnp.float32)
+
+
+opt = DistributedOptimizer(optax.sgd(0.1))
+step = make_train_step(loss_fn, opt, mesh, donate=False)
+state = TrainState.create(params, opt)
+batch = jnp.ones((hvd.size() * 8, 512), jnp.bfloat16)
+with tl.profile():
+    for _ in range(3):
+        state, loss = step(state, batch)
+    jax.block_until_ready(loss)
+basics.stop_timeline()
+evs = json.load(open(path))["traceEvents"]
+xp = [e for e in evs if e.get("cat") == "xplane"]
+print("xplane events:", len(xp))
+device = [e["name"] for e in xp
+          if "TPU" in e["name"] or "all-reduce" in e["name"]]
+print("device/collective spans:", device[:10])
+assert any("all-reduce" in n or "fusion" in n for n in device), \
+    "no device-side collective spans in the merged timeline"

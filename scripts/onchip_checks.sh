@@ -1,25 +1,23 @@
 #!/bin/bash
 # On-chip validation queue (see memory: onchip-validation-queue).
 # Run when `python -c "import jax; print(jax.devices())"` answers.
+#
+# NOTE: scripts/evidence_sentinel.py runs this same queue AUTOMATICALLY
+# (bounded, logged, committed) the moment the TPU tunnel answers — this
+# script remains the human-driven entry point.  The smoke snippets live
+# once, in scripts/onchip/*.py, shared by both paths.
 set -x
 cd "$(dirname "$0")/.."
 
 # 1. flash-ring cond+pallas lowering smoke (1-chip sp mesh, jit-compile)
-python - <<'PY'
-import jax, jax.numpy as jnp, numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
-from horovod_tpu.parallel.sequence import ring_attention
-mesh = Mesh(np.array(jax.devices()[:1]), ("sp",))
-q = jnp.ones((1, 256, 4, 64), jnp.bfloat16)
-f = jax.jit(jax.shard_map(
-    lambda a: ring_attention(a, a, a, axis_name="sp", causal=True,
-                             use_flash=True),
-    mesh=mesh, in_specs=P(None, "sp", None, None),
-    out_specs=P(None, "sp", None, None)))
-print("flash-ring on-chip:", np.asarray(f(q), np.float32).shape)
-PY
+python scripts/onchip/flash_ring.py
 
-# 2. padded flash kernels: ViT bench (196 -> 256 blocks)
+# 2. padded flash kernels: ViT bench (196 -> 256 blocks).  The padded
+# kernel is gated off by default until validated on silicon (it hung
+# once on-chip, undiagnosed); run the tiny bounded diagnostic with the
+# kernel FORCED on first, then the default (gated) bench.
+HVD_BENCH_MODEL=vit HVD_BENCH_ITERS=2 HVD_BENCH_BATCH=16 \
+    HVD_FLASH_ALLOW_PADDED=1 timeout 1200 python bench.py
 HVD_BENCH_MODEL=vit HVD_BENCH_ITERS=10 python bench.py
 
 # 3. BERT flash vs plain
@@ -31,19 +29,7 @@ HVD_BENCH_MODEL=gpt HVD_BENCH_SEQ=32768 HVD_BENCH_BATCH=1 \
     HVD_BENCH_ITERS=3 python bench.py
 
 # 5. int8 allreduce smoke (n=1 degenerate)
-python - <<'PY'
-import jax, jax.numpy as jnp, numpy as np
-from jax.sharding import Mesh, PartitionSpec as P
-from horovod_tpu.parallel import allreduce_int8
-mesh = Mesh(np.array(jax.devices()[:1]), ("hvd",))
-x = jnp.asarray(np.random.default_rng(0).standard_normal(4096), jnp.float32)
-out = jax.jit(jax.shard_map(
-    lambda t: allreduce_int8(t[None])[0], mesh=mesh,
-    in_specs=P(), out_specs=P()))(x)
-err = float(jnp.abs(out - x).max())
-print("int8 on-chip n=1 max err:", err)
-assert err < float(jnp.abs(x).max()) / 100
-PY
+python scripts/onchip/int8_allreduce.py
 
 # 6. LLaMA-400M causal-LM bench (GQA + RoPE + SwiGLU through flash kernels)
 HVD_BENCH_MODEL=llama HVD_BENCH_ITERS=10 python bench.py
@@ -53,22 +39,7 @@ HVD_BENCH_MODEL=t5 HVD_BENCH_ITERS=10 python bench.py
 
 # 6c. GQA-native flash kernels: narrow-KV index maps must lower through
 # Mosaic and match the repeat path on-chip (CPU interpret already passes)
-python - <<'PY'
-import jax, jax.numpy as jnp, numpy as np
-from horovod_tpu.ops.pallas import flash_attention
-rng = np.random.default_rng(0)
-B, L, H, KV, D = 2, 1024, 8, 2, 64
-q = jnp.asarray(rng.standard_normal((B, L, H, D)), jnp.bfloat16)
-k = jnp.asarray(rng.standard_normal((B, L, KV, D)), jnp.bfloat16)
-v = jnp.asarray(rng.standard_normal((B, L, KV, D)), jnp.bfloat16)
-f = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-out = np.asarray(f(q, k, v), np.float32)
-ref = np.asarray(f(q, jnp.repeat(k, H // KV, 2), jnp.repeat(v, H // KV, 2)),
-                 np.float32)
-err = np.abs(out - ref).max()
-print("gqa flash on-chip max err vs repeat:", err)
-assert err < 2e-2
-PY
+python scripts/onchip/gqa_flash.py
 
 # 7. ResNet-50 tracked config re-baseline
 HVD_BENCH_ITERS=20 python bench.py
@@ -76,38 +47,7 @@ HVD_BENCH_ITERS=20 python bench.py
 # 8. Timeline XPlane ingestion: the jitted step's DEVICE lane must show the
 # fused all-reduce span in the merged chrome trace (round-3: in-jit path
 # observability; CPU runs only see host dispatch spans).
-python - <<'PY'
-import json, tempfile
-import jax, jax.numpy as jnp, optax
-import horovod_tpu as hvd
-from horovod_tpu.common import basics
-from horovod_tpu.optim import DistributedOptimizer
-from horovod_tpu.parallel import TrainState, make_train_step
-
-hvd.init()
-path = tempfile.mktemp(suffix=".json")
-tl = basics.start_timeline(path)
-mesh = hvd.global_process_set.mesh
-params = {"w": jnp.ones((512, 512), jnp.bfloat16)}
-def loss_fn(p, b):
-    return jnp.mean((b @ p["w"]) ** 2).astype(jnp.float32)
-opt = DistributedOptimizer(optax.sgd(0.1))
-step = make_train_step(loss_fn, opt, mesh, donate=False)
-state = TrainState.create(params, opt)
-batch = jnp.ones((hvd.size() * 8, 512), jnp.bfloat16)
-with tl.profile():
-    for _ in range(3):
-        state, loss = step(state, batch)
-    jax.block_until_ready(loss)
-basics.stop_timeline()
-evs = json.load(open(path))["traceEvents"]
-xp = [e for e in evs if e.get("cat") == "xplane"]
-print("xplane events:", len(xp))
-device = [e["name"] for e in xp if "TPU" in e["name"] or "all-reduce" in e["name"]]
-print("device/collective spans:", device[:10])
-assert any("all-reduce" in n or "fusion" in n for n in device), \
-    "no device-side collective spans in the merged timeline"
-PY
+python scripts/onchip/timeline_xplane.py
 
 # 9. MFU A/B sweep (round 3 knobs): capture the roofline lines of each run
 # (stderr) next to the JSON; pick winners into the tracked configs.
