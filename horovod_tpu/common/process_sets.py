@@ -33,6 +33,11 @@ class ProcessSet:
         self.ranks = ranks_or_comm  # None = global set
         self.process_set_id = None
         self._mesh = None
+        # Per-set joined accounting for the armed multi-process JOIN
+        # protocol (reference: joined_size lives on each ProcessSet,
+        # controller.cc:269-327); global ranks. The GLOBAL set's protocol
+        # tracks its state in basics state (st.joined_ranks) instead.
+        self.joined_ranks = set()
 
     def _invalidate(self):
         self._mesh = None

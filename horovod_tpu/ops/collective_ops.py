@@ -825,19 +825,23 @@ def barrier(process_set=None, name=None):
 
 def _active_mask(ps):
     """0/1 tuple over the set's ranks excluding joined ranks, or None when
-    nobody has joined (the fast path)."""
+    nobody has joined (the fast path). Joined state is the union of the
+    global protocol's (st.joined_ranks) and this set's own armed-mode
+    accounting (ps.joined_ranks, reference: per-ProcessSet joined_size)."""
     st = basics._get_state()
-    if not st.joined_ranks:
+    set_joined = getattr(ps, "joined_ranks", set())
+    if not st.joined_ranks and not set_joined:
         return None
+    joined_union = set(st.joined_ranks) | set_joined
     ranks = ps.rank_list()
-    if all(r in st.joined_ranks for r in ranks):
+    if all(r in joined_union for r in ranks):
         # Every participant of this set joined — there is nobody left to
         # contribute, so the collective is a contract violation (the global
         # set can't reach here: join() resets on world completion).
         from horovod_tpu.common.exceptions import HorovodInternalError
         raise HorovodInternalError(
             f"collective on process set {ranks} after all its ranks joined")
-    return tuple(0 if r in st.joined_ranks else 1 for r in ranks)
+    return tuple(0 if r in joined_union else 1 for r in ranks)
 
 
 # ----------------------------------------------------------------------------
@@ -867,22 +871,69 @@ def _join_armed():
     return st.config.join_mode and jax.process_count() > 1
 
 
-def _join_round(payload):
-    """One protocol round: every process publishes ``{"joined": [...],
-    "desc": ...}`` and reads everyone else's. Returns ``(joined_union,
-    descs)``."""
+def _exchange_join_round(tag, procs, payload):
+    """One raw protocol round on ``tag``: each participant publishes
+    ``{"joined": [...], "desc": ...}`` and reads everyone else's.
+    Returns ``(joined_union, descs)``."""
     from horovod_tpu.common import negotiation
-    payloads = negotiation.exchange("join_round", payload)
+    payloads = negotiation.exchange(tag, payload, procs=procs)
     joined = set()
     descs = []
     for p in payloads:
         joined.update(int(r) for r in p["joined"])
         if p.get("desc") is not None:
             descs.append(p["desc"])
+    return joined, descs
+
+
+def _join_round(payload):
+    """Global-set protocol round; updates st.joined_ranks to the union."""
+    joined, descs = _exchange_join_round("join_round", None, payload)
     st = basics._get_state()
     st.joined_ranks.clear()
     st.joined_ranks.update(joined)
     return joined, descs
+
+
+def _join_round_set(ps, mesh, payload):
+    """SET-SCOPED protocol round: only the processes owning devices of
+    ``ps``'s mesh participate (reference: joined_size is per ProcessSet,
+    controller.cc:269-327 — the complement of the set never pays the
+    round). The tag carries the set's rank list so two sets with the same
+    owner processes keep distinct descriptor streams. Updates
+    ``ps.joined_ranks`` to the union."""
+    tag = "join_round_set/" + ",".join(str(r) for r in ps.rank_list())
+    joined, descs = _exchange_join_round(tag, _mesh_processes(mesh), payload)
+    ps.joined_ranks = set(joined)
+    return joined, descs
+
+
+def _round_mask(joined, descs, desc, ranks, what):
+    """Shared active-dispatch epilogue of a join round: verify every
+    active peer dispatched the same descriptor, then build the 0/1 active
+    mask over ``ranks`` (set positions) — None when nobody has joined."""
+    bad = [d for d in descs if d != desc]
+    if bad:
+        raise TensorShapeMismatchError(
+            f"join-mode collective mismatch on {what}: this process "
+            f"dispatched {desc}, peer(s) dispatched {bad[:2]} at the same "
+            f"round — every process must issue the same collectives in "
+            f"the same order")
+    if not joined:
+        return None
+    if len(joined) >= len(ranks):
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        raise HorovodInternalError(
+            f"collective on {what} after all its ranks joined")
+    return tuple(0 if r in joined else 1 for r in ranks)
+
+
+def _set_local_ranks(ps, mesh):
+    """Global ranks of this process's devices WITHIN the set's mesh
+    (submesh device order == rank_list order, topology.build_submesh)."""
+    _, local_pos = _local_mesh_info(mesh)
+    ranks = ps.rank_list()
+    return [ranks[i] for i in local_pos]
 
 
 def _join_sync(ps, mesh, desc):
@@ -901,30 +952,20 @@ def _join_sync(ps, mesh, desc):
         return _active_mask(ps)
     if ps.ranks is not None:
         multi, _ = _local_mesh_info(mesh)
-        if multi:
-            raise NotImplementedError(
-                "HOROVOD_JOIN_MODE supports collectives on the global "
-                "process set (and single-owner subsets) only — a joined "
-                "process cannot mirror ops on meshes it is not "
-                "synchronized with")
-        return _active_mask(ps)
+        if not multi:
+            return _active_mask(ps)
+        # Set-scoped armed round among the set's owner processes only
+        # (the complement keeps training untouched).
+        mine = sorted(set(ps.joined_ranks) & set(_set_local_ranks(ps, mesh)))
+        joined, descs = _join_round_set(ps, mesh,
+                                        {"joined": mine, "desc": desc})
+        return _round_mask(joined, descs, desc, ps.rank_list(),
+                           f"process set {ps.rank_list()}")
     _, local_pos = _local_mesh_info(mesh)
     mine = sorted(st.joined_ranks.intersection(local_pos))
     joined, descs = _join_round({"joined": mine, "desc": desc})
-    bad = [d for d in descs if d != desc]
-    if bad:
-        raise TensorShapeMismatchError(
-            f"join-mode collective mismatch: this process dispatched "
-            f"{desc}, peer(s) dispatched {bad[:2]} at the same round — "
-            f"every process must issue the same collectives in the same "
-            f"order")
-    if not joined:
-        return None
-    n = ps.size()
-    if len(joined) >= n:
-        from horovod_tpu.common.exceptions import HorovodInternalError
-        raise HorovodInternalError("collective after all ranks joined")
-    return tuple(0 if r in joined else 1 for r in range(n))
+    return _round_mask(joined, descs, desc, list(range(ps.size())),
+                       "the global set")
 
 
 def _slice_desc(tensors, mesh=None, n=None, what=None):
@@ -945,15 +986,18 @@ def _slice_desc(tensors, mesh=None, n=None, what=None):
     return out
 
 
-def _mirror_dispatch(desc, joined):
+def _mirror_dispatch(desc, joined, process_set=None):
     """Run on a JOINED process: launch the XLA program the active ranks
     negotiated, feeding zero-filled local rows (the mask makes the math
-    exact; the launch itself is what the device collective needs)."""
-    mesh, ps = _mesh_for(None)
+    exact; the launch itself is what the device collective needs).
+    ``process_set`` scopes the mirror to a sub-set's mesh (set-scoped
+    armed join); default is the global set."""
+    mesh, ps = _mesh_for(process_set)
     n = ps.size()
     _, local_pos = _local_mesh_info(mesh)
     rows = len(local_pos)
-    mask = tuple(0 if r in joined else 1 for r in range(n))
+    # Mask positions follow the SET's rank order (global set: identity).
+    mask = tuple(0 if r in joined else 1 for r in ps.rank_list())
     kind = desc["kind"]
     if kind == "alltoall":
         from horovod_tpu.common.exceptions import HorovodInternalError
@@ -968,7 +1012,7 @@ def _mirror_dispatch(desc, joined):
         tail = tuple(desc["tail"])
         zeros = [jnp.zeros((0,) + tail, desc["dtype"])
                  for _ in range(rows)]
-        allgather_ragged(zeros, _mirror=True)
+        allgather_ragged(zeros, process_set=process_set, _mirror=True)
         return
     if kind == "barrier":
         token = np.zeros((rows, 1), np.int32)
@@ -1051,7 +1095,51 @@ def _join_multiprocess(st, rank):
         prev = joined
 
 
-def join(rank=None):
+def _join_multiprocess_set(ps):
+    """join(process_set=ps) under HOROVOD_JOIN_MODE: publish this
+    process's ranks WITHIN the set as joined and service the set-scoped
+    protocol loop — mirroring every collective the set's still-active
+    ranks dispatch — until the whole set has joined. Processes outside
+    the set never participate (reference: per-ProcessSet joined_size,
+    controller.cc:269-327). Returns the highest GLOBAL rank of the final
+    round's newly-joined set (like the global join(); NOT the set-local
+    index — index into rank_list() to convert).
+
+    Contract: while any process is inside ``join(process_set=ps)``, the
+    set's other owner processes may only dispatch ``ps``-scoped
+    collectives until the set join completes (the joining process cannot
+    answer other meshes' control rounds while it loops here) — the same
+    same-order SPMD contract every armed-mode exchange carries.
+    """
+    mesh = ps.mesh
+    my_ranks = sorted(_set_local_ranks(ps, mesh))
+    if not my_ranks:
+        raise ValueError(
+            f"join(process_set=...): this process owns no ranks of "
+            f"{ps.rank_list()}")
+    ranks = ps.rank_list()
+    n = len(ranks)
+    prev = set(ps.joined_ranks)
+    ps.joined_ranks = prev | set(my_ranks)
+    while True:
+        joined, descs = _join_round_set(ps, mesh,
+                                        {"joined": my_ranks, "desc": None})
+        if descs:
+            if any(d != descs[0] for d in descs[1:]):
+                raise TensorShapeMismatchError(
+                    f"join-mode collective mismatch among active ranks of "
+                    f"process set {ranks}: {descs[:3]}")
+            _mirror_dispatch(descs[0], joined, process_set=ps)
+            prev = joined
+            continue
+        if len(joined) >= n:
+            newly = joined - prev
+            ps.joined_ranks = set()
+            return max(newly) if newly else ranks[-1]
+        prev = joined
+
+
+def join(rank=None, process_set=None):
     """Signal that ``rank`` (default: every rank this controller owns) has
     exhausted its uneven workload.
 
@@ -1071,11 +1159,34 @@ def join(rank=None):
     zero contributions — until every rank has joined. Without the mode
     flag, calling join() under a multi-process launch raises rather than
     corrupting state (a process cannot silently drop out of SPMD
-    dispatch). Process-set-scoped collectives that span processes are not
-    supported while the mode is armed; alltoall raises while ranks are
-    joined (reference: JOIN covers allreduce/allgather/broadcast).
+    dispatch). alltoall raises while ranks are joined (reference: JOIN
+    covers allreduce/allgather/broadcast).
+
+    ``process_set``: join only within that set (reference: joined_size is
+    per ProcessSet, controller.cc:269-327). The set's OTHER owner
+    processes keep dispatching set-scoped collectives with this process's
+    ranks masked out; processes outside the set are untouched and keep
+    training. The join loop services set-scoped rounds only — see
+    :func:`_join_multiprocess_set` for the ordering contract.
     """
     st = basics._get_state()
+    if process_set is not None and process_set.ranks is not None:
+        if rank is not None:
+            raise ValueError(
+                "join(process_set=...) takes no rank argument: the process "
+                "joins all the ranks it owns within the set")
+        multi, _ = _local_mesh_info(process_set.mesh)
+        if multi:
+            if not st.config.join_mode:
+                raise NotImplementedError(
+                    "hvd.join(process_set=...) across processes requires "
+                    "HOROVOD_JOIN_MODE=1 on every owner process of the set")
+            return _join_multiprocess_set(process_set)
+        # Single owner process: all the set's ranks are ours — the join
+        # completes immediately (nothing to mirror, nobody else to wait
+        # for) and the set's joined state resets.
+        process_set.joined_ranks = set()
+        return process_set.rank_list()[-1]
     if jax.process_count() > 1:
         if st.config.join_mode:
             return _join_multiprocess(st, rank)

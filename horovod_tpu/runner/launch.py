@@ -386,7 +386,20 @@ def _run_static(args, extra_env=None, harvest=None, kv_preload=None):
             harvest(kv)
         return 0
     finally:
-        kv.stop()
+        # Reap surviving workers on ANY exit path: an exception propagating
+        # out of the wait (driver-side timeout/interrupt) must not leave
+        # orphaned worker processes running — possibly blocked inside a
+        # device collective that outlives the KV store (reference:
+        # gloo_run terminates the job on driver exit). No-op for workers
+        # that already exited.
+        try:
+            for w in workers:
+                try:
+                    w.terminate()
+                except Exception:  # noqa: BLE001 — best-effort reaping
+                    pass
+        finally:
+            kv.stop()
 
 
 def _run_elastic(args):

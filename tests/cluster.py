@@ -104,33 +104,41 @@ class LocalCluster:
         remaining = set(range(self.n_hosts))
         errors = []
         deadline = time.time() + timeout
-        while remaining:
-            if "err" in self._outcome:
-                raise RuntimeError(
-                    f"cluster {self.hosts} died: {self._outcome['err']}")
-            if not self._thread.is_alive() and "res" not in self._outcome:
-                raise RuntimeError(f"cluster {self.hosts} launcher exited")
-            if time.time() > deadline:
-                # Mark dead so later tests fail fast (and the fixture
-                # respawns) instead of each burning its own full timeout.
-                self.dead = True
-                raise TimeoutError(
-                    f"cluster job {k}: no result from host(s) "
-                    f"{sorted(remaining)} within {timeout}s"
-                    + (f"; errors already reported: {errors}" if errors
-                       else ""))
-            for r in list(remaining):
-                p = os.path.join(self.dir, f"res_{k}_{r}.pkl")
-                if os.path.exists(p):
-                    with open(p, "rb") as f:
-                        status, val = cloudpickle.loads(f.read())
-                    remaining.discard(r)
-                    if status == "err":
-                        errors.append((r, val))
-                    else:
-                        out[r] = val
-            if remaining:
-                time.sleep(_POLL_S)
+        try:
+            while remaining:
+                if "err" in self._outcome:
+                    raise RuntimeError(
+                        f"cluster {self.hosts} died: {self._outcome['err']}")
+                if not self._thread.is_alive() \
+                        and "res" not in self._outcome:
+                    raise RuntimeError(
+                        f"cluster {self.hosts} launcher exited")
+                if time.time() > deadline:
+                    raise TimeoutError(
+                        f"cluster job {k}: no result from host(s) "
+                        f"{sorted(remaining)} within {timeout}s"
+                        + (f"; errors already reported: {errors}" if errors
+                           else ""))
+                for r in list(remaining):
+                    p = os.path.join(self.dir, f"res_{k}_{r}.pkl")
+                    if os.path.exists(p):
+                        with open(p, "rb") as f:
+                            status, val = cloudpickle.loads(f.read())
+                        remaining.discard(r)
+                        if status == "err":
+                            errors.append((r, val))
+                        else:
+                            out[r] = val
+                if remaining:
+                    time.sleep(_POLL_S)
+        except BaseException:
+            # ANY exception escaping the wait (our own TimeoutError, the
+            # conftest per-test SIGALRM, Ctrl-C) leaves job k possibly
+            # mid-flight on the workers: mark the cluster dead so the
+            # fixture respawns instead of handing later tests a wedged
+            # cluster mid-job (they would each burn a full timeout).
+            self.dead = True
+            raise
         if errors:
             raise RuntimeError(
                 f"cluster job {k} failed on host(s): {errors}")

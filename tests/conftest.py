@@ -37,8 +37,74 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 
+import signal  # noqa: E402
+
 import numpy as np  # noqa: E402
 import pytest  # noqa: E402
+
+# ---------------------------------------------------------------------------
+# Per-test timeout (pytest-timeout is not installed in this image, so the
+# guard is implemented here): a wedged test must fail in minutes, not block
+# the suite until a cluster-level timeout. SIGALRM fires in the main thread
+# — where pytest runs tests — and interrupts subprocess waits, sleeps, and
+# device gets alike. Override per test with @pytest.mark.timeout(seconds)
+# or suite-wide with HVD_TEST_TIMEOUT (reference analog: per-step `timeout`
+# wrappers in .buildkite/gen-pipeline.sh:126-149).
+# ---------------------------------------------------------------------------
+_DEFAULT_TEST_TIMEOUT = float(os.environ.get("HVD_TEST_TIMEOUT", "300"))
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers", "timeout(seconds): per-test timeout override "
+        "(default %ss, suite-wide env HVD_TEST_TIMEOUT)"
+        % int(_DEFAULT_TEST_TIMEOUT))
+
+
+class _PhaseTimeout:
+    """SIGALRM guard for one runtest phase; no-op when already expired."""
+
+    def __init__(self, item, phase):
+        m = item.get_closest_marker("timeout")
+        self.seconds = float(m.args[0]) if m and m.args \
+            else _DEFAULT_TEST_TIMEOUT
+        self.item, self.phase = item, phase
+
+    def _fire(self, signum, frame):
+        pytest.fail(
+            f"{self.item.nodeid} {self.phase} exceeded "
+            f"{self.seconds:.0f}s (HVD_TEST_TIMEOUT / @pytest.mark.timeout)",
+            pytrace=False)
+
+    def __enter__(self):
+        if self.seconds > 0:
+            self._prev = signal.signal(signal.SIGALRM, self._fire)
+            signal.setitimer(signal.ITIMER_REAL, self.seconds)
+        return self
+
+    def __exit__(self, *exc):
+        if self.seconds > 0:
+            signal.setitimer(signal.ITIMER_REAL, 0)
+            signal.signal(signal.SIGALRM, self._prev)
+        return False
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_setup(item):
+    with _PhaseTimeout(item, "setup"):
+        return (yield)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_call(item):
+    with _PhaseTimeout(item, "call"):
+        return (yield)
+
+
+@pytest.hookimpl(wrapper=True)
+def pytest_runtest_teardown(item):
+    with _PhaseTimeout(item, "teardown"):
+        return (yield)
 
 
 @pytest.fixture(scope="session")

@@ -234,6 +234,24 @@ class TestAsyncAndMisc:
     def test_join(self, hvd):
         assert hvd.join() == N - 1
 
+    def test_join_process_set_returns_global_rank(self, hvd):
+        """join(process_set=ps) returns the highest GLOBAL rank of the
+        last joiners (not the set-local index) — pinned with a set whose
+        ranks differ from their indices. Single owner: completes and
+        resets immediately."""
+        ps = hvd.add_process_set(hvd.ProcessSet([2, 5]))
+        try:
+            assert hvd.join(process_set=ps) == 5
+            # state reset: a set collective works again afterwards
+            x = np.stack([np.full((3,), float(r)) for r in (2, 5)]).astype(
+                np.float32)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum, process_set=ps))
+            np.testing.assert_allclose(out[0], np.full((3,), 7.0))
+            with pytest.raises(ValueError, match="no rank argument"):
+                hvd.join(rank=2, process_set=ps)
+        finally:
+            hvd.remove_process_set(ps)
+
     def test_join_uneven_batches(self, hvd, rng):
         """Joined ranks contribute zeros; Average divides by active count
         (reference: JOIN semantics, controller.cc:269-327)."""

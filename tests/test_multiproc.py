@@ -478,6 +478,77 @@ def _join_worker():
     return (r, last, last2)
 
 
+def _join_subset_worker():
+    """Set-scoped JOIN (reference: joined_size is per ProcessSet,
+    controller.cc:269-327): rank 1 joins INSIDE the 2-rank subset {0,1}
+    while processes 2,3 keep training on their own subset {2,3} —
+    completely untouched by the join protocol (set rounds are scoped to
+    the set's owner processes). Then the roles inside {0,1} swap to prove
+    the set protocol resets and is reusable."""
+    import numpy as np
+    import horovod_tpu as hvd
+
+    r = hvd.rank()
+    base = np.arange(3, dtype=np.float32)
+    local = (base + r)[None].astype(np.float32)     # local stack: 1 chip
+    full = np.stack([base + i for i in range(hvd.size())])
+
+    set_a = hvd.add_process_set(hvd.ProcessSet([0, 1]))
+    set_b = hvd.add_process_set(hvd.ProcessSet([2, 3]))
+    try:
+        last = last2 = None
+        if r == 1:
+            last = hvd.join(process_set=set_a)  # services A-scoped mirrors
+        elif r == 0:
+            # rank 1 joined: every A-scoped collective masks it out
+            for op, want in ((hvd.Sum, base), (hvd.Average, base)):
+                out = np.asarray(hvd.allreduce(local, op=op,
+                                               process_set=set_a))
+                np.testing.assert_allclose(
+                    out, np.broadcast_to(want, (1, 3)), rtol=1e-5,
+                    err_msg=f"op={op}")
+            out = np.asarray(hvd.allgather(local, process_set=set_a))
+            np.testing.assert_allclose(out, np.broadcast_to(base, (1, 3)),
+                                       rtol=1e-5)
+            out = np.asarray(hvd.allgather_ragged(
+                [np.full((2, 2), 7.0, np.float32)], process_set=set_a))
+            np.testing.assert_allclose(out, np.full((2, 2), 7.0), rtol=1e-5)
+            out = np.asarray(hvd.broadcast(local, root_rank=0,
+                                           process_set=set_a))
+            np.testing.assert_allclose(out, np.broadcast_to(base, (1, 3)),
+                                       rtol=1e-5)
+            last = hvd.join(process_set=set_a)
+        else:
+            # THE COMPLEMENT KEEPS TRAINING: B-scoped collectives run
+            # while {0,1} is mid-join — if set rounds wrongly rode the
+            # global tag these would deadlock (rank 1 only answers A's).
+            full_b = np.stack([base + i for i in (2, 3)])
+            for _ in range(4):
+                out = np.asarray(hvd.allreduce(local, op=hvd.Sum,
+                                               process_set=set_b))
+                np.testing.assert_allclose(
+                    out, np.broadcast_to(full_b.sum(0), (1, 3)), rtol=1e-5)
+        # Cycle 2, roles swapped inside A: the set's protocol state and
+        # round counters must be reusable after a completed set join.
+        if r == 0:
+            last2 = hvd.join(process_set=set_a)
+        elif r == 1:
+            out = np.asarray(hvd.allreduce(local, op=hvd.Sum,
+                                           process_set=set_a))
+            np.testing.assert_allclose(out, np.broadcast_to(base + 1, (1, 3)),
+                                       rtol=1e-5)
+            last2 = hvd.join(process_set=set_a)
+        # Full-world sanity: the global set never saw a join; everyone
+        # meets again on one armed global round.
+        out = np.asarray(hvd.allreduce(local, op=hvd.Sum))
+        np.testing.assert_allclose(out, np.broadcast_to(full.sum(0), (1, 3)),
+                                   rtol=1e-5)
+    finally:
+        hvd.remove_process_set(set_a)
+        hvd.remove_process_set(set_b)
+    return (r, last, last2)
+
+
 class TestMultiProcessJoin:
     def test_join_world4(self):
         """VERDICT round-2 item 3: Sum/Average/Min/Max/allgather/ragged/
@@ -490,6 +561,18 @@ class TestMultiProcessJoin:
         # last = 2; cycle 2 (roles swapped): ranks 1 and 3 -> last = 3
         assert sorted(results) == [(0, 2, 3), (1, 2, 3), (2, 2, 3),
                                    (3, 2, 3)]
+
+    def test_join_subset_world4(self):
+        """VERDICT round-3 item 5: joining a rank inside a 2-rank subset
+        while the complement keeps training on its own subset."""
+        results = run(_join_subset_worker,
+                      hosts="localhost:1,127.0.0.1:1,127.0.0.2:1,"
+                            "127.0.0.3:1",
+                      extra_env={"HOROVOD_JOIN_MODE": "1"})
+        # cycle 1: rank 0 is the last joiner of set A -> 0; cycle 2
+        # (swapped): rank 1 -> 1. The complement (2,3) never joins.
+        assert sorted(results) == [(0, 0, 1), (1, 0, 1), (2, None, None),
+                                   (3, None, None)]
 
 
 class TestMultiProcessWorldEight:
