@@ -68,6 +68,14 @@ class State:
     def sync(self):
         raise NotImplementedError
 
+    def detach_to_host(self):
+        """Pull live device-array attrs to host memory. Called by the
+        elastic re-init path BEFORE the XLA backend teardown: on the
+        skip_sync (removal-only) path the CURRENT attrs survive into the
+        new backend, and buffers of the destroyed PJRT client must not
+        leak into post-re-init computation (committed state is already
+        host-side under an elastic launch, save()). Default: no-op."""
+
     def reset(self):
         pass
 
@@ -79,11 +87,20 @@ class State:
             return
         observed = self._host_messages.poll()
         if observed is not None:
+            # Removal-only update windows skip the re-sync: survivors
+            # keep their CURRENT (possibly uncommitted) attrs, matching
+            # the reference's HostUpdateResult.removed -> skip_sync path
+            # (common/elastic.py). Additions must sync so new workers
+            # receive rank 0's state. Decided BEFORE acknowledge(): the
+            # kind walk spans (last-acknowledged, observed] and its KV
+            # reads are fallible — an error after acknowledging would
+            # swallow the interrupt for good.
+            skip = self._host_messages.removal_only(observed)
             # Acknowledge exactly the observed version before raising so
             # the next commit after recovery doesn't re-trigger on it — a
             # bump published in between must still raise later.
             self._host_messages.acknowledge(observed)
-            raise HostsUpdatedInterrupt(skip_sync=False)
+            raise HostsUpdatedInterrupt(skip_sync=skip)
 
 
 class ObjectState(State):
@@ -127,6 +144,16 @@ class ObjectState(State):
             for attr, value in synced.items():
                 setattr(self, attr, value)
             self._saved_state = synced
+
+    def detach_to_host(self):
+        import jax
+
+        def conv(x):
+            return jax.device_get(x) if isinstance(x, jax.Array) else x
+
+        for attr in self._saved_state:
+            setattr(self, attr,
+                    jax.tree_util.tree_map(conv, getattr(self, attr)))
 
 
 class TpuState(ObjectState):
@@ -185,6 +212,16 @@ class TpuState(ObjectState):
             self._trees[name] = broadcast_parameters(tree, root_rank=0)
         super().sync()
 
+    def detach_to_host(self):
+        import jax
+
+        def conv(x):
+            return jax.device_get(x) if isinstance(x, jax.Array) else x
+
+        self._trees = {name: jax.tree_util.tree_map(conv, tree)
+                       for name, tree in self._trees.items()}
+        super().detach_to_host()
+
 
 def run(func):
     """Elastic run decorator (reference: common/elastic.py:168 run_fn).
@@ -215,7 +252,7 @@ def run(func):
                 # No-op outside elastic launches.
                 mark_new_rank_ready()
                 read_new_rank_ready()
-                if not skip_sync:
+                if _sync_vote(want_sync=not skip_sync):
                     state.sync()
                 skip_sync = False
                 known_version = configured_version()
@@ -236,6 +273,25 @@ def run(func):
                 reset_required = True
                 skip_sync = e.skip_sync
 
+    def _sync_vote(want_sync):
+        """COLLECTIVE sync decision: sync iff ANY member of the (new)
+        membership needs it. Members can legitimately disagree locally —
+        a new worker or a HorovodInternalError-recoverer needs the rank-0
+        broadcast, while a graceful removal-only survivor does not — and
+        ``sync()`` is a collective, so acting on divergent local flags
+        would hang the broadcast with mismatched participants. One tiny
+        KV exchange makes the decision unanimous (the reference gets this
+        consistency from its push NotificationService delivering the same
+        update to every worker). Outside elastic multi-process launches:
+        the local flag decides, as before."""
+        import jax
+
+        if not _elastic_launch() or jax.process_count() <= 1:
+            return want_sync
+        from horovod_tpu.common import negotiation
+        votes = negotiation.exchange("elastic_sync_vote", bool(want_sync))
+        return any(votes)
+
     def _reset(state):
         """In-place re-initialization at the current membership: surviving
         workers keep their process (and committed state) and rebuild the
@@ -244,6 +300,12 @@ def run(func):
         import os
 
         from horovod_tpu.elastic.worker import refresh_assignment_env
+        # Live attrs must not carry buffers of the client we are about to
+        # destroy into the new backend (the skip_sync path keeps them).
+        try:
+            state.detach_to_host()
+        except NotImplementedError:
+            pass
         basics.shutdown()
         consumed_version = refresh_assignment_env()
         if consumed_version is None:

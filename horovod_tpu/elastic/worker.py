@@ -41,6 +41,30 @@ class HostUpdateListener:
         assignment with nothing left to re-trigger the re-init."""
         self._seen = int(version) if version is not None else self._current()
 
+    def removal_only(self, observed):
+        """Whether EVERY membership bump since the last acknowledged
+        version (i.e. versions ``_seen+1 .. observed`` — polls can
+        coalesce several bumps) only REMOVED hosts — survivors may then
+        skip the state re-sync and keep uncommitted progress (reference:
+        HostUpdateResult is accumulated across pending updates and
+        skip_sync requires all-removed, common/elastic.py
+        check_host_updates). Unknown kind (old driver, GC'd row, KV
+        error) conservatively syncs. Call BEFORE acknowledge().
+
+        The local answer is only a preference: the ``@elastic.run``
+        wrapper makes the final decision with a collective vote, so a
+        wrong local guess cannot desynchronize the sync broadcast."""
+        if self._client is None:
+            return False
+        try:
+            for v in range(int(self._seen) + 1, int(observed) + 1):
+                if self._client.get("elastic",
+                                    f"update_kind/{v}") != b"removal":
+                    return False
+        except Exception:  # noqa: BLE001 — transient KV error: sync
+            return False
+        return True
+
 
 def _kv_client():
     addr = os.environ.get("HOROVOD_KV_ADDR")

@@ -8,9 +8,18 @@ SlotInfo per membership version), registration.py/worker.py (host-update push).
 TPU adaptation: membership is per *host* (a host owns all its chips; TPU
 slices don't shrink by one chip). Workers learn about membership changes by
 polling a version counter in the KV store (replacing the push
-WorkerNotificationService); on a version bump the driver respawns workers
-with the new assignment — jax.distributed clusters are rebuilt rather than
-patched, which is the honest TPU equivalent of "re-rendezvous".
+WorkerNotificationService). On a version bump the (re)spawn is
+DIFFERENTIAL, matching the reference's no-restart UX for survivors
+(reference: driver.py:240-283 preserves surviving ranks, :284-302 only
+spawns new slots): workers on surviving hosts keep their PROCESS and
+re-initialize jax.distributed in place from the ``@elastic.run`` wrapper
+(shutdown → refresh assignment env → re-init at the new
+coordinator/world, elastic/state.py `_reset`); workers on removed hosts
+are terminated; only added hosts get new processes. Each version also
+publishes its update kind ("add"/"removal") so workers can skip the
+state re-sync on removal-only changes — preserving uncommitted progress
+exactly like the reference's ``HostUpdateResult.removed`` →
+``skip_sync`` path (common/elastic.py check_host_updates).
 """
 
 import threading
@@ -300,6 +309,13 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
         # (api._elastic_harvester).
         kv.put("elastic", f"nhosts/{version}", str(len(by_host)).encode())
         kv.delete("elastic", f"nhosts/{version - 2}")
+        # Update kind for this version: removal-only changes let survivors
+        # skip the state re-sync and keep uncommitted progress (reference:
+        # HostUpdateResult.removed -> skip_sync, common/elastic.py).
+        kind = b"add" if any(h not in survivors for h in by_host) \
+            else b"removal"
+        kv.put("elastic", f"update_kind/{version}", kind)
+        kv.delete("elastic", f"update_kind/{version - 2}")
         kv.put("elastic", "nhosts", str(len(by_host)).encode())
         kv.put("elastic", "version", str(version).encode())
         for host, slots in by_host.items():

@@ -78,6 +78,99 @@ class TestElasticFailureInjection:
         # happened exactly at the restore point (2,2,2 then 1,1,1).
         assert worlds == [2, 2, 2, 1, 1, 1]
 
+    def test_graceful_scale_down_preserves_pid_and_uncommitted(
+            self, hvd, tmp_path):
+        """Reference no-restart UX for survivors (common/elastic.py:168
+        run_fn + runner/elastic/driver.py:240-283): on a GRACEFUL host
+        removal (discovery shrinks, nobody crashes) the surviving worker
+        (1) keeps its OS process — PID unchanged across the membership
+        change — re-initializing jax.distributed in place, and (2) keeps
+        its uncommitted python state: removal-only updates raise
+        HostsUpdatedInterrupt(skip_sync=True) (the reference's
+        HostUpdateResult.removed path), so attrs mutated since the last
+        commit survive the re-init instead of being rolled back by the
+        rank-0 re-sync."""
+        from horovod_tpu.runner import run_elastic
+
+        script = tmp_path / "discover.sh"
+        script.write_text("#!/bin/sh\necho localhost:1\necho 127.0.0.1:1\n")
+        script.chmod(0o755)
+
+        total_steps = 6
+
+        def train(script_path, total_steps):
+            import os
+
+            import jax.numpy as jnp
+            import numpy as np
+
+            import horovod_tpu as hvd
+            from horovod_tpu import elastic
+            from horovod_tpu.elastic.worker import (configured_version,
+                                                    wait_for_version_change)
+
+            hvd.init()
+            state = elastic.TpuState(trees={"w": jnp.zeros((2,))},
+                                     step=0, pid0=0, uncommitted=0,
+                                     worlds=[])
+            elastic.attach_listener(state)
+
+            @elastic.run
+            def loop(state):
+                while state.step < total_steps:
+                    if state.step == 3 and hvd.process_count() == 2:
+                        if state.pid0 == 0:
+                            # First arrival at the event step: pin this
+                            # process's identity INTO the committed state,
+                            # then leave one attr uncommitted.
+                            state.pid0 = os.getpid()
+                            state.commit()
+                            state.uncommitted = 7   # NOT committed
+                            known = configured_version()
+                            if hvd.cross_rank() == 1:
+                                # Graceful removal of THIS host: shrink
+                                # discovery; the driver terminates us (or
+                                # our re-init exits cleanly via the
+                                # missing assignment row).
+                                with open(script_path, "w") as f:
+                                    f.write("#!/bin/sh\necho localhost:1\n")
+                            # Both workers idle at the membership fence (no
+                            # collectives in flight -> the survivor sees
+                            # the GRACEFUL interrupt, never a collective
+                            # failure), then notice the bump at the next
+                            # commit-point check.
+                            wait_for_version_change(known, timeout=120)
+                            state.check_host_updates()
+                    contrib = jnp.ones((1, 2))
+                    g = hvd.allreduce(contrib, op=hvd.Sum)
+                    state.w = state.w + g[0]
+                    state.step += 1
+                    state.worlds.append(hvd.process_count())
+                    state.commit()
+                return (state.step, np.asarray(state.w).tolist(),
+                        list(state.worlds), state.pid0, os.getpid(),
+                        state.uncommitted, hvd.process_count())
+
+            return loop(state)
+
+        results = run_elastic(train, args=(str(script), total_steps),
+                              min_np=1, host_discovery_script=str(script))
+
+        assert len(results) == 1      # only the survivor reports
+        steps, w, worlds, pid0, pid_now, uncommitted, final_world = \
+            results[0]
+        assert steps == total_steps
+        assert final_world == 1
+        # (1) the survivor's process was never respawned
+        assert pid0 == pid_now and pid0 != 0
+        # (2) the uncommitted attr survived the removal-only re-init
+        # (skip_sync): a re-sync would have rolled it back to 0.
+        assert uncommitted == 7
+        # Steps 0-2 at world 2 (sum=2/el), steps 3-5 at world 1 (sum=1/el):
+        # no step was lost or re-run.
+        np.testing.assert_allclose(w, [3 * 2 + 3 * 1] * 2)
+        assert worlds == [2, 2, 2, 1, 1, 1]
+
     def test_host_added_midrun_scales_up_in_place(self, hvd, tmp_path):
         """Scale-UP: discovery grows 1 -> 2 hosts mid-training; the
         surviving worker re-initializes in place at the next commit, the
