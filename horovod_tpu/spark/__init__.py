@@ -10,7 +10,7 @@ pyspark is only required for the distributed job backend.
 
 from horovod_tpu.spark.estimator import TpuEstimator, TpuModel
 from horovod_tpu.spark.keras import KerasEstimator, KerasModel
-from horovod_tpu.spark.lightning import LightningEstimator
+from horovod_tpu.spark.lightning import LightningEstimator, LightningModel
 from horovod_tpu.spark.runner import run, run_elastic, spark_available
 from horovod_tpu.spark.store import (DBFSLocalStore, FilesystemStore,
                                      HDFSStore, LocalStore, Store)
@@ -21,4 +21,4 @@ __all__ = ["run", "run_elastic", "spark_available", "Store", "LocalStore",
            "FilesystemStore", "HDFSStore", "DBFSLocalStore",
            "TpuEstimator", "TpuModel", "KerasEstimator",
            "KerasModel", "TorchEstimator", "TorchModel",
-           "LightningEstimator", "assign_ranks"]
+           "LightningEstimator", "LightningModel", "assign_ranks"]

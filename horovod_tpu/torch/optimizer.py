@@ -55,6 +55,17 @@ class _DistributedOptimizer(torch.optim.Optimizer):
                         self._make_hook())
                     self._grad_accs.append(acc)
 
+    def _remove_hooks(self):
+        """Deregister the gradient hooks — retire this wrapper. A caller
+        that wraps a NEW optimizer over the same parameters (e.g. a
+        second estimator fit calling configure_optimizers again) must
+        retire the old wrapper first, or both hooks fire per backward
+        and the orphaned one trips the duplicate-reduction check."""
+        for acc in self._grad_accs:
+            acc.remove()
+        self._grad_accs = []
+        self._handles.clear()
+
     def _make_hook(self):
         def hook(p):
             self._passes[p] += 1
