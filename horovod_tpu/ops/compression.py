@@ -98,9 +98,22 @@ class Int8Compressor(Compressor):
         return tensor
 
 
+def _powersgd(rank=4, min_compression_rate=2.0, ef_dtype=None):
+    """Construct the stateful PowerSGD marker (low-rank factor exchange
+    with error feedback; honored by DistributedOptimizer only — see
+    horovod_tpu/optim/powersgd.py). ``ef_dtype`` keeps the error-feedback
+    residual in a wider dtype than the gradients (e.g. fp32 under bf16
+    training)."""
+    from horovod_tpu.optim.powersgd import PowerSGDCompressor
+    return PowerSGDCompressor(rank=rank,
+                              min_compression_rate=min_compression_rate,
+                              ef_dtype=ef_dtype)
+
+
 class Compression:
     """reference: compression.py Compression namespace."""
     none = NoneCompressor
     fp16 = FP16Compressor
     bf16 = BF16Compressor
     int8 = Int8Compressor
+    powersgd = staticmethod(_powersgd)

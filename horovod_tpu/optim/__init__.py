@@ -3,3 +3,6 @@ from horovod_tpu.optim.optimizer import (  # noqa: F401
     DistributedOptimizer, allreduce_gradients_transform, fused_allreduce_tree,
     distributed_value_and_grad, broadcast_parameters, broadcast_object_tree,
 )
+from horovod_tpu.optim.powersgd import (  # noqa: F401
+    PowerSGDCompressor, powersgd_gradients_transform, powersgd_wire_numbers,
+)

@@ -180,13 +180,24 @@ def DistributedOptimizer(optimizer, op=Average, axis_name=HVD_AXIS,
         raise ValueError(
             f"backward_passes_per_step must be >= 1, got "
             f"{backward_passes_per_step}")
-    tx = optax.chain(
-        allreduce_gradients_transform(
+    from horovod_tpu.optim.powersgd import (PowerSGDCompressor,
+                                            powersgd_gradients_transform)
+    if isinstance(compression, PowerSGDCompressor):
+        # Stateful low-rank compression: its own transform carries the
+        # warm-start factors + error feedback (powersgd.py).
+        reduce_tx = powersgd_gradients_transform(
+            rank=compression.rank, op=op, axis_name=axis_name,
+            process_set=process_set,
+            min_compression_rate=compression.min_compression_rate,
+            prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor,
+            ef_dtype=compression.ef_dtype)
+    else:
+        reduce_tx = allreduce_gradients_transform(
             op=op, axis_name=axis_name, process_set=process_set,
             compression=compression, prescale_factor=prescale_factor,
-            postscale_factor=postscale_factor),
-        optimizer,
-    )
+            postscale_factor=postscale_factor)
+    tx = optax.chain(reduce_tx, optimizer)
     if backward_passes_per_step > 1:
         tx = _local_aggregation(tx, backward_passes_per_step,
                                 average_aggregated_gradients, axis_name)

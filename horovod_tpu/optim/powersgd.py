@@ -1,0 +1,244 @@
+"""PowerSGD low-rank gradient compression with error feedback.
+
+Beyond-parity capability (the reference's wire compression stops at fp16
+casts, horovod/torch/compression.py; this implements Vogels et al.,
+"PowerSGD: Practical Low-Rank Gradient Compression for Distributed
+Optimization", NeurIPS 2019 — the algorithm torch.distributed ships as its
+``powerSGD_hook``): each gradient matrix ``M (n, m)`` is exchanged as two
+rank-``r`` factors instead of ``n*m`` elements,
+
+1. ``P = M @ Q`` with the previous step's ``Q`` (warm start),
+2. allreduce-average ``P`` (r*n elements on the wire), orthonormalize,
+3. ``Q = M^T @ P``, allreduce-average ``Q`` (r*m elements),
+4. decompress ``M_hat = P @ Q^T``; the LOCAL residual ``M + e - M_hat``
+   becomes the next step's error-feedback ``e`` (what low-rank dropped
+   this step is re-injected next step, which is what makes the method
+   converge like exact SGD).
+
+TPU-native mapping: the whole procedure runs inside the jitted train step.
+Every leaf's ``P`` (then every ``Q``) rides ONE fused flat-buffer
+allreduce (:func:`horovod_tpu.optim.optimizer.fused_allreduce_tree`), so
+the per-step collective count stays O(1) regardless of layer count —
+PowerSGD composes with the fusion buffer exactly like the reference's
+fp16 cast does. Matmuls are (n,m)@(m,r) MXU work. Orthonormalization is a
+reduced QR on the (n,r) tall-skinny averaged ``P`` — identical on every
+rank since the input is identical, so the factor state stays replicated
+without extra communication.
+
+Tensors that don't pay for compression — 1-D leaves (biases, norms),
+tiny matrices where ``r*(n+m) * min_compression_rate > n*m`` — are
+reduced uncompressed in the same fused buckets (torch's
+``min_compression_rate`` rule).
+"""
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+from horovod_tpu.common.topology import HVD_AXIS
+from horovod_tpu.ops.collective_ops import Average, ReduceOp, Sum
+
+
+class PowerSGDCompressor:
+    """Marker carried through ``DistributedOptimizer(compression=...)``.
+
+    Unlike the cast compressors this one is STATEFUL (warm-start factors
+    + error feedback), so it cannot run inside the stateless
+    ``fused_allreduce_tree`` — the optimizer routes gradients through
+    :func:`powersgd_gradients_transform` instead when it sees this
+    marker. ``Compression.powersgd(rank)`` constructs it.
+    """
+
+    def __init__(self, rank=4, min_compression_rate=2.0, ef_dtype=None):
+        if rank < 1:
+            raise ValueError(f"PowerSGD rank must be >= 1, got {rank}")
+        self.rank = int(rank)
+        self.min_compression_rate = float(min_compression_rate)
+        # None: error feedback in the leaf dtype. bf16 training can pass
+        # jnp.float32 to keep the residual accumulation full-precision.
+        self.ef_dtype = ef_dtype
+
+    # Stateless-path guards: reaching compress() means a code path that
+    # cannot provide state was handed this compressor.
+    def compress(self, tensor):
+        raise ValueError(
+            "Compression.powersgd is stateful (warm-start factors + error "
+            "feedback) and only works through DistributedOptimizer / "
+            "powersgd_gradients_transform — the stateless eager/fused "
+            "compression path cannot run it")
+
+    def decompress(self, tensor, ctx):
+        raise ValueError(
+            "Compression.powersgd only works through DistributedOptimizer")
+
+
+def _as_matrix(leaf):
+    """(n, m) view: dim-0 rows vs everything else (torch powerSGD_hook's
+    matrixization rule)."""
+    return leaf.reshape(leaf.shape[0], -1)
+
+
+def _use_powersgd(shape, rank, min_rate):
+    if len(shape) < 2:
+        return False
+    n = shape[0]
+    m = 1
+    for s in shape[1:]:
+        m *= s
+    r = min(rank, n, m)
+    return r * (n + m) * min_rate <= n * m
+
+
+def _init_q(shape, rank, i, dtype):
+    """Deterministic per-leaf factor init — identical on every rank (the
+    factors must stay replicated; any fixed seed works, rank-dependent
+    seeds would break the algorithm)."""
+    n = shape[0]
+    m = 1
+    for s in shape[1:]:
+        m *= s
+    r = min(rank, n, m)
+    q = jax.random.normal(jax.random.PRNGKey(17 + i), (m, r), jnp.float32)
+    return q.astype(dtype)
+
+
+def powersgd_gradients_transform(rank=4, op=Average, axis_name=HVD_AXIS,
+                                 process_set=None, min_compression_rate=2.0,
+                                 prescale_factor=1.0, postscale_factor=1.0,
+                                 ef_dtype=None):
+    """Optax transform: PowerSGD-compressed cross-replica gradient
+    reduction (drop-in for ``allreduce_gradients_transform``).
+
+    Only ``Average`` and ``Sum`` are defined for low-rank factors
+    (matching the int8 route's contract); ``axis_name=None`` degrades to
+    identity like the plain transform.
+    """
+    from horovod_tpu.ops.compression import Compression
+    from horovod_tpu.optim.optimizer import fused_allreduce_tree
+
+    op = ReduceOp(op)
+    if op not in (Sum, Average):
+        raise ValueError(
+            f"PowerSGD supports Sum/Average only, got {op!r} (Min/Max/"
+            f"Product/Adasum have no low-rank-factor semantics)")
+
+    def init_fn(params):
+        leaves = jax.tree_util.tree_leaves(params)
+        qs = []
+        errs = []
+        for i, p in enumerate(leaves):
+            e_dt = ef_dtype or p.dtype
+            if _use_powersgd(p.shape, rank, min_compression_rate):
+                qs.append(_init_q(p.shape, rank, i, jnp.float32))
+                errs.append(jnp.zeros(p.shape, e_dt))
+            else:
+                qs.append(jnp.zeros((0,), jnp.float32))
+                errs.append(jnp.zeros((0,), e_dt))
+        return {"q": tuple(qs), "err": tuple(errs)}
+
+    def update_fn(updates, state, params=None):
+        del params
+        if axis_name is None:
+            return updates, state
+        leaves, treedef = jax.tree_util.tree_flatten(updates)
+        compressed_idx = [
+            i for i, l in enumerate(leaves)
+            if _use_powersgd(l.shape, rank, min_compression_rate)]
+        plain_idx = [i for i in range(len(leaves))
+                     if i not in set(compressed_idx)]
+
+        # --- uncompressed leaves: ordinary fused allreduce -------------
+        plain_out = {}
+        if plain_idx:
+            reduced = fused_allreduce_tree(
+                [leaves[i] for i in plain_idx], op=op, axis_name=axis_name,
+                process_set=process_set, compression=Compression.none,
+                prescale_factor=prescale_factor,
+                postscale_factor=postscale_factor)
+            plain_out = dict(zip(plain_idx, reduced))
+
+        new_qs = list(state["q"])
+        new_errs = list(state["err"])
+        out = [None] * len(leaves)
+        if compressed_idx:
+            mats = []
+            for i in compressed_idx:
+                m = _as_matrix(leaves[i]).astype(jnp.float32)
+                if prescale_factor != 1.0:
+                    m = m * prescale_factor
+                # Error feedback: re-inject what low-rank dropped last
+                # step BEFORE projecting (Vogels et al. alg. 2, line 2).
+                m = m + _as_matrix(state["err"][i]).astype(jnp.float32)
+                mats.append(m)
+            # Phase 1: P = M @ Q, ONE fused allreduce over every P.
+            ps = [m @ state["q"][j] for m, j
+                  in zip(mats, compressed_idx)]
+            ps = fused_allreduce_tree(ps, op=Average, axis_name=axis_name,
+                                      process_set=process_set)
+            # Orthonormalize the averaged P's (reduced QR on identical
+            # inputs -> identical factors on every rank).
+            ps = [jnp.linalg.qr(p)[0] for p in ps]
+            # Phase 2: Q = M^T @ P, ONE fused allreduce over every Q.
+            qs = [m.T @ p for m, p in zip(mats, ps)]
+            qs = fused_allreduce_tree(qs, op=Average, axis_name=axis_name,
+                                      process_set=process_set)
+            # Static participant count for the Sum rescale: the factor
+            # exchange averaged over the process SET (in_jit.allreduce
+            # scopes to its axis_index_groups), so the scale must be the
+            # set's size, not the world's.
+            if op == Sum:
+                n_participants = process_set.size() \
+                    if process_set is not None and process_set.ranks \
+                    is not None else lax.axis_size(axis_name)
+            for m, p, q, i in zip(mats, ps, qs, compressed_idx):
+                m_hat = p @ q.T
+                # The residual of THIS rank's (error-fed) gradient
+                # against the shared approximation becomes next step's
+                # error feedback.
+                err = (m - m_hat).astype(state["err"][i].dtype)
+                new_errs[i] = err.reshape(leaves[i].shape)
+                new_qs[i] = q
+                if op == Sum:
+                    # Factors were averaged (the numerically stable
+                    # exchange); Sum semantics scale the decompressed
+                    # mean back up.
+                    m_hat = m_hat * n_participants
+                if postscale_factor != 1.0:
+                    m_hat = m_hat * postscale_factor
+                out[i] = m_hat.reshape(leaves[i].shape).astype(
+                    leaves[i].dtype)
+        for i in plain_idx:
+            out[i] = plain_out[i]
+        new_state = {"q": tuple(new_qs), "err": tuple(new_errs)}
+        # Normalize the state's mesh-varying types: err is device-varying
+        # (per-rank residual) while the psum'd q comes back axis-invariant
+        # — a scan/cond carrying this state needs stable types across
+        # iterations (same fix as _local_aggregation's _mark_varying).
+        from horovod_tpu.ops import in_jit
+        new_state = in_jit.mark_varying(new_state, axis_name)
+        return jax.tree_util.tree_unflatten(treedef, out), new_state
+
+    return optax.GradientTransformation(init_fn, update_fn)
+
+
+def powersgd_wire_numbers(shapes, rank, min_compression_rate=2.0):
+    """Diagnostic: (compressed_bytes, uncompressed_bytes) per step for a
+    list of fp32 leaf shapes — what the factor exchange moves vs a plain
+    allreduce. Matrix leaves move r*(n+m) elements; exempt leaves move
+    their full size either way."""
+    wire = 0
+    full = 0
+    for shape in shapes:
+        n = shape[0] if shape else 1
+        m = 1
+        for s in shape[1:]:
+            m *= s
+        size = n * m
+        full += size * 4
+        if _use_powersgd(tuple(shape), rank, min_compression_rate):
+            r = min(rank, n, m)
+            wire += r * (n + m) * 4
+        else:
+            wire += size * 4
+    return wire, full
