@@ -12,3 +12,6 @@ from horovod_tpu.models.t5 import (  # noqa: F401
     T5, T5Config, t5_beam_decode, t5_generate, t5_greedy_decode,
 )
 from horovod_tpu.models.generate import beam_search, generate  # noqa: F401
+from horovod_tpu.models.speculative import (  # noqa: F401
+    speculative_accept, speculative_generate,
+)

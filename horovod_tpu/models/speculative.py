@@ -1,0 +1,238 @@
+"""Speculative decoding: draft-model proposal + target-model verification.
+
+Beyond-parity serving capability (the reference ships no inference
+tooling, docs/inference.rst): implements the acceptance-rejection scheme
+of Leviathan et al. 2023 ("Fast Inference from Transformers via
+Speculative Decoding") / Chen et al. 2023 — a cheap DRAFT model proposes
+``gamma`` tokens autoregressively, the expensive TARGET model scores all
+of them in ONE forward, and an acceptance test keeps a prefix of the
+proposals such that the OUTPUT DISTRIBUTION IS EXACTLY THE TARGET
+MODEL'S (greedy output is bit-identical to target-only greedy decoding;
+sampled output follows the target's tempered/filtered distribution).
+
+TPU-first structure: the whole decode is one compiled program — a
+``lax.while_loop`` over speculation blocks, a ``lax.scan`` of ``gamma``
+draft steps inside, static shapes throughout (fixed working buffer of
+``max_len + gamma + 1``; per-row cursors advance by the per-row accepted
+count, so batch rows progress independently with masked column writes
+instead of dynamic shapes). Per block the target runs one forward over
+the buffer — large batched matmuls on the MXU — instead of one forward
+per token.
+
+Acceptance math (the exactness core, unit-tested against numpy in
+tests/test_models.py): draft token ``x_i ~ q_i`` is accepted iff
+``u_i * q_i(x_i) <= p_i(x_i)`` (i.e. with probability ``min(1, p/q)``);
+at the first rejection the replacement is drawn from the residual
+``max(p - q, 0)`` renormalized; if all ``gamma`` are accepted a bonus
+token is drawn from the target's next-position distribution — so every
+block yields between 1 and ``gamma + 1`` target-distributed tokens.
+"""
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from horovod_tpu.models.generate import (_check_position_capacity,
+                                         _filter_logits)
+
+
+def _spec_probs(logits, temperature, top_k, top_p):
+    """The sampling distribution as fp32 probs (temper then filter, same
+    semantics as sample_or_argmax). Accepts (..., V): _filter_logits is
+    2-D, so leading dims are flattened around it."""
+    shape = logits.shape
+    flat = logits.reshape(-1, shape[-1]).astype(jnp.float32) / temperature
+    return jax.nn.softmax(_filter_logits(flat, top_k, top_p),
+                          axis=-1).reshape(shape)
+
+
+def speculative_accept(p, q, x, u, r_resid, r_bonus):
+    """Vectorized acceptance-rejection for one speculation block.
+
+    Args:
+        p: (B, gamma+1, V) fp32 TARGET probs at the gamma proposal
+           positions plus the bonus position.
+        q: (B, gamma, V) fp32 DRAFT probs the proposals were drawn from.
+        x: (B, gamma) int32 draft proposals.
+        u: (B, gamma) uniforms for the acceptance tests.
+        r_resid / r_bonus: PRNG keys for the residual / bonus draws.
+
+    Returns ``(tokens, count)``: (B, gamma+1) output tokens whose first
+    ``count`` entries are valid (accepted prefix + correction-or-bonus),
+    1 <= count <= gamma+1.
+
+    Correctness (Leviathan et al., thm. 1): accept x_i with prob
+    min(1, p_i(x_i)/q_i(x_i)); on first rejection resample from
+    norm(max(p_i - q_i, 0)); after gamma acceptances draw from
+    p_{gamma+1}. Marginal of every emitted token == target's.
+    """
+    B, gamma = x.shape
+    px = jnp.take_along_axis(p[:, :gamma], x[..., None], axis=-1)[..., 0]
+    qx = jnp.take_along_axis(q, x[..., None], axis=-1)[..., 0]
+    # u*q <= p  <=>  u <= p/q  (and q(x)=0 can't occur for a drawn x)
+    accept = u * qx <= px
+    # leading-accept count: stops at the first rejection
+    k = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    # residual distribution at the (clamped) rejection position
+    rej = jnp.minimum(k, gamma - 1)
+    p_rej = jnp.take_along_axis(p, rej[:, None, None], axis=1)[:, 0]
+    q_rej = jnp.take_along_axis(q, rej[:, None, None], axis=1)[:, 0]
+    resid = jnp.maximum(p_rej - q_rej, 0.0)
+    z = jnp.sum(resid, axis=-1, keepdims=True)
+    # z == 0 (p == q exactly at the rejection position) degenerates to p
+    resid = jnp.where(z > 0, resid / jnp.maximum(z, 1e-30), p_rej)
+    y_resid = jax.random.categorical(
+        r_resid, jnp.log(jnp.maximum(resid, 1e-30)))
+    y_bonus = jax.random.categorical(
+        r_bonus, jnp.log(jnp.maximum(p[:, gamma], 1e-30)))
+    y = jnp.where(k == gamma, y_bonus, y_resid).astype(jnp.int32)
+    # tokens: accepted drafts, then y at slot k
+    toks = jnp.concatenate([x, jnp.zeros((B, 1), jnp.int32)], axis=1)
+    slots = jnp.arange(gamma + 1)[None]
+    toks = jnp.where(slots == k[:, None], y[:, None], toks)
+    return toks, k + 1
+
+
+def _greedy_accept(p_logits, x):
+    """Greedy acceptance: accept draft tokens while they equal the
+    target argmax; the first mismatch (or the bonus slot) takes the
+    target argmax — output tokens are exactly target-greedy tokens."""
+    B, gamma = x.shape
+    tgt = jnp.argmax(p_logits, axis=-1).astype(jnp.int32)  # (B, gamma+1)
+    accept = x == tgt[:, :gamma]
+    k = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
+    # accepted prefix == tgt prefix, and slot k takes tgt[k]: the whole
+    # emitted block is just the target's own argmax tokens
+    return tgt, k + 1
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 5, 6, 7, 9, 10, 12))
+def _speculative(target, draft, t_params, d_params, prompt, max_len, gamma,
+                 temperature, rng, top_k, top_p, eos_id, width):
+    B, P = prompt.shape
+    W = width                                   # max_len + gamma + 1
+    cols = jnp.arange(W)[None]                  # (1, W)
+    buf = jnp.zeros((B, W), jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
+    pos0 = jnp.full((B,), P, jnp.int32)         # next position to fill
+
+    def draft_step(carry, _):
+        buf, pos, i, drng = carry
+        logits = draft.apply({"params": d_params}, buf)      # (B, W, V)
+        prev = (pos + i - 1)[:, None, None]
+        lg = jnp.take_along_axis(logits, prev, axis=1)[:, 0]  # (B, V)
+        if temperature == 0.0:
+            nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+            qfull = lg  # structural placeholder; greedy never reads q
+        else:
+            qfull = _spec_probs(lg, temperature, top_k, top_p)
+            drng, sub = jax.random.split(drng)
+            nxt = jax.random.categorical(
+                sub, jnp.log(jnp.maximum(qfull, 1e-30))).astype(jnp.int32)
+        write = cols == (pos + i)[:, None]
+        buf = jnp.where(write, nxt[:, None], buf)
+        return (buf, pos, i + 1, drng), (nxt, qfull)
+
+    def body(carry):
+        buf, pos, done, rng = carry
+        rng, r_draft, r_u, r_resid, r_bonus = jax.random.split(rng, 5)
+        (buf, _, _, _), (xs, qs) = lax.scan(
+            draft_step, (buf, pos, jnp.zeros((), jnp.int32), r_draft),
+            None, length=gamma)
+        xs = jnp.moveaxis(xs, 0, 1)             # (B, gamma)
+        qs = jnp.moveaxis(qs, 0, 1)             # (B, gamma, V)
+        # ONE target forward scores all proposals + the bonus position
+        logits = target.apply({"params": t_params}, buf)     # (B, W, V)
+        idx = (pos[:, None] - 1 + jnp.arange(gamma + 1)[None])[..., None]
+        p_logits = jnp.take_along_axis(logits, idx, axis=1)  # (B, g+1, V)
+        if temperature == 0.0:
+            toks, count = _greedy_accept(p_logits, xs)
+        else:
+            p = _spec_probs(p_logits, temperature, top_k, top_p)
+            u = jax.random.uniform(r_u, xs.shape)
+            toks, count = speculative_accept(p, qs, xs, u, r_resid,
+                                             r_bonus)
+        # clamp to remaining room; finished rows write nothing
+        count = jnp.where(done, 0, jnp.minimum(count, max_len - pos))
+        in_block = (cols >= pos[:, None]) & (cols < (pos + count)[:, None])
+        slot = jnp.clip(cols - pos[:, None], 0, gamma)
+        vals = jnp.take_along_axis(toks, slot, axis=1)       # (B, W)
+        buf = jnp.where(in_block, vals, buf)
+        if eos_id is not None:
+            # a generated EOS inside the block finishes the row; the
+            # trailing-cleanup pass pads everything after it
+            hit = jnp.any(in_block & (buf == eos_id), axis=1)
+            done = done | hit
+        pos = pos + count
+        done = done | (pos >= max_len)
+        return buf, pos, done, rng
+
+    def cond(carry):
+        _, pos, done, _ = carry
+        return jnp.any(~done)
+
+    buf, pos, done, _ = lax.while_loop(cond, body,
+                                       (buf, pos0, jnp.zeros((B,), bool),
+                                        rng))
+    out = buf[:, :max_len]
+    if eos_id is not None:
+        # fixed-length EOS contract (same as generate): everything after
+        # the first GENERATED eos becomes eos
+        gcols = jnp.arange(max_len)[None]
+        is_eos = (out == eos_id) & (gcols >= P)
+        after = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+            - is_eos.astype(jnp.int32) > 0
+        out = jnp.where(after & (gcols >= P), eos_id, out)
+    return out
+
+
+def speculative_generate(target_model, target_params, draft_model,
+                         draft_params, prompt, max_len, gamma=4,
+                         temperature=0.0, rng=None, top_k=0, top_p=1.0,
+                         eos_id=None):
+    """Speculative decoding: generate up to ``max_len`` total tokens with
+    the TARGET model's output distribution at a fraction of its forward
+    passes.
+
+    - ``target_model``/``draft_model``: causal LMs with the
+      :func:`horovod_tpu.models.generate.generate` contract (e.g. a large
+      and a small :class:`~horovod_tpu.models.GPT` sharing a tokenizer).
+      Both need position capacity for ``max_len + gamma + 1`` (the draft
+      runs ``gamma`` positions ahead of the accepted text).
+    - ``gamma``: proposals per block; each block costs ``gamma`` draft
+      forwards + ONE target forward and yields 1..gamma+1 tokens.
+    - ``temperature=0``: output is BIT-IDENTICAL to target-only greedy
+      decoding. Otherwise the emitted tokens follow the target's
+      tempered/filtered distribution exactly (Leviathan et al. 2023,
+      thm. 1) — NOT merely approximately.
+    - ``top_k``/``top_p``/``eos_id``: as in ``generate`` (EOS latches and
+      pads to ``max_len``).
+
+    Returns (B, max_len) int32: prompt + generated tokens. Batch rows
+    advance independently (per-row acceptance counts).
+    """
+    B, P = prompt.shape
+    if not 1 <= P <= max_len:
+        raise ValueError(
+            f"prompt length {P} must be in [1, max_len={max_len}]")
+    if gamma < 1:
+        raise ValueError(f"gamma must be >= 1, got {gamma}")
+    if temperature < 0.0:
+        raise ValueError(f"temperature must be >= 0, got {temperature}")
+    if top_k < 0 or not 0.0 < top_p <= 1.0:
+        raise ValueError(f"need top_k >= 0 and 0 < top_p <= 1, got "
+                         f"top_k={top_k}, top_p={top_p}")
+    if temperature != 0.0 and rng is None:
+        raise ValueError("sampling (temperature != 0) requires rng")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)
+    width = int(max_len) + int(gamma) + 1
+    _check_position_capacity(target_model, width)
+    _check_position_capacity(draft_model, width)
+    prompt = jnp.asarray(prompt, jnp.int32)
+    return _speculative(target_model, draft_model, target_params,
+                        draft_params, prompt, int(max_len), int(gamma),
+                        float(temperature), rng, int(top_k), float(top_p),
+                        None if eos_id is None else int(eos_id), width)

@@ -462,8 +462,8 @@ def broadcast_variables(variables, root_rank=0, process_set=None):
         v.assign(broadcast(v, root_rank=root_rank, process_set=process_set))
 
 
-def join():
-    return C.join()
+def join(process_set=None):
+    return C.join(process_set=process_set)
 
 
 def barrier(process_set=None):

@@ -350,10 +350,12 @@ def barrier(process_set=None, name=None):
     C.barrier(process_set=process_set, name=name)
 
 
-def join(device=None):
+def join(device=None, process_set=None):
     """reference: hvd.join (torch/mpi_ops_v2.cc DoJoin:972). ``device`` is
-    accepted for API compatibility and ignored (chips are mesh-addressed)."""
-    return C.join()
+    accepted for API compatibility and ignored (chips are mesh-addressed).
+    ``process_set`` scopes the join to a sub-set (core extension; the
+    reference's joined_size is per-ProcessSet, controller.cc:269-327)."""
+    return C.join(process_set=process_set)
 
 
 class _InplaceGroupItem:

@@ -725,6 +725,136 @@ class TestGenerate:
                      jnp.zeros((1, 2), jnp.int32), 4, top_p=0.0)
 
 
+class TestSpeculative:
+    """Speculative decoding (models/speculative.py, Leviathan et al.
+    2023): greedy output must be BIT-IDENTICAL to target-only decoding;
+    the sampled acceptance math must reproduce the target distribution
+    exactly (verified at the math level against closed forms)."""
+
+    def _models(self, rng, max_pos=16):
+        from horovod_tpu.models import GPT, GPTConfig
+        t_cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=2,
+                               max_position_embeddings=max_pos)
+        d_cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=1,
+                               max_position_embeddings=max_pos)
+        target, draft = GPT(t_cfg), GPT(d_cfg)
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (3, 4)), np.int32))
+        tp = target.init(jax.random.PRNGKey(0), prompt)["params"]
+        dp = draft.init(jax.random.PRNGKey(1), prompt)["params"]
+        return target, tp, draft, dp, prompt
+
+    def test_greedy_bit_identical_to_target(self, hvd, rng):
+        """Independent draft params; batch rows accept different counts;
+        output must still equal target-only greedy decode exactly."""
+        from horovod_tpu.models import generate, speculative_generate
+        target, tp, draft, dp, prompt = self._models(rng)
+        want = np.asarray(generate(target, tp, prompt, max_len=12))
+        got = np.asarray(speculative_generate(
+            target, tp, draft, dp, prompt, max_len=12, gamma=3))
+        np.testing.assert_array_equal(got, want)
+
+    def test_draft_equals_target_still_exact(self, hvd, rng):
+        """Perfect draft (same model+params): every block accepts all
+        gamma proposals; output unchanged."""
+        from horovod_tpu.models import generate, speculative_generate
+        target, tp, _, _, prompt = self._models(rng)
+        want = np.asarray(generate(target, tp, prompt, max_len=12))
+        got = np.asarray(speculative_generate(
+            target, tp, target, tp, prompt, max_len=12, gamma=3))
+        np.testing.assert_array_equal(got, want)
+
+    def test_eos_semantics_match_generate(self, hvd, rng):
+        """EOS latch + padding must mirror generate()'s fixed-length
+        contract — pick an eos the target actually emits mid-decode."""
+        from horovod_tpu.models import generate, speculative_generate
+        target, tp, draft, dp, prompt = self._models(rng)
+        base = np.asarray(generate(target, tp, prompt, max_len=12))
+        eos = int(base[0, 7])              # a token row 0 emits
+        want = np.asarray(generate(target, tp, prompt, max_len=12,
+                                   eos_id=eos))
+        got = np.asarray(speculative_generate(
+            target, tp, draft, dp, prompt, max_len=12, gamma=3,
+            eos_id=eos))
+        np.testing.assert_array_equal(got, want)
+
+    def test_acceptance_math_deterministic_cases(self, hvd):
+        from horovod_tpu.models import speculative_accept
+        gamma, V = 2, 4
+        onehot = np.eye(V, dtype=np.float32)
+        # Case A: u=0 accepts everything; bonus dist one-hot at 3
+        p = np.stack([onehot[1], onehot[2], onehot[3]])[None]  # (1,3,4)
+        q = np.stack([onehot[1], onehot[2]])[None]             # (1,2,4)
+        x = np.asarray([[1, 2]], np.int32)
+        toks, count = speculative_accept(
+            jnp.asarray(p), jnp.asarray(q), jnp.asarray(x),
+            jnp.zeros((1, gamma)), jax.random.PRNGKey(0),
+            jax.random.PRNGKey(1))
+        assert int(count[0]) == 3
+        np.testing.assert_array_equal(np.asarray(toks)[0], [1, 2, 3])
+        # Case B: first proposal rejected (p(x_0)=0); residual == p_0
+        # one-hot at 0 -> correction token 0, count 1
+        p2 = np.stack([onehot[0], onehot[2], onehot[3]])[None]
+        toks, count = speculative_accept(
+            jnp.asarray(p2), jnp.asarray(q), jnp.asarray(x),
+            jnp.full((1, gamma), 0.5), jax.random.PRNGKey(0),
+            jax.random.PRNGKey(1))
+        assert int(count[0]) == 1
+        assert int(np.asarray(toks)[0, 0]) == 0
+
+    def test_first_token_marginal_is_target_distribution(self, hvd):
+        """Empirical exactness (thm. 1): the first emitted token's
+        marginal over many runs equals the TARGET distribution p, not the
+        draft's q, despite proposals coming from q."""
+        from horovod_tpu.models import speculative_accept
+        V, gamma, n = 4, 2, 4000
+        p0 = np.asarray([0.5, 0.3, 0.15, 0.05], np.float32)
+        q0 = np.asarray([0.1, 0.2, 0.3, 0.4], np.float32)
+        p = jnp.broadcast_to(jnp.asarray(p0), (n, gamma + 1, V))
+        q = jnp.broadcast_to(jnp.asarray(q0), (n, gamma, V))
+        key = jax.random.PRNGKey(42)
+        kx, ku, kr, kb = jax.random.split(key, 4)
+        x = jax.random.categorical(
+            kx, jnp.log(q0)[None, None], shape=(n, gamma)).astype(jnp.int32)
+        u = jax.random.uniform(ku, (n, gamma))
+        toks, _ = speculative_accept(p, q, x, u, kr, kb)
+        first = np.asarray(toks)[:, 0]
+        freq = np.bincount(first, minlength=V) / n
+        np.testing.assert_allclose(freq, p0, atol=0.03)
+
+    def test_sampled_with_filters_reproducible(self, hvd, rng):
+        """Sampled mode end-to-end with top-k/top-p engaged (the filter
+        runs on (B, gamma+1, V) target logits — a 2-D-only filter breaks
+        here): reproducible under one key, valid tokens."""
+        from horovod_tpu.models import speculative_generate
+        target, tp, draft, dp, prompt = self._models(rng)
+        k = jax.random.PRNGKey(5)
+        kw = dict(gamma=3, temperature=0.8, top_k=32, top_p=0.9, rng=k)
+        a = np.asarray(speculative_generate(target, tp, draft, dp, prompt,
+                                            12, **kw))
+        b = np.asarray(speculative_generate(target, tp, draft, dp, prompt,
+                                            12, **kw))
+        np.testing.assert_array_equal(a, b)
+        assert a.min() >= 0 and a.max() < 256
+        np.testing.assert_array_equal(a[:, :4], np.asarray(prompt))
+
+    def test_misuse(self, hvd, rng):
+        from horovod_tpu.models import speculative_generate
+        target, tp, draft, dp, prompt = self._models(rng)
+        with pytest.raises(ValueError, match="gamma"):
+            speculative_generate(target, tp, draft, dp, prompt, 12,
+                                 gamma=0)
+        with pytest.raises(ValueError, match="requires rng"):
+            speculative_generate(target, tp, draft, dp, prompt, 12,
+                                 temperature=1.0)
+        with pytest.raises(ValueError, match="must be in"):
+            speculative_generate(target, tp, draft, dp, prompt, 3)
+        with pytest.raises(ValueError, match="position"):
+            # width = max_len + gamma + 1 exceeds the position table
+            speculative_generate(target, tp, draft, dp, prompt, 16,
+                                 gamma=3)
+
+
 class TestLlama:
     """LLaMA family: RMSNorm + RoPE + SwiGLU + grouped-query attention
     (models/llama.py) — new capability beyond the reference's model-less
