@@ -71,8 +71,11 @@ def speculative_accept(p, q, x, u, r_resid, r_bonus):
     B, gamma = x.shape
     px = jnp.take_along_axis(p[:, :gamma], x[..., None], axis=-1)[..., 0]
     qx = jnp.take_along_axis(q, x[..., None], axis=-1)[..., 0]
-    # u*q <= p  <=>  u <= p/q  (and q(x)=0 can't occur for a drawn x)
-    accept = u * qx <= px
+    # STRICT u*q < p  <=>  u < p/q: accept prob is still min(1, p/q)
+    # (u ~ [0,1) is continuous; at p >= q, u < p/q always holds), while a
+    # token with p(x) = 0 — outside the target's filtered support — is
+    # NEVER accepted even when u draws exactly 0.0.
+    accept = u * qx < px
     # leading-accept count: stops at the first rejection
     k = jnp.sum(jnp.cumprod(accept.astype(jnp.int32), axis=1), axis=1)
     # residual distribution at the (clamped) rejection position
