@@ -1,0 +1,78 @@
+"""PowerSGD low-rank gradient compression with error feedback.
+
+Beyond reference parity (Horovod's wire compression stops at fp16
+casts): each (n, m) gradient matrix crosses the wire as two rank-r
+factors — ``rank*(n+m)`` elements instead of ``n*m`` — with an
+error-feedback residual that re-injects what low-rank dropped, so
+training converges like exact SGD (Vogels et al., NeurIPS 2019).
+
+    JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+        python flax_powersgd.py
+"""
+
+import argparse
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.optim import DistributedOptimizer, powersgd_wire_numbers
+from horovod_tpu.parallel import TrainState, make_train_step
+
+
+class MLP(nn.Module):
+    width: int = 256
+
+    @nn.compact
+    def __call__(self, x):
+        x = nn.relu(nn.Dense(self.width)(x))
+        return nn.Dense(1)(x)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=150)
+    ap.add_argument("--rank", type=int, default=4)
+    args = ap.parse_args()
+
+    hvd.init()
+    n = hvd.size()
+    mesh = hvd.global_process_set.mesh
+    rng = np.random.default_rng(0)
+
+    model = MLP()
+    X = rng.standard_normal((n * 16, 32)).astype(np.float32)
+    w_true = rng.standard_normal((32,)).astype(np.float32)
+    y = (X @ w_true)[:, None]
+
+    params = model.init(jax.random.PRNGKey(0), jnp.asarray(X[:1]))["params"]
+    opt = DistributedOptimizer(
+        optax.adam(1e-2),
+        compression=hvd.Compression.powersgd(rank=args.rank))
+
+    def loss_fn(p, b):
+        return jnp.mean((model.apply({"params": p}, b["x"]) - b["y"]) ** 2)
+
+    step = make_train_step(loss_fn, opt, mesh)
+    state = TrainState.create(params, opt)
+    batch = {"x": jnp.asarray(X), "y": jnp.asarray(y)}
+    losses = []
+    for _ in range(args.steps):
+        state, loss = step(state, batch)
+        losses.append(float(loss))
+    print(f"rank-{args.rank} PowerSGD: loss {losses[0]:.3f} -> "
+          f"{losses[-1]:.5f} over {args.steps} steps")
+
+    shapes = [p.shape for p in jax.tree_util.tree_leaves(params)]
+    wire, full = powersgd_wire_numbers(shapes, args.rank)
+    print(f"wire bytes per step: {wire:,} vs {full:,} uncompressed "
+          f"({full / wire:.1f}x less traffic)")
+    assert losses[-1] < losses[0] * 1e-2, "did not converge"
+    print("converged with low-rank gradients + error feedback")
+
+
+if __name__ == "__main__":
+    main()

@@ -90,6 +90,15 @@ class TestExamples:
         out = _run("flax/flax_generate.py", "--steps", "250")
         assert "decoded sequence matches training target" in out
 
+    def test_flax_speculative(self):
+        out = _run("flax/flax_speculative.py", "--steps", "250")
+        assert "bit-identical to target greedy decode" in out
+
+    def test_flax_powersgd(self):
+        out = _run("flax/flax_powersgd.py", "--steps", "120")
+        assert "converged with low-rank gradients" in out
+        assert "less traffic" in out
+
     def test_flax_llama(self):
         out = _run("flax/flax_llama.py", "--steps", "250")
         assert "decoded sequence matches training target" in out
