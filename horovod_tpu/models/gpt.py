@@ -57,8 +57,10 @@ class GPTEmbed(nn.Module):
     """Token + learned position embeddings (replicated params).
 
     ``pos`` (decode mode): a traced scalar — the global position of the
-    single token in ``input_ids`` (shape (B, 1)); the table is indexed
-    dynamically instead of by the static prefix.
+    FIRST token in ``input_ids`` (shape (B, s)); the table is sliced
+    dynamically at positions ``pos..pos+s-1`` instead of by the static
+    prefix (s=1 is the classic one-token step; s>1 is the chunked feed
+    the speculative verifier uses).
     """
     config: GPTConfig
 
@@ -73,7 +75,7 @@ class GPTEmbed(nn.Module):
                            jnp.float32)
         if pos is not None:
             import jax
-            sl = jax.lax.dynamic_slice_in_dim(table, pos, 1)   # (1, H)
+            sl = jax.lax.dynamic_slice_in_dim(table, pos, L)   # (s, H)
             return tok + jnp.asarray(sl, c.dtype)[None]
         pos = table  # legacy local name for the static paths below
         if c.sp_axis is not None:

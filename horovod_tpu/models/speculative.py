@@ -139,7 +139,7 @@ def _speculative(target, draft, t_params, d_params, prompt, max_len, gamma,
         return (buf, pos, i + 1, drng), (nxt, qfull)
 
     def body(carry):
-        buf, pos, done, rng = carry
+        buf, pos, done, rng, nblk = carry
         rng, r_draft, r_u, r_resid, r_bonus = jax.random.split(rng, 5)
         (buf, _, _, _), (xs, qs) = lax.scan(
             draft_step, (buf, pos, jnp.zeros((), jnp.int32), r_draft),
@@ -170,31 +170,175 @@ def _speculative(target, draft, t_params, d_params, prompt, max_len, gamma,
             done = done | hit
         pos = pos + count
         done = done | (pos >= max_len)
-        return buf, pos, done, rng
+        return buf, pos, done, rng, nblk + 1
 
     def cond(carry):
-        _, pos, done, _ = carry
+        _, pos, done, _, _ = carry
         return jnp.any(~done)
 
-    buf, pos, done, _ = lax.while_loop(cond, body,
-                                       (buf, pos0, jnp.zeros((B,), bool),
-                                        rng))
-    out = buf[:, :max_len]
-    if eos_id is not None:
-        # fixed-length EOS contract (same as generate): everything after
-        # the first GENERATED eos becomes eos
-        gcols = jnp.arange(max_len)[None]
-        is_eos = (out == eos_id) & (gcols >= P)
-        after = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
-            - is_eos.astype(jnp.int32) > 0
-        out = jnp.where(after & (gcols >= P), eos_id, out)
-    return out
+    buf, pos, done, _, nblk = lax.while_loop(
+        cond, body, (buf, pos0, jnp.zeros((B,), bool), rng,
+                     jnp.zeros((), jnp.int32)))
+    return _eos_pad(buf[:, :max_len], P, eos_id), nblk
+
+
+def rewind_cache(cache, new_idx):
+    """Roll every layer's KV-cache cursor back to ``new_idx`` — the
+    speculative REJECTION primitive: stale K/V rows beyond the cursor are
+    masked out by the decode attend (`valid = pos <= idx + i`) and
+    overwritten by later feeds, so rewinding is just resetting the per-
+    layer ``idx`` leaves."""
+    import jax.tree_util as jtu
+
+    def _rewind(path, leaf):
+        last = path[-1]
+        key = getattr(last, "key", None)
+        if key == "idx":
+            return jnp.asarray(new_idx, leaf.dtype)
+        return leaf
+
+    return jtu.tree_map_with_path(_rewind, cache)
+
+
+def _eos_pad(out, P, eos_id):
+    """Fixed-length EOS contract shared by both decode paths: everything
+    after the first GENERATED eos becomes eos (matches generate())."""
+    if eos_id is None:
+        return out
+    gcols = jnp.arange(out.shape[1])[None]
+    is_eos = (out == eos_id) & (gcols >= P)
+    after = jnp.cumsum(is_eos.astype(jnp.int32), axis=1) \
+        - is_eos.astype(jnp.int32) > 0
+    return jnp.where(after & (gcols >= P), eos_id, out)
+
+
+@functools.partial(jax.jit, static_argnums=(0, 1, 5, 6, 7, 9, 10, 12))
+def _speculative_cached(target, draft, t_state, d_state, prompt, max_len,
+                        gamma, temperature, rng, top_k, top_p, eos_id,
+                        width):
+    """KV-cached speculative decode: the draft runs ``gamma`` one-token
+    cached steps, the target verifies the whole block with ONE CHUNKED
+    cached feed (gamma+1 query tokens attending cache + intra-chunk
+    causal), and rejection is a cache-cursor rewind. Batch rows advance
+    in LOCKSTEP by the block's minimum accepted count (``pos`` is a
+    SCALAR — one cursor for the whole batch, mirroring the scalar
+    per-layer cache cursors); per-token marginals are unchanged
+    (truncating an accepted prefix cannot bias it), B=1 serving loses
+    nothing. Returns ``(buffer, n_blocks)``."""
+    from horovod_tpu.models.generate import _decode_feed
+
+    t_params, t_cache = t_state
+    d_params, d_cache = d_state
+    B, P = prompt.shape
+    W = width
+    cols = jnp.arange(W)[None]
+    buf = jnp.zeros((B, W), jnp.int32)
+    buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
+
+    def chunk_feed(decoder, params):
+        """Multi-token cached feed: returns ALL s logit rows (the
+        one-token _decode_feed keeps only the first)."""
+
+        def feed(cache, toks, t):
+            logits, upd = decoder.apply(
+                {"params": params, "cache": cache}, toks, pos=t,
+                mutable=["cache"])
+            return upd["cache"], logits
+
+        return feed
+
+    t_chunk = chunk_feed(target, t_params)
+    d_chunk = chunk_feed(draft, d_params)
+    d_feed = _decode_feed(draft, d_params)
+    # CHUNKED prefill: prompt tokens 0..P-2 enter each cache in one feed
+    # (cursor = P-1) instead of a P-1-step scan
+    if P > 1:
+        t_cache, _ = t_chunk(t_cache, prompt[:, :P - 1], 0)
+        d_cache, _ = d_chunk(d_cache, prompt[:, :P - 1], 0)
+
+    def body(carry):
+        buf, t_cache, d_cache, pos, done, rng, nblk = carry
+
+        rng, r_draft, r_u, r_resid, r_bonus = jax.random.split(rng, 5)
+
+        def dstep(c, i):
+            dbuf, dc, drng = c
+            tok = lax.dynamic_slice(dbuf, (0, pos + i - 1), (B, 1))
+            dc, lg = d_feed(dc, tok, pos + i - 1)
+            if temperature == 0.0:
+                nxt = jnp.argmax(lg, axis=-1).astype(jnp.int32)
+                qfull = lg
+            else:
+                qfull = _spec_probs(lg, temperature, top_k, top_p)
+                drng, sub = jax.random.split(drng)
+                nxt = jax.random.categorical(
+                    sub, jnp.log(jnp.maximum(qfull, 1e-30))).astype(
+                        jnp.int32)
+            write = cols == pos + i
+            dbuf = jnp.where(write, nxt[:, None], dbuf)
+            return (dbuf, dc, drng), (nxt, qfull)
+
+        (buf, d_cache, _), (xs, qs) = lax.scan(
+            dstep, (buf, d_cache, r_draft), jnp.arange(gamma))
+        xs = jnp.moveaxis(xs, 0, 1)
+        qs = jnp.moveaxis(qs, 0, 1)
+        # ONE chunked target feed verifies the block: tokens at positions
+        # pos-1 .. pos+gamma-1
+        chunk = lax.dynamic_slice(buf, (0, pos - 1), (B, gamma + 1))
+        t_cache, p_logits = t_chunk(t_cache, chunk, pos - 1)
+        if temperature == 0.0:
+            toks, count = _greedy_accept(p_logits, xs)
+        else:
+            p = _spec_probs(p_logits, temperature, top_k, top_p)
+            u = jax.random.uniform(r_u, xs.shape)
+            toks, count = speculative_accept(p, qs, xs, u, r_resid,
+                                             r_bonus)
+        # lockstep: advance by the minimum accepted count over active
+        # rows (scalar cursors), bounded by the remaining room
+        count = jnp.where(done, gamma + 1, count)
+        adv = jnp.minimum(jnp.min(count), max_len - pos)
+        per_row = jnp.where(done, 0, adv)
+        in_block = (cols >= pos) & (cols < pos + per_row[:, None])
+        slot = jnp.clip(cols - pos, 0, gamma)
+        vals = jnp.take_along_axis(toks, slot, axis=1)
+        buf = jnp.where(in_block, vals, buf)
+        if eos_id is not None:
+            hit = jnp.any(in_block & (buf == eos_id), axis=1)
+            done = done | hit
+        # Re-feed the draft cache with the COMMITTED block before
+        # rewinding: the gamma-step draft scan never fed x_{gamma-1}, so
+        # a FULLY-accepted block would wind the cursor past a row the
+        # draft never wrote — a permanent garbage K/V row silently
+        # degrading every later proposal. One cheap chunked draft feed
+        # writes every committed row; rows at/beyond the cursor stay
+        # masked.
+        chunk2 = lax.dynamic_slice(buf, (0, pos - 1), (B, gamma + 1))
+        d_cache = rewind_cache(d_cache, pos - 1)
+        d_cache, _ = d_chunk(d_cache, chunk2, pos - 1)
+        # rewind both cursors to the verified frontier: tokens
+        # 0..pos+adv-2 are committed, the token at pos+adv-1 is the next
+        # feed's input
+        new_cursor = pos - 1 + adv
+        t_cache = rewind_cache(t_cache, new_cursor)
+        d_cache = rewind_cache(d_cache, new_cursor)
+        pos = pos + adv
+        done = done | (pos >= max_len)
+        return buf, t_cache, d_cache, pos, done, rng, nblk + 1
+
+    def cond(carry):
+        _, _, _, pos, done, _, _ = carry
+        return jnp.any(~done)
+
+    buf, _, _, _, _, _, nblk = lax.while_loop(
+        cond, body, (buf, t_cache, d_cache, jnp.asarray(P, jnp.int32),
+                     jnp.zeros((B,), bool), rng, jnp.zeros((), jnp.int32)))
+    return _eos_pad(buf[:, :max_len], P, eos_id), nblk
 
 
 def speculative_generate(target_model, target_params, draft_model,
                          draft_params, prompt, max_len, gamma=4,
                          temperature=0.0, rng=None, top_k=0, top_p=1.0,
-                         eos_id=None):
+                         eos_id=None, use_cache=False, return_stats=False):
     """Speculative decoding: generate up to ``max_len`` total tokens with
     the TARGET model's output distribution at a fraction of its forward
     passes.
@@ -212,9 +356,21 @@ def speculative_generate(target_model, target_params, draft_model,
       thm. 1) — NOT merely approximately.
     - ``top_k``/``top_p``/``eos_id``: as in ``generate`` (EOS latches and
       pads to ``max_len``).
+    - ``use_cache=True``: KV-cached speculation (dense GPT/LLaMA) — the
+      draft runs one-token cached steps, the target verifies each block
+      with ONE CHUNKED cached feed (gamma+1 query tokens against the
+      cache, causal within the chunk), and a rejection is a cache-cursor
+      rewind (:func:`rewind_cache`). Batch rows advance in lockstep by
+      the block-minimum accepted count (scalar cache cursors); B=1
+      serving loses nothing. Greedy output remains bit-identical to
+      target-only decoding.
 
     Returns (B, max_len) int32: prompt + generated tokens. Batch rows
-    advance independently (per-row acceptance counts).
+    advance independently (per-row acceptance counts; lockstep under
+    ``use_cache``). ``return_stats=True`` returns ``(tokens, stats)``
+    with ``stats["blocks"]`` — the number of speculation blocks (=
+    target forwards); ``(max_len - P) / blocks`` is the realized
+    tokens-per-target-forward, the acceptance-rate diagnostic.
     """
     B, P = prompt.shape
     if not 1 <= P <= max_len:
@@ -235,7 +391,25 @@ def speculative_generate(target_model, target_params, draft_model,
     _check_position_capacity(target_model, width)
     _check_position_capacity(draft_model, width)
     prompt = jnp.asarray(prompt, jnp.int32)
-    return _speculative(target_model, draft_model, target_params,
-                        draft_params, prompt, int(max_len), int(gamma),
-                        float(temperature), rng, int(top_k), float(top_p),
-                        None if eos_id is None else int(eos_id), width)
+    if use_cache:
+        import dataclasses as _dc
+
+        from horovod_tpu.models.generate import init_decode_cache
+        t_dec = _dc.replace(target_model, decode=True)
+        d_dec = _dc.replace(draft_model, decode=True)
+        t_cache = init_decode_cache(t_dec, prompt[:, :1], pos=0)
+        d_cache = init_decode_cache(d_dec, prompt[:, :1], pos=0)
+        out, nblk = _speculative_cached(
+            t_dec, d_dec, (target_params, t_cache),
+            (draft_params, d_cache), prompt, int(max_len), int(gamma),
+            float(temperature), rng, int(top_k), float(top_p),
+            None if eos_id is None else int(eos_id), width)
+    else:
+        out, nblk = _speculative(
+            target_model, draft_model, target_params, draft_params,
+            prompt, int(max_len), int(gamma), float(temperature), rng,
+            int(top_k), float(top_p),
+            None if eos_id is None else int(eos_id), width)
+    if return_stats:
+        return out, {"blocks": int(nblk)}
+    return out

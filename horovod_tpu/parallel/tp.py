@@ -212,15 +212,19 @@ class TPSelfAttention(nn.Module):
     use_bias: bool = True
 
     def _decode_attend(self, q, k, v, bias=None):
-        """Single-token decode against the KV cache (O(1) projections per
-        step, attention against the filled prefix). q: (B, 1, h, d),
-        k/v: (B, 1, kv, d) — the cache stores only the kv heads, the GQA
-        serving win. ``bias``: (local_heads, 1, cache_len) additive scores
-        bias for THIS step's query position (T5 relative positions,
-        computed by the caller from the cache cursor). Cache variables are
-        created on the first call (B and capacity fix the shapes; flax
-        initializes them lazily under mutable=['cache'])."""
-        B, _, h, d = q.shape
+        """Cached decode against the KV cache: ``s`` query tokens per call
+        (s=1 is the classic one-token step; s>1 is a CHUNK — the
+        speculative-verification path scores gamma+1 proposals in one
+        feed). q: (B, s, h, d), k/v: (B, s, kv, d) — the cache stores only
+        the kv heads, the GQA serving win. Within the chunk attention is
+        causal (query row i sees cache positions <= idx + i). ``bias``:
+        (local_heads, 1, cache_len) additive scores bias for a
+        SINGLE-token step (T5 relative positions; the caller computes it
+        from the cache cursor — chunked T5 decode is not supported).
+        Cache variables are created on the first call (B and capacity fix
+        the shapes; flax initializes them lazily under
+        mutable=['cache'])."""
+        B, s, h, d = q.shape
         kv = k.shape[2]
         L = self.cache_len
         ck = self.variable("cache", "k", jnp.zeros, (B, L, kv, d), q.dtype)
@@ -229,29 +233,30 @@ class TPSelfAttention(nn.Module):
                            lambda: jnp.zeros((), jnp.int32))
         idx = ci.value
         if self.rope_theta is not None:
-            pos = idx[None]                            # this token's position
+            pos = idx + jnp.arange(s)                 # the chunk's positions
             q = apply_rope(q, pos, self.rope_theta)
-            k = apply_rope(k, pos, self.rope_theta)    # cache holds rotated K
+            k = apply_rope(k, pos, self.rope_theta)   # cache holds rotated K
         ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
         cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
-        ci.value = idx + 1
+        ci.value = idx + s
         keys, vals = ck.value, cv.value
         # Grouped attend: q heads reshaped to (kv, group) contract directly
         # against the NARROW cache — no materialized broadcast of K/V to the
         # query heads, so the GQA cache shrinks bandwidth, not just capacity.
         g = h // kv
-        qg = q.reshape(B, 1, kv, g, d)
+        qg = q.reshape(B, s, kv, g, d)
         scores = jnp.einsum("bqngd,bknd->bngqk", qg, keys) / np.sqrt(d)
         if bias is not None:
             scores = scores + bias.reshape(kv, g, 1, L)[None].astype(
                 scores.dtype)
-        # positions beyond the filled prefix are invalid
-        valid = jnp.arange(L) <= idx                  # (L,)
-        scores = jnp.where(valid[None, None, None, None, :], scores,
+        # causal within the chunk, bounded by the filled prefix: query row
+        # i attends cache positions <= idx + i
+        valid = jnp.arange(L)[None, :] <= idx + jnp.arange(s)[:, None]
+        scores = jnp.where(valid[None, None, None, :, :], scores,
                            jnp.asarray(-1e9, scores.dtype))
         probs = jax.nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
         out = jnp.einsum("bngqk,bknd->bqngd", probs, vals)
-        return out.reshape(B, 1, h, d)
+        return out.reshape(B, s, h, d)
 
     def _attend(self, q, k, v, mask, bias=None):
         """Route full-sequence attention: sp ring/Ulysses, Pallas flash,
@@ -340,9 +345,10 @@ class TPSelfAttention(nn.Module):
             if self.sp_axis is not None or mask is not None:
                 raise ValueError(
                     "decode mode supports neither sp_axis nor masks")
-            if x.shape[1] != 1:
+            if bias is not None and x.shape[1] != 1:
                 raise ValueError(
-                    f"decode mode feeds ONE token per call, got "
+                    f"decode with an attention bias (T5 relative "
+                    f"positions) feeds ONE token per call, got "
                     f"{x.shape[1]}")
             if self.cache_len < 1:
                 raise ValueError("decode=True requires cache_len >= 1")

@@ -822,6 +822,123 @@ class TestSpeculative:
         freq = np.bincount(first, minlength=V) / n
         np.testing.assert_allclose(freq, p0, atol=0.03)
 
+    @pytest.mark.parametrize("family", ["gpt", "llama"])
+    def test_cached_speculative_bit_identical(self, hvd, rng, family):
+        """use_cache=True speculation (one-token cached draft steps, ONE
+        chunked cached target feed per block, cursor-rewind rejection)
+        must still be bit-identical to target-only greedy decoding —
+        for GPT (learned positions) and LLaMA (RoPE + GQA narrow
+        cache)."""
+        from horovod_tpu.models import (GPT, GPTConfig, Llama, LlamaConfig,
+                                        generate, speculative_generate)
+        if family == "gpt":
+            target = GPT(GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                                        num_layers=2,
+                                        max_position_embeddings=16))
+            draft = GPT(GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                                       num_layers=1,
+                                       max_position_embeddings=16))
+        else:
+            target = Llama(LlamaConfig.tiny(tp_axis=None, num_kv_heads=2,
+                                            max_position_embeddings=16))
+            draft = Llama(LlamaConfig.tiny(tp_axis=None, num_kv_heads=2,
+                                           num_layers=1,
+                                           max_position_embeddings=16))
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (3, 4)), np.int32))
+        tp = target.init(jax.random.PRNGKey(0), prompt)["params"]
+        dp = draft.init(jax.random.PRNGKey(1), prompt)["params"]
+        want = np.asarray(generate(target, tp, prompt, max_len=12))
+        got = np.asarray(speculative_generate(
+            target, tp, draft, dp, prompt, max_len=12, gamma=3,
+            use_cache=True))
+        np.testing.assert_array_equal(got, want)
+
+    def test_cached_perfect_draft_full_accept_block_count(self, hvd, rng):
+        """Perfect draft (same model+params) under use_cache: every block
+        must fully accept, so the block count is minimal —
+        ceil(generated / (gamma+1)). This is the regression guard for
+        the draft-cache hole: a fully-accepted block whose last proposal
+        was never fed into the draft cache would corrupt later proposals
+        and inflate the count."""
+        import math
+        from horovod_tpu.models import GPT, GPTConfig, speculative_generate
+        target = GPT(GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                                    num_layers=2,
+                                    max_position_embeddings=32))
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (1, 3)), np.int32))
+        tp = target.init(jax.random.PRNGKey(0), prompt)["params"]
+        max_len, gamma = 27, 3
+        _, stats = speculative_generate(
+            target, tp, target, tp, prompt, max_len=max_len, gamma=gamma,
+            use_cache=True, return_stats=True)
+        want_blocks = math.ceil((max_len - 3) / (gamma + 1))
+        assert stats["blocks"] == want_blocks, stats
+
+    def test_chunked_cache_feed_matches_sequential(self, hvd, rng):
+        """The chunked cached feed (s query tokens in one call) must
+        produce the same logits and cache state as s one-token feeds —
+        the invariant the speculative verifier relies on."""
+        import dataclasses as dc
+        from horovod_tpu.models import GPT, GPTConfig
+        from horovod_tpu.models.generate import init_decode_cache
+        cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=2,
+                             max_position_embeddings=16)
+        dec = dc.replace(GPT(cfg), decode=True)
+        toks = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (2, 5)), np.int32))
+        params = GPT(cfg).init(jax.random.PRNGKey(0), toks)["params"]
+        cache = init_decode_cache(dec, toks[:, :1], pos=0)
+        # chunked: all 5 tokens in one feed
+        chunk_logits, upd = dec.apply(
+            {"params": params, "cache": cache}, toks, pos=0,
+            mutable=["cache"])
+        # sequential: one token at a time
+        seq_cache = cache
+        seq_logits = []
+        for t in range(5):
+            lg, u = dec.apply(
+                {"params": params, "cache": seq_cache}, toks[:, t:t + 1],
+                pos=t, mutable=["cache"])
+            seq_cache = u["cache"]
+            seq_logits.append(lg[:, 0])
+        np.testing.assert_allclose(np.asarray(chunk_logits),
+                                   np.stack(seq_logits, axis=1),
+                                   rtol=2e-4, atol=2e-5)
+        for a, b in zip(jax.tree_util.tree_leaves(upd["cache"]),
+                        jax.tree_util.tree_leaves(seq_cache)):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=2e-4, atol=2e-5)
+
+    def test_rewind_cache_resets_cursors_only(self, hvd, rng):
+        """rewind_cache: every layer's idx leaf moves to the new cursor;
+        K/V contents are untouched (stale rows are masked, not erased)."""
+        import dataclasses as dc
+        from horovod_tpu.models import GPT, GPTConfig
+        from horovod_tpu.models.generate import init_decode_cache
+        from horovod_tpu.models.speculative import rewind_cache
+        cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=1,
+                             max_position_embeddings=8)
+        dec = dc.replace(GPT(cfg), decode=True)
+        toks = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (1, 4)), np.int32))
+        params = GPT(cfg).init(jax.random.PRNGKey(0), toks)["params"]
+        cache = init_decode_cache(dec, toks[:, :1], pos=0)
+        _, upd = dec.apply({"params": params, "cache": cache}, toks,
+                           pos=0, mutable=["cache"])
+        wound = rewind_cache(upd["cache"], 2)
+        flat = jax.tree_util.tree_flatten_with_path(wound)[0]
+        idxs = [l for p, l in flat if getattr(p[-1], "key", None) == "idx"]
+        assert idxs and all(int(v) == 2 for v in idxs)
+        kvs_a = [l for p, l in flat
+                 if getattr(p[-1], "key", None) in ("k", "v")]
+        kvs_b = [l for p, l in
+                 jax.tree_util.tree_flatten_with_path(upd["cache"])[0]
+                 if getattr(p[-1], "key", None) in ("k", "v")]
+        for a, b in zip(kvs_a, kvs_b):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
     def test_sampled_with_filters_reproducible(self, hvd, rng):
         """Sampled mode end-to-end with top-k/top-p engaged (the filter
         runs on (B, gamma+1, V) target logits — a 2-D-only filter breaks
