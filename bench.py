@@ -285,7 +285,8 @@ def _bench_bert(hvd):
 
     variables = jax.jit(model.init)(jax.random.PRNGKey(0), ids[:1])
     _mark("bert init done")
-    opt = DistributedOptimizer(optax.adamw(1e-5))
+    opt = DistributedOptimizer(optax.adamw(1e-5),
+                               compression=_compression())
 
     def loss_fn(p, b):
         mlm_logits, nsp_logits = model.apply({"params": p}, b["ids"])
@@ -317,7 +318,8 @@ def _bench_lm(hvd, label, metric, model, init_args, batch_dict, loss_fn,
     mesh = hvd.global_process_set.mesh
     variables = jax.jit(model.init)(jax.random.PRNGKey(0), *init_args)
     _mark(f"{label} init done")
-    opt = DistributedOptimizer(optax.adamw(1e-4))
+    opt = DistributedOptimizer(optax.adamw(1e-4),
+                               compression=_compression())
     step = make_train_step(loss_fn, opt, mesh, donate=True)
     state = TrainState.create(variables["params"], opt)
     iters, dt = _timed_steps(step, state, batch_dict)
@@ -528,7 +530,7 @@ def _bench_image(hvd, name):
 
     opt = DistributedOptimizer(
         optax.sgd(0.1, momentum=0.9),
-        compression=hvd.Compression.none)
+        compression=_compression())
 
     if batch_stats is not None:
         def loss_fn(p, b, extra):
@@ -557,6 +559,88 @@ def _bench_image(hvd, name):
           round(per_chip / baseline, 3) if baseline else 0.0)
 
 
+def _compression():
+    """HVD_BENCH_COMPRESSION=none|bf16|fp16|int8|powersgd[:rank] — wire
+    compression A/B for the training benches. On the single bench chip
+    collectives are degenerate, so this measures each scheme's compute
+    OVERHEAD (quantize/dequantize, low-rank factor math); the wire savings
+    need a multi-chip run."""
+    import horovod_tpu as hvd
+
+    sel = os.environ.get("HVD_BENCH_COMPRESSION", "none")
+    if sel == "powersgd" or sel.startswith("powersgd:"):
+        rank = int(sel.split(":", 1)[1]) if ":" in sel else 4
+        return hvd.Compression.powersgd(rank=rank)
+    if sel in ("none", "bf16", "fp16", "int8"):
+        return getattr(hvd.Compression, sel)
+    raise ValueError(f"unknown HVD_BENCH_COMPRESSION={sel!r}")
+
+
+def _bench_spec(hvd):
+    """Speculative-decoding serving bench: GPT-2-small target decoding
+    with KV-cached speculation (models/speculative.py). The draft is the
+    TARGET itself (perfect draft, 100% acceptance): every block does the
+    same forward work as gamma+1 plain cached steps, so the ratio vs the
+    plain cached generate() baseline (stderr) measures the MACHINERY
+    OVERHEAD — 1.0x means chunk-verify + cursor-rewind are free, and a
+    real draft at cost c*target with acceptance alpha then delivers its
+    textbook speedup undiminished. Reports generated tokens/sec/chip."""
+    from horovod_tpu.models import GPT, GPTConfig, generate, \
+        speculative_generate
+
+    # SINGLE-CHIP serving bench: the decode path is not mesh-sharded, so
+    # the batch is NOT scaled by world size and the metric is plain
+    # tokens/sec on the serving chip (unlike the training benches).
+    if hvd.size() > 1:
+        _mark(f"note: spec bench is single-chip; {hvd.size() - 1} other "
+              f"chip(s) idle")
+    gen_len = int(os.environ.get("HVD_BENCH_GENLEN", "128"))
+    gamma = int(os.environ.get("HVD_BENCH_SPEC_GAMMA", "4"))
+    batch = int(os.environ.get("HVD_BENCH_BATCH", "8"))
+    plen = max(1, min(32, gen_len // 2))   # prompt must fit small GENLENs
+    cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                    num_heads=12, intermediate_size=3072,
+                    max_position_embeddings=gen_len + gamma + 1,
+                    dtype=jnp.bfloat16, tp_axis=None, ep_axis=None)
+    model = GPT(cfg)
+    prompt = jnp.asarray(np.random.default_rng(0).integers(
+        0, cfg.vocab_size, (batch, plen)), jnp.int32)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0), prompt)["params"]
+    _mark("spec init done")
+
+    def spec():
+        return speculative_generate(model, params, model, params, prompt,
+                                    max_len=gen_len, gamma=gamma,
+                                    use_cache=True)
+
+    out = spec()
+    np.asarray(out)                       # sync: compile + warmup
+    _mark("spec warmup done")
+    iters = int(os.environ.get("HVD_BENCH_ITERS", "5"))
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = spec()
+    np.asarray(out)
+    dt = time.perf_counter() - t0
+    _mark(f"{iters} speculative decodes in {dt:.2f}s")
+    toks = (gen_len - plen) * batch * iters
+    # baseline: plain cached decode, same shapes (stderr only)
+    base = generate(model, params, prompt, max_len=gen_len, use_cache=True)
+    np.asarray(base)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        base = generate(model, params, prompt, max_len=gen_len,
+                        use_cache=True)
+    np.asarray(base)
+    dt_base = time.perf_counter() - t0
+    _mark(f"baseline cached generate: "
+          f"{toks / dt_base:.1f} tokens/sec/chip; self-draft ratio "
+          f"{dt_base / dt:.2f}x at gamma={gamma} (1.0 = the speculation "
+          f"machinery is overhead-free)")
+    _emit("gpt2_speculative_tokens_per_sec_per_chip",
+          round(toks / dt, 1), "tokens/sec/chip", 0.0)
+
+
 # Non-image benchmarks: selector -> (bench fn, metric name, unit). One
 # registry so dispatch and failure records can never disagree.
 _EXTRA_MODELS = {
@@ -570,6 +654,8 @@ _EXTRA_MODELS = {
               "tokens/sec/chip"),
     "t5": (_bench_t5, "t5_small_tokens_per_sec_per_chip",
            "tokens/sec/chip"),
+    "spec": (_bench_spec, "gpt2_speculative_tokens_per_sec_per_chip",
+             "tokens/sec/chip"),
 }
 
 

@@ -114,6 +114,17 @@ CONFIGS = [
     ("llama_chunked_remat", "bench",
      {"HVD_BENCH_MODEL": "llama", "HVD_BENCH_ITERS": "10",
       "HVD_BENCH_CHUNKED_XENT": "1", "HVD_BENCH_REMAT": "1"}, 1800),
+    # -- round-4 features: serving + compression overhead A/B -------------
+    ("gpt_spec_serving", "bench", {"HVD_BENCH_MODEL": "spec",
+                                   "HVD_BENCH_ITERS": "5"}, 2400),
+    ("resnet50_powersgd_overhead", "bench",
+     {"HVD_BENCH_ITERS": "20", "HVD_BENCH_COMPRESSION": "powersgd:4"},
+     1800),
+    ("gpt_powersgd_overhead", "bench",
+     {"HVD_BENCH_MODEL": "gpt", "HVD_BENCH_ITERS": "10",
+      "HVD_BENCH_COMPRESSION": "powersgd:4"}, 1800),
+    ("resnet50_int8_overhead", "bench",
+     {"HVD_BENCH_ITERS": "20", "HVD_BENCH_COMPRESSION": "int8"}, 1800),
 ]
 
 SCRIPTS = {
