@@ -122,18 +122,20 @@ class Config:
 
     def __post_init__(self):
         # Normalize/validate on EVERY construction path (env, CLI, direct):
-        # the fusion runtime CASTS float buffers to this dtype, so an
-        # integer/bogus value would silently destroy gradients (quantized
-        # int8 is a different mechanism: Compression.int8).
+        # the fusion runtime CASTS float buffers to a 16-bit wire dtype,
+        # while "int8" routes the fused bucket through the two-phase
+        # quantized exchange (strategies.allreduce_int8) — any other value
+        # would silently destroy gradients.
         self.wire_dtype = {"fp16": "float16",
                            "bf16": "bfloat16"}.get(self.wire_dtype,
                                                    self.wire_dtype)
         if self.wire_dtype and self.wire_dtype not in ("float16",
-                                                       "bfloat16"):
+                                                       "bfloat16", "int8"):
             raise ValueError(
-                f"wire_dtype={self.wire_dtype!r}: only float16/bfloat16 "
-                "cast compression is valid here; for quantized int8 use "
-                "Compression.int8 on the optimizer")
+                f"wire_dtype={self.wire_dtype!r}: float16/bfloat16 (cast) "
+                "or int8 (quantized exchange) are the wire options; the "
+                "jit-path analog of int8 is Compression.int8 on the "
+                "optimizer")
 
     @classmethod
     def from_env(cls):

@@ -88,7 +88,8 @@ class Int8Compressor(Compressor):
                 "Compression.int8 only takes effect in the fused jit "
                 "allreduce path (DistributedOptimizer / "
                 "fused_allreduce_tree with op=Sum/Average and no process "
-                "set); this collective runs UNCOMPRESSED.",
+                "set); this collective runs UNCOMPRESSED. For the EAGER "
+                "fusion runtime use HOROVOD_WIRE_DTYPE=int8 instead.",
                 stacklevel=3)
             Int8Compressor._warned = True
         return tensor, None

@@ -127,6 +127,17 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
         from horovod_tpu.ops.in_jit import mark_varying
         return mark_varying(mark_varying(out, CROSS_AXIS), LOCAL_AXIS)
 
+    # "int8" wire: the fused bucket rides the two-phase quantized exchange
+    # (EQuARX-style, parallel/strategies.allreduce_int8 — ~2 B/element vs
+    # 4) instead of a cast+psum. Only Sum/Average have exchange semantics,
+    # join masks can't ride it, tiny buckets would INFLATE (the exchange
+    # pads to n*1024 blocks), and the 2-level strategies keep their own
+    # wire schemes — all those cases quietly keep the exact psum.
+    int8_wire = (wire_dtype is not None
+                 and jnp.dtype(wire_dtype) == jnp.int8)
+    int8_ok = (int8_wire and strategy == "flat" and active is None
+               and op in (ReduceOp.SUM, ReduceOp.AVERAGE))
+
     def body(*xs):
         # xs: local slices (1, ...). Flatten each, concat per the bucket
         # layout (the MemcpyInFusionBuffer analog, fused by XLA into the
@@ -142,11 +153,22 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
         flats = []
         for x in xs:
             f = x.reshape(-1)
-            if wire_dtype is not None and jnp.issubdtype(f.dtype, jnp.floating):
+            if not int8_wire and wire_dtype is not None \
+                    and jnp.issubdtype(f.dtype, jnp.floating):
                 f = f.astype(wire_dtype)
             flats.append(f)
         buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-        buf = reduce_buf(buf)
+        if int8_ok and buf.size >= n * 1024 \
+                and jnp.issubdtype(buf.dtype, jnp.floating):
+            from horovod_tpu.ops.in_jit import mark_varying
+            from horovod_tpu.parallel.strategies import scaled_allreduce_int8
+            buf = mark_varying(scaled_allreduce_int8(
+                buf, axis_name=HVD_AXIS,
+                average=(op == ReduceOp.AVERAGE),
+                prescale_factor=prescale, postscale_factor=postscale),
+                HVD_AXIS)
+        else:
+            buf = reduce_buf(buf)
         outs, off = [], 0
         for x, sz in zip(xs, sizes):
             piece = lax.slice_in_dim(buf, off, off + sz).astype(x.dtype)
@@ -224,7 +246,12 @@ class FusionRuntime:
             cats = {"strategy": [self.strategy] + [
                 s for s in ("flat", "hierarchical", "torus")
                 if s != self.strategy]}
-            if config.wire_dtype:
+            if config.wire_dtype == "int8":
+                # The user opted into the LOSSY quantized exchange;
+                # sweeping UP in precision is allowed (never down — that
+                # is precision policy, not a speed knob).
+                cats["wire_dtype"] = ["int8", "bfloat16", "float16"]
+            elif config.wire_dtype:
                 other = ("bfloat16" if config.wire_dtype == "float16"
                          else "float16")
                 cats["wire_dtype"] = [config.wire_dtype, other]
@@ -520,7 +547,13 @@ class FusionRuntime:
     def _bucket_key(self, tensor, op, prescale, postscale):
         dt = jnp.dtype(tensor.dtype) if hasattr(tensor, "dtype") \
             else np.result_type(tensor)
-        if self.wire_dtype is not None and jnp.issubdtype(dt, jnp.floating):
+        if self.wire_dtype is not None and jnp.issubdtype(dt, jnp.floating) \
+                and jnp.dtype(self.wire_dtype) != jnp.int8:
+            # 16-bit casts make the bucket homogeneous at the wire dtype;
+            # int8 keeps each bucket in its ORIGINAL float dtype (the
+            # quantized exchange consumes/returns that dtype — folding
+            # fp32 and bf16 tensors into one "int8" bucket would make the
+            # concat heterogeneous).
             dt = jnp.dtype(self.wire_dtype)
         return (ReduceOp(op), float(prescale), float(postscale), str(dt))
 

@@ -115,14 +115,14 @@ def fused_allreduce_tree(tree, op=Average, axis_name=HVD_AXIS,
             if int8_route and jnp.issubdtype(dt, jnp.floating):
                 # int8 can't ride a plain psum (overflow + per-rank
                 # scales): route the bucket through the two-phase
-                # quantized exchange (strategies.allreduce_int8).
-                from horovod_tpu.parallel.strategies import allreduce_int8
-                if prescale_factor != 1.0:
-                    buf = buf * jnp.asarray(prescale_factor, buf.dtype)
-                buf = allreduce_int8(buf, axis_name=axis_name,
-                                     average=(op == Average))
-                if postscale_factor != 1.0:
-                    buf = buf * jnp.asarray(postscale_factor, buf.dtype)
+                # quantized exchange (shared wrapper so the eager fusion
+                # path can never diverge on scaling order).
+                from horovod_tpu.parallel.strategies import \
+                    scaled_allreduce_int8
+                buf = scaled_allreduce_int8(
+                    buf, axis_name=axis_name, average=(op == Average),
+                    prescale_factor=prescale_factor,
+                    postscale_factor=postscale_factor)
             else:
                 buf = in_jit.allreduce(buf, op=op, axis_name=axis_name,
                                        process_set=process_set,

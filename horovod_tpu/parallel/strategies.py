@@ -102,6 +102,20 @@ def allreduce_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
     return out
 
 
+def scaled_allreduce_int8(x, axis_name="hvd", average=False,
+                          prescale_factor=1.0, postscale_factor=1.0):
+    """:func:`allreduce_int8` with the reference's pre/postscale applied
+    around the exchange — the ONE wrapper both the jit fused path
+    (optim/optimizer.py) and the eager fusion runtime (ops/fusion.py)
+    call, so the scaling order can never diverge between them."""
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, x.dtype)
+    x = allreduce_int8(x, axis_name=axis_name, average=average)
+    if postscale_factor != 1.0:
+        x = x * jnp.asarray(postscale_factor, x.dtype)
+    return x
+
+
 def allreduce_int8(x, axis_name="hvd", average=False):
     """Quantized allreduce: int8 on the wire, fp32 accumulation.
 

@@ -107,7 +107,8 @@ def parse_args(argv=None):
                         help="2-level ICI/DCN torus allreduce "
                              "(fork knob HOROVOD_TORUS_ALLREDUCE)")
     tuning.add_argument("--wire-dtype", dest="wire_dtype",
-                        choices=["", "bfloat16", "float16", "bf16", "fp16"])
+                        choices=["", "bfloat16", "float16", "bf16", "fp16",
+                                 "int8"])
 
     autotune = p.add_argument_group("autotune")
     autotune.add_argument("--autotune", action="store_true", dest="autotune")

@@ -347,6 +347,34 @@ def _async_cycle_worker():
     return "ok"
 
 
+def _int8_wire_worker():
+    """Async fused allreduce under HOROVOD_WIRE_DTYPE=int8 at world 4
+    (2 procs x 2 chips): the big bucket rides the quantized exchange
+    (error bounded but nonzero), the small one stays exact."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+
+    n = hvd.size()
+    nl = len(hvd.topology().local_device_ranks)
+    rng = np.random.default_rng(7)
+    # per-device shard must clear the n*1024 inflation guard
+    big_all = rng.standard_normal((n, 8192)).astype(np.float32)
+    lr = hvd.topology().local_device_ranks
+    big = big_all[lr]
+    h = hvd.allreduce_async(big, op=hvd.Sum, name="int8big")
+    out = np.asarray(h.synchronize())
+    want = big_all.sum(0)
+    err = np.abs(out[0] - want).max()
+    bound = 4 * np.abs(big_all).max() * n / 127
+    assert 0 < err < bound, (err, bound)
+    small = np.ones((nl, 8), np.float32)
+    hs = hvd.allreduce_async(small, op=hvd.Sum, name="int8small")
+    np.testing.assert_allclose(np.asarray(hs.synchronize()),
+                               np.full((nl, 8), float(n)), rtol=1e-5)
+    return "ok"
+
+
 def _async_sync_interleave_worker():
     """Sync eager collectives interleaved with in-flight async enqueues:
     the sync-op fence must keep the device-collective submission order
@@ -395,6 +423,13 @@ class TestMultiProcessAsyncCycle:
     def test_sync_interleaved_with_async_2x2(self, shared_cluster):
         assert shared_cluster(H22).run(
             _async_sync_interleave_worker) == ["ok", "ok"]
+
+    def test_int8_wire_async_2x2(self, shared_cluster):
+        """HOROVOD_WIRE_DTYPE=int8 across real processes: the int8 wire
+        name must survive the coordinator->follower boundary publish and
+        the quantized fused program must agree on both processes."""
+        c = shared_cluster(H22, extra_env={"HOROVOD_WIRE_DTYPE": "int8"})
+        assert c.run(_int8_wire_worker) == ["ok", "ok"]
 
 
 def _join_worker():

@@ -272,6 +272,37 @@ class TestFusionRuntime:
         np.testing.assert_array_equal(np.asarray(hi.synchronize())[0],
                                       np.full(4, N, np.int32))
 
+    def test_int8_wire_dtype_on_eager_fusion(self, hvd, rng):
+        """HOROVOD_WIRE_DTYPE=int8: large fused buckets ride the
+        quantized exchange (bounded block error), tiny buckets and
+        non-Sum/Average ops stay EXACT (the exchange's padding would
+        inflate them / has no min/max semantics)."""
+        from horovod_tpu.ops import fusion
+        rt = fusion.get_runtime()
+        old_wire = rt.wire_dtype
+        rt.wire_dtype = jnp.int8
+        try:
+            # per-DEVICE shard must clear the n*1024 inflation guard
+            big = np.asarray(rng.standard_normal((N, 16384)), np.float32)
+            h = hvd.allreduce_async(big, op=hvd.Sum, name="int8big")
+            out = np.asarray(h.synchronize())
+            want = big.sum(0)
+            err = np.abs(out[0] - want).max()
+            # two quantization legs, each bounded by its block max/127
+            bound = 4 * np.abs(big).max() * N / 127
+            assert 0 < err < bound, (err, bound)
+            # tiny bucket: below n*1024 elements -> exact psum
+            small = np.asarray(rng.standard_normal((N, 16)), np.float32)
+            hs = hvd.allreduce_async(small, op=hvd.Sum, name="int8small")
+            np.testing.assert_allclose(np.asarray(hs.synchronize())[0],
+                                       small.sum(0), rtol=1e-5)
+            # Min has no quantized-exchange semantics -> exact
+            hm = hvd.allreduce_async(big, op=hvd.Min, name="int8min")
+            np.testing.assert_allclose(np.asarray(hm.synchronize())[0],
+                                       big.min(0), rtol=1e-6)
+        finally:
+            rt.wire_dtype = old_wire
+
 
 class TestPowerSGD:
     """Low-rank gradient compression with error feedback (optim/powersgd.py,
