@@ -1,0 +1,173 @@
+"""LoRA: low-rank adaptation for parameter-efficient fine-tuning.
+
+Beyond-parity capability (the reference has no model-level tooling;
+SURVEY.md §5.7 — this framework carries the model zoo, so it carries the
+fine-tuning story too): Hu et al. 2021, "LoRA: Low-Rank Adaptation of
+Large Language Models". Frozen base weights ``W`` are adapted as
+``W + (alpha/r) * B @ A`` with trainable rank-``r`` factors.
+
+TPU-first, MODEL-AGNOSTIC design: instead of wrapping layer modules (a
+per-architecture surgery), the adapters live as a separate small pytree
+and are MERGED FUNCTIONALLY into the parameter tree before each
+``model.apply`` — XLA fuses the rank-r matmul + add into the step, so
+any zoo model (GPT, LLaMA, BERT, T5, ViT, ...) works unchanged. The
+distributed win is structural: only the adapter gradients cross the
+wire, so the fused allreduce moves ``r*(n+m)`` elements per adapted
+``(n, m)`` kernel instead of ``n*m`` — the same economics PowerSGD
+approximates, exact here by construction.
+
+    lora = lora_init(params, rank=8, rng=key)           # adapters only
+    step = make_train_step(adapter_loss_fn(loss_fn, params, lora),
+                           DistributedOptimizer(optax.adamw(1e-4)), mesh)
+    ...                                                 # train adapters
+    export = lora_merge(params, trained_lora)           # standalone tree
+"""
+
+import re
+
+import jax
+import jax.numpy as jnp
+
+
+def _joined(path):
+    """THE slash-join convention for parameter paths — defined once."""
+    return "/".join(str(getattr(p, "key", p)) for p in path)
+
+
+def _kernel_leaves(params, targets):
+    """``(joined_path, leaf)`` pairs of 2-D ``kernel`` leaves matching
+    the ``targets`` regex (e.g. ``layer_0/attn/qkv/kernel``)."""
+    out = []
+    for path, leaf in jax.tree_util.tree_flatten_with_path(params)[0]:
+        joined = _joined(path)
+        if getattr(leaf, "ndim", 0) == 2 \
+                and joined.rsplit("/", 1)[-1] == "kernel" \
+                and re.search(targets, joined):
+            out.append((joined, leaf))
+    return out
+
+
+def lora_init(params, rank=8, alpha=None, targets=r".", rng=None,
+              dtype=None):
+    """Build the adapter pytree: for every 2-D ``kernel (n_in, n_out)``
+    whose path matches ``targets``, a gaussian-init ``a (n_in, r)`` and
+    a ZERO-init ``b (r, n_out)`` — so the adapted model starts EXACTLY
+    at the base model (Hu et al. §4.1). Returns ``{"rank", "alpha",
+    "adapters": {path: {"a", "b"}}}``; paths are the slash-joined
+    locations inside ``params``."""
+    if rank < 1:
+        raise ValueError(f"LoRA rank must be >= 1, got {rank}")
+    rng = rng if rng is not None else jax.random.PRNGKey(0)
+    alpha = float(alpha) if alpha is not None else float(rank)
+    selected = _kernel_leaves(params, targets)
+    if not selected:
+        raise ValueError(
+            f"lora_init: no 2-D 'kernel' leaves match targets={targets!r}")
+    adapters = {}
+    for i, (path, w) in enumerate(selected):
+        n_in, n_out = w.shape
+        r = min(rank, n_in, n_out)
+        dt = dtype or w.dtype
+        a = jax.random.normal(jax.random.fold_in(rng, i),
+                              (n_in, r), jnp.float32) * (1.0 / max(n_in, 1)
+                                                         ** 0.5)
+        adapters[path] = {"a": a.astype(dt),
+                          "b": jnp.zeros((r, n_out), dt)}
+    return {"rank": rank, "alpha": alpha, "adapters": adapters}
+
+
+def _delta(ad, alpha, rank):
+    scale = alpha / max(1, min(rank, ad["a"].shape[1]))
+    return (ad["a"] @ ad["b"]) * scale
+
+
+def lora_apply(params, lora):
+    """Merge the adapters into a NEW parameter tree for ``model.apply``:
+    ``W + (alpha/r) * A @ B`` at every adapted path, everything else
+    shared by reference. Run INSIDE the jitted step — XLA fuses the
+    rank-r work; base params stay untouched (frozen)."""
+    adapters = lora["adapters"]
+    alpha, rank = lora["alpha"], lora["rank"]
+
+    def merge(path, leaf):
+        ad = adapters.get(_joined(path))
+        if ad is None:
+            return leaf
+        return (leaf + _delta(ad, alpha, rank).astype(leaf.dtype))
+
+    return jax.tree_util.tree_map_with_path(merge, params)
+
+
+def lora_merge(params, lora):
+    """Export: fold the adapters permanently into a standalone parameter
+    tree (same structure as ``params``) for serving without the LoRA
+    machinery."""
+    return lora_apply(params, lora)
+
+
+def lora_wire_numbers(params, lora):
+    """(adapter_bytes, full_bytes) per allreduce — what LoRA fine-tuning
+    moves on the wire vs full fine-tuning (fp32 accounting)."""
+    adapter = sum(ad["a"].size + ad["b"].size
+                  for ad in lora["adapters"].values()) * 4
+    full = sum(l.size for l in jax.tree_util.tree_leaves(params)) * 4
+    return adapter, full
+
+
+def adapter_loss_fn(loss_fn, base_params, lora):
+    """The LoRA fine-tuning adapter for the standard training machinery:
+    given the model's ``loss_fn(params, batch)``, return
+    ``adapter_loss(adapters, batch)`` that merges the adapters into the
+    FROZEN ``base_params`` (a closure constant — gradients cannot reach
+    it by construction) before calling through.
+
+    Use with the ordinary step builders — LoRA is just a smaller
+    parameter tree to them, which is exactly the distributed win (the
+    fused allreduce moves adapter-sized buckets)::
+
+        lora = lora_init(params, rank=8, rng=key)
+        opt = DistributedOptimizer(optax.adamw(1e-4))
+        step = make_train_step(adapter_loss_fn(loss_fn, params, lora),
+                               opt, mesh)
+        state = TrainState.create(lora["adapters"], opt)
+        ...
+        trained = {**lora, "adapters": state.params}
+        export = lora_merge(params, trained)
+
+    The base tree is captured as a jit closure constant here — fine for
+    small/medium bases; for a LARGE base model use
+    :func:`adapter_loss_fn_via_extra`, which threads the base through
+    ``TrainState.extra`` as a real operand (no constant capture, compile
+    cache keys stay small).
+    """
+    rank, alpha = lora["rank"], lora["alpha"]
+
+    def adapter_loss(adapters, batch):
+        merged = lora_apply(
+            base_params,
+            {"rank": rank, "alpha": alpha, "adapters": adapters})
+        return loss_fn(merged, batch)
+
+    return adapter_loss
+
+
+def adapter_loss_fn_via_extra(loss_fn, lora):
+    """Large-base variant of :func:`adapter_loss_fn`: the frozen base
+    tree rides ``TrainState.extra`` as an explicit (non-differentiated)
+    operand instead of a jit closure constant::
+
+        step = make_train_step(adapter_loss_fn_via_extra(loss_fn, lora),
+                               opt, mesh, has_aux=True)
+        state = TrainState.create(lora["adapters"], opt, extra=params)
+
+    The returned ``adapter_loss(adapters, batch, base) -> (loss, base)``
+    passes the base back unchanged (the has_aux extra contract).
+    """
+    rank, alpha = lora["rank"], lora["alpha"]
+
+    def adapter_loss(adapters, batch, base):
+        merged = lora_apply(
+            base, {"rank": rank, "alpha": alpha, "adapters": adapters})
+        return loss_fn(merged, batch), base
+
+    return adapter_loss
