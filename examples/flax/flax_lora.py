@@ -1,0 +1,69 @@
+"""LoRA fine-tuning: adapt a frozen LLaMA with rank-8 factors only.
+
+The adapters merge functionally inside the jitted step (models/lora.py,
+Hu et al. 2021) — any zoo model works unchanged, and the distributed
+step's allreduce shrinks to adapter size. Runs anywhere:
+    JAX_PLATFORMS=cpu python flax_lora.py --steps 300
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+import horovod_tpu as hvd
+from horovod_tpu.models import (Llama, LlamaConfig, adapter_loss_fn,
+                                generate, lora_init, lora_merge,
+                                lora_wire_numbers)
+from horovod_tpu.optim import DistributedOptimizer
+from horovod_tpu.parallel import TrainState, make_train_step
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=300)
+    ap.add_argument("--rank", type=int, default=8)
+    args = ap.parse_args()
+
+    hvd.init()
+    n = hvd.size()
+    cfg = LlamaConfig.tiny(tp_axis=None, num_kv_heads=2, vocab_size=32,
+                           max_position_embeddings=12)
+    model = Llama(cfg)
+    seq = jnp.asarray(np.tile([[5, 9, 3, 7, 11, 2, 8, 4, 6, 10, 1, 12]],
+                              (n, 1)), jnp.int32)
+    params = model.init(jax.random.PRNGKey(0), seq)["params"]
+
+    def loss_fn(p, b):
+        lg = model.apply({"params": p}, b)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            lg[:, :-1].astype(jnp.float32), b[:, 1:]).mean()
+
+    lora = lora_init(params, rank=args.rank, rng=jax.random.PRNGKey(1))
+    opt = DistributedOptimizer(optax.adam(5e-2))
+    step = make_train_step(adapter_loss_fn(loss_fn, params, lora), opt,
+                           hvd.global_process_set.mesh)
+    state = TrainState.create(lora["adapters"], opt)
+    losses = []
+    for _ in range(args.steps):
+        state, loss = step(state, seq)
+        losses.append(float(loss))
+    wire, full = lora_wire_numbers(params, lora)
+    print(f"rank-{args.rank} LoRA: loss {losses[0]:.3f} -> "
+          f"{losses[-1]:.4f}; allreduce {wire:,} B vs {full:,} B full "
+          f"fine-tune ({full / wire:.1f}x less)")
+
+    merged = lora_merge(params,
+                        {**lora, "adapters": jax.device_get(state.params)})
+    out = np.asarray(generate(model, merged, seq[:1, :3], max_len=12))
+    ok = out[0].tolist() == np.asarray(seq)[0].tolist()
+    print(f"merged-export decode: {out[0].tolist()}")
+    assert ok, "merged export did not reproduce the target"
+    print("adapters-only fine-tune memorized the target; "
+          "merged export serves standalone")
+
+
+if __name__ == "__main__":
+    main()

@@ -99,6 +99,11 @@ class TestExamples:
         assert "converged with low-rank gradients" in out
         assert "less traffic" in out
 
+    def test_flax_lora(self):
+        out = _run("flax/flax_lora.py", "--steps", "500")
+        assert "merged export serves standalone" in out
+        assert "x less" in out
+
     def test_flax_llama(self):
         out = _run("flax/flax_llama.py", "--steps", "250")
         assert "decoded sequence matches training target" in out
