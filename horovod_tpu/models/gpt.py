@@ -43,6 +43,7 @@ class GPTConfig:
     # blocks at ~1/3 extra FLOPs — the lever for bigger per-chip batches
     # (MFU) and longer contexts on fixed HBM.
     remat: bool = False
+    kv_cache_int8: bool = False     # quantized decode cache (serving)
 
     @staticmethod
     def tiny(**kw):
@@ -186,6 +187,7 @@ class GPT(nn.Module):
                     use_flash=c.use_flash, sp_axis=c.sp_axis,
                     sp_impl=c.sp_impl, decode=self.decode,
                     cache_len=c.max_position_embeddings,
+                    kv_cache_int8=c.kv_cache_int8,
                     name=f"layer_{i}")(x)
         if features_only:
             return x

@@ -43,6 +43,7 @@ class LlamaConfig:
     sp_impl: str = "ring"           # "ring" | "ulysses" (parallel/sequence)
     # jax.checkpoint each block's backward (see GPTConfig.remat)
     remat: bool = False
+    kv_cache_int8: bool = False     # quantized decode cache (serving)
 
     @staticmethod
     def tiny(**kw):
@@ -95,6 +96,7 @@ class LlamaBlock(nn.Module):
             causal=True, use_flash=c.use_flash, sp_axis=c.sp_axis,
             sp_impl=c.sp_impl, decode=self.decode,
             cache_len=c.max_position_embeddings,
+            kv_cache_int8=c.kv_cache_int8,
             num_kv_heads=c.num_kv_heads, rope_theta=c.rope_theta,
             use_bias=False, name="attention")(
                 nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,

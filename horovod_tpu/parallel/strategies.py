@@ -102,6 +102,16 @@ def allreduce_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
     return out
 
 
+def symmetric_int8_quantize(t):
+    """THE symmetric int8 quantizer (one definition for the wire exchange
+    AND the quantized KV cache): per-LAST-axis scale ``max|t|/127``
+    clamped at 1e-30, round + clip to ±127. Returns ``(q8, scale)`` with
+    ``scale.shape == t.shape[:-1]`` (fp32 math expected in ``t``)."""
+    scale = jnp.maximum(jnp.max(jnp.abs(t), axis=-1) / 127.0, 1e-30)
+    q = jnp.clip(jnp.round(t / scale[..., None]), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
 def scaled_allreduce_int8(x, axis_name="hvd", average=False,
                           prescale_factor=1.0, postscale_factor=1.0):
     """:func:`allreduce_int8` with the reference's pre/postscale applied
@@ -155,17 +165,13 @@ def allreduce_int8(x, axis_name="hvd", average=False):
         flat = jnp.pad(flat, (0, pad))
     nb = flat.size // (n * block)                    # blocks per shard
     blocks = flat.reshape(n, nb, block)              # [dest, block, elem]
-    scale = jnp.maximum(
-        jnp.max(jnp.abs(blocks), axis=2) / 127.0, 1e-30)       # (n, nb)
-    q = jnp.clip(jnp.round(blocks / scale[..., None]),
-                 -127, 127).astype(jnp.int8)
+    q, scale = symmetric_int8_quantize(blocks)       # scale (n, nb)
     # Row d goes to rank d; row r of the result came from rank r.
     qt = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
     st = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
     part = jnp.sum(qt.astype(jnp.float32) * st[..., None],
                    axis=0)                           # (nb, block) fp32
-    s2 = jnp.maximum(jnp.max(jnp.abs(part), axis=1) / 127.0, 1e-30)  # (nb,)
-    q2 = jnp.clip(jnp.round(part / s2[:, None]), -127, 127).astype(jnp.int8)
+    q2, s2 = symmetric_int8_quantize(part)           # s2 (nb,)
     full_q = lax.all_gather(q2, axis_name, axis=0, tiled=False)  # (n,nb,blk)
     full_s = lax.all_gather(s2, axis_name, axis=0, tiled=False)  # (n, nb)
     out = (full_q.astype(jnp.float32) * full_s[..., None]).reshape(-1)

@@ -207,6 +207,7 @@ class TPSelfAttention(nn.Module):
     sp_impl: str = "ring"           # "ring" | "ulysses"
     decode: bool = False            # KV-cache single-token decoding
     cache_len: int = 0              # cache capacity when decode=True
+    kv_cache_int8: bool = False     # quantized decode cache (lossy)
     num_kv_heads: Optional[int] = None   # None -> MHA (= num_heads)
     rope_theta: Optional[float] = None   # None -> no rotary embedding
     use_bias: bool = True
@@ -223,23 +224,56 @@ class TPSelfAttention(nn.Module):
         from the cache cursor — chunked T5 decode is not supported).
         Cache variables are created on the first call (B and capacity fix
         the shapes; flax initializes them lazily under
-        mutable=['cache'])."""
+        mutable=['cache']).
+
+        ``kv_cache_int8``: rows are stored int8 with one fp32 scale per
+        (batch, position, kv-head) — ~1/2 the HBM of a bf16 cache (1/4 of
+        fp32) and half the cache bandwidth per step, the usual serving
+        bottleneck; dequantization is fused into the attend. Lossy: one
+        symmetric-quantization error per row, bounded by max|row|/127."""
         B, s, h, d = q.shape
         kv = k.shape[2]
         L = self.cache_len
-        ck = self.variable("cache", "k", jnp.zeros, (B, L, kv, d), q.dtype)
-        cv = self.variable("cache", "v", jnp.zeros, (B, L, kv, d), q.dtype)
+        int8c = self.kv_cache_int8
+        cache_dt = jnp.int8 if int8c else q.dtype
+        ck = self.variable("cache", "k", jnp.zeros, (B, L, kv, d), cache_dt)
+        cv = self.variable("cache", "v", jnp.zeros, (B, L, kv, d), cache_dt)
         ci = self.variable("cache", "idx",
                            lambda: jnp.zeros((), jnp.int32))
+        if int8c:
+            cks = self.variable("cache", "k_scale", jnp.zeros,
+                                (B, L, kv), jnp.float32)
+            cvs = self.variable("cache", "v_scale", jnp.zeros,
+                                (B, L, kv), jnp.float32)
         idx = ci.value
         if self.rope_theta is not None:
             pos = idx + jnp.arange(s)                 # the chunk's positions
             q = apply_rope(q, pos, self.rope_theta)
             k = apply_rope(k, pos, self.rope_theta)   # cache holds rotated K
-        ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
-        cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+
+        if int8c:
+            from horovod_tpu.parallel.strategies import \
+                symmetric_int8_quantize
+
+            def quant(t):
+                # per-(B, s, kv)-row scale over the head dim, fp32 math
+                return symmetric_int8_quantize(t.astype(jnp.float32))
+
+            k8, ks = quant(k)
+            v8, vs_ = quant(v)
+            ck.value = lax.dynamic_update_slice(ck.value, k8, (0, idx, 0, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, v8, (0, idx, 0, 0))
+            cks.value = lax.dynamic_update_slice(cks.value, ks, (0, idx, 0))
+            cvs.value = lax.dynamic_update_slice(cvs.value, vs_, (0, idx, 0))
+            keys = (ck.value.astype(jnp.float32)
+                    * cks.value[..., None]).astype(q.dtype)
+            vals = (cv.value.astype(jnp.float32)
+                    * cvs.value[..., None]).astype(q.dtype)
+        else:
+            ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
+            cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
+            keys, vals = ck.value, cv.value
         ci.value = idx + s
-        keys, vals = ck.value, cv.value
         # Grouped attend: q heads reshaped to (kv, group) contract directly
         # against the NARROW cache — no materialized broadcast of K/V to the
         # query heads, so the GQA cache shrinks bandwidth, not just capacity.
@@ -497,6 +531,7 @@ class TPTransformerBlock(nn.Module):
     sp_impl: str = "ring"
     decode: bool = False
     cache_len: int = 0
+    kv_cache_int8: bool = False
 
     @nn.compact
     def __call__(self, x, mask=None):
@@ -505,6 +540,7 @@ class TPTransformerBlock(nn.Module):
                             causal=self.causal, use_flash=self.use_flash,
                             sp_axis=self.sp_axis, sp_impl=self.sp_impl,
                             decode=self.decode, cache_len=self.cache_len,
+                            kv_cache_int8=self.kv_cache_int8,
                             name="attention")(
                                 nn.LayerNorm(dtype=self.dtype,
                                              name="ln_attn")(x), mask)
