@@ -598,10 +598,14 @@ def _bench_spec(hvd):
     gamma = int(os.environ.get("HVD_BENCH_SPEC_GAMMA", "4"))
     batch = int(os.environ.get("HVD_BENCH_BATCH", "8"))
     plen = max(1, min(32, gen_len // 2))   # prompt must fit small GENLENs
+    # HVD_BENCH_KV_INT8=1: quantized decode cache — halves the per-step
+    # cache bandwidth (the decode bottleneck); A/B against the default.
+    kv_int8 = os.environ.get("HVD_BENCH_KV_INT8", "0") == "1"
     cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
                     num_heads=12, intermediate_size=3072,
                     max_position_embeddings=gen_len + gamma + 1,
-                    dtype=jnp.bfloat16, tp_axis=None, ep_axis=None)
+                    dtype=jnp.bfloat16, tp_axis=None, ep_axis=None,
+                    kv_cache_int8=kv_int8)
     model = GPT(cfg)
     prompt = jnp.asarray(np.random.default_rng(0).integers(
         0, cfg.vocab_size, (batch, plen)), jnp.int32)

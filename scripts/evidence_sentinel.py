@@ -125,6 +125,9 @@ CONFIGS = [
       "HVD_BENCH_COMPRESSION": "powersgd:4"}, 1800),
     ("resnet50_int8_overhead", "bench",
      {"HVD_BENCH_ITERS": "20", "HVD_BENCH_COMPRESSION": "int8"}, 1800),
+    ("gpt_spec_kv_int8", "bench",
+     {"HVD_BENCH_MODEL": "spec", "HVD_BENCH_ITERS": "5",
+      "HVD_BENCH_KV_INT8": "1"}, 2400),
 ]
 
 SCRIPTS = {
