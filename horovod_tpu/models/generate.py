@@ -64,8 +64,11 @@ def _absorb_eos(nxt, done, eos_id):
 
 
 def _decode_feed(decoder, params):
-    """One cached decode step: feed a (B, 1) token at (traced) position
-    ``t``, return the updated cache and next-token logits (B, V)."""
+    """One cached decode step: feed a (B, s) token chunk starting at
+    (traced) position ``t`` (s=1 for the classic one-token step), return
+    the updated cache and the FIRST fed token's next-token logits
+    (B, V) — chunk consumers that need every row use their own feed
+    (models/speculative.py chunk_feed)."""
 
     def feed(cache, tok, t):
         logits, upd = decoder.apply(
@@ -76,19 +79,18 @@ def _decode_feed(decoder, params):
     return feed
 
 
-def _prefill_cache(feed, cache, prompt):
+def _prefill_cache(feed, cache, prompt, chunk=512):
     """Teacher-force tokens 0..P-2 of ``prompt`` into the cache (the last
-    prompt token is the first decode step's input)."""
-    P = prompt.shape[1]
-    if P <= 1:
-        return cache
-
-    def body(cache, t):
-        tok = lax.dynamic_slice_in_dim(prompt, t, 1, axis=1)
-        cache, _ = feed(cache, tok, t)
-        return cache, None
-
-    cache, _ = lax.scan(body, cache, jnp.arange(0, P - 1))
+    prompt token is the first decode step's input) — in CHUNKED feeds of
+    up to ``chunk`` tokens: the decode path accepts s-token chunks
+    (causal within the chunk), so time-to-first-token costs ~P/chunk
+    forwards instead of a P-1-step scan, while the per-layer fp32 score
+    transient stays bounded at (B, heads, chunk, cache_len) — one giant
+    chunk would peak prefill memory far above the decode loop's. Logits
+    are discarded (prefill wants only the K/V rows)."""
+    n = prompt.shape[1] - 1
+    for s in range(0, n, chunk):
+        cache, _ = feed(cache, prompt[:, s:min(s + chunk, n)], s)
     return cache
 
 
@@ -112,10 +114,10 @@ def sample_or_argmax(logits, rng, temperature, top_k, top_p):
 def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
                      top_k, top_p, eos_id=None):
     """KV-cache decode: ONE token per step through the cache-enabled model
-    (O(1) projections per step; attention reads the filled prefix). Two
-    scans: a prefill pass teacher-forces the prompt into the cache (no
+    (O(1) projections per step; attention reads the filled prefix). A
+    chunked prefill teacher-forces the prompt into the cache (no
     sampling, so the PRNG stream aligns with the re-forward path), then
-    the decode pass samples one token per step."""
+    a decode scan samples one token per step."""
     params, cache = state
     B, P = prompt.shape
     buf = jnp.zeros((B, max_len), jnp.int32)

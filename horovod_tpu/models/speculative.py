@@ -250,11 +250,11 @@ def _speculative_cached(target, draft, t_state, d_state, prompt, max_len,
     t_chunk = chunk_feed(target, t_params)
     d_chunk = chunk_feed(draft, d_params)
     d_feed = _decode_feed(draft, d_params)
-    # CHUNKED prefill: prompt tokens 0..P-2 enter each cache in one feed
-    # (cursor = P-1) instead of a P-1-step scan
-    if P > 1:
-        t_cache, _ = t_chunk(t_cache, prompt[:, :P - 1], 0)
-        d_cache, _ = d_chunk(d_cache, prompt[:, :P - 1], 0)
+    # Chunked prefill (THE shared implementation — bounded chunk size):
+    # prompt tokens 0..P-2 enter each cache, cursor lands at P-1.
+    from horovod_tpu.models.generate import _prefill_cache
+    t_cache = _prefill_cache(t_chunk, t_cache, prompt)
+    d_cache = _prefill_cache(d_chunk, d_cache, prompt)
 
     def body(carry):
         buf, t_cache, d_cache, pos, done, rng, nblk = carry
