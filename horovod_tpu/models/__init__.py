@@ -11,7 +11,9 @@ from horovod_tpu.models.llama import Llama, LlamaBlock, LlamaConfig  # noqa: F40
 from horovod_tpu.models.t5 import (  # noqa: F401
     T5, T5Config, t5_beam_decode, t5_generate, t5_greedy_decode,
 )
-from horovod_tpu.models.generate import beam_search, generate  # noqa: F401
+from horovod_tpu.models.generate import (  # noqa: F401
+    beam_search, generate, prefill_prefix,
+)
 from horovod_tpu.models.lora import (  # noqa: F401
     adapter_loss_fn, adapter_loss_fn_via_extra, lora_apply, lora_init,
     lora_merge, lora_wire_numbers,

@@ -18,6 +18,7 @@ import functools
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 
 
@@ -79,19 +80,58 @@ def _decode_feed(decoder, params):
     return feed
 
 
-def _prefill_cache(feed, cache, prompt, chunk=512):
-    """Teacher-force tokens 0..P-2 of ``prompt`` into the cache (the last
-    prompt token is the first decode step's input) — in CHUNKED feeds of
-    up to ``chunk`` tokens: the decode path accepts s-token chunks
-    (causal within the chunk), so time-to-first-token costs ~P/chunk
-    forwards instead of a P-1-step scan, while the per-layer fp32 score
-    transient stays bounded at (B, heads, chunk, cache_len) — one giant
-    chunk would peak prefill memory far above the decode loop's. Logits
-    are discarded (prefill wants only the K/V rows)."""
-    n = prompt.shape[1] - 1
-    for s in range(0, n, chunk):
-        cache, _ = feed(cache, prompt[:, s:min(s + chunk, n)], s)
+def _chunk_feed(decoder, params):
+    """Multi-token cached feed returning ALL ``s`` logit rows (the
+    one-token :func:`_decode_feed` keeps only the first) — used by the
+    chunked prefill, prefix caching, and the speculative verifier."""
+
+    def feed(cache, toks, t):
+        logits, upd = decoder.apply(
+            {"params": params, "cache": cache}, toks, pos=t,
+            mutable=["cache"])
+        return upd["cache"], logits
+
+    return feed
+
+
+def _prefill_cache(feed, cache, prompt, chunk=512, start=0, end=None):
+    """Teacher-force prompt tokens ``[start, end)`` into the cache — in
+    CHUNKED feeds of up to ``chunk`` tokens: the decode path accepts
+    s-token chunks (causal within the chunk), so time-to-first-token
+    costs ~P/chunk forwards instead of a P-1-step scan, while the
+    per-layer fp32 score transient stays bounded at
+    (B, heads, chunk, cache_len) — one giant chunk would peak prefill
+    memory far above the decode loop's. ``end`` defaults to P-1 (the
+    last prompt token is the first decode step's input); ``start > 0``
+    continues from a precomputed prefix cache (:func:`prefill_prefix`).
+    Logits are discarded (prefill wants only the K/V rows)."""
+    end = prompt.shape[1] - 1 if end is None else end
+    for s in range(start, end, chunk):
+        cache, _ = feed(cache, prompt[:, s:min(s + chunk, end)], s)
     return cache
+
+
+def prefill_prefix(model, params, prefix):
+    """Precompute the decode cache for a FIXED prompt prefix (the serving
+    system-prompt pattern): feed ALL ``Pp`` prefix tokens once, reuse the
+    result across ``generate(..., use_cache=True, prefix_state=state)``
+    calls — each call then prefills only the tokens AFTER the prefix.
+
+    ``prefix``: (B, Pp) int32, or (1, Pp) to be tiled to any decode
+    batch. Returns an opaque state dict; the prompt passed to generate
+    must still carry the FULL sequence (prefix + continuation) and must
+    begin with exactly these prefix tokens (validated)."""
+    import dataclasses as _dc
+
+    prefix = jnp.asarray(prefix, jnp.int32)
+    # fail loudly, like every decode entry point: an over-long prefix
+    # would silently CLAMP its cache writes onto the last rows
+    _check_position_capacity(model, prefix.shape[1])
+    decoder = _dc.replace(model, decode=True)
+    cache = init_decode_cache(decoder, prefix[:, :1], pos=0)
+    cache = _prefill_cache(_chunk_feed(decoder, params), cache, prefix,
+                           end=prefix.shape[1])
+    return {"cache": cache, "len": int(prefix.shape[1]), "prefix": prefix}
 
 
 def sample_or_argmax(logits, rng, temperature, top_k, top_p):
@@ -110,21 +150,23 @@ def sample_or_argmax(logits, rng, temperature, top_k, top_p):
     return nxt, rng
 
 
-@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8))
+@functools.partial(jax.jit, static_argnums=(0, 3, 4, 6, 7, 8, 9))
 def _generate_cached(decoder, state, prompt, max_len, temperature, rng,
-                     top_k, top_p, eos_id=None):
+                     top_k, top_p, eos_id=None, prefill_start=0):
     """KV-cache decode: ONE token per step through the cache-enabled model
     (O(1) projections per step; attention reads the filled prefix). A
     chunked prefill teacher-forces the prompt into the cache (no
     sampling, so the PRNG stream aligns with the re-forward path), then
-    a decode scan samples one token per step."""
+    a decode scan samples one token per step. ``prefill_start > 0``:
+    the supplied cache already holds a prefix (:func:`prefill_prefix`)
+    and only the later prompt tokens are fed."""
     params, cache = state
     B, P = prompt.shape
     buf = jnp.zeros((B, max_len), jnp.int32)
     buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
     feed = _decode_feed(decoder, params)
-    cache = _prefill_cache(feed, cache, prompt)
+    cache = _prefill_cache(feed, cache, prompt, start=prefill_start)
 
     def step(carry, t):
         buf, cache, rng, done = carry
@@ -417,7 +459,8 @@ def beam_search(model, params, prompt, max_len, num_beams=4, eos_id=None,
 
 
 def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
-             use_cache=False, top_k=0, top_p=1.0, eos_id=None):
+             use_cache=False, top_k=0, top_p=1.0, eos_id=None,
+             prefix_state=None):
     """Generate up to ``max_len`` total tokens from ``prompt``.
 
     - ``model``: a causal LM whose ``apply({"params": p}, ids)`` returns
@@ -438,6 +481,12 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
       with ``eos_id`` to ``max_len`` (fixed shapes; slice at the first
       EOS to recover the variable-length output). EOS tokens inside the
       prompt do not count.
+    - ``prefix_state`` (with ``use_cache=True``): a
+      :func:`prefill_prefix` result — the cache already holds the shared
+      prefix (system prompt), so only the prompt tokens after it are
+      prefilled. ``prompt`` must still carry the FULL sequence and begin
+      with the prefix tokens (validated; a (1, Pp) prefix cache is tiled
+      to the prompt batch).
 
     Returns (B, max_len) int32: the prompt followed by generated tokens.
     The decode loop is one compiled program; like any jit, it retraces per
@@ -460,17 +509,53 @@ def generate(model, params, prompt, max_len, temperature=0.0, rng=None,
         rng = jax.random.PRNGKey(0)
     prompt = jnp.asarray(prompt, jnp.int32)
     _check_position_capacity(model, max_len)
+    if prefix_state is not None and not use_cache:
+        raise ValueError("prefix_state requires use_cache=True (the "
+                         "prefix lives in the decode cache)")
     if use_cache:
         # KV-cache path: O(1) projection work per token instead of a full
         # re-forward (dense GPT/LLaMA; the cache model shares the params
         # tree).
         import dataclasses as _dc
         decoder = _dc.replace(model, decode=True)
-        cache = init_decode_cache(decoder, prompt[:, :1], pos=0)
+        start = 0
+        if prefix_state is not None:
+            start = int(prefix_state["len"])
+            pfx = prefix_state["prefix"]
+            if start >= P:
+                # The prefix cache's cursor already sits PAST its last
+                # token; the decode scan must still feed prompt[:, P-1],
+                # so a prefix covering the whole prompt would double-feed
+                # it (duplicate K/V row, positions shifted by one).
+                raise ValueError(
+                    f"prefix length {start} must be SHORTER than the "
+                    f"prompt ({P}): the last prompt token is the first "
+                    f"decode input")
+            if pfx.shape[0] not in (1, B):
+                raise ValueError(
+                    f"prefix batch {pfx.shape[0]} incompatible with "
+                    f"prompt batch {B} (use 1 or {B})")
+            want = np.broadcast_to(np.asarray(pfx), (B, start))
+            if not np.array_equal(np.asarray(prompt[:, :start]), want):
+                raise ValueError(
+                    "prompt does not begin with the prefix the "
+                    "prefix_state was built from — the cached K/V rows "
+                    "would silently describe different text")
+            cache = prefix_state["cache"]
+            if pfx.shape[0] == 1 and B > 1:
+                # tile the 1-row prefix cache to the decode batch
+                # (scalar cursors stay shared)
+                cache = jax.tree_util.tree_map(
+                    lambda c: jnp.repeat(c, B, axis=0)
+                    if getattr(c, "ndim", 0) >= 1 and c.shape[0] == 1
+                    else c, cache)
+        else:
+            cache = init_decode_cache(decoder, prompt[:, :1], pos=0)
         return _generate_cached(decoder, (params, cache), prompt,
                                 int(max_len), float(temperature), rng,
                                 int(top_k), float(top_p),
-                                None if eos_id is None else int(eos_id))
+                                None if eos_id is None else int(eos_id),
+                                start)
     return _generate(model, params, prompt,
                      int(max_len), float(temperature), rng,
                      int(top_k), float(top_p),

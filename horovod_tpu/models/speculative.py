@@ -225,7 +225,7 @@ def _speculative_cached(target, draft, t_state, d_state, prompt, max_len,
     per-layer cache cursors); per-token marginals are unchanged
     (truncating an accepted prefix cannot bias it), B=1 serving loses
     nothing. Returns ``(buffer, n_blocks)``."""
-    from horovod_tpu.models.generate import _decode_feed
+    from horovod_tpu.models.generate import _chunk_feed, _decode_feed
 
     t_params, t_cache = t_state
     d_params, d_cache = d_state
@@ -235,20 +235,8 @@ def _speculative_cached(target, draft, t_state, d_state, prompt, max_len,
     buf = jnp.zeros((B, W), jnp.int32)
     buf = lax.dynamic_update_slice(buf, prompt, (0, 0))
 
-    def chunk_feed(decoder, params):
-        """Multi-token cached feed: returns ALL s logit rows (the
-        one-token _decode_feed keeps only the first)."""
-
-        def feed(cache, toks, t):
-            logits, upd = decoder.apply(
-                {"params": params, "cache": cache}, toks, pos=t,
-                mutable=["cache"])
-            return upd["cache"], logits
-
-        return feed
-
-    t_chunk = chunk_feed(target, t_params)
-    d_chunk = chunk_feed(draft, d_params)
+    t_chunk = _chunk_feed(target, t_params)
+    d_chunk = _chunk_feed(draft, d_params)
     d_feed = _decode_feed(draft, d_params)
     # Chunked prefill (THE shared implementation — bounded chunk size):
     # prompt tokens 0..P-2 enter each cache, cursor lands at P-1.

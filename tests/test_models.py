@@ -972,6 +972,89 @@ class TestSpeculative:
                                  gamma=3)
 
 
+class TestPrefixCache:
+    """prefill_prefix + generate(prefix_state=): the serving
+    system-prompt pattern — the shared prefix's K/V rows are computed
+    once and reused; outputs stay bit-identical to a cold decode."""
+
+    def _model(self, rng, family="gpt"):
+        from horovod_tpu.models import GPT, GPTConfig, Llama, LlamaConfig
+        if family == "gpt":
+            m = GPT(GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                                   num_layers=2,
+                                   max_position_embeddings=16))
+        else:
+            m = Llama(LlamaConfig.tiny(tp_axis=None, num_kv_heads=2,
+                                       num_layers=2,
+                                       max_position_embeddings=16))
+        ids = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (3, 8)), np.int32))
+        return m, m.init(jax.random.PRNGKey(0), ids)["params"]
+
+    @pytest.mark.parametrize("family", ["gpt", "llama"])
+    def test_bit_identical_to_cold_decode(self, hvd, rng, family):
+        from horovod_tpu.models import generate, prefill_prefix
+        model, params = self._model(rng, family)
+        prefix = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (3, 5)), np.int32))
+        user = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (3, 3)), np.int32))
+        prompt = jnp.concatenate([prefix, user], axis=1)
+        cold = np.asarray(generate(model, params, prompt, max_len=14,
+                                   use_cache=True))
+        state = prefill_prefix(model, params, prefix)
+        warm = np.asarray(generate(model, params, prompt, max_len=14,
+                                   use_cache=True, prefix_state=state))
+        np.testing.assert_array_equal(warm, cold)
+
+    def test_one_row_prefix_tiles_to_batch(self, hvd, rng):
+        from horovod_tpu.models import generate, prefill_prefix
+        model, params = self._model(rng)
+        prefix = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (1, 5)), np.int32))
+        user = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (3, 3)), np.int32))
+        prompt = jnp.concatenate(
+            [jnp.broadcast_to(prefix, (3, 5)), user], axis=1)
+        cold = np.asarray(generate(model, params, prompt, max_len=14,
+                                   use_cache=True))
+        state = prefill_prefix(model, params, prefix)   # batch 1
+        warm = np.asarray(generate(model, params, prompt, max_len=14,
+                                   use_cache=True, prefix_state=state))
+        np.testing.assert_array_equal(warm, cold)
+
+    def test_misuse(self, hvd, rng):
+        from horovod_tpu.models import generate, prefill_prefix
+        model, params = self._model(rng)
+        prefix = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (1, 5)), np.int32))
+        state = prefill_prefix(model, params, prefix)
+        other = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (2, 8)), np.int32))
+        with pytest.raises(ValueError, match="begin with the prefix"):
+            generate(model, params, other, max_len=14, use_cache=True,
+                     prefix_state=state)
+        with pytest.raises(ValueError, match="requires use_cache"):
+            generate(model, params, other, max_len=14,
+                     prefix_state=state)
+        with pytest.raises(ValueError, match="SHORTER than the prompt"):
+            # prefix == whole prompt would double-feed the last token
+            generate(model, params, jnp.broadcast_to(prefix, (1, 5)),
+                     max_len=14, use_cache=True, prefix_state=state)
+        with pytest.raises(ValueError, match="incompatible with"):
+            two_row = prefill_prefix(
+                model, params, jnp.broadcast_to(prefix, (2, 5)))
+            prompt3 = jnp.concatenate(
+                [jnp.broadcast_to(prefix, (3, 5)),
+                 jnp.zeros((3, 2), jnp.int32)], axis=1)
+            generate(model, params, prompt3, max_len=14, use_cache=True,
+                     prefix_state=two_row)
+        with pytest.raises(ValueError, match="position"):
+            # prefix longer than the position table fails loudly
+            prefill_prefix(model, params,
+                           jnp.zeros((1, 20), jnp.int32))
+
+
 class TestInt8KVCache:
     """Quantized decode cache (kv_cache_int8): rows stored int8 with one
     fp32 scale per (batch, position, kv-head) — ~1/4 the fp32 cache HBM
