@@ -1,0 +1,92 @@
+"""Serving tour: the decode stack end to end on one trained model.
+
+Trains a tiny LLaMA, then walks the serving levers in order — plain
+KV-cached decode, a reusable system-prompt prefix cache, the
+int8-quantized KV cache, and KV-cached speculative decoding — asserting
+each produces the trained target. Runs anywhere:
+    JAX_PLATFORMS=cpu python flax_serving.py --steps 400
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from horovod_tpu.models import (Llama, LlamaConfig, generate,
+                                prefill_prefix, speculative_generate)
+
+
+def train(model, params, seq, steps):
+    tx = optax.adam(5e-3)
+
+    def step(c, _):
+        p, o = c
+
+        def loss(p):
+            lg = model.apply({"params": p}, seq)
+            return optax.softmax_cross_entropy_with_integer_labels(
+                lg[:, :-1].astype(jnp.float32), seq[:, 1:]).mean()
+
+        l, g = jax.value_and_grad(loss)(p)
+        up, o = tx.update(g, o, p)
+        return (optax.apply_updates(p, up), o), l
+
+    (params, _), ls = jax.jit(lambda p, o: lax.scan(
+        step, (p, o), None, length=steps))(params, tx.init(params))
+    return params, float(ls[0]), float(ls[-1])
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--steps", type=int, default=400)
+    args = ap.parse_args()
+
+    target = [5, 9, 3, 7, 11, 2, 8, 4, 6, 10, 1, 12]
+    seq = jnp.asarray([target], jnp.int32)
+
+    def make(**kw):
+        return Llama(LlamaConfig.tiny(tp_axis=None, num_kv_heads=2,
+                                      vocab_size=32,
+                                      max_position_embeddings=20, **kw))
+
+    model = make()
+    params = model.init(jax.random.PRNGKey(0), seq)["params"]
+    params, l0, l1 = train(model, params, seq, args.steps)
+    print(f"trained: loss {l0:.2f} -> {l1:.4f}")
+    prompt = seq[:, :3]
+
+    # 1. plain KV-cached greedy decode (chunked prefill inside)
+    out = np.asarray(generate(model, params, prompt, max_len=12,
+                              use_cache=True))
+    assert out[0].tolist() == target, out
+    print("1. KV-cached decode reproduces the target")
+
+    # 2. prefix caching: the 'system prompt' K/V rows computed ONCE
+    state = prefill_prefix(model, params, prompt[:, :2])
+    out = np.asarray(generate(model, params, prompt, max_len=12,
+                              use_cache=True, prefix_state=state))
+    assert out[0].tolist() == target, out
+    print("2. prefix-cached decode bit-matches (prefix prefilled once)")
+
+    # 3. int8-quantized KV cache: ~1/4 the cache HBM, lossy but bounded
+    q_model = make(kv_cache_int8=True)
+    out = np.asarray(generate(q_model, params, prompt, max_len=12,
+                              use_cache=True))
+    assert out[0].tolist() == target, out
+    print("3. int8-quantized KV cache still reproduces the target")
+
+    # 4. KV-cached speculative decoding (self-draft: every block accepts)
+    out, stats = speculative_generate(
+        model, params, model, params, prompt, max_len=12, gamma=3,
+        use_cache=True, return_stats=True)
+    assert np.asarray(out)[0].tolist() == target
+    print(f"4. cached speculative decode matches in {stats['blocks']} "
+          f"target forwards for {12 - 3} tokens")
+    print("SERVING TOUR OK")
+
+
+if __name__ == "__main__":
+    main()

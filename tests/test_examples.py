@@ -104,6 +104,11 @@ class TestExamples:
         assert "merged export serves standalone" in out
         assert "x less" in out
 
+    def test_flax_serving(self):
+        out = _run("flax/flax_serving.py", "--steps", "400")
+        assert "SERVING TOUR OK" in out
+        assert "prefix-cached decode bit-matches" in out
+
     def test_flax_llama(self):
         out = _run("flax/flax_llama.py", "--steps", "250")
         assert "decoded sequence matches training target" in out

@@ -283,6 +283,52 @@ class TestRunApi:
         assert results == [("el", 0, 2), ("el", 1, 2)]
 
 
+class TestRemovalOnlyWindow:
+    """HostUpdateListener.removal_only walks EVERY coalesced bump since
+    the last acknowledged version (reference: HostUpdateResult is
+    accumulated across pending updates) — a poll that skipped an 'add'
+    bump must NOT skip the state re-sync."""
+
+    def _listener(self, kinds, seen=0):
+        from horovod_tpu.elastic.worker import HostUpdateListener
+
+        class FakeKV:
+            def get(self, scope, key):
+                assert scope == "elastic"
+                v = key.rsplit("/", 1)[-1]
+                return kinds.get(int(v))
+
+        listener = HostUpdateListener.__new__(HostUpdateListener)
+        listener._client = FakeKV()
+        listener._seen = seen
+        return listener
+
+    def test_all_removals_skip_sync(self):
+        l = self._listener({1: b"removal", 2: b"removal"})
+        assert l.removal_only(2) is True
+
+    def test_coalesced_add_forces_sync(self):
+        # poll observed only v2; v1 was an ADD the worker never saw
+        l = self._listener({1: b"add", 2: b"removal"})
+        assert l.removal_only(2) is False
+
+    def test_missing_kind_row_conservative(self):
+        l = self._listener({2: b"removal"})     # v1 row GC'd/absent
+        assert l.removal_only(2) is False
+
+    def test_kv_error_conservative(self):
+        from horovod_tpu.elastic.worker import HostUpdateListener
+
+        class Boom:
+            def get(self, scope, key):
+                raise OSError("transient")
+
+        listener = HostUpdateListener.__new__(HostUpdateListener)
+        listener._client = Boom()
+        listener._seen = 0
+        assert listener.removal_only(1) is False
+
+
 class TestElasticDriver:
     """In-process simulation with synthetic host sets
     (reference: test_elastic_driver.py drives _update_host_assignments)."""
