@@ -35,10 +35,11 @@ class TestExamples:
         out = _run("flax/flax_mnist.py")
         assert "final loss" in out
 
+    @pytest.mark.timeout(600)   # slowest examples: headroom for parallel CI shards on one machine
     def test_flax_synthetic_benchmark(self):
         out = _run("flax/flax_synthetic_benchmark.py",
                    "--batch-size", "2", "--num-iters", "2",
-                   "--num-warmup", "1")
+                   "--num-warmup", "1", timeout=580)
         assert "Img/sec per chip" in out
 
     def test_tensorflow2_synthetic_benchmark(self):
@@ -67,8 +68,9 @@ class TestExamples:
         out = _run("pytorch/pytorch_mnist.py")
         assert "loss" in out
 
+    @pytest.mark.timeout(600)   # join protocol rounds; headroom under contention
     def test_pytorch_uneven_batches_join(self):
-        out = _run("pytorch/pytorch_uneven_batches.py", timeout=600)
+        out = _run("pytorch/pytorch_uneven_batches.py", timeout=580)
         assert "last rank to join = 1" in out
         assert "join() complete" in out
 
@@ -126,13 +128,16 @@ class TestExamples:
         assert "final loss" in out
         assert "moments/chip" in out
 
-    def test_flax_pipeline(self):
-        for sched in ("gpipe", "1f1b"):
-            out = _run("flax/flax_pipeline.py", "--schedule", sched,
-                       "--steps", "6")
-            assert "final loss" in out and f"schedule={sched}" in out
+    @pytest.mark.timeout(600)   # slow example: headroom for parallel CI shards on one machine
+    @pytest.mark.parametrize("sched", ["gpipe", "1f1b"])
+    def test_flax_pipeline(self, sched):
+        out = _run("flax/flax_pipeline.py", "--schedule", sched,
+                   "--steps", "6", timeout=580)
+        assert "final loss" in out and f"schedule={sched}" in out
 
+    @pytest.mark.timeout(600)   # slowest examples: headroom for parallel CI shards on one machine
     def test_flax_t5(self):
-        out = _run("flax/flax_t5.py", "--steps", "120", "--use-cache")
+        out = _run("flax/flax_t5.py", "--steps", "120", "--use-cache",
+                   timeout=580)
         assert "decode copy accuracy: 100%" in out
         assert "copied the source back" in out

@@ -953,8 +953,11 @@ def _sp_gpt_worker():
 
 
 class TestMultiProcessSequenceParallel:
+    @pytest.mark.timeout(600)   # ~90s solo; headroom for parallel CI shards
     def test_sp_gpt_crosses_processes(self, shared_cluster):
-        results = shared_cluster(H22).run(_sp_gpt_worker)
+        # cluster-job timeout must match the marker, or the cluster's own
+        # 300s default fires first and marks the shared cluster dead
+        results = shared_cluster(H22).run(_sp_gpt_worker, timeout=580)
         assert len(results) == 2
         assert results[0] == results[1]
 
