@@ -31,6 +31,18 @@ standing procedure, not ad-hoc runs (reference: docs/benchmarks.rst:15-64).
 
 The sentinel itself never imports jax — a poisoned backend can only hang
 its subprocesses, which it kills.
+
+Rehearsal mode (``--rehearsal`` or ``HVD_SENTINEL_REHEARSAL=1``) proves the
+whole capture path END TO END without a tunnel: every config — bench JSON
+parse, on-chip scripts, retry/refund accounting, summary, path-scoped git
+commit — runs against the CPU backend with tiny shapes.  Rehearsal is
+hermetically separated from real evidence: it scrubs the tunnel env
+(PALLAS_AXON_*), pins ``JAX_PLATFORMS=cpu``, writes to
+``docs/bench_runs_rehearsal/`` (own probe log, state, lock), stamps every
+record ``"rehearsal": true``, and its evidence bar accepts ``platform ==
+"cpu"`` — so a rehearsal artifact can never mark a real config done or
+read as an on-chip number.  Run the CI-tested subset via ``--configs``;
+the full sweep runs once per round (see tests/test_sentinel.py).
 """
 import argparse
 import json
@@ -47,6 +59,52 @@ PROBE_LOG = RUNS / "probe_log.jsonl"
 STATE = RUNS / "state.json"
 SUMMARY = RUNS / "summary.json"
 MAX_TRIES = 3
+REHEARSAL = False
+
+# Tiny-shape clamps applied AFTER each config's own env in rehearsal: the
+# rehearsal proves the capture path (parse, retry, commit), not perf, so
+# every config must finish on CPU in minutes.
+REHEARSAL_CLAMPS = {
+    "HVD_BENCH_ITERS": "1",
+    "HVD_BENCH_BATCH": "2",
+    "HVD_BENCH_SEQ": "128",
+    "HVD_BENCH_GENLEN": "32",
+    "HVD_BENCH_WATCHDOG": "600",
+}
+
+
+def _enter_rehearsal():
+    """Switch the module into rehearsal mode: isolated output tree (own
+    probe log / state / lock) so rehearsal can run concurrently with a
+    real sentinel and can never mark a real config done."""
+    global REHEARSAL, RUNS, PROBE_LOG, STATE, SUMMARY
+    REHEARSAL = True
+    RUNS = REPO / "docs" / "bench_runs_rehearsal"
+    PROBE_LOG = RUNS / "probe_log.jsonl"
+    STATE = RUNS / "state.json"
+    SUMMARY = RUNS / "summary.json"
+
+
+def _scrub_env(env):
+    """CPU-backend env for every rehearsal subprocess (probes included):
+    drop the tunnel trigger (a poisoned axon plugin hangs at import), pin
+    CPU, and pin the CPU thunk scheduler flag the test tier needs (see
+    tests/conftest.py / docs/troubleshooting.md)."""
+    for k in ("PALLAS_AXON_POOL_IPS", "PALLAS_AXON_REMOTE_COMPILE",
+              "PALLAS_AXON_TPU_GEN"):
+        env.pop(k, None)
+    env["JAX_PLATFORMS"] = "cpu"
+    # Pin the scheduler flag to false even when the inherited env pins it
+    # true — the optimized CPU thunk scheduler deadlocks parallel
+    # collective chains (docs/troubleshooting.md).
+    flags = " ".join(
+        f for f in env.get("XLA_FLAGS", "").split()
+        if "xla_cpu_enable_concurrency_optimized_scheduler" not in f)
+    env["XLA_FLAGS"] = (
+        flags + " --xla_cpu_enable_concurrency_optimized_scheduler=false"
+    ).strip()
+    env["HVD_SENTINEL_REHEARSAL"] = "1"
+    return env
 
 # Ordered evidence queue: (name, kind, env-overrides, timeout-seconds).
 # kind "bench" runs `python bench.py`; kind "script" runs the given file.
@@ -166,19 +224,24 @@ def _save_state(state):
 def probe(timeout):
     """One bounded backend probe in a killable subprocess."""
     t0 = time.time()
+    env = _scrub_env(dict(os.environ)) if REHEARSAL else None
+    want = "cpu" if REHEARSAL else "tpu"
     try:
         r = subprocess.run(
             [sys.executable, "-c",
              "import jax; d=jax.devices(); "
              "print(len(d), d[0].platform, d[0].device_kind)"],
-            capture_output=True, text=True, timeout=timeout, cwd=REPO)
+            capture_output=True, text=True, timeout=timeout, cwd=REPO,
+            env=env)
         dt = round(time.time() - t0, 1)
         if r.returncode == 0 and r.stdout.strip():
             # A CPU fallback answering the probe must NOT count as a
             # tunnel window — the sweep would burn every config's tries
-            # on CPU and record CPU numbers as evidence.
-            if "tpu" not in r.stdout.lower():
-                return False, dt, f"non-TPU backend: {r.stdout.strip()[:120]}"
+            # on CPU and record CPU numbers as evidence.  (In rehearsal
+            # the CPU backend IS the target.)
+            if want not in r.stdout.lower():
+                return False, dt, \
+                    f"non-{want.upper()} backend: {r.stdout.strip()[:120]}"
             return True, dt, r.stdout.strip()
         tail = (r.stderr or r.stdout).strip().splitlines()[-1:] or ["?"]
         return False, dt, f"rc={r.returncode}: {tail[0][:160]}"
@@ -200,10 +263,27 @@ def _parse_bench_json(stdout):
 
 def run_config(name, kind, env_over, timeout):
     """Run one evidence config bounded; write <name>.json + <name>.log."""
+    env_over = dict(env_over)
+    raw_cmd = env_over.pop("_cmd", None)
     env = dict(os.environ)
+    # `python scripts/onchip/x.py` puts scripts/onchip on sys.path, NOT the
+    # repo root — without this the on-chip scripts die on `import
+    # horovod_tpu` (caught by the first rehearsal sweep, round 5).
+    env["PYTHONPATH"] = str(REPO) + (
+        os.pathsep + env["PYTHONPATH"] if env.get("PYTHONPATH") else "")
     env.update(env_over)
+    if REHEARSAL:
+        _scrub_env(env)
+        env.update(REHEARSAL_CLAMPS)
+        # The record/log must show the env the subprocess ACTUALLY ran
+        # with — an artifact claiming SEQ=8192 that ran SEQ=128 is the
+        # misleading-evidence class this mode exists to prevent.
+        env_over.update(REHEARSAL_CLAMPS)
+        timeout = min(timeout, 900)
     if kind == "bench":
         cmd = [sys.executable, "bench.py"]
+    elif kind == "cmd":
+        cmd = [sys.executable, "-c", raw_cmd]
     else:
         cmd = [sys.executable, SCRIPTS[name]]
     _log(f"running {name} ({' '.join(f'{k}={v}' for k, v in env_over.items())}"
@@ -225,14 +305,18 @@ def run_config(name, kind, env_over, timeout):
     # Evidence bar: a bench config only counts when it measured on REAL
     # TPU (bench.py stamps `platform`; the smoke scripts assert it
     # themselves) — a silent CPU fallback mid-window must not mark a
-    # config done or commit a CPU number as on-chip evidence.
+    # config done or commit a CPU number as on-chip evidence.  Rehearsal
+    # inverts the bar (CPU IS the target) and stamps the record so its
+    # artifacts can never be mistaken for on-chip numbers.
+    want_platform = "cpu" if REHEARSAL else "tpu"
     ok = (parsed is not None and parsed.get("value", 0) > 0
           and "error" not in parsed
-          and parsed.get("platform") == "tpu") if kind == "bench" \
+          and parsed.get("platform") == want_platform) if kind == "bench" \
         else (rc == 0 and not timed_out)
     record = {
         "name": name, "ts": _now(), "ok": ok, "rc": rc,
         "timed_out": timed_out, "seconds": dt, "env": env_over,
+        "rehearsal": REHEARSAL,
         "result": parsed if kind == "bench" else {"stdout_tail":
                                                   out.strip()[-500:]},
     }
@@ -260,21 +344,42 @@ def _update_summary():
         {"updated": _now(), "runs": rows}, indent=1, sort_keys=True))
 
 
-def _git_commit():
+def _git_commit(message, paths=None):
     """Path-scoped commit of the evidence dir only; racing the builder's
-    own commits is tolerated (index.lock errors are logged + skipped)."""
+    own commits is tolerated (index.lock errors are logged + skipped).
+    ``message`` must state what was ACTUALLY captured — a probe-log-only
+    commit must not be titled as captured evidence (round-4 VERDICT
+    weak #2) — so probe-log-only commits pass ``paths=[PROBE_LOG]`` to
+    keep evidence files a racing earlier commit left unstaged from
+    riding in under the wrong title."""
+    rels = [str(p.relative_to(REPO)) for p in paths] if paths \
+        else [str(RUNS.relative_to(REPO))]
+    if REHEARSAL:
+        message = f"[rehearsal] {message}"
     try:
-        subprocess.run(["git", "add", "docs/bench_runs"], cwd=REPO,
+        subprocess.run(["git", "add", *rels], cwd=REPO,
                        capture_output=True, timeout=60)
         r = subprocess.run(
-            ["git", "commit", "-m",
-             "Evidence sentinel: captured bench/onchip runs",
-             "--", "docs/bench_runs"],
+            ["git", "commit", "-m", message, "--", *rels],
             cwd=REPO, capture_output=True, text=True, timeout=60)
         _log(f"git commit rc={r.returncode}: "
              f"{(r.stdout or r.stderr).strip().splitlines()[-1:]}")
     except Exception as e:  # noqa: BLE001 — evidence files are already on disk
         _log(f"git commit failed: {e}")
+
+
+def _describe(name, kind, record, tries):
+    """Honest one-line commit subject for one config result."""
+    if record["ok"]:
+        if kind == "bench":
+            res = record["result"] or {}
+            return (f"Sentinel evidence: {name} OK "
+                    f"({res.get('metric')}={res.get('value')} "
+                    f"{res.get('unit')})")
+        return f"Sentinel evidence: {name} OK (rc=0)"
+    return (f"Sentinel: {name} FAILED (rc={record['rc']}, "
+            f"timed_out={record['timed_out']}, try {tries}/{MAX_TRIES}) "
+            f"— no evidence captured")
 
 
 def main():
@@ -284,7 +389,26 @@ def main():
     ap.add_argument("--probe-timeout", type=float, default=120)
     ap.add_argument("--once", action="store_true",
                     help="one probe (+ sweep if up), then exit")
+    ap.add_argument("--rehearsal", action="store_true",
+                    help="run the capture path against the CPU backend "
+                         "with tiny shapes (see module docstring)")
+    ap.add_argument("--configs", default=None,
+                    help="comma-separated subset of config names to run")
     args = ap.parse_args()
+
+    configs = list(CONFIGS)
+    if args.rehearsal or os.environ.get("HVD_SENTINEL_REHEARSAL") == "1":
+        _enter_rehearsal()
+        # Synthetic always-failing config: exercises the failure branch,
+        # try accounting, and the post-failure probe in every rehearsal.
+        configs.append(("rehearsal_fail", "cmd",
+                        {"_cmd": "import sys; sys.exit(3)"}, 60))
+    if args.configs:
+        sel = set(args.configs.split(","))
+        unknown = sel - {n for n, *_ in configs}
+        if unknown:
+            ap.error(f"unknown --configs names: {sorted(unknown)}")
+        configs = [c for c in configs if c[0] in sel]
 
     RUNS.mkdir(parents=True, exist_ok=True)
     # Single-instance guard: two sentinels would race state.json and run
@@ -300,12 +424,15 @@ def main():
         return 2
     lock_f.write(str(os.getpid()))
     lock_f.flush()
-    _log(f"sentinel up: {len(CONFIGS)} configs queued, probe every "
+    _log(f"sentinel up{' (REHEARSAL)' if REHEARSAL else ''}: "
+         f"{len(configs)} configs queued, probe every "
          f"{args.interval:.0f}s (timeout {args.probe_timeout:.0f}s)")
     n_probes = 0
+    probes_uncommitted = 0
     while True:
         ok, dt, detail = probe(args.probe_timeout)
         n_probes += 1
+        probes_uncommitted += 0 if ok else 1
         _append(PROBE_LOG, {"ts": _now(), "ok": ok, "seconds": dt,
                             "detail": detail})
         _log(f"probe: {'UP' if ok else 'down'} ({dt}s) {detail}")
@@ -313,11 +440,20 @@ def main():
             # Commit the probe log on the DOWN path too: a round where the
             # tunnel never answers must still carry committed proof of the
             # bounded attempts (the whole point of the log).
-            _git_commit()
+            _git_commit(f"Sentinel probe log only: {probes_uncommitted} "
+                        f"failed probes, tunnel still down",
+                        paths=[PROBE_LOG])
+            probes_uncommitted = 0
         if ok:
             state = _load_state()
+            if REHEARSAL:
+                # The synthetic failure config must run in EVERY rehearsal
+                # (its whole point is exercising the failure branch), so
+                # its persisted tries/done never carry across sweeps.
+                state["tries"].pop("rehearsal_fail", None)
+                state["done"].pop("rehearsal_fail", None)
             ran_any = False
-            for name, kind, env_over, timeout in CONFIGS:
+            for name, kind, env_over, timeout in configs:
                 if state["done"].get(name):
                     continue
                 if state["tries"].get(name, 0) >= MAX_TRIES:
@@ -327,6 +463,7 @@ def main():
                 # remaining config.
                 if ran_any:
                     up, pdt, pdetail = probe(min(args.probe_timeout, 90))
+                    probes_uncommitted += 0 if up else 1
                     _append(PROBE_LOG, {"ts": _now(), "ok": up,
                                         "seconds": pdt, "detail": pdetail,
                                         "mid_sweep": True})
@@ -335,7 +472,7 @@ def main():
                         break
                 state["tries"][name] = state["tries"].get(name, 0) + 1
                 _save_state(state)
-                cfg_ok, _rec = run_config(name, kind, env_over, timeout)
+                cfg_ok, rec = run_config(name, kind, env_over, timeout)
                 ran_any = True
                 if cfg_ok:
                     state["done"][name] = _now()
@@ -344,6 +481,7 @@ def main():
                     # the run — a config longer than a short tunnel
                     # window must not get exhausted without one fair run.
                     up, pdt, pdetail = probe(min(args.probe_timeout, 90))
+                    probes_uncommitted += 0 if up else 1
                     _append(PROBE_LOG, {"ts": _now(), "ok": up,
                                         "seconds": pdt, "detail": pdetail,
                                         "post_failure": True})
@@ -351,14 +489,19 @@ def main():
                         state["tries"][name] -= 1
                         _save_state(state)
                         _update_summary()
-                        _git_commit()
+                        _git_commit(f"Sentinel: {name} FAILED, tunnel died "
+                                    f"during the run (try refunded) — no "
+                                    f"evidence captured")
+                        probes_uncommitted = 0
                         _log(f"tunnel down after {name} failed; try "
                              "refunded, pausing queue")
                         break
                 _save_state(state)
                 _update_summary()
-                _git_commit()
-            pending = [n for n, *_ in CONFIGS
+                _git_commit(_describe(name, kind, rec,
+                                      state["tries"].get(name, 0)))
+                probes_uncommitted = 0
+            pending = [n for n, *_ in configs
                        if not state["done"].get(n)
                        and state["tries"].get(n, 0) < MAX_TRIES]
             _log(f"sweep pass complete; pending={pending}")

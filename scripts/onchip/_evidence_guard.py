@@ -1,0 +1,26 @@
+"""Shared on-chip evidence guard for every scripts/onchip/*.py smoke.
+
+On-chip evidence only: a silent CPU fallback would run the Pallas
+interpreter (or plain XLA) and validate nothing on silicon, so by default
+the guard refuses any non-TPU platform.  Rehearsal
+(HVD_SENTINEL_REHEARSAL=1, scripts/evidence_sentinel.py) runs the same
+scripts on CPU to prove the sentinel capture path; rehearsal artifacts
+are stamped and stored separately, never as on-chip evidence, and the
+banner below makes a stray flag in an operator's shell unmissable
+(scripts/onchip_checks.sh additionally unsets it for manual runs).
+
+The guard lives HERE, once — scripts/onchip/ is sys.path[0] when a smoke
+runs as ``python scripts/onchip/x.py``, so ``from _evidence_guard import
+REHEARSAL`` executes it as each script's first import.
+"""
+
+import os
+
+import jax
+
+REHEARSAL = os.environ.get("HVD_SENTINEL_REHEARSAL") == "1"
+if REHEARSAL:
+    print("*** REHEARSAL MODE (platform="
+          f"{jax.devices()[0].platform}) — NOT on-chip evidence ***")
+assert REHEARSAL or jax.devices()[0].platform == "tpu", \
+    f"not on TPU (got {jax.devices()[0].platform}); refusing to record"

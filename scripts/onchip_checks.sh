@@ -8,6 +8,12 @@
 # once, in scripts/onchip/*.py, shared by both paths.
 set -x
 cd "$(dirname "$0")/.."
+# `python scripts/onchip/x.py` puts scripts/onchip on sys.path, not the
+# repo root — horovod_tpu imports need the root exported explicitly.
+export PYTHONPATH="$(pwd)${PYTHONPATH:+:$PYTHONPATH}"
+# Manual runs are ALWAYS on-chip evidence: a rehearsal flag lingering in
+# the operator's shell must not bypass the scripts' TPU asserts.
+unset HVD_SENTINEL_REHEARSAL
 
 # 1. flash-ring cond+pallas lowering smoke (1-chip sp mesh, jit-compile)
 python scripts/onchip/flash_ring.py
