@@ -25,6 +25,43 @@ import jax
 
 _counters = {}
 _lock = threading.Lock()
+# Control-plane traffic accounting (this process's view). The scaling
+# contract the reference's coordinator also satisfies (reference:
+# controller.cc:74 — ONE ComputeResponseList negotiation per ready batch,
+# regardless of world size): *rounds* per collective are O(1) in world
+# size, and per-rank payloads stay small.  tests/test_multiproc.py's
+# control-plane guard asserts both by comparing snapshots across worlds.
+# The fusion runtime's boundary publish/consume path rides the raw
+# coordination client (ops/fusion.py), so it reports here through
+# record_fusion_kv() — otherwise the guard would be blind to the async
+# path's KV traffic.
+_stats = {"rounds": 0, "gets": 0, "payload_bytes": 0,
+          "fusion_sets": 0, "fusion_gets": 0, "fusion_payload_bytes": 0}
+
+
+def stats_snapshot():
+    """Copy of this process's cumulative KV-traffic counters: ``rounds``
+    (exchange() calls that hit the KV store — one set each), ``gets``
+    (peer reads issued; world-1 per round), ``payload_bytes`` (serialized
+    local payload), and the fusion runtime's boundary traffic
+    (``fusion_sets``/``fusion_gets``/``fusion_payload_bytes``)."""
+    with _lock:
+        return dict(_stats)
+
+
+def stats_reset():
+    with _lock:
+        for k in _stats:
+            _stats[k] = 0
+
+
+def record_fusion_kv(sets=0, gets=0, payload_bytes=0):
+    """Report a fusion-runtime boundary KV operation (ops/fusion.py) into
+    the shared traffic counters."""
+    with _lock:
+        _stats["fusion_sets"] += sets
+        _stats["fusion_gets"] += gets
+        _stats["fusion_payload_bytes"] += payload_bytes
 # Epoch namespace for the KV keys: bumped when an init REUSES a live
 # coordination service (its store may still hold the last two undeleted
 # keys per tag from the previous incarnation, see the lag-2 GC in
@@ -105,7 +142,12 @@ def exchange(tag, payload, procs=None):
     seq = _next_seq((tag, proc_tag))
     client = _client()
     base = f"hvd/neg/e{_epoch}/{tag}/{proc_tag}/{seq}"
-    client.key_value_set(f"{base}/{me}", json.dumps(payload))
+    blob = json.dumps(payload)
+    with _lock:
+        _stats["rounds"] += 1
+        _stats["gets"] += len(procs) - 1
+        _stats["payload_bytes"] += len(blob)
+    client.key_value_set(f"{base}/{me}", blob)
     # Bound coordinator memory on long jobs: reaching seq s implies this
     # process completed exchange s-1, which required reading every peer's
     # s-1 key — so every peer had *started* s-1 and therefore finished s-2.

@@ -383,6 +383,9 @@ class FusionRuntime:
                 if client is None:
                     continue
                 client.key_value_set(self._boundary_key(seq), payload)
+                from horovod_tpu.common import negotiation
+                negotiation.record_fusion_kv(sets=1,
+                                             payload_bytes=len(payload))
                 if seq >= self._BOUNDARY_GC_LAG:
                     try:
                         client.key_value_delete(
@@ -425,6 +428,8 @@ class FusionRuntime:
             except Exception:
                 return applied              # no new boundary yet
             import json as _json
+            from horovod_tpu.common import negotiation
+            negotiation.record_fusion_kv(gets=1, payload_bytes=len(raw))
             payload = _json.loads(raw)
             last_tid = int(payload["t"])
             with self._boundary_lock:
@@ -438,24 +443,22 @@ class FusionRuntime:
                 self.wire_dtype = jnp.dtype(wire).type if wire else None
                 # The local enqueue stream may lag the coordinator's:
                 # applying early would flush a SHORTER prefix and misalign
-                # every later collective. Wait for tids <= last_tid (safe:
-                # boundary tids are monotonic and consumed in order, so a
-                # sync-path consumer never waits here for tensors the main
-                # thread hasn't submitted yet — see ensure_flushed).
-                deadline = time.perf_counter() + 120.0
-                while True:
-                    with self._lock:
-                        if self._next_tid > last_tid:
-                            self._boundary_seq += 1
-                            self._flush_locked(up_to=last_tid)
-                            break
-                    if time.perf_counter() > deadline:
-                        raise RuntimeError(
-                            f"fusion boundary {last_tid} published by the "
-                            f"coordinator but this process only enqueued "
-                            f"up to tid {self._next_tid - 1} after 120s — "
-                            f"SPMD enqueue streams diverged")
-                    time.sleep(0.0005)
+                # every later collective. A boundary AHEAD of the local
+                # stream is DEFERRED, not waited on: on the sync path the
+                # consumer IS the enqueuing thread (a handle.synchronize()
+                # between enqueues), so waiting here for the next enqueue
+                # would self-deadlock — the coordinator legitimately runs
+                # one op ahead under an enqueue-sync-enqueue-sync pattern.
+                # The un-consumed boundary stays at this seq (the KV key
+                # persists, GC lag 4096) and is applied by a later call
+                # once the local stream catches up; the SPMD contract
+                # guarantees it does, and true divergence is still caught
+                # by ensure_flushed's covering-boundary deadline.
+                with self._lock:
+                    if self._next_tid <= last_tid:
+                        return applied       # ahead of us: defer
+                    self._boundary_seq += 1
+                    self._flush_locked(up_to=last_tid)
             applied = True
             block_ms = 1
 
