@@ -117,25 +117,37 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
     registers within the chunk's fori tile sweep.
     """
     qi = pl.program_id(1)
-    jc = pl.program_id(2)
+    # Single-chunk grids (n_kc == 1) are specialized to STATIC control
+    # flow: jc is the literal 0, init/finalize run unconditionally, and
+    # the masked trip count below is a compile-time constant. The generic
+    # path's pl.when(contributes) + dynamically-clipped fori_loop is only
+    # ever needed when the chunk index is a real grid variable; on padded
+    # single-chunk grids it is the suspected Mosaic compile hang
+    # (docs/troubleshooting.md "Padded flash attention").
+    single = n_kc == 1
+    jc = 0 if single else pl.program_id(2)
 
-    @pl.when(jc == 0)
     def _init():
         m_ref[...] = jnp.full_like(m_ref, NEG_INF)
         l_ref[...] = jnp.zeros_like(l_ref)
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
+    if single:
+        _init()
+    else:
+        pl.when(jc == 0)(_init)
+
     # End-aligned causal convention (tril with k = Lk - Lq), matching
     # local_attention and the backward pass: query row i may attend keys
     # <= i + (Lk - Lq). q_offset = Lk - Lq.
     q_end = q_offset + (qi + 1) * block_q - 1  # last query row's key bound
-    contributes = jnp.asarray(True)
+    contributes = None                 # None == statically always-true
     if causal:
         contributes = q_end >= jc * k_chunk
-    if masked:
-        contributes = contributes & (jc * k_chunk < kv_valid)
+    if masked and not single:
+        c = jc * k_chunk < kv_valid
+        contributes = c if contributes is None else contributes & c
 
-    @pl.when(contributes)
     def _compute():
         q = q_ref[0].astype(jnp.float32) * sm_scale        # (BQ, D)
 
@@ -162,11 +174,15 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
             return m_new, l_new, acc_new
 
         n_t = k_chunk // block_k
+        if masked and single:
+            # The chunk starts at key 0, so the last valid key tile is a
+            # compile-time constant: a static trip count, no dynamic clip.
+            n_t = min(n_t, max(0, (kv_valid + block_k - 1) // block_k))
         if causal:
             # Bound the tile sweep at the diagonal within this chunk.
             n_t = jnp.clip(
                 pl.cdiv(q_end + 1 - jc * k_chunk, block_k), 0, n_t)
-        if masked:
+        if masked and not single:
             # ...and at the last VALID key tile.
             n_t = jnp.clip(
                 pl.cdiv(kv_valid - jc * k_chunk, block_k), 0, n_t)
@@ -176,7 +192,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         l_ref[...] = l[:, None]
         acc_ref[...] = acc
 
-    @pl.when(jc == n_kc - 1)
+    if contributes is None:
+        _compute()
+    else:
+        pl.when(contributes)(_compute)
+
     def _finalize():
         l_safe = jnp.maximum(l_ref[:, 0], 1e-30)
         o_ref[0] = (acc_ref[...] / l_safe[:, None]).astype(o_ref.dtype)
@@ -184,6 +204,11 @@ def _fa_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, m_ref, l_ref, acc_ref,
         # block's last two dims to be (8k, 128k) or equal to the array's —
         # a trailing singleton satisfies that where (1, block_q) cannot.
         lse_ref[0] = (m_ref[:, 0] + jnp.log(l_safe))[:, None]
+
+    if single:
+        _finalize()
+    else:
+        pl.when(jc == n_kc - 1)(_finalize)
 
 
 def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
@@ -274,20 +299,26 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """dQ pass: (query-block, key-chunk) grid with the dq accumulator in
     scratch across chunks and a register fori sweep within each chunk."""
     qi = pl.program_id(1)
-    jc = pl.program_id(2)
+    # Same single-chunk static specialization as _fa_kernel (see there).
+    single = n_kc == 1
+    jc = 0 if single else pl.program_id(2)
 
-    @pl.when(jc == 0)
     def _init():
         acc_ref[...] = jnp.zeros_like(acc_ref)
 
+    if single:
+        _init()
+    else:
+        pl.when(jc == 0)(_init)
+
     q_end = q_offset + (qi + 1) * block_q - 1
-    contributes = jnp.asarray(True)
+    contributes = None
     if causal:
         contributes = q_end >= jc * k_chunk
-    if masked:
-        contributes = contributes & (jc * k_chunk < kv_valid)
+    if masked and not single:
+        c = jc * k_chunk < kv_valid
+        contributes = c if contributes is None else contributes & c
 
-    @pl.when(contributes)
     def _compute():
         q = q_ref[0].astype(jnp.float32)                   # (BQ, D)
         do = do_ref[0].astype(jnp.float32)
@@ -314,17 +345,28 @@ def _fa_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                 preferred_element_type=jnp.float32)
 
         n_t = k_chunk // block_k
+        if masked and single:
+            n_t = min(n_t, max(0, (kv_valid + block_k - 1) // block_k))
         if causal:
             n_t = jnp.clip(
                 pl.cdiv(q_end + 1 - jc * k_chunk, block_k), 0, n_t)
-        if masked:
+        if masked and not single:
             n_t = jnp.clip(
                 pl.cdiv(kv_valid - jc * k_chunk, block_k), 0, n_t)
         acc_ref[...] = jax.lax.fori_loop(0, n_t, body, acc_ref[...])
 
-    @pl.when(jc == n_kc - 1)
+    if contributes is None:
+        _compute()
+    else:
+        pl.when(contributes)(_compute)
+
     def _finalize():
         dq_ref[0] = acc_ref[...].astype(dq_ref.dtype)
+
+    if single:
+        _finalize()
+    else:
+        pl.when(jc == n_kc - 1)(_finalize)
 
 
 def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
@@ -334,23 +376,31 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     """dK/dV pass: (key-block, query-chunk) grid; per-key-block accumulators
     in scratch across query chunks, register fori sweep within."""
     ki = pl.program_id(1)
-    jc = pl.program_id(2)
+    # Single-chunk static specialization for the QUERY-chunk grid dim
+    # (n_qc == 1): literal jc, unconditional init/finalize. The masked
+    # and causal gates ride ki — a real grid variable — and remain.
+    single = n_qc == 1
+    jc = 0 if single else pl.program_id(2)
 
-    @pl.when(jc == 0)
     def _init():
         dk_acc[...] = jnp.zeros_like(dk_acc)
         dv_acc[...] = jnp.zeros_like(dv_acc)
 
-    contributes = jnp.asarray(True)
+    if single:
+        _init()
+    else:
+        pl.when(jc == 0)(_init)
+
+    contributes = None
     if causal:
         # Query chunks ending above this key block's diagonal contribute
         # nothing: rows i attend keys <= i + q_offset.
         contributes = (q_offset + (jc + 1) * q_chunk - 1) >= ki * block_k
     if masked:
         # Entirely-padding key blocks receive zero gradient.
-        contributes = contributes & (ki * block_k < kv_valid)
+        c = ki * block_k < kv_valid
+        contributes = c if contributes is None else contributes & c
 
-    @pl.when(contributes)
     def _compute():
         kb = k_ref[0].astype(jnp.float32)                  # (BK, D)
         vb = v_ref[0].astype(jnp.float32)
@@ -394,10 +444,19 @@ def _fa_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dk_acc[...] = dk
         dv_acc[...] = dv
 
-    @pl.when(jc == n_qc - 1)
+    if contributes is None:
+        _compute()
+    else:
+        pl.when(contributes)(_compute)
+
     def _finalize():
         dk_ref[0] = dk_acc[...].astype(dk_ref.dtype)
         dv_ref[0] = dv_acc[...].astype(dv_ref.dtype)
+
+    if single:
+        _finalize()
+    else:
+        pl.when(jc == n_qc - 1)(_finalize)
 
 
 def _fa_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
