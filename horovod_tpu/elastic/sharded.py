@@ -1,0 +1,95 @@
+"""Elastic membership changes for SHARDED training state (ZeRO-1 / FSDP).
+
+The reference never faced this: Horovod replicates optimizer state on
+every worker, so an elastic restore is a plain broadcast
+(reference: common/elastic.py:127-170 ObjectState sync). This framework
+promotes sharded optimizers (parallel/dp.py ZeRO-1, parallel/fsdp.py),
+whose state is partitioned 1/n over the mesh — a membership change
+n -> n' must RE-PARTITION, not just re-broadcast:
+
+- **save** gathers each process's shards into the FULL logical value on
+  the host (a committed shard-view would be useless at a different n);
+- **restore/sync** re-lays the logical value out for the new mesh — for
+  ZeRO-1 that means re-padding the flat moment vectors from n*shard_len
+  to n'*shard_len'; for FSDP re-placing with the new mesh's shardings.
+
+Wire cost: the gather is an allgather of the sharded leaves per commit —
+the price of an elastic-consistent snapshot (the reference pays a full
+deep copy per commit for the same reason, torch/elastic/state.py:154+).
+Commit less often if it shows up in profiles.
+
+Used through :class:`horovod_tpu.elastic.TpuState` ``placements=``:
+
+    state = elastic.TpuState(
+        trees={"zs": zero_state}, placements={"zs": elastic.zero_reshard},
+        step=0)
+"""
+
+import numpy as np
+
+from horovod_tpu.common.topology import HVD_AXIS
+
+
+def gather_to_host(tree):
+    """Fetch a pytree to host memory, materializing the FULL value of any
+    leaf sharded across non-addressable devices (multi-process meshes).
+    Collective when such leaves exist: every owning process must call in
+    the same order (the elastic commit/SPMD contract already requires
+    this)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    def leaf(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            x = jax.jit(lambda a: a, out_shardings=NamedSharding(
+                x.sharding.mesh, P()))(x)
+        return jax.device_get(x) if isinstance(x, jax.Array) else x
+
+    return jax.tree_util.tree_map(leaf, tree)
+
+
+def _axis_size(mesh, axis_name):
+    names = axis_name if isinstance(axis_name, tuple) else (axis_name,)
+    return int(np.prod([mesh.shape[a] for a in names]))
+
+
+def zero_reshard(state_host, mesh, axis_name=HVD_AXIS):
+    """Re-partition a host-side :class:`ZeroTrainState` for ``mesh``.
+
+    The flat moment vectors carry padding to ``n * shard_len`` for the
+    mesh they were built on; after a membership change the new world size
+    n' needs different padding. Truncate each moment leaf to the logical
+    (raveled-params) length and re-pad for the new mesh. Values are
+    returned host-side — the next jitted step places them under the new
+    mesh's shardings."""
+    import jax
+
+    n = _axis_size(mesh, axis_name)
+    flat_params, _ = jax.flatten_util.ravel_pytree(state_host.params)
+    logical = flat_params.size
+    pad = (-logical) % n
+
+    def leaf(x):
+        x = np.asarray(x)
+        if x.ndim >= 1 and x.size >= logical:        # a flat moment vector
+            return np.pad(x.reshape(-1)[:logical], (0, pad))
+        return x                                     # count / scalar leaf
+
+    return state_host.replace(
+        opt_state=jax.tree_util.tree_map(leaf, state_host.opt_state))
+
+
+def fsdp_reshard(tree_host, mesh, axis_name=HVD_AXIS, min_size=16384):
+    """Re-place a host-side FSDP pytree (params or optimizer state) with
+    the shardings :func:`horovod_tpu.parallel.fsdp.fsdp_shardings` derives
+    for ``mesh``. Leaf shapes are mesh-independent under FSDP — only the
+    placement changes (a dim divisible by the old n may not divide n', in
+    which case that leaf comes back replicated, exactly as a fresh
+    ``shard_params`` would lay it out)."""
+    import jax
+
+    from horovod_tpu.parallel.fsdp import _place, fsdp_shardings
+
+    sh = fsdp_shardings(tree_host, mesh, axis_name, min_size)
+    return jax.tree_util.tree_map(_place, tree_host, sh)
