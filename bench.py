@@ -186,13 +186,37 @@ _WATCHDOG = None
 _WATCHDOG_SECS = None
 
 
+def _metrics_snapshot_field():
+    """The metrics-registry ride-along for every BENCH record: collective/
+    fusion/KV counters captured even when the device probe fails (round
+    5's tunnel-down runs scored blind on control-plane behavior). Returns
+    ``(snapshot_or_None, reason_or_None)`` — ``None`` with a reason when
+    the registry is unavailable or empty-by-failure."""
+    try:
+        import horovod_tpu as hvd
+        # No is_initialized() gate: the registry is process-global and
+        # accrues control-plane/elastic counters DURING a failing init —
+        # exactly the evidence a tunnel-down record needs.
+        return hvd.metrics_snapshot(), None
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail bench
+        return None, (str(e).splitlines() or ["?"])[0][:160]
+
+
+def _with_metrics(record):
+    snap, reason = _metrics_snapshot_field()
+    record["metrics_snapshot"] = snap
+    if snap is None:
+        record["metrics_snapshot_reason"] = reason
+    return record
+
+
 def _emit_failure(metric, unit, error):
     """The ONE parseable failure-record shape (shared by the watchdog and
     the __main__ handler so the driver's parser sees one schema)."""
-    print(json.dumps({
+    print(json.dumps(_with_metrics({
         "metric": metric, "value": 0.0, "unit": unit, "vs_baseline": 0.0,
         "error": error,
-    }), flush=True)
+    })), flush=True)
 
 
 def _arm_watchdog(seconds, metric, unit):
@@ -245,13 +269,13 @@ def _emit(metric, value, unit, vs_baseline):
         platform = jax.devices()[0].platform
     except Exception:  # noqa: BLE001
         platform = "unknown"
-    print(json.dumps({
+    print(json.dumps(_with_metrics({
         "metric": metric,
         "value": value,
         "unit": unit,
         "vs_baseline": vs_baseline,
         "platform": platform,
-    }))
+    })))
 
 
 def _bench_bert(hvd):

@@ -17,13 +17,17 @@ Typical use mirrors ``import horovod.torch as hvd``:
 
 from horovod_tpu.version import __version__  # noqa: F401
 
+from horovod_tpu.common import compat as _compat  # noqa: F401  (shims first)
+
 from horovod_tpu.common.basics import (  # noqa: F401
     init, shutdown, is_initialized, rank, local_rank, cross_rank, size,
     local_size, cross_size, process_index, process_count, is_homogeneous,
     mpi_threads_supported, mpi_enabled, mpi_built, gloo_enabled, gloo_built,
     nccl_built, ddl_built, ccl_built, cuda_built, rocm_built, xla_built,
     ici_built, start_timeline, stop_timeline, topology, config,
+    metrics_snapshot, metrics_text,
 )
+from horovod_tpu import metrics  # noqa: F401
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt, NotInitializedError,
 )

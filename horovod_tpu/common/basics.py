@@ -153,6 +153,29 @@ def init(comm=None, process_sets=None, devices=None):
             start_timeline(config.timeline_filename,
                            mark_cycles=config.timeline_mark_cycles)
 
+        # Metrics: arm the always-on registry with this job's knobs and
+        # (optionally) the scrape endpoint. Offset by the LOCAL (per-host)
+        # process rank only — same-host processes must not fight over one
+        # bind, while every host keeps the same base port so a uniform
+        # scrape config works across the fleet.
+        from horovod_tpu import metrics as hvd_metrics
+        hvd_metrics.set_enabled(config.metrics)
+        hvd_metrics.set_prefix(config.metrics_prefix)
+        if config.metrics and config.metrics_port:
+            # Topology-derived, not config.local_rank: launchers that skip
+            # the HOROVOD_LOCAL_RANK env (direct jax.distributed, SLURM)
+            # would leave every same-host process at offset 0.
+            local_rank_now = (
+                topology.local_device_ranks[0] % topology.local_size
+                if topology.local_device_ranks else 0)
+            try:
+                port = hvd_metrics.start_http_server(
+                    config.metrics_port + local_rank_now,
+                    addr=config.metrics_addr)
+                hvd_logging.info("metrics scrape endpoint on :%d", port)
+            except OSError as e:  # busy port must not kill training
+                hvd_logging.warning("metrics endpoint failed to bind: %s", e)
+
         hvd_logging.info(
             "horovod_tpu initialized: size=%d local_size=%d cross_size=%d",
             topology.size, topology.local_size, topology.cross_size)
@@ -211,7 +234,16 @@ def shutdown():
             except Exception as e:  # pragma: no cover
                 hvd_logging.warning("flush on shutdown failed: %s", e)
         if _state.timeline is not None:
+            # Final registry dump as Chrome-trace counter events, so the
+            # written trace ends with the job's aggregate totals.
+            try:
+                from horovod_tpu import metrics as hvd_metrics
+                hvd_metrics.emit_timeline_counters(_state.timeline)
+            except Exception:  # noqa: BLE001 — telemetry must not block
+                pass
             _state.timeline.close()
+        from horovod_tpu import metrics as hvd_metrics
+        hvd_metrics.stop_http_server()
         from horovod_tpu.common import negotiation
         negotiation.reset()
         _state = None
@@ -363,3 +395,21 @@ def stop_timeline():
 def timeline():
     st = _state
     return st.timeline if st is not None else None
+
+
+# --- metrics (horovod_tpu/metrics; no reference analog — the reference's
+# observability stops at the timeline + stall inspector) ---
+
+def metrics_snapshot():
+    """JSON-able dict of every metrics series' current value (counters,
+    gauges, histograms with cumulative buckets). Works before init too —
+    the registry is process-global."""
+    from horovod_tpu import metrics as hvd_metrics
+    return hvd_metrics.snapshot()
+
+
+def metrics_text():
+    """The metrics registry in Prometheus text exposition format 0.0.4 —
+    the same payload the ``HOROVOD_METRICS_PORT`` scrape endpoint serves."""
+    from horovod_tpu import metrics as hvd_metrics
+    return hvd_metrics.render_text()

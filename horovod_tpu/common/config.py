@@ -120,6 +120,23 @@ class Config:
     # Donate fused buffers to XLA (buffer reuse).
     donate_buffers: bool = True
 
+    # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
+    # the reference's observability stops at timeline + stall inspector).
+    # Always-on by default: the registry hot path is O(1) and lock-light
+    # (guarded by tests/test_perf_guards.py TestMetricsOverheadBudget).
+    metrics: bool = True
+    # Scrape endpoint port; 0 = no HTTP server. Each process binds
+    # port + local_rank: same-host processes must not collide, but every
+    # host keeps the same base port for uniform scrape configs.
+    metrics_port: int = 0
+    # Scrape endpoint bind address. Prometheus-exporter convention is
+    # bind-all (the payload is read-only telemetry, and an off-host
+    # scraper is the point of the endpoint); set 127.0.0.1 to keep it
+    # host-local on shared machines.
+    metrics_addr: str = "0.0.0.0"
+    # Series-name prefix in the text exposition.
+    metrics_prefix: str = "horovod"
+
     def __post_init__(self):
         # Normalize/validate on EVERY construction path (env, CLI, direct):
         # the fusion runtime CASTS float buffers to a 16-bit wire dtype,
@@ -197,4 +214,10 @@ class Config:
         c.wire_dtype = os.environ.get("HOROVOD_WIRE_DTYPE", c.wire_dtype)
         c.__post_init__()  # re-normalize after the env override
         c.donate_buffers = _env_bool("HOROVOD_DONATE_BUFFERS", c.donate_buffers)
+        c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
+        c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
+        c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",
+                                        c.metrics_addr)
+        c.metrics_prefix = os.environ.get("HOROVOD_METRICS_PREFIX",
+                                          c.metrics_prefix)
         return c

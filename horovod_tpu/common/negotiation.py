@@ -23,6 +23,8 @@ import threading
 
 import jax
 
+from horovod_tpu.metrics import instruments as _metrics
+
 _counters = {}
 _lock = threading.Lock()
 # Control-plane traffic accounting (this process's view). The scaling
@@ -57,11 +59,16 @@ def stats_reset():
 
 def record_fusion_kv(sets=0, gets=0, payload_bytes=0):
     """Report a fusion-runtime boundary KV operation (ops/fusion.py) into
-    the shared traffic counters."""
+    the shared traffic counters AND the metrics registry
+    (``fusion_kv_rpcs_total`` / ``control_plane_rpcs_total``) — the
+    hot-poll class of regression is a visible counter, not a code-review
+    catch."""
     with _lock:
         _stats["fusion_sets"] += sets
         _stats["fusion_gets"] += gets
         _stats["fusion_payload_bytes"] += payload_bytes
+    _metrics.record_fusion_kv(sets=sets, gets=gets,
+                              payload_bytes=payload_bytes)
 # Epoch namespace for the KV keys: bumped when an init REUSES a live
 # coordination service (its store may still hold the last two undeleted
 # keys per tag from the previous incarnation, see the lag-2 GC in
@@ -147,6 +154,7 @@ def exchange(tag, payload, procs=None):
         _stats["rounds"] += 1
         _stats["gets"] += len(procs) - 1
         _stats["payload_bytes"] += len(blob)
+    _metrics.record_negotiation(gets=len(procs) - 1, payload_bytes=len(blob))
     client.key_value_set(f"{base}/{me}", blob)
     # Bound coordinator memory on long jobs: reaching seq s implies this
     # process completed exchange s-1, which required reading every peer's

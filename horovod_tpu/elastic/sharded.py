@@ -65,6 +65,11 @@ def zero_reshard(state_host, mesh, axis_name=HVD_AXIS):
     mesh's shardings."""
     import jax
 
+    # ADVICE.md round-5: `jax.flatten_util` is NOT auto-loaded by
+    # `import jax` — import the submodule explicitly (as parallel/dp.py
+    # does) instead of relying on another module's side-effect import.
+    import jax.flatten_util
+
     n = _axis_size(mesh, axis_name)
     flat_params, _ = jax.flatten_util.ravel_pytree(state_host.params)
     logical = flat_params.size

@@ -26,6 +26,7 @@ from horovod_tpu.common import basics
 from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.common.exceptions import (HorovodInternalError,
                                            HostsUpdatedInterrupt)
+from horovod_tpu.metrics import instruments as _metrics
 
 
 def _elastic_launch():
@@ -253,11 +254,13 @@ def run(func):
                 mark_new_rank_ready()
                 read_new_rank_ready()
                 if _sync_vote(want_sync=not skip_sync):
+                    _metrics.record_elastic_event("sync")
                     state.sync()
                 skip_sync = False
                 known_version = configured_version()
                 return func(state, *args, **kwargs)
             except HorovodInternalError:
+                _metrics.record_elastic_event("restore")
                 hvd_logging.warning(
                     "collective failure; restoring last committed state")
                 state.restore()
@@ -269,6 +272,7 @@ def run(func):
                 wait_for_version_change(known_version)
                 reset_required = True
             except HostsUpdatedInterrupt as e:
+                _metrics.record_elastic_event("host_update")
                 hvd_logging.info("host set updated; re-initializing")
                 reset_required = True
                 skip_sync = e.skip_sync
@@ -300,6 +304,7 @@ def run(func):
         import os
 
         from horovod_tpu.elastic.worker import refresh_assignment_env
+        _metrics.record_elastic_event("reset")
         # Live attrs must not carry buffers of the client we are about to
         # destroy into the new backend (the skip_sync path keeps them).
         try:

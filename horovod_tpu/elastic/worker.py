@@ -8,6 +8,7 @@ run_elastic_driver) — same contract, simpler transport.
 
 import os
 
+from horovod_tpu.metrics import instruments as _metrics
 from horovod_tpu.runner.http_kv import KVStoreClient
 
 
@@ -103,6 +104,7 @@ def mark_new_rank_ready():
         return
     version = _configured_version(client)
     cross_rank = os.environ.get("HOROVOD_CROSS_RANK", "0")
+    _metrics.record_elastic_event("rank_ready")
     client.put(f"new_rank_ready/{version}", cross_rank, b"1")
 
 
@@ -140,6 +142,9 @@ def read_new_rank_ready(timeout=600):
                     f"new_rank_ready/{version}", str(i)) is not None:
                 seen.add(i)
         if len(seen) >= nhosts:
+            # The whole membership is up: this worker completed a
+            # rendezvous at its configured version.
+            _metrics.record_elastic_event("rendezvous")
             return True
         current = (client.get("elastic", "version") or b"0").decode()
         if current != version:

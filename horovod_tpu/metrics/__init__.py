@@ -1,0 +1,48 @@
+"""Metrics & telemetry: per-collective counters/histograms, control-plane
+RPC accounting, and a Prometheus-style scrape endpoint.
+
+The always-on observability layer the reference lacks (its story stops at
+the on-demand timeline + stall inspector): every eager collective, fusion
+flush, control-plane KV RPC, elastic lifecycle event and stall finding is
+counted into a process-local registry, exposed three ways —
+
+- ``hvd.metrics_snapshot()`` / ``hvd.metrics_text()`` (Prometheus text
+  exposition format),
+- an optional background HTTP scrape endpoint
+  (``HOROVOD_METRICS_PORT`` / :func:`start_http_server`),
+- Chrome-trace counter events merged into the timeline
+  (:func:`emit_timeline_counters`).
+
+Knobs: ``HOROVOD_METRICS`` (default on), ``HOROVOD_METRICS_PORT``
+(default off), ``HOROVOD_METRICS_PREFIX`` (default ``horovod``). Series
+catalogue: :mod:`horovod_tpu.metrics.instruments` / docs/observability.md.
+"""
+
+from horovod_tpu.metrics.registry import (  # noqa: F401
+    Counter, Gauge, Histogram, MetricsRegistry, exponential_buckets,
+)
+from horovod_tpu.metrics.instruments import (  # noqa: F401
+    REGISTRY, enabled, set_enabled, set_prefix, get_registry,
+    emit_timeline_counters, maybe_emit_timeline_counters,
+    record_boundary, record_collective, record_collective_error,
+    record_collective_latency, record_elastic_event, record_fusion_flush,
+    record_fusion_kv, record_http_kv, record_negotiation, record_stall,
+)
+from horovod_tpu.metrics.server import (  # noqa: F401
+    MetricsServer, http_server_port, start_http_server, stop_http_server,
+)
+
+
+def snapshot():
+    """JSON-able dict of every series' current value."""
+    return REGISTRY.snapshot()
+
+
+def render_text():
+    """Prometheus text exposition (format 0.0.4) of the whole registry."""
+    return REGISTRY.render_text()
+
+
+def reset():
+    """Zero every series (registered families survive) — test hygiene."""
+    return REGISTRY.reset()

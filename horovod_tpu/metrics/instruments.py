@@ -1,0 +1,267 @@
+"""The framework's series catalogue + recording helpers.
+
+Every instrumentation point in the stack goes through one of the
+``record_*`` functions here, so the catalogue below is the single source
+of truth for series names, labels and units (documented in
+docs/observability.md). All helpers are no-ops when metrics are disabled
+(``HOROVOD_METRICS=0``) and never raise into the hot path.
+
+Catalogue (names shown without the ``HOROVOD_METRICS_PREFIX``, default
+``horovod``):
+
+- ``collective_ops_total{op,process_set}``          dispatches (counter)
+- ``collective_bytes_total{op,process_set}``        payload bytes (counter)
+- ``collective_latency_seconds{op}``                dispatch latency (histogram)
+- ``collective_errors_total{op}``                   failed dispatches (counter)
+- ``fusion_flushes_total``                          bucket flushes (counter)
+- ``fusion_flush_tensors``                          tensors per flush (histogram)
+- ``fusion_flush_bytes``                            bytes per flush (histogram)
+- ``fusion_fill_ratio``                             flushed/threshold (histogram)
+- ``fusion_boundary_outcomes_total{outcome}``       applied|deferred (counter)
+- ``fusion_kv_rpcs_total{kind}``                    boundary KV set/get (counter)
+- ``negotiation_rounds_total``                      exchange() rounds (counter)
+- ``control_plane_rpcs_total{transport,kind}``      every KV RPC (counter)
+- ``control_plane_payload_bytes_total{transport}``  KV payload bytes (counter)
+- ``elastic_events_total{event}``                   rendezvous/reset/... (counter)
+- ``stall_events_total{kind}``                      warning|shutdown (counter)
+"""
+
+import os
+import threading
+import time
+
+from horovod_tpu.metrics.registry import MetricsRegistry, exponential_buckets
+
+_enabled = os.environ.get("HOROVOD_METRICS", "1").lower() \
+    not in ("0", "false", "no", "off")
+
+REGISTRY = MetricsRegistry(
+    prefix=os.environ.get("HOROVOD_METRICS_PREFIX", "horovod"))
+
+
+def get_registry():
+    return REGISTRY
+
+
+def enabled():
+    return _enabled
+
+
+def set_enabled(value):
+    global _enabled
+    _enabled = bool(value)
+
+
+def set_prefix(prefix):
+    REGISTRY.prefix = prefix
+
+
+# --- the catalogue (created eagerly so HELP/TYPE lines are always part of
+# the exposition, observed or not) --------------------------------------
+
+_LAT_BUCKETS = exponential_buckets(1e-5, 2.0, 22)          # 10us .. ~21s
+_BYTE_BUCKETS = exponential_buckets(1024, 4.0, 14)         # 1KiB .. 64GiB
+_COUNT_BUCKETS = exponential_buckets(1, 2.0, 13)           # 1 .. 4096
+_RATIO_BUCKETS = exponential_buckets(1.0 / 64, 2.0, 9)     # ~0.016 .. 4
+
+COLLECTIVE_OPS = REGISTRY.counter(
+    "collective_ops_total",
+    "Eager collective dispatches (sync ops and fused async flush buckets).",
+    ("op", "process_set"))
+COLLECTIVE_BYTES = REGISTRY.counter(
+    "collective_bytes_total",
+    "Bytes moved by eager collectives (global rank-major stacked layout).",
+    ("op", "process_set"))
+COLLECTIVE_LATENCY = REGISTRY.histogram(
+    "collective_latency_seconds",
+    "Host-side dispatch latency of eager collectives (enqueue to program "
+    "return; device execution is async beyond it).",
+    ("op",), buckets=_LAT_BUCKETS)
+COLLECTIVE_ERRORS = REGISTRY.counter(
+    "collective_errors_total",
+    "Eager collective dispatches that raised.",
+    ("op",))
+FUSION_FLUSHES = REGISTRY.counter(
+    "fusion_flushes_total",
+    "Fusion-runtime bucket flushes dispatched by this process.")
+FUSION_FLUSH_TENSORS = REGISTRY.histogram(
+    "fusion_flush_tensors",
+    "Tensors per fusion flush (bucket size).",
+    buckets=_COUNT_BUCKETS)
+FUSION_FLUSH_BYTES = REGISTRY.histogram(
+    "fusion_flush_bytes",
+    "Bytes per fusion flush.",
+    buckets=_BYTE_BUCKETS)
+FUSION_FILL_RATIO = REGISTRY.histogram(
+    "fusion_fill_ratio",
+    "Flushed bytes / fusion threshold (1.0 = a full bucket; small values "
+    "mean cycle/explicit flushes dominate threshold flushes).",
+    buckets=_RATIO_BUCKETS)
+FUSION_BOUNDARY_OUTCOMES = REGISTRY.counter(
+    "fusion_boundary_outcomes_total",
+    "Follower handling of coordinator flush boundaries: applied "
+    "immediately vs deferred (boundary ahead of the local enqueue stream).",
+    ("outcome",))
+FUSION_KV_RPCS = REGISTRY.counter(
+    "fusion_kv_rpcs_total",
+    "Coordination-service KV RPCs issued by the fusion boundary "
+    "publish/consume path (the ADVICE.md hot-poll class shows up here).",
+    ("kind",))
+NEGOTIATION_ROUNDS = REGISTRY.counter(
+    "negotiation_rounds_total",
+    "Host-side negotiation.exchange() rounds (dynamic-shape collectives, "
+    "join mode, order checks).")
+CONTROL_PLANE_RPCS = REGISTRY.counter(
+    "control_plane_rpcs_total",
+    "Control-plane KV RPCs by transport (coord = jax.distributed "
+    "coordination service, http = runner HTTP KV store) and verb.",
+    ("transport", "kind"))
+CONTROL_PLANE_PAYLOAD = REGISTRY.counter(
+    "control_plane_payload_bytes_total",
+    "Serialized payload bytes written to the control plane.",
+    ("transport",))
+ELASTIC_EVENTS = REGISTRY.counter(
+    "elastic_events_total",
+    "Elastic lifecycle events: rank_ready, rendezvous, reset, restore, "
+    "host_update, sync.",
+    ("event",))
+STALL_EVENTS = REGISTRY.counter(
+    "stall_events_total",
+    "Stall-inspector findings (kind=warning|shutdown).",
+    ("kind",))
+
+
+# --- recording helpers (the stack's API) --------------------------------
+
+def record_collective(op, nbytes, process_set="global"):
+    """One eager collective dispatch attempt: count + bytes (recorded at
+    entry; failures still count as attempts)."""
+    if not _enabled:
+        return
+    COLLECTIVE_OPS.labels(op, process_set).inc()
+    if nbytes:
+        COLLECTIVE_BYTES.labels(op, process_set).inc(float(nbytes))
+
+
+def record_collective_latency(op, seconds):
+    """Dispatch latency of one SUCCESSFUL eager collective."""
+    if not _enabled:
+        return
+    COLLECTIVE_LATENCY.labels(op).observe(seconds)
+
+
+def record_collective_error(op):
+    if not _enabled:
+        return
+    COLLECTIVE_ERRORS.labels(op).inc()
+
+
+def record_fusion_flush(n_tensors, nbytes, threshold):
+    if not _enabled:
+        return
+    FUSION_FLUSHES.inc()
+    FUSION_FLUSH_TENSORS.observe(n_tensors)
+    FUSION_FLUSH_BYTES.observe(nbytes)
+    if threshold:
+        FUSION_FILL_RATIO.observe(nbytes / float(threshold))
+
+
+def record_boundary(outcome):
+    if not _enabled:
+        return
+    FUSION_BOUNDARY_OUTCOMES.labels(outcome).inc()
+
+
+def record_fusion_kv(sets=0, gets=0, payload_bytes=0):
+    if not _enabled:
+        return
+    if sets:
+        FUSION_KV_RPCS.labels("set").inc(sets)
+        CONTROL_PLANE_RPCS.labels("coord", "set").inc(sets)
+    if gets:
+        FUSION_KV_RPCS.labels("get").inc(gets)
+        CONTROL_PLANE_RPCS.labels("coord", "get").inc(gets)
+    if payload_bytes:
+        CONTROL_PLANE_PAYLOAD.labels("coord").inc(payload_bytes)
+
+
+def record_negotiation(gets, payload_bytes):
+    """One negotiation.exchange() round: 1 set + ``gets`` peer reads."""
+    if not _enabled:
+        return
+    NEGOTIATION_ROUNDS.inc()
+    CONTROL_PLANE_RPCS.labels("coord", "set").inc()
+    if gets:
+        CONTROL_PLANE_RPCS.labels("coord", "get").inc(gets)
+    if payload_bytes:
+        CONTROL_PLANE_PAYLOAD.labels("coord").inc(payload_bytes)
+
+
+def record_http_kv(kind, payload_bytes=0):
+    """One runner HTTP-KV client RPC (kind=get|put|delete|wait)."""
+    if not _enabled:
+        return
+    CONTROL_PLANE_RPCS.labels("http", kind).inc()
+    if payload_bytes:
+        CONTROL_PLANE_PAYLOAD.labels("http").inc(payload_bytes)
+
+
+def record_elastic_event(event):
+    if not _enabled:
+        return
+    ELASTIC_EVENTS.labels(event).inc()
+
+
+def record_stall(kind):
+    if not _enabled:
+        return
+    STALL_EVENTS.labels(kind).inc()
+
+
+# --- timeline integration ----------------------------------------------
+#
+# Registry values double as Chrome-trace COUNTER events ("ph": "C") so the
+# aggregate series land in the same chrome://tracing file as the op spans
+# (the reference's timeline has no counter tracks at all). Emission is
+# throttled: the fusion runtime calls maybe_emit_timeline_counters() after
+# every flush, which at kHz flush rates would otherwise snapshot the whole
+# registry per flush.
+
+_TL_MIN_INTERVAL_S = 0.1
+_tl_last = 0.0
+_tl_lock = threading.Lock()
+
+
+def emit_timeline_counters(timeline):
+    """Dump every series' current value into ``timeline`` as counter
+    events. Histograms emit their _count and _sum. No-op when metrics are
+    disabled — an all-zero dump would pollute the trace of a user who
+    explicitly turned the registry off."""
+    if timeline is None or not _enabled:
+        return 0
+    n = 0
+    for name, fam in REGISTRY.snapshot().items():
+        for s in fam["series"]:
+            lab = ",".join(f"{k}={v}" for k, v in s["labels"].items())
+            label = f"{name}{{{lab}}}" if lab else name
+            if fam["type"] == "histogram":
+                timeline.record_counter(label + "_count", s["count"])
+                timeline.record_counter(label + "_sum", s["sum"])
+                n += 2
+            else:
+                timeline.record_counter(label, s["value"])
+                n += 1
+    return n
+
+
+def maybe_emit_timeline_counters(timeline):
+    """Throttled emit_timeline_counters (at most once per 100 ms)."""
+    global _tl_last
+    if timeline is None or not _enabled:
+        return 0
+    now = time.monotonic()
+    with _tl_lock:
+        if now - _tl_last < _TL_MIN_INTERVAL_S:
+            return 0
+        _tl_last = now
+    return emit_timeline_counters(timeline)

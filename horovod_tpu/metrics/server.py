@@ -1,0 +1,93 @@
+"""Prometheus-style scrape endpoint for the metrics registry.
+
+Reuses the stdlib threaded-HTTP-server idiom of
+:mod:`horovod_tpu.runner.http_kv` (no new dependencies): ``GET /metrics``
+returns the registry in text exposition format 0.0.4. Started by
+``hvd.init()`` when ``HOROVOD_METRICS_PORT`` is set (each process binds
+``port + local_rank`` so same-host processes don't collide while every
+host keeps the same base port), or manually via :func:`start_http_server`.
+"""
+
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class _MetricsHandler(BaseHTTPRequestHandler):
+    protocol_version = "HTTP/1.1"
+
+    def log_message(self, fmt, *args):  # silence
+        pass
+
+    def do_GET(self):
+        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+            self.send_response(404)
+            self.send_header("Content-Length", "0")
+            self.end_headers()
+            return
+        body = self.server.registry.render_text().encode("utf-8")
+        self.send_response(200)
+        self.send_header("Content-Type", CONTENT_TYPE)
+        self.send_header("Content-Length", str(len(body)))
+        self.end_headers()
+        self.wfile.write(body)
+
+
+class MetricsServer:
+    """Background scrape server over one registry; ``port=0`` binds a free
+    port (read it back from ``.port`` after ``start()``)."""
+
+    def __init__(self, port=0, registry=None, addr="0.0.0.0"):
+        from horovod_tpu.metrics.instruments import REGISTRY
+        self._httpd = ThreadingHTTPServer((addr, port), _MetricsHandler)
+        self._httpd.registry = registry if registry is not None else REGISTRY
+        self._thread = None
+
+    @property
+    def port(self):
+        return self._httpd.server_address[1]
+
+    def start(self):
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, daemon=True,
+            name="hvd-metrics-scrape")
+        self._thread.start()
+        return self.port
+
+    def stop(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=2)
+            self._thread = None
+
+
+_server = None
+_server_lock = threading.Lock()
+
+
+def start_http_server(port=0, registry=None, addr="0.0.0.0"):
+    """Start (or return) the process-wide scrape server; returns the bound
+    port. Idempotent: a second call returns the running server's port."""
+    global _server
+    with _server_lock:
+        if _server is None:
+            s = MetricsServer(port=port, registry=registry, addr=addr)
+            s.start()
+            _server = s
+        return _server.port
+
+
+def stop_http_server():
+    global _server
+    with _server_lock:
+        if _server is not None:
+            _server.stop()
+            _server = None
+
+
+def http_server_port():
+    """Bound port of the running scrape server, or None."""
+    with _server_lock:
+        return _server.port if _server is not None else None

@@ -104,17 +104,44 @@ def _check_stacked(x, n, what):
 
 
 import contextlib
+import time
+
+
+def _ps_label(process_set):
+    """Bounded-cardinality process-set label for metrics series: 'global'
+    or the registered set id."""
+    if process_set is None or process_set.ranks is None:
+        return "global"
+    pid = getattr(process_set, "process_set_id", None)
+    return f"set{pid}" if pid is not None else "unregistered"
 
 
 @contextlib.contextmanager
-def _timeline_op(name, op_kind):
-    """Timeline span + failure translation around one eager collective.
+def _timeline_op(name, op_kind, tensors=(), process_set=None):
+    """Timeline span + metrics + failure translation around one eager
+    collective.
+
+    Metrics: the span is the single choke point every eager dispatch (sync
+    ops AND fused flush buckets) passes through, so per-op count/bytes go
+    in at entry (failures still count as attempts) and the latency
+    histogram on successful return — the aggregate layer the reference
+    never had (its observability stops at the timeline trace).
 
     A collective that dies at runtime (peer process gone, transport torn
     down mid-op) must surface as :class:`HorovodInternalError` so the
     elastic ``@run`` wrapper can restore the last commit and re-rendezvous
     (reference: common/exceptions.py — op status callbacks raise
     HorovodInternalError; nccl_operations.h:70 async error polling)."""
+    from horovod_tpu.metrics import instruments as hvd_metrics
+    op_label = op_kind.lower()
+    # Gated HERE, not just inside the helpers: the nbytes sum is
+    # O(n_tensors) and must cost nothing under HOROVOD_METRICS=0.
+    metrics_on = hvd_metrics.enabled()
+    if metrics_on:
+        hvd_metrics.record_collective(
+            op_label, sum(getattr(t, "nbytes", 0) for t in tensors),
+            _ps_label(process_set))
+        t0 = time.perf_counter()
     tl = basics.timeline()
     span = tl.op_span(name, op_kind) if tl is not None \
         else contextlib.nullcontext()
@@ -126,7 +153,11 @@ def _timeline_op(name, op_kind):
         with jax.profiler.TraceAnnotation(f"hvd::{op_kind}::{name}"):
             with span:
                 yield
+        if metrics_on:
+            hvd_metrics.record_collective_latency(
+                op_label, time.perf_counter() - t0)
     except (ValueError, RuntimeError) as e:
+        hvd_metrics.record_collective_error(op_label)
         # Inside the span only the compiled program executes (inputs were
         # validated before it). Translate ONLY transport/peer failures to
         # HorovodInternalError — those are what elastic recovery can fix by
@@ -517,7 +548,8 @@ def grouped_allreduce(tensors, op=Average, prescale_factor=1.0,
     prog = _allreduce_program(mesh, n, ReduceOp(op), float(prescale_factor),
                               float(postscale_factor), shapes, dtypes,
                               active_mask)
-    with _timeline_op(name or "grouped_allreduce", "ALLREDUCE"):
+    with _timeline_op(name or "grouped_allreduce", "ALLREDUCE", tensors,
+                      process_set=ps):
         return _localize(list(prog(*tensors)), mesh)
 
 
@@ -556,7 +588,8 @@ def grouped_allgather(tensors, process_set=None, name=None):
             and getattr(topo, "mesh2d", None) is not None)
     prog = _allgather_program(topo.mesh2d if hier else mesh, n, shapes,
                               dtypes, active_mask, hier)
-    with _timeline_op(name or "grouped_allgather", "ALLGATHER"):
+    with _timeline_op(name or "grouped_allgather", "ALLGATHER", tensors,
+                      process_set=ps):
         return _localize(list(prog(*tensors)), mesh)
 
 
@@ -653,7 +686,8 @@ def grouped_broadcast(tensors, root_rank, process_set=None, name=None):
     tensors = _prepare(tensors, mesh, n, "broadcast")
     shapes, dtypes = _signature(tensors)
     prog = _broadcast_program(mesh, n, int(root), shapes, dtypes)
-    with _timeline_op(name or "grouped_broadcast", "BROADCAST"):
+    with _timeline_op(name or "grouped_broadcast", "BROADCAST", tensors,
+                      process_set=ps):
         return _localize(list(prog(*tensors)), mesh)
 
 
@@ -690,7 +724,8 @@ def grouped_reducescatter(tensors, op=Sum, prescale_factor=1.0,
     prog = _reducescatter_program(mesh, n, ReduceOp(op), float(prescale_factor),
                                   float(postscale_factor), shapes, dtypes,
                                   active_mask)
-    with _timeline_op(name or "grouped_reducescatter", "REDUCESCATTER"):
+    with _timeline_op(name or "grouped_reducescatter", "REDUCESCATTER",
+                      tensors, process_set=ps):
         return _localize(list(prog(*tensors)), mesh)
 
 
@@ -726,7 +761,8 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
         (tt,) = _prepare([t], mesh, n, "alltoall")
         shapes, dtypes = _signature([tt])
         prog = _alltoall_program(mesh, n, shapes, dtypes)
-        with _timeline_op(name or "alltoall", "ALLTOALL"):
+        with _timeline_op(name or "alltoall", "ALLTOALL", (tt,),
+                          process_set=ps):
             return _localize([prog(tt)[0]], mesh)[0]
 
     splits = np.asarray(splits)
@@ -778,7 +814,8 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
     (dense,) = _prepare([dense], mesh, n, "alltoall")
     shapes, dtypes = _signature([dense])
     prog = _alltoall_program(mesh, n, shapes, dtypes)
-    with _timeline_op(name or "alltoall", "ALLTOALL"):
+    with _timeline_op(name or "alltoall", "ALLTOALL", (dense,),
+                      process_set=ps):
         exchanged = _localize([prog(dense)[0]], mesh)[0]
     received = full.T  # received[r][p] = rows rank r got from peer p
     rows = []
@@ -819,7 +856,7 @@ def barrier(process_set=None, name=None):
     _join_sync(ps, mesh, {"kind": "barrier"})
     token = np.zeros((rows, 1), np.int32)
     (token,) = _prepare([token], mesh, ps.size(), "barrier")
-    with _timeline_op(name or "barrier", "BARRIER"):
+    with _timeline_op(name or "barrier", "BARRIER", process_set=ps):
         jax.block_until_ready(_barrier_program(mesh)(token))
 
 

@@ -295,6 +295,10 @@ class FusionRuntime:
         self._boundary_seq = 0      # publisher: next seq; follower: next
         self._boundary_lock = threading.RLock()
         self._flushed_tid = -1
+        # Follower: the last fetched-but-not-yet-applicable boundary
+        # (seq, payload) — kept so an AHEAD boundary is fetched from the
+        # KV store exactly once per seq (ADVICE.md hot-poll fix).
+        self._deferred_boundary = None
         self._publish_queue = None
         self._publisher_thread = None
         if not self._multi or self._coord:
@@ -415,6 +419,7 @@ class FusionRuntime:
         blocking KV get runs OUTSIDE the locks (concurrent consumers may
         fetch the same key; the seq re-check under the lock dedupes) so a
         long blocking window never delays the sync path."""
+        from horovod_tpu import metrics as hvd_metrics
         applied = False
         while True:
             client = self._kv_client()
@@ -422,18 +427,37 @@ class FusionRuntime:
                 return applied
             with self._boundary_lock:
                 seq = self._boundary_seq
-            try:
-                raw = client.blocking_key_value_get(
-                    self._boundary_key(seq), max(int(block_ms), 1))
-            except Exception:
-                return applied              # no new boundary yet
-            import json as _json
-            from horovod_tpu.common import negotiation
-            negotiation.record_fusion_kv(gets=1, payload_bytes=len(raw))
-            payload = _json.loads(raw)
+                deferred = self._deferred_boundary
+            if deferred is not None and deferred[0] == seq:
+                # An AHEAD boundary for this seq was already fetched: serve
+                # it from the local cache instead of re-issuing the KV get
+                # — the key already exists, so blocking_key_value_get would
+                # return instantly and the 1 ms follower loop would hot-
+                # poll the shared coordination service ~1000x/sec while
+                # waiting for the local stream (ADVICE.md round-5 finding).
+                payload = deferred[1]
+                with self._lock:
+                    behind = self._next_tid <= int(payload["t"])
+                if behind:
+                    # Still ahead of us: bounded backoff (no RPC at all)
+                    # paces BOTH the follower loop and ensure_flushed's
+                    # blocking loop while they wait for the enqueue stream.
+                    time.sleep(min(max(int(block_ms), 1), 50) / 1000.0)
+                    return applied
+            else:
+                try:
+                    raw = client.blocking_key_value_get(
+                        self._boundary_key(seq), max(int(block_ms), 1))
+                except Exception:
+                    return applied          # no new boundary yet
+                import json as _json
+                from horovod_tpu.common import negotiation
+                negotiation.record_fusion_kv(gets=1, payload_bytes=len(raw))
+                payload = _json.loads(raw)
             last_tid = int(payload["t"])
             with self._boundary_lock:
                 if self._boundary_seq != seq:
+                    self._deferred_boundary = None
                     block_ms = 1            # another consumer took it
                     continue
                 # Adopt the coordinator's program-shaping knobs for this
@@ -449,16 +473,23 @@ class FusionRuntime:
                 # between enqueues), so waiting here for the next enqueue
                 # would self-deadlock — the coordinator legitimately runs
                 # one op ahead under an enqueue-sync-enqueue-sync pattern.
-                # The un-consumed boundary stays at this seq (the KV key
-                # persists, GC lag 4096) and is applied by a later call
-                # once the local stream catches up; the SPMD contract
-                # guarantees it does, and true divergence is still caught
-                # by ensure_flushed's covering-boundary deadline.
+                # The fetched payload is cached at this seq and applied by
+                # a later call once the local stream catches up — WITHOUT
+                # touching the KV store again; the SPMD contract
+                # guarantees it does catch up, and true divergence is
+                # still caught by ensure_flushed's covering-boundary
+                # deadline.
                 with self._lock:
                     if self._next_tid <= last_tid:
+                        if self._deferred_boundary is None \
+                                or self._deferred_boundary[0] != seq:
+                            hvd_metrics.record_boundary("deferred")
+                        self._deferred_boundary = (seq, payload)
                         return applied       # ahead of us: defer
+                    self._deferred_boundary = None
                     self._boundary_seq += 1
                     self._flush_locked(up_to=last_tid)
+                    hvd_metrics.record_boundary("applied")
             applied = True
             block_ms = 1
 
@@ -733,6 +764,9 @@ class FusionRuntime:
         self._flushed_tid = max(self._flushed_tid, pending[-1][0])
         if self._stall_inspector is not None:
             self._stall_inspector.record_flush()
+        from horovod_tpu import metrics as hvd_metrics
+        hvd_metrics.record_fusion_flush(len(pending), flushed_bytes,
+                                        self.threshold)
         topo = basics.topology()
         mesh = topo.mesh
         n = topo.size
@@ -835,7 +869,7 @@ class FusionRuntime:
             from horovod_tpu.ops.collective_ops import _timeline_op
             try:
                 with _timeline_op(f"fused_allreduce[{len(items)}]",
-                                  "ALLREDUCE"):
+                                  "ALLREDUCE", tensors):
                     outs = prog(*tensors)
                     # Multi-process: hand back this process's local rows,
                     # matching the sync ops' contract.
@@ -846,6 +880,12 @@ class FusionRuntime:
                 continue
             for (_, h), o in zip(items, outs):
                 h._set(o)
+        # Mirror registry totals into the timeline as counter events
+        # (throttled inside), so aggregate series and op spans land in the
+        # same chrome://tracing file.
+        tl = basics.timeline()
+        if tl is not None:
+            hvd_metrics.maybe_emit_timeline_counters(tl)
 
 
 class GroupedFusedHandle:

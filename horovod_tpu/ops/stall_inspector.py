@@ -19,6 +19,7 @@ import time
 
 from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.metrics import instruments as _metrics
 
 
 class StallInspector:
@@ -66,6 +67,9 @@ class StallInspector:
                 age = time.monotonic() - self._oldest_enqueue
                 names = list(self._pending_names[:8])
             if age > self.warning_secs and not self._warned:
+                # Counted as well as logged: stall_events_total makes the
+                # finding scrapeable instead of a log-grep-only signal.
+                _metrics.record_stall("warning")
                 hvd_logging.warning(
                     "One or more tensors submitted to the fusion queue "
                     "%.0fs ago were never reduced — missing synchronize()? "
@@ -73,4 +77,6 @@ class StallInspector:
                     "CheckForStalledTensors)", age, names)
                 self._warned = True
             if self.shutdown_secs > 0 and age > self.shutdown_secs:
+                if not self.shutdown_flagged:
+                    _metrics.record_stall("shutdown")
                 self.shutdown_flagged = True

@@ -17,6 +17,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib import error as urlerror
 from urllib import request as urlrequest
 
+from horovod_tpu.metrics import instruments as _metrics
 from horovod_tpu.runner.secret import (SECRET_ENV, check_digest,
                                        compute_digest)
 
@@ -177,6 +178,7 @@ class KVStoreClient:
 
     def get(self, scope, key):
         path = f"/{scope}/{key}"
+        _metrics.record_http_kv("get")
         try:
             with urlrequest.urlopen(self._request("GET", path),
                                     timeout=self._timeout) as r:
@@ -198,16 +200,21 @@ class KVStoreClient:
             raise
 
     def put(self, scope, key, value: bytes):
+        _metrics.record_http_kv("put", payload_bytes=len(value))
         req = self._request("PUT", f"/{scope}/{key}", value)
         with urlrequest.urlopen(req, timeout=self._timeout):
             pass
 
     def delete(self, scope, key="*"):
+        _metrics.record_http_kv("delete")
         req = self._request("DELETE", f"/{scope}/{key}")
         with urlrequest.urlopen(req, timeout=self._timeout):
             pass
 
     def wait_for(self, scope, key, timeout=60, interval=0.1):
+        # Counted once as a "wait" on top of the per-iteration gets, so the
+        # scrape distinguishes intentional polling waits from raw get storms.
+        _metrics.record_http_kv("wait")
         import time
         deadline = time.time() + timeout
         while time.time() < deadline:

@@ -81,6 +81,31 @@ class Timeline:
             self.record("CYCLE", "i", "cycle", self._now_us(),
                         args={"s": "g"})
 
+    def record_counter(self, name, value, ts_us=None):
+        """Chrome-trace COUNTER event ("ph": "C"): one sample of a named
+        series, rendered by chrome://tracing / Perfetto as a counter track
+        alongside the op spans. The metrics registry emits its totals
+        through this (metrics.emit_timeline_counters), so aggregate series
+        and per-op spans land in the same trace file. The native writer's
+        record signature carries no args, so there the value is folded
+        into an instant-event name instead — data preserved, track
+        rendering lost."""
+        if self._closed:
+            # record() guards the Python path; the native branch below
+            # must not touch a closed C++ writer (shutdown racing the
+            # fusion cycle thread's throttled counter emit).
+            return
+        ts = ts_us if ts_us is not None else self._now_us()
+        if self._native is not None:
+            # Exact formatting (not %g): byte/op counters past ~1e6 must
+            # stay cross-checkable against the registry's scrape values.
+            v = float(value)
+            sv = str(int(v)) if v == int(v) and abs(v) < 1e15 else repr(v)
+            self._native.record(f"{name}={sv}", "metrics", "i", ts,
+                                0.0, 0)
+            return
+        self.record(name, "C", "metrics", ts, args={"value": value}, tid=0)
+
     def negotiate(self, name, op_kind, dur_us):
         """Host-side coordination time (size exchange for ragged ops etc.) —
         the surviving analog of NEGOTIATE_* (reference: timeline.cc)."""

@@ -1,0 +1,387 @@
+"""Metrics & telemetry subsystem (horovod_tpu/metrics).
+
+Covers the registry semantics (labels, exponential histogram bucketing,
+concurrent increments), the Prometheus text exposition, the HTTP scrape
+endpoint, the integration contract (eager allreduce + fused flush produce
+the documented series, scraped over real HTTP), and the ADVICE.md
+regression guard: a follower waiting on an AHEAD fusion boundary issues a
+bounded number of KV gets (the round-5 ~1000x/sec hot poll), asserted
+through the new ``fusion_kv_rpcs_total`` counter.
+"""
+
+import json
+import threading
+import time
+import urllib.request
+
+import numpy as np
+import pytest
+
+from horovod_tpu.metrics import (MetricsServer, MetricsRegistry,
+                                 exponential_buckets)
+from horovod_tpu.metrics import instruments
+
+
+def _series_value(snap, name, **labels):
+    """Value of one series in a snapshot (0.0 when never observed)."""
+    for s in snap.get(name, {}).get("series", []):
+        if s["labels"] == labels:
+            return s.get("value", s.get("count"))
+    return 0.0
+
+
+class TestRegistry:
+    def test_counter_labels_and_values(self):
+        reg = MetricsRegistry(prefix="t")
+        c = reg.counter("ops_total", "ops", ("op", "ps"))
+        c.labels("allreduce", "global").inc()
+        c.labels("allreduce", "global").inc(2.5)
+        c.labels(op="allgather", ps="set1").inc()
+        snap = reg.snapshot()
+        assert _series_value(snap, "ops_total",
+                             op="allreduce", ps="global") == 3.5
+        assert _series_value(snap, "ops_total",
+                             op="allgather", ps="set1") == 1
+
+    def test_label_schema_enforced(self):
+        reg = MetricsRegistry()
+        c = reg.counter("x_total", "d", ("a",))
+        with pytest.raises(ValueError):
+            c.labels("v1", "v2")
+        with pytest.raises(ValueError):
+            c.inc()  # labelled family has no default child
+        # idempotent re-get, mismatched schema rejected
+        assert reg.counter("x_total", "d", ("a",)) is c
+        with pytest.raises(ValueError):
+            reg.counter("x_total", "d", ("a", "b"))
+        with pytest.raises(ValueError):
+            reg.gauge("x_total", "d", ("a",))
+
+    def test_gauge_set(self):
+        reg = MetricsRegistry()
+        g = reg.gauge("pending_bytes", "d")
+        g.set(123)
+        g.inc(7)
+        assert _series_value(reg.snapshot(), "pending_bytes") == 130
+
+    def test_histogram_exponential_bucketing(self):
+        assert exponential_buckets(1, 2, 4) == (1, 2, 4, 8)
+        reg = MetricsRegistry()
+        h = reg.histogram("lat", "d", buckets=(1.0, 2.0, 4.0))
+        for v in (0.5, 1.0, 3.0, 100.0):
+            h.observe(v)
+        (s,) = reg.snapshot()["lat"]["series"]
+        # le is an INCLUSIVE upper bound; counts are cumulative.
+        assert s["buckets"] == [[1.0, 2], [2.0, 2], [4.0, 3], ["+Inf", 4]]
+        assert s["count"] == 4
+        assert s["sum"] == pytest.approx(104.5)
+
+    def test_concurrent_increments_exact(self):
+        reg = MetricsRegistry()
+        c = reg.counter("n_total", "d", ("k",))
+        child = c.labels("x")
+        per, threads = 10_000, 8
+
+        def worker():
+            for _ in range(per):
+                child.inc()
+
+        ts = [threading.Thread(target=worker) for _ in range(threads)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert _series_value(reg.snapshot(), "n_total",
+                             k="x") == per * threads
+
+    def test_reset_zeroes_series_keeps_families(self):
+        reg = MetricsRegistry()
+        c = reg.counter("y_total", "d")
+        c.inc(5)
+        reg.reset()
+        assert "y_total" in reg.snapshot()
+        assert _series_value(reg.snapshot(), "y_total") == 0.0
+        c.inc()
+        assert _series_value(reg.snapshot(), "y_total") == 1
+
+
+class TestPrometheusText:
+    def test_exposition_format(self):
+        reg = MetricsRegistry(prefix="hvdtest")
+        c = reg.counter("ops_total", "dispatch count", ("op",))
+        c.labels("allreduce").inc(3)
+        h = reg.histogram("lat_seconds", "latency", ("op",),
+                          buckets=(0.001, 0.01))
+        h.labels("allreduce").observe(0.005)
+        text = reg.render_text()
+        lines = text.splitlines()
+        assert "# HELP hvdtest_ops_total dispatch count" in lines
+        assert "# TYPE hvdtest_ops_total counter" in lines
+        assert 'hvdtest_ops_total{op="allreduce"} 3' in lines
+        assert "# TYPE hvdtest_lat_seconds histogram" in lines
+        assert 'hvdtest_lat_seconds_bucket{op="allreduce",le="0.001"} 0' \
+            in lines
+        assert 'hvdtest_lat_seconds_bucket{op="allreduce",le="0.01"} 1' \
+            in lines
+        assert 'hvdtest_lat_seconds_bucket{op="allreduce",le="+Inf"} 1' \
+            in lines
+        assert 'hvdtest_lat_seconds_count{op="allreduce"} 1' in lines
+        assert text.endswith("\n")
+
+    def test_label_value_escaping(self):
+        reg = MetricsRegistry(prefix="p")
+        c = reg.counter("e_total", "d", ("msg",))
+        c.labels('say "hi"\nback\\slash').inc()
+        text = reg.render_text()
+        assert r'msg="say \"hi\"\nback\\slash"' in text
+
+    def test_infinity_bucket_matches_count_for_every_family(self):
+        """Every histogram's +Inf cumulative bucket equals its _count —
+        the invariant scrapers rely on."""
+        snap = instruments.REGISTRY.snapshot()
+        for fam in snap.values():
+            if fam["type"] != "histogram":
+                continue
+            for s in fam["series"]:
+                assert s["buckets"][-1][0] == "+Inf"
+                assert s["buckets"][-1][1] == s["count"]
+
+
+class TestScrapeEndpoint:
+    def test_start_scrape_shutdown_on_free_port(self):
+        reg = MetricsRegistry(prefix="scr")
+        reg.counter("up_total", "d").inc(7)
+        srv = MetricsServer(port=0, registry=reg, addr="127.0.0.1")
+        port = srv.start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/metrics", timeout=10) as r:
+                assert r.status == 200
+                assert r.headers["Content-Type"].startswith("text/plain")
+                body = r.read().decode()
+            assert "scr_up_total 7" in body
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/nope", timeout=10)
+            assert ei.value.code == 404
+        finally:
+            srv.stop()
+        # Port released: a fresh server can bind it again immediately-ish
+        # (SO_REUSEADDR in ThreadingHTTPServer).
+        srv2 = MetricsServer(port=port, registry=reg, addr="127.0.0.1")
+        srv2.start()
+        srv2.stop()
+
+
+class TestStackIntegration:
+    """Acceptance: an eager allreduce and a fused flush must produce the
+    documented count/bytes/latency + fusion series, and the text form must
+    be scrapeable over real HTTP."""
+
+    def test_eager_and_fused_series_then_scrape(self, hvd):
+        import jax.numpy as jnp
+        from horovod_tpu import metrics
+        from horovod_tpu.ops import fusion
+
+        n = hvd.size()
+        x = jnp.ones((n, 8), jnp.float32)
+        before = metrics.snapshot()
+
+        hvd.allreduce(x, op=hvd.Sum, name="metrics.eager")
+        rt = fusion.get_runtime()
+        with rt.cycle_paused():
+            hs = [hvd.allreduce_async(x, op=hvd.Sum, name=f"metrics.f{i}")
+                  for i in range(4)]
+            for h in hs:
+                h.synchronize()
+
+        after = metrics.snapshot()
+
+        def delta(name, **labels):
+            return _series_value(after, name, **labels) \
+                - _series_value(before, name, **labels)
+
+        # eager dispatch + >=1 fused flush bucket, both labelled allreduce
+        assert delta("collective_ops_total",
+                     op="allreduce", process_set="global") >= 2
+        # bytes: the eager call alone moves n*8*4 bytes
+        assert delta("collective_bytes_total",
+                     op="allreduce", process_set="global") >= n * 8 * 4
+        # latency histogram observed the successful dispatches
+        lat = [s for s in after["collective_latency_seconds"]["series"]
+               if s["labels"] == {"op": "allreduce"}]
+        assert lat and lat[0]["count"] >= 2
+        assert delta("fusion_flushes_total") >= 1
+        tens = [s for s in after["fusion_flush_tensors"]["series"]]
+        assert tens and tens[0]["count"] >= 1
+
+        # Scrape over HTTP and check the documented series names survive
+        # exposition (acceptance bar: count/bytes/latency + fusion + KV +
+        # stall series are all present in one scrape).
+        port = metrics.start_http_server(0)
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/metrics", timeout=10
+            ).read().decode()
+        finally:
+            metrics.stop_http_server()
+        for series in ("horovod_collective_ops_total",
+                       "horovod_collective_bytes_total",
+                       "horovod_collective_latency_seconds_bucket",
+                       "horovod_fusion_flushes_total",
+                       "horovod_fusion_flush_bytes",
+                       "horovod_fusion_kv_rpcs_total",
+                       "horovod_control_plane_rpcs_total",
+                       "horovod_stall_events_total"):
+            assert series in body, series
+        assert 'op="allreduce"' in body
+
+    def test_metrics_text_matches_module_render(self, hvd):
+        from horovod_tpu import metrics
+        assert hvd.metrics_text().splitlines()[0] \
+            == metrics.render_text().splitlines()[0]
+
+    def test_snapshot_is_json_able(self, hvd):
+        json.dumps(hvd.metrics_snapshot())
+
+
+class TestTimelineCounters:
+    def test_registry_values_become_chrome_counter_events(self, tmp_path):
+        from horovod_tpu.metrics import instruments
+        from horovod_tpu.timeline import Timeline
+
+        instruments.REGISTRY.counter(
+            "collective_ops_total",
+            "Eager collective dispatches (sync ops and fused async flush "
+            "buckets).",
+            ("op", "process_set")).labels("allreduce", "global").inc()
+        path = tmp_path / "trace.json"
+        tl = Timeline(str(path), native=False)
+        n = instruments.emit_timeline_counters(tl)
+        assert n > 0
+        tl.close()
+        trace = json.loads(path.read_text())
+        counters = [e for e in trace["traceEvents"] if e.get("ph") == "C"]
+        assert counters, "no Chrome counter events written"
+        names = {e["name"] for e in counters}
+        assert any("collective_ops_total" in nm for nm in names)
+        for e in counters:
+            assert "value" in e["args"]
+
+    def test_throttled_emit(self, tmp_path):
+        from horovod_tpu.metrics import instruments
+        from horovod_tpu.timeline import Timeline
+
+        tl = Timeline(str(tmp_path / "t.json"), native=False)
+        instruments._tl_last = 0.0
+        assert instruments.maybe_emit_timeline_counters(tl) > 0
+        # within the 100ms window: suppressed
+        assert instruments.maybe_emit_timeline_counters(tl) == 0
+        tl.close()
+
+
+class _FakeKVClient:
+    """Coordination-service stub: only boundary seq 0 exists."""
+
+    def __init__(self, payload):
+        self.gets = 0
+        self._payload = json.dumps(payload)
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        self.gets += 1
+        if key.endswith("/b0"):
+            return self._payload
+        raise TimeoutError(f"no key {key}")
+
+
+class TestDeferHotPollRegression:
+    """ADVICE.md round-5: while the coordinator's boundary is AHEAD of the
+    local enqueue stream, the follower must NOT re-fetch the (already
+    existing) boundary key in a loop — one KV get per boundary seq, then a
+    locally-cached defer with backoff."""
+
+    def _follower(self):
+        import threading as th
+        from horovod_tpu.ops.fusion import FusionRuntime
+
+        rt = FusionRuntime.__new__(FusionRuntime)
+        rt._lock = th.RLock()
+        rt._boundary_lock = th.RLock()
+        rt._boundary_seq = 0
+        rt._deferred_boundary = None
+        rt._pending = []
+        rt._pending_groups = []
+        rt._flushed_groups = []
+        rt._pending_bytes = 0
+        rt._flushed_tid = -1
+        rt._next_tid = 0
+        rt._multi = True
+        rt._coord = False
+        rt._native = None
+        rt._stall_inspector = None
+        rt.strategy = "flat"
+        rt.wire_dtype = None
+        return rt
+
+    def test_deferred_follower_issues_bounded_kv_gets(self):
+        rt = self._follower()
+        fake = _FakeKVClient({"t": 5, "s": "flat", "w": ""})
+        rt._kv_client = lambda: fake
+
+        def kv_gets():
+            return instruments.FUSION_KV_RPCS.labels("get").get()
+
+        def outcomes(which):
+            return instruments.FUSION_BOUNDARY_OUTCOMES.labels(which).get()
+
+        gets0, def0, app0 = kv_gets(), outcomes("deferred"), \
+            outcomes("applied")
+        # 20 consumer passes while the local stream lags the boundary —
+        # the pre-fix behavior issued one KV get per pass (~1000x/sec at
+        # the follower loop's 1ms pacing).
+        for _ in range(20):
+            assert rt._apply_ready_boundaries(block_ms=1) is False
+        assert fake.gets == 1, \
+            f"defer path re-fetched the ahead boundary {fake.gets}x"
+        assert kv_gets() - gets0 == 1
+        assert outcomes("deferred") - def0 == 1
+        assert rt._deferred_boundary is not None
+
+        # Local stream catches up: the cached payload applies with ZERO
+        # additional gets for this boundary (the next-seq probe is the
+        # only new RPC).
+        rt._next_tid = 10
+        rt._pending = [(6, None, 0, 1.0, 1.0, None)]  # beyond the boundary
+        assert rt._apply_ready_boundaries(block_ms=1) is True
+        assert rt._boundary_seq == 1
+        assert rt._flushed_tid == 5
+        assert rt._deferred_boundary is None
+        assert fake.gets <= 2          # seq-0 fetch + one seq-1 probe
+        assert outcomes("applied") - app0 == 1
+
+    def test_defer_backoff_paces_the_wait(self):
+        """The cached-defer path must sleep (bounded backoff), not spin:
+        20 passes at block_ms=10 take >= ~20 * 10ms."""
+        rt = self._follower()
+        fake = _FakeKVClient({"t": 5, "s": "flat", "w": ""})
+        rt._kv_client = lambda: fake
+        rt._apply_ready_boundaries(block_ms=1)   # fetch + defer
+        t0 = time.perf_counter()
+        for _ in range(10):
+            rt._apply_ready_boundaries(block_ms=10)
+        assert time.perf_counter() - t0 >= 0.05
+        assert fake.gets == 1
+
+
+class TestRecordHelpersDisabled:
+    def test_disabled_helpers_are_noops(self):
+        from horovod_tpu.metrics import instruments as ins
+        base = ins.COLLECTIVE_OPS.labels("allreduce", "global").get()
+        ins.set_enabled(False)
+        try:
+            ins.record_collective("allreduce", 100, "global")
+            ins.record_fusion_flush(1, 100, 1000)
+            ins.record_stall("warning")
+        finally:
+            ins.set_enabled(True)
+        assert ins.COLLECTIVE_OPS.labels("allreduce", "global").get() == base

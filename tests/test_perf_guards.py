@@ -218,14 +218,26 @@ class TestHostOverheadBudget:
         regression this catches. Regenerate the baseline on a hardware
         change with HVD_UPDATE_PERF_BASELINE=1."""
         got = _measure_host_overhead(hvd)
-        if os.environ.get("HVD_UPDATE_PERF_BASELINE") == "1" \
-                or not os.path.exists(_BASELINE):
+        if os.environ.get("HVD_UPDATE_PERF_BASELINE") == "1":
             with open(_BASELINE, "w") as f:
                 json.dump({**got, "note":
                            "CPU-tier 8-device mesh; median eager call / "
                            "best-of-3 50-tensor async burst; guard fails "
                            "at 2x (test_perf_guards.py)"}, f, indent=1)
             return
+        if not os.path.exists(_BASELINE):
+            # ADVICE.md round-5: silently regenerating here turned a
+            # deleted/renamed baseline into an always-pass no-op (and a
+            # docs-tree mutation as a test side effect). The committed
+            # baseline is part of the guard's contract — its absence is a
+            # failure, not a bootstrap.
+            import pytest
+            pytest.fail(
+                f"committed baseline {os.path.abspath(_BASELINE)} is "
+                f"missing — the host-overhead regression guard cannot "
+                f"run. Restore docs/host_overhead_baseline.json or "
+                f"regenerate it deliberately with "
+                f"HVD_UPDATE_PERF_BASELINE=1.")
         with open(_BASELINE) as f:
             base = json.load(f)
         for key in ("eager_us", "async_us_per_tensor"):
@@ -233,6 +245,51 @@ class TestHostOverheadBudget:
                 f"{key} regressed: {got[key]}us vs baseline {base[key]}us "
                 f"(2x budget). If the machine changed, regenerate with "
                 f"HVD_UPDATE_PERF_BASELINE=1.")
+
+
+class TestMetricsOverheadBudget:
+    """The metrics registry is ALWAYS ON in the eager hot path (one
+    record_collective per dispatch, one record per fusion enqueue/flush).
+    Its budget: a few microseconds per collective enqueue, no locks held
+    across RPC or flush boundaries — the registry only ever takes its own
+    per-child locks around a float add."""
+
+    N = 20_000
+
+    def _per_call_us(self, fn):
+        fn()                                  # warm: child creation
+        t0 = time.perf_counter()
+        for _ in range(self.N):
+            fn()
+        return (time.perf_counter() - t0) / self.N * 1e6
+
+    def test_collective_record_within_budget(self):
+        from horovod_tpu.metrics import instruments as ins
+
+        per = self._per_call_us(
+            lambda: ins.record_collective("allreduce", 4096, "global"))
+        # Two cached-child lookups + two locked float adds. Typically well
+        # under 2us; 25us bounds it on a loaded CI host while still
+        # catching an accidental O(series) walk or I/O on the hot path.
+        assert per < 25.0, f"record_collective costs {per:.1f}us/call"
+
+    def test_histogram_observe_within_budget(self):
+        from horovod_tpu.metrics import instruments as ins
+
+        child = ins.COLLECTIVE_LATENCY.labels("allreduce")
+        per = self._per_call_us(lambda: child.observe(1.5e-6))
+        assert per < 25.0, f"histogram observe costs {per:.1f}us/call"
+
+    def test_disabled_recording_is_cheaper_than_a_dispatch(self):
+        from horovod_tpu.metrics import instruments as ins
+
+        ins.set_enabled(False)
+        try:
+            per = self._per_call_us(
+                lambda: ins.record_collective("allreduce", 4096, "global"))
+        finally:
+            ins.set_enabled(True)
+        assert per < 10.0, f"disabled record costs {per:.1f}us/call"
 
 
 class TestLlamaStepGuards:
