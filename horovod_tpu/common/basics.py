@@ -143,6 +143,13 @@ def init(comm=None, process_sets=None, devices=None):
                 from horovod_tpu.common import negotiation
                 negotiation.reset_epoch()
 
+        # Persistent XLA compile cache BEFORE the first backend touch, so
+        # every compile this job performs (including the eager collective
+        # programs) is eligible: elastic re-rendezvous and repeat launches
+        # then skip XLA recompiles entirely (see docs/performance.md).
+        if config.compile_cache_dir:
+            _setup_compile_cache(config.compile_cache_dir)
+
         topology = build_topology(devices)
         _state = _State(topology, config)
 
@@ -180,6 +187,34 @@ def init(comm=None, process_sets=None, devices=None):
             "horovod_tpu initialized: size=%d local_size=%d cross_size=%d",
             topology.size, topology.local_size, topology.cross_size)
         atexit.register(shutdown)
+
+
+def _setup_compile_cache(path):
+    """Arm JAX's persistent compilation cache at ``path``
+    (``HOROVOD_COMPILE_CACHE_DIR``).
+
+    The min-compile-time / min-entry-size gates are zeroed: the eager
+    collective programs are individually cheap compiles, but an elastic
+    restart pays ALL of them again back-to-back — exactly the latency this
+    cache exists to remove. Cache-hit/request totals are mirrored into the
+    metrics registry (``compile_cache_events_total``). Failures downgrade
+    to a warning: a broken cache dir must not block training (delete a
+    stale directory if hits stay at zero — docs/troubleshooting.md)."""
+    try:
+        os.makedirs(path, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+        # jax latches its "is the cache usable" decision at the first
+        # compile; compiles before init() (user warmup, site hooks) would
+        # have latched it off — reset so the new dir takes effect.
+        from jax._src import compilation_cache as _cc
+        _cc.reset_cache()
+        from horovod_tpu import metrics as hvd_metrics
+        hvd_metrics.install_compile_cache_listener()
+        hvd_logging.info("persistent XLA compile cache at %s", path)
+    except Exception as e:  # noqa: BLE001 — cache is an optimization only
+        hvd_logging.warning("compile cache setup failed (%s): %s", path, e)
 
 
 def _clear_backends_and_program_caches():

@@ -119,6 +119,18 @@ class Config:
     wire_dtype: str = ""
     # Donate fused buffers to XLA (buffer reuse).
     donate_buffers: bool = True
+    # Donate SYNC eager-collective inputs that are already correctly-sharded
+    # jax.Arrays (the dispatch-plan fast path). Requires the caller to treat
+    # allreduce as consuming its input, so it is opt-in: armed only when
+    # HOROVOD_DONATE_BUFFERS is set EXPLICITLY (and truthy) in the
+    # environment — the default-on donate_buffers above covers only the
+    # fusion runtime's host-staged buckets, which alias nothing.
+    donate_eager: bool = False
+    # Persistent XLA compilation cache directory (HOROVOD_COMPILE_CACHE_DIR;
+    # "" = off). Wired to jax's compilation cache in basics.init so elastic
+    # re-rendezvous and repeat launches skip recompiles — recovery time is
+    # a perf metric too. See docs/performance.md.
+    compile_cache_dir: str = ""
 
     # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
     # the reference's observability stops at timeline + stall inspector).
@@ -214,6 +226,11 @@ class Config:
         c.wire_dtype = os.environ.get("HOROVOD_WIRE_DTYPE", c.wire_dtype)
         c.__post_init__()  # re-normalize after the env override
         c.donate_buffers = _env_bool("HOROVOD_DONATE_BUFFERS", c.donate_buffers)
+        # Eager-path donation only on an EXPLICIT opt-in (see field docs).
+        c.donate_eager = "HOROVOD_DONATE_BUFFERS" in os.environ \
+            and c.donate_buffers
+        c.compile_cache_dir = os.environ.get("HOROVOD_COMPILE_CACHE_DIR",
+                                             c.compile_cache_dir)
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
         c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
         c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",

@@ -1,3 +1,6 @@
+from horovod_tpu.elastic.sharded import (  # noqa: F401
+    fsdp_reshard, gather_to_host, zero_reshard,
+)
 from horovod_tpu.elastic.state import (  # noqa: F401
     State, ObjectState, TpuState, run,
 )

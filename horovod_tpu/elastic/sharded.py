@@ -18,11 +18,18 @@ the price of an elastic-consistent snapshot (the reference pays a full
 deep copy per commit for the same reason, torch/elastic/state.py:154+).
 Commit less often if it shows up in profiles.
 
-Used through :class:`horovod_tpu.elastic.TpuState` ``placements=``:
+Used alongside :class:`horovod_tpu.elastic.TpuState` from a reset
+callback — gather the sharded state to its full logical value, then
+re-partition it for the post-change mesh before resuming:
 
-    state = elastic.TpuState(
-        trees={"zs": zero_state}, placements={"zs": elastic.zero_reshard},
-        step=0)
+    state = elastic.TpuState(trees={"zs": zero_state}, step=0)
+
+    def on_membership_change():
+        host = elastic.gather_to_host(state.zs)
+        state.zs = elastic.zero_reshard(
+            host, hvd.global_process_set.mesh)
+
+    state.register_reset_callbacks([on_membership_change])
 """
 
 import numpy as np

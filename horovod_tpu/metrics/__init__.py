@@ -23,10 +23,12 @@ from horovod_tpu.metrics.registry import (  # noqa: F401
 )
 from horovod_tpu.metrics.instruments import (  # noqa: F401
     REGISTRY, enabled, set_enabled, set_prefix, get_registry,
-    emit_timeline_counters, maybe_emit_timeline_counters,
+    emit_timeline_counters, install_compile_cache_listener,
+    maybe_emit_timeline_counters,
     record_boundary, record_collective, record_collective_error,
-    record_collective_latency, record_elastic_event, record_fusion_flush,
-    record_fusion_kv, record_http_kv, record_negotiation, record_stall,
+    record_collective_latency, record_compile_cache, record_elastic_event,
+    record_fusion_flush, record_fusion_kv, record_http_kv,
+    record_negotiation, record_plan_cache, record_stall,
 )
 from horovod_tpu.metrics.server import (  # noqa: F401
     MetricsServer, http_server_port, start_http_server, stop_http_server,

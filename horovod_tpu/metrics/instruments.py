@@ -19,6 +19,9 @@ Catalogue (names shown without the ``HOROVOD_METRICS_PREFIX``, default
 - ``fusion_fill_ratio``                             flushed/threshold (histogram)
 - ``fusion_boundary_outcomes_total{outcome}``       applied|deferred (counter)
 - ``fusion_kv_rpcs_total{kind}``                    boundary KV set/get (counter)
+- ``dispatch_plan_events_total{event}``             plan cache hit|miss (counter)
+- ``compile_cache_events_total{event}``             XLA persistent-cache
+  request|hit (counter; armed by ``HOROVOD_COMPILE_CACHE_DIR``)
 - ``negotiation_rounds_total``                      exchange() rounds (counter)
 - ``control_plane_rpcs_total{transport,kind}``      every KV RPC (counter)
 - ``control_plane_payload_bytes_total{transport}``  KV payload bytes (counter)
@@ -107,6 +110,18 @@ FUSION_KV_RPCS = REGISTRY.counter(
     "Coordination-service KV RPCs issued by the fusion boundary "
     "publish/consume path (the ADVICE.md hot-poll class shows up here).",
     ("kind",))
+DISPATCH_PLAN_EVENTS = REGISTRY.counter(
+    "dispatch_plan_events_total",
+    "Eager dispatch-plan cache outcomes (event=hit|miss|invalidate). A "
+    "steady-state training loop is all hits; misses mean new signatures "
+    "(or churn past the plan-cache cap).",
+    ("event",))
+COMPILE_CACHE_EVENTS = REGISTRY.counter(
+    "compile_cache_events_total",
+    "JAX persistent-compilation-cache outcomes (event=request|hit). "
+    "Armed when HOROVOD_COMPILE_CACHE_DIR wires the cache up; "
+    "request-minus-hit is the fresh-XLA-compile count.",
+    ("event",))
 NEGOTIATION_ROUNDS = REGISTRY.counter(
     "negotiation_rounds_total",
     "Host-side negotiation.exchange() rounds (dynamic-shape collectives, "
@@ -183,6 +198,43 @@ def record_fusion_kv(sets=0, gets=0, payload_bytes=0):
         CONTROL_PLANE_RPCS.labels("coord", "get").inc(gets)
     if payload_bytes:
         CONTROL_PLANE_PAYLOAD.labels("coord").inc(payload_bytes)
+
+
+def record_plan_cache(event):
+    """One dispatch-plan cache outcome (event=hit|miss|invalidate)."""
+    if not _enabled:
+        return
+    DISPATCH_PLAN_EVENTS.labels(event).inc()
+
+
+def record_compile_cache(event):
+    """One persistent-compilation-cache outcome (event=request|hit)."""
+    if not _enabled:
+        return
+    COMPILE_CACHE_EVENTS.labels(event).inc()
+
+
+_compile_listener_installed = False
+
+
+def install_compile_cache_listener():
+    """Mirror JAX's persistent-compilation-cache monitoring events into
+    the registry, so cache effectiveness (and the zero-fresh-compiles
+    restart guarantee) is assertable from metrics. Idempotent; installed
+    when ``HOROVOD_COMPILE_CACHE_DIR`` arms the cache (basics.init)."""
+    global _compile_listener_installed
+    if _compile_listener_installed:
+        return
+    from jax._src import monitoring as _jax_monitoring
+
+    def _on_event(event, **kwargs):
+        if event == "/jax/compilation_cache/cache_hits":
+            record_compile_cache("hit")
+        elif event == "/jax/compilation_cache/compile_requests_use_cache":
+            record_compile_cache("request")
+
+    _jax_monitoring.register_event_listener(_on_event)
+    _compile_listener_installed = True
 
 
 def record_negotiation(gets, payload_bytes):

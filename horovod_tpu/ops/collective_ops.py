@@ -27,6 +27,7 @@ TPU-native design — NOT a port of the background-thread/NCCL model:
 
 import enum
 import functools
+import weakref
 
 import jax
 import jax.numpy as jnp
@@ -116,8 +117,39 @@ def _ps_label(process_set):
     return f"set{pid}" if pid is not None else "unregistered"
 
 
+def _translate_dispatch_error(name, op_label, e):
+    """Runtime-failure epilogue shared by :func:`_timeline_op` and the
+    dispatch-plan fast path: count the error, then re-raise — translating
+    transport/peer failures to :class:`HorovodInternalError`.
+
+    Inside the dispatch only the compiled program executes (inputs were
+    validated before it). Translate ONLY transport/peer failures to
+    HorovodInternalError — those are what elastic recovery can fix by
+    re-rendezvousing (e.g. status UNKNOWN "Gloo all-reduce failed:
+    Connection closed by peer" maps to ValueError, coordination
+    aborts to JaxRuntimeError). Deterministic runtime errors (OOM =
+    RESOURCE_EXHAUSTED, shape/layout issues) must propagate as-is or
+    the elastic @run wrapper would retry them forever."""
+    from horovod_tpu.metrics import instruments as hvd_metrics
+    hvd_metrics.record_collective_error(op_label)
+    from horovod_tpu.common.exceptions import HorovodInternalError
+    if isinstance(e, HorovodInternalError):
+        raise e
+    msg = str(e)
+    transport = any(m in msg for m in (
+        "UNAVAILABLE", "UNKNOWN", "DEADLINE_EXCEEDED", "ABORTED",
+        "CANCELLED", "Gloo", "gloo", "onnection",  # Connection/connection
+        "peer", "heartbeat", "oordination", "socket", "Socket"))
+    if jax.process_count() > 1 and transport:
+        raise HorovodInternalError(
+            f"collective {name} failed at runtime: "
+            f"{(msg.splitlines() or [''])[0][:200]}") from e
+    raise e
+
+
 @contextlib.contextmanager
-def _timeline_op(name, op_kind, tensors=(), process_set=None):
+def _timeline_op(name, op_kind, tensors=(), process_set=None,
+                 op_label=None, ps_label=None):
     """Timeline span + metrics + failure translation around one eager
     collective.
 
@@ -126,6 +158,8 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None):
     in at entry (failures still count as attempts) and the latency
     histogram on successful return — the aggregate layer the reference
     never had (its observability stops at the timeline trace).
+    ``op_label``/``ps_label``: precomputed label strings (the dispatch-plan
+    fast path passes them so nothing is re-formatted per call).
 
     A collective that dies at runtime (peer process gone, transport torn
     down mid-op) must surface as :class:`HorovodInternalError` so the
@@ -133,14 +167,15 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None):
     (reference: common/exceptions.py — op status callbacks raise
     HorovodInternalError; nccl_operations.h:70 async error polling)."""
     from horovod_tpu.metrics import instruments as hvd_metrics
-    op_label = op_kind.lower()
+    if op_label is None:
+        op_label = op_kind.lower()
     # Gated HERE, not just inside the helpers: the nbytes sum is
     # O(n_tensors) and must cost nothing under HOROVOD_METRICS=0.
     metrics_on = hvd_metrics.enabled()
     if metrics_on:
         hvd_metrics.record_collective(
             op_label, sum(getattr(t, "nbytes", 0) for t in tensors),
-            _ps_label(process_set))
+            ps_label if ps_label is not None else _ps_label(process_set))
         t0 = time.perf_counter()
     tl = basics.timeline()
     span = tl.op_span(name, op_kind) if tl is not None \
@@ -157,28 +192,7 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None):
             hvd_metrics.record_collective_latency(
                 op_label, time.perf_counter() - t0)
     except (ValueError, RuntimeError) as e:
-        hvd_metrics.record_collective_error(op_label)
-        # Inside the span only the compiled program executes (inputs were
-        # validated before it). Translate ONLY transport/peer failures to
-        # HorovodInternalError — those are what elastic recovery can fix by
-        # re-rendezvousing (e.g. status UNKNOWN "Gloo all-reduce failed:
-        # Connection closed by peer" maps to ValueError, coordination
-        # aborts to JaxRuntimeError). Deterministic runtime errors (OOM =
-        # RESOURCE_EXHAUSTED, shape/layout issues) must propagate as-is or
-        # the elastic @run wrapper would retry them forever.
-        from horovod_tpu.common.exceptions import HorovodInternalError
-        if isinstance(e, HorovodInternalError):
-            raise
-        msg = str(e)
-        transport = any(m in msg for m in (
-            "UNAVAILABLE", "UNKNOWN", "DEADLINE_EXCEEDED", "ABORTED",
-            "CANCELLED", "Gloo", "gloo", "onnection",  # Connection/connection
-            "peer", "heartbeat", "oordination", "socket", "Socket"))
-        if jax.process_count() > 1 and transport:
-            raise HorovodInternalError(
-                f"collective {name} failed at runtime: "
-                f"{(msg.splitlines() or [''])[0][:200]}") from e
-        raise
+        _translate_dispatch_error(name, op_label, e)
 
 
 def _is_float(dtype):
@@ -252,11 +266,15 @@ def _reduce_shard(x, op, n, prescale, postscale, axis_name, active=None):
 
 @functools.lru_cache(maxsize=4096)
 def _allreduce_program(mesh, n, op, prescale, postscale, shapes, dtypes,
-                       active_mask=None):
+                       active_mask=None, donate=False):
     """``active_mask``: optional tuple of 0/1 per rank — joined ranks are
     masked out of the reduction and Average divides by the active count
     (reference: JOIN handling / joined_size accounting, controller.cc:269-327
-    and operations.cc global joined_size)."""
+    and operations.cc global joined_size). ``donate``: donate every input
+    buffer to XLA so the output reuses its HBM — the eager-path opt-in
+    (``HOROVOD_DONATE_BUFFERS`` set explicitly; used by the dispatch-plan
+    fast path only when the inputs are already sharded jax.Arrays, where
+    in-place reuse is actually possible)."""
     active = None if active_mask is None else np.array(active_mask)
 
     def body(*xs):
@@ -267,7 +285,8 @@ def _allreduce_program(mesh, n, op, prescale, postscale, shapes, dtypes,
     f = jax.shard_map(body, mesh=mesh,
                       in_specs=tuple(P(HVD_AXIS) for _ in shapes),
                       out_specs=tuple(P(HVD_AXIS) for _ in shapes))
-    return jax.jit(f)
+    return jax.jit(f, donate_argnums=tuple(range(len(shapes)))
+                   if donate else ())
 
 
 @functools.lru_cache(maxsize=4096)
@@ -404,10 +423,15 @@ def clear_program_caches():
                  _alltoall_program, _barrier_program,
                  _alltoall_pack_index):
         prog.cache_clear()
+    # Dispatch plans capture compiled programs + NamedShardings of the
+    # torn-down backend; a stale hit after an elastic resize would dispatch
+    # into a dead client.
+    _invalidate_plans()
     # Fused eager programs are keyed by Mesh too; stale entries would pin a
     # torn-down XLA client (and its buffers) for the rest of the job.
     from horovod_tpu.ops import fusion
     fusion._fused_program.cache_clear()
+    fusion._flush_plans.clear()
 
 
 @functools.lru_cache(maxsize=1024)
@@ -512,6 +536,263 @@ def _signature(tensors):
 
 
 # ----------------------------------------------------------------------------
+# Dispatch-plan cache: the eager hot path's one-cache-hit steady state.
+#
+# The compiled-program cache already replaces the reference's negotiation
+# (response_cache.h:45), but every eager call still paid Python-side costs
+# the program cache does not amortize: signature/string formatting,
+# NamedSharding construction, per-call device_put of inputs, timeline/
+# metrics setup even when observability is off, and a sort-per-call
+# _localize. A _DispatchPlan resolves all of that ONCE per
+# (op kind, mesh, process set, op params, tensor signature); steady state
+# is: tuple-key dict hit -> compiled-program call -> indexed localization.
+#
+# Input staging on the plan path is the compiled program's own C++
+# dispatch: jit uploads/reshards host or mismatched-sharding inputs and
+# caches one executable per input-sharding signature, so no Python-side
+# device_put runs per call (measured ~2x cheaper than device_put + call on
+# the CPU tier), and an input that is already a correctly-sharded
+# jax.Array passes through zero-copy. Multi-process keeps the explicit
+# make_array_from_process_local_data assembly (local rows -> global array
+# cannot be inferred by jit).
+# ----------------------------------------------------------------------------
+
+_PLAN_CAP = 4096
+_plans = {}
+_plan_stats = {"hits": 0, "misses": 0, "invalidations": 0}
+
+
+def plan_cache_stats():
+    """Copy of the dispatch-plan cache counters (always on — plain ints;
+    the metrics registry carries the same series when enabled)."""
+    return dict(_plan_stats, size=len(_plans))
+
+
+def _invalidate_plans():
+    if _plans:
+        _plan_stats["invalidations"] += 1
+        _plans.clear()
+
+
+def _plan_sig(tensors):
+    """Hashable per-tensor (shape, dtype) signature of a call, or None
+    when any input is not ndarray-like (python scalars/lists take the
+    generic path — they need np.asarray normalization first)."""
+    sig = []
+    for t in tensors:
+        if not isinstance(t, (jax.Array, np.ndarray)):
+            return None
+        sig.append((t.shape, t.dtype))
+    return tuple(sig)
+
+
+def _plan_lookup(key, ps):
+    """Return the hit plan for ``key`` — after re-checking the runtime
+    conditions a plan cannot capture (join armed/active, debug order
+    check), which re-route to the generic negotiated path. A hit fences
+    in-flight fused async work exactly like :func:`_join_sync` does."""
+    st = basics._state
+    if st is None:
+        return None
+    plan = _plans.get(key)
+    if plan is None:
+        _plan_stats["misses"] += 1
+        from horovod_tpu.metrics import instruments as hvd_metrics
+        hvd_metrics.record_plan_cache("miss")
+        return None
+    cfg = st.config
+    if cfg.order_check or st.joined_ranks or ps.joined_ranks \
+            or (cfg.join_mode and jax.process_count() > 1):
+        return None
+    if st.fusion is not None:
+        st.fusion.fence()
+    _plan_stats["hits"] += 1
+    from horovod_tpu.metrics import instruments as hvd_metrics
+    hvd_metrics.record_plan_cache("hit")
+    return plan
+
+
+def _plan_eligible(st, active_mask):
+    """A plan may be registered only for dispatches whose control path is
+    pure (no join mask, no armed per-op negotiation, no debug order
+    check) — everything a plan precomputes is then call-invariant."""
+    return (active_mask is None and not st.config.order_check
+            and not (st.config.join_mode and jax.process_count() > 1))
+
+
+def _register_plan(key, plan):
+    if len(_plans) >= _PLAN_CAP:
+        _plans.pop(next(iter(_plans)))      # drop the oldest entry
+    _plans[key] = plan
+    return plan
+
+
+class _DispatchPlan:
+    """Everything one eager-collective signature needs per call, resolved
+    once: compiled program (plus the opt-in donating variant), input
+    NamedSharding, global stacked shapes, metrics label strings, and the
+    output localization order (shard order resolved on first use —
+    localization becomes indexed ``np.asarray`` without re-sorting)."""
+
+    __slots__ = ("kind", "op_kind", "op_label", "default_name", "program",
+                 "donate_program", "mesh", "sharding", "ps", "ps_label",
+                 "multi", "global_shapes", "nbytes", "_localize_order",
+                 "_stage_memo")
+
+    _STAGE_MEMO_CAP = 16
+
+    def __init__(self, kind, op_kind, program, mesh, ps, staged,
+                 default_name, donate_program=None):
+        self.kind = kind
+        self.op_kind = op_kind
+        self.op_label = op_kind.lower()
+        self.default_name = default_name
+        self.program = program
+        self.donate_program = donate_program
+        self.mesh = mesh
+        self.sharding = NamedSharding(mesh, P(HVD_AXIS))
+        self.ps = ps
+        self.ps_label = _ps_label(ps)
+        self.multi = _local_mesh_info(mesh)[0]
+        # Derived from the registration call's staged (global) tensors:
+        # every later key-matched call has the same shapes/dtypes, so the
+        # metrics byte count is a plan constant, not a per-call walk.
+        self.global_shapes = tuple(tuple(t.shape) for t in staged)
+        self.nbytes = sum(getattr(t, "nbytes", 0) for t in staged)
+        self._localize_order = None
+        # id(src) -> (weakref(src), staged): re-sharding the SAME
+        # immutable jax.Array every step (re-reducing a pinned buffer)
+        # is pure waste — stage once, reuse while the source is alive.
+        # WEAK source refs: a fresh-gradient-per-step loop gets no memo
+        # hits, and strong refs would pin up to CAP dead source+staged
+        # buffer pairs per plan; the weakref callback drops the staged
+        # copy the moment the caller's array dies, and the liveness
+        # check (wr() is t) guards id reuse. Host numpy is NEVER
+        # memoized (mutable in place).
+        self._stage_memo = {}
+
+    def run(self, tensors, name=None):
+        if self.multi:
+            sharding = self.sharding
+            staged = [jax.make_array_from_process_local_data(
+                          sharding, np.asarray(t), g)
+                      for t, g in zip(tensors, self.global_shapes)]
+            return self.dispatch(staged, name, prog=self.program)
+        sharding = self.sharding
+        staged = []
+        passthrough = True
+        memo = self._stage_memo
+        for t in tensors:
+            if isinstance(t, jax.Array):
+                if t.sharding == sharding:
+                    staged.append(t)        # zero-copy passthrough
+                    continue
+                passthrough = False
+                m = memo.get(id(t))
+                if m is not None and m[0]() is t:
+                    staged.append(m[1])
+                    continue
+                s = jax.device_put(t, sharding)
+                if len(memo) >= self._STAGE_MEMO_CAP:
+                    memo.clear()
+                try:
+                    wr = weakref.ref(
+                        t, lambda _, k=id(t), m=memo: m.pop(k, None))
+                except TypeError:
+                    pass            # not weakref-able: stage, don't memo
+                else:
+                    memo[id(t)] = (wr, s)
+                staged.append(s)
+            else:
+                # Host numpy: the program's own C++ dispatch stages it.
+                passthrough = False
+                staged.append(t)
+        # Donation ONLY for all-passthrough calls: the caller's own
+        # correctly-sharded arrays (the explicit opt-in contract). A
+        # memoized staged copy must never be donated — its buffer would
+        # be dead on the next memo hit.
+        prog = self.donate_program \
+            if self.donate_program is not None and passthrough \
+            else self.program
+        return self.dispatch(staged, name, prog=prog)
+
+    def _program_for(self, staged):
+        """The donating program applies only when every input is already a
+        correctly-sharded jax.Array: donation is then real in-place buffer
+        reuse (and the caller explicitly opted into losing its inputs via
+        HOROVOD_DONATE_BUFFERS); anything else keeps the plain program —
+        donating a to-be-resharded buffer is a no-op plus an XLA warning."""
+        if self.donate_program is None:
+            return self.program
+        sharding = self.sharding
+        for t in staged:
+            if not (isinstance(t, jax.Array) and t.sharding == sharding):
+                return self.program
+        return self.donate_program
+
+    def dispatch(self, staged, name=None, prog=None):
+        from horovod_tpu.metrics import instruments as hvd_metrics
+        if prog is None:
+            # Slow-path registration call: staged buffers are fresh
+            # _prepare outputs, safe to donate under the opt-in.
+            prog = self._program_for(staged)
+        metrics_on = hvd_metrics.enabled()
+        tl = basics.timeline()
+        if tl is None and not metrics_on:
+            # Observability fully off: no span/annotation bookkeeping, no
+            # metrics — just the compiled call + error translation.
+            try:
+                outs = prog(*staged)
+            except (ValueError, RuntimeError) as e:
+                _translate_dispatch_error(name or self.default_name,
+                                          self.op_label, e)
+            return self._localize(outs)
+        # Inline _timeline_op with the plan's precomputed labels/byte
+        # count (no contextmanager frame, no per-call nbytes walk; the
+        # XPlane TraceAnnotation rides only with an active timeline).
+        if metrics_on:
+            hvd_metrics.record_collective(self.op_label, self.nbytes,
+                                          self.ps_label)
+            t0 = time.perf_counter()
+        try:
+            if tl is not None:
+                with jax.profiler.TraceAnnotation(
+                        f"hvd::{self.op_kind}::{name or self.default_name}"):
+                    with tl.op_span(name or self.default_name,
+                                    self.op_kind):
+                        outs = prog(*staged)
+            else:
+                outs = prog(*staged)
+            if metrics_on:
+                hvd_metrics.record_collective_latency(
+                    self.op_label, time.perf_counter() - t0)
+        except (ValueError, RuntimeError) as e:
+            _translate_dispatch_error(name or self.default_name,
+                                      self.op_label, e)
+        return self._localize(outs)
+
+    def _localize(self, outs):
+        """Per-process local rows of each output (multi-process), with the
+        shard order resolved once per plan instead of sorted per call."""
+        if not self.multi:
+            return list(outs)
+        order = self._localize_order
+        res = []
+        for o in outs:
+            shards = o.addressable_shards
+            if order is None:
+                order = tuple(int(i) for i in np.argsort(
+                    [s.index[0].start or 0 for s in shards]))
+                self._localize_order = order
+            if len(order) == 1:
+                res.append(np.asarray(shards[0].data))
+            else:
+                res.append(np.concatenate(
+                    [np.asarray(shards[i].data) for i in order], axis=0))
+        return res
+
+
+# ----------------------------------------------------------------------------
 # Public eager API
 # ----------------------------------------------------------------------------
 
@@ -534,6 +815,13 @@ def grouped_allreduce(tensors, op=Average, prescale_factor=1.0,
     the reference's grouped ops (reference: EnqueueTensorAllreduces
     operations.cc:1480, group_table.h:39)."""
     mesh, ps = _mesh_for(process_set)
+    sig = _plan_sig(tensors)
+    if sig is not None:
+        key = ("allreduce", mesh, ps, int(op), float(prescale_factor),
+               float(postscale_factor), sig)
+        plan = _plan_lookup(key, ps)
+        if plan is not None:
+            return plan.run(tensors, name)
     n = ps.size()
     if op == Average and any(
             not _is_float(_dtype_of(t)) for t in tensors):
@@ -548,6 +836,16 @@ def grouped_allreduce(tensors, op=Average, prescale_factor=1.0,
     prog = _allreduce_program(mesh, n, ReduceOp(op), float(prescale_factor),
                               float(postscale_factor), shapes, dtypes,
                               active_mask)
+    st = basics._get_state()
+    if sig is not None and _plan_eligible(st, active_mask):
+        donate_prog = _allreduce_program(
+            mesh, n, ReduceOp(op), float(prescale_factor),
+            float(postscale_factor), shapes, dtypes, active_mask,
+            donate=True) if st.config.donate_eager else None
+        plan = _register_plan(key, _DispatchPlan(
+            "allreduce", "ALLREDUCE", prog, mesh, ps, tensors,
+            "grouped_allreduce", donate_program=donate_prog))
+        return plan.dispatch(tensors, name)
     with _timeline_op(name or "grouped_allreduce", "ALLREDUCE", tensors,
                       process_set=ps):
         return _localize(list(prog(*tensors)), mesh)
@@ -565,6 +863,12 @@ def allgather(tensor, process_set=None, name=None):
 
 def grouped_allgather(tensors, process_set=None, name=None):
     mesh, ps = _mesh_for(process_set)
+    sig = _plan_sig(tensors)
+    if sig is not None:
+        key = ("allgather", mesh, ps, sig)
+        plan = _plan_lookup(key, ps)
+        if plan is not None:
+            return plan.run(tensors, name)
     n = ps.size()
     slices = _slice_desc(tensors, mesh, n, "allgather")
     # Validate BEFORE the join round: an active raising after publishing
@@ -588,6 +892,12 @@ def grouped_allgather(tensors, process_set=None, name=None):
             and getattr(topo, "mesh2d", None) is not None)
     prog = _allgather_program(topo.mesh2d if hier else mesh, n, shapes,
                               dtypes, active_mask, hier)
+    st = basics._get_state()
+    if sig is not None and _plan_eligible(st, active_mask):
+        plan = _register_plan(key, _DispatchPlan(
+            "allgather", "ALLGATHER", prog, mesh, ps, tensors,
+            "grouped_allgather"))
+        return plan.dispatch(tensors, name)
     with _timeline_op(name or "grouped_allgather", "ALLGATHER", tensors,
                       process_set=ps):
         return _localize(list(prog(*tensors)), mesh)
@@ -662,6 +972,12 @@ def broadcast(tensor, root_rank, process_set=None, name=None):
 
 def grouped_broadcast(tensors, root_rank, process_set=None, name=None):
     mesh, ps = _mesh_for(process_set)
+    sig = _plan_sig(tensors)
+    if sig is not None:
+        key = ("broadcast", mesh, ps, int(root_rank), sig)
+        plan = _plan_lookup(key, ps)
+        if plan is not None:
+            return plan.run(tensors, name)
     n = ps.size()
     if ps.ranks is not None:
         try:
@@ -686,6 +1002,12 @@ def grouped_broadcast(tensors, root_rank, process_set=None, name=None):
     tensors = _prepare(tensors, mesh, n, "broadcast")
     shapes, dtypes = _signature(tensors)
     prog = _broadcast_program(mesh, n, int(root), shapes, dtypes)
+    st = basics._get_state()
+    if sig is not None and _plan_eligible(st, mask):
+        plan = _register_plan(key, _DispatchPlan(
+            "broadcast", "BROADCAST", prog, mesh, ps, tensors,
+            "grouped_broadcast"))
+        return plan.dispatch(tensors, name)
     with _timeline_op(name or "grouped_broadcast", "BROADCAST", tensors,
                       process_set=ps):
         return _localize(list(prog(*tensors)), mesh)
@@ -707,6 +1029,13 @@ def reducescatter(tensor, op=Sum, prescale_factor=1.0, postscale_factor=1.0,
 def grouped_reducescatter(tensors, op=Sum, prescale_factor=1.0,
                           postscale_factor=1.0, process_set=None, name=None):
     mesh, ps = _mesh_for(process_set)
+    sig = _plan_sig(tensors)
+    if sig is not None:
+        key = ("reducescatter", mesh, ps, int(op), float(prescale_factor),
+               float(postscale_factor), sig)
+        plan = _plan_lookup(key, ps)
+        if plan is not None:
+            return plan.run(tensors, name)
     n = ps.size()
     slices = _slice_desc(tensors, mesh, n, "reducescatter")
     # Validate BEFORE the join round (see grouped_allgather).
@@ -724,6 +1053,12 @@ def grouped_reducescatter(tensors, op=Sum, prescale_factor=1.0,
     prog = _reducescatter_program(mesh, n, ReduceOp(op), float(prescale_factor),
                                   float(postscale_factor), shapes, dtypes,
                                   active_mask)
+    st = basics._get_state()
+    if sig is not None and _plan_eligible(st, active_mask):
+        plan = _register_plan(key, _DispatchPlan(
+            "reducescatter", "REDUCESCATTER", prog, mesh, ps, tensors,
+            "grouped_reducescatter"))
+        return plan.dispatch(tensors, name)
     with _timeline_op(name or "grouped_reducescatter", "REDUCESCATTER",
                       tensors, process_set=ps):
         return _localize(list(prog(*tensors)), mesh)
@@ -744,6 +1079,12 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
     """
     mesh, ps = _mesh_for(process_set)
     n = ps.size()
+    sig = _plan_sig((tensor,)) if splits is None else None
+    if sig is not None:
+        key = ("alltoall", mesh, ps, sig)
+        plan = _plan_lookup(key, ps)
+        if plan is not None:
+            return plan.run([tensor], name)[0]
     if _join_sync(ps, mesh, {"kind": "alltoall"}) is not None:
         from horovod_tpu.common.exceptions import HorovodInternalError
         raise HorovodInternalError(
@@ -761,6 +1102,12 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
         (tt,) = _prepare([t], mesh, n, "alltoall")
         shapes, dtypes = _signature([tt])
         prog = _alltoall_program(mesh, n, shapes, dtypes)
+        st = basics._get_state()
+        if sig is not None and _plan_eligible(st, None):
+            plan = _register_plan(key, _DispatchPlan(
+                "alltoall", "ALLTOALL", prog, mesh, ps, (tt,),
+                "alltoall"))
+            return plan.dispatch([tt], name)[0]
         with _timeline_op(name or "alltoall", "ALLTOALL", (tt,),
                           process_set=ps):
             return _localize([prog(tt)[0]], mesh)[0]

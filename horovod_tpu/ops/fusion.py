@@ -186,6 +186,15 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
     return jax.jit(f, donate_argnums=tuple(donate))
 
 
+# Flush-plan cache: bucket signature -> compiled fused program. The lru on
+# _fused_program already dedupes compiles; this dict additionally pins the
+# steady-state lookup to one tuple-key hit per bucket (no Mesh re-hash per
+# flush) and gives clear_program_caches() a single invalidation point for
+# the flush path (collective_ops.clear_program_caches clears it alongside
+# the dispatch-plan cache).
+_flush_plans = {}
+
+
 class FusionRuntime:
     # Forwarded to the native scheduler so runtime threshold changes (the
     # autotuner, tests) affect its flush decision too.
@@ -730,6 +739,32 @@ class FusionRuntime:
                 return None
             return self._native.cache_stats()
 
+    def _stage_local(self, raw, mesh):
+        """Single-process staging for one flush bucket: already-sharded
+        jax.Arrays pass through zero-copy; a mismatched jax.Array is
+        device_put ONCE per distinct buffer (id-deduped — re-reducing the
+        same immutable array many times in one burst, the gradient-hook
+        microbench shape, used to pay a python reshard per occurrence);
+        host numpy stays raw for the program's own C++ staging (mutable —
+        never alias-deduped)."""
+        from jax.sharding import NamedSharding
+        cached = getattr(self, "_stage_sharding", None)
+        if cached is None or cached[0] is not mesh:
+            cached = (mesh, NamedSharding(mesh, P(HVD_AXIS)))
+            self._stage_sharding = cached
+        sharding = cached[1]
+        staged_by_id = {}
+        out = []
+        for t in raw:
+            if isinstance(t, jax.Array) and t.sharding != sharding:
+                s = staged_by_id.get(id(t))
+                if s is None:
+                    s = staged_by_id[id(t)] = jax.device_put(t, sharding)
+                out.append(s)
+            else:
+                out.append(t)
+        return out
+
     def _flush_locked(self, up_to=None):
         """Dispatch pending tensors. ``up_to`` (follower boundary replay):
         flush only the prefix with tid <= up_to — the exact set the
@@ -839,25 +874,34 @@ class FusionRuntime:
         for op, pre, post, items, strategy in plan:
             raw = [i[0] for i in items]
             # Donate per argument, and only inputs staged from the HOST
-            # (numpy/torch/etc. → device_put always copies): a jax.Array
+            # (numpy/torch/etc. → staging always copies): a jax.Array
             # input with a matching sharding may ALIAS the staged buffer,
             # and donating it would invalidate the caller's array.
             donate = tuple(i for i, t in enumerate(raw)
                            if not isinstance(t, jax.Array)) \
                 if self._donate else ()
-            tensors = _prepare(raw, mesh, n, "fused_allreduce")
+            if self._multi:
+                tensors = _prepare(raw, mesh, n, "fused_allreduce")
+            else:
+                tensors = self._stage_local(raw, mesh)
             shapes = tuple(tuple(t.shape) for t in tensors)
-            dtypes = tuple(str(t.dtype) for t in tensors)
+            dtypes = tuple(np.dtype(t.dtype).name for t in tensors)
             if self._native is not None:
                 # Steady-state training flushes the same bucket signatures
                 # every step; the native LRU mirrors the reference's
                 # response cache and exposes hit-rate stats (cache_stats()).
                 self._native.cache_lookup(
                     hash((op, pre, post, shapes, dtypes)))
-            prog_mesh = topo.mesh2d if strategy != "flat" else mesh
-            prog = _fused_program(prog_mesh, n, op, pre, post, shapes,
-                                  dtypes, wire_now, active_mask, strategy,
-                                  donate)
+            fkey = (mesh, op, pre, post, shapes, dtypes, wire_now,
+                    active_mask, strategy, donate)
+            prog = _flush_plans.get(fkey)
+            if prog is None:
+                if len(_flush_plans) >= 2048:   # runaway-signature guard
+                    _flush_plans.clear()
+                prog_mesh = topo.mesh2d if strategy != "flat" else mesh
+                prog = _flush_plans[fkey] = _fused_program(
+                    prog_mesh, n, op, pre, post, shapes, dtypes, wire_now,
+                    active_mask, strategy, donate)
             # _timeline_op supplies BOTH the timeline span and the
             # transport-failure → HorovodInternalError translation: a peer
             # dying mid fused collective must be recoverable by the elastic
@@ -875,6 +919,9 @@ class FusionRuntime:
                     # matching the sync ops' contract.
                     outs = _localize(list(outs), mesh)
             except Exception as e:  # noqa: BLE001
+                # A failed dispatch also evicts its flush plan: never pin
+                # a program that just raised (rebuild costs one lru hit).
+                _flush_plans.pop(fkey, None)
                 for _, h in items:
                     h._set_error(e)
                 continue

@@ -40,6 +40,7 @@ _ARG_ENV_MAP = [
     ("log_hide_timestamp", "HOROVOD_LOG_HIDE_TIME",
      lambda v: "1" if v else None),
     ("wire_dtype", "HOROVOD_WIRE_DTYPE", str),
+    ("compile_cache_dir", "HOROVOD_COMPILE_CACHE_DIR", str),
     ("elastic_timeout", "HOROVOD_ELASTIC_TIMEOUT", str),
     ("gloo_timeout_seconds", "HOROVOD_GLOO_TIMEOUT_SECONDS", str),
     ("nics", "HOROVOD_NICS", str),

@@ -109,6 +109,13 @@ def parse_args(argv=None):
     tuning.add_argument("--wire-dtype", dest="wire_dtype",
                         choices=["", "bfloat16", "float16", "bf16", "fp16",
                                  "int8"])
+    tuning.add_argument("--compile-cache-dir", dest="compile_cache_dir",
+                        help="Persistent XLA compile-cache directory "
+                             "exported to every worker "
+                             "(HOROVOD_COMPILE_CACHE_DIR). Elastic launches "
+                             "default it to <output-dir or cwd>/"
+                             ".horovod_compile_cache so re-rendezvoused "
+                             "workers skip XLA recompiles.")
 
     autotune = p.add_argument_group("autotune")
     autotune.add_argument("--autotune", action="store_true", dest="autotune")
@@ -243,6 +250,19 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
     })
     if os.environ.get(SECRET_ENV):
         env[SECRET_ENV] = os.environ[SECRET_ENV]
+    # Persistent XLA compile cache: propagate the launcher's dir; elastic
+    # launches (whose whole point is fast recovery — every re-rendezvous
+    # otherwise recompiles every program from scratch) default it to a
+    # stable per-host path under the run's base dir. Workers on different
+    # hosts each keep a local cache at the same relative path.
+    cache_dir = os.environ.get("HOROVOD_COMPILE_CACHE_DIR") \
+        or getattr(args, "compile_cache_dir", None)
+    if not cache_dir and env.get("HOROVOD_ELASTIC"):
+        cache_dir = os.path.join(
+            getattr(args, "output_filename", None) or ".",
+            ".horovod_compile_cache")
+    if cache_dir:
+        env.setdefault("HOROVOD_COMPILE_CACHE_DIR", cache_dir)
     # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU
     # device: pin each worker's device count to its slot count so the world
     # size equals the requested slots regardless of ambient XLA_FLAGS.

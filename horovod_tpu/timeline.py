@@ -98,11 +98,12 @@ class Timeline:
         ts = ts_us if ts_us is not None else self._now_us()
         if self._native is not None:
             # Exact formatting (not %g): byte/op counters past ~1e6 must
-            # stay cross-checkable against the registry's scrape values.
-            v = float(value)
-            sv = str(int(v)) if v == int(v) and abs(v) < 1e15 else repr(v)
-            self._native.record(f"{name}={sv}", "metrics", "i", ts,
-                                0.0, 0)
+            # stay cross-checkable against the registry's scrape values —
+            # use the registry's own sample formatter so the two can
+            # never drift.
+            from horovod_tpu.metrics.registry import _fmt
+            self._native.record(f"{name}={_fmt(value)}", "metrics", "i",
+                                ts, 0.0, 0)
             return
         self.record(name, "C", "metrics", ts, args={"value": value}, tid=0)
 

@@ -59,6 +59,9 @@ def pytest_configure(config):
         "markers", "timeout(seconds): per-test timeout override "
         "(default %ss, suite-wide env HVD_TEST_TIMEOUT)"
         % int(_DEFAULT_TEST_TIMEOUT))
+    config.addinivalue_line(
+        "markers", "slow: excluded from the tier-1 `-m 'not slow'` run "
+        "(multi-interpreter cold starts etc.)")
 
 
 class _PhaseTimeout:

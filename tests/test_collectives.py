@@ -520,3 +520,102 @@ class TestAsyncTransportTranslation:
         assert h._result is not None, "cycle thread never flushed"
         np.testing.assert_allclose(np.asarray(h.synchronize()),
                                    np.full((hvd.size(), 2), hvd.size()))
+
+
+class TestDispatchPlanSemantics:
+    """The dispatch-plan fast path must be semantically invisible: same
+    values on hit as on the registration (slow) call, for every input
+    staging flavor, and the opt-in donation must consume exactly the
+    passthrough inputs."""
+
+    def test_plan_hit_matches_slow_path_values(self, hvd, rng):
+        import jax
+
+        from horovod_tpu.ops import collective_ops as co
+
+        n = hvd.size()
+        vals = rng.standard_normal((n, 7)).astype(np.float32)
+        expect = np.tile(vals.sum(axis=0, keepdims=True), (n, 1))
+        # Registration call (slow path) + hits from every staging flavor:
+        # numpy, uncommitted jax.Array, presharded jax.Array.
+        hits_before = co.plan_cache_stats()["hits"]
+        out0 = np.asarray(hvd.allreduce(vals, op=hvd.Sum))
+        out1 = np.asarray(hvd.allreduce(np.array(vals), op=hvd.Sum))
+        out2 = np.asarray(hvd.allreduce(jnp.asarray(vals), op=hvd.Sum))
+        presharded = jax.device_put(
+            jnp.asarray(vals),
+            jax.sharding.NamedSharding(
+                hvd.global_process_set.mesh,
+                jax.sharding.PartitionSpec("hvd")))
+        out3 = np.asarray(hvd.allreduce(presharded, op=hvd.Sum))
+        for out in (out0, out1, out2, out3):
+            np.testing.assert_allclose(out, expect, rtol=1e-5)
+        assert co.plan_cache_stats()["hits"] >= hits_before + 3
+
+    def test_stage_memo_reuses_identical_buffer(self, hvd):
+        from horovod_tpu.ops import collective_ops as co
+
+        x = jnp.full((hvd.size(), 23), 2.0, jnp.float32)
+        np.asarray(hvd.allreduce(x, op=hvd.Sum))      # registers
+        key = [k for k in co._plans
+               if k[0] == "allreduce" and k[3] == int(hvd.Sum)
+               and k[-1] and k[-1][0][0] == (hvd.size(), 23)]
+        assert len(key) == 1
+        plan = co._plans[key[0]]
+        np.asarray(hvd.allreduce(x, op=hvd.Sum))      # memoizes staging
+        memo_entry = plan._stage_memo.get(id(x))
+        assert memo_entry is not None and memo_entry[0]() is x
+        staged_first = memo_entry[1]
+        np.asarray(hvd.allreduce(x, op=hvd.Sum))      # reuses it
+        assert plan._stage_memo[id(x)][1] is staged_first
+        # WEAK source ref: when the caller's array dies, the memo entry
+        # (and its staged copy) must go with it — a fresh-gradient loop
+        # must not accumulate dead buffers.
+        xid = id(x)
+        del x, memo_entry
+        import gc
+        gc.collect()
+        assert xid not in plan._stage_memo, \
+            "stage memo retained a dead source array"
+
+    def test_eager_donation_opt_in_consumes_passthrough_input(self, hvd):
+        """HOROVOD_DONATE_BUFFERS armed: an allreduce whose input is
+        already a correctly-sharded jax.Array donates it (the buffer is
+        dead afterwards); staged inputs are never donated."""
+        import jax
+
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import collective_ops as co
+
+        st = basics._get_state()
+        prev = st.config.donate_eager
+        st.config.donate_eager = True
+        sharding = jax.sharding.NamedSharding(
+            hvd.global_process_set.mesh, jax.sharding.PartitionSpec("hvd"))
+        try:
+            n = hvd.size()
+            x0 = jax.device_put(jnp.full((n, 21), 3.0, jnp.float32),
+                                sharding)
+            # Registration: _prepare's device_put of a matching-sharded
+            # array aliases it, so the opt-in consumes it here already.
+            out = np.asarray(hvd.allreduce(x0, op=hvd.Sum))
+            np.testing.assert_allclose(out, np.full((n, 21), 3.0 * n),
+                                       rtol=1e-5)
+            # Plan hit with a fresh presharded input: donated too.
+            x1 = jax.device_put(jnp.full((n, 21), 5.0, jnp.float32),
+                                sharding)
+            out = np.asarray(hvd.allreduce(x1, op=hvd.Sum))
+            np.testing.assert_allclose(out, np.full((n, 21), 5.0 * n),
+                                       rtol=1e-5)
+            assert x1.is_deleted(), \
+                "opt-in donation did not consume the passthrough input"
+            # A host-numpy input is NOT donated and stays usable.
+            xh = np.full((n, 21), 7.0, np.float32)
+            out = np.asarray(hvd.allreduce(xh, op=hvd.Sum))
+            np.testing.assert_allclose(out, np.full((n, 21), 7.0 * n),
+                                       rtol=1e-5)
+            np.testing.assert_allclose(xh, 7.0)
+        finally:
+            st.config.donate_eager = prev
+            # Drop the donating plan so later tests reuse a plain one.
+            co.clear_program_caches()

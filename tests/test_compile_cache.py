@@ -1,0 +1,115 @@
+"""Persistent XLA compilation cache (HOROVOD_COMPILE_CACHE_DIR).
+
+Elastic re-rendezvous and repeat launches used to recompile every eager
+collective program from scratch; with the cache armed, a restart's
+compiles are disk hits. Recovery time is a perf metric too — the
+VERDICT round-5 finding this subsystem answers.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+def _cc_events():
+    """{event: value} of compile_cache_events_total."""
+    from horovod_tpu.metrics import instruments as ins
+
+    fam = ins.REGISTRY.snapshot().get("compile_cache_events_total")
+    out = {"request": 0.0, "hit": 0.0}
+    for s in (fam or {"series": []})["series"]:
+        out[s["labels"]["event"]] = s["value"]
+    return out
+
+
+class TestPersistentCompileCache:
+    def test_config_reads_env(self, monkeypatch):
+        from horovod_tpu.common.config import Config
+
+        monkeypatch.setenv("HOROVOD_COMPILE_CACHE_DIR", "/tmp/hvd-cc-test")
+        assert Config.from_env().compile_cache_dir == "/tmp/hvd-cc-test"
+        monkeypatch.delenv("HOROVOD_COMPILE_CACHE_DIR")
+        assert Config.from_env().compile_cache_dir == ""
+
+    def test_recompile_after_cache_clear_is_all_hits(self, hvd, tmp_path):
+        """Arm the cache, compile a distinctively-shaped program, drop
+        every in-process program cache (what an elastic reset does), and
+        re-dispatch: every compile request must be served from the
+        persistent cache — zero fresh XLA compiles."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import collective_ops as co
+
+        basics._setup_compile_cache(str(tmp_path))
+        try:
+            x = jnp.full((hvd.size(), 13), 3.25, jnp.float32)
+            np.asarray(hvd.allreduce(x, op=hvd.Sum))   # compiles + writes
+            co.clear_program_caches()                  # the restart analog
+            before = _cc_events()
+            np.testing.assert_allclose(
+                np.asarray(hvd.allreduce(x, op=hvd.Sum)),
+                np.full((hvd.size(), 13), 3.25 * hvd.size(), np.float32),
+                rtol=1e-6)
+            after = _cc_events()
+            requests = after["request"] - before["request"]
+            hits = after["hit"] - before["hit"]
+            assert requests > 0, "no compile went through the cache layer"
+            assert requests == hits, (
+                f"{requests - hits:.0f} fresh XLA compile(s) on the "
+                f"post-clear pass — the persistent cache missed")
+        finally:
+            jax.config.update("jax_compilation_cache_dir", None)
+            from jax._src import compilation_cache as _cc
+            _cc.reset_cache()
+
+    @pytest.mark.slow
+    def test_init_cycle_across_processes_zero_fresh_compiles(self, tmp_path):
+        """The acceptance cycle, with real process boundaries: a cold
+        init() -> collective -> shutdown() run populates the cache; a
+        SECOND interpreter doing the same performs zero fresh XLA
+        compiles (every request is a hit). Two subprocesses so no
+        in-process jit cache can mask a miss."""
+        code = (
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "os.environ['XLA_FLAGS'] = "
+            "'--xla_force_host_platform_device_count=8'\n"
+            "import numpy as np\n"
+            "import jax.numpy as jnp\n"
+            "import horovod_tpu as hvd\n"
+            "hvd.init()\n"
+            "x = jnp.ones((hvd.size(), 11), jnp.float32)\n"
+            "np.asarray(hvd.allreduce(x, op=hvd.Sum))\n"
+            "from horovod_tpu.metrics import instruments as ins\n"
+            "fam = ins.REGISTRY.snapshot()['compile_cache_events_total']\n"
+            "ev = {s['labels']['event']: s['value'] "
+            "for s in fam['series']}\n"
+            "hvd.shutdown()\n"
+            "print('CCSTATS', int(ev.get('request', 0)), "
+            "int(ev.get('hit', 0)))\n")
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        env["HOROVOD_COMPILE_CACHE_DIR"] = str(tmp_path)
+
+        def run():
+            r = subprocess.run([sys.executable, "-c", code],
+                               capture_output=True, text=True, timeout=240,
+                               env=env)
+            assert r.returncode == 0, r.stderr[-2000:]
+            line = [ln for ln in r.stdout.splitlines()
+                    if ln.startswith("CCSTATS")][0]
+            _, requests, hits = line.split()
+            return int(requests), int(hits)
+
+        req1, hit1 = run()       # cold: populates the cache
+        assert req1 > 0
+        req2, hit2 = run()       # warm restart: all hits
+        assert req2 > 0
+        assert req2 == hit2, (
+            f"second pass performed {req2 - hit2} fresh XLA compile(s) "
+            f"with HOROVOD_COMPILE_CACHE_DIR set (requests={req2}, "
+            f"hits={hit2})")

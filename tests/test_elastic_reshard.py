@@ -1,0 +1,106 @@
+"""Elastic re-partitioning of SHARDED optimizer state (ZeRO-1 / FSDP).
+
+ADVICE round-5: `gather_to_host` / `zero_reshard` / `fsdp_reshard` are the
+membership-change story for sharded state (elastic/sharded.py) — they must
+be exported from `horovod_tpu.elastic` and a sharded state must round-trip
+through a mesh resize without losing moments.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+from jax.sharding import Mesh
+
+from horovod_tpu.common.topology import HVD_AXIS
+
+
+def _submesh(k):
+    return Mesh(np.array(jax.devices()[:k]), (HVD_AXIS,))
+
+
+class TestElasticExports:
+    def test_sharded_helpers_exported(self):
+        from horovod_tpu import elastic
+
+        for name in ("gather_to_host", "zero_reshard", "fsdp_reshard"):
+            assert callable(getattr(elastic, name)), name
+
+
+class TestZeroReshardResize:
+    @pytest.mark.parametrize("n_old,n_new", [(8, 4), (4, 8)])
+    def test_round_trip_through_resized_mesh(self, hvd, n_old, n_new):
+        """Build a ZeRO-1 state on an n_old-chip mesh, gather it to host,
+        re-partition for an n_new-chip mesh, and run one training step on
+        the new mesh: the moment vectors must carry the SAME logical
+        values re-padded to the new shard grid, and the resized step must
+        be numerically identical to a fresh-state step whose moments were
+        seeded with those values."""
+        from horovod_tpu import elastic
+        from horovod_tpu.parallel import ZeroTrainState, make_zero_train_step
+
+        mesh_old, mesh_new = _submesh(n_old), _submesh(n_new)
+        params = {"w": jnp.arange(12.0, dtype=jnp.float32).reshape(3, 4),
+                  "b": jnp.ones((5,), jnp.float32)}
+        tx = optax.adam(0.1)
+        state = ZeroTrainState.create(params, tx, mesh_old)
+
+        def loss_fn(p, batch):
+            return jnp.sum(p["w"] * batch["x"][:3, :4]) + jnp.sum(p["b"])
+
+        batch = {"x": jnp.ones((n_old, 4), jnp.float32)}
+        step = make_zero_train_step(loss_fn, tx, mesh_old, donate=False)
+        state, _ = step(state, batch)
+
+        host = elastic.gather_to_host(state)
+        resized = elastic.zero_reshard(host, mesh_new)
+
+        flat, _ = jax.flatten_util.ravel_pytree(host.params)
+        logical = flat.size
+        shard_len_new = (logical + (-logical) % n_new) // n_new
+        moments = [leaf for leaf in
+                   jax.tree_util.tree_leaves(resized.opt_state)
+                   if getattr(leaf, "ndim", 0) >= 1
+                   and leaf.size >= logical]
+        old_moments = [leaf for leaf in
+                       jax.tree_util.tree_leaves(host.opt_state)
+                       if getattr(leaf, "ndim", 0) >= 1
+                       and leaf.size >= logical]
+        assert moments and len(moments) == len(old_moments)
+        for new_m, old_m in zip(moments, old_moments):
+            # Re-padded to the new shard grid...
+            assert new_m.shape == (n_new * shard_len_new,)
+            # ...with the logical prefix preserved and the pad zeroed.
+            np.testing.assert_allclose(
+                np.asarray(new_m)[:logical],
+                np.asarray(old_m).reshape(-1)[:logical], rtol=1e-6)
+            assert not np.asarray(new_m)[logical:].any()
+
+        # The resized state must actually train on the new mesh.
+        step_new = make_zero_train_step(loss_fn, tx, mesh_new, donate=False)
+        batch_new = {"x": jnp.ones((n_new, 4), jnp.float32)}
+        stepped, loss = step_new(resized, batch_new)
+        assert np.isfinite(float(loss))
+        # And identically to a state rebuilt from the same host values —
+        # resharding is a layout change, not a value change.
+        rebuilt = ZeroTrainState.create(host.params, tx, mesh_new)
+        rebuilt = rebuilt.replace(step=resized.step,
+                                  opt_state=jax.tree_util.tree_map(
+                                      jnp.asarray, resized.opt_state))
+        stepped_ref, _ = step_new(rebuilt, batch_new)
+        jax.tree_util.tree_map(
+            lambda a, b: np.testing.assert_allclose(
+                np.asarray(a), np.asarray(b), rtol=1e-6),
+            stepped.params, stepped_ref.params)
+
+
+class TestFsdpReshardResize:
+    def test_replaces_placement_on_resized_mesh(self, hvd):
+        from horovod_tpu import elastic
+
+        tree = {"w": np.arange(32.0, dtype=np.float32).reshape(16, 2),
+                "tiny": np.ones((3,), np.float32)}
+        placed = elastic.fsdp_reshard(tree, _submesh(4), min_size=8)
+        np.testing.assert_allclose(np.asarray(placed["w"]), tree["w"])
+        np.testing.assert_allclose(np.asarray(placed["tiny"]), tree["tiny"])

@@ -16,6 +16,7 @@ import time
 import jax
 import jax.numpy as jnp
 import numpy as np
+import pytest
 from jax.sharding import PartitionSpec as P
 
 N_PARAMS = 100
@@ -102,17 +103,23 @@ class TestEagerFusionCacheGuards:
         with rt.cycle_paused():
             submit()  # cold: compiles the fused program(s)
             progs_after_cold = fusion._fused_program.cache_info()
+            plans_after_cold = len(fusion._flush_plans)
             stats_cold = rt.cache_stats()
 
             submit()  # steady state: same signatures
             progs_after_warm = fusion._fused_program.cache_info()
+            plans_after_warm = len(fusion._flush_plans)
             stats_warm = rt.cache_stats()
 
         # No new fused programs were compiled on the warm pass...
         assert progs_after_warm.misses == progs_after_cold.misses, \
             "steady-state step recompiled its fused program"
-        # ...and the program cache was actually consulted.
-        assert progs_after_warm.hits > progs_after_cold.hits
+        # ...and the warm pass was served from the flush-plan cache (the
+        # steady-state signatures were registered cold and reused, not
+        # re-added).
+        assert plans_after_cold > 0
+        assert plans_after_warm == plans_after_cold, \
+            "steady-state flush re-registered its flush plan"
         if stats_cold is not None and stats_warm is not None:
             assert stats_warm["hits"] > stats_cold["hits"], \
                 f"response cache not hit in steady state: {stats_warm}"
@@ -169,6 +176,112 @@ class TestEagerFusionCacheGuards:
             f"{new_programs} fused programs for 50 identical tensors"
 
 
+def _counter_total(name, label=None):
+    """Sum of a registry counter family's series (optionally filtered to
+    series whose labels contain ``label`` as a (k, v) item)."""
+    from horovod_tpu.metrics import instruments as ins
+
+    fam = ins.REGISTRY.snapshot().get(name)
+    if fam is None:
+        return 0.0
+    total = 0.0
+    for s in fam["series"]:
+        if label is None or label[1] == s["labels"].get(label[0]):
+            total += s["value"]
+    return total
+
+
+class TestDispatchPlanGuards:
+    """The dispatch-plan cache is the eager hot path's steady state: one
+    tuple-key hit, zero new compiled programs, zero control-plane RPCs
+    (the response-cache discipline of the reference, response_cache.h:45,
+    applied to the whole python dispatch)."""
+
+    def test_steady_state_is_plan_hits_no_compiles_no_kv(self, hvd):
+        from horovod_tpu.ops import collective_ops as co
+
+        x = jnp.ones((hvd.size(), 16), jnp.float32) * 3
+        np.asarray(hvd.allreduce(x, op=hvd.Sum))     # registers the plan
+        stats0 = co.plan_cache_stats()
+        prog0 = co._allreduce_program.cache_info()
+        kv0 = _counter_total("fusion_kv_rpcs_total")
+        hits0 = _counter_total("dispatch_plan_events_total",
+                               ("event", "hit"))
+        out = None
+        for _ in range(10):
+            out = hvd.allreduce(x, op=hvd.Sum)
+        np.asarray(out)
+        stats1 = co.plan_cache_stats()
+        assert stats1["hits"] >= stats0["hits"] + 10, \
+            f"steady state missed the plan cache: {stats0} -> {stats1}"
+        assert stats1["misses"] == stats0["misses"]
+        # Zero new compiled programs entered the program cache...
+        assert co._allreduce_program.cache_info().misses == prog0.misses
+        # ...zero coordination-service KV RPCs were issued...
+        assert _counter_total("fusion_kv_rpcs_total") == kv0
+        # ...and the hit counters are exported through the registry.
+        assert _counter_total("dispatch_plan_events_total",
+                              ("event", "hit")) >= hits0 + 10
+
+    def test_plan_cache_invalidated_by_clear_program_caches(self, hvd):
+        """clear_program_caches() — the invalidation hook the elastic
+        reset path calls via basics._clear_backends_and_program_caches —
+        must fully drop the plan cache; the next dispatch re-registers."""
+        from horovod_tpu.ops import collective_ops as co
+
+        x = jnp.ones((hvd.size(), 4), jnp.float32)
+        np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        assert co.plan_cache_stats()["size"] > 0
+        inval0 = co.plan_cache_stats()["invalidations"]
+        co.clear_program_caches()
+        stats = co.plan_cache_stats()
+        assert stats["size"] == 0
+        assert stats["invalidations"] == inval0 + 1
+        # Re-registration works after invalidation: miss, then hit.
+        np.testing.assert_allclose(
+            np.asarray(hvd.allreduce(x, op=hvd.Sum)),
+            np.full((hvd.size(), 4), hvd.size(), np.float32))
+        misses_after = co.plan_cache_stats()["misses"]
+        hits_before = co.plan_cache_stats()["hits"]
+        np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        assert co.plan_cache_stats()["misses"] == misses_after
+        assert co.plan_cache_stats()["hits"] == hits_before + 1
+
+    def test_plan_cache_invalidated_by_elastic_membership_change(self):
+        """An elastic membership change tears the backend down through
+        basics.teardown_distributed, which must leave zero live dispatch
+        plans (a stale hit would dispatch into a dead XLA client). Run in
+        a subprocess: the teardown destroys the session's backends."""
+        import subprocess
+        import sys
+
+        code = (
+            "import os\n"
+            "os.environ['JAX_PLATFORMS'] = 'cpu'\n"
+            "os.environ['XLA_FLAGS'] = "
+            "'--xla_force_host_platform_device_count=8'\n"
+            "import numpy as np\n"
+            "import jax.numpy as jnp\n"
+            "import horovod_tpu as hvd\n"
+            "from horovod_tpu.common import basics\n"
+            "from horovod_tpu.ops import collective_ops as co\n"
+            "hvd.init()\n"
+            "x = jnp.ones((hvd.size(), 4), jnp.float32)\n"
+            "np.asarray(hvd.allreduce(x, op=hvd.Sum))\n"
+            "assert co.plan_cache_stats()['size'] > 0\n"
+            "basics.teardown_distributed()\n"
+            "assert co.plan_cache_stats()['size'] == 0, "
+            "co.plan_cache_stats()\n"
+            "print('PLANS_CLEARED')\n")
+        env = {k: v for k, v in os.environ.items()
+               if k != "PALLAS_AXON_POOL_IPS"}
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=240,
+                           env=env)
+        assert r.returncode == 0, r.stderr[-2000:]
+        assert "PLANS_CLEARED" in r.stdout
+
+
 def _measure_host_overhead(hvd, iters=150, burst=50):
     """Host-path cost of the eager runtime (VERDICT r4 item 4; SURVEY §7
     names the bucketing runtime as where most perf risk sits — the
@@ -176,8 +289,12 @@ def _measure_host_overhead(hvd, iters=150, burst=50):
     operations.cc:747-853).
 
     - ``eager_us``: median wall time of one small eager allreduce
-      (dispatch + program-cache lookup + device roundtrip on the CPU
-      tier).
+      (dispatch + plan-cache hit + device roundtrip on the CPU tier),
+      taken as the best of 3 blocks of ``iters/3`` calls — the same
+      best-window protocol as the async leg: on the 2-core CI hosts an
+      ambient scheduler stall inflates a whole window by multiple ms,
+      and the guard exists to catch HOST-PATH regressions, not noisy
+      neighbors.
     - ``async_us_per_tensor``: hook-enqueue -> handle resolution through
       the fusion runtime, amortized over a ``burst``-tensor flush (best
       of 3 bursts — the gradient-hook steady state).
@@ -187,12 +304,16 @@ def _measure_host_overhead(hvd, iters=150, burst=50):
     n_rows = hvd.size()
     x = jnp.ones((n_rows, 8), jnp.float32)
     np.asarray(hvd.allreduce(x, op=hvd.Sum))         # warm compile
-    ts = []
-    for _ in range(iters):
-        t0 = time.perf_counter()
-        jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))
-        ts.append(time.perf_counter() - t0)
-    eager_us = sorted(ts)[len(ts) // 2] * 1e6
+    block_medians = []
+    block = max(iters // 3, 1)
+    for _ in range(3):
+        ts = []
+        for _ in range(block):
+            t0 = time.perf_counter()
+            jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))
+            ts.append(time.perf_counter() - t0)
+        block_medians.append(sorted(ts)[len(ts) // 2])
+    eager_us = min(block_medians) * 1e6
 
     rt = fusion.get_runtime()
     rt.flush_all()
@@ -211,19 +332,37 @@ def _measure_host_overhead(hvd, iters=150, burst=50):
 
 
 class TestHostOverheadBudget:
-    def test_eager_and_async_overhead_within_budget(self, hvd):
+    @pytest.mark.parametrize("metrics_on", [True, False],
+                             ids=["metrics1", "metrics0"])
+    def test_eager_and_async_overhead_within_budget(self, hvd, metrics_on):
         """The committed baseline (docs/host_overhead_baseline.json) is
         the budget: fail at 2x — the eager path growing a host-side
         stall (lock contention, per-call recompile, KV chatter) is the
-        regression this catches. Regenerate the baseline on a hardware
-        change with HVD_UPDATE_PERF_BASELINE=1."""
-        got = _measure_host_overhead(hvd)
+        regression this catches. Runs under BOTH HOROVOD_METRICS settings
+        so the disabled-observability short-circuit branch of the
+        dispatch plan is guarded too. Regenerate the baseline on a
+        hardware change with HVD_UPDATE_PERF_BASELINE=1 (the metrics-on
+        run writes it — that is the default production config)."""
+        from horovod_tpu.metrics import instruments as ins
+
+        prev = ins.enabled()
+        ins.set_enabled(metrics_on)
+        try:
+            got = _measure_host_overhead(hvd)
+        finally:
+            ins.set_enabled(prev)
         if os.environ.get("HVD_UPDATE_PERF_BASELINE") == "1":
+            if not metrics_on:
+                return  # the default-config (metrics-on) run writes it
             with open(_BASELINE, "w") as f:
                 json.dump({**got, "note":
-                           "CPU-tier 8-device mesh; median eager call / "
-                           "best-of-3 50-tensor async burst; guard fails "
-                           "at 2x (test_perf_guards.py)"}, f, indent=1)
+                           "CPU-tier 8-device mesh; eager = best block "
+                           "median of 3x50 calls, async = best-of-3 "
+                           "50-tensor bursts; guard fails at 2x "
+                           "(test_perf_guards.py). Single regen run — "
+                           "consider committing a max over several runs "
+                           "on noisy hosts (see the PR-3 baseline's "
+                           "provenance note)."}, f, indent=1)
             return
         if not os.path.exists(_BASELINE):
             # ADVICE.md round-5: silently regenerating here turned a
