@@ -46,6 +46,7 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
     poll, synchronize, Handle, broadcast_object, allgather_object,
 )
 from horovod_tpu import callbacks  # noqa: F401
+from horovod_tpu import chaos  # noqa: F401
 from horovod_tpu.runner.api import run, run_elastic  # noqa: F401
 from horovod_tpu import checkpoint  # noqa: F401
 from horovod_tpu import elastic  # noqa: F401

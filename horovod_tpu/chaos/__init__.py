@@ -1,0 +1,42 @@
+"""Chaos: deterministic fault injection + the elastic-recovery soak harness.
+
+Production collective stacks treat failure as the steady state — fault
+handling, not raw bandwidth, dominates engineering cost at scale (arxiv
+2510.20171) — yet recovery code is the least-exercised code in most
+frameworks. This subsystem makes every failure mode a reproducible,
+CPU-runnable experiment:
+
+- :mod:`horovod_tpu.chaos.plan` — declarative, seeded fault plans
+  (kind × site × trigger), parsed from YAML/JSON or the
+  ``HOROVOD_CHAOS_PLAN`` / ``HOROVOD_CHAOS_SEED`` env
+  (``hvdrun --chaos-plan`` propagates them).
+- :mod:`horovod_tpu.chaos.injector` — the process-local runtime behind the
+  named injection sites wired through the KV client, negotiation, eager
+  dispatch, fusion flush, elastic commit/rendezvous, and the elastic
+  driver's discovery loop. Disabled-by-default: each site is one bool
+  check. Every firing counts into ``chaos_injections_total{site,kind}``
+  and appends to a per-rank JSONL ledger.
+- :mod:`horovod_tpu.chaos.soak` — drives a multi-process elastic training
+  run through a scheduled failure plan and asserts the recovery
+  invariants: target step reached, loss parity with a clean run, resets
+  within the kill budget, ledger equal across same-seed re-runs.
+
+See docs/robustness.md for the plan format, the sites catalogue, and the
+soak runbook.
+"""
+
+from horovod_tpu.chaos.plan import (  # noqa: F401
+    KINDS, SITES, ChaosPlan, FaultSpec,
+)
+from horovod_tpu.chaos.injector import (  # noqa: F401
+    filter_hosts, fire, install, install_from_env, ledger_path,
+    ledger_schedule, plan, read_ledger, set_role, set_step, stats,
+    uninstall,
+)
+from horovod_tpu.chaos import injector  # noqa: F401
+
+
+def armed():
+    """Whether a plan is armed in this process (live view — the module
+    attribute ``injector.armed`` is the hot-path gate)."""
+    return injector.armed

@@ -1,0 +1,282 @@
+"""Elastic-recovery soak harness.
+
+Drives a multi-process elastic training run through a seeded fault plan
+(worker kill + KV drop + collective straggler by default) and asserts the
+recovery invariants the elastic stack promises:
+
+1. the run reaches the target step despite the injected failures,
+2. the final weights match a clean (chaos-free) run within tolerance —
+   the training contribution is world-size invariant (an ``Average`` of
+   identical per-rank terms), so a correct restore/re-rendezvous sequence
+   is loss-neutral by construction,
+3. elastic resets stay within the plan's kill budget (no flapping),
+4. every recovering worker populated the ``elastic_recovery_seconds``
+   histogram, and
+5. re-running the same plan + seed produces an identical injection-ledger
+   schedule (the determinism contract of :mod:`horovod_tpu.chaos.plan`).
+
+Progress streams through the same JSONL channel as ``bench.py``
+(``HVD_BENCH_PROGRESS_FILE``), so a wedged soak still leaves parseable
+evidence of how far it got. CLI wrapper: ``scripts/chaos_soak.py``;
+runbook: docs/robustness.md.
+"""
+
+import contextlib
+import json
+import os
+import time
+
+_PROGRESS_PATH = os.environ.get("HVD_BENCH_PROGRESS_FILE",
+                                "bench_progress.jsonl")
+_T0 = time.perf_counter()
+
+
+def _progress(phase, **extra):
+    """One bench-channel JSONL record (same shape as bench.py's)."""
+    if not _PROGRESS_PATH:
+        return
+    try:
+        rec = {"ts": round(time.time(), 3),
+               "elapsed_s": round(time.perf_counter() - _T0, 3),
+               "model": "chaos_soak", "phase": phase}
+        rec.update(extra)
+        with open(_PROGRESS_PATH, "a") as f:
+            f.write(json.dumps(rec) + "\n")
+    except OSError:
+        pass                      # evidence must never fail the soak
+
+
+def soak_train(total_steps):
+    """The per-worker training loop (importable by name — spawned workers
+    resolve it from the installed package). World-size-invariant updates:
+    each step adds ``Average(step + 1)`` of identical per-rank
+    contributions, so the final weights are independent of membership
+    changes — any deviation from the clean run is a recovery bug, not a
+    modeling artifact."""
+    import os
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu import elastic
+
+    hvd.init()
+    state = elastic.TpuState(trees={"w": jnp.zeros((4,))},
+                             step=0, worlds=[])
+    elastic.attach_listener(state)
+
+    @elastic.run
+    def loop(state):
+        while state.step < total_steps:
+            contrib = jnp.ones((1, 4)) * float(state.step + 1)
+            g = hvd.allreduce(contrib, op=hvd.Average)
+            state.w = state.w + g[0]
+            state.step += 1
+            state.worlds.append(hvd.process_count())
+            state.commit()
+        snap = hvd.metrics_snapshot()
+
+        def _count(name, labels=None):
+            total = 0
+            for s in snap.get(name, {}).get("series", ()):
+                if labels is None or all(
+                        s["labels"].get(k) == v for k, v in labels.items()):
+                    total += s.get("count", s.get("value", 0))
+            return total
+
+        return {
+            "steps": state.step,
+            "w": np.asarray(state.w).tolist(),
+            "worlds": list(state.worlds),
+            "final_world": hvd.process_count(),
+            "cross_rank": hvd.cross_rank(),
+            "pid": os.getpid(),
+            "resets": _count("elastic_events_total", {"event": "reset"}),
+            "recoveries": _count("elastic_recovery_seconds"),
+            "kv_retries": _count("kv_client_retries_total"),
+            "injections": _count("chaos_injections_total"),
+        }
+
+    return loop(state)
+
+
+def default_plan(procs=8, seed=123, kill_rank=None, kill_step=3,
+                 straggler_rank=2, drop_step=None):
+    """The acceptance plan: one hard worker kill at a step boundary, one
+    KV-RPC drop per rank at a later step (absorbed by the client's retry),
+    and a collective-dispatch straggler on one rank. All triggers are
+    step-keyed, so the ledger schedule is re-run deterministic."""
+    if kill_rank is None:
+        # A mid-fleet rank for real fleets; never rank 0 on tiny worlds
+        # (killing the coordination-service host is legal but makes the
+        # small validation runs needlessly noisy).
+        kill_rank = procs - 3 if procs > 3 else procs - 1
+    drop_step = kill_step + 3 if drop_step is None else drop_step
+    return {
+        "seed": seed,
+        "note": f"soak: kill r{kill_rank}@s{kill_step}, kv drop @s"
+                f"{drop_step}, straggler r{straggler_rank}",
+        "faults": [
+            {"site": "elastic.commit", "kind": "crash", "rank": kill_rank,
+             "at_step": [kill_step], "max_fires": 1},
+            {"site": "http_kv.request", "kind": "drop",
+             "at_step": [drop_step]},
+            {"site": "collective.dispatch", "kind": "delay",
+             "delay_ms": 30, "rank": straggler_rank,
+             "at_step": [1, drop_step]},
+        ],
+    }
+
+
+def plan_kill_budget(plan_dict):
+    """Total process-fatal firings the plan allows (crash + host_remove
+    budgets; an unbounded fatal spec counts as its trigger-list length)."""
+    budget = 0
+    for f in plan_dict.get("faults", ()):
+        if f.get("kind") in ("crash", "hang", "host_remove"):
+            budget += f.get("max_fires") or \
+                len(f.get("at_step") or f.get("at") or (1,))
+    return budget
+
+
+@contextlib.contextmanager
+def _scoped_env(overrides):
+    saved = {k: os.environ.get(k) for k in overrides}
+    try:
+        for k, v in overrides.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = str(v)
+        yield
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def _write_discovery(path, procs):
+    """N distinct loopback 'hosts' (127.0.0.0/8 is local to WorkerProcess),
+    one slot each."""
+    hosts = ["localhost"] + [f"127.0.0.{i}" for i in range(2, procs + 1)]
+    with open(path, "w") as f:
+        f.write("#!/bin/sh\n")
+        for h in hosts:
+            f.write(f"echo {h}:1\n")
+    os.chmod(path, 0o755)
+    return hosts
+
+
+def _elastic_run(steps, procs, min_np, workdir, chaos_env):
+    from horovod_tpu.runner import run_elastic
+
+    script = os.path.join(workdir, "discover.sh")
+    _write_discovery(script, procs)
+    env = {
+        # The killed host must STAY out (determinism: exactly one shrink,
+        # no timing-dependent re-add mid-run).
+        "HOROVOD_BLACKLIST_COOLDOWN_RANGE": "600,600",
+        # Fast failure detection keeps the soak's wall clock bounded.
+        "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT": "5",
+    }
+    env.update(chaos_env)
+    with _scoped_env(env):
+        return run_elastic(soak_train, args=(steps,), min_np=min_np,
+                           host_discovery_script=script)
+
+
+def run_soak(procs=8, steps=8, seed=123, workdir=None, plan_dict=None,
+             loss_tol=1e-5, reruns=1):
+    """Run clean + chaos (+ ``reruns`` same-seed repeats), assert the
+    invariants, and return the evidence dict. Raises AssertionError with
+    the failing invariant."""
+    import tempfile
+    workdir = workdir or tempfile.mkdtemp(prefix="hvd_chaos_soak_")
+    os.makedirs(workdir, exist_ok=True)
+    plan_dict = plan_dict or default_plan(procs=procs, seed=seed)
+    plan_dict["seed"] = seed
+    plan_path = os.path.join(workdir, "plan.yaml")
+    with open(plan_path, "w") as f:
+        json.dump(plan_dict, f)    # JSON is valid YAML
+    budget = plan_kill_budget(plan_dict)
+    evidence = {"procs": procs, "steps": steps, "seed": seed,
+                "plan": plan_dict, "kill_budget": budget,
+                "workdir": workdir}
+
+    min_np = max(procs - budget, 1)
+
+    try:
+        return _run_soak_inner(procs, steps, seed, workdir, plan_dict,
+                               plan_path, budget, min_np, loss_tol,
+                               reruns, evidence)
+    finally:
+        # The elastic DRIVER runs in this process and armed the plan from
+        # the scoped env — the caller (a pytest process, a notebook) must
+        # not inherit a live injector.
+        from horovod_tpu import chaos
+        chaos.uninstall()
+
+
+def _run_soak_inner(procs, steps, seed, workdir, plan_dict, plan_path,
+                    budget, min_np, loss_tol, reruns, evidence):
+    _progress("soak clean run start", procs=procs, steps=steps)
+    clean = _elastic_run(steps, procs, min_np, workdir, {})
+    _progress("soak clean run done", hosts=len(clean))
+    assert all(r["steps"] == steps for r in clean), \
+        f"clean run fell short of {steps} steps: {clean}"
+    clean_w = clean[0]["w"]
+    evidence["clean_w"] = clean_w
+
+    schedules = []
+    for attempt in range(1 + reruns):
+        ledger_dir = os.path.join(workdir, f"ledger_{attempt}")
+        _progress("soak chaos run start", attempt=attempt)
+        results = _elastic_run(steps, procs, min_np, workdir, {
+            "HOROVOD_CHAOS_PLAN": plan_path,
+            "HOROVOD_CHAOS_SEED": str(seed),
+            "HOROVOD_CHAOS_LEDGER": ledger_dir,
+        })
+        from horovod_tpu.chaos import injector
+        entries = injector.read_ledger(ledger_dir)
+        schedules.append(injector.ledger_schedule(entries))
+        _progress("soak chaos run done", attempt=attempt,
+                  hosts=len(results), injections=len(entries))
+        if attempt == 0:
+            evidence["chaos_results"] = results
+            evidence["ledger"] = entries
+            # (1) the run survived to the target step
+            assert all(r["steps"] == steps for r in results), \
+                f"chaos run fell short of {steps} steps: {results}"
+            # (2) loss/weight parity with the clean run
+            import numpy as np
+            np.testing.assert_allclose(
+                [r["w"] for r in results],
+                [clean_w] * len(results), atol=loss_tol,
+                err_msg="recovery was not loss-neutral vs the clean run")
+            # (3) resets within the kill budget, and the membership
+            # actually shrank by the killed workers
+            for r in results:
+                assert r["resets"] <= budget, \
+                    f"worker r{r['cross_rank']} reset {r['resets']}x " \
+                    f"(> kill budget {budget}): flapping recovery"
+            assert all(r["final_world"] == procs - budget
+                       for r in results), results
+            # (4) recovering workers populated the recovery histogram
+            recovered = [r for r in results if r["resets"]]
+            assert recovered and all(r["recoveries"] >= 1
+                                     for r in recovered), \
+                f"elastic_recovery_seconds not populated: {results}"
+            # the injected kill actually fired (exactly once)
+            kills = [e for e in entries if e["kind"] == "crash"]
+            assert len(kills) == budget, entries
+    # (5) same seed ⇒ identical ledger schedule
+    for i, sched in enumerate(schedules[1:], 1):
+        assert sched == schedules[0], (
+            f"ledger schedule diverged between same-seed runs 0 and {i}:\n"
+            f"{schedules[0]}\nvs\n{sched}")
+    evidence["ledger_deterministic"] = len(schedules) > 1
+    _progress("soak done", ok=True)
+    return evidence

@@ -83,10 +83,35 @@ def init(comm=None, process_sets=None, devices=None):
             return
         config = Config.from_env()
 
+        # Chaos fault injection (HOROVOD_CHAOS_PLAN): armed before any
+        # control-plane traffic so the whole init/rendezvous path is
+        # injectable. Idempotent across elastic in-place re-inits — site
+        # counters and the injection ledger survive shutdown()/init()
+        # cycles within one process (a mid-plan reset would re-fire
+        # already-spent faults).
+        if config.chaos_plan:
+            from horovod_tpu.chaos import injector as _chaos_injector
+            _chaos_injector.install_from_env()
+
         # Decide on distributed bootstrap from the env alone: probing
         # jax.process_count() here would initialize the local backend and
         # forbid jax.distributed.initialize afterwards.
         if config.coordinator_addr and config.cross_size > 1:
+            # Multi-process CPU tier (tests, soaks, dry runs): jax 0.4.x
+            # ships cross-process CPU collectives behind an explicit gloo
+            # selection — without it every multi-device program dies with
+            # "Multiprocess computations aren't implemented on the CPU
+            # backend". Newer jax defaults to gloo and drops the knob
+            # (AttributeError), hence the probe.
+            plat = (os.environ.get("JAX_PLATFORMS") or "").lower()
+            if plat.startswith("cpu"):
+                global _gloo_selected_by_init
+                try:
+                    jax.config.update(
+                        "jax_cpu_collectives_implementation", "gloo")
+                    _gloo_selected_by_init = True
+                except AttributeError:
+                    pass
             target = f"{config.coordinator_addr}:{config.coordinator_port}"
             replace = False
             if _distributed_client_active():
@@ -121,23 +146,23 @@ def init(comm=None, process_sets=None, devices=None):
                         "clearing pre-initialized XLA backends before "
                         "distributed bootstrap")
                     _clear_backends_and_program_caches()
-                kwargs = {}
                 if os.environ.get("HOROVOD_ELASTIC"):
                     # Elastic membership: a peer dying must surface as a
                     # recoverable collective error in survivors, not a
                     # process-fatal coordination abort, and failure
                     # detection should beat the default 100 s heartbeat
                     # (reference: NCCL comms marked elastic abort instead
-                    # of hanging, nccl_operations.h:55).
-                    jax.config.update("jax_enable_recoverability", True)
+                    # of hanging, nccl_operations.h:55). Version-dependent
+                    # plumbing lives in _elastic_distributed_initialize.
                     hb = int(os.environ.get(
                         "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", "10"))
-                    kwargs = dict(heartbeat_timeout_seconds=hb,
-                                  shutdown_timeout_seconds=hb)
-                jax.distributed.initialize(
-                    coordinator_address=target,
-                    num_processes=config.cross_size,
-                    process_id=config.cross_rank, **kwargs)
+                    _elastic_distributed_initialize(
+                        target, config.cross_size, config.cross_rank, hb)
+                else:
+                    jax.distributed.initialize(
+                        coordinator_address=target,
+                        num_processes=config.cross_size,
+                        process_id=config.cross_rank)
                 # Fresh coordination service: empty KV store, epoch 0 for
                 # every participant (incl. replacement elastic workers).
                 from horovod_tpu.common import negotiation
@@ -189,6 +214,125 @@ def init(comm=None, process_sets=None, devices=None):
         atexit.register(shutdown)
 
 
+# True while a jax-0.4.x compat elastic client (no shutdown barrier on
+# teardown — see _elastic_distributed_initialize) is connected.
+_elastic_compat_client = False
+
+# True when init's CPU-tier probe selected gloo collectives itself (vs a
+# user-configured jax_cpu_collectives_implementation, which teardown must
+# not clobber).
+_gloo_selected_by_init = False
+
+# Superseded compat coordination services and clients, kept alive on
+# purpose: see teardown_distributed. Shutting a service down kills every
+# still-connected peer, and DESTROYING a connected client races its own
+# error-polling thread against the destructor — the poll fails with
+# "Socket closed" and the hardwired fatal callback terminates the process.
+# Neither object is ever destroyed mid-run; compat worker processes end
+# with os._exit (runner/task.py) so interpreter finalization cannot run
+# the destructors either.
+_leaked_compat_services = []
+_leaked_compat_clients = []
+
+# Every coordinator port a compat membership has ever used in this process.
+# Leaked clients keep live gRPC connections to leaked services on the OLD
+# ports; the membership watchdog's data-plane abort (common/sockets.py) must
+# treat those as control plane — severing one fires the leaked client's
+# hardwired fatal callback.
+_compat_coordinator_ports = set()
+
+
+def compat_coordinator_ports():
+    """All coordinator-service ports this process has connected to across
+    compat elastic memberships (current + leaked)."""
+    return set(_compat_coordinator_ports)
+
+
+def elastic_compat_leaks():
+    """True when this process holds leaked jax-0.4.x compat distributed
+    objects whose destructors must never run (exit via os._exit)."""
+    return bool(_leaked_compat_services or _leaked_compat_clients)
+
+
+def _elastic_distributed_initialize(target, num_processes, process_id, hb):
+    """Bootstrap ``jax.distributed`` with ELASTIC failure semantics: a peer
+    dying must become a recoverable error in survivors — never a
+    process-fatal abort.
+
+    Newer jax exposes exactly that via ``jax_enable_recoverability`` +
+    heartbeat/shutdown kwargs on the public ``initialize``. jax 0.4.x has
+    neither, and its coordination-service defaults are all-process
+    fate-sharing: the service declares a silent task dead after the
+    heartbeat window and PROPAGATES a fatal error to every connected
+    client, whose hardwired callback terminates the process (xla
+    distributed client.h) — survivors never outlive a crash, and during a
+    staggered elastic teardown even HEALTHY peers kill each other (the
+    first client to drop out looks dead to the service, which then fatals
+    the stragglers; a Python callback override is not viable — invoking
+    it from the C++ polling thread dies in argument marshalling). So on
+    0.4.x the compat bootstrap inverts the contract:
+
+    - the service gets an effectively-infinite miss window — liveness is
+      OUR elastic driver's job, and the data plane already surfaces peer
+      death instantly (gloo connection reset → HorovodInternalError);
+    - teardown never runs the client shutdown barrier (a dead peer can
+      never join it; failing it propagates a job-fatal error) — the
+      client reference is dropped instead, see teardown_distributed;
+    - if the COORDINATOR process itself dies, surviving workers still hit
+      the default fatal callback (~100 s heartbeat window) and the driver
+      respawns the job from the last commit — elastic-by-restart instead
+      of in-place, the documented old-jax degradation."""
+    import inspect
+
+    global _elastic_compat_client
+    try:
+        jax.config.update("jax_enable_recoverability", True)
+    except AttributeError:
+        pass                       # pre-recoverability jax: compat below
+    accepted = inspect.signature(jax.distributed.initialize).parameters
+    if "heartbeat_timeout_seconds" in accepted:
+        jax.distributed.initialize(
+            coordinator_address=target, num_processes=num_processes,
+            process_id=process_id, heartbeat_timeout_seconds=hb,
+            shutdown_timeout_seconds=hb)
+        return
+    from jax._src import distributed as _dist
+    from jax._src.lib import xla_extension as _xe
+    state = _dist.global_state
+    if process_id == 0:
+        if state.service is not None:
+            raise RuntimeError("distributed service already running; "
+                               "teardown_distributed() first")
+        bind = "[::]:" + target.rsplit(":", 1)[1]
+        state.service = _xe.get_distributed_runtime_service(
+            bind, num_processes, heartbeat_interval=10,
+            max_missing_heartbeats=1_000_000)
+    if state.client is not None:
+        raise RuntimeError("distributed client already connected; "
+                           "teardown_distributed() first")
+    # Bounded connect: if the new world cannot assemble (e.g. its
+    # coordinator is itself wedged), the client's connect failure fires
+    # the hardwired fatal callback and this process dies — better to
+    # surface that within the recovery budget than after 300 s of limbo.
+    # shutdown_timeout bounds ONLY the clean-finish shutdown barrier
+    # (runner/task.py _orderly_distributed_exit); the failure-recovery
+    # teardown never runs it. Generous: ranks reach the barrier staggered
+    # by their result uploads, and a timeout here degrades a clean exit
+    # into the abrupt-disconnect race.
+    state.client = _xe.get_distributed_runtime_client(
+        target, process_id, init_timeout=max(60, 10 * hb),
+        shutdown_timeout=max(6 * hb, 30),
+        shutdown_on_destruction=False, use_compression=True)
+    state.client.connect()
+    state.num_processes = num_processes
+    state.process_id = process_id
+    state.coordinator_address = target
+    _elastic_compat_client = True
+    _compat_coordinator_ports.add(int(target.rsplit(":", 1)[1]))
+    hvd_logging.info("compat elastic client connected to %s (world %d)",
+                     target, num_processes)
+
+
 def _setup_compile_cache(path):
     """Arm JAX's persistent compilation cache at ``path``
     (``HOROVOD_COMPILE_CACHE_DIR``).
@@ -229,6 +373,14 @@ def _clear_backends_and_program_caches():
     clear_backends()
     from horovod_tpu.ops import collective_ops
     collective_ops.clear_program_caches()
+    # The old CPU client must actually DIE here, not linger until an
+    # arbitrary later GC: it owns the gloo contexts, and its destruction
+    # is what closes their TCP connections — peers blocked on us in a
+    # collective unblock on that close. Reference-cycle stragglers
+    # (exception tracebacks through jit frames are the usual holders)
+    # otherwise keep the sockets open indefinitely.
+    import gc
+    gc.collect()
 
 
 def teardown_distributed():
@@ -241,17 +393,75 @@ def teardown_distributed():
     shrinking to world size 1 rebuilds a backend that still believes in its
     dead peers. Used by elastic in-place re-initialization
     (horovod_tpu/elastic/state.py _reset)."""
+    global _elastic_compat_client
+    if _elastic_compat_client:
+        # jax-0.4.x elastic client: NEVER run the shutdown barrier — with
+        # a dead peer it can only time out, and the coordination service
+        # then propagates the failure as a job-fatal error to every peer
+        # still connected (killing healthy survivors mid-recovery). But
+        # the client must not be DESTROYED either: its destructor races
+        # its own error-polling thread, which sees the dying channel as
+        # "Socket closed" and fires the hardwired fatal callback. And
+        # the OLD service (this process = old rank 0) must not be shut
+        # down while peers' old clients still poll it — same callback,
+        # every peer at once. So both are LEAKED alive: the old client
+        # keeps polling the old (leaked, healthy) service until process
+        # exit, which must be os._exit for compat workers
+        # (runner/task.py) so finalization can't run the destructors.
+        # Cost: a few threads + a port per membership change; the next
+        # membership's service lives on a fresh driver-assigned port.
+        try:
+            from jax._src import distributed as _dist
+            if _dist.global_state.client is not None:
+                _leaked_compat_clients.append(_dist.global_state.client)
+                _dist.global_state.client = None
+            if _dist.global_state.service is not None:
+                _leaked_compat_services.append(_dist.global_state.service)
+                _dist.global_state.service = None
+        except Exception:  # pragma: no cover
+            pass
+        _elastic_compat_client = False
     try:
         jax.distributed.shutdown()
     except Exception as e:  # old cluster half-dead: proceed with teardown
         hvd_logging.warning("jax.distributed shutdown: %s", e)
     try:
         from jax._src import distributed as _dist
+        # A failed shutdown barrier (elastic: a DEAD peer can never join
+        # it) raises out of State.shutdown BEFORE it nulls client/service;
+        # the next initialize would then refuse with "should only be
+        # called once". Finish the dismantling by hand — the compat client
+        # is created with shutdown_on_destruction=False, so dropping the
+        # reference cannot re-trigger the barrier.
+        if _dist.global_state.client is not None:
+            _dist.global_state.client = None
+        if _dist.global_state.service is not None:
+            try:
+                _dist.global_state.service.shutdown()
+            except Exception:  # noqa: BLE001 — stopping a dead service
+                pass
+            _dist.global_state.service = None
+        _dist.global_state.preemption_sync_manager = None
         _dist.global_state.process_id = 0
         _dist.global_state.num_processes = 1
         _dist.global_state.coordinator_address = None
     except Exception as e:  # pragma: no cover
         hvd_logging.warning("distributed state reset: %s", e)
+    # Undo the multi-process CPU gloo selection (jax 0.4.x): a backend
+    # rebuilt at world size 1 has no distributed client, and the gloo
+    # factory requires one — a worker shrinking to a single-process world
+    # would otherwise fail its re-init inside make_cpu_client. Only undone
+    # when init itself made the selection: a user-configured
+    # JAX_CPU_COLLECTIVES_IMPLEMENTATION (e.g. with JAX_PLATFORMS unset,
+    # which init's probe would not re-select on the next cycle) is theirs
+    # to keep.
+    global _gloo_selected_by_init
+    if _gloo_selected_by_init:
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "none")
+        except AttributeError:
+            pass
+        _gloo_selected_by_init = False
     _clear_backends_and_program_caches()
 
 

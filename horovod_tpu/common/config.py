@@ -132,6 +132,26 @@ class Config:
     # a perf metric too. See docs/performance.md.
     compile_cache_dir: str = ""
 
+    # --- control-plane resilience (runner/http_kv.py KVStoreClient) ---
+    # A single transient connection reset mid-negotiation used to kill the
+    # caller; the client now retries transient transport faults (URLError,
+    # connection reset, HTTP 5xx) this many times with jittered exponential
+    # backoff before surfacing the error. 404s and other 4xx are semantic
+    # answers, never retried.
+    kv_retries: int = 3
+    kv_retry_backoff_ms: float = 50.0
+    kv_retry_backoff_max_ms: float = 2000.0
+
+    # --- chaos / fault injection (horovod_tpu/chaos; docs/robustness.md).
+    # A seeded declarative fault plan: path to a YAML/JSON file or inline
+    # text. "" = disarmed (every injection site is a single bool check).
+    chaos_plan: str = ""
+    # Seed overriding the plan's own (probabilistic triggers are a
+    # counter-hash of seed x spec x call count — reproducible schedules).
+    chaos_seed: int = 0
+    # Directory for the per-rank JSONL injection ledgers.
+    chaos_ledger: str = ""
+
     # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
     # the reference's observability stops at timeline + stall inspector).
     # Always-on by default: the registry hot path is O(1) and lock-light
@@ -231,6 +251,15 @@ class Config:
             and c.donate_buffers
         c.compile_cache_dir = os.environ.get("HOROVOD_COMPILE_CACHE_DIR",
                                              c.compile_cache_dir)
+        c.kv_retries = _env_int("HOROVOD_KV_RETRIES", c.kv_retries)
+        c.kv_retry_backoff_ms = _env_float("HOROVOD_KV_RETRY_BACKOFF_MS",
+                                           c.kv_retry_backoff_ms)
+        c.kv_retry_backoff_max_ms = _env_float(
+            "HOROVOD_KV_RETRY_BACKOFF_MAX_MS", c.kv_retry_backoff_max_ms)
+        c.chaos_plan = os.environ.get("HOROVOD_CHAOS_PLAN", c.chaos_plan)
+        c.chaos_seed = _env_int("HOROVOD_CHAOS_SEED", c.chaos_seed)
+        c.chaos_ledger = os.environ.get("HOROVOD_CHAOS_LEDGER",
+                                        c.chaos_ledger)
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
         c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
         c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",

@@ -23,6 +23,7 @@ import threading
 
 import jax
 
+from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.metrics import instruments as _metrics
 
 _counters = {}
@@ -148,6 +149,10 @@ def exchange(tag, payload, procs=None):
     proc_tag = ",".join(str(p) for p in procs)
     seq = _next_seq((tag, proc_tag))
     client = _client()
+    if _chaos.armed:
+        # Chaos site: a delay here stalls this rank's publish, making every
+        # peer's blocking get wait — the control-plane straggler mode.
+        _chaos.fire("negotiation.exchange")
     base = f"hvd/neg/e{_epoch}/{tag}/{proc_tag}/{seq}"
     blob = json.dumps(payload)
     with _lock:

@@ -19,9 +19,11 @@ the mesh from the new host set.
 """
 
 import copy
+import time
 
 import jax.numpy as jnp
 
+from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.common.exceptions import (HorovodInternalError,
@@ -58,6 +60,11 @@ class State:
     def commit(self):
         """Commit (save) + check for host changes (reference: elastic.py:54)."""
         self.save()
+        if _chaos.armed:
+            # Chaos site: the step boundary — where a worker crash/hang is
+            # injected (the committed step also advances the plan's step
+            # clock, so KV/dispatch faults can be step-keyed).
+            _chaos.fire("elastic.commit", step=getattr(self, "step", None))
         self.check_host_updates()
 
     def save(self):
@@ -233,12 +240,21 @@ def run(func):
     """
 
     def wrapper(state, *args, **kwargs):
-        from horovod_tpu.elastic.worker import (configured_version,
+        from horovod_tpu.elastic.worker import (arm_collective_abort,
+                                                configured_version,
+                                                disarm_collective_abort,
                                                 mark_new_rank_ready,
                                                 read_new_rank_ready,
                                                 wait_for_version_change)
         reset_required = False
         skip_sync = False
+        # (cause, monotonic detection time) of the oldest unrecovered
+        # failure: observed into elastic_recovery_seconds when training
+        # re-enters — the detection → first-post-restore-step latency the
+        # soak harness (and capacity planning) cares about. Not reset by
+        # a second interrupt landing mid-recovery: the user-visible outage
+        # runs from the FIRST detection.
+        recovering = None
         while True:
             known_version = configured_version()
             try:
@@ -258,8 +274,24 @@ def run(func):
                     state.sync()
                 skip_sync = False
                 known_version = configured_version()
-                return func(state, *args, **kwargs)
+                if recovering is not None:
+                    _metrics.record_elastic_recovery(
+                        recovering[0], time.monotonic() - recovering[1])
+                    recovering = None
+                # Membership watchdog: while the user function runs, a
+                # published removal severs in-flight collectives so EVERY
+                # rank (not just the dead peer's gloo neighbors) fails
+                # fast into the except arms below. Disarmed on unwind —
+                # the recovery path's fresh rendezvous sockets must not
+                # be severed by a stale observation.
+                arm_collective_abort(known_version)
+                try:
+                    return func(state, *args, **kwargs)
+                finally:
+                    disarm_collective_abort()
             except HorovodInternalError:
+                if recovering is None:
+                    recovering = ("failure", time.monotonic())
                 _metrics.record_elastic_event("restore")
                 hvd_logging.warning(
                     "collective failure; restoring last committed state")
@@ -272,6 +304,8 @@ def run(func):
                 wait_for_version_change(known_version)
                 reset_required = True
             except HostsUpdatedInterrupt as e:
+                if recovering is None:
+                    recovering = ("host_update", time.monotonic())
                 _metrics.record_elastic_event("host_update")
                 hvd_logging.info("host set updated; re-initializing")
                 reset_required = True
@@ -316,6 +350,20 @@ def run(func):
         if consumed_version is None:
             hvd_logging.info(
                 "host removed from membership; exiting cleanly")
+            # Orderly disconnect before dying: letting interpreter
+            # finalization destroy the jax.distributed client (and, on a
+            # coordinator, the service with peers still attached) can
+            # fire the hardwired fatal callback on us or on survivors.
+            basics.teardown_distributed()
+            if basics.elastic_compat_leaks():
+                # Leaked jax-0.4.x compat objects: interpreter
+                # finalization would run their destructors and race their
+                # polling threads (see runner/task.py _compat_exit) —
+                # die without finalizing.
+                import sys
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(0)
             raise SystemExit(0)
         if os.environ.get("HOROVOD_ELASTIC") and \
                 basics._distributed_client_active():

@@ -7,7 +7,10 @@ run_elastic_driver) — same contract, simpler transport.
 """
 
 import os
+import threading
 
+from horovod_tpu.chaos import injector as _chaos
+from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.metrics import instruments as _metrics
 from horovod_tpu.runner.http_kv import KVStoreClient
 
@@ -102,6 +105,11 @@ def mark_new_rank_ready():
     client = _kv_client()
     if client is None or not os.environ.get("HOROVOD_ELASTIC"):
         return
+    if _chaos.armed:
+        # Chaos site: a delay here holds this worker's ready mark back, so
+        # the whole membership sits at the scale-up barrier — the
+        # slow-to-rejoin-host mode.
+        _chaos.fire("elastic.rendezvous")
     version = _configured_version(client)
     cross_rank = os.environ.get("HOROVOD_CROSS_RANK", "0")
     _metrics.record_elastic_event("rank_ready")
@@ -226,6 +234,105 @@ def refresh_assignment_env():
         "HOROVOD_ELASTIC_INIT_VERSION": version,
     })
     return version
+
+
+# --- membership watchdog: the push-notification analog -------------------
+#
+# Reference: Horovod's WorkerNotificationService PUSHES HostsUpdated to every
+# worker, and the gloo context is aborted so in-flight collectives raise on
+# ALL ranks at once. Our KV polling covers the notification half, but
+# without the abort half only the dead rank's direct gloo neighbors detect a
+# failure (connection reset); every other rank blocks on live-but-stuck
+# peers for XLA's ~30-minute collective timeout, the detectors then time out
+# waiting for a new world that can never assemble, and the job wedges. The
+# watchdog restores the abort half: while the main thread is inside the
+# training function, a published membership version that REMOVED a host
+# severs this process's data-plane sockets (common/sockets.py), failing the
+# blocked collective immediately — it surfaces as the HorovodInternalError
+# the @elastic.run recovery loop already handles, on every rank in parallel.
+
+_WATCH_INTERVAL = 0.5
+
+_watch_lock = threading.Lock()
+_watch_thread = None
+_armed_version = None          # membership version the training run is at
+_last_abort_version = 0        # never abort the same bump twice
+
+
+def arm_collective_abort(version):
+    """Enable the watchdog while training runs at membership ``version``.
+    Called by the ``@elastic.run`` wrapper just before entering the user
+    function; no-op outside elastic launches."""
+    global _watch_thread, _armed_version
+    if not (os.environ.get("HOROVOD_ELASTIC")
+            and os.environ.get("HOROVOD_KV_ADDR")):
+        return
+    with _watch_lock:
+        _armed_version = int(version)
+        if _watch_thread is None or not _watch_thread.is_alive():
+            _watch_thread = threading.Thread(
+                target=_watch_loop, name="hvd-membership-watchdog",
+                daemon=True)
+            _watch_thread.start()
+
+
+def disarm_collective_abort():
+    """Disable the watchdog (training unwound into the recovery path —
+    teardown/re-init sockets must not be severed mid-rendezvous)."""
+    global _armed_version
+    with _watch_lock:
+        _armed_version = None
+
+
+def _removed_since(client, armed, current):
+    """Whether any membership bump in (armed, current] removed a host.
+    Additions leave in-flight collectives completable — they are picked up
+    at the next commit boundary without an abort. A missing row (driver
+    GC'd it: this worker lags 2+ versions) means the in-flight op is
+    doomed regardless — treat as removal."""
+    for v in range(int(armed) + 1, int(current) + 1):
+        if client.get("elastic", f"removed/{v}") != b"0":
+            return True
+    return False
+
+
+def _watch_loop():
+    global _last_abort_version
+    client = _kv_client()
+    if client is None:
+        return
+    while True:
+        import time
+        time.sleep(_WATCH_INTERVAL)
+        with _watch_lock:
+            armed = _armed_version
+        if armed is None:
+            continue
+        try:
+            current = int(client.get("elastic", "version") or b"0")
+            if current <= armed or current <= _last_abort_version:
+                continue
+            if not _removed_since(client, armed, current):
+                continue
+        except Exception:  # noqa: BLE001 — transient KV error: retry
+            continue
+        with _watch_lock:
+            # Re-check under the lock: while we were reading the KV (gets
+            # can take seconds under retry backoff), the main thread may
+            # have unwound into recovery (disarm) — or completed it and
+            # RE-ARMED at the very version we observed, in which case the
+            # observation is stale and an abort would sever the brand-new
+            # membership's sockets, forcing a spurious second recovery.
+            if (_armed_version is None or current <= _armed_version
+                    or current <= _last_abort_version):
+                continue
+            _last_abort_version = current
+        from horovod_tpu.common import sockets
+        hvd_logging.warning(
+            "membership v%d removed a host while training at v%s: "
+            "aborting in-flight collectives", current, armed)
+        _metrics.record_elastic_event("abort")
+        sockets.abort_data_plane_sockets(sockets.control_plane_ports())
 
 
 def attach_listener(state):

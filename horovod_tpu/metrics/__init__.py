@@ -25,9 +25,10 @@ from horovod_tpu.metrics.instruments import (  # noqa: F401
     REGISTRY, enabled, set_enabled, set_prefix, get_registry,
     emit_timeline_counters, install_compile_cache_listener,
     maybe_emit_timeline_counters,
-    record_boundary, record_collective, record_collective_error,
-    record_collective_latency, record_compile_cache, record_elastic_event,
-    record_fusion_flush, record_fusion_kv, record_http_kv,
+    record_boundary, record_chaos, record_collective,
+    record_collective_error, record_collective_latency,
+    record_compile_cache, record_elastic_event, record_elastic_recovery,
+    record_fusion_flush, record_fusion_kv, record_http_kv, record_kv_retry,
     record_negotiation, record_plan_cache, record_stall,
 )
 from horovod_tpu.metrics.server import (  # noqa: F401

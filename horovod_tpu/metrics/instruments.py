@@ -26,7 +26,11 @@ Catalogue (names shown without the ``HOROVOD_METRICS_PREFIX``, default
 - ``control_plane_rpcs_total{transport,kind}``      every KV RPC (counter)
 - ``control_plane_payload_bytes_total{transport}``  KV payload bytes (counter)
 - ``elastic_events_total{event}``                   rendezvous/reset/... (counter)
+- ``elastic_recovery_seconds{cause}``               failure detection →
+  training re-entry (histogram; cause=failure|host_update)
 - ``stall_events_total{kind}``                      warning|shutdown (counter)
+- ``kv_client_retries_total``                       HTTP-KV client retries (counter)
+- ``chaos_injections_total{site,kind}``             chaos faults fired (counter)
 """
 
 import os
@@ -138,12 +142,28 @@ CONTROL_PLANE_PAYLOAD = REGISTRY.counter(
 ELASTIC_EVENTS = REGISTRY.counter(
     "elastic_events_total",
     "Elastic lifecycle events: rank_ready, rendezvous, reset, restore, "
-    "host_update, sync.",
+    "host_update, sync, abort (watchdog severed in-flight collectives).",
     ("event",))
+ELASTIC_RECOVERY = REGISTRY.histogram(
+    "elastic_recovery_seconds",
+    "Elastic recovery latency: failure detection (HorovodInternalError / "
+    "HostsUpdatedInterrupt caught by the @elastic.run wrapper) to re-entry "
+    "into the training function at the new membership "
+    "(cause=failure|host_update).",
+    ("cause",), buckets=exponential_buckets(0.01, 2.0, 16))  # 10ms..~5min
 STALL_EVENTS = REGISTRY.counter(
     "stall_events_total",
     "Stall-inspector findings (kind=warning|shutdown).",
     ("kind",))
+KV_CLIENT_RETRIES = REGISTRY.counter(
+    "kv_client_retries_total",
+    "Runner HTTP-KV client attempts that failed transiently and were "
+    "retried (bounded, jittered exponential backoff — HOROVOD_KV_RETRIES).")
+CHAOS_INJECTIONS = REGISTRY.counter(
+    "chaos_injections_total",
+    "Faults fired by the chaos injection runtime (horovod_tpu/chaos; "
+    "always zero unless a HOROVOD_CHAOS_PLAN is armed).",
+    ("site", "kind"))
 
 
 # --- recording helpers (the stack's API) --------------------------------
@@ -262,6 +282,28 @@ def record_elastic_event(event):
     if not _enabled:
         return
     ELASTIC_EVENTS.labels(event).inc()
+
+
+def record_elastic_recovery(cause, seconds):
+    """One completed elastic recovery: detection → training re-entry."""
+    if not _enabled:
+        return
+    ELASTIC_RECOVERY.labels(cause).observe(seconds)
+
+
+def record_kv_retry():
+    if not _enabled:
+        return
+    KV_CLIENT_RETRIES.inc()
+
+
+def record_chaos(site, kind):
+    """One chaos fault fired. Recorded even though injections are test
+    machinery: the counter is how a soak (or an operator reading a scrape)
+    correlates observed symptoms with injected causes."""
+    if not _enabled:
+        return
+    CHAOS_INJECTIONS.labels(site, kind).inc()
 
 
 def record_stall(kind):

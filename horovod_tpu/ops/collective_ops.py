@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.common.exceptions import TensorShapeMismatchError
 from horovod_tpu.common.process_sets import global_process_set
@@ -169,6 +170,10 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None,
     from horovod_tpu.metrics import instruments as hvd_metrics
     if op_label is None:
         op_label = op_kind.lower()
+    if _chaos.armed:
+        # Chaos site: a delay here holds THIS rank's enqueue back while its
+        # peers dispatch — the straggler mode of the SPMD contract.
+        _chaos.fire("collective.dispatch")
     # Gated HERE, not just inside the helpers: the nbytes sum is
     # O(n_tensors) and must cost nothing under HOROVOD_METRICS=0.
     metrics_on = hvd_metrics.enabled()
@@ -732,6 +737,8 @@ class _DispatchPlan:
 
     def dispatch(self, staged, name=None, prog=None):
         from horovod_tpu.metrics import instruments as hvd_metrics
+        if _chaos.armed:
+            _chaos.fire("collective.dispatch")
         if prog is None:
             # Slow-path registration call: staged buffers are fresh
             # _prepare outputs, safe to donate under the opt-in.

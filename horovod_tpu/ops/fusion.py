@@ -31,6 +31,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.common.topology import HVD_AXIS
 from horovod_tpu.ops.collective_ops import (ReduceOp, _localize, _prepare,
@@ -771,6 +772,11 @@ class FusionRuntime:
         coordinator flushed when it published that boundary."""
         if not self._pending:
             return
+        if _chaos.armed:
+            # Chaos site: a delay here stalls the flush UNDER the runtime
+            # lock — every gradient-hook enqueue blocks behind it, the
+            # fusion-flush stall mode.
+            _chaos.fire("fusion.flush")
         if up_to is None:
             pending, self._pending = self._pending, []
             flushed_bytes, self._pending_bytes = self._pending_bytes, 0

@@ -51,6 +51,9 @@ _ARG_ENV_MAP = [
      lambda v: "1" if v else None),
     ("blacklist_cooldown_range", "HOROVOD_BLACKLIST_COOLDOWN_RANGE",
      lambda v: f"{v[0]},{v[1]}"),
+    ("chaos_plan", "HOROVOD_CHAOS_PLAN", str),
+    ("chaos_seed", "HOROVOD_CHAOS_SEED", str),
+    ("chaos_ledger", "HOROVOD_CHAOS_LEDGER", str),
 ]
 
 

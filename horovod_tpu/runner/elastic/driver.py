@@ -25,6 +25,7 @@ exactly like the reference's ``HostUpdateResult.removed`` →
 import threading
 import time
 
+from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.runner.elastic.discovery import HostManager
 from horovod_tpu.runner.hosts import HostInfo, get_host_assignments
@@ -49,6 +50,7 @@ class ElasticDriver:
         self._assignment = []          # list[SlotInfo]
         self._last_hosts = None        # last discovered {host: slots}
         self._host_order = []          # rank-ordered hostnames
+        self._disrupted = {}           # version -> membership shrank/changed
         self._version = 0
         self._reset_count = 0
         self._shutdown = threading.Event()
@@ -89,6 +91,12 @@ class ElasticDriver:
         while not self._shutdown.is_set():
             try:
                 hosts = self._host_manager.current_hosts()
+                if _chaos.armed:
+                    # Chaos site: host_remove specs drop a victim from the
+                    # discovered set for their window — simulated host
+                    # preemption, recovered through the exact reassignment
+                    # path a real removal takes.
+                    hosts = _chaos.filter_hosts("driver.discovery", hosts)
                 self._maybe_update(hosts)
             except Exception as e:  # discovery script hiccup: keep going
                 if self._shutdown.is_set():
@@ -114,6 +122,17 @@ class ElasticDriver:
     def update_host_assignments(self, hosts):
         """Recompute SlotInfos, preserving the rank order of surviving hosts
         so their state stays rank-stable (reference: driver.py:240-283)."""
+        # Membership DISRUPTION (vs the previous membership): a host left,
+        # or an existing host's slot count changed (its worker is
+        # terminated + respawned either way). Survivors' in-flight
+        # collectives can then never complete, and the worker-side
+        # watchdog must abort them; a pure addition leaves them
+        # completable. Decided against the last membership, NOT the live
+        # worker table — a crashed worker is reaped from that table before
+        # the respawn runs, which would mask its own removal.
+        prev = self._last_hosts or {}
+        disrupted = any(h not in hosts or hosts[h] != prev[h]
+                        for h in prev)
         self._last_hosts = dict(hosts)
         with self._assignment_cv:
             surviving = [h for h in self._host_order if h in hosts]
@@ -128,6 +147,8 @@ class ElasticDriver:
             self._assignment = assignment
             self._version += 1
             version = self._version
+            self._disrupted[version] = disrupted
+            self._disrupted.pop(version - 3, None)
             self._assignment_cv.notify_all()
         hvd_logging.info("new assignment v%d over hosts %s", version, order)
         self._reset_count += 1
@@ -141,6 +162,15 @@ class ElasticDriver:
     def assignment(self):
         with self._assignment_cv:
             return list(self._assignment), self._version
+
+    def version_disrupted(self, version):
+        """Whether ``version``'s membership change disrupted the previous
+        one (host removed / slot count changed) — i.e. whether in-flight
+        collectives of earlier memberships must be aborted. Unknown
+        (GC'd) versions count as disruptive: a worker that stale is
+        wedged by construction."""
+        with self._assignment_cv:
+            return self._disrupted.get(version, True)
 
     def wait_for_assignment_change(self, known_version, timeout=None):
         with self._assignment_cv:
@@ -198,6 +228,11 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
     # flags like --blacklist-cooldown-range would silently stay defaulted.
     from horovod_tpu.runner.config_parser import set_env_from_args
     set_env_from_args(_os.environ, args)
+    # Arm the driver-side chaos plan (host_remove rides the discovery
+    # loop); workers arm their own copy from the propagated env at init.
+    from horovod_tpu import chaos as _chaos_api
+    _chaos_api.set_role("driver")
+    _chaos_api.install_from_env()
     kv = KVStoreServer()
     kv_port = kv.start()
     for (scope, key), value in (kv_preload or {}).items():
@@ -316,6 +351,14 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
             else b"removal"
         kv.put("elastic", f"update_kind/{version}", kind)
         kv.delete("elastic", f"update_kind/{version - 2}")
+        # Disruption marker for the worker-side membership watchdog
+        # (elastic/worker.py): a version whose membership change makes
+        # in-flight collectives uncompletable (host removed / resized)
+        # must ABORT them on every survivor; a pure addition leaves them
+        # completable and is picked up at the next commit boundary.
+        kv.put("elastic", f"removed/{version}",
+               b"1" if driver.version_disrupted(version) else b"0")
+        kv.delete("elastic", f"removed/{version - 2}")
         kv.put("elastic", "nhosts", str(len(by_host)).encode())
         kv.put("elastic", "version", str(version).encode())
         for host, slots in by_host.items():

@@ -12,11 +12,15 @@ verb/scope protocol.
 """
 
 import os
+import random
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib import error as urlerror
 from urllib import request as urlrequest
 
+from horovod_tpu.chaos import injector as _chaos
+from horovod_tpu.common.config import Config, _env_float, _env_int
 from horovod_tpu.metrics import instruments as _metrics
 from horovod_tpu.runner.secret import (SECRET_ENV, check_digest,
                                        compute_digest)
@@ -163,11 +167,25 @@ class KVStoreClient:
     """reference: http_client.py read_data_from_kvstore/put_data_into_kvstore,
     with per-job HMAC signing (network.py:306)."""
 
-    def __init__(self, addr, port, timeout=30, secret=None):
+    def __init__(self, addr, port, timeout=30, secret=None, retries=None,
+                 backoff_ms=None, backoff_max_ms=None):
         self._base = f"http://{addr}:{port}"
         self._timeout = timeout
         self._secret = secret if secret is not None \
             else os.environ.get(SECRET_ENV)
+        # Read the knobs directly from the env (not Config.from_env: the
+        # client is used before hvd.init, e.g. the task bootstrap probe);
+        # the Config dataclass defaults stay the single source of truth.
+        self._retries = retries if retries is not None \
+            else max(_env_int("HOROVOD_KV_RETRIES", Config.kv_retries), 0)
+        self._backoff_s = (backoff_ms if backoff_ms is not None
+                           else _env_float("HOROVOD_KV_RETRY_BACKOFF_MS",
+                                           Config.kv_retry_backoff_ms)
+                           ) / 1000.0
+        self._backoff_max_s = (
+            backoff_max_ms if backoff_max_ms is not None
+            else _env_float("HOROVOD_KV_RETRY_BACKOFF_MAX_MS",
+                            Config.kv_retry_backoff_max_ms)) / 1000.0
 
     def _request(self, method, path, body=None):
         req = urlrequest.Request(self._base + path, data=body, method=method)
@@ -176,12 +194,40 @@ class KVStoreClient:
                 self._secret, method.encode(), path.encode(), body or b""))
         return req
 
+    def _open(self, method, path, body=None):
+        """One KV RPC with bounded retry on TRANSIENT transport faults
+        (connection reset/refused mid-negotiation, HTTP 5xx) under
+        jittered exponential backoff. Safe because every KV verb is
+        idempotent (GET reads, PUT overwrites the same cell, DELETE of a
+        gone key is a no-op). 4xx — including the 404 that ``get``
+        interprets as "key absent" — are semantic answers and propagate
+        immediately. A single reset used to kill the caller outright;
+        now it costs one backoff sleep and a counter increment
+        (``kv_client_retries_total``)."""
+        delay = self._backoff_s
+        for attempt in range(self._retries + 1):
+            try:
+                # Chaos site: each ATTEMPT is one site call, so a plan
+                # dropping calls [0, 1] exercises exactly two retries.
+                if _chaos.armed:
+                    _chaos.fire("http_kv.request", url=self._base + path)
+                return urlrequest.urlopen(self._request(method, path, body),
+                                          timeout=self._timeout)
+            except urlerror.HTTPError as e:
+                if e.code < 500 or attempt == self._retries:
+                    raise
+            except (urlerror.URLError, ConnectionError, TimeoutError):
+                if attempt == self._retries:
+                    raise
+            _metrics.record_kv_retry()
+            time.sleep(delay * (0.5 + random.random()))
+            delay = min(delay * 2, self._backoff_max_s)
+
     def get(self, scope, key):
         path = f"/{scope}/{key}"
         _metrics.record_http_kv("get")
         try:
-            with urlrequest.urlopen(self._request("GET", path),
-                                    timeout=self._timeout) as r:
+            with self._open("GET", path) as r:
                 value = r.read()
                 if self._secret and not check_digest(
                         self._secret, r.headers.get(SIG_HEADER, ""),
@@ -201,14 +247,12 @@ class KVStoreClient:
 
     def put(self, scope, key, value: bytes):
         _metrics.record_http_kv("put", payload_bytes=len(value))
-        req = self._request("PUT", f"/{scope}/{key}", value)
-        with urlrequest.urlopen(req, timeout=self._timeout):
+        with self._open("PUT", f"/{scope}/{key}", value):
             pass
 
     def delete(self, scope, key="*"):
         _metrics.record_http_kv("delete")
-        req = self._request("DELETE", f"/{scope}/{key}")
-        with urlrequest.urlopen(req, timeout=self._timeout):
+        with self._open("DELETE", f"/{scope}/{key}"):
             pass
 
     def wait_for(self, scope, key, timeout=60, interval=0.1):

@@ -154,6 +154,21 @@ def parse_args(argv=None):
                             "dispatch (catches SPMD order divergence as an "
                             "error instead of a hang)")
 
+    chaos = p.add_argument_group("chaos")
+    chaos.add_argument("--chaos-plan", dest="chaos_plan",
+                       help="Fault-injection plan exported to every worker "
+                            "(HOROVOD_CHAOS_PLAN): a YAML/JSON file path "
+                            "(must be readable on the worker hosts) or "
+                            "inline YAML/JSON. See docs/robustness.md.")
+    chaos.add_argument("--chaos-seed", type=int, dest="chaos_seed",
+                       help="Seed overriding the plan's own "
+                            "(HOROVOD_CHAOS_SEED) — probabilistic triggers "
+                            "are a counter-hash of it, so a seed pins the "
+                            "whole injection schedule.")
+    chaos.add_argument("--chaos-ledger", dest="chaos_ledger",
+                       help="Directory for the per-rank JSONL injection "
+                            "ledgers (HOROVOD_CHAOS_LEDGER).")
+
     elastic = p.add_argument_group("elastic")
     elastic.add_argument("--min-np", "--min-num-proc", type=int,
                          dest="min_np")

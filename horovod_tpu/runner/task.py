@@ -94,6 +94,97 @@ def main():
             # would wait forever on this exited rank).
             client.put("elastic", "finished", b"1")
     hvd.shutdown()
+    _orderly_distributed_exit()
+
+
+def _orderly_distributed_exit():
+    """CLEAN-finish disconnect from the jax.distributed cluster: run the
+    real shutdown barrier, then dismantle local state.
+
+    The elastic FAILURE-recovery teardown must never run the barrier (a
+    dead peer can't join it — common/basics.py teardown_distributed drops
+    references instead), but a clean finish is the opposite case: every
+    rank is alive and exiting together, so the barrier completes, each
+    agent sends a proper ShutdownTask (stopping its heartbeat/error-poll
+    threads first), and nobody's teardown looks like a task death to the
+    coordination service. Skipping this and letting references (or
+    interpreter finalization) destroy clients abruptly races each
+    client's destructor against its own polling thread and the service's
+    stream-break detection — either race ends in the hardwired fatal
+    callback, turning a clean exit into a crash the driver then
+    blacklists."""
+    if not os.environ.get("HOROVOD_ELASTIC"):
+        return
+    from horovod_tpu.common import basics
+    if not basics._distributed_client_active():
+        # No live cluster, but a membership change may have left leaked
+        # compat objects (e.g. a survivor that shrank to a single-process
+        # world re-inits with no distributed client at all) — those still
+        # forbid interpreter finalization.
+        if basics.elastic_compat_leaks():
+            _compat_exit()
+        return
+    try:
+        from jax._src import distributed as _dist
+        client = _dist.global_state.client
+        if client is not None:
+            client.shutdown()
+    except Exception as e:  # peer died post-training: fall through
+        print(f"# distributed shutdown barrier failed (continuing): {e}",
+              file=sys.stderr)
+    basics.teardown_distributed()
+    if basics.elastic_compat_leaks():
+        _compat_exit()
+
+
+def _compat_exit():
+    """End a jax-0.4.x compat elastic worker with ``os._exit(0)``,
+    coordinator last.
+
+    The process holds LEAKED compat coordination clients/services
+    (common/basics.py): destroying a connected 0.4.x client races its own
+    error-polling thread, and a service dying while any peer's client
+    still polls it fires every poller's hardwired fatal callback. Normal
+    interpreter exit would run exactly those destructors during
+    finalization, so the only clean ending is ``os._exit`` — no atexit
+    (``hvd.shutdown()`` already ran), no GC, no finalizers. Ordering
+    matters too: every peer's leaked clients poll services hosted by the
+    rank-0 PROCESS (superseded memberships' services live where their
+    rank 0 ran — with in-place recovery that is the current rank 0; a
+    coordinator-host death recovers by full restart, not in place, so no
+    leaks cross it). Rank 0 therefore exits LAST: peers post an
+    exit-ready mark to the runner KV and die; rank 0 waits for the marks
+    plus a short grace, then dies, taking all leaked services with it
+    once nobody is left to poll them."""
+    import time
+    rank = int(os.environ.get("HOROVOD_CROSS_RANK", "0") or 0)
+    size = int(os.environ.get("HOROVOD_CROSS_SIZE", "1") or 1)
+    version = os.environ.get("HOROVOD_ELASTIC_INIT_VERSION", "0")
+    kv_addr = os.environ.get("HOROVOD_KV_ADDR")
+    kv_port = os.environ.get("HOROVOD_KV_PORT")
+    try:
+        if kv_addr and kv_port:
+            from horovod_tpu.runner.http_kv import KVStoreClient
+            client = KVStoreClient(kv_addr, int(kv_port))
+            if rank == 0:
+                want = {str(r) for r in range(1, size)}
+                deadline = time.monotonic() + 30
+                while want and time.monotonic() < deadline:
+                    want = {r for r in want if not client.get(
+                        "exit_ready", f"{version}/{r}")}
+                    if want:
+                        time.sleep(0.2)
+                # Grace: a peer posts its mark a few syscalls before its
+                # os._exit actually severs its leaked-client connections.
+                time.sleep(1.0)
+            else:
+                client.put("exit_ready", f"{version}/{rank}", b"1")
+    except Exception as e:  # KV gone: exit anyway, driver reaps us
+        print(f"# compat exit coordination failed (continuing): {e}",
+              file=sys.stderr)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    os._exit(0)
 
 
 if __name__ == "__main__":

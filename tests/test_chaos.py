@@ -1,0 +1,462 @@
+"""Chaos subsystem tier: plan parsing/seeding, injector runtime, per-site
+behavior, ledger/metrics evidence, KV-client retry resilience, and the
+driver-side host-removal fault. The fast single-process chaos smoke (KV
+drop + dispatch straggler) runs in tier-1; the full 8-process elastic soak
+is the ``slow``-marked acceptance leg in test_chaos_soak.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from horovod_tpu import chaos
+from horovod_tpu.chaos import ChaosPlan, FaultSpec, injector
+
+
+@pytest.fixture(autouse=True)
+def _chaos_hygiene(tmp_path, monkeypatch):
+    """Every test gets a private ledger dir and leaves the process
+    disarmed — a leaked plan would inject into the rest of the suite."""
+    monkeypatch.setenv("HOROVOD_CHAOS_LEDGER", str(tmp_path / "ledgers"))
+    yield
+    chaos.uninstall()
+
+
+def _plan(*faults, seed=0):
+    return ChaosPlan([FaultSpec(**f) for f in faults], seed=seed)
+
+
+class TestPlanParsing:
+    def test_yaml_round_trip_and_env(self, tmp_path, monkeypatch):
+        text = """
+seed: 42
+faults:
+  - {site: http_kv.request, kind: drop, at: [0, 1]}
+  - {site: elastic.commit, kind: crash, rank: 5, at_step: [3], max_fires: 1}
+  - {site: collective.dispatch, kind: delay, every: 7, delay_ms: 2}
+"""
+        p = ChaosPlan.from_yaml(text)
+        assert p.seed == 42 and len(p) == 3
+        assert p.faults[0].at == (0, 1)
+        assert p.faults[1].rank == 5 and p.faults[1].at_step == (3,)
+        # from_env: file path + seed override
+        f = tmp_path / "plan.yaml"
+        f.write_text(text)
+        monkeypatch.setenv("HOROVOD_CHAOS_PLAN", str(f))
+        monkeypatch.setenv("HOROVOD_CHAOS_SEED", "7")
+        p2 = ChaosPlan.from_env()
+        assert p2.seed == 7 and len(p2) == 3
+        # from_env: inline text (workers without a shared filesystem)
+        monkeypatch.setenv("HOROVOD_CHAOS_PLAN",
+                           '{"faults": [{"site": "fusion.flush", '
+                           '"kind": "delay", "at": [0]}]}')
+        p3 = ChaosPlan.from_env()
+        assert len(p3) == 1 and p3.faults[0].site == "fusion.flush"
+        # round trip through to_dict
+        p4 = ChaosPlan.from_dict(p.to_dict())
+        assert len(p4) == 3 and p4.faults[2].every == 7
+
+    def test_to_dict_keeps_meaningful_zeros(self):
+        """Serialization must not confuse rank/host_index 0 with 'unset' —
+        a rank-0-scoped crash that round-trips to rank=None would fire on
+        EVERY rank."""
+        p = ChaosPlan([
+            FaultSpec(site="elastic.commit", kind="crash", rank=0,
+                      at_step=[3]),
+            FaultSpec(site="driver.discovery", kind="host_remove",
+                      at=[2], host_index=0),
+        ], seed=1)
+        p2 = ChaosPlan.from_dict(p.to_dict())
+        assert p2.faults[0].rank == 0
+        assert p2.faults[1].host_index == 0
+
+    def test_no_plan_env_means_none(self, monkeypatch):
+        monkeypatch.delenv("HOROVOD_CHAOS_PLAN", raising=False)
+        assert ChaosPlan.from_env() is None
+
+    @pytest.mark.parametrize("bad", [
+        {"site": "nope.where", "kind": "delay", "at": [0]},
+        {"site": "http_kv.request", "kind": "explode", "at": [0]},
+        # kind-site mismatch: drop only models the KV transport
+        {"site": "collective.dispatch", "kind": "drop", "at": [0]},
+        {"site": "elastic.commit", "kind": "host_remove", "at": [0]},
+        # no trigger at all
+        {"site": "collective.dispatch", "kind": "delay"},
+        # p out of range
+        {"site": "collective.dispatch", "kind": "delay", "p": 1.5},
+        # host_remove without a victim
+        {"site": "driver.discovery", "kind": "host_remove", "at": [0]},
+    ])
+    def test_validation_rejects(self, bad):
+        with pytest.raises(ValueError):
+            FaultSpec(**bad)
+
+    def test_unknown_field_rejected(self):
+        with pytest.raises(ValueError, match="unknown chaos spec field"):
+            ChaosPlan.from_dict({"faults": [
+                {"site": "fusion.flush", "kind": "delay", "at": [0],
+                 "typo_field": 1}]})
+
+
+class TestTriggers:
+    def _fired(self, plan, site, calls):
+        chaos.install(plan)
+        try:
+            fired = []
+            for i in range(calls):
+                before = injector.stats()["fires"]
+                injector.fire(site)
+                if injector.stats()["fires"] != before:
+                    fired.append(i)
+            return fired
+        finally:
+            chaos.uninstall()
+
+    def test_at_every_after_and_budget(self):
+        site = "collective.dispatch"
+        d = {"site": site, "kind": "delay", "delay_ms": 0}
+        assert self._fired(_plan({**d, "at": [2, 5]}), site, 8) == [2, 5]
+        assert self._fired(_plan({**d, "every": 3}), site, 9) == [0, 3, 6]
+        assert self._fired(_plan({**d, "every": 3, "after": 4}),
+                           site, 12) == [6, 9]
+        assert self._fired(_plan({**d, "every": 2, "max_fires": 2}),
+                           site, 10) == [0, 2]
+
+    def test_rank_scoping(self, monkeypatch):
+        site = "collective.dispatch"
+        p = _plan({"site": site, "kind": "delay", "delay_ms": 0,
+                   "rank": 3, "at": [0]})
+        monkeypatch.setenv("HOROVOD_CROSS_RANK", "2")
+        assert self._fired(p, site, 2) == []
+        monkeypatch.setenv("HOROVOD_CROSS_RANK", "3")
+        assert self._fired(p, site, 2) == [0]
+
+    def test_probability_is_seed_deterministic(self):
+        site = "negotiation.exchange"
+        d = {"site": site, "kind": "delay", "delay_ms": 0, "p": 0.4}
+        a = self._fired(_plan(d, seed=11), site, 200)
+        b = self._fired(_plan(d, seed=11), site, 200)
+        c = self._fired(_plan(d, seed=12), site, 200)
+        assert a == b                      # same seed: same schedule
+        assert a != c                      # different seed: different one
+        assert 40 <= len(a) <= 120         # p=0.4 over 200 calls
+
+    def test_at_step_fires_once_per_step(self):
+        site = "http_kv.request"
+        chaos.install(_plan({"site": site, "kind": "delay", "delay_ms": 0,
+                             "at_step": [3, 5]}))
+        try:
+            # step clock unset: step-keyed specs never fire
+            injector.fire(site)
+            assert injector.stats()["fires"] == {}
+            chaos.set_step(3)
+            for _ in range(4):            # a step issues many KV calls...
+                injector.fire(site)
+            assert injector.stats()["fires"] == {0: 1}   # ...one injection
+            chaos.set_step(4)
+            injector.fire(site)
+            assert injector.stats()["fires"] == {0: 1}
+            chaos.set_step(5)
+            injector.fire(site)
+            assert injector.stats()["fires"] == {0: 2}
+        finally:
+            chaos.uninstall()
+
+
+class TestInjectorRuntime:
+    def test_ledger_contents_and_metrics(self, tmp_path, monkeypatch):
+        from horovod_tpu.metrics import instruments as ins
+
+        ledger_dir = str(tmp_path / "ledgers")
+        monkeypatch.setenv("HOROVOD_CHAOS_LEDGER", ledger_dir)
+        monkeypatch.setenv("HOROVOD_CROSS_RANK", "4")
+        before = ins.CHAOS_INJECTIONS.labels(
+            "collective.dispatch", "delay").get()
+        chaos.install(_plan({"site": "collective.dispatch", "kind": "delay",
+                             "delay_ms": 0, "at": [1]}))
+        try:
+            injector.fire("collective.dispatch")
+            injector.fire("collective.dispatch", step=9)
+            entries = chaos.read_ledger(ledger_dir)
+            assert len(entries) == 1
+            e = entries[0]
+            assert e["site"] == "collective.dispatch"
+            assert e["kind"] == "delay" and e["rank"] == 4
+            assert e["spec"] == 0 and e["fire"] == 0
+            assert e["n"] == 1 and e["step"] == 9 and "ts" in e
+            assert ins.CHAOS_INJECTIONS.labels(
+                "collective.dispatch", "delay").get() == before + 1
+            # the schedule projection strips the nondeterministic fields
+            sched = chaos.ledger_schedule(entries)
+            assert sched == [("worker", 4, "collective.dispatch", "delay",
+                              0, 0, 9, None)]
+        finally:
+            chaos.uninstall()
+
+    def test_install_from_env_is_idempotent(self, monkeypatch):
+        monkeypatch.setenv(
+            "HOROVOD_CHAOS_PLAN",
+            '{"faults": [{"site": "collective.dispatch", "kind": "delay", '
+            '"delay_ms": 0, "at": [0], "max_fires": 1}]}')
+        chaos.install_from_env()
+        assert injector.armed
+        injector.fire("collective.dispatch")
+        assert injector.stats()["fires"] == {0: 1}
+        # Re-install with the SAME env (an elastic in-place re-init calls
+        # hvd.init again): counters must survive — the spent fault stays
+        # spent.
+        chaos.install_from_env()
+        assert injector.stats()["fires"] == {0: 1}
+        # A CHANGED plan re-installs from scratch.
+        monkeypatch.setenv(
+            "HOROVOD_CHAOS_PLAN",
+            '{"faults": [{"site": "fusion.flush", "kind": "delay", '
+            '"delay_ms": 0, "at": [0]}]}')
+        chaos.install_from_env()
+        assert injector.stats()["fires"] == {}
+        # A CLEARED env disarms an env-installed plan: the operator's next
+        # chaos-free run must not inherit stale faults.
+        monkeypatch.delenv("HOROVOD_CHAOS_PLAN")
+        chaos.install_from_env()
+        assert injector.armed is False
+
+    def test_crash_is_a_hard_exit(self, tmp_path):
+        """crash = os._exit(exit_code): no cleanup, no atexit — verified in
+        a disposable subprocess."""
+        code = (
+            "import os\n"
+            f"os.environ['HOROVOD_CHAOS_LEDGER'] = {str(tmp_path)!r}\n"
+            "from horovod_tpu import chaos\n"
+            "from horovod_tpu.chaos import ChaosPlan, FaultSpec\n"
+            "chaos.install(ChaosPlan([FaultSpec(site='elastic.commit', "
+            "kind='crash', at=[0], exit_code=17)]))\n"
+            "chaos.injector.fire('elastic.commit')\n"
+            "print('UNREACHABLE')\n")
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 17
+        assert "UNREACHABLE" not in r.stdout
+        entries = chaos.read_ledger(str(tmp_path))
+        assert [e["kind"] for e in entries] == ["crash"]
+
+    def test_hang_sleeps(self):
+        import time
+        chaos.install(_plan({"site": "elastic.commit", "kind": "hang",
+                             "hang_s": 0.2, "at": [0]}))
+        try:
+            t0 = time.perf_counter()
+            injector.fire("elastic.commit")
+            assert time.perf_counter() - t0 >= 0.2
+        finally:
+            chaos.uninstall()
+
+
+class TestKVClientRetries:
+    """Satellite: a single transient URLError / connection reset /
+    HTTP 5xx mid-negotiation must cost a bounded retry, not the caller."""
+
+    def _server(self):
+        from horovod_tpu.runner.http_kv import KVStoreServer
+        srv = KVStoreServer()
+        srv.start()
+        return srv
+
+    def test_dropped_requests_still_complete_negotiation(self):
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.runner.http_kv import KVStoreClient
+
+        srv = self._server()
+        try:
+            cli = KVStoreClient("127.0.0.1", srv.port, retries=3,
+                                backoff_ms=5)
+            # Drop 2 attempts and 5xx a third, interleaved across the
+            # put/get conversation of a rendezvous.
+            chaos.install(_plan(
+                {"site": "http_kv.request", "kind": "drop", "at": [0, 3]},
+                {"site": "http_kv.request", "kind": "http_5xx", "at": [1]}))
+            retries0 = ins.KV_CLIENT_RETRIES.labels().get()
+            cli.put("neg", "rank0", b"payload")     # attempts 0,1 injected
+            assert cli.get("neg", "rank0") == b"payload"  # attempt 3 drop
+            assert ins.KV_CLIENT_RETRIES.labels().get() == retries0 + 3
+            # the injections are on the ledger for correlation
+            kinds = sorted(e["kind"] for e in chaos.read_ledger())
+            assert kinds == ["drop", "drop", "http_5xx"]
+        finally:
+            chaos.uninstall()
+            srv.stop()
+
+    def test_retry_budget_exhaustion_raises(self):
+        from urllib import error as urlerror
+
+        from horovod_tpu.runner.http_kv import KVStoreClient
+
+        # No server needed: every attempt is dropped before the wire.
+        cli = KVStoreClient("127.0.0.1", 1, retries=2, backoff_ms=1)
+        chaos.install(_plan({"site": "http_kv.request", "kind": "drop",
+                             "every": 1}))
+        try:
+            with pytest.raises(urlerror.URLError):
+                cli.put("s", "k", b"v")
+            assert injector.stats()["sites"]["http_kv.request"] == 3
+        finally:
+            chaos.uninstall()
+
+    def test_404_is_an_answer_not_a_retry(self):
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.runner.http_kv import KVStoreClient
+
+        srv = self._server()
+        try:
+            cli = KVStoreClient("127.0.0.1", srv.port, retries=3,
+                                backoff_ms=5)
+            retries0 = ins.KV_CLIENT_RETRIES.labels().get()
+            assert cli.get("nope", "missing") is None
+            assert ins.KV_CLIENT_RETRIES.labels().get() == retries0
+        finally:
+            srv.stop()
+
+
+class TestLauncherPropagation:
+    def test_hvdrun_chaos_flags_reach_worker_env(self):
+        """`hvdrun --chaos-plan/--chaos-seed/--chaos-ledger` must export
+        HOROVOD_CHAOS_* into every worker's env (the same
+        set_env_from_args path every other tuning flag rides)."""
+        from horovod_tpu.runner.config_parser import set_env_from_args
+        from horovod_tpu.runner.launch import parse_args
+
+        args = parse_args(["--chaos-plan", "plan.yaml", "--chaos-seed",
+                           "7", "--chaos-ledger", "/tmp/led", "-np", "2",
+                           "python", "train.py"])
+        env = set_env_from_args({}, args)
+        assert env["HOROVOD_CHAOS_PLAN"] == "plan.yaml"
+        assert env["HOROVOD_CHAOS_SEED"] == "7"
+        assert env["HOROVOD_CHAOS_LEDGER"] == "/tmp/led"
+
+
+class TestDriverHostRemove:
+    def test_discovery_window_removes_then_restores(self, monkeypatch):
+        """host_remove drops the victim from the discovered set for its
+        window — the driver reassigns exactly as for a real preemption,
+        then scales back up when the window closes."""
+        from horovod_tpu.runner.elastic import driver as driver_mod
+        from horovod_tpu.runner.elastic.discovery import FixedHosts
+        from horovod_tpu.runner.hosts import HostInfo
+
+        monkeypatch.setattr(driver_mod, "DISCOVER_INTERVAL_SECS", 0.05)
+        chaos.install(_plan({"site": "driver.discovery",
+                             "kind": "host_remove", "at": [2],
+                             "duration": 3, "host": "hostB"}))
+        spawns = []
+        drv = driver_mod.ElasticDriver(
+            FixedHosts([HostInfo("hostA", 1), HostInfo("hostB", 1)]),
+            min_np=1,
+            spawn_fn=lambda a, v: spawns.append(
+                (v, sorted({s.hostname for s in a}))))
+        try:
+            drv.start()
+            import time
+            deadline = time.time() + 20
+            while time.time() < deadline and len(spawns) < 3:
+                time.sleep(0.05)
+        finally:
+            drv.stop()
+            chaos.uninstall()
+        assert spawns[0] == (1, ["hostA", "hostB"])
+        assert spawns[1] == (2, ["hostA"]), spawns      # preemption window
+        assert spawns[2] == (3, ["hostA", "hostB"])     # restored
+        entries = chaos.read_ledger()
+        assert [(e["kind"], e.get("host"), e["role"]) for e in entries] \
+            == [("host_remove", "hostB", "worker")]
+
+
+class TestChaosSmoke:
+    """Tier-1 fast deterministic smoke: KV drop + dispatch straggler in a
+    single process, asserting correctness under injection and ledger
+    equality across a same-seed re-run."""
+
+    def _workload(self, hvd, srv_port):
+        from horovod_tpu.runner.http_kv import KVStoreClient
+
+        cli = KVStoreClient("127.0.0.1", srv_port, retries=3, backoff_ms=5)
+        cli.put("smoke", "k", b"v")
+        assert cli.get("smoke", "k") == b"v"
+        x = jnp.ones((hvd.size(), 8), jnp.float32) * 2
+        for _ in range(6):
+            out = hvd.allreduce(x, op=hvd.Sum)
+        np.testing.assert_allclose(
+            np.asarray(out), np.full((hvd.size(), 8), 2 * hvd.size()))
+
+    def test_smoke_deterministic_ledger(self, hvd, tmp_path, monkeypatch):
+        from horovod_tpu.runner.http_kv import KVStoreServer
+
+        plan = _plan(
+            {"site": "http_kv.request", "kind": "drop", "at": [0]},
+            {"site": "collective.dispatch", "kind": "delay",
+             "delay_ms": 1, "every": 3},
+            seed=5)
+        srv = KVStoreServer()
+        srv.start()
+        schedules = []
+        try:
+            for attempt in range(2):
+                d = str(tmp_path / f"run{attempt}")
+                monkeypatch.setenv("HOROVOD_CHAOS_LEDGER", d)
+                chaos.install(plan)
+                self._workload(hvd, srv.port)
+                entries = chaos.read_ledger(d)
+                schedules.append(chaos.ledger_schedule(entries))
+                chaos.uninstall()
+            assert schedules[0], "smoke produced no injections"
+            assert schedules[0] == schedules[1]
+            kinds = {s[3] for s in schedules[0]}
+            assert kinds == {"drop", "delay"}
+        finally:
+            chaos.uninstall()
+            srv.stop()
+
+    def test_fusion_flush_stall_site(self, hvd, monkeypatch, tmp_path):
+        from horovod_tpu.ops import fusion
+
+        monkeypatch.setenv("HOROVOD_CHAOS_LEDGER", str(tmp_path / "f"))
+        rt = fusion.get_runtime()
+        rt.flush_all()
+        chaos.install(_plan({"site": "fusion.flush", "kind": "delay",
+                             "delay_ms": 1, "at": [0]}))
+        try:
+            with rt.cycle_paused():
+                hs = [hvd.allreduce_async(
+                    jnp.ones((hvd.size(), 4), jnp.float32), op=hvd.Sum,
+                    name=f"chaos.{i}") for i in range(4)]
+                for h in hs:
+                    h.synchronize()
+            entries = chaos.read_ledger(str(tmp_path / "f"))
+            assert [e["site"] for e in entries] == ["fusion.flush"]
+        finally:
+            chaos.uninstall()
+
+    def test_commit_site_advances_step_clock(self, hvd, monkeypatch,
+                                             tmp_path):
+        from horovod_tpu.elastic.state import ObjectState
+
+        monkeypatch.setenv("HOROVOD_CHAOS_LEDGER", str(tmp_path / "c"))
+        chaos.install(_plan(
+            {"site": "elastic.commit", "kind": "delay", "delay_ms": 0,
+             "at_step": [2]},
+            {"site": "http_kv.request", "kind": "delay", "delay_ms": 0,
+             "at_step": [2]}))
+        try:
+            state = ObjectState(step=0)
+            for _ in range(4):
+                state.step += 1
+                state.commit()          # fires at step 2, sets the clock
+                injector.fire("http_kv.request")
+            entries = chaos.read_ledger(str(tmp_path / "c"))
+            assert sorted((e["site"], e["step"]) for e in entries) == [
+                ("elastic.commit", 2), ("http_kv.request", 2)]
+        finally:
+            chaos.uninstall()

@@ -1,0 +1,35 @@
+"""The 8-process chaos soak — the acceptance leg of the chaos subsystem.
+
+Marked ``slow`` (three full 8-process elastic runs: clean, chaos, same-seed
+re-run) so tier-1 stays within budget; run it explicitly with::
+
+    pytest tests/test_chaos_soak.py -m slow
+    # or: python scripts/chaos_soak.py
+
+Asserts (inside horovod_tpu.chaos.soak.run_soak): the seeded worker-kill +
+KV-drop + straggler plan reaches the target step, final weights match the
+clean run, elastic resets stay within the kill budget, every recovering
+worker populated elastic_recovery_seconds, and the injection-ledger
+schedule is identical across the same-seed re-run.
+"""
+
+import pytest
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1500)
+class TestChaosSoak:
+    def test_eight_process_kill_drop_straggler_soak(self, hvd, tmp_path):
+        from horovod_tpu.chaos import soak
+
+        evidence = soak.run_soak(procs=8, steps=8, seed=123,
+                                 workdir=str(tmp_path), reruns=1)
+        assert evidence["ledger_deterministic"]
+        # One crash spec -> exactly one membership shrink survived.
+        assert evidence["kill_budget"] == 1
+        assert all(r["final_world"] == 7
+                   for r in evidence["chaos_results"])
+        # The KV drops were absorbed by the client retry layer: every
+        # surviving rank retried at least once and still finished.
+        assert any(r["kv_retries"] >= 1
+                   for r in evidence["chaos_results"])

@@ -56,8 +56,18 @@ class TestElasticFailureInjection:
                     state.step += 1
                     state.worlds.append(hvd.process_count())
                     state.commit()
+                # The survivor's recovery (failure detection → training
+                # re-entry) must land in the elastic_recovery_seconds
+                # histogram — the latency evidence the chaos soak and
+                # capacity planning consume.
+                recovery = hvd.metrics_snapshot().get(
+                    "elastic_recovery_seconds", {})
+                recoveries = {
+                    s["labels"].get("cause"): s["count"]
+                    for s in recovery.get("series", ())}
                 return (state.step, np.asarray(state.w).tolist(),
-                        list(state.worlds), hvd.process_count())
+                        list(state.worlds), hvd.process_count(),
+                        recoveries)
 
             return loop(state)
 
@@ -66,9 +76,13 @@ class TestElasticFailureInjection:
 
         # Only the surviving host reports (final world size 1).
         assert len(results) == 1
-        steps, w, worlds, final_world = results[0]
+        steps, w, worlds, final_world, recoveries = results[0]
         assert steps == total_steps
         assert final_world == 1
+        # The collective-failure recovery was measured: at least one
+        # cause=failure observation with a sane (sub-timeout) latency
+        # recorded by the @elastic.run wrapper.
+        assert recoveries.get("failure", 0) >= 1, recoveries
         # Steps 0-2 ran at world 2 (allreduce sum = 1+2 = 3 per element);
         # the survivor's in-flight step 3 was rolled back to the commit and
         # re-run at world 1 (sum = 1): w = 3*3 + 3*1 = 12. Any other value

@@ -247,6 +247,38 @@ class TestDispatchPlanGuards:
         assert co.plan_cache_stats()["misses"] == misses_after
         assert co.plan_cache_stats()["hits"] == hits_before + 1
 
+    def test_steady_state_unaffected_by_disarmed_chaos(self, hvd):
+        """The chaos injection sites live INSIDE the dispatch fast path; a
+        disarmed injector (the default) must leave the steady state
+        untouched: plan hits, zero injections, zero ledger writes — and an
+        ARMED plan whose specs target other sites must not fire here
+        either."""
+        from horovod_tpu import chaos
+        from horovod_tpu.chaos import ChaosPlan, FaultSpec
+        from horovod_tpu.ops import collective_ops as co
+
+        assert chaos.injector.armed is False, \
+            "chaos must be disarmed by default"
+        x = jnp.ones((hvd.size(), 8), jnp.float32)
+        np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        chaos0 = _counter_total("chaos_injections_total")
+        hits0 = co.plan_cache_stats()["hits"]
+        for _ in range(5):
+            np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        # Armed-but-elsewhere: dispatch still takes the plan fast path and
+        # fires nothing (the site match is per-spec, not global).
+        chaos.install(ChaosPlan([FaultSpec(
+            site="elastic.rendezvous", kind="delay", at=[0])]))
+        try:
+            for _ in range(5):
+                np.asarray(hvd.allreduce(x, op=hvd.Sum))
+            ledger = chaos.ledger_path()
+        finally:
+            chaos.uninstall()
+        assert co.plan_cache_stats()["hits"] >= hits0 + 10
+        assert _counter_total("chaos_injections_total") == chaos0
+        assert ledger is None, "no-fire chaos opened a ledger"
+
     def test_plan_cache_invalidated_by_elastic_membership_change(self):
         """An elastic membership change tears the backend down through
         basics.teardown_distributed, which must leave zero live dispatch
@@ -332,27 +364,42 @@ def _measure_host_overhead(hvd, iters=150, burst=50):
 
 
 class TestHostOverheadBudget:
-    @pytest.mark.parametrize("metrics_on", [True, False],
-                             ids=["metrics1", "metrics0"])
-    def test_eager_and_async_overhead_within_budget(self, hvd, metrics_on):
+    @pytest.mark.parametrize("metrics_on,chaos_armed",
+                             [(True, False), (False, False), (True, True)],
+                             ids=["metrics1", "metrics0", "chaos_nofire"])
+    def test_eager_and_async_overhead_within_budget(self, hvd, metrics_on,
+                                                    chaos_armed):
         """The committed baseline (docs/host_overhead_baseline.json) is
         the budget: fail at 2x — the eager path growing a host-side
         stall (lock contention, per-call recompile, KV chatter) is the
         regression this catches. Runs under BOTH HOROVOD_METRICS settings
         so the disabled-observability short-circuit branch of the
-        dispatch plan is guarded too. Regenerate the baseline on a
+        dispatch plan is guarded too, and the default (disarmed-chaos)
+        legs double as the proof that the injection sites cost nothing
+        when off — each is one module-bool read. The chaos_nofire leg
+        arms a plan with no hot-path specs: the armed-but-no-match walk
+        must also fit the same budget. Regenerate the baseline on a
         hardware change with HVD_UPDATE_PERF_BASELINE=1 (the metrics-on
         run writes it — that is the default production config)."""
+        from horovod_tpu import chaos
+        from horovod_tpu.chaos import ChaosPlan, FaultSpec
         from horovod_tpu.metrics import instruments as ins
 
+        assert chaos.injector.armed is False, \
+            "chaos must be disarmed by default for the perf legs"
         prev = ins.enabled()
         ins.set_enabled(metrics_on)
+        if chaos_armed:
+            chaos.install(ChaosPlan([FaultSpec(
+                site="elastic.rendezvous", kind="delay", at=[0])]))
         try:
             got = _measure_host_overhead(hvd)
         finally:
             ins.set_enabled(prev)
+            if chaos_armed:
+                chaos.uninstall()
         if os.environ.get("HVD_UPDATE_PERF_BASELINE") == "1":
-            if not metrics_on:
+            if not metrics_on or chaos_armed:
                 return  # the default-config (metrics-on) run writes it
             with open(_BASELINE, "w") as f:
                 json.dump({**got, "note":
