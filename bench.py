@@ -41,6 +41,12 @@ def _progress_record(phase, **extra):
                "model": os.environ.get("HVD_BENCH_MODEL", "resnet50"),
                "phase": phase}
         rec.update(extra)
+        # Flight-recorder evidence rides every progress line: even when
+        # the run never reaches a BENCH record, each phase mark says how
+        # far the collective sequence got and what the steps cost.
+        fsum, _ = _flight_summary_field()
+        if fsum is not None:
+            rec["flight"] = fsum
         with open(_PROGRESS_PATH, "a") as f:
             f.write(json.dumps(rec) + "\n")
     except OSError:
@@ -224,11 +230,29 @@ def _metrics_snapshot_field():
         return None, (str(e).splitlines() or ["?"])[0][:160]
 
 
+def _flight_summary_field():
+    """The flight-recorder ride-along: event counts by kind, per-set max
+    collective seq, step-span stats. Like the metrics snapshot, this
+    accrues during a FAILING run too — a tunnel-collapsed partial bench
+    (round 5's value-0.0 records) still says how many collectives
+    dispatched, where the sequence stopped, and what the last steps cost.
+    Returns ``(summary_or_None, reason_or_None)``."""
+    try:
+        from horovod_tpu.flight import recorder
+        return recorder.summary(), None
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail bench
+        return None, (str(e).splitlines() or ["?"])[0][:160]
+
+
 def _with_metrics(record):
     snap, reason = _metrics_snapshot_field()
     record["metrics_snapshot"] = snap
     if snap is None:
         record["metrics_snapshot_reason"] = reason
+    fsum, freason = _flight_summary_field()
+    record["flight_summary"] = fsum
+    if fsum is None:
+        record["flight_summary_reason"] = freason
     return record
 
 

@@ -28,6 +28,9 @@ from horovod_tpu.common.basics import (  # noqa: F401
     metrics_snapshot, metrics_text,
 )
 from horovod_tpu import metrics  # noqa: F401
+from horovod_tpu import flight  # noqa: F401
+from horovod_tpu.flight.recorder import step_marker  # noqa: F401
+from horovod_tpu.flight.recorder import summary as flight_summary  # noqa: F401
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt, NotInitializedError,
 )

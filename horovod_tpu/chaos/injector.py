@@ -35,6 +35,7 @@ import threading
 import time
 
 from horovod_tpu.chaos.plan import ChaosPlan
+from horovod_tpu.flight import recorder as _flight
 
 # The one-word hot-path gate. Module attribute, not a function call: sites
 # read ``injector.armed`` and skip everything else when False.
@@ -227,7 +228,10 @@ def _apply(spec, url=None):
     elif kind == "crash":
         # Hard death with no interpreter cleanup — the worker vanishes the
         # way a preempted/OOM-killed process does (reference analog:
-        # elastic_common.py kills workers mid-training).
+        # elastic_common.py kills workers mid-training). Last words: the
+        # victim's ring (which already holds this injection — _record ran
+        # under _decide) hits disk before os._exit skips every atexit.
+        _flight.dump("chaos_crash", force=True)
         os._exit(spec.exit_code)
     elif kind == "hang":
         time.sleep(spec.hang_s)
@@ -270,6 +274,11 @@ def ledger_path():
 def _record(site, spec, idx, fire_idx, n, step, rank, **extra):
     from horovod_tpu.metrics import instruments as _metrics
     _metrics.record_chaos(site, spec.kind)
+    if _flight.armed:
+        # Mirrored into the flight ring so a single per-rank dump carries
+        # the injection AND its downstream anomaly in one timeline (the
+        # analyzer's causation pass works from either source).
+        _flight.record_event("chaos", name=site, what=spec.kind, seq=step)
     entry = {"role": _role, "rank": rank, "site": site, "kind": spec.kind,
              "spec": idx, "fire": fire_idx, "n": n, "step": step,
              "ts": round(time.time(), 3)}

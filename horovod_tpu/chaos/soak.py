@@ -140,6 +140,64 @@ def plan_kill_budget(plan_dict):
     return budget
 
 
+def _assert_flight_forensics(flight_dir, ledger_dir, kills, procs):
+    """Merge the chaos leg's flight dumps and assert the analyzer localizes
+    the injected kill: the victim's rank, the first unmatched collective
+    sequence number, and the causing injection site. Returns a compact
+    report for the evidence dict."""
+    from horovod_tpu.flight import analyze as flight_analyze
+
+    kill_ranks = sorted({k["rank"] for k in kills})
+    events, metas, marks = flight_analyze.load_dir(flight_dir,
+                                                   ledger_dir=ledger_dir)
+    assert events, f"chaos leg left no flight dumps under {flight_dir}"
+    report = flight_analyze.analyze(events, metas, marks)
+    # Each victim's last act was dumping its ring (chaos crash hook).
+    for r in kill_ranks:
+        assert r in report["crash_dump_ranks"], report["dumps"]
+    assert report["killed_ranks"] == kill_ranks, report["killed_ranks"]
+    # Every rank left a dump: each victim's chaos_crash plus each
+    # survivor's internal-error / membership-abort / atexit dump.
+    # Ledger-synthesized events (from_ledger) are NOT dump evidence — a
+    # rank whose dump write failed still has ledger entries, and counting
+    # those would hide exactly the missing-dump regression this catches.
+    worker_ranks = {e["rank"] for e in events
+                    if e.get("role") != "driver"
+                    and not e.get("from_ledger")}
+    missing = set(range(procs)) - worker_ranks
+    assert not missing, \
+        f"ranks {sorted(missing)} left no flight dump: {report['dumps']}"
+    causes = []
+    for k in kills:
+        # Cross-rank desync: each victim lags, and the first unmatched
+        # seq names the collective it never dispatched.
+        lagging = [d for d in report["desync"].values()
+                   if d["desynced"] and k["rank"] in d["lagging_ranks"]]
+        assert lagging, \
+            f"killed rank {k['rank']} not localized: {report['desync']}"
+        assert isinstance(lagging[0]["first_unmatched_seq"], int)
+        # Causation: each crash injection is correlated with the first
+        # downstream anomaly some rank recorded.
+        cause = next((c for c in report["chaos"]
+                      if c["what"] == "crash" and c["rank"] == k["rank"]
+                      and c["site"] == k["site"]), None)
+        assert cause is not None, (k, report["chaos"])
+        assert cause.get("first_anomaly"), \
+            f"crash injection has no downstream anomaly: {cause}"
+        causes.append(cause)
+    # The driver tied the dumps to the membership changes that removed the
+    # victims' hosts.
+    assert report["driver_disruptions"], "driver wrote no disruption marker"
+    return {
+        "dumps": report["dumps"],
+        "killed_ranks": report["killed_ranks"],
+        "desync": report["desync"],
+        "cause": causes[0],
+        "causes": causes,
+        "driver_disruptions": report["driver_disruptions"],
+    }
+
+
 @contextlib.contextmanager
 def _scoped_env(overrides):
     saved = {k: os.environ.get(k) for k in overrides}
@@ -233,11 +291,17 @@ def _run_soak_inner(procs, steps, seed, workdir, plan_dict, plan_path,
     schedules = []
     for attempt in range(1 + reruns):
         ledger_dir = os.path.join(workdir, f"ledger_{attempt}")
+        flight_dir = os.path.join(workdir, f"flight_{attempt}")
         _progress("soak chaos run start", attempt=attempt)
         results = _elastic_run(steps, procs, min_np, workdir, {
             "HOROVOD_CHAOS_PLAN": plan_path,
             "HOROVOD_CHAOS_SEED": str(seed),
             "HOROVOD_CHAOS_LEDGER": ledger_dir,
+            # Archive the chaos leg's flight dumps under the workdir: the
+            # victim's chaos_crash dump, every survivor's internal-error /
+            # membership-abort dump, and the driver's disruption marker
+            # land in one analyzable directory.
+            "HOROVOD_FLIGHT_DIR": flight_dir,
         })
         from horovod_tpu.chaos import injector
         entries = injector.read_ledger(ledger_dir)
@@ -272,6 +336,13 @@ def _run_soak_inner(procs, steps, seed, workdir, plan_dict, plan_path,
             # the injected kill actually fired (exactly once)
             kills = [e for e in entries if e["kind"] == "crash"]
             assert len(kills) == budget, entries
+            # (6) the flight forensics localize the kill: merge the per-
+            # rank dumps the failure left behind and check the analyzer
+            # names the killed rank, the first unmatched collective seq,
+            # and the injection that caused it — "it recovered" AND "the
+            # forensics say why".
+            evidence["flight_report"] = _assert_flight_forensics(
+                flight_dir, ledger_dir, kills, procs)
     # (5) same seed ⇒ identical ledger schedule
     for i, sched in enumerate(schedules[1:], 1):
         assert sched == schedules[0], (

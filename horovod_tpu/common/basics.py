@@ -93,6 +93,14 @@ def init(comm=None, process_sets=None, devices=None):
             from horovod_tpu.chaos import injector as _chaos_injector
             _chaos_injector.install_from_env()
 
+        # Flight recorder (always-armed crash forensics): configured
+        # before any dispatch so the ring covers init/rendezvous too.
+        # configure() never clears a live ring — elastic in-place
+        # re-init must keep the pre-failure events (they ARE the
+        # evidence a post-mortem needs).
+        from horovod_tpu.flight import recorder as _flight_recorder
+        _flight_recorder.configure(config)
+
         # Decide on distributed bootstrap from the env alone: probing
         # jax.process_count() here would initialize the local backend and
         # forbid jax.distributed.initialize afterwards.

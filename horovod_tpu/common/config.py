@@ -152,6 +152,18 @@ class Config:
     # Directory for the per-rank JSONL injection ledgers.
     chaos_ledger: str = ""
 
+    # --- flight recorder (horovod_tpu/flight; no reference analog — the
+    # reference's timeline must be armed BEFORE the run, so unpredicted
+    # failures leave no artifact). Always-on bounded event ring, dumped on
+    # failure paths; budgeted by TestFlightRecorderOverhead.
+    flight: bool = True
+    # Ring capacity in events (two per collective: dispatch + complete).
+    flight_capacity: int = 4096
+    # Dump directory ("" = ./flight_dumps); hvdrun --flight-dir exports it
+    # to every worker so the elastic driver collects per-rank dumps in one
+    # place.
+    flight_dir: str = ""
+
     # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
     # the reference's observability stops at timeline + stall inspector).
     # Always-on by default: the registry hot path is O(1) and lock-light
@@ -260,6 +272,10 @@ class Config:
         c.chaos_seed = _env_int("HOROVOD_CHAOS_SEED", c.chaos_seed)
         c.chaos_ledger = os.environ.get("HOROVOD_CHAOS_LEDGER",
                                         c.chaos_ledger)
+        c.flight = _env_bool("HOROVOD_FLIGHT_RECORDER", c.flight)
+        c.flight_capacity = _env_int("HOROVOD_FLIGHT_CAPACITY",
+                                     c.flight_capacity)
+        c.flight_dir = os.environ.get("HOROVOD_FLIGHT_DIR", c.flight_dir)
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
         c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
         c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",

@@ -24,6 +24,7 @@ import threading
 import jax
 
 from horovod_tpu.chaos import injector as _chaos
+from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.metrics import instruments as _metrics
 
 _counters = {}
@@ -160,6 +161,11 @@ def exchange(tag, payload, procs=None):
         _stats["gets"] += len(procs) - 1
         _stats["payload_bytes"] += len(blob)
     _metrics.record_negotiation(gets=len(procs) - 1, payload_bytes=len(blob))
+    if _flight.armed:
+        # Negotiation rounds are SPMD-ordered like collectives, so a rank
+        # wedged INSIDE an exchange shows as the last event before the gap.
+        _flight.record_event("negotiation", name=tag, seq=seq,
+                             nbytes=len(blob))
     client.key_value_set(f"{base}/{me}", blob)
     # Bound coordinator memory on long jobs: reaching seq s implies this
     # process completed exchange s-1, which required reading every peer's

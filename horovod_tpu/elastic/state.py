@@ -28,6 +28,7 @@ from horovod_tpu.common import basics
 from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.common.exceptions import (HorovodInternalError,
                                            HostsUpdatedInterrupt)
+from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.metrics import instruments as _metrics
 
 
@@ -60,11 +61,19 @@ class State:
     def commit(self):
         """Commit (save) + check for host changes (reference: elastic.py:54)."""
         self.save()
+        step = getattr(self, "step", None)
+        if _flight.armed and step is not None:
+            # Step annotation BEFORE the chaos site: a crash injected at
+            # this commit leaves the step marker in the victim's dump.
+            # Only with a real step attribute — a step-less State must not
+            # burn the auto counter the torch optimizer wrapper may be
+            # driving in the same process.
+            _flight.step_marker(step)
         if _chaos.armed:
             # Chaos site: the step boundary — where a worker crash/hang is
             # injected (the committed step also advances the plan's step
             # clock, so KV/dispatch faults can be step-keyed).
-            _chaos.fire("elastic.commit", step=getattr(self, "step", None))
+            _chaos.fire("elastic.commit", step=step)
         self.check_host_updates()
 
     def save(self):
@@ -293,6 +302,10 @@ def run(func):
                 if recovering is None:
                     recovering = ("failure", time.monotonic())
                 _metrics.record_elastic_event("restore")
+                # The ring's tail at this moment is the failed collective
+                # plus everything leading up to it — dump before restore
+                # overwrites any of it with recovery traffic.
+                _flight.dump("horovod_internal_error")
                 hvd_logging.warning(
                     "collective failure; restoring last committed state")
                 state.restore()
@@ -350,6 +363,9 @@ def run(func):
         if consumed_version is None:
             hvd_logging.info(
                 "host removed from membership; exiting cleanly")
+            # Last words: this process exits via os._exit/SystemExit below,
+            # where no atexit dump may ever run.
+            _flight.dump("membership_removed")
             # Orderly disconnect before dying: letting interpreter
             # finalization destroy the jax.distributed client (and, on a
             # coordinator, the service with peers still attached) can

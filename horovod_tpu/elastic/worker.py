@@ -11,6 +11,7 @@ import threading
 
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import logging as hvd_logging
+from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.metrics import instruments as _metrics
 from horovod_tpu.runner.http_kv import KVStoreClient
 
@@ -332,6 +333,10 @@ def _watch_loop():
             "membership v%d removed a host while training at v%s: "
             "aborting in-flight collectives", current, armed)
         _metrics.record_elastic_event("abort")
+        # Dump BEFORE severing: the ring's tail is the in-flight collective
+        # this abort is about to fail (its dispatch has no completion — the
+        # analyzer's desync anchor).
+        _flight.dump("membership_abort")
         sockets.abort_data_plane_sockets(sockets.control_plane_ports())
 
 

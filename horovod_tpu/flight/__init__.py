@@ -1,0 +1,35 @@
+"""Collective flight recorder: always-armed crash forensics.
+
+Two halves (see the module docstrings for the full story):
+
+- :mod:`horovod_tpu.flight.recorder` — a per-rank, default-on, fixed-size
+  ring buffer of structured events (collective dispatches/completions with
+  per-process-set sequence numbers, fusion boundaries, negotiation rounds,
+  KV faults, elastic transitions, chaos injections, step markers),
+  recorded lock-cheap and dumped as JSONL on exactly the paths where today
+  you get nothing: stall findings, the membership watchdog abort, the
+  dispatch failure epilogue, chaos crashes, SIGTERM/atexit during elastic
+  teardown, ``SIGUSR2``, and on demand via ``GET /debug/flight`` on the
+  metrics endpoint.
+- :mod:`horovod_tpu.flight.analyze` — the post-mortem CLI
+  (``python -m horovod_tpu.flight.analyze <dir>``): merge per-rank dumps,
+  detect cross-rank desync (naming the first diverging collective), rank
+  stragglers by host-latency skew, reconstruct per-step time breakdowns,
+  correlate chaos injections with their first downstream anomaly, and
+  emit a merged Perfetto-loadable Chrome trace.
+
+Knobs: ``HOROVOD_FLIGHT_RECORDER`` (default 1), ``HOROVOD_FLIGHT_CAPACITY``
+(default 4096), ``HOROVOD_FLIGHT_DIR`` / ``hvdrun --flight-dir``.
+Runbook: docs/observability.md (schema/knobs) + docs/troubleshooting.md
+(desync/straggler post-mortem).
+"""
+
+from horovod_tpu.flight import recorder  # noqa: F401
+from horovod_tpu.flight.recorder import (  # noqa: F401
+    FlightRecorder, configure, dump, dump_dir, driver_mark, enabled, events,
+    record_dispatch, record_complete, record_event, render_jsonl, set_enabled,
+    set_role, signature, step_marker, summary,
+)
+# NOT imported eagerly: `python -m horovod_tpu.flight.analyze` would then
+# find the module pre-imported by its own package and warn (runpy); the
+# analyzer is import-on-use (`from horovod_tpu.flight import analyze`).

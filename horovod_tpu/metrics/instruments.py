@@ -37,6 +37,7 @@ import os
 import threading
 import time
 
+from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.metrics.registry import MetricsRegistry, exponential_buckets
 
 _enabled = os.environ.get("HOROVOD_METRICS", "1").lower() \
@@ -279,6 +280,10 @@ def record_http_kv(kind, payload_bytes=0):
 
 
 def record_elastic_event(event):
+    # Flight ring BEFORE the metrics gate: elastic transitions are exactly
+    # the events a post-mortem needs, and the recorder has its own switch.
+    if _flight.armed:
+        _flight.record_event("elastic", what=event)
     if not _enabled:
         return
     ELASTIC_EVENTS.labels(event).inc()
@@ -286,6 +291,9 @@ def record_elastic_event(event):
 
 def record_elastic_recovery(cause, seconds):
     """One completed elastic recovery: detection → training re-entry."""
+    if _flight.armed:
+        _flight.record_event("elastic", what=f"recovered_{cause}",
+                             dur=seconds)
     if not _enabled:
         return
     ELASTIC_RECOVERY.labels(cause).observe(seconds)
@@ -307,6 +315,8 @@ def record_chaos(site, kind):
 
 
 def record_stall(kind):
+    if _flight.armed:
+        _flight.record_event("stall", what=kind)
     if not _enabled:
         return
     STALL_EVENTS.labels(kind).inc()

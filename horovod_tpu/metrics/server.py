@@ -21,7 +21,20 @@ class _MetricsHandler(BaseHTTPRequestHandler):
         pass
 
     def do_GET(self):
-        if self.path.split("?", 1)[0] not in ("/metrics", "/"):
+        path = self.path.split("?", 1)[0]
+        if path == "/debug/flight":
+            # On-demand flight-recorder dump: the ring served directly
+            # (meta line + events as JSONL), no file written — the live
+            # counterpart of the crash-path dumps in flight/recorder.py.
+            from horovod_tpu.flight import recorder as _flight
+            body = _flight.render_jsonl("debug_endpoint").encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", "application/jsonl")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
+        if path not in ("/metrics", "/"):
             self.send_response(404)
             self.send_header("Content-Length", "0")
             self.end_headers()

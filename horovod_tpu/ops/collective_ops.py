@@ -37,6 +37,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
+from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.common.exceptions import TensorShapeMismatchError
 from horovod_tpu.common.process_sets import global_process_set
 from horovod_tpu.common.topology import HVD_AXIS
@@ -133,6 +134,13 @@ def _translate_dispatch_error(name, op_label, e):
     the elastic @run wrapper would retry them forever."""
     from horovod_tpu.metrics import instruments as hvd_metrics
     hvd_metrics.record_collective_error(op_label)
+    if _flight.armed:
+        # The flight recorder's reason to exist: a failed dispatch leaves
+        # a per-rank JSONL dump (ring of recent collectives + this error)
+        # for horovod_tpu.flight.analyze to merge — no pre-arming needed.
+        _flight.record_event("error", op=op_label, name=name,
+                             what=(str(e).splitlines() or [""])[0][:200])
+        _flight.dump("dispatch_error")
     from horovod_tpu.common.exceptions import HorovodInternalError
     if isinstance(e, HorovodInternalError):
         raise e
@@ -177,11 +185,25 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None,
     # Gated HERE, not just inside the helpers: the nbytes sum is
     # O(n_tensors) and must cost nothing under HOROVOD_METRICS=0.
     metrics_on = hvd_metrics.enabled()
-    if metrics_on:
-        hvd_metrics.record_collective(
-            op_label, sum(getattr(t, "nbytes", 0) for t in tensors),
-            ps_label if ps_label is not None else _ps_label(process_set))
+    flight_on = _flight.armed
+    if metrics_on or flight_on:
+        nbytes = sum(getattr(t, "nbytes", 0) for t in tensors)
+        if ps_label is None:
+            ps_label = _ps_label(process_set)
         t0 = time.perf_counter()
+    if metrics_on:
+        hvd_metrics.record_collective(op_label, nbytes, ps_label)
+    if flight_on:
+        # SPMD contract: every process dispatches the same collectives in
+        # the same order, so the per-process-set seq assigned here lines
+        # up across ranks — the analyzer's desync key. Caveat: seq is
+        # arrival-ordered, so when the fusion CYCLE THREAD flushes
+        # concurrently with main-thread eager dispatches the eager/fused
+        # interleaving (and thus seq->op mapping) can differ per rank;
+        # max-seq comparisons stay valid, first-diverging identification
+        # is corroborated by op/sig in the analyzer.
+        fl_seq = _flight.record_dispatch(op_label, ps_label, nbytes,
+                                         _flight.signature(tensors), name)
     tl = basics.timeline()
     span = tl.op_span(name, op_kind) if tl is not None \
         else contextlib.nullcontext()
@@ -196,6 +218,9 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None,
         if metrics_on:
             hvd_metrics.record_collective_latency(
                 op_label, time.perf_counter() - t0)
+        if flight_on:
+            _flight.record_complete(op_label, ps_label, fl_seq,
+                                    time.perf_counter() - t0)
     except (ValueError, RuntimeError) as e:
         _translate_dispatch_error(name, op_label, e)
 
@@ -641,8 +666,8 @@ class _DispatchPlan:
 
     __slots__ = ("kind", "op_kind", "op_label", "default_name", "program",
                  "donate_program", "mesh", "sharding", "ps", "ps_label",
-                 "multi", "global_shapes", "nbytes", "_localize_order",
-                 "_stage_memo")
+                 "multi", "global_shapes", "nbytes", "sig",
+                 "_localize_order", "_stage_memo")
 
     _STAGE_MEMO_CAP = 16
 
@@ -664,6 +689,9 @@ class _DispatchPlan:
         # metrics byte count is a plan constant, not a per-call walk.
         self.global_shapes = tuple(tuple(t.shape) for t in staged)
         self.nbytes = sum(getattr(t, "nbytes", 0) for t in staged)
+        # Flight-recorder signature: a plan constant (every key-matched
+        # call shares shapes/dtypes), so the hot path never re-hashes.
+        self.sig = _flight.signature(staged)
         self._localize_order = None
         # id(src) -> (weakref(src), staged): re-sharding the SAME
         # immutable jax.Array every step (re-reducing a pinned buffer)
@@ -744,15 +772,26 @@ class _DispatchPlan:
             # _prepare outputs, safe to donate under the opt-in.
             prog = self._program_for(staged)
         metrics_on = hvd_metrics.enabled()
+        flight_on = _flight.armed
+        if flight_on:
+            # Plan fast path stays plan-cheap: every flight field (label,
+            # byte count, signature) is a plan constant resolved once.
+            fl_seq = _flight.record_dispatch(self.op_label, self.ps_label,
+                                             self.nbytes, self.sig, name)
+            t0f = time.perf_counter()
         tl = basics.timeline()
         if tl is None and not metrics_on:
-            # Observability fully off: no span/annotation bookkeeping, no
-            # metrics — just the compiled call + error translation.
+            # Observability (timeline/metrics) off: no span/annotation
+            # bookkeeping — the compiled call, error translation, and the
+            # always-armed flight record above.
             try:
                 outs = prog(*staged)
             except (ValueError, RuntimeError) as e:
                 _translate_dispatch_error(name or self.default_name,
                                           self.op_label, e)
+            if flight_on:
+                _flight.record_complete(self.op_label, self.ps_label,
+                                        fl_seq, time.perf_counter() - t0f)
             return self._localize(outs)
         # Inline _timeline_op with the plan's precomputed labels/byte
         # count (no contextmanager frame, no per-call nbytes walk; the
@@ -773,6 +812,9 @@ class _DispatchPlan:
             if metrics_on:
                 hvd_metrics.record_collective_latency(
                     self.op_label, time.perf_counter() - t0)
+            if flight_on:
+                _flight.record_complete(self.op_label, self.ps_label,
+                                        fl_seq, time.perf_counter() - t0f)
         except (ValueError, RuntimeError) as e:
             _translate_dispatch_error(name or self.default_name,
                                       self.op_label, e)

@@ -34,6 +34,7 @@ from jax.sharding import PartitionSpec as P
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.common.topology import HVD_AXIS
+from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.ops.collective_ops import (ReduceOp, _localize, _prepare,
                                             _reduce_shard)
 
@@ -610,6 +611,11 @@ class FusionRuntime:
                                   float(postscale), handle))
             self._pending_bytes += tensor.nbytes
             self._last_enqueue = time.perf_counter()
+            if _flight.armed:
+                # seq carries the fusion tid here — the analyzer pairs it
+                # with the covering fusion_flush boundary's last tid.
+                _flight.record_event("fusion_enqueue", seq=tid,
+                                     nbytes=tensor.nbytes, name=name)
             if self._stall_inspector is not None:
                 self._stall_inspector.record_enqueue(name or "tensor")
             if self._multi and not self._coord:
@@ -664,6 +670,13 @@ class FusionRuntime:
                 self._last_enqueue = time.perf_counter()
                 if self._native is not None and not follower:
                     flush |= self._native.enqueue(tid, hash(key), t.nbytes)
+            if _flight.armed:
+                # One event per GROUP (first tid + total bytes), not per
+                # tensor: grouped enqueues complete atomically anyway.
+                _flight.record_event(
+                    "fusion_enqueue", seq=tids[0], name=name,
+                    nbytes=sum(t.nbytes for t in tensors),
+                    what=f"group{len(tensors)}")
             if self._stall_inspector is not None:
                 self._stall_inspector.record_enqueue(name or "grouped")
             if follower:
@@ -808,6 +821,13 @@ class FusionRuntime:
         from horovod_tpu import metrics as hvd_metrics
         hvd_metrics.record_fusion_flush(len(pending), flushed_bytes,
                                         self.threshold)
+        if _flight.armed:
+            # Flush boundary: the covering tid prefix + bucket size. The
+            # fused dispatches below additionally ride the _timeline_op
+            # flight bracket like every sync collective.
+            _flight.record_event("fusion_flush", seq=pending[-1][0],
+                                 nbytes=flushed_bytes,
+                                 what=f"n{len(pending)}")
         topo = basics.topology()
         mesh = topo.mesh
         n = topo.size

@@ -37,6 +37,21 @@ except ImportError:  # pragma: no cover
 
 NEG_INF = -1e30  # finite big-negative: avoids inf-inf NaNs in the masking
 
+try:  # pre-VMA jax (< 0.7): ShapeDtypeStruct has no ``vma`` kwarg
+    jax.ShapeDtypeStruct((1,), jnp.float32, vma=frozenset())
+    _SDS_TAKES_VMA = True
+except TypeError:
+    _SDS_TAKES_VMA = False
+
+
+def _out_struct(shape, dtype, vma):
+    """ShapeDtypeStruct carrying the varying-manual-axes set when this jax
+    understands it. On pre-VMA jax the computed ``vma`` is always empty
+    (avals have no ``vma`` attribute), so omitting the kwarg is exact."""
+    if _SDS_TAKES_VMA:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=vma)
+    return jax.ShapeDtypeStruct(shape, dtype)
+
 
 def _interpret():
     return jax.default_backend() != "tpu"
@@ -262,8 +277,8 @@ def _fa_forward(q, k, v, causal, sm_scale, block_q, block_k,
             pl.BlockSpec((1, block_q, 1), lambda b, i, j: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, lq, d), q.dtype, vma=vma),
-            jax.ShapeDtypeStruct((bh, lq, 1), jnp.float32, vma=vma),
+            _out_struct((bh, lq, d), q.dtype, vma),
+            _out_struct((bh, lq, 1), jnp.float32, vma),
         ],
         scratch_shapes=[_scratch((block_q, 1)), _scratch((block_q, 1)),
                         _scratch((block_q, d))],
@@ -490,7 +505,7 @@ def _fa_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
         grid=(bh, lq // block_q, n_kc),
         in_specs=[q_blk, kc_swept, kc_swept, q_blk, r_blk, r_blk],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, i, j: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, lq, d), q.dtype, vma=vma),
+        out_shape=_out_struct((bh, lq, d), q.dtype, vma),
         scratch_shapes=[_scratch((block_q, d))],
         compiler_params=_compiler_params(),
         interpret=_interpret(),
@@ -506,8 +521,8 @@ def _fa_backward(q, k, v, o, lse, do, causal, sm_scale, block_q, block_k,
         in_specs=[qc_swept, k_blk, k_blk, qc_swept, rc_swept, rc_swept],
         out_specs=[pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0)),
                    pl.BlockSpec((1, block_k, d), lambda b, i, j: (b, i, 0))],
-        out_shape=[jax.ShapeDtypeStruct((bh, lk, d), k.dtype, vma=vma),
-                   jax.ShapeDtypeStruct((bh, lk, d), v.dtype, vma=vma)],
+        out_shape=[_out_struct((bh, lk, d), k.dtype, vma),
+                   _out_struct((bh, lk, d), v.dtype, vma)],
         scratch_shapes=[_scratch((block_k, d)), _scratch((block_k, d))],
         compiler_params=_compiler_params(),
         interpret=_interpret(),

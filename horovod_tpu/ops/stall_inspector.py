@@ -19,6 +19,7 @@ import time
 
 from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.common.exceptions import HorovodInternalError
+from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.metrics import instruments as _metrics
 
 
@@ -70,6 +71,10 @@ class StallInspector:
                 # Counted as well as logged: stall_events_total makes the
                 # finding scrapeable instead of a log-grep-only signal.
                 _metrics.record_stall("warning")
+                # ... and the flight ring dumps: the stall is exactly the
+                # "wedge with no artifact" failure the recorder exists for
+                # — the dump names the pending tensors' enqueue history.
+                _flight.dump("stall_warning")
                 hvd_logging.warning(
                     "One or more tensors submitted to the fusion queue "
                     "%.0fs ago were never reduced — missing synchronize()? "
@@ -79,4 +84,5 @@ class StallInspector:
             if self.shutdown_secs > 0 and age > self.shutdown_secs:
                 if not self.shutdown_flagged:
                     _metrics.record_stall("shutdown")
+                    _flight.dump("stall_shutdown")
                 self.shutdown_flagged = True

@@ -380,10 +380,14 @@ class _CompositeLM:
             params = optax.apply_updates(params, updates)
             return params, opt_state, loss
 
+        # check_vma off: the updated params/opt state ARE replicated over
+        # tp (grads come out of psum'd TP collectives), but the rep
+        # checker cannot statically infer that through the blocks' psum/
+        # all-gather chains — the same inference gap dp.py documents.
         sharded = jax.shard_map(
             step, mesh=self.mesh,
             in_specs=(param_specs, opt_specs, self._ids_spec()),
-            out_specs=(param_specs, opt_specs, P()))
+            out_specs=(param_specs, opt_specs, P()), check_vma=False)
         return jax.jit(sharded,
                        donate_argnums=(0, 1) if donate else ())
 

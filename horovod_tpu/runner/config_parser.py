@@ -51,6 +51,9 @@ _ARG_ENV_MAP = [
      lambda v: "1" if v else None),
     ("blacklist_cooldown_range", "HOROVOD_BLACKLIST_COOLDOWN_RANGE",
      lambda v: f"{v[0]},{v[1]}"),
+    ("flight_dir", "HOROVOD_FLIGHT_DIR", str),
+    ("no_flight_recorder", "HOROVOD_FLIGHT_RECORDER",
+     lambda v: "0" if v else None),
     ("chaos_plan", "HOROVOD_CHAOS_PLAN", str),
     ("chaos_seed", "HOROVOD_CHAOS_SEED", str),
     ("chaos_ledger", "HOROVOD_CHAOS_LEDGER", str),

@@ -233,6 +233,23 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
     from horovod_tpu import chaos as _chaos_api
     _chaos_api.set_role("driver")
     _chaos_api.install_from_env()
+    from horovod_tpu.flight import recorder as _flight
+    _flight.set_role("driver")
+    # recorder.armed was fixed at import time — before set_env_from_args
+    # above applied --no-flight-recorder to this process's env. Re-read it
+    # (the chaos install_from_env() parallel), or the driver would write
+    # disruption markers for a run the operator opted out of.
+    from horovod_tpu.common.config import _env_bool
+    _flight.set_enabled(_env_bool("HOROVOD_FLIGHT_RECORDER", True))
+    # Same flight-dir default the workers get (launch.build_worker_env):
+    # the driver's disruption markers must land in the SAME directory as
+    # the worker dumps or the analyzer loses the kill-to-membership-change
+    # correlation. set_env_from_args above only covers an explicit
+    # --flight-dir; this covers the defaulted elastic launch.
+    _os.environ.setdefault(
+        "HOROVOD_FLIGHT_DIR",
+        _flight.default_collection_dir(
+            getattr(args, "output_filename", None)))
     kv = KVStoreServer()
     kv_port = kv.start()
     for (scope, key), value in (kv_preload or {}).items():
@@ -356,9 +373,16 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
         # in-flight collectives uncompletable (host removed / resized)
         # must ABORT them on every survivor; a pure addition leaves them
         # completable and is picked up at the next commit boundary.
+        disrupted = driver.version_disrupted(version)
         kv.put("elastic", f"removed/{version}",
-               b"1" if driver.version_disrupted(version) else b"0")
+               b"1" if disrupted else b"0")
         kv.delete("elastic", f"removed/{version - 2}")
+        if disrupted:
+            # Collection-point marker: workers dump their rings into
+            # HOROVOD_FLIGHT_DIR (one directory for the whole launch —
+            # the env is propagated to every worker), and this line ties
+            # those dumps to the membership change that triggered them.
+            _flight.driver_mark(version, removed, list(by_host))
         kv.put("elastic", "nhosts", str(len(by_host)).encode())
         kv.put("elastic", "version", str(version).encode())
         for host, slots in by_host.items():

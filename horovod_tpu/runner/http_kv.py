@@ -21,6 +21,7 @@ from urllib import request as urlrequest
 
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common.config import Config, _env_float, _env_int
+from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.metrics import instruments as _metrics
 from horovod_tpu.runner.secret import (SECRET_ENV, check_digest,
                                        compute_digest)
@@ -215,11 +216,24 @@ class KVStoreClient:
                                           timeout=self._timeout)
             except urlerror.HTTPError as e:
                 if e.code < 500 or attempt == self._retries:
+                    # The 404 that get() maps to "absent" is a semantic
+                    # answer and must NOT flood the flight ring — elastic
+                    # version polls 404 constantly. Every other failure
+                    # (a retries-exhausted 5xx, a 403 from a mismatched
+                    # secret) is an anomaly the post-mortem needs.
+                    if e.code != 404 and _flight.armed:
+                        _flight.record_event("kv_error", name=path,
+                                             what=f"http_{e.code}")
                     raise
-            except (urlerror.URLError, ConnectionError, TimeoutError):
+            except (urlerror.URLError, ConnectionError, TimeoutError) as e:
                 if attempt == self._retries:
+                    if _flight.armed:
+                        _flight.record_event("kv_error", name=path,
+                                             what=type(e).__name__)
                     raise
             _metrics.record_kv_retry()
+            if _flight.armed:
+                _flight.record_event("kv_retry", name=path, seq=attempt)
             time.sleep(delay * (0.5 + random.random()))
             delay = min(delay * 2, self._backoff_max_s)
 

@@ -154,6 +154,19 @@ def parse_args(argv=None):
                             "dispatch (catches SPMD order divergence as an "
                             "error instead of a hang)")
 
+    flight = p.add_argument_group("flight recorder")
+    flight.add_argument("--flight-dir", dest="flight_dir",
+                        help="Directory for per-rank flight-recorder crash "
+                             "dumps (HOROVOD_FLIGHT_DIR), exported to every "
+                             "worker so an elastic disruption collects all "
+                             "ranks' dumps in one place for "
+                             "`python -m horovod_tpu.flight.analyze`. "
+                             "See docs/observability.md.")
+    flight.add_argument("--no-flight-recorder", action="store_true",
+                        dest="no_flight_recorder",
+                        help="Disable the always-armed flight recorder "
+                             "(HOROVOD_FLIGHT_RECORDER=0).")
+
     chaos = p.add_argument_group("chaos")
     chaos.add_argument("--chaos-plan", dest="chaos_plan",
                        help="Fault-injection plan exported to every worker "
@@ -278,6 +291,21 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
             ".horovod_compile_cache")
     if cache_dir:
         env.setdefault("HOROVOD_COMPILE_CACHE_DIR", cache_dir)
+    # Flight-recorder collection point: every worker dumps into the same
+    # directory so a disruption leaves one analyzable set of per-rank
+    # rings (flight.analyze merges them). Elastic launches default it —
+    # that is exactly the launch mode whose failures need forensics.
+    flight_dir = os.environ.get("HOROVOD_FLIGHT_DIR") \
+        or getattr(args, "flight_dir", None)
+    if not flight_dir and env.get("HOROVOD_ELASTIC"):
+        from horovod_tpu.flight.recorder import default_collection_dir
+        flight_dir = default_collection_dir(
+            getattr(args, "output_filename", None))
+    if flight_dir:
+        env.setdefault("HOROVOD_FLIGHT_DIR", flight_dir)
+    if os.environ.get("HOROVOD_FLIGHT_RECORDER"):
+        env.setdefault("HOROVOD_FLIGHT_RECORDER",
+                       os.environ["HOROVOD_FLIGHT_RECORDER"])
     # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU
     # device: pin each worker's device count to its slot count so the world
     # size equals the requested slots regardless of ambient XLA_FLAGS.

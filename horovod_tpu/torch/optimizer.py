@@ -14,6 +14,7 @@ drain at step) carries over 1:1 without a background thread.
 
 import torch
 
+from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.torch import mpi_ops
 from horovod_tpu.torch.compression import Compression
 from horovod_tpu.torch.mpi_ops import Average, Sum
@@ -120,6 +121,11 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if self._should_synchronize:
             self.synchronize()
         self._synchronized = False
+        if _flight.armed:
+            # Automatic step annotation: step() is the host-side training
+            # step boundary, so the flight ring's step spans need no user
+            # instrumentation on this frontend.
+            _flight.step_marker()
         return super(self.__class__, self).step(closure)
 
     def zero_grad(self, *args, **kwargs):

@@ -9,8 +9,11 @@ re-run) so tier-1 stays within budget; run it explicitly with::
 Asserts (inside horovod_tpu.chaos.soak.run_soak): the seeded worker-kill +
 KV-drop + straggler plan reaches the target step, final weights match the
 clean run, elastic resets stay within the kill budget, every recovering
-worker populated elastic_recovery_seconds, and the injection-ledger
-schedule is identical across the same-seed re-run.
+worker populated elastic_recovery_seconds, the injection-ledger schedule
+is identical across the same-seed re-run, and the flight-recorder dumps
+the failure left behind let ``horovod_tpu.flight.analyze`` name the
+killed rank, the first unmatched collective sequence number, and the
+injection that caused it (the PR-5 acceptance scenario).
 """
 
 import pytest
@@ -33,3 +36,12 @@ class TestChaosSoak:
         # surviving rank retried at least once and still finished.
         assert any(r["kv_retries"] >= 1
                    for r in evidence["chaos_results"])
+        # Flight forensics (asserted in depth inside run_soak's
+        # _assert_flight_forensics): the analyzer named the killed rank,
+        # the first unmatched collective seq, and the causing injection.
+        flight = evidence["flight_report"]
+        kill_rank = evidence["plan"]["faults"][0]["rank"]
+        assert flight["killed_ranks"] == [kill_rank]
+        assert flight["cause"]["site"] == "elastic.commit"
+        assert any(d.get("first_unmatched_seq")
+                   for d in flight["desync"].values())

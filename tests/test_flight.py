@@ -1,0 +1,569 @@
+"""Flight-recorder tier: ring-buffer mechanics, crash-path dumps, the
+``/debug/flight`` endpoint, and the forensics analyzer.
+
+The always-armed half (horovod_tpu/flight/recorder.py) is asserted at the
+unit level — wraparound, per-process-set sequence numbers, dump files and
+their triggers (stall inspector, membership-watchdog abort) — and the
+merge/localize half (flight/analyze.py) on synthetic multi-rank dumps plus
+a real 4-process smoke. The full kill-one-rank-of-8 acceptance scenario
+(every survivor auto-dumps, the driver collects, the analyzer names the
+killed rank and the causing injection) is the ``slow``-marked leg inside
+test_chaos_soak.py.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+import urllib.request
+
+import cloudpickle
+import pytest
+
+from horovod_tpu.flight import analyze, recorder
+
+# Worker processes can't import this test module by name; ship the smoke
+# job by value (the tests/test_multiproc.py idiom).
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+@pytest.fixture(autouse=True)
+def _flight_hygiene(tmp_path, monkeypatch):
+    """Every test gets a private dump dir, a fresh dump budget, and leaves
+    the module armed-state as it found it — a disabled recorder or a spent
+    MAX_DUMPS budget must not leak into the rest of the suite."""
+    monkeypatch.setenv("HOROVOD_FLIGHT_DIR", str(tmp_path / "dumps"))
+    was = recorder.armed
+    yield
+    recorder.set_enabled(was)
+    with recorder._dump_lock:
+        recorder._dump_count = 0
+        recorder._dump_counts.clear()
+        recorder._last_dump.clear()
+
+
+def _mk_events(ring, n, op="allreduce", ps="global"):
+    for i in range(n):
+        seq = ring.record_dispatch(op, ps, 256, "cafe0001", f"t{i}")
+        ring.record_complete(op, ps, seq, 0.001)
+
+
+class TestRingBuffer:
+    def test_wraparound_keeps_newest(self):
+        r = recorder.FlightRecorder(capacity=8)
+        for i in range(20):
+            r.record_dispatch("allreduce", "global", 64, "aa", f"t{i}")
+        evs = r.events()
+        assert len(evs) == 8
+        assert r.appended() == 20 and r.dropped() == 12
+        # oldest-first, newest survives, seq numbering unbroken
+        assert [e["seq"] for e in evs] == list(range(13, 21))
+        assert [e["i"] for e in evs] == list(range(12, 20))
+        assert r.max_seq() == {"global": 20}
+
+    def test_seq_is_per_process_set(self):
+        r = recorder.FlightRecorder(capacity=32)
+        assert r.record_dispatch("allreduce", "global", 1, "aa") == 1
+        assert r.record_dispatch("allreduce", "subset", 1, "aa") == 1
+        assert r.record_dispatch("allgather", "global", 1, "bb") == 2
+        assert r.max_seq() == {"global": 2, "subset": 1}
+
+    def test_none_fields_omitted_and_meta(self):
+        r = recorder.FlightRecorder(capacity=8)
+        r.record_event("stall", what="warning")
+        (e,) = r.events()
+        assert e["kind"] == "stall" and e["what"] == "warning"
+        assert "op" not in e and "bytes" not in e
+        m = r.meta(reason="unit")
+        assert m["kind"] == "meta" and m["reason"] == "unit"
+        assert m["capacity"] == 8 and m["appended"] == 1
+
+    def test_summary_counts_and_step_spans(self):
+        r = recorder.FlightRecorder(capacity=64)
+        _mk_events(r, 3)
+        r.record_event("step", seq=1)
+        r.record_event("step", seq=2)
+        s = r.summary()
+        assert s["by_kind"]["dispatch"] == 3
+        assert s["by_kind"]["complete"] == 3
+        assert s["steps"]["count"] == 2
+        assert s["steps"]["mean_span_s"] is not None
+        assert s["max_seq"] == {"global": 3}
+
+    def test_module_gate_skips_everything_when_off(self):
+        recorder.set_enabled(False)
+        before = recorder.get().appended()
+        assert recorder.record_dispatch("allreduce", "g", 1, "aa") is None
+        recorder.record_complete("allreduce", "g", 1, 0.0)
+        recorder.record_event("stall", what="warning")
+        recorder.step_marker(7)
+        assert recorder.get().appended() == before
+
+    def test_step_marker_guarded_and_explicit_wins(self, monkeypatch):
+        """A non-int step must not raise (State.commit feeds an arbitrary
+        user attribute), and explicit marks suppress the auto counter so
+        torch ``step()`` + elastic ``commit()`` don't double-mark."""
+        r = recorder.FlightRecorder(capacity=64)
+        monkeypatch.setattr(recorder, "_recorder", r)
+        recorder.set_enabled(True)
+        recorder.step_marker()                     # auto: 1
+        recorder.step_marker("warmup")             # not int-convertible: no-op
+        recorder.step_marker(object())             # ditto
+        recorder.step_marker(5)                    # explicit
+        recorder.step_marker()                     # auto now suppressed
+        steps = [e["seq"] for e in r.events() if e.get("kind") == "step"]
+        assert steps == [1, 5]
+
+    def test_signature_is_shape_dtype_stable(self):
+        import numpy as np
+
+        a = np.zeros((4, 8), np.float32)
+        b = np.ones((4, 8), np.float32)     # same shape/dtype, other data
+        c = np.zeros((8, 4), np.float32)
+        assert recorder.signature([a]) == recorder.signature([b])
+        assert recorder.signature([a]) != recorder.signature([c])
+
+
+class TestDumps:
+    def test_dump_writes_meta_plus_events(self, tmp_path):
+        recorder.set_enabled(True)
+        recorder.record_event("error", op="allreduce", what="unit-test")
+        d = str(tmp_path / "out")
+        path = recorder.dump("unit", directory=d, force=True)
+        assert path and os.path.isfile(path)
+        rows = [json.loads(line) for line in open(path)]
+        assert rows[0]["kind"] == "meta" and rows[0]["reason"] == "unit"
+        assert any(e["kind"] == "error" for e in rows[1:])
+
+    def test_per_reason_throttle_and_force(self, tmp_path):
+        recorder.set_enabled(True)
+        recorder.record_event("stall", what="warning")
+        d = str(tmp_path / "thr")
+        assert recorder.dump("same_reason", directory=d) is not None
+        # within the 1s window the same reason is swallowed...
+        assert recorder.dump("same_reason", directory=d) is None
+        # ...but another reason, or force, still dumps
+        assert recorder.dump("other_reason", directory=d) is not None
+        assert recorder.dump("same_reason", directory=d,
+                             force=True) is not None
+
+    def test_max_dumps_runaway_guards(self, tmp_path, monkeypatch):
+        recorder.set_enabled(True)
+        recorder.record_event("error", what="storm")
+        d = str(tmp_path / "storm")
+        monkeypatch.setattr(recorder, "_DUMP_MIN_INTERVAL_S", 0.0)
+        # A storm of ONE reason is capped per reason...
+        wrote = sum(
+            recorder.dump("dispatch_error", directory=d) is not None
+            for i in range(recorder.MAX_DUMPS_PER_REASON + 10))
+        assert wrote == recorder.MAX_DUMPS_PER_REASON
+        # ...and must NOT spend the budget of a later decisive dump.
+        assert recorder.dump("membership_abort", directory=d) is not None
+        # Global backstop across many distinct reasons.
+        wrote = sum(
+            recorder.dump(f"r{i}", directory=d) is not None
+            for i in range(recorder.MAX_DUMPS + 10))
+        assert recorder._dump_count == recorder.MAX_DUMPS
+
+    def test_failed_writes_and_forced_dumps_spare_the_budget(
+            self, tmp_path, monkeypatch):
+        """A write failure rolls back budget + throttle window (an
+        unwritable volume must not silence the later decisive dump),
+        forced dumps are never charged (a runbook SIGUSR2 loop must not
+        starve crash dumps), and filename ordinals are never reused (a
+        rolled-back index would overwrite a concurrent dump's file)."""
+        recorder.set_enabled(True)
+        recorder.record_event("stall", what="warning")
+        monkeypatch.setattr(recorder, "_DUMP_MIN_INTERVAL_S", 0.0)
+        bad = tmp_path / "file_not_dir"
+        bad.write_text("")
+        seq0 = recorder._dump_seq
+        assert recorder.dump("stall_warning",
+                             directory=str(bad / "x")) is None
+        with recorder._dump_lock:
+            assert recorder._dump_count == 0
+            assert not recorder._dump_counts.get("stall_warning")
+            assert "stall_warning" not in recorder._last_dump
+            assert recorder._dump_seq == seq0 + 1   # ordinal NOT reused
+        good = str(tmp_path / "good")
+        assert recorder.dump("stall_warning", directory=good) is not None
+        for _ in range(recorder.MAX_DUMPS + 2):
+            assert recorder.dump("usr2", directory=good, force=True)
+        with recorder._dump_lock:
+            assert recorder._dump_count == 1    # forced dumps uncharged
+        names = os.listdir(good)
+        assert len(names) == len(set(names)) == recorder.MAX_DUMPS + 3
+
+    def test_render_jsonl_round_trips(self):
+        recorder.set_enabled(True)
+        recorder.record_event("chaos", name="elastic.commit", what="crash")
+        body = recorder.render_jsonl("rt")
+        rows = [json.loads(line) for line in body.splitlines()]
+        assert rows[0]["kind"] == "meta"
+        assert any(e["kind"] == "chaos" for e in rows[1:])
+
+
+class TestDumpOnStall:
+    def test_stall_warning_dumps(self, tmp_path, monkeypatch):
+        from horovod_tpu.ops.stall_inspector import StallInspector
+
+        recorder.set_enabled(True)
+        d = str(tmp_path / "stall")
+        monkeypatch.setenv("HOROVOD_FLIGHT_DIR", d)
+        recorder.record_event("fusion_enqueue", seq=0, name="orphan")
+        monkeypatch.setattr(StallInspector, "CHECK_INTERVAL_SECS", 0.05)
+        insp = StallInspector(warning_secs=0.01)
+        try:
+            insp.record_enqueue("orphan")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not os.path.isdir(d):
+                time.sleep(0.05)
+            names = os.listdir(d) if os.path.isdir(d) else []
+            assert names, "stall warning left no flight dump"
+            rows = [json.loads(line)
+                    for line in open(os.path.join(d, names[0]))]
+            assert rows[0]["reason"] == "stall_warning"
+            # the stall finding itself is on the ring via record_stall
+            assert any(e["kind"] == "stall" and e.get("what") == "warning"
+                       for e in recorder.events())
+        finally:
+            insp.stop()
+
+    def test_stall_shutdown_dumps_and_flags(self, tmp_path, monkeypatch):
+        from horovod_tpu.common.exceptions import HorovodInternalError
+        from horovod_tpu.ops.stall_inspector import StallInspector
+
+        recorder.set_enabled(True)
+        d = str(tmp_path / "shut")
+        monkeypatch.setenv("HOROVOD_FLIGHT_DIR", d)
+        recorder.record_event("fusion_enqueue", seq=0, name="orphan")
+        monkeypatch.setattr(StallInspector, "CHECK_INTERVAL_SECS", 0.05)
+        insp = StallInspector(warning_secs=0.01, shutdown_secs=0.02)
+        try:
+            insp.record_enqueue("orphan")
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline and not insp.shutdown_flagged:
+                time.sleep(0.05)
+            assert insp.shutdown_flagged
+            with pytest.raises(HorovodInternalError):
+                insp.record_enqueue("next")
+            reasons = set()
+            for name in os.listdir(d):
+                with open(os.path.join(d, name)) as f:
+                    reasons.add(json.loads(f.readline())["reason"])
+            assert "stall_shutdown" in reasons
+        finally:
+            insp.stop()
+
+
+class TestDumpOnAbort:
+    @pytest.mark.timeout(120)
+    def test_membership_abort_dumps(self, tmp_path):
+        """The watchdog abort (what a chaos ``host_remove``/kill triggers
+        through the driver's removed/{v} marker) dumps the ring BEFORE
+        severing sockets. Run in a disposable subprocess: the abort shuts
+        down this process's established data-plane TCP connections."""
+        d = str(tmp_path / "abort")
+        code = f"""
+import os, time
+os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ["HOROVOD_ELASTIC"] = "1"
+os.environ["HOROVOD_FLIGHT_DIR"] = {d!r}
+os.environ["HOROVOD_CROSS_RANK"] = "3"
+
+from horovod_tpu.runner.http_kv import KVStoreServer, KVStoreClient
+srv = KVStoreServer()
+port = srv.start()
+os.environ["HOROVOD_KV_ADDR"] = "127.0.0.1"
+os.environ["HOROVOD_KV_PORT"] = str(port)
+
+from horovod_tpu.flight import recorder
+recorder.record_dispatch("allreduce", "global", 1024, "feed0001", "wedged")
+
+from horovod_tpu.elastic import worker
+kv = KVStoreClient("127.0.0.1", port)
+kv.put("elastic", "version", b"1")
+worker._WATCH_INTERVAL = 0.05
+worker.arm_collective_abort(1)
+# the driver publishes a DISRUPTIVE membership bump (host removed)
+kv.put("elastic", "removed/2", b"1")
+kv.put("elastic", "version", b"2")
+deadline = time.time() + 30
+while time.time() < deadline and not os.path.isdir({d!r}):
+    time.sleep(0.05)
+worker.disarm_collective_abort()
+srv.stop()
+print("DUMPED" if os.path.isdir({d!r}) else "NO_DUMP")
+"""
+        r = subprocess.run([sys.executable, "-c", code],
+                           capture_output=True, text=True, timeout=110)
+        assert "DUMPED" in r.stdout, (r.stdout, r.stderr)
+        names = os.listdir(d)
+        assert names
+        rows = [json.loads(line) for line in open(os.path.join(d, names[0]))]
+        assert rows[0]["reason"] == "membership_abort"
+        assert rows[0]["rank"] == 3
+        # the wedged dispatch (no completion) is the last thing on the ring
+        assert any(e["kind"] == "dispatch" and e.get("name") == "wedged"
+                   for e in rows[1:])
+
+
+class TestDebugFlightEndpoint:
+    def test_get_debug_flight_serves_ring(self):
+        from horovod_tpu.metrics import MetricsServer
+
+        recorder.set_enabled(True)
+        recorder.record_event("elastic", what="reset")
+        srv = MetricsServer(port=0, addr="127.0.0.1")
+        srv.start()
+        try:
+            body = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/debug/flight",
+                timeout=10).read().decode()
+            rows = [json.loads(line) for line in body.splitlines()]
+            assert rows[0]["kind"] == "meta"
+            assert rows[0]["reason"] == "debug_endpoint"
+            assert any(e["kind"] == "elastic" and e.get("what") == "reset"
+                       for e in rows[1:])
+            # /metrics is untouched by the new route
+            text = urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/metrics",
+                timeout=10).read().decode()
+            assert "# TYPE" in text
+        finally:
+            srv.stop()
+
+
+def _write_dump(directory, rank, events, reason=None, pid=None, n=0):
+    """Hand-built per-rank dump file in the recorder's on-disk format."""
+    os.makedirs(directory, exist_ok=True)
+    pid = pid if pid is not None else 1000 + rank
+    meta = {"kind": "meta", "rank": rank, "pid": pid, "role": "worker",
+            "capacity": 4096, "appended": len(events), "dropped": 0,
+            "max_seq": {}, "ts": time.time()}
+    if reason:
+        meta["reason"] = reason
+    path = os.path.join(directory, f"flight_worker_r{rank}_p{pid}_{n:02d}.jsonl")
+    with open(path, "w") as f:
+        f.write(json.dumps(meta) + "\n")
+        for i, e in enumerate(events):
+            f.write(json.dumps(dict(e, i=i)) + "\n")
+    return path
+
+
+def _disp(seq, t, op="allreduce", ps="global", dur=0.001, sig="aa"):
+    """A dispatch + its completion, as event dicts."""
+    return [
+        {"t": t, "kind": "dispatch", "op": op, "ps": ps, "seq": seq,
+         "bytes": 256, "sig": sig},
+        {"t": t + dur, "kind": "complete", "op": op, "ps": ps, "seq": seq,
+         "dur": dur},
+    ]
+
+
+class TestAnalyzer:
+    def _desync_dir(self, tmp_path):
+        """Ranks 0/1 reach seq 5; rank 2 stops at 3 (the victim)."""
+        d = str(tmp_path / "merged")
+        t0 = 1000.0
+        for rank in (0, 1):
+            evs = []
+            for s in range(1, 6):
+                evs += _disp(s, t0 + s)
+            _write_dump(d, rank, evs)
+        evs = []
+        for s in range(1, 4):
+            evs += _disp(s, t0 + s)
+        evs.append({"t": t0 + 3.5, "kind": "chaos", "name": "elastic.commit",
+                    "what": "crash", "seq": 3})
+        _write_dump(d, 2, evs, reason="chaos_crash")
+        return d
+
+    def test_desync_names_first_unmatched_collective(self, tmp_path):
+        d = self._desync_dir(tmp_path)
+        events, metas, marks = analyze.load_dir(d)
+        assert sorted({e["rank"] for e in events}) == [0, 1, 2]
+        report = analyze.analyze(events, metas, marks)
+        desync = report["desync"]["global"]
+        assert desync["desynced"]
+        assert desync["lagging_ranks"] == [2]
+        assert desync["max_seq_by_rank"] == {"0": 5, "1": 5, "2": 3}
+        assert desync["first_unmatched_seq"] == 4
+        assert desync["first_diverging"]["op"] == "allreduce"
+        assert report["killed_ranks"] == [2]
+        assert report["crash_dump_ranks"] == [2]
+
+    def test_straggler_ranked_by_latency_skew(self, tmp_path):
+        d = str(tmp_path / "strag")
+        t0 = 2000.0
+        for rank in range(3):
+            evs = []
+            # rank 1's dispatches take 20x the others' host latency
+            dur = 0.020 if rank == 1 else 0.001
+            for s in range(1, 6):
+                evs += _disp(s, t0 + s, dur=dur)
+            _write_dump(d, rank, evs)
+        events, metas, marks = analyze.load_dir(d)
+        report = analyze.analyze(events, metas, marks)
+        strag = report["stragglers"]["allreduce"]
+        assert strag["top_straggler"] == 1
+        assert strag["ranked"][0]["rank"] == 1
+        assert strag["ranked"][0]["skew"] > 1.5
+
+    def test_step_spans_reconstructed(self, tmp_path):
+        d = str(tmp_path / "steps")
+        t0 = 3000.0
+        evs = [{"t": t0, "kind": "step", "seq": 1}]
+        evs += _disp(1, t0 + 0.1) + _disp(2, t0 + 0.2)
+        evs.append({"t": t0 + 1.0, "kind": "step", "seq": 2})
+        evs += _disp(3, t0 + 1.1)
+        evs.append({"t": t0 + 2.0, "kind": "step", "seq": 3})
+        _write_dump(d, 0, evs)
+        events, metas, marks = analyze.load_dir(d)
+        steps = analyze.analyze_steps(events)["0"]
+        assert steps["steps_marked"] == 3
+        spans = steps["spans"]
+        assert len(spans) == 2
+        assert spans[0]["step"] == 1 and spans[0]["collectives"] == 2
+        assert spans[1]["step"] == 2 and spans[1]["collectives"] == 1
+        assert abs(spans[0]["span_s"] - 1.0) < 1e-6
+
+    def test_chaos_correlated_with_first_anomaly(self, tmp_path):
+        d = str(tmp_path / "cause")
+        t0 = 4000.0
+        evs = _disp(1, t0)
+        evs.append({"t": t0 + 1.0, "kind": "chaos", "name": "http_kv.request",
+                    "what": "http_5xx"})
+        evs.append({"t": t0 + 1.2, "kind": "kv_error", "name": "/kv/x",
+                    "what": "http_503"})
+        _write_dump(d, 0, evs)
+        events, metas, marks = analyze.load_dir(d)
+        (row,) = analyze.analyze_chaos(events)
+        assert row["site"] == "http_kv.request"
+        assert row["first_anomaly"]["kind"] == "kv_error"
+        assert abs(row["first_anomaly"]["gap_s"] - 0.2) < 1e-6
+
+    def test_overlapping_dumps_deduplicate(self, tmp_path):
+        """Two dumps from one process (stall warning, then crash) share
+        ring indices — the merge must not double count."""
+        d = str(tmp_path / "dedup")
+        evs = _disp(1, 5000.0) + _disp(2, 5001.0)
+        _write_dump(d, 0, evs, reason="stall_warning", pid=77, n=0)
+        _write_dump(d, 0, evs + _disp(3, 5002.0), reason="dispatch_error",
+                    pid=77, n=1)
+        events, _, _ = analyze.load_dir(d)
+        assert len([e for e in events if e["kind"] == "dispatch"]) == 3
+
+    def test_torn_row_skipped_not_fatal(self, tmp_path):
+        """A signal-handler dump that timed out the ring lock can contain a
+        mid-append row with every field omitted ({"i": N}) — the analyzer
+        must skip it, not KeyError the whole post-mortem."""
+        d = str(tmp_path / "torn")
+        _write_dump(d, 0, _disp(1, 6000.0) + [{}] + _disp(2, 6001.0),
+                    reason="sigterm")
+        events, metas, marks = analyze.load_dir(d)
+        assert all("kind" in e for e in events)
+        assert len([e for e in events if e["kind"] == "dispatch"]) == 2
+        report = analyze.analyze(events, metas, marks)
+        assert not report["desync"]["global"]["desynced"]
+
+    def test_rank_with_zero_dispatches_flagged_lagging(self, tmp_path):
+        """A rank wedged before its FIRST collective (killed in
+        rendezvous: dump holds only kv/elastic events) must appear in the
+        global desync report at seq 0, not silently vanish from it."""
+        d = str(tmp_path / "zerodisp")
+        for rank in (0, 1):
+            _write_dump(d, rank, _disp(1, 8000.0) + _disp(2, 8001.0))
+        _write_dump(d, 2, [{"t": 8000.5, "kind": "kv_retry", "name": "/kv"}],
+                    reason="membership_abort")
+        events, metas, marks = analyze.load_dir(d)
+        desync = analyze.analyze_desync(events)["global"]
+        assert desync["desynced"]
+        assert desync["lagging_ranks"] == [2]
+        assert desync["max_seq_by_rank"]["2"] == 0
+        assert desync["first_unmatched_seq"] == 1
+
+    def test_torn_meta_dumps_keep_separate_identities(self, tmp_path):
+        """Two dumps whose meta line was truncated off must not collapse
+        into one shared identity (which would drop one file's events as
+        ring-index duplicates of the other's)."""
+        d = str(tmp_path / "tornmeta")
+        for rank in (0, 1):
+            path = _write_dump(d, rank, _disp(1, 7000.0) + _disp(2, 7001.0))
+            lines = open(path).read().splitlines()
+            with open(path, "w") as f:        # drop the meta line
+                f.write("\n".join(lines[1:]) + "\n")
+        events, metas, marks = analyze.load_dir(d)
+        assert sorted({e["rank"] for e in events}) == [0, 1]
+        assert len([e for e in events if e["kind"] == "dispatch"]) == 4
+        assert all(m.get("meta_torn") for m in metas)
+
+    def test_chrome_trace_one_track_per_rank(self, tmp_path):
+        d = self._desync_dir(tmp_path)
+        events, _, _ = analyze.load_dir(d)
+        out = str(tmp_path / "trace.json")
+        n = analyze.write_trace(events, out)
+        assert n > 0
+        trace = json.load(open(out))
+        names = [e for e in trace["traceEvents"] if e["ph"] == "M"]
+        assert {e["pid"] for e in names} == {0, 1, 2}
+        spans = [e for e in trace["traceEvents"] if e["ph"] == "X"]
+        assert spans and all(e["dur"] > 0 for e in spans)
+
+    def test_cli_main(self, tmp_path, capsys):
+        d = self._desync_dir(tmp_path)
+        trace = str(tmp_path / "t.json")
+        assert analyze.main([d, "--trace", trace]) == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["desync"]["global"]["lagging_ranks"] == [2]
+        assert report["trace_events_written"] > 0
+        assert os.path.isfile(trace)
+        # empty dir is an error, not a crash
+        empty = str(tmp_path / "void")
+        os.makedirs(empty)
+        assert analyze.main([empty]) == 1
+
+
+def _smoke_job(dump_dir):
+    """Runs inside each spawned worker: a few real collectives bracketed
+    by step markers, then a forced ring dump into the shared directory."""
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.flight import recorder
+
+    recorder.set_enabled(True)
+    # rank-major stacked layout: one slice per locally-owned rank
+    nl = len(hvd.topology().local_device_ranks)
+    x = jnp.ones((nl, 4), jnp.float32)
+    for step in range(3):
+        hvd.step_marker(step)
+        hvd.allreduce(x, op=hvd.Sum)
+        hvd.allgather(x)
+    path = recorder.dump("smoke", directory=dump_dir, force=True)
+    return (hvd.cross_rank(), path)
+
+
+class TestMultiprocSmoke:
+    @pytest.mark.slow
+    def test_four_process_dumps_merge(self, shared_cluster, tmp_path_factory):
+        """4 real processes run the same collective program; the merged
+        rings agree on the per-set sequence numbers (no desync) and the
+        analyzer sees all 4 ranks and their step spans."""
+        d = str(tmp_path_factory.mktemp("flight_smoke"))
+        results = shared_cluster(
+            "localhost:1,127.0.0.1:1,127.0.0.2:1,127.0.0.3:1").run(
+                _smoke_job, args=(d,))
+        assert len(results) == 4
+        assert all(path for _, path in results)
+        events, metas, marks = analyze.load_dir(d)
+        report = analyze.analyze(events, metas, marks)
+        assert report["ranks"] == [0, 1, 2, 3]
+        # same SPMD program on every rank: identical max seq, no desync
+        for ps, entry in report["desync"].items():
+            assert not entry["desynced"], (ps, entry)
+        seqs = {e["seq"] for e in events if e["kind"] == "dispatch"}
+        assert seqs, "no dispatches recorded"
+        for rank in range(4):
+            assert report["steps"][str(rank)]["steps_marked"] == 3
