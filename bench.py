@@ -47,6 +47,10 @@ def _progress_record(phase, **extra):
         fsum, _ = _flight_summary_field()
         if fsum is not None:
             rec["flight"] = fsum
+        # Step-profiler evidence too: per-phase attribution + MFU so far.
+        ssum, _ = _step_report_field()
+        if ssum is not None:
+            rec["step_report"] = ssum
         with open(_PROGRESS_PATH, "a") as f:
             f.write(json.dumps(rec) + "\n")
     except OSError:
@@ -133,10 +137,17 @@ def _remat_default():
     return os.environ.get("HVD_BENCH_REMAT", "0") == "1"
 
 
-# Per-chip peaks for the roofline (TPU v5e: 197 TFLOP/s bf16, 819 GB/s
-# HBM — public spec sheet numbers; the env vars override for other gens).
-_PEAK_TFLOPS = float(os.environ.get("HVD_BENCH_PEAK_TFLOPS", "197"))
-_PEAK_GBS = float(os.environ.get("HVD_BENCH_PEAK_GBS", "819"))
+def _roofline_peaks():
+    """Per-chip peaks for the roofline: ONE source of truth
+    (horovod_tpu.profile.roofline's chip-detected table, the same one the
+    step profiler's MFU uses) with the historical HVD_BENCH_PEAK_* env
+    overrides kept on top."""
+    from horovod_tpu.profile import roofline as prof_roofline
+    peaks = prof_roofline.chip_peaks()
+    return (float(os.environ.get("HVD_BENCH_PEAK_TFLOPS",
+                                 peaks["bf16_tflops"])),
+            float(os.environ.get("HVD_BENCH_PEAK_GBS",
+                                 peaks["hbm_gbs"])))
 
 
 def _roofline(compiled, dt_per_step, n_chips):
@@ -146,28 +157,26 @@ def _roofline(compiled, dt_per_step, n_chips):
     (round-2 VERDICT weak #1). Numbers go to stderr; the single stdout
     JSON line stays the driver contract."""
     del n_chips  # XLA cost_analysis is already PER-DEVICE for SPMD programs
-    try:
-        cost = compiled.cost_analysis()
-        if isinstance(cost, list):           # one dict per device program
-            cost = cost[0]
-        flops = float(cost.get("flops", 0.0))
-        bytes_acc = float(cost.get("bytes accessed", 0.0))
-    except Exception as e:  # noqa: BLE001 — diagnostics must not fail bench
-        _mark(f"roofline: cost_analysis unavailable ({e})")
+    from horovod_tpu.profile import roofline as prof_roofline
+    flops, bytes_acc = prof_roofline.cost_from_compiled(compiled)
+    if flops is None:
+        _mark("roofline: cost_analysis unavailable")
         return
-    if flops <= 0 or dt_per_step <= 0:
+    bytes_acc = bytes_acc or 0.0
+    if dt_per_step <= 0:
         return
+    peak_tflops, peak_gbs = _roofline_peaks()
     achieved = flops / dt_per_step / 1e12
     intensity = flops / max(bytes_acc, 1.0)
     # time lower bounds from each roof
-    t_compute = flops / (_PEAK_TFLOPS * 1e12)
-    t_memory = bytes_acc / (_PEAK_GBS * 1e9)
+    t_compute = flops / (peak_tflops * 1e12)
+    t_memory = bytes_acc / (peak_gbs * 1e9)
     bound = "memory" if t_memory > t_compute else "compute"
     _mark(f"roofline: {flops / 1e9:.1f} GFLOP/step/chip, "
           f"{bytes_acc / 1e9:.2f} GB accessed/step/chip, "
           f"intensity {intensity:.0f} FLOP/B")
     _mark(f"roofline: achieved {achieved:.1f} TFLOP/s/chip = "
-          f"{100 * achieved / _PEAK_TFLOPS:.1f}% of peak; {bound}-bound "
+          f"{100 * achieved / peak_tflops:.1f}% of peak; {bound}-bound "
           f"(compute roof {1e3 * t_compute:.2f} ms vs memory roof "
           f"{1e3 * t_memory:.2f} ms vs measured "
           f"{1e3 * dt_per_step:.2f} ms/step)")
@@ -191,14 +200,33 @@ def _timed_steps(step, state, data, warmup=2):
     except Exception as e:  # noqa: BLE001 — fall back to the jit cache
         _mark(f"AOT compile unavailable ({e}); using jit path")
         run = step
+    # Feed the step profiler: FLOPs/step from the compiled program's cost
+    # analysis (MFU per step record) and a step marker per iteration so
+    # every BENCH record carries a step_report summary. Markers bracket
+    # DISPATCH cadence — the trailing device_get means the last window
+    # absorbs the device lag, which the summary's p50 ignores.
+    try:
+        import horovod_tpu as hvd
+        from horovod_tpu.profile import roofline as prof_roofline
+        if compiled is not None:
+            flops = prof_roofline.flops_from_compiled(compiled)
+            if flops:
+                hvd.set_flops_per_step(flops, source="cost_analysis")
+        hvd.step_marker(0)
+        _bench_step = hvd.step_marker
+    except Exception:  # noqa: BLE001 — profiling must not fail the bench
+        def _bench_step(i):
+            return None
     for i in range(warmup):
         state, loss = run(state, data)
         float(loss)
         _mark(f"warmup step {i} done")
+        _bench_step(i + 1)
     iters = int(os.environ.get("HVD_BENCH_ITERS", "20"))
     t0 = time.perf_counter()
-    for _ in range(iters):
+    for k in range(iters):
         state, loss = run(state, data)
+        _bench_step(warmup + k + 1)
     float(loss)
     dt = time.perf_counter() - t0
     _mark(f"{iters} timed steps in {dt:.2f}s")
@@ -244,6 +272,19 @@ def _flight_summary_field():
         return None, (str(e).splitlines() or ["?"])[0][:160]
 
 
+def _step_report_field():
+    """The step-profiler ride-along: per-phase attribution means, step
+    wall p50, and the MFU estimate (flops from the compiled step's cost
+    analysis). Accrues during a failing run too — a partial bench still
+    says where its steps' time went.
+    Returns ``(summary_or_None, reason_or_None)``."""
+    try:
+        from horovod_tpu.profile import ledger
+        return ledger.step_report_summary(), None
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail bench
+        return None, (str(e).splitlines() or ["?"])[0][:160]
+
+
 def _with_metrics(record):
     snap, reason = _metrics_snapshot_field()
     record["metrics_snapshot"] = snap
@@ -253,6 +294,10 @@ def _with_metrics(record):
     record["flight_summary"] = fsum
     if fsum is None:
         record["flight_summary_reason"] = freason
+    ssum, sreason = _step_report_field()
+    record["step_report"] = ssum
+    if ssum is None:
+        record["step_report_reason"] = sreason
     return record
 
 

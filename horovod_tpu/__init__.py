@@ -29,8 +29,12 @@ from horovod_tpu.common.basics import (  # noqa: F401
 )
 from horovod_tpu import metrics  # noqa: F401
 from horovod_tpu import flight  # noqa: F401
+from horovod_tpu import profile  # noqa: F401
 from horovod_tpu.flight.recorder import step_marker  # noqa: F401
 from horovod_tpu.flight.recorder import summary as flight_summary  # noqa: F401
+from horovod_tpu.profile import (  # noqa: F401
+    step_report, step_report_summary, set_flops_per_step,
+)
 from horovod_tpu.common.exceptions import (  # noqa: F401
     HorovodInternalError, HostsUpdatedInterrupt, NotInitializedError,
 )

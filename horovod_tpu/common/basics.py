@@ -101,6 +101,13 @@ def init(comm=None, process_sets=None, devices=None):
         from horovod_tpu.flight import recorder as _flight_recorder
         _flight_recorder.configure(config)
 
+        # Step profiler: arm the per-step ledger/watchdog/capture knobs
+        # before any dispatch so attribution covers the first step.
+        # Completed records survive re-init (like the flight ring); only
+        # the open window resets (basics.shutdown).
+        from horovod_tpu.profile import ledger as _profile_ledger
+        _profile_ledger.configure(config)
+
         # Decide on distributed bootstrap from the env alone: probing
         # jax.process_count() here would initialize the local backend and
         # forbid jax.distributed.initialize afterwards.
@@ -497,6 +504,20 @@ def shutdown():
             _state.timeline.close()
         from horovod_tpu import metrics as hvd_metrics
         hvd_metrics.stop_http_server()
+        # Step profiler: discard the OPEN window and bump the record
+        # epoch — an elastic reset's recovery traffic must not be
+        # attributed to the first post-restore step, and reports must not
+        # double-count across a rendezvous (completed records are kept).
+        try:
+            from horovod_tpu.profile import ledger as _profile_ledger
+            _profile_ledger.reset_window()
+            # A trace capture still open (step window never reached its
+            # stop marker, mid-/debug/profile shutdown) must flush to
+            # disk now — the session would otherwise leak past teardown.
+            from horovod_tpu.profile import capture as _profile_capture
+            _profile_capture.shutdown()
+        except Exception:  # noqa: BLE001 — profiling must not block exit
+            pass
         from horovod_tpu.common import negotiation
         negotiation.reset()
         _state = None

@@ -58,17 +58,36 @@ def install():
 
         jax.typeof = get_aval
     if not hasattr(lax, "pcast"):
-        # No varying-manual-axes type system on this jax: pcast only
-        # adjusts the static type, so the identity is semantically exact.
-        def pcast(x, axis_name, to="varying"):
-            del axis_name, to
+        # No varying-manual-axes type system on this jax — but 0.4.x
+        # shard_map DOES run a replication checker (check_rep), and its
+        # rules reject control flow whose branches/carries disagree on the
+        # rep set: exactly what mark_varying exists to harmonize. The
+        # modern pvary's moral ancestor is the internal pbroadcast
+        # primitive (drops axes from a tracer's rep set); unlike pvary it
+        # REFUSES values already varying over the axis, so the shim
+        # applies it only when the tracer's rep actually contains the
+        # axis — the idempotent pvary contract. Outside shard_map tracing
+        # (or with check_rep off) tracers carry no rep and the identity
+        # is semantically exact.
+        def _drop_rep(x, axis_name):
+            rep = getattr(x, "rep", None)
+            if rep and axis_name in rep:
+                try:
+                    from jax.experimental.shard_map import pbroadcast
+                except ImportError:
+                    return x
+                return pbroadcast(x, axis_name)
             return x
+
+        def pcast(x, axis_name, to="varying"):
+            if to != "varying":
+                return x
+            return _drop_rep(x, axis_name)
 
         lax.pcast = pcast
     if not hasattr(lax, "pvary"):
         def pvary(x, axis_name):
-            del axis_name
-            return x
+            return lax.pcast(x, axis_name, to="varying")
 
         lax.pvary = pvary
 

@@ -164,6 +164,22 @@ class Config:
     # place.
     flight_dir: str = ""
 
+    # --- step profiler (horovod_tpu/profile; the Horovod-timeline idea
+    # rebuilt as structured per-step accounting — docs/observability.md).
+    # Always-on by default: the ledger hot path is a short lock + float
+    # adds (guarded by TestStepProfilerOverhead).
+    step_profiler: bool = True
+    # JSONL stream of per-step records ("" = in-memory ring only);
+    # rendered by `python -m horovod_tpu.profile.report`.
+    step_report_file: str = ""
+    # "a:b" = capture a jax.profiler trace from the step-a marker to the
+    # step-b marker ("" = off).
+    profile_steps: str = ""
+    # Capture output directory ("" = ./profile_traces).
+    profile_dir: str = ""
+    # Watchdog cross-rank publish cadence in steps (0 = local-only).
+    profile_publish_steps: int = 16
+
     # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
     # the reference's observability stops at timeline + stall inspector).
     # Always-on by default: the registry hot path is O(1) and lock-light
@@ -276,6 +292,16 @@ class Config:
         c.flight_capacity = _env_int("HOROVOD_FLIGHT_CAPACITY",
                                      c.flight_capacity)
         c.flight_dir = os.environ.get("HOROVOD_FLIGHT_DIR", c.flight_dir)
+        c.step_profiler = _env_bool("HOROVOD_STEP_PROFILER",
+                                    c.step_profiler)
+        c.step_report_file = os.environ.get("HVD_STEP_REPORT_FILE",
+                                            c.step_report_file)
+        c.profile_steps = os.environ.get("HOROVOD_PROFILE_STEPS",
+                                         c.profile_steps)
+        c.profile_dir = os.environ.get("HOROVOD_PROFILE_DIR",
+                                       c.profile_dir)
+        c.profile_publish_steps = _env_int("HOROVOD_PROFILE_PUBLISH_STEPS",
+                                           c.profile_publish_steps)
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
         c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
         c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",

@@ -20,12 +20,14 @@ call the same negotiations in the same order.
 
 import json
 import threading
+import time
 
 import jax
 
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.metrics import instruments as _metrics
+from horovod_tpu.profile import ledger as _profile
 
 _counters = {}
 _lock = threading.Lock()
@@ -149,6 +151,9 @@ def exchange(tag, payload, procs=None):
             f"(participants: {procs})")
     proc_tag = ",".join(str(p) for p in procs)
     seq = _next_seq((tag, proc_tag))
+    # Step-profiler bracket: the whole round — publish + blocking peer
+    # reads — is control-plane time in the step attribution.
+    t_cp = time.perf_counter() if _profile.armed else None
     client = _client()
     if _chaos.armed:
         # Chaos site: a delay here stalls this rank's publish, making every
@@ -184,6 +189,8 @@ def exchange(tag, payload, procs=None):
             continue
         raw = client.blocking_key_value_get(f"{base}/{p}", _TIMEOUT_MS)
         out.append(json.loads(raw))
+    if t_cp is not None:
+        _profile.record_control_plane(time.perf_counter() - t_cp)
     return out
 
 

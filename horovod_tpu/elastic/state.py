@@ -62,12 +62,14 @@ class State:
         """Commit (save) + check for host changes (reference: elastic.py:54)."""
         self.save()
         step = getattr(self, "step", None)
-        if _flight.armed and step is not None:
+        if step is not None:
             # Step annotation BEFORE the chaos site: a crash injected at
             # this commit leaves the step marker in the victim's dump.
             # Only with a real step attribute — a step-less State must not
             # burn the auto counter the torch optimizer wrapper may be
-            # driving in the same process.
+            # driving in the same process. Not gated on _flight.armed:
+            # step_marker also feeds the step profiler's ledger (its own
+            # switch), and applies the flight gate itself.
             _flight.step_marker(step)
         if _chaos.armed:
             # Chaos site: the step boundary — where a worker crash/hang is

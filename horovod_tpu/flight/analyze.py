@@ -394,6 +394,12 @@ def write_trace(events, path):
     completed = {(e["rank"], e.get("ps"), e.get("seq"))
                  for e in events if e["kind"] == "complete"}
     trace_events = []
+    # Wall-clock anchor of ts=0 (event times are time.time() seconds):
+    # the same convention as the Timeline's clock_sync metadata, so a
+    # Chrome timeline and this trace can be rebased onto one axis
+    # (--merge-timeline does exactly that).
+    trace_events.append({"ph": "M", "name": "clock_sync", "pid": 0,
+                         "args": {"wall_t0_us": ts0 * 1e6}})
     for rank in sorted({e["rank"] for e in events}):
         trace_events.append({"ph": "M", "name": "process_name", "pid": rank,
                              "args": {"name": f"rank {rank}"}})
@@ -434,6 +440,59 @@ def write_trace(events, path):
     return len(trace_events)
 
 
+def merge_timeline(trace_path, timeline_path):
+    """Merge a :mod:`horovod_tpu.timeline` Chrome trace into a trace
+    written by :func:`write_trace`, rebasing the timeline's
+    perf_counter-based timestamps onto the flight trace's wall-clock axis
+    via both files' ``clock_sync`` metadata. Timeline tracks land under a
+    distinct pid block (10000 + original pid) so rank tracks stay
+    separate. Returns the number of events merged; 0 when either side
+    lacks its clock_sync anchor (pre-alignment trace files)."""
+    with open(trace_path) as f:
+        trace = json.load(f)
+    with open(timeline_path) as f:
+        tl = json.load(f)
+
+    def _anchor(events):
+        for e in events:
+            name = str(e.get("name", ""))
+            if name == "clock_sync" and e.get("ph") == "M":
+                return float(e.get("args", {}).get("wall_t0_us", 0.0))
+            if name.startswith("clock_sync="):
+                # native-writer form: the fixed record signature carries
+                # no args, so the anchor is folded into the name.
+                try:
+                    return float(name.split("=", 1)[1])
+                except ValueError:
+                    continue
+        return None
+
+    flight_t0 = _anchor(trace.get("traceEvents", []))
+    tl_t0 = _anchor(tl.get("traceEvents", []))
+    if flight_t0 is None or tl_t0 is None:
+        return 0
+    offset = tl_t0 - flight_t0
+    merged = 0
+    for e in tl.get("traceEvents", []):
+        name = str(e.get("name", ""))
+        if name == "clock_sync" or name.startswith("clock_sync="):
+            # both anchor forms (python metadata / native folded-name
+            # instant) are consumed, never copied — a stale rebased
+            # anchor in the merged file would poison a later merge pass.
+            continue
+        e = dict(e)
+        e["ts"] = float(e.get("ts", 0.0)) + offset
+        e["pid"] = 10000 + int(e.get("pid", 0))
+        trace["traceEvents"].append(e)
+        merged += 1
+    trace["traceEvents"].append({
+        "ph": "M", "name": "process_name", "pid": 10000,
+        "args": {"name": f"timeline {timeline_path}"}})
+    with open(trace_path, "w") as f:
+        json.dump(trace, f)
+    return merged
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(
         prog="python -m horovod_tpu.flight.analyze",
@@ -445,6 +504,11 @@ def main(argv=None):
                                     "(defaults to the dump directory)")
     p.add_argument("--trace", help="also write a merged Chrome trace "
                                    "(Perfetto-loadable) to this path")
+    p.add_argument("--merge-timeline",
+                   help="a horovod_tpu.timeline Chrome-trace file to merge "
+                        "into --trace, rebased via both files' clock_sync "
+                        "anchors (one view: timeline spans + flight "
+                        "forensics)")
     args = p.parse_args(argv)
     events, metas, driver_marks = load_dir(args.directory,
                                            ledger_dir=args.ledger)
@@ -456,6 +520,9 @@ def main(argv=None):
     if args.trace:
         report["trace_events_written"] = write_trace(events, args.trace)
         report["trace_path"] = args.trace
+        if args.merge_timeline:
+            report["timeline_events_merged"] = merge_timeline(
+                args.trace, args.merge_timeline)
     json.dump(report, sys.stdout, indent=1)
     print()
     return 0

@@ -334,6 +334,20 @@ def record_event(kind, **fields):
     get().record_event(kind, **fields)
 
 
+# Step-boundary listener (the step profiler's ledger registers here):
+# step markers are the shared step-clock call sites, and the profiler has
+# its own arming switch independent of the forensics ring — so the
+# listener fires BEFORE the `armed` gate. One slot, set at import by
+# horovod_tpu.profile.ledger; a listener that raises is the listener's
+# bug to contain (the ledger wraps itself in try/except).
+_step_listener = None
+
+
+def set_step_listener(fn):
+    global _step_listener
+    _step_listener = fn
+
+
 def step_marker(step=None):
     """User/optimizer step annotation: ``hvd.step_marker(step)``. With no
     argument an internal monotonic counter supplies the step (the
@@ -341,6 +355,9 @@ def step_marker(step=None):
     been marked, auto marks are suppressed — under torch+elastic both the
     optimizer's ``step()`` and ``State.commit`` fire per training step,
     and interleaving two counters would halve every analyzed step span."""
+    listener = _step_listener
+    if listener is not None:
+        listener(step)
     if not armed:
         return
     r = get()

@@ -31,6 +31,10 @@ Catalogue (names shown without the ``HOROVOD_METRICS_PREFIX``, default
 - ``stall_events_total{kind}``                      warning|shutdown (counter)
 - ``kv_client_retries_total``                       HTTP-KV client retries (counter)
 - ``chaos_injections_total{site,kind}``             chaos faults fired (counter)
+- ``step_time_seconds``                             step wall time from the
+  step profiler's marker-to-marker windows (histogram)
+- ``step_profiler_events_total{kind}``              watchdog findings:
+  straggler|regression (counter; horovod_tpu/profile)
 """
 
 import os
@@ -165,6 +169,17 @@ CHAOS_INJECTIONS = REGISTRY.counter(
     "Faults fired by the chaos injection runtime (horovod_tpu/chaos; "
     "always zero unless a HOROVOD_CHAOS_PLAN is armed).",
     ("site", "kind"))
+STEP_TIME = REGISTRY.histogram(
+    "step_time_seconds",
+    "Training-step wall time measured by the step profiler's "
+    "marker-to-marker windows (hvd.step_marker / optimizer wrapper / "
+    "elastic State.commit).",
+    buckets=exponential_buckets(1e-4, 2.0, 22))        # 100us .. ~3.5min
+STEP_PROFILER_EVENTS = REGISTRY.counter(
+    "step_profiler_events_total",
+    "Online watchdog findings from the step profiler "
+    "(kind=straggler|regression; horovod_tpu/profile/watchdog.py).",
+    ("kind",))
 
 
 # --- recording helpers (the stack's API) --------------------------------
@@ -312,6 +327,31 @@ def record_chaos(site, kind):
     if not _enabled:
         return
     CHAOS_INJECTIONS.labels(site, kind).inc()
+
+
+def record_step(seconds):
+    """One completed step-profiler window (wall seconds)."""
+    if not _enabled:
+        return
+    STEP_TIME.observe(seconds)
+
+
+def record_profiler_event(kind):
+    """One watchdog finding (kind=straggler|regression)."""
+    if not _enabled:
+        return
+    STEP_PROFILER_EVENTS.labels(kind).inc()
+
+
+def record_profiler_kv(sets=0, gets=0):
+    """Watchdog cross-rank publish traffic on the coordination service —
+    reported under control_plane_rpcs_total like every other KV user."""
+    if not _enabled:
+        return
+    if sets:
+        CONTROL_PLANE_RPCS.labels("coord", "prof_set").inc(sets)
+    if gets:
+        CONTROL_PLANE_RPCS.labels("coord", "prof_get").inc(gets)
 
 
 def record_stall(kind):

@@ -20,8 +20,57 @@ class _MetricsHandler(BaseHTTPRequestHandler):
     def log_message(self, fmt, *args):  # silence
         pass
 
+    def _send_json(self, body, code=200,
+                   content_type="application/json"):
+        data = body if isinstance(body, bytes) else body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
     def do_GET(self):
-        path = self.path.split("?", 1)[0]
+        import json as _json
+        from urllib.parse import parse_qs, urlsplit
+
+        split = urlsplit(self.path)
+        path = split.path
+        if path == "/debug/profile":
+            # On-demand jax.profiler capture: block this handler thread
+            # for ?ms=<window> (default 1000, cap 60000), dump the trace
+            # under HOROVOD_PROFILE_DIR, and answer with the capture
+            # directory. 409 when a capture is already running — the
+            # profiler session is process-global.
+            from horovod_tpu.profile import capture
+            try:
+                ms = int(parse_qs(split.query).get("ms", ["1000"])[0])
+            except ValueError:
+                ms = 1000
+            # Clamped HERE too so the response reports the window that
+            # actually ran, not the client's ask.
+            ms = capture.clamp_ms(ms)
+            d = capture.capture_ms(ms, tag="debug_endpoint")
+            if d is None:
+                self._send_json(_json.dumps(
+                    {"error": "capture already running or profiler "
+                              "unavailable"}), code=409)
+                return
+            self._send_json(_json.dumps({"path": d, "ms": ms}))
+            return
+        if path == "/debug/steps":
+            # The step profiler's recent records + aggregate — the live
+            # counterpart of the HVD_STEP_REPORT_FILE stream.
+            from horovod_tpu.profile import ledger
+            try:
+                last = int(parse_qs(split.query).get("last", ["32"])[0])
+            except ValueError:
+                last = 32
+            # records(last=...) (not step_report): "records" must be a
+            # list even for last=1.
+            self._send_json(_json.dumps({
+                "summary": ledger.step_report_summary(),
+                "records": ledger.get().records(last=last)}))
+            return
         if path == "/debug/flight":
             # On-demand flight-recorder dump: the ring served directly
             # (meta line + events as JSONL), no file written — the live

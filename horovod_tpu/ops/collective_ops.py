@@ -38,6 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.flight import recorder as _flight
+from horovod_tpu.profile import ledger as _profile
 from horovod_tpu.common.exceptions import TensorShapeMismatchError
 from horovod_tpu.common.process_sets import global_process_set
 from horovod_tpu.common.topology import HVD_AXIS
@@ -178,6 +179,14 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None,
     from horovod_tpu.metrics import instruments as hvd_metrics
     if op_label is None:
         op_label = op_kind.lower()
+    # Profiler bracket opens BEFORE the chaos site: an injected delay is a
+    # host-side stall of THIS rank's dispatch path, and landing it in the
+    # ledger's host_dispatch category is what lets the watchdog name the
+    # straggler by its own-rank signal (its peers book the wait under
+    # `collective` instead).
+    profile_on = _profile.armed
+    if profile_on:
+        t_api = time.perf_counter()
     if _chaos.armed:
         # Chaos site: a delay here holds THIS rank's enqueue back while its
         # peers dispatch — the straggler mode of the SPMD contract.
@@ -186,7 +195,7 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None,
     # O(n_tensors) and must cost nothing under HOROVOD_METRICS=0.
     metrics_on = hvd_metrics.enabled()
     flight_on = _flight.armed
-    if metrics_on or flight_on:
+    if metrics_on or flight_on or profile_on:
         nbytes = sum(getattr(t, "nbytes", 0) for t in tensors)
         if ps_label is None:
             ps_label = _ps_label(process_set)
@@ -215,12 +224,18 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None,
         with jax.profiler.TraceAnnotation(f"hvd::{op_kind}::{name}"):
             with span:
                 yield
+        if metrics_on or flight_on or profile_on:
+            dur = time.perf_counter() - t0
         if metrics_on:
-            hvd_metrics.record_collective_latency(
-                op_label, time.perf_counter() - t0)
+            hvd_metrics.record_collective_latency(op_label, dur)
         if flight_on:
-            _flight.record_complete(op_label, ps_label, fl_seq,
-                                    time.perf_counter() - t0)
+            _flight.record_complete(op_label, ps_label, fl_seq, dur)
+        if profile_on:
+            # dur covers the program call (+ localize on the caller side
+            # of the yield) = `collective`; everything else between the
+            # bracket open and here is dispatch-path overhead.
+            _profile.record_dispatch(
+                op_label, dur, time.perf_counter() - t_api - dur, nbytes)
     except (ValueError, RuntimeError) as e:
         _translate_dispatch_error(name, op_label, e)
 
@@ -705,12 +720,16 @@ class _DispatchPlan:
         self._stage_memo = {}
 
     def run(self, tensors, name=None):
+        # Profiler bracket opens at API entry so input staging (and the
+        # chaos delay site inside dispatch) land in host_dispatch.
+        t_api = time.perf_counter() if _profile.armed else None
         if self.multi:
             sharding = self.sharding
             staged = [jax.make_array_from_process_local_data(
                           sharding, np.asarray(t), g)
                       for t, g in zip(tensors, self.global_shapes)]
-            return self.dispatch(staged, name, prog=self.program)
+            return self.dispatch(staged, name, prog=self.program,
+                                 t_api=t_api)
         sharding = self.sharding
         staged = []
         passthrough = True
@@ -747,7 +766,7 @@ class _DispatchPlan:
         prog = self.donate_program \
             if self.donate_program is not None and passthrough \
             else self.program
-        return self.dispatch(staged, name, prog=prog)
+        return self.dispatch(staged, name, prog=prog, t_api=t_api)
 
     def _program_for(self, staged):
         """The donating program applies only when every input is already a
@@ -763,8 +782,11 @@ class _DispatchPlan:
                 return self.program
         return self.donate_program
 
-    def dispatch(self, staged, name=None, prog=None):
+    def dispatch(self, staged, name=None, prog=None, t_api=None):
         from horovod_tpu.metrics import instruments as hvd_metrics
+        profile_on = _profile.armed
+        if profile_on and t_api is None:
+            t_api = time.perf_counter()
         if _chaos.armed:
             _chaos.fire("collective.dispatch")
         if prog is None:
@@ -784,6 +806,8 @@ class _DispatchPlan:
             # Observability (timeline/metrics) off: no span/annotation
             # bookkeeping — the compiled call, error translation, and the
             # always-armed flight record above.
+            if profile_on:
+                t0p = time.perf_counter()
             try:
                 outs = prog(*staged)
             except (ValueError, RuntimeError) as e:
@@ -792,7 +816,14 @@ class _DispatchPlan:
             if flight_on:
                 _flight.record_complete(self.op_label, self.ps_label,
                                         fl_seq, time.perf_counter() - t0f)
-            return self._localize(outs)
+            outs = self._localize(outs)
+            if profile_on:
+                # collective = program + localize (the multi-process
+                # peer-wait); host_dispatch = everything before the call.
+                _profile.record_dispatch(
+                    self.op_label, time.perf_counter() - t0p,
+                    t0p - t_api, self.nbytes)
+            return outs
         # Inline _timeline_op with the plan's precomputed labels/byte
         # count (no contextmanager frame, no per-call nbytes walk; the
         # XPlane TraceAnnotation rides only with an active timeline).
@@ -800,6 +831,8 @@ class _DispatchPlan:
             hvd_metrics.record_collective(self.op_label, self.nbytes,
                                           self.ps_label)
             t0 = time.perf_counter()
+        if profile_on:
+            t0p = time.perf_counter()
         try:
             if tl is not None:
                 with jax.profiler.TraceAnnotation(
@@ -818,7 +851,12 @@ class _DispatchPlan:
         except (ValueError, RuntimeError) as e:
             _translate_dispatch_error(name or self.default_name,
                                       self.op_label, e)
-        return self._localize(outs)
+        outs = self._localize(outs)
+        if profile_on:
+            _profile.record_dispatch(
+                self.op_label, time.perf_counter() - t0p,
+                t0p - t_api, self.nbytes)
+        return outs
 
     def _localize(self, outs):
         """Per-process local rows of each output (multi-process), with the

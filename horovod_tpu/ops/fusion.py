@@ -35,6 +35,7 @@ from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.common.topology import HVD_AXIS
 from horovod_tpu.flight import recorder as _flight
+from horovod_tpu.profile import ledger as _profile
 from horovod_tpu.ops.collective_ops import (ReduceOp, _localize, _prepare,
                                             _reduce_shard)
 
@@ -219,6 +220,10 @@ class FusionRuntime:
         self._pending = []  # (tid, tensor, op, prescale, postscale, handle)
         self._pending_bytes = 0
         self._last_enqueue = 0.0
+        # perf_counter of the enqueue that made the pending set non-empty:
+        # flush_start - first_enqueue is the bucket's DEFER window (the
+        # step profiler's fusion_defer_s).
+        self._first_enqueue = 0.0
         self._next_tid = 0
         self._flushed_groups = []  # group ids to deregister after flush
         self._pending_groups = []  # follower: grouped tids awaiting replay
@@ -607,6 +612,8 @@ class FusionRuntime:
             tid = self._next_tid
             self._next_tid += 1
             handle = FusedHandle(self, name, tid=tid)
+            if not self._pending:
+                self._first_enqueue = time.perf_counter()
             self._pending.append((tid, tensor, ReduceOp(op), float(prescale),
                                   float(postscale), handle))
             self._pending_bytes += tensor.nbytes
@@ -663,6 +670,8 @@ class FusionRuntime:
                     self._flushed_groups.append(
                         self._native.register_group(tids))
             flush = False
+            if not self._pending and tids:
+                self._first_enqueue = time.perf_counter()
             for tid, t, key, h in zip(tids, tensors, keys, handles):
                 self._pending.append((tid, t, op, float(prescale),
                                       float(postscale), h))
@@ -785,6 +794,16 @@ class FusionRuntime:
         coordinator flushed when it published that boundary."""
         if not self._pending:
             return
+        # Step-profiler bracket: the flush's wall time minus the fused
+        # program dispatches recorded inside it (they book under
+        # `collective` via _timeline_op) is the fusion runtime's own
+        # overhead — bucket assembly, staging, scheduler bookkeeping.
+        profile_on = _profile.armed
+        if profile_on:
+            t_f0 = time.perf_counter()
+            coll0 = _profile.collective_total()
+            defer_s = max(t_f0 - self._first_enqueue, 0.0) \
+                if self._first_enqueue else 0.0
         if _chaos.armed:
             # Chaos site: a delay here stalls the flush UNDER the runtime
             # lock — every gradient-hook enqueue blocks behind it, the
@@ -953,6 +972,14 @@ class FusionRuntime:
                 continue
             for (_, h), o in zip(items, outs):
                 h._set(o)
+        if profile_on:
+            self._first_enqueue = 0.0 if not self._pending \
+                else self._first_enqueue
+            _profile.record_fusion_flush(
+                time.perf_counter() - t_f0,
+                _profile.collective_total() - coll0, defer_s,
+                wire_dtype=jnp.dtype(wire_now).name if wire_now else None,
+                wire_bytes=flushed_bytes)
         # Mirror registry totals into the timeline as counter events
         # (throttled inside), so aggregate series and op spans land in the
         # same chrome://tracing file.

@@ -1,0 +1,36 @@
+"""Step profiler: per-step time attribution, MFU/roofline accounting, and
+on-demand trace capture.
+
+The Horovod-timeline idea (PAPERS.md: arxiv 1802.05799) rebuilt as
+structured accounting with the efficiency discipline of the MLPerf TPU-pod
+scaling study (arxiv 1909.09756): instead of a trace you read by eye, every
+training step closes into ONE structured record attributing its wall time
+to compute vs collective vs host-dispatch vs fusion vs control-plane, plus
+roofline context (achieved TFLOP/s vs the chip peak, bytes/s vs the
+ICI/DCN roof).
+
+Modules:
+
+- :mod:`horovod_tpu.profile.ledger`   — the per-step performance ledger
+  (the hot-path instrumentation target; ``hvd.step_report()``)
+- :mod:`horovod_tpu.profile.roofline` — per-chip peak tables + MFU math
+- :mod:`horovod_tpu.profile.watchdog` — online straggler/regression
+  detection (rolling robust z-score, low-cadence cross-rank KV publish)
+- :mod:`horovod_tpu.profile.capture`  — on-demand ``jax.profiler`` trace
+  windows (``GET /debug/profile``, ``HOROVOD_PROFILE_STEPS=a:b``)
+- :mod:`horovod_tpu.profile.report`   — ``python -m
+  horovod_tpu.profile.report`` CLI over the ``HVD_STEP_REPORT_FILE`` JSONL
+
+Knobs (docs/observability.md): ``HOROVOD_STEP_PROFILER`` (default on),
+``HVD_STEP_REPORT_FILE`` (JSONL stream), ``HOROVOD_PROFILE_STEPS``,
+``HOROVOD_PROFILE_DIR``, ``HOROVOD_PROFILE_PUBLISH_STEPS``.
+"""
+
+from horovod_tpu.profile.ledger import (  # noqa: F401
+    step_report, step_report_summary, set_flops_per_step, reset_window,
+    configure, enabled, set_enabled,
+)
+from horovod_tpu.profile.roofline import (  # noqa: F401
+    chip_peaks, detect_chip,
+)
+from horovod_tpu.profile.watchdog import findings as watchdog_findings  # noqa: F401

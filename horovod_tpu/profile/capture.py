@@ -1,0 +1,175 @@
+"""On-demand ``jax.profiler`` trace capture, aligned with the flight
+recorder's clock.
+
+Two triggers, one implementation:
+
+- ``GET /debug/profile?ms=500`` on the metrics scrape endpoint
+  (:mod:`horovod_tpu.metrics.server`) — capture a wall-clock window right
+  now, from outside the process;
+- ``HOROVOD_PROFILE_STEPS=a:b`` — capture a STEP window: the trace starts
+  at the step-``a`` marker and stops at the step-``b`` marker (i.e. it
+  covers steps ``a+1..b``), driven by the ledger's step boundaries.
+
+Each capture directory gets a ``clock_sync.json`` recording the wall
+clock (``time.time()``, the flight recorder's event time base and the
+timeline's clock_sync anchor) at trace start/stop, so the XPlane trace,
+the merged flight Perfetto trace, and the Chrome timeline can be rebased
+onto one axis offline.
+
+Captures are process-local and serialized by a lock — ``jax.profiler``
+has one global trace session; a second concurrent request is refused
+(HTTP 409 on the endpoint) instead of corrupting the first.
+"""
+
+import json
+import os
+import threading
+import time
+
+DEFAULT_DIR = "profile_traces"
+
+_lock = threading.Lock()
+_active_dir = None
+_window = None            # (start_step, stop_step) from HOROVOD_PROFILE_STEPS
+_step_capture_dir = None  # the capture the STEP WINDOW owns (on_step must
+                          # never stop a /debug/profile capture it didn't
+                          # start)
+_base_dir = ""
+_captures = 0
+_MAX_STEP_CAPTURES = 4    # runaway guard for re-entered step windows
+
+
+def trace_dir():
+    return _base_dir or os.environ.get("HOROVOD_PROFILE_DIR") \
+        or DEFAULT_DIR
+
+
+def configure_window(spec, base_dir=""):
+    """Parse ``HOROVOD_PROFILE_STEPS`` (``a:b``, ints, a < b). Returns
+    True when a valid window is armed."""
+    global _window, _base_dir
+    if base_dir:
+        _base_dir = base_dir
+    if not spec:
+        return False
+    try:
+        a, b = spec.split(":", 1)
+        a, b = int(a), int(b)
+    except ValueError:
+        return False
+    if b <= a:
+        return False
+    _window = (a, b)
+    return True
+
+
+def _capture_path(tag):
+    rank = os.environ.get("HOROVOD_CROSS_RANK", "0")
+    return os.path.join(trace_dir(), f"{tag}_r{rank}_{int(time.time())}")
+
+
+def _write_clock_sync(d, extra=None):
+    try:
+        payload = {"wall_s": round(time.time(), 6),
+                   "perf_ns": time.perf_counter_ns()}
+        if extra:
+            payload.update(extra)
+        with open(os.path.join(d, "clock_sync.json"), "a") as f:
+            f.write(json.dumps(payload) + "\n")
+    except OSError:
+        pass
+
+
+def start(tag="ondemand"):
+    """Start a trace into a fresh capture directory; returns the path or
+    None (already tracing, or jax.profiler unavailable)."""
+    global _active_dir
+    with _lock:
+        if _active_dir is not None:
+            return None
+        d = _capture_path(tag)
+        try:
+            os.makedirs(d, exist_ok=True)
+            import jax
+            jax.profiler.start_trace(d)
+        except Exception:  # noqa: BLE001 — capture must never fail the job
+            return None
+        _active_dir = d
+    _write_clock_sync(d, {"event": "start", "tag": tag})
+    return d
+
+
+def stop():
+    """Stop the active trace; returns its directory or None."""
+    global _active_dir
+    with _lock:
+        d = _active_dir
+        if d is None:
+            return None
+        try:
+            import jax
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001
+            pass
+        _active_dir = None
+    _write_clock_sync(d, {"event": "stop"})
+    return d
+
+
+def active():
+    return _active_dir
+
+
+MAX_CAPTURE_MS = 60_000
+
+
+def clamp_ms(ms):
+    """The capture-window clamp — shared with the ``/debug/profile``
+    handler so the response reports the window that actually ran."""
+    return max(1, min(int(ms), MAX_CAPTURE_MS))
+
+
+def capture_ms(ms, tag="ondemand"):
+    """Blocking wall-clock capture (the ``/debug/profile`` handler): start,
+    sleep ``ms`` (clamped), stop. Returns the capture directory or None
+    when a capture is already running."""
+    ms = clamp_ms(ms)
+    d = start(tag)
+    if d is None:
+        return None
+    time.sleep(ms / 1000.0)
+    stop()
+    return d
+
+
+def on_step(step):
+    """Ledger step-boundary hook for the HOROVOD_PROFILE_STEPS window.
+    Only ever stops the capture IT started: a concurrent
+    ``/debug/profile`` capture occupying the (process-global) profiler
+    slot is left alone — the step window then simply never fires."""
+    global _window, _captures, _step_capture_dir
+    w = _window
+    if w is None:
+        return
+    a, b = w
+    if _step_capture_dir is None and _active_dir is None \
+            and a <= step < b and _captures < _MAX_STEP_CAPTURES:
+        d = start(f"steps{a}_{b}")
+        if d is not None:
+            _step_capture_dir = d
+            _captures += 1
+    elif _step_capture_dir is not None and step >= b:
+        if _active_dir == _step_capture_dir:
+            stop()
+        _step_capture_dir = None
+        _window = None          # one-shot: the window is consumed
+
+
+def shutdown():
+    """Close any capture still open (called by ``basics.shutdown``): a
+    job that ends — or elastically resets — before the step window's stop
+    marker must still flush its trace to disk instead of leaking an open
+    profiler session; ``stop()`` is a no-op when nothing is active."""
+    global _step_capture_dir
+    _step_capture_dir = None
+    return stop()

@@ -1,0 +1,130 @@
+"""Per-chip peak tables and MFU/roofline math.
+
+Methodology (docs/performance.md): MFU is achieved model FLOP/s divided by
+the chip's peak dense bf16 FLOP/s — model FLOPs come from
+``Compiled.cost_analysis()`` (XLA's per-device estimate) or an explicit
+``hvd.set_flops_per_step()`` (the paper-formula route, e.g. ``6·N·B·L``
+for transformer training, the convention of the PaLM/MLPerf accounting).
+Wire utilization is collective bytes-on-wire per second against the
+interconnect roof: the ICI roof within a slice, the DCN roof across
+slices/hosts.
+
+Peak numbers are public spec-sheet figures per chip; the CPU row is an
+order-of-magnitude placeholder so the CPU tier still produces ratios
+(clearly labeled estimates). Override any peak with the env knobs
+``HOROVOD_PEAK_TFLOPS`` / ``HOROVOD_PEAK_HBM_GBS`` /
+``HOROVOD_PEAK_ICI_GBS`` / ``HOROVOD_PEAK_DCN_GBS``.
+"""
+
+import os
+
+# chip -> peaks: dense bf16 TFLOP/s, HBM GB/s, ICI GB/s per chip
+# (aggregate across links, one direction), DCN GB/s per host.
+PEAKS = {
+    # TPU v4: 275 TFLOP/s bf16, 32 GiB HBM2 @ 1228 GB/s, 6 ICI links
+    # x 50 GB/s.
+    "v4": {"bf16_tflops": 275.0, "hbm_gbs": 1228.0, "ici_gbs": 300.0,
+           "dcn_gbs": 25.0},
+    # TPU v5e: 197 TFLOP/s bf16, 16 GiB HBM2 @ 819 GB/s, 1600 Gbps ICI.
+    "v5e": {"bf16_tflops": 197.0, "hbm_gbs": 819.0, "ici_gbs": 200.0,
+            "dcn_gbs": 25.0},
+    # TPU v5p: 459 TFLOP/s bf16, 95 GiB HBM @ 2765 GB/s, 4800 Gbps ICI.
+    "v5p": {"bf16_tflops": 459.0, "hbm_gbs": 2765.0, "ici_gbs": 600.0,
+            "dcn_gbs": 25.0},
+    # CPU tier (tests, dry runs): order-of-magnitude placeholder so MFU
+    # ratios stay computable — never quote these as hardware truth.
+    "cpu": {"bf16_tflops": 0.2, "hbm_gbs": 50.0, "ici_gbs": 10.0,
+            "dcn_gbs": 10.0, "estimate": True},
+}
+
+
+def detect_chip():
+    """Best-effort chip generation from the local backend: one of the
+    PEAKS keys. Never raises (profiling must not fail the job)."""
+    try:
+        import jax
+        dev = jax.devices()[0]
+        if dev.platform != "tpu":
+            return "cpu"
+        kind = (getattr(dev, "device_kind", "") or "").lower()
+        if "v5p" in kind or "v5 p" in kind:
+            return "v5p"
+        if "v5e" in kind or "v5 lite" in kind or "v5litepod" in kind:
+            return "v5e"
+        if "v4" in kind:
+            return "v4"
+        if "v5" in kind:
+            return "v5e"
+        return "cpu"
+    except Exception:  # noqa: BLE001
+        return "cpu"
+
+
+def chip_peaks(chip=None):
+    """Peak table for ``chip`` (default: detected), env overrides
+    applied. Returns a fresh dict: ``bf16_tflops``, ``hbm_gbs``,
+    ``ici_gbs``, ``dcn_gbs``, ``chip`` (+ ``estimate`` on the CPU row)."""
+    chip = chip or detect_chip()
+    peaks = dict(PEAKS.get(chip, PEAKS["cpu"]))
+    peaks["chip"] = chip
+
+    def _ovr(env, key):
+        v = os.environ.get(env)
+        if v:
+            try:
+                peaks[key] = float(v)
+            except ValueError:
+                pass
+
+    _ovr("HOROVOD_PEAK_TFLOPS", "bf16_tflops")
+    _ovr("HOROVOD_PEAK_HBM_GBS", "hbm_gbs")
+    _ovr("HOROVOD_PEAK_ICI_GBS", "ici_gbs")
+    _ovr("HOROVOD_PEAK_DCN_GBS", "dcn_gbs")
+    return peaks
+
+
+def cost_from_compiled(compiled):
+    """Per-device (FLOPs, bytes-accessed) of one compiled program from
+    XLA's cost analysis (``Compiled.cost_analysis()`` is already
+    per-device for SPMD programs). ``(None, None)`` when unavailable."""
+    try:
+        cost = compiled.cost_analysis()
+        if isinstance(cost, list):
+            cost = cost[0]
+        flops = float(cost.get("flops", 0.0))
+        nbytes = float(cost.get("bytes accessed", 0.0))
+        return (flops if flops > 0 else None,
+                nbytes if nbytes > 0 else None)
+    except Exception:  # noqa: BLE001
+        return None, None
+
+
+def flops_from_compiled(compiled):
+    """FLOPs half of :func:`cost_from_compiled` — callers without a
+    bandwidth story (the ledger's MFU) fall back to
+    ``hvd.set_flops_per_step()`` on None."""
+    return cost_from_compiled(compiled)[0]
+
+
+def mfu(flops_per_step, step_seconds, peaks=None):
+    """Model FLOP/s utilization: achieved TFLOP/s / peak bf16 TFLOP/s.
+    Returns ``(mfu_fraction, achieved_tflops)`` or ``(None, None)``."""
+    if not flops_per_step or not step_seconds or step_seconds <= 0:
+        return None, None
+    peaks = peaks or chip_peaks()
+    achieved = flops_per_step / step_seconds / 1e12
+    peak = peaks.get("bf16_tflops") or 0.0
+    return (achieved / peak if peak > 0 else None), achieved
+
+
+def wire_utilization(bytes_on_wire, step_seconds, peaks=None,
+                     cross_host=False):
+    """Collective bytes/s against the interconnect roof (ICI within a
+    slice, DCN across hosts). Returns ``(fraction, gbytes_per_s)`` or
+    ``(None, None)``."""
+    if not bytes_on_wire or not step_seconds or step_seconds <= 0:
+        return None, None
+    peaks = peaks or chip_peaks()
+    gbs = bytes_on_wire / step_seconds / 1e9
+    roof = peaks.get("dcn_gbs" if cross_host else "ici_gbs") or 0.0
+    return (gbs / roof if roof > 0 else None), gbs

@@ -57,6 +57,12 @@ _ARG_ENV_MAP = [
     ("chaos_plan", "HOROVOD_CHAOS_PLAN", str),
     ("chaos_seed", "HOROVOD_CHAOS_SEED", str),
     ("chaos_ledger", "HOROVOD_CHAOS_LEDGER", str),
+    ("no_step_profiler", "HOROVOD_STEP_PROFILER",
+     lambda v: "0" if v else None),
+    ("step_report_file", "HVD_STEP_REPORT_FILE", str),
+    ("profile_steps", "HOROVOD_PROFILE_STEPS", str),
+    ("profile_dir", "HOROVOD_PROFILE_DIR", str),
+    ("profile_publish_steps", "HOROVOD_PROFILE_PUBLISH_STEPS", str),
 ]
 
 

@@ -167,6 +167,29 @@ def parse_args(argv=None):
                         help="Disable the always-armed flight recorder "
                              "(HOROVOD_FLIGHT_RECORDER=0).")
 
+    profiler = p.add_argument_group("step profiler")
+    profiler.add_argument("--no-step-profiler", action="store_true",
+                          dest="no_step_profiler",
+                          help="Disable the always-on step profiler "
+                               "(HOROVOD_STEP_PROFILER=0).")
+    profiler.add_argument("--step-report-file", dest="step_report_file",
+                          help="Per-step attribution JSONL stream "
+                               "(HVD_STEP_REPORT_FILE), exported to every "
+                               "worker; render with `python -m "
+                               "horovod_tpu.profile.report`.")
+    profiler.add_argument("--profile-steps", dest="profile_steps",
+                          help="a:b — capture a jax.profiler trace from "
+                               "the step-a marker to the step-b marker "
+                               "(HOROVOD_PROFILE_STEPS).")
+    profiler.add_argument("--profile-dir", dest="profile_dir",
+                          help="Trace-capture output directory "
+                               "(HOROVOD_PROFILE_DIR).")
+    profiler.add_argument("--profile-publish-steps", type=int,
+                          dest="profile_publish_steps",
+                          help="Watchdog cross-rank publish cadence in "
+                               "steps (HOROVOD_PROFILE_PUBLISH_STEPS; "
+                               "0 = local-only).")
+
     chaos = p.add_argument_group("chaos")
     chaos.add_argument("--chaos-plan", dest="chaos_plan",
                        help="Fault-injection plan exported to every worker "
@@ -306,6 +329,14 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
     if os.environ.get("HOROVOD_FLIGHT_RECORDER"):
         env.setdefault("HOROVOD_FLIGHT_RECORDER",
                        os.environ["HOROVOD_FLIGHT_RECORDER"])
+    # Step-profiler knobs ride through to every worker (the ledger/
+    # watchdog/capture run per process; the JSONL stream and capture dirs
+    # are shared collection points like the flight dir).
+    for var in ("HOROVOD_STEP_PROFILER", "HVD_STEP_REPORT_FILE",
+                "HOROVOD_PROFILE_STEPS", "HOROVOD_PROFILE_DIR",
+                "HOROVOD_PROFILE_PUBLISH_STEPS"):
+        if os.environ.get(var):
+            env.setdefault(var, os.environ[var])
     # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU
     # device: pin each worker's device count to its slot count so the world
     # size equals the requested slots regardless of ambient XLA_FLAGS.

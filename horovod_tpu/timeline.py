@@ -28,6 +28,12 @@ class Timeline:
         self.mark_cycles = mark_cycles
         self._closed = False
         self._t0 = time.perf_counter_ns()
+        # Wall-clock anchor of ts=0, captured at the same instant as _t0:
+        # the flight recorder's events (and its analyzer's Perfetto trace)
+        # run on time.time(), while this timeline runs on perf_counter —
+        # the clock_sync metadata event below is what lets the two merge
+        # onto one axis (flight.analyze --merge-timeline).
+        self.wall_t0_us = time.time() * 1e6
         # Prefer the C++ writer (lock-minimal queue + drain thread,
         # reference: timeline.cc TimelineWriter); fall back to the Python
         # thread when the native lib isn't built.
@@ -44,6 +50,20 @@ class Timeline:
             self._events = []
             self._writer = threading.Thread(target=self._drain, daemon=True)
             self._writer.start()
+        self._emit_clock_sync()
+
+    def _emit_clock_sync(self):
+        """First event of every trace: the wall-clock anchor. The python
+        writer emits a metadata event (invisible as a span, machine-
+        readable by the merge); the native writer's fixed record signature
+        carries it folded into an instant-event name instead."""
+        if self._native is not None:
+            self._native.record(f"clock_sync={self.wall_t0_us:.1f}",
+                                "clock", "i", 0.0, 0.0, 0)
+            return
+        self._queue.put({"name": "clock_sync", "ph": "M", "cat": "clock",
+                         "ts": 0.0, "pid": 0, "tid": 0,
+                         "args": {"wall_t0_us": self.wall_t0_us}})
 
     # --- recording -----------------------------------------------------
     def _now_us(self):
@@ -80,6 +100,13 @@ class Timeline:
         if self.mark_cycles:
             self.record("CYCLE", "i", "cycle", self._now_us(),
                         args={"s": "g"})
+
+    def mark_step(self, step):
+        """Step bracket: one instant per training-step boundary (the step
+        profiler's marker sites feed this), so op spans group by step in
+        the same view as the flight analyzer's per-step reconstruction."""
+        self.record(f"STEP {step}" if step is not None else "STEP", "i",
+                    "step", self._now_us(), tid=0)
 
     def record_counter(self, name, value, ts_us=None):
         """Chrome-trace COUNTER event ("ph": "C"): one sample of a named

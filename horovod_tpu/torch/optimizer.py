@@ -121,11 +121,12 @@ class _DistributedOptimizer(torch.optim.Optimizer):
         if self._should_synchronize:
             self.synchronize()
         self._synchronized = False
-        if _flight.armed:
-            # Automatic step annotation: step() is the host-side training
-            # step boundary, so the flight ring's step spans need no user
-            # instrumentation on this frontend.
-            _flight.step_marker()
+        # Automatic step annotation: step() is the host-side training
+        # step boundary, so the flight ring's step spans need no user
+        # instrumentation on this frontend. Not gated on _flight.armed:
+        # step_marker also feeds the step profiler's ledger (its own
+        # switch) and applies the flight gate itself.
+        _flight.step_marker()
         return super(self.__class__, self).step(closure)
 
     def zero_grad(self, *args, **kwargs):

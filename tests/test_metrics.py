@@ -315,6 +315,7 @@ class TestDeferHotPollRegression:
         rt._pending_bytes = 0
         rt._flushed_tid = -1
         rt._next_tid = 0
+        rt._first_enqueue = 0.0
         rt._multi = True
         rt._coord = False
         rt._native = None
