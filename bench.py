@@ -51,6 +51,11 @@ def _progress_record(phase, **extra):
         ssum, _ = _step_report_field()
         if ssum is not None:
             rec["step_report"] = ssum
+        # Cluster-health evidence: job-view health counts + unhealthy
+        # ranks, so a wedged phase names its suspect in the stream.
+        csum, _ = _cluster_snapshot_field()
+        if csum is not None:
+            rec["cluster_snapshot"] = csum
         with open(_PROGRESS_PATH, "a") as f:
             f.write(json.dumps(rec) + "\n")
     except OSError:
@@ -285,6 +290,37 @@ def _step_report_field():
         return None, (str(e).splitlines() or ["?"])[0][:160]
 
 
+def _cluster_snapshot_field():
+    """The telemetry-plane ride-along: per-rank health states + per-slice
+    digest counts from the job view (local-only view on single-process
+    benches — cluster_snapshot() never returns None). A wedged or
+    tunnel-down run then still records WHICH rank/slice the plane last
+    saw unhealthy. Compacted: health counts, per-slice leader/digest
+    counts, progress, and only the non-healthy ranks in full.
+    Returns ``(snapshot_or_None, reason_or_None)``."""
+    try:
+        import horovod_tpu as hvd
+        view = hvd.cluster_snapshot()
+        return {
+            "gen": view.get("gen"),
+            "world": view.get("world"),
+            "num_slices": view.get("num_slices"),
+            "local_only": view.get("local_only", False),
+            "counts": view.get("counts"),
+            "progress": view.get("progress"),
+            "slices": {
+                sid: {"leader": s.get("leader"),
+                      "digests": s.get("digests")}
+                for sid, s in (view.get("slices") or {}).items()},
+            "unhealthy": {
+                r: s for r, s in (view.get("health") or {}).items()
+                if s.get("state") != "healthy"},
+            "events": (view.get("events") or [])[-8:],
+        }, None
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail bench
+        return None, (str(e).splitlines() or ["?"])[0][:160]
+
+
 def _with_metrics(record):
     snap, reason = _metrics_snapshot_field()
     record["metrics_snapshot"] = snap
@@ -298,6 +334,10 @@ def _with_metrics(record):
     record["step_report"] = ssum
     if ssum is None:
         record["step_report_reason"] = sreason
+    csum, creason = _cluster_snapshot_field()
+    record["cluster_snapshot"] = csum
+    if csum is None:
+        record["cluster_snapshot_reason"] = creason
     return record
 
 

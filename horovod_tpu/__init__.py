@@ -25,11 +25,12 @@ from horovod_tpu.common.basics import (  # noqa: F401
     mpi_threads_supported, mpi_enabled, mpi_built, gloo_enabled, gloo_built,
     nccl_built, ddl_built, ccl_built, cuda_built, rocm_built, xla_built,
     ici_built, start_timeline, stop_timeline, topology, config,
-    metrics_snapshot, metrics_text,
+    metrics_snapshot, metrics_text, cluster_snapshot,
 )
 from horovod_tpu import metrics  # noqa: F401
 from horovod_tpu import flight  # noqa: F401
 from horovod_tpu import profile  # noqa: F401
+from horovod_tpu import telemetry  # noqa: F401
 from horovod_tpu.flight.recorder import step_marker  # noqa: F401
 from horovod_tpu.flight.recorder import summary as flight_summary  # noqa: F401
 from horovod_tpu.profile import (  # noqa: F401

@@ -65,6 +65,7 @@ SITES = (
     "elastic.commit",         # elastic/state.py State.commit (step boundary)
     "elastic.rendezvous",     # elastic/worker.py scale-up barrier
     "driver.discovery",       # runner/elastic/driver.py discovery poll
+    "telemetry.tick",         # telemetry/aggregator.py aggregation round
 )
 
 _SITE_ONLY = {

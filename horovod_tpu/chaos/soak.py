@@ -46,6 +46,31 @@ def _progress(phase, **extra):
         pass                      # evidence must never fail the soak
 
 
+def wait_cluster_view(timeout=12.0):
+    """Post-loop telemetry convergence: poll the job view until it shows
+    the FINAL membership fully healthy (or the timeout lapses — the last
+    view is still returned as evidence). Immediate local view when no
+    aggregation plane is armed, so non-telemetry soaks pay nothing."""
+    import time
+
+    import jax
+
+    from horovod_tpu.telemetry import aggregator
+    view = aggregator.cluster_snapshot()
+    if aggregator.get_agent() is None:
+        return view
+    world = jax.process_count()
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        view = aggregator.cluster_snapshot()
+        if not view.get("local_only") \
+                and view.get("world") == world \
+                and view.get("counts", {}).get("healthy") == world:
+            break
+        time.sleep(0.25)
+    return view
+
+
 def soak_train(total_steps):
     """The per-worker training loop (importable by name — spawned workers
     resolve it from the installed package). World-size-invariant updates:
@@ -96,6 +121,9 @@ def soak_train(total_steps):
             "recoveries": _count("elastic_recovery_seconds"),
             "kv_retries": _count("kv_client_retries_total"),
             "injections": _count("chaos_injections_total"),
+            # Telemetry-plane evidence: the job view after the final
+            # membership converged (local-only when the plane is off).
+            "cluster": wait_cluster_view(),
         }
 
     return loop(state)
@@ -244,6 +272,98 @@ def _elastic_run(steps, procs, min_np, workdir, chaos_env):
     with _scoped_env(env):
         return run_elastic(soak_train, args=(steps,), min_np=min_np,
                            host_discovery_script=script)
+
+
+def leader_kill_plan(procs, slices, seed, kill_step=3):
+    """One hard kill of a TELEMETRY SLICE LEADER at a step boundary — the
+    aggregation plane's own failure drill: its slice must re-elect, and
+    the job view must record the lost host."""
+    from horovod_tpu.telemetry.aggregator import slice_members
+    victim = slice_members(1, procs, slices)[0] if slices > 1 \
+        else procs - 1
+    return victim, {
+        "seed": seed,
+        "note": f"telemetry soak: kill slice-1 leader r{victim}"
+                f"@s{kill_step} ({slices} slices over {procs} procs)",
+        "faults": [
+            {"site": "elastic.commit", "kind": "crash", "rank": victim,
+             "at_step": [kill_step], "max_fires": 1},
+        ],
+    }
+
+
+def run_leader_kill_soak(procs=8, slices=2, steps=8, seed=321,
+                         workdir=None, kill_step=3):
+    """Kill a telemetry slice leader mid-elastic-run and assert the
+    aggregation plane's recovery invariants on top of the elastic ones:
+
+    1. the run still reaches the target step at the shrunk world,
+    2. the post-recovery job view is FRESH, covers the new membership,
+       and reports every surviving rank healthy,
+    3. re-election converged: every slice (including the victim's) has a
+       live leader among the survivors and a full digest count,
+    4. the job view's event log names the killed host as dead
+       (``membership_removed`` — the generation diff), and
+    5. no surviving worker's aggregator crashed (they all produced the
+       converged view — the "never a crashed aggregator" contract).
+    """
+    import tempfile
+    workdir = workdir or tempfile.mkdtemp(prefix="hvd_leader_kill_")
+    os.makedirs(workdir, exist_ok=True)
+    victim, plan_dict = leader_kill_plan(procs, slices, seed,
+                                         kill_step=kill_step)
+    plan_path = os.path.join(workdir, "plan.yaml")
+    with open(plan_path, "w") as f:
+        json.dump(plan_dict, f)
+    # Host naming mirrors _write_discovery: rank r lives on
+    # localhost/127.0.0.<r+1>.
+    victim_host = "localhost" if victim == 0 else f"127.0.0.{victim + 1}"
+    _progress("leader-kill soak start", procs=procs, slices=slices,
+              victim=victim)
+    try:
+        results = _elastic_run(steps, procs, procs - 1, workdir, {
+            "HOROVOD_CHAOS_PLAN": plan_path,
+            "HOROVOD_CHAOS_SEED": str(seed),
+            "HOROVOD_CHAOS_LEDGER": os.path.join(workdir, "ledger"),
+            "HOROVOD_FLIGHT_DIR": os.path.join(workdir, "flight"),
+            "HOROVOD_MESH_SLICES": str(slices),
+            # Tight beacon cadence: the old generation's job view must
+            # exist before the kill, and the new generation must converge
+            # within the post-loop wait.
+            "HOROVOD_TELEMETRY_INTERVAL": "0.1",
+        })
+    finally:
+        # The driver armed the plan in THIS process from the scoped env.
+        from horovod_tpu import chaos
+        chaos.uninstall()
+    survivors = procs - 1
+    # (1) elastic recovery held.
+    assert all(r["steps"] == steps for r in results), \
+        f"leader-kill run fell short of {steps} steps: {results}"
+    assert all(r["final_world"] == survivors for r in results), results
+    views = [r["cluster"] for r in results]
+    # (5) every survivor's plane produced a real (non-fallback) view.
+    assert all(v and not v.get("local_only") for v in views), views
+    view = views[0]
+    # (2) fresh, full coverage, all healthy.
+    assert view["world"] == survivors, view
+    assert view["counts"]["healthy"] == survivors, view["health"]
+    assert view["num_slices"] == slices, view
+    # (3) re-election: every slice has a leader among the survivors and
+    # saw every member's digest.
+    for sid, meta in view["slices"].items():
+        assert meta["leader"] is not None, (sid, meta)
+        assert meta["digests"] == len(meta["members"]), (sid, meta)
+    # (4) the lost host is named dead in the event log.
+    removed = [e for e in view.get("events", ())
+               if e.get("why") == "membership_removed"]
+    assert removed, f"no membership_removed event: {view.get('events')}"
+    assert any(e.get("host") == victim_host for e in removed), \
+        (victim_host, removed)
+    _progress("leader-kill soak done", ok=True)
+    return {"procs": procs, "slices": slices, "victim": victim,
+            "victim_host": victim_host, "view": view,
+            "results": results, "workdir": workdir}
 
 
 def run_soak(procs=8, steps=8, seed=123, workdir=None, plan_dict=None,

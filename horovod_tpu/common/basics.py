@@ -223,6 +223,17 @@ def init(comm=None, process_sets=None, devices=None):
             except OSError as e:  # busy port must not kill training
                 hvd_logging.warning("metrics endpoint failed to bind: %s", e)
 
+        # Cluster telemetry plane: rank → slice-leader → job-view
+        # aggregation over the launcher HTTP-KV (horovod_tpu/telemetry).
+        # Armed after the topology is known (slice membership comes from
+        # it); no-ops on single-process or KV-less runs, where
+        # hvd.cluster_snapshot() serves the local-only view.
+        try:
+            from horovod_tpu.telemetry import aggregator as _telemetry
+            _telemetry.start_from_config(config, topology)
+        except Exception as e:  # noqa: BLE001 — telemetry must not block init
+            hvd_logging.warning("telemetry plane failed to start: %s", e)
+
         hvd_logging.info(
             "horovod_tpu initialized: size=%d local_size=%d cross_size=%d",
             topology.size, topology.local_size, topology.cross_size)
@@ -504,6 +515,15 @@ def shutdown():
             _state.timeline.close()
         from horovod_tpu import metrics as hvd_metrics
         hvd_metrics.stop_http_server()
+        # Telemetry agent: stopped here, restarted by the next init (an
+        # elastic re-init restarts it under the new membership generation
+        # — rank numbering changes across memberships, so the old agent's
+        # keys must not outlive it).
+        try:
+            from horovod_tpu.telemetry import aggregator as _telemetry
+            _telemetry.stop()
+        except Exception:  # noqa: BLE001 — telemetry must not block exit
+            pass
         # Step profiler: discard the OPEN window and bump the record
         # epoch — an elastic reset's recovery traffic must not be
         # attributed to the first post-restore step, and reports must not
@@ -687,3 +707,15 @@ def metrics_text():
     the same payload the ``HOROVOD_METRICS_PORT`` scrape endpoint serves."""
     from horovod_tpu import metrics as hvd_metrics
     return hvd_metrics.render_text()
+
+
+def cluster_snapshot():
+    """The job-level cluster view from the hierarchical telemetry plane
+    (horovod_tpu/telemetry): per-rank health states
+    (healthy/straggling/desynced/stalled/dead), per-slice digest counts
+    and leader, job step progress, and the bounded state-transition event
+    log — the same payload ``GET /cluster/health`` serves. Falls back to
+    a local-only view on single-process or KV-less runs; never returns
+    None. Works before init too (local fallback)."""
+    from horovod_tpu.telemetry import aggregator as _telemetry
+    return _telemetry.cluster_snapshot()

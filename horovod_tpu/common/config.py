@@ -180,6 +180,27 @@ class Config:
     # Watchdog cross-rank publish cadence in steps (0 = local-only).
     profile_publish_steps: int = 16
 
+    # --- cluster telemetry plane (horovod_tpu/telemetry; no reference
+    # analog — the reference's observability is strictly per-rank).
+    # Hierarchical rank → slice-leader → job-view aggregation over the
+    # launcher HTTP-KV; armed by hvd.init when the KV is reachable and
+    # the world is multi-process. See docs/observability.md.
+    telemetry: bool = True
+    # Beacon/aggregation round cadence in seconds.
+    telemetry_interval: float = 2.0
+    # Include the mergeable metrics snapshot in each digest (=0 keeps
+    # beacons minimal: liveness + step + anomaly counts only).
+    telemetry_metrics: bool = True
+    # Health thresholds (0 = derive from the interval; see
+    # telemetry/health.thresholds): beacon age marking a rank dead, step
+    # clock stop marking it stalled.
+    telemetry_dead_after: float = 0.0
+    telemetry_stall_after: float = 0.0
+    # Step-lag (vs the job median) marking a rank straggling, and
+    # global-collective-seq lag marking it desynced.
+    telemetry_step_lag: int = 5
+    telemetry_seq_lag: int = 64
+
     # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
     # the reference's observability stops at timeline + stall inspector).
     # Always-on by default: the registry hot path is O(1) and lock-light
@@ -302,6 +323,19 @@ class Config:
                                        c.profile_dir)
         c.profile_publish_steps = _env_int("HOROVOD_PROFILE_PUBLISH_STEPS",
                                            c.profile_publish_steps)
+        c.telemetry = _env_bool("HOROVOD_TELEMETRY", c.telemetry)
+        c.telemetry_interval = _env_float("HOROVOD_TELEMETRY_INTERVAL",
+                                          c.telemetry_interval)
+        c.telemetry_metrics = _env_bool("HOROVOD_TELEMETRY_METRICS",
+                                        c.telemetry_metrics)
+        c.telemetry_dead_after = _env_float("HOROVOD_TELEMETRY_DEAD_AFTER",
+                                            c.telemetry_dead_after)
+        c.telemetry_stall_after = _env_float(
+            "HOROVOD_TELEMETRY_STALL_AFTER", c.telemetry_stall_after)
+        c.telemetry_step_lag = _env_int("HOROVOD_TELEMETRY_STEP_LAG",
+                                        c.telemetry_step_lag)
+        c.telemetry_seq_lag = _env_int("HOROVOD_TELEMETRY_SEQ_LAG",
+                                       c.telemetry_seq_lag)
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
         c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
         c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",

@@ -419,6 +419,31 @@ def has_anomaly():
     return False
 
 
+def digest():
+    """Compact beacon fields for the telemetry plane
+    (:mod:`horovod_tpu.telemetry.digest`): anomaly counts by kind plus the
+    per-process-set max collective seq — the cross-rank desync key the
+    job health model compares against the fleet median. Reads the live
+    ring off the hot path (the beacon thread), never raises."""
+    r = _recorder
+    if r is None or not armed:
+        return {"enabled": armed, "anomalies": 0}
+    anomalies = 0
+    by_kind = {}
+    for e in r.events():
+        kind = e.get("kind")
+        if kind in _ANOMALY_KINDS:
+            key = kind
+        elif kind == "elastic" and e.get("what") in _ANOMALY_ELASTIC:
+            key = f"elastic_{e.get('what')}"
+        else:
+            continue
+        anomalies += 1
+        by_kind[key] = by_kind.get(key, 0) + 1
+    return {"enabled": True, "anomalies": anomalies, "by_kind": by_kind,
+            "max_seq": r.max_seq(), "dropped": r.dropped()}
+
+
 def render_jsonl(reason=None):
     """Meta line + every ring event as JSONL (the ``/debug/flight``
     payload and the dump file body)."""

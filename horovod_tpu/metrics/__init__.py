@@ -34,6 +34,7 @@ from horovod_tpu.metrics.instruments import (  # noqa: F401
 from horovod_tpu.metrics.server import (  # noqa: F401
     MetricsServer, http_server_port, start_http_server, stop_http_server,
 )
+from horovod_tpu.metrics import merge  # noqa: F401  (mergeable snapshots)
 
 
 def snapshot():

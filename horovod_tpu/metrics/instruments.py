@@ -180,6 +180,15 @@ STEP_PROFILER_EVENTS = REGISTRY.counter(
     "Online watchdog findings from the step profiler "
     "(kind=straggler|regression; horovod_tpu/profile/watchdog.py).",
     ("kind",))
+TELEMETRY_RPCS = REGISTRY.counter(
+    "telemetry_rpcs_total",
+    "Telemetry-plane KV RPCs by phase (horovod_tpu/telemetry): the "
+    "aggregation round's beacon_put|probe_get|slice_get|slice_put|"
+    "job_get|job_put — whose scaling contract is job_get per round == "
+    "slice count, not world size (TestTelemetryScaling) — plus read_get, "
+    "the demand-driven /cluster/* endpoint reads that scale with scrape "
+    "rate instead.",
+    ("phase",))
 
 
 # --- recording helpers (the stack's API) --------------------------------
@@ -352,6 +361,14 @@ def record_profiler_kv(sets=0, gets=0):
         CONTROL_PLANE_RPCS.labels("coord", "prof_set").inc(sets)
     if gets:
         CONTROL_PLANE_RPCS.labels("coord", "prof_get").inc(gets)
+
+
+def record_telemetry_rpc(phase, n=1):
+    """One (or ``n``) telemetry-plane KV RPCs in the given aggregation
+    phase (horovod_tpu/telemetry/aggregator.py)."""
+    if not _enabled:
+        return
+    TELEMETRY_RPCS.labels(phase).inc(n)
 
 
 def record_stall(kind):

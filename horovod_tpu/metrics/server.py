@@ -83,6 +83,33 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if path == "/cluster/health":
+            # The hierarchical telemetry plane's job view: per-rank
+            # health states, per-slice digest counts, step progress and
+            # the transition event log (hvd.cluster_snapshot()). Served
+            # from any rank: non-leaders fetch the view from the
+            # launcher KV (one GET), leaders answer from memory.
+            from horovod_tpu.telemetry import aggregator
+            self._send_json(_json.dumps(aggregator.cluster_snapshot()))
+            return
+        if path == "/cluster/steps":
+            # Per-rank step progress + job medians from the merged slice
+            # summaries — the cluster-level /debug/steps.
+            from horovod_tpu.telemetry import aggregator
+            self._send_json(_json.dumps(aggregator.cluster_steps()))
+            return
+        if path == "/cluster/metrics":
+            # Job-aggregated Prometheus exposition: counters summed and
+            # histograms merged within each slice, every series stamped
+            # with its slice label.
+            from horovod_tpu.telemetry import aggregator
+            body = aggregator.cluster_metrics_text().encode("utf-8")
+            self.send_response(200)
+            self.send_header("Content-Type", CONTENT_TYPE)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+            return
         if path not in ("/metrics", "/"):
             self.send_response(404)
             self.send_header("Content-Length", "0")

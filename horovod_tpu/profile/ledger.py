@@ -433,6 +433,33 @@ def step_report(last=1):
     return recs
 
 
+def digest(last=32):
+    """Compact beacon fields for the telemetry plane
+    (:mod:`horovod_tpu.telemetry.digest`): current step + when it closed,
+    recent wall/attribution means — the step-lag and stall inputs of the
+    job health model. Bounded to the last ``last`` records."""
+    recs = _ledger.records(last=last)
+    out = {"enabled": armed, "steps": len(recs)}
+    if not recs:
+        return out
+    latest = recs[-1]
+    out["step"] = latest["step"]
+    out["step_t"] = latest["t"]
+    out["epoch"] = latest["epoch"]
+    walls = [r["wall_s"] for r in recs]
+    out["wall_mean_s"] = round(sum(walls) / len(walls), 6)
+    att = {}
+    for cat in CATEGORIES + ("compute",):
+        att[cat] = round(
+            sum(r["attribution"].get(cat, 0.0) for r in recs) / len(recs),
+            6)
+    out["attribution_mean_s"] = att
+    mfus = [r["mfu"] for r in recs if "mfu" in r]
+    if mfus:
+        out["mfu_mean"] = round(sum(mfus) / len(mfus), 5)
+    return out
+
+
 def step_report_summary():
     """Aggregate over the retained records: mean/p50 wall, per-category
     attribution means, mean MFU — the bench.py ride-along field."""

@@ -59,6 +59,9 @@ _ARG_ENV_MAP = [
     ("chaos_ledger", "HOROVOD_CHAOS_LEDGER", str),
     ("no_step_profiler", "HOROVOD_STEP_PROFILER",
      lambda v: "0" if v else None),
+    ("no_telemetry", "HOROVOD_TELEMETRY",
+     lambda v: "0" if v else None),
+    ("telemetry_interval", "HOROVOD_TELEMETRY_INTERVAL", str),
     ("step_report_file", "HVD_STEP_REPORT_FILE", str),
     ("profile_steps", "HOROVOD_PROFILE_STEPS", str),
     ("profile_dir", "HOROVOD_PROFILE_DIR", str),

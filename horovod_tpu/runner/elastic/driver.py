@@ -328,6 +328,12 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
         keep = (f"{version}/", f"{version - 1}/")
         for scope in ("results", "assignment", "new_rank_ready"):
             kv.prune_scope(scope, keep)
+        # Telemetry keys are generation-scoped the same way (rank
+        # numbering changes across memberships); the unscoped "job" view
+        # survives so the new generation's leader can diff the previous
+        # membership's hosts and record who was lost.
+        kv.prune_scope("telemetry",
+                       (f"g{version}/", f"g{version - 1}/", "job"))
         # Assignment rows and nhosts must land before the version bump:
         # surviving workers re-rendezvous the moment they observe the bump
         # (elastic/worker.py refresh_assignment_env), and the

@@ -131,7 +131,11 @@ class KVStoreServer:
         return self.port
 
     def stop(self):
-        self._httpd.shutdown()
+        # shutdown() handshakes with serve_forever and would block
+        # forever if start() was never called (in-process users drive
+        # get/put directly); close the listener socket either way.
+        if self._thread is not None:
+            self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=2)

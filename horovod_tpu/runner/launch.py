@@ -190,6 +190,19 @@ def parse_args(argv=None):
                                "steps (HOROVOD_PROFILE_PUBLISH_STEPS; "
                                "0 = local-only).")
 
+    telemetry = p.add_argument_group("cluster telemetry")
+    telemetry.add_argument("--no-telemetry", action="store_true",
+                           dest="no_telemetry",
+                           help="Disable the hierarchical cluster "
+                                "telemetry plane (HOROVOD_TELEMETRY=0). "
+                                "See docs/observability.md.")
+    telemetry.add_argument("--telemetry-interval", type=float,
+                           dest="telemetry_interval",
+                           help="Telemetry beacon/aggregation cadence in "
+                                "seconds (HOROVOD_TELEMETRY_INTERVAL, "
+                                "default 2.0). Health thresholds derive "
+                                "from it unless overridden.")
+
     chaos = p.add_argument_group("chaos")
     chaos.add_argument("--chaos-plan", dest="chaos_plan",
                        help="Fault-injection plan exported to every worker "
@@ -335,6 +348,16 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
     for var in ("HOROVOD_STEP_PROFILER", "HVD_STEP_REPORT_FILE",
                 "HOROVOD_PROFILE_STEPS", "HOROVOD_PROFILE_DIR",
                 "HOROVOD_PROFILE_PUBLISH_STEPS"):
+        if os.environ.get(var):
+            env.setdefault(var, os.environ[var])
+    # Cluster-telemetry knobs + the virtual-slice override ride through to
+    # every worker: slice membership must be computed identically on all
+    # ranks, and the health thresholds must agree with the leader's.
+    for var in ("HOROVOD_TELEMETRY", "HOROVOD_TELEMETRY_INTERVAL",
+                "HOROVOD_TELEMETRY_METRICS", "HOROVOD_TELEMETRY_DEAD_AFTER",
+                "HOROVOD_TELEMETRY_STALL_AFTER",
+                "HOROVOD_TELEMETRY_STEP_LAG", "HOROVOD_TELEMETRY_SEQ_LAG",
+                "HOROVOD_MESH_SLICES"):
         if os.environ.get(var):
             env.setdefault(var, os.environ[var])
     # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU

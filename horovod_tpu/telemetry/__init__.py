@@ -1,0 +1,38 @@
+"""Hierarchical cluster telemetry plane.
+
+PRs 1/5/6 built rich *per-rank* observability (metrics registry, flight
+recorder, step profiler); this package is the plane that composes it into
+one *job-level* answer to "is this job healthy, and which slice/rank is
+the problem?" — without the O(world) scrape-every-rank pattern that
+"Collective Communication for 100k+ GPUs" (PAPERS.md: arxiv 2510.20171)
+identifies as what breaks at scale, using the per-slice hierarchy the
+MLPerf TPU-pod study (arxiv 1909.09756) applies to pods:
+
+- every rank periodically publishes a compact **digest** (liveness
+  beacon, current step + attribution means, flight-recorder anomaly
+  counts, watchdog findings, mergeable metrics snapshot) to the runner
+  HTTP-KV store (:mod:`horovod_tpu.telemetry.digest`);
+- the **slice leader** merges its slice's digests into one slice summary
+  (:mod:`horovod_tpu.telemetry.aggregator`), so the fan-in above slice
+  level scales with *slice count*, not world size;
+- the **job leader** (lowest live slice's leader) composes the slice
+  summaries into the job view — per-rank health states
+  (healthy / straggling / desynced / stalled / dead,
+  :mod:`horovod_tpu.telemetry.health`), job step medians, and a bounded
+  state-transition event log.
+
+Read it via ``hvd.cluster_snapshot()``, the ``GET /cluster/health`` /
+``/cluster/metrics`` / ``/cluster/steps`` endpoints on the metrics
+server, or the live terminal view ``python -m horovod_tpu.telemetry.top``.
+Leadership is leased by freshness, not configured: a leader that stops
+beaconing is replaced by the next live rank within a couple of beacon
+intervals (see ``aggregator.TelemetryAgent``). Knobs:
+``HOROVOD_TELEMETRY`` (default on), ``HOROVOD_TELEMETRY_INTERVAL``, and
+the health thresholds in :class:`horovod_tpu.common.config.Config`;
+docs/observability.md has the full catalogue.
+"""
+
+from horovod_tpu.telemetry.aggregator import (  # noqa: F401
+    TelemetryAgent, cluster_snapshot, get_agent, start_from_config, stop,
+)
+from horovod_tpu.telemetry import digest, health  # noqa: F401

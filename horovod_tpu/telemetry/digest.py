@@ -1,0 +1,104 @@
+"""Per-rank telemetry digest: what one process tells its slice leader.
+
+A digest is the compact, JSON-serializable beacon each process publishes
+every ``HOROVOD_TELEMETRY_INTERVAL`` seconds — the *only* thing a rank
+contributes to the cluster view, so everything the job health model needs
+must be in it:
+
+- liveness: ``t`` (publish wall time) — beacon age IS the liveness signal;
+- progress: current step + when it closed, recent wall/attribution means
+  (step-profiler ledger digest — the step-lag/stall/straggler inputs);
+- anomalies: flight-recorder anomaly counts + per-process-set max
+  collective seq (the desync key);
+- findings: the watchdog's recent straggler/regression namings;
+- metrics: a mergeable compacted registry snapshot
+  (``HOROVOD_TELEMETRY_METRICS=0`` drops it for minimal beacons).
+
+Collection runs on the beacon thread, off every dispatch hot path; each
+contributor is independently fail-soft (a wedged subsystem must not
+silence the liveness beacon that reports it wedged).
+"""
+
+import os
+import time
+
+from horovod_tpu.common.config import _env_bool, _env_int
+
+SCHEMA_VERSION = 1
+
+
+def _rank():
+    return _env_int("HOROVOD_CROSS_RANK", 0)
+
+
+def _host():
+    h = os.environ.get("HOROVOD_HOST_KEY")
+    if h:
+        return h
+    import socket
+    try:
+        return socket.gethostname()
+    except OSError:
+        return ""
+
+
+def collect(rank=None, include_metrics=None):
+    """Build this process's digest. Never raises: each contributing
+    subsystem is wrapped separately so the beacon survives any of them
+    misbehaving — a beacon with a missing section still proves liveness."""
+    d = {
+        "v": SCHEMA_VERSION,
+        "rank": _rank() if rank is None else rank,
+        "host": _host(),
+        "pid": os.getpid(),
+        "t": round(time.time(), 6),
+    }
+    try:
+        from horovod_tpu.profile import ledger
+        d["profile"] = ledger.digest()
+    except Exception:  # noqa: BLE001 — beacon survives a wedged ledger
+        pass
+    try:
+        from horovod_tpu.flight import recorder
+        d["flight"] = recorder.digest()
+    except Exception:  # noqa: BLE001
+        pass
+    try:
+        from horovod_tpu.profile import watchdog
+        d["findings"] = watchdog.findings(last=4)
+    except Exception:  # noqa: BLE001
+        pass
+    if include_metrics is None:
+        include_metrics = _env_bool("HOROVOD_TELEMETRY_METRICS", True)
+    if include_metrics:
+        try:
+            from horovod_tpu.metrics import merge
+            from horovod_tpu.metrics.instruments import REGISTRY, enabled
+            if enabled():
+                d["metrics"] = merge.compact(REGISTRY.snapshot())
+        except Exception:  # noqa: BLE001
+            pass
+    return d
+
+
+def health_row(digest_dict):
+    """The slice-summary per-rank row: the digest minus its metrics bulk
+    (metrics are merged INTO the slice summary, not repeated per rank),
+    keeping exactly the health-model inputs + identity."""
+    prof = digest_dict.get("profile") or {}
+    flight = digest_dict.get("flight") or {}
+    return {
+        "t": digest_dict.get("t"),
+        "host": digest_dict.get("host"),
+        "pid": digest_dict.get("pid"),
+        "step": prof.get("step"),
+        "step_t": prof.get("step_t"),
+        "steps": prof.get("steps", 0),
+        "wall_mean_s": prof.get("wall_mean_s"),
+        "host_dispatch_mean_s": (prof.get("attribution_mean_s") or {})
+        .get("host_dispatch"),
+        "anomalies": flight.get("anomalies", 0),
+        "anomaly_kinds": flight.get("by_kind") or {},
+        "max_seq": flight.get("max_seq") or {},
+        "findings": digest_dict.get("findings") or [],
+    }

@@ -50,9 +50,41 @@ def main(argv=None):
     p.add_argument("--no-rerun", action="store_true",
                    help="Skip the same-seed determinism re-run")
     p.add_argument("--loss-tol", type=float, default=1e-5)
+    p.add_argument("--leader-kill", action="store_true",
+                   help="Run the TELEMETRY leader-kill soak instead: "
+                        "kill a slice leader under HOROVOD_MESH_SLICES="
+                        "--slices and assert re-election + the job view "
+                        "naming the dead host (soak.run_leader_kill_soak)")
+    p.add_argument("--slices", type=int, default=2,
+                   help="Virtual slice count for --leader-kill; default 2")
     args = p.parse_args(argv)
 
     from horovod_tpu.chaos import soak
+
+    if args.leader_kill:
+        record = {"metric": "telemetry_leader_kill_soak",
+                  "unit": "invariants", "procs": args.procs,
+                  "slices": args.slices, "steps": args.steps,
+                  "seed": args.seed}
+        try:
+            ev = soak.run_leader_kill_soak(
+                procs=args.procs, slices=args.slices, steps=args.steps,
+                seed=args.seed, workdir=args.workdir)
+        except (AssertionError, RuntimeError, TimeoutError) as e:
+            record.update({"value": 0.0, "ok": False,
+                           "error": str(e)[:500]})
+            print(json.dumps(record))
+            return 1
+        record.update({
+            "value": 1.0, "ok": True, "victim": ev["victim"],
+            "victim_host": ev["victim_host"],
+            "healthy": ev["view"]["counts"]["healthy"],
+            "slice_leaders": {s: m["leader"]
+                              for s, m in ev["view"]["slices"].items()},
+            "workdir": ev["workdir"],
+        })
+        print(json.dumps(record))
+        return 0
 
     plan_dict = None
     if args.plan:

@@ -20,6 +20,35 @@ import pytest
 
 
 @pytest.mark.slow
+@pytest.mark.timeout(900)
+class TestTelemetryLeaderKillSoak:
+    def test_slice_leader_kill_reelects_and_names_the_dead(self, hvd,
+                                                           tmp_path):
+        """The telemetry plane's own failure drill (PR-7 acceptance): an
+        8-process, 2-slice elastic run whose chaos plan kills slice 1's
+        telemetry leader at a step boundary. The invariants — re-election
+        converges (every slice of the post-recovery view has a live
+        leader and a full digest count), the job view names the killed
+        host dead via the generation diff, and no survivor's aggregator
+        crashed — are asserted inside run_leader_kill_soak."""
+        from horovod_tpu.chaos import soak
+
+        evidence = soak.run_leader_kill_soak(procs=8, slices=2, steps=8,
+                                             workdir=str(tmp_path))
+        view = evidence["view"]
+        # Victim was slice 1's leader (rank 4 of 8 under 2 slices).
+        assert evidence["victim"] == 4
+        # The survivors' view is a 7-rank, still-2-slice world with a
+        # re-elected slice-1 leader on a surviving host.
+        assert view["world"] == 7 and view["num_slices"] == 2
+        assert view["slices"]["1"]["leader"] is not None
+        # The dead host is named in the job view's transition log.
+        assert any(e.get("host") == evidence["victim_host"]
+                   and e.get("to") == "dead"
+                   for e in view["events"])
+
+
+@pytest.mark.slow
 @pytest.mark.timeout(1500)
 class TestChaosSoak:
     def test_eight_process_kill_drop_straggler_soak(self, hvd, tmp_path):

@@ -365,15 +365,19 @@ def _measure_host_overhead(hvd, iters=150, burst=50):
 
 class TestHostOverheadBudget:
     @pytest.mark.parametrize(
-        "metrics_on,chaos_armed,flight_on,profile_on",
-        [(True, False, True, True), (False, False, True, True),
-         (True, True, True, True), (True, False, False, True),
-         (True, False, True, False)],
+        "metrics_on,chaos_armed,flight_on,profile_on,telemetry_on",
+        [(True, False, True, True, False),
+         (False, False, True, True, False),
+         (True, True, True, True, False),
+         (True, False, False, True, False),
+         (True, False, True, False, False),
+         (True, False, True, True, True)],
         ids=["metrics1", "metrics0", "chaos_nofire", "flight0",
-             "profile0"])
+             "profile0", "telemetry1"])
     def test_eager_and_async_overhead_within_budget(self, hvd, metrics_on,
                                                     chaos_armed, flight_on,
-                                                    profile_on):
+                                                    profile_on,
+                                                    telemetry_on):
         """The committed baseline (docs/host_overhead_baseline.json) is
         the budget: fail at 2x — the eager path growing a host-side
         stall (lock contention, per-call recompile, KV chatter) is the
@@ -415,6 +419,22 @@ class TestHostOverheadBudget:
         if chaos_armed:
             chaos.install(ChaosPlan([FaultSpec(
                 site="elastic.rendezvous", kind="delay", at=[0])]))
+        telemetry_stack = None
+        if telemetry_on:
+            # The digest-publish leg: a live agent beaconing aggressively
+            # (20 ms rounds, full digest incl. the metrics snapshot walk)
+            # against an in-process KV while the dispatch loop is timed.
+            # Telemetry runs entirely off the dispatch path, so its cost
+            # must disappear into the same 2x budget as every other
+            # always-on observability layer.
+            from horovod_tpu.runner.http_kv import KVStoreServer
+            from horovod_tpu.telemetry.aggregator import TelemetryAgent
+            kv = KVStoreServer(secret="")
+            agent = TelemetryAgent(kv, rank=0, world=1, num_slices=1,
+                                   interval=0.02, gen="perf",
+                                   include_metrics=True)
+            agent.start()
+            telemetry_stack = (kv, agent)
         try:
             got = _measure_host_overhead(hvd)
         finally:
@@ -423,9 +443,14 @@ class TestHostOverheadBudget:
             profile_ledger.set_enabled(prev_profile)
             if chaos_armed:
                 chaos.uninstall()
+            if telemetry_stack is not None:
+                telemetry_stack[1].stop()
+                telemetry_stack[0].stop()
+                assert telemetry_stack[1].rounds > 0, \
+                    "telemetry leg never completed a beacon round"
         if os.environ.get("HVD_UPDATE_PERF_BASELINE") == "1":
             if not metrics_on or chaos_armed or not flight_on \
-                    or not profile_on:
+                    or not profile_on or telemetry_on:
                 return  # the default-config (metrics-on) run writes it
             with open(_BASELINE, "w") as f:
                 json.dump({**got, "note":
@@ -699,6 +724,90 @@ class TestStepProfilerOverhead:
             f"profile-on eager dispatch {best[True] * 1e6:.0f}us vs "
             f"profile-off {best[False] * 1e6:.0f}us — ledger cost "
             f"exceeds the same-run 2x noise envelope")
+
+
+class TestTelemetryScaling:
+    """ROADMAP item 2's scaling contract, telemetry edition (the
+    TestControlPlaneScaling pattern): telemetry KV RPCs per aggregation
+    round must grow with SLICE COUNT, not world size. Virtual slices are
+    what HOROVOD_MESH_SLICES models; here the same partition is driven
+    directly through TelemetryAgent (in-process KV, manual ticks) so the
+    guard measures exact per-round RPC counts deterministically — via the
+    public telemetry_rpcs_total counter, the same series an operator
+    reads off the scrape endpoint."""
+
+    ROUNDS = 4
+
+    def _phase_counts(self, world, slices):
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.runner.http_kv import KVStoreServer
+        from horovod_tpu.telemetry.aggregator import (PHASES,
+                                                      TelemetryAgent)
+        kv = KVStoreServer(secret="")
+        try:
+            clock = [1000.0]
+            agents = [TelemetryAgent(kv, rank=r, world=world,
+                                     num_slices=slices, interval=1.0,
+                                     gen="perf", include_metrics=False,
+                                     time_fn=lambda: clock[0])
+                      for r in range(world)]
+            for _ in range(3):                   # converge leadership
+                clock[0] += 1.0
+                for a in agents:
+                    a.tick()
+            before = {p: ins.TELEMETRY_RPCS.labels(p).get()
+                      for p in PHASES}
+            for a in agents:
+                a.counters = dict.fromkeys(a.counters, 0)
+            for _ in range(self.ROUNDS):
+                clock[0] += 1.0
+                for a in agents:
+                    a.tick()
+            registry_delta = {
+                p: ins.TELEMETRY_RPCS.labels(p).get() - before[p]
+                for p in PHASES}
+            return agents, registry_delta
+        finally:
+            kv.stop()                 # no leaked listener fds (2-core CI)
+
+    def test_job_fan_in_tracks_slices_not_world(self, hvd):
+        per_cfg = {}
+        for world, slices in ((4, 2), (8, 2), (8, 4)):
+            agents, delta = self._phase_counts(world, slices)
+            leader = agents[0]
+            per_cfg[(world, slices)] = {
+                "job_get_per_round":
+                    leader.counters["job_get"] / self.ROUNDS,
+                "job_put_per_round":
+                    leader.counters["job_put"] / self.ROUNDS,
+            }
+            # The public counter agrees with the agents' own accounting.
+            assert delta["job_get"] == leader.counters["job_get"]
+            assert delta["beacon_put"] == world * self.ROUNDS
+        # World doubled at fixed slice count: job-level fan-in unchanged.
+        assert per_cfg[(4, 2)]["job_get_per_round"] \
+            == per_cfg[(8, 2)]["job_get_per_round"] == 1
+        # Slice count doubled at fixed world: fan-in doubles with it.
+        assert per_cfg[(8, 4)]["job_get_per_round"] == 3
+        for cfg in per_cfg.values():
+            assert cfg["job_put_per_round"] == 1
+
+    def test_follower_cost_is_o1_in_world_size(self, hvd):
+        for world in (4, 8):
+            agents, _ = self._phase_counts(world, 2)
+            for a in agents:
+                lead_slice = a.rank == min(a.members)
+                total = sum(a.counters.values())
+                if not lead_slice:
+                    # beacon PUT + one freshness probe GET, regardless of
+                    # world size.
+                    assert total == 2 * self.ROUNDS, (world, a.rank,
+                                                     a.counters)
+                else:
+                    # A leader's extra cost is bounded by its own slice
+                    # size + the job round — never O(world).
+                    bound = (len(a.members) + 3) * self.ROUNDS
+                    assert total <= bound, (world, a.rank, a.counters)
 
 
 class TestLlamaStepGuards:
