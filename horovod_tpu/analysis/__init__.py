@@ -1,0 +1,33 @@
+"""Static collective-correctness analysis + runtime lint (hvdlint).
+
+Two halves (ISSUE 9 / docs/static_analysis.md):
+
+- :func:`check_program` (exported as ``hvd.check_program``) — abstract-eval
+  a step function per simulated rank and diff the collective sequences for
+  desync hazards before the run, each finding carrying the flight
+  recorder's ``(op, ps, seq, sig)`` identity;
+- :mod:`horovod_tpu.analysis.lint` — AST-based codebase lint
+  (``python -m horovod_tpu.analysis.lint``, ``scripts/lint.py``) for the
+  bug classes previous PRs fixed by hand.
+"""
+
+from horovod_tpu.analysis.events import (  # noqa: F401
+    CollectiveEvent, sequence_hash,
+)
+from horovod_tpu.analysis.findings import Finding  # noqa: F401
+from horovod_tpu.analysis.program import (  # noqa: F401
+    CheckReport, check_program, cross_check,
+)
+
+_LINT_EXPORTS = ("LintFinding", "declared_knobs", "lint_paths",
+                 "lint_source")
+
+
+def __getattr__(name):
+    # Lazy: `python -m horovod_tpu.analysis.lint` imports this package
+    # first, and an eager `from .lint import ...` would double-import the
+    # module it is about to execute (runpy RuntimeWarning).
+    if name in _LINT_EXPORTS:
+        from horovod_tpu.analysis import lint
+        return getattr(lint, name)
+    raise AttributeError(name)

@@ -1,0 +1,9 @@
+"""``python -m horovod_tpu.analysis``: run the codebase lint (the program
+analyzer is an API — ``hvd.check_program`` — since it needs your step
+function and inputs; see docs/static_analysis.md)."""
+
+import sys
+
+from horovod_tpu.analysis.lint import main
+
+sys.exit(main())

@@ -1,0 +1,117 @@
+"""Collective-event model for the static analyzer.
+
+A :class:`CollectiveEvent` is the static twin of one flight-recorder
+``dispatch`` event: same ``op`` label (``collective_ops`` op_kind,
+lowercased), same process-set label (``_ps_label``), same per-process-set
+monotonic ``seq`` (1-based), and — for eager ops — the same signature hash
+(``flight.recorder.signature`` over the staged GLOBAL stacked tensors).
+That alignment is what makes :func:`horovod_tpu.analysis.program.cross_check`
+a tuple comparison instead of a joining heuristic.
+
+In-jit collectives (``lax.psum``/``ppermute``/... inside ``shard_map``/
+``pjit``) are never seen by the flight recorder — they live inside the
+user's compiled program — so their events are static-only, labelled
+``ps="axis:<name>"`` with ``origin="jit"``.
+"""
+
+import dataclasses
+import zlib
+
+
+class _Aval:
+    """shape/dtype stand-in accepted by ``flight.recorder.signature`` (it
+    reads only ``.shape`` and ``.dtype``)."""
+
+    __slots__ = ("shape", "dtype")
+
+    def __init__(self, shape, dtype):
+        self.shape = tuple(shape)
+        self.dtype = dtype
+
+
+def signature_of(shapes, dtypes):
+    """The flight recorder's signature hash for a (shapes, dtypes) pair —
+    one source of truth (delegates to :func:`flight.recorder.signature`)."""
+    from horovod_tpu.flight.recorder import signature
+    return signature([_Aval(s, d) for s, d in zip(shapes, dtypes)])
+
+
+@dataclasses.dataclass(frozen=True)
+class CollectiveEvent:
+    """One predicted collective dispatch on one simulated rank."""
+
+    op: str            # flight op label: "allreduce", "allgather", ... or
+    #                    the jit primitive: "psum", "ppermute", ...
+    ps: str            # "global" / "set<N>" / "unregistered" / "axis:<ax>"
+    seq: int           # per-ps monotonic sequence number (1-based)
+    shapes: tuple      # wire tensor shapes (global stacked, eager ops)
+    dtypes: tuple      # matching dtype strings
+    origin: str        # "eager" | "fused" | "jit"
+    name: str = None   # user-supplied op name, if any
+    nbytes: int = 0
+    # Static trip count when inside a lax.scan; 0 = UNKNOWN (the event
+    # sits in a while-loop body whose trip count is data-dependent —
+    # diffed for presence across ranks, excluded from sequence hashes).
+    repeat: int = 1
+
+    @property
+    def sig(self):
+        return signature_of(self.shapes, self.dtypes)
+
+    def identity(self):
+        """The flight-recorder identity tuple ``(op, ps, seq, sig)``."""
+        return (self.op, self.ps, self.seq, self.sig)
+
+    def key(self):
+        """Identity *without* seq — what cross-rank diffing compares at
+        each position."""
+        return (self.op, self.ps, self.sig, self.repeat)
+
+    def describe(self):
+        shp = ",".join(f"{tuple(s)}:{d}"
+                       for s, d in zip(self.shapes, self.dtypes))
+        rep = "" if self.repeat == 1 \
+            else (" x?" if self.repeat == 0 else f" x{self.repeat}")
+        return (f"{self.op}[{self.ps}] seq={self.seq} sig={self.sig}"
+                f" {shp}{rep} ({self.origin})")
+
+
+def assign_seqs(events):
+    """Stamp per-process-set monotonic 1-based seqs (the flight recorder's
+    numbering) onto an ordered event list; ``repeat`` advances the counter
+    by its trip count, matching what the recorder would log across loop
+    iterations. Returns a new list."""
+    counters = {}
+    out = []
+    for e in events:
+        seq = counters.get(e.ps, 0) + 1
+        # repeat 0 (unknown while-loop count) still advances by one: the
+        # later numbering is approximate either way, and the hash skips
+        # these events.
+        counters[e.ps] = seq + (max(e.repeat, 1) - 1)
+        out.append(dataclasses.replace(e, seq=seq))
+    return out
+
+
+def sequence_hash(events, ps=None):
+    """Stable (cross-process) hash of an ordered collective sequence:
+    crc32 over each event's ``op|ps|sig|repeat``. Accepts
+    :class:`CollectiveEvent` lists or flight-recorder event dicts
+    (``kind=="dispatch"`` rows; others are skipped). ``ps`` filters to one
+    process set. Events with ``repeat=0`` (unknown while-loop trip count)
+    are excluded — their contribution is inherently non-static."""
+    parts = []
+    for e in events:
+        if isinstance(e, dict):
+            if e.get("kind") != "dispatch":
+                continue
+            if ps is not None and e.get("ps") != ps:
+                continue
+            parts.append(f"{e.get('op')}|{e.get('ps')}|{e.get('sig')}|1")
+        else:
+            if ps is not None and e.ps != ps:
+                continue
+            if e.repeat == 0:
+                continue
+            parts.append(f"{e.op}|{e.ps}|{e.sig}|{e.repeat}")
+    return format(zlib.crc32(";".join(parts).encode()), "08x")

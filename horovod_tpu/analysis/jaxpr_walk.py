@@ -1,0 +1,146 @@
+"""Jaxpr traversal: extract the ordered in-jit collective sequence.
+
+Walks a (closed) jaxpr in equation order, recursing into every
+higher-order primitive that carries sub-jaxprs (``pjit``, ``shard_map``,
+``scan``/``while``/``cond``, ``custom_jvp/vjp_call``, ``remat`` — found
+generically by probing params for jaxpr-shaped values), and yields one
+record per communication primitive: ``psum``/``pmin``/``pmax``/
+``ppermute``/``all_gather``/``all_to_all``/``reduce_scatter``/``pgather``.
+
+``pbroadcast``/``pvary`` are type-system bookkeeping (no bytes move) and
+are excluded. A ``lax.scan`` multiplies its body's collectives by the
+static trip count (``repeat``); a ``while`` has no static count, so its
+body events carry ``repeat=0`` (unknown — excluded from exact sequence
+hashes, still diffed for presence). ``cond`` branches are walked
+separately so the caller can flag branch-divergent collectives (the
+subset-participation deadlock class, see ``parallel/pp.py``).
+"""
+
+import dataclasses
+
+# Primitive-name -> canonical op label. JAX renames across versions
+# (psum/psum2/psum_invariant); canonicalize so findings and hashes are
+# version-stable.
+_WIRE_PRIMS = {
+    "psum": "psum", "psum2": "psum", "psum_invariant": "psum",
+    "pmin": "pmin", "pmax": "pmax",
+    "ppermute": "ppermute", "pgather": "pgather",
+    "all_gather": "all_gather", "all_gather_invariant": "all_gather",
+    "all_to_all": "all_to_all",
+    "reduce_scatter": "reduce_scatter", "psum_scatter": "reduce_scatter",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class JitCollective:
+    """One communication primitive found in a jaxpr."""
+
+    op: str                 # canonical label ("psum", "all_gather", ...)
+    axes: tuple             # named mesh axes it communicates over
+    shapes: tuple           # operand shapes (per-device view)
+    dtypes: tuple           # operand dtype strings
+    axis_sizes: tuple       # size of each named axis (None = unknown)
+    repeat: int = 1         # static trip count (0 = unknown, while-loop)
+    in_cond: bool = False   # inside a lax.cond branch
+    branch: int = None      # which branch, when in_cond
+
+    @property
+    def nbytes(self):
+        total = 0
+        for s, d in zip(self.shapes, self.dtypes):
+            n = 1
+            for dim in s:
+                n *= int(dim)
+            total += n * _dtype_width(d)
+        return total
+
+
+def _dtype_width(dtype_str):
+    s = str(dtype_str)
+    for w, names in ((8, ("float64", "int64", "uint64", "complex64")),
+                     (4, ("float32", "int32", "uint32")),
+                     (2, ("float16", "bfloat16", "int16", "uint16")),
+                     (1, ("int8", "uint8", "bool", "float8"))):
+        if any(n in s for n in names):
+            return w
+    return 4
+
+
+def _axis_names(eqn):
+    """The named axes a communication eqn touches (params spell it
+    ``axes``, ``axis_name`` or inside ``perm``-less ppermute params)."""
+    p = eqn.params
+    ax = p.get("axes", p.get("axis_name", ()))
+    if not isinstance(ax, (tuple, list)):
+        ax = (ax,)
+    # positional (int) axes of a pmap-free jaxpr aren't mesh axes
+    return tuple(a for a in ax if isinstance(a, str))
+
+
+def _sub_jaxprs(eqn):
+    """Generic sub-jaxpr discovery: any param value that is (or wraps, or
+    contains) something with ``.eqns``."""
+    found = []
+    for key, v in eqn.params.items():
+        items = v if isinstance(v, (tuple, list)) else (v,)
+        for item in items:
+            sub = getattr(item, "jaxpr", item)
+            if hasattr(sub, "eqns"):
+                found.append((key, sub))
+    return found
+
+
+def _scan_length(eqn):
+    if eqn.primitive.name == "scan":
+        return int(eqn.params.get("length", 1))
+    return None
+
+
+def iter_collectives(jaxpr, axis_sizes=None, repeat=1, in_cond=False,
+                     branch=None):
+    """Yield :class:`JitCollective` for every communication primitive in
+    ``jaxpr`` (equation order, depth-first). ``axis_sizes`` maps axis name
+    -> size, extended by ``shard_map`` meshes on the way down."""
+    axis_sizes = dict(axis_sizes or {})
+    for eqn in jaxpr.eqns:
+        name = eqn.primitive.name
+        op = _WIRE_PRIMS.get(name)
+        if op is not None:
+            axes = _axis_names(eqn)
+            avals = [getattr(v, "aval", None) for v in eqn.invars]
+            shapes = tuple(tuple(getattr(a, "shape", ())) for a in avals)
+            dtypes = tuple(str(getattr(a, "dtype", "")) for a in avals)
+            yield JitCollective(
+                op=op, axes=axes, shapes=shapes, dtypes=dtypes,
+                axis_sizes=tuple(axis_sizes.get(a) for a in axes),
+                repeat=repeat, in_cond=in_cond, branch=branch)
+            continue
+        subs = _sub_jaxprs(eqn)
+        if not subs:
+            continue
+        sub_sizes = axis_sizes
+        if name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            shape = getattr(mesh, "shape", None)
+            if shape:
+                sub_sizes = dict(axis_sizes)
+                sub_sizes.update({str(k): int(v)
+                                  for k, v in dict(shape).items()})
+        length = _scan_length(eqn)
+        sub_repeat = repeat
+        if length is not None:
+            sub_repeat = repeat * length
+        elif name == "while":
+            sub_repeat = 0                      # unknown trip count
+        is_cond = name == "cond"
+        for i, (_key, sub) in enumerate(subs):
+            yield from iter_collectives(
+                sub, sub_sizes, repeat=sub_repeat,
+                in_cond=in_cond or is_cond,
+                branch=i if is_cond else branch)
+
+
+def collect(closed_jaxpr, axis_sizes=None):
+    """All communication primitives of a ``ClosedJaxpr`` (or raw jaxpr)."""
+    jaxpr = getattr(closed_jaxpr, "jaxpr", closed_jaxpr)
+    return list(iter_collectives(jaxpr, axis_sizes))

@@ -1,0 +1,603 @@
+"""``hvd.check_program``: static collective-correctness analysis.
+
+Abstract-evals a step function once per simulated rank (``jax.make_jaxpr``
+— zero device execution), extracts the ordered collective sequence each
+rank would dispatch, and diffs the sequences to report desync hazards
+BEFORE the run:
+
+- framework eager ops (``hvd.allreduce``/...) are captured via the
+  interception hook in :mod:`horovod_tpu.ops.collective_ops` — each call
+  records the event the flight recorder WOULD record (same op label,
+  process-set label, per-set seq and signature hash over the global
+  stacked tensors) and returns an abstract stand-in, so rank-conditional
+  Python control flow (``if hvd.rank() == 0: ...``) resolves per
+  simulated rank through the :mod:`horovod_tpu.common.basics` overlay;
+- raw in-jit collectives (``lax.psum``/``ppermute``/``all_gather``/
+  ``all_to_all``/... inside ``shard_map``/``pjit``) are extracted from
+  the traced jaxpr (:mod:`horovod_tpu.analysis.jaxpr_walk`).
+
+Event ordering: eager events appear in Python call order; in-jit events
+follow in equation order. A program interleaving BOTH styles gets the
+eager events first — exact interleave would need trace markers; for
+sequence-hash cross-checks against the flight recorder use eager-only (or
+jit-only) steps.
+
+The per-finding ``(op, ps, seq, sig)`` identity matches the flight
+recorder's event fields, so a runtime ``flight.analyze`` desync can be
+cross-checked against the static prediction with :func:`cross_check`.
+"""
+
+import dataclasses
+import functools
+
+import numpy as np
+
+from horovod_tpu.analysis import jaxpr_walk
+from horovod_tpu.analysis.events import (CollectiveEvent, assign_seqs,
+                                         sequence_hash, signature_of)
+from horovod_tpu.analysis.findings import (ERROR, INFO, WARNING, Finding,
+                                           sort_findings)
+
+# Advisory thresholds (HVP105): a program dispatching at least this many
+# sync eager collectives, each under this fraction of the fusion
+# threshold, is leaving the fusion buffer empty.
+_FILL_MIN_EVENTS = 8
+_FILL_SMALL_FRACTION = 0.01
+
+
+def _ps_label(process_set):
+    if process_set is None or getattr(process_set, "ranks", None) is None:
+        return "global"
+    pid = getattr(process_set, "process_set_id", None)
+    return f"set{pid}" if pid is not None else "unregistered"
+
+
+def _ps_size(process_set, world_size):
+    if process_set is None or getattr(process_set, "ranks", None) is None:
+        return world_size
+    return len(process_set.ranks)
+
+
+def _shape_dtype(x):
+    shape = tuple(getattr(x, "shape", ()))
+    dtype = getattr(x, "dtype", None)
+    return shape, str(dtype) if dtype is not None else "float32"
+
+
+class _TraceRecorder:
+    """Per-rank event capture: the interception hook + reuse tracking."""
+
+    def __init__(self, world_size):
+        self.world_size = world_size
+        self.events = []
+        self.input_ids = {}          # id(tracer) -> first event index
+        self.reused = []             # (first_idx, second_idx)
+
+    def _note_inputs(self, tensors):
+        idx = len(self.events)
+        for t in tensors:
+            key = id(t)
+            first = self.input_ids.get(key)
+            if first is not None:
+                self.reused.append((first, idx))
+            else:
+                self.input_ids[key] = idx
+
+    def record(self, op, tensors, process_set, name, origin="eager"):
+        """Record one eager dispatch the way the runtime would: signature
+        over the GLOBAL stacked tensors (leading axis = set size)."""
+        n = _ps_size(process_set, self.world_size)
+        shapes, dtypes, nbytes = [], [], 0
+        for t in tensors:
+            shape, dtype = _shape_dtype(t)
+            gshape = (n,) + tuple(shape[1:]) if shape else (n,)
+            shapes.append(gshape)
+            dtypes.append(dtype)
+            width = jaxpr_walk._dtype_width(dtype)
+            cnt = 1
+            for d in gshape:
+                cnt *= int(d)
+            nbytes += cnt * width
+        self._note_inputs(tensors)
+        self.events.append(CollectiveEvent(
+            op=op, ps=_ps_label(process_set), seq=0, shapes=tuple(shapes),
+            dtypes=tuple(dtypes), origin=origin, name=name, nbytes=nbytes))
+
+
+def _stub_outputs(kind, tensors, n, return_sizes=False):
+    """Abstract results for an intercepted eager call: right shapes/dtypes,
+    no device work (``jnp`` ops on tracers while the analyzer traces).
+    ``tensors`` is the already-resolved input list, ``n`` the resolved
+    process-set size."""
+    import jax.numpy as jnp
+
+    def zeros_like_rows(t, scale_axis1=1):
+        shape, _ = _shape_dtype(t)
+        if scale_axis1 != 1 and len(shape) > 1:
+            shape = (shape[0], shape[1] * scale_axis1) + tuple(shape[2:])
+        return jnp.zeros(shape, getattr(t, "dtype", jnp.float32))
+
+    if kind in ("allreduce", "broadcast"):
+        return [t + jnp.zeros((), getattr(t, "dtype", jnp.float32))
+                if hasattr(t, "dtype") else t for t in tensors]
+    if kind == "allgather":
+        return [zeros_like_rows(t, scale_axis1=n) for t in tensors]
+    if kind == "allgather_ragged":
+        total = sum(int(t.shape[0]) for t in tensors)
+        rest = tuple(tensors[0].shape[1:]) if tensors else ()
+        out = jnp.zeros((total,) + rest,
+                        getattr(tensors[0], "dtype", jnp.float32))
+        if return_sizes:
+            return out, [int(t.shape[0]) for t in tensors]
+        return out
+    if kind == "reducescatter":
+        outs = []
+        for t in tensors:
+            shape, _ = _shape_dtype(t)
+            rows = shape[1] // n if len(shape) > 1 and n else 0
+            outs.append(jnp.zeros((shape[0], rows) + tuple(shape[2:]),
+                                  getattr(t, "dtype", jnp.float32)))
+        return outs
+    if kind == "alltoall":
+        return zeros_like_rows(tensors[0])
+    if kind == "barrier":
+        return None
+    raise NotImplementedError(kind)
+
+
+_GROUPED_KINDS = {"allreduce", "allgather", "broadcast", "reducescatter"}
+
+# Positional index of process_set in each intercepted entry point's
+# signature (collective_ops.py public API).
+_PS_POS = {"allreduce": 4, "allgather": 1, "allgather_ragged": 1,
+           "broadcast": 2, "reducescatter": 4, "alltoall": 2, "barrier": 0,
+           "allreduce_async": 4, "allgather_async": 1,
+           "broadcast_async": 2, "alltoall_async": 2,
+           "reducescatter_async": 2}
+
+
+def _make_hook(rec):
+    """The collective_ops interception hook for one simulated rank."""
+
+    def hook(kind, args, kwargs):
+        def get(name, pos, default=None):
+            if name in kwargs:
+                return kwargs[name]
+            return args[pos] if len(args) > pos else default
+
+        ps_pos = _PS_POS.get(kind, 1)
+        ps = get("process_set", ps_pos)
+        name = get("name", ps_pos + 1)
+        n = _ps_size(ps, rec.world_size)
+        # The tensor operand may arrive positionally or by keyword; the
+        # grouped ops spell it `tensors`, the singular ones `tensor`.
+        first = get("tensors", 0, get("tensor", 0))
+        was_list = isinstance(first, (list, tuple))
+        tensors = list(first) if was_list else [first]
+        if kind in _GROUPED_KINDS:
+            rec.record(kind, tensors, ps, name)
+            return _stub_outputs(kind, tensors, n)
+        if kind == "allgather_ragged":
+            rec.record("allgather", tensors, ps, name)
+            return _stub_outputs(kind, tensors, n,
+                                 return_sizes=bool(get("return_sizes", 3)))
+        if kind == "alltoall":
+            rec.record("alltoall", tensors, ps, name)
+            return _stub_outputs(kind, tensors, n)
+        if kind == "barrier":
+            rec.record("barrier", [], ps, name)
+            return None
+        if kind.endswith("_async"):
+            from horovod_tpu.ops.collective_ops import Handle
+            base = kind[:-len("_async")]
+            # Async allreduce rides the fusion runtime: its flush order is
+            # cycle-timed, so seq prediction is approximate -> "fused".
+            origin = "fused" if base == "allreduce" else "eager"
+            rec.record(base, tensors, ps, name, origin=origin)
+            out = _stub_outputs(base, tensors, n)
+            if not was_list and isinstance(out, list):
+                out = out[0]
+            return Handle(out, name)
+        return NotImplemented
+
+    return hook
+
+
+@dataclasses.dataclass
+class CheckReport:
+    """Result of :func:`check_program`."""
+
+    world_size: int
+    ranks: tuple
+    sequences: dict                  # rank -> [CollectiveEvent]
+    findings: list
+    sampled: bool = False            # True when not every rank was traced
+
+    @property
+    def ok(self):
+        return not any(f.severity == ERROR for f in self.findings)
+
+    def errors(self):
+        return [f for f in self.findings if f.severity == ERROR]
+
+    def sequence_hash(self, rank=None, ps=None):
+        """Stable hash of one rank's predicted sequence (default: the
+        lowest simulated rank) — comparable with
+        ``events.sequence_hash(flight_events)`` from a real run."""
+        rank = self.ranks[0] if rank is None else rank
+        return sequence_hash(self.sequences[rank], ps=ps)
+
+    def predicted(self, rank=None):
+        """The ordered ``(op, ps, seq, sig)`` identities for one rank."""
+        rank = self.ranks[0] if rank is None else rank
+        return [e.identity() for e in self.sequences[rank]]
+
+    def render(self):
+        lines = [f"check_program: world_size={self.world_size} "
+                 f"ranks={list(self.ranks)}"
+                 + (" (sampled)" if self.sampled else "")]
+        ref = self.sequences.get(self.ranks[0], [])
+        lines.append(f"  predicted collectives (rank {self.ranks[0]}): "
+                     f"{len(ref)}")
+        for e in ref[:32]:
+            lines.append(f"    {e.describe()}")
+        if len(ref) > 32:
+            lines.append(f"    ... {len(ref) - 32} more")
+        if not self.findings:
+            lines.append("  findings: none — program is desync-clean")
+        else:
+            lines.append(f"  findings: {len(self.findings)}")
+            for f in sort_findings(self.findings):
+                lines.append(f"    {f.render()}")
+        return "\n".join(lines)
+
+
+def _abstractify(args, kwargs):
+    """Split pytree leaves into dynamic (traced: arrays / ShapeDtypeStruct)
+    and static (python scalars, strings — kept concrete so user control
+    flow on them still works)."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten((args, kwargs or {}))
+    dyn_idx, dyn_specs = [], []
+    for i, leaf in enumerate(leaves):
+        if isinstance(leaf, jax.ShapeDtypeStruct):
+            dyn_idx.append(i)
+            dyn_specs.append(leaf)
+        elif isinstance(leaf, (jax.Array, np.ndarray)):
+            dyn_idx.append(i)
+            dyn_specs.append(jax.ShapeDtypeStruct(leaf.shape, leaf.dtype))
+    return leaves, treedef, dyn_idx, dyn_specs
+
+
+def _trace_rank(step_fn, args, kwargs, rank, world_size, local_size):
+    """One simulated-rank abstract eval; returns (events, reused, jaxpr)."""
+    import jax
+
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops import collective_ops
+
+    rec = _TraceRecorder(world_size)
+    leaves, treedef, dyn_idx, dyn_specs = _abstractify(args, kwargs)
+
+    def traced(*dyn):
+        filled = list(leaves)
+        for i, v in zip(dyn_idx, dyn):
+            filled[i] = v
+        a, kw = jax.tree_util.tree_unflatten(treedef, filled)
+        return step_fn(*a, **kw)
+
+    prev_sim = basics._set_sim_world(
+        basics._SimWorld(rank, world_size, local_size))
+    prev_hook = collective_ops.set_intercept(_make_hook(rec))
+    try:
+        closed = jax.make_jaxpr(traced)(*dyn_specs)
+    finally:
+        collective_ops.set_intercept(prev_hook)
+        basics._set_sim_world(prev_sim)
+    return rec, closed
+
+
+def _jit_events(closed):
+    """In-jit collectives of a traced step as CollectiveEvents; also
+    returns the cond-gated and degenerate (1-sized axis) subsets."""
+    events = []
+    cond_ops = []
+    degenerate = []
+    for c in jaxpr_walk.collect(closed):
+        ax = ",".join(c.axes) if c.axes else "?"
+        events.append(CollectiveEvent(
+            op=c.op, ps=f"axis:{ax}", seq=0, shapes=c.shapes,
+            dtypes=c.dtypes, origin="jit", nbytes=c.nbytes,
+            repeat=max(c.repeat, 0)))  # 0 = unknown (while-loop body)
+        if c.in_cond:
+            cond_ops.append(c)
+        if any(s == 1 for s in c.axis_sizes if s is not None):
+            degenerate.append(c)
+    return events, cond_ops, degenerate
+
+
+def check_program(step_fn, args=(), kwargs=None, *, world_size=None,
+                  local_size=None, ranks=None, config=None,
+                  include_advisories=True, max_traced_ranks=16):
+    """Statically analyze ``step_fn(*args, **kwargs)`` for collective
+    desync hazards across a simulated world.
+
+    Array(-like) leaves of ``args``/``kwargs`` are abstracted to
+    shape/dtype (pass real arrays or ``jax.ShapeDtypeStruct``); python
+    scalars stay concrete. ``world_size`` defaults to the live
+    ``hvd.size()`` when initialized, else 2. ``ranks`` selects which ranks
+    to simulate (default: all, sampled down to ``max_traced_ranks``
+    boundary ranks for very large worlds). Returns a :class:`CheckReport`.
+
+    Zero device execution: the step is traced per rank with
+    ``jax.make_jaxpr``; eager framework collectives are intercepted, raw
+    in-jit collectives are read from the jaxpr.
+    """
+    from horovod_tpu.common import basics
+    from horovod_tpu.common.config import Config
+
+    if world_size is None:
+        try:
+            world_size = basics.size()
+        except Exception:
+            world_size = 2
+    world_size = int(world_size)
+    if world_size < 1:
+        raise ValueError(f"world_size={world_size}")
+    sampled = False
+    if ranks is None:
+        if world_size <= max_traced_ranks:
+            ranks = tuple(range(world_size))
+        else:
+            # Boundary ranks catch the usual rank-gated patterns (first,
+            # second, middle, last); sampling is reported on the report.
+            ranks = tuple(sorted({0, 1, world_size // 2,
+                                  world_size - 2, world_size - 1}))
+            sampled = True
+    else:
+        ranks = tuple(sorted(set(int(r) for r in ranks)))
+        for r in ranks:
+            if not 0 <= r < world_size:
+                raise ValueError(f"rank {r} outside world {world_size}")
+    if config is None:
+        try:
+            config = basics.config()
+        except Exception:
+            config = Config()
+
+    sequences, reuse_by_rank, cond_by_rank, degen_by_rank = {}, {}, {}, {}
+    for r in ranks:
+        rec, closed = _trace_rank(step_fn, args, kwargs, r, world_size,
+                                  local_size)
+        jit_events, cond_ops, degen_jit = _jit_events(closed)
+        sequences[r] = assign_seqs(rec.events + jit_events)
+        reuse_by_rank[r] = (rec, rec.reused)
+        cond_by_rank[r] = cond_ops
+        degen_by_rank[r] = degen_jit
+
+    findings = _diff_sequences(sequences, ranks)
+    findings += _degenerate_findings(sequences, ranks)
+    r0 = ranks[0]
+    findings += _cond_findings(cond_by_rank[r0], r0)
+    for c in degen_by_rank[r0]:
+        ax = ",".join(c.axes) if c.axes else "?"
+        findings.append(Finding(
+            code="HVP104", severity=WARNING,
+            message=(f"degenerate sharding: {c.op} over 1-device mesh "
+                     f"axis {ax} — all dispatch cost, no exchange"),
+            rank=r0, op=c.op, ps=f"axis:{ax}"))
+    if include_advisories:
+        findings += _advisory_findings(sequences[r0], r0, config,
+                                       reuse_by_rank[r0])
+    return CheckReport(world_size=world_size, ranks=ranks,
+                       sequences=sequences,
+                       findings=sort_findings(findings), sampled=sampled)
+
+
+def _diff_sequences(sequences, ranks):
+    """Cross-rank sequence diff per process set: HVP101/102/103."""
+    findings = []
+    all_ps = []
+    for r in ranks:
+        for e in sequences[r]:
+            if e.ps not in all_ps:
+                all_ps.append(e.ps)
+    r0 = ranks[0]
+    for ps in all_ps:
+        streams = {r: [e for e in sequences[r] if e.ps == ps]
+                   for r in ranks}
+        lens = {r: len(s) for r, s in streams.items()}
+        if len(set(lens.values())) > 1:
+            # Count mismatch: the rank-gated-collective deadlock class.
+            # Name the first seq where a longer rank has an event some
+            # shorter rank does not.
+            short = min(lens, key=lambda r: lens[r])
+            long = max(lens, key=lambda r: lens[r])
+            pos = _first_divergence(streams[short], streams[long])
+            extra = streams[long][min(pos, lens[long] - 1)]
+            gated = sorted(r for r in ranks if lens[r] == lens[long])
+            absent = sorted(r for r in ranks if lens[r] == lens[short])
+            findings.append(Finding(
+                code="HVP101", severity=ERROR,
+                message=(f"rank-gated collective: rank(s) {gated} dispatch "
+                         f"{extra.op} on {ps} at seq {extra.seq} that "
+                         f"rank(s) {absent} never dispatch — ranks "
+                         f"{absent} block forever at their next {ps} "
+                         f"collective (or the job wedges at this one)"),
+                rank=gated[0], op=extra.op, ps=ps, seq=extra.seq,
+                sig=extra.sig))
+            continue
+        base = streams[r0]
+        for r in ranks[1:]:
+            pos = _first_divergence(base, streams[r])
+            if pos is None:
+                continue
+            a, b = base[pos], streams[r][pos]
+            ops_aligned = [e.op for e in base] \
+                == [e.op for e in streams[r]]
+            if ops_aligned and a.sig != b.sig:
+                code, sev, what = "HVP103", ERROR, (
+                    f"dtype/shape mismatch: rank {r0} dispatches "
+                    f"{a.op} with sig {a.sig} "
+                    f"{a.shapes}/{a.dtypes} at {ps} seq {a.seq}, rank {r} "
+                    f"dispatches sig {b.sig} {b.shapes}/{b.dtypes}")
+            elif {e.key() for e in base} == {e.key() for e in streams[r]}:
+                code, sev, what = "HVP102", ERROR, (
+                    f"op-order mismatch on {ps}: at seq {a.seq} rank "
+                    f"{r0} dispatches {a.op} (sig {a.sig}) while rank "
+                    f"{r} dispatches {b.op} (sig {b.sig})")
+            else:
+                code, sev, what = "HVP101", ERROR, (
+                    f"rank-divergent collective stream on {ps}: first "
+                    f"divergence at seq {a.seq} — rank {r0}: {a.op} "
+                    f"(sig {a.sig}) vs rank {r}: {b.op} (sig {b.sig})")
+            findings.append(Finding(
+                code=code, severity=sev, message=what, rank=r, op=b.op,
+                ps=ps, seq=b.seq, sig=b.sig))
+            break
+    return findings
+
+
+def _first_divergence(a, b):
+    for i, (x, y) in enumerate(zip(a, b)):
+        if x.key() != y.key():
+            return i
+    if len(a) != len(b):
+        return min(len(a), len(b))
+    return None
+
+
+def _degenerate_findings(sequences, ranks):
+    findings = []
+    seen = set()
+    for r in ranks:
+        for e in sequences[r]:
+            if e.origin == "jit":
+                continue
+            # eager op over a 1-member set: leading global dim == 1
+            n = e.shapes[0][0] if e.shapes and e.shapes[0] else None
+            if n == 1 and e.op != "barrier":
+                key = (e.op, e.ps, e.seq)
+                if key not in seen:
+                    seen.add(key)
+                    findings.append(Finding(
+                        code="HVP104", severity=WARNING,
+                        message=(f"degenerate collective: {e.op} over "
+                                 f"1-member process set {e.ps} — all "
+                                 "dispatch cost, no exchange"),
+                        rank=r, op=e.op, ps=e.ps, seq=e.seq, sig=e.sig))
+    return findings
+
+
+def _cond_findings(cond_ops, rank):
+    findings = []
+    for c in cond_ops:
+        ax = ",".join(c.axes) if c.axes else "?"
+        findings.append(Finding(
+            code="HVP108", severity=WARNING,
+            message=(f"{c.op} over axis {ax} inside a lax.cond branch "
+                     f"{c.branch}: if the predicate varies across the "
+                     "mesh, a device subset enters the collective and "
+                     "the rendezvous deadlocks (see "
+                     "parallel/pp.py head-gating)"),
+            rank=rank, op=c.op, ps=f"axis:{ax}"))
+    return findings
+
+
+def _advisory_findings(events, rank, config, reuse_info):
+    findings = []
+    rec, reused = reuse_info
+    sync_eager = [e for e in events if e.origin == "eager"
+                  and e.op != "barrier"]
+    threshold = int(getattr(config, "fusion_threshold", 0)) or 1
+    small = [e for e in sync_eager
+             if e.nbytes < threshold * _FILL_SMALL_FRACTION]
+    if len(small) >= _FILL_MIN_EVENTS:
+        total = sum(e.nbytes for e in small)
+        findings.append(Finding(
+            code="HVP105", severity=INFO,
+            message=(f"{len(small)} sync eager collectives of "
+                     f"{total} B total — fusion-threshold fill ratio "
+                     f"{total / threshold:.1%}; *_async dispatch would "
+                     "batch them into one fused flush"),
+            rank=rank))
+    for e in events:
+        if e.origin == "fused" and e.nbytes > threshold:
+            findings.append(Finding(
+                code="HVP105", severity=INFO,
+                message=(f"async {e.op} of {e.nbytes} B exceeds the "
+                         f"fusion threshold ({threshold} B): every "
+                         "enqueue flushes immediately — raise "
+                         "HOROVOD_FUSION_THRESHOLD or dispatch sync"),
+                rank=rank, op=e.op, ps=e.ps, seq=e.seq, sig=e.sig))
+            break
+    wire = getattr(config, "wire_dtype", "")
+    if wire:
+        fp32_jit = [e for e in events if e.origin == "jit"
+                    and any("float32" in d for d in e.dtypes)]
+        if fp32_jit:
+            e = fp32_jit[0]
+            findings.append(Finding(
+                code="HVP106", severity=INFO,
+                message=(f"wire_dtype={wire} is configured but "
+                         f"{len(fp32_jit)} in-jit collective(s) move "
+                         "float32 on the wire — the wire cast covers "
+                         "only eager/fused dispatches; use "
+                         "Compression inside jit"),
+                rank=rank, op=e.op, ps=e.ps))
+    if reused:
+        first, second = reused[0]
+        ev = events[first] if first < len(events) else None
+        donate = bool(getattr(config, "donate_eager", False))
+        findings.append(Finding(
+            code="HVP107",
+            severity=WARNING if donate else INFO,
+            message=(("donated buffer reused: the same input buffer "
+                      "feeds collectives at event positions "
+                      f"{first} and {second} while HOROVOD_DONATE_BUFFERS "
+                      "is armed — the first dispatch invalidates it")
+                     if donate else
+                     ("non-donated buffer reuse: one input buffer feeds "
+                      f"collectives at event positions {first} and "
+                      f"{second}; eager donation "
+                      "(HOROVOD_DONATE_BUFFERS) cannot apply to reused "
+                      "buffers")),
+            rank=rank, op=getattr(ev, "op", None),
+            ps=getattr(ev, "ps", None),
+            seq=getattr(ev, "seq", None),
+            sig=getattr(ev, "sig", None) if ev else None))
+    return findings
+
+
+def cross_check(report, flight_events, rank=None, ps="global"):
+    """Compare a static :class:`CheckReport` against flight-recorder events
+    from a real run (``flight.recorder.events()`` dicts or a loaded dump).
+
+    Returns a dict: ``match`` (bool), ``predicted``/``recorded`` hash,
+    ``first_mismatch`` (None or ``(predicted_identity,
+    recorded_identity)``), ``n_predicted``/``n_recorded``."""
+    rank = report.ranks[0] if rank is None else rank
+    predicted = [e for e in report.sequences[rank]
+                 if ps is None or e.ps == ps]
+    recorded = [e for e in flight_events
+                if e.get("kind") == "dispatch"
+                and (ps is None or e.get("ps") == ps)]
+    pred_ids = [e.identity() for e in predicted]
+    rec_ids = [(e.get("op"), e.get("ps"), e.get("seq"), e.get("sig"))
+               for e in recorded]
+    first_mismatch = None
+    for i in range(max(len(pred_ids), len(rec_ids))):
+        p = pred_ids[i] if i < len(pred_ids) else None
+        q = rec_ids[i] if i < len(rec_ids) else None
+        if p != q:
+            first_mismatch = (p, q)
+            break
+    return {
+        "match": first_mismatch is None,
+        "predicted_hash": sequence_hash(predicted, ps=ps),
+        "recorded_hash": sequence_hash(recorded, ps=ps),
+        "first_mismatch": first_mismatch,
+        "n_predicted": len(pred_ids),
+        "n_recorded": len(rec_ids),
+    }

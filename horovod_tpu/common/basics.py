@@ -564,29 +564,71 @@ def config():
 
 # --- rank / size queries (reference: operations.cc:1119-1229) ---
 
+# Simulated-world overlay (horovod_tpu/analysis/program.py): while the
+# static analyzer abstract-evals a step function "as rank r of n", the
+# rank/size queries below answer from this overlay instead of the live
+# topology — rank-conditional Python control flow then resolves per
+# simulated rank with zero device execution. None = no simulation.
+_sim_world = None
+
+
+class _SimWorld:
+    __slots__ = ("rank", "size", "local_rank", "local_size",
+                 "cross_rank", "cross_size")
+
+    def __init__(self, rank, size, local_size=None):
+        self.rank = rank
+        self.size = size
+        self.local_size = size if local_size is None else local_size
+        self.local_rank = rank % self.local_size
+        self.cross_rank = rank // self.local_size
+        self.cross_size = max(1, size // self.local_size)
+
+
+def _set_sim_world(sim):
+    """Install (or clear, with ``None``) the simulated world. Returns the
+    previous overlay so nested simulations can restore it."""
+    global _sim_world
+    prev = _sim_world
+    _sim_world = sim
+    return prev
+
+
 def size():
+    if _sim_world is not None:
+        return _sim_world.size
     return _get_state().topology.size
 
 
 def local_size():
+    if _sim_world is not None:
+        return _sim_world.local_size
     return _get_state().topology.local_size
 
 
 def cross_size():
+    if _sim_world is not None:
+        return _sim_world.cross_size
     return _get_state().topology.cross_size
 
 
 def rank():
+    if _sim_world is not None:
+        return _sim_world.rank
     t = _get_state().topology
     return t.local_device_ranks[0] if t.local_device_ranks else 0
 
 
 def local_rank():
+    if _sim_world is not None:
+        return _sim_world.local_rank
     t = _get_state().topology
     return rank() % t.local_size
 
 
 def cross_rank():
+    if _sim_world is not None:
+        return _sim_world.cross_rank
     t = _get_state().topology
     return rank() // t.local_size
 

@@ -201,6 +201,54 @@ class Config:
     telemetry_step_lag: int = 5
     telemetry_seq_lag: int = 64
 
+    # --- logging (common/logging.py; reference: HOROVOD_LOG_LEVEL /
+    # HOROVOD_LOG_HIDE_TIME, horovod/common/logging.cc) ---
+    log_level: str = "warning"
+    log_hide_time: bool = False
+
+    # --- elastic control knobs read outside Config (declared here so the
+    # launcher propagates them and the docs catalogue them; the reading
+    # sites keep their import-time env reads) ---
+    # Coordination-service heartbeat window under HOROVOD_ELASTIC (s).
+    elastic_heartbeat_timeout: int = 10
+    # "min,max" cooldown seconds before a blacklisted host is retried
+    # (runner/elastic/discovery.py; "" = built-in defaults).
+    blacklist_cooldown_range: str = ""
+    # Ports the membership watchdog's data-plane abort must never sever
+    # (comma-separated; common/sockets.py).
+    abort_exclude_ports: str = ""
+    # Virtual slice layout override "slices" or "slices:len" for the
+    # hierarchical telemetry/topology plane (common/topology.py).
+    mesh_slices: str = ""
+
+    # --- step-profiler tuning (horovod_tpu/profile; the always-on knobs
+    # above arm the subsystem, these tune it) ---
+    # Completed per-step records kept in the in-memory ring.
+    profile_history: int = 512
+    # Per-peer KV read budget in the watchdog's cross-rank round (ms).
+    profile_publish_timeout_ms: int = 250
+    # Robust z-score marking a rank a straggler, and the minimum absolute
+    # excess (ms) so microsecond jitter never trips it.
+    profile_z_threshold: float = 4.0
+    profile_straggler_min_ms: float = 5.0
+    # Roofline peak overrides (0 = detected chip table): bf16 TFLOP/s,
+    # HBM / ICI / DCN GB/s (profile/roofline.py).
+    peak_tflops: float = 0.0
+    peak_hbm_gbs: float = 0.0
+    peak_ici_gbs: float = 0.0
+    peak_dcn_gbs: float = 0.0
+
+    # --- Pallas flash-attention kernels (ops/pallas/flash_attention.py) ---
+    # Tile-size cap for on-chip sweeps (0 = auto).
+    flash_block: int = 0
+    # Re-enable the kernels on non-multiple-of-block shapes (padded
+    # path; off pending silicon sentinel evidence — ROADMAP item 4).
+    flash_allow_padded: bool = False
+
+    # --- bench/progress plumbing (bench.py, chaos/soak.py) ---
+    # JSONL progress stream consumed by the evidence sentinel ("" = off).
+    bench_progress_file: str = ""
+
     # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
     # the reference's observability stops at timeline + stall inspector).
     # Always-on by default: the registry hot path is O(1) and lock-light
@@ -336,6 +384,35 @@ class Config:
                                         c.telemetry_step_lag)
         c.telemetry_seq_lag = _env_int("HOROVOD_TELEMETRY_SEQ_LAG",
                                        c.telemetry_seq_lag)
+        c.log_level = os.environ.get("HOROVOD_LOG_LEVEL", c.log_level)
+        c.log_hide_time = _env_bool("HOROVOD_LOG_HIDE_TIME",
+                                    c.log_hide_time)
+        c.elastic_heartbeat_timeout = _env_int(
+            "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT", c.elastic_heartbeat_timeout)
+        c.blacklist_cooldown_range = os.environ.get(
+            "HOROVOD_BLACKLIST_COOLDOWN_RANGE", c.blacklist_cooldown_range)
+        c.abort_exclude_ports = os.environ.get(
+            "HOROVOD_ABORT_EXCLUDE_PORTS", c.abort_exclude_ports)
+        c.mesh_slices = os.environ.get("HOROVOD_MESH_SLICES",
+                                       c.mesh_slices)
+        c.profile_history = _env_int("HOROVOD_PROFILE_HISTORY",
+                                     c.profile_history)
+        c.profile_publish_timeout_ms = _env_int(
+            "HOROVOD_PROFILE_PUBLISH_TIMEOUT_MS",
+            c.profile_publish_timeout_ms)
+        c.profile_z_threshold = _env_float("HOROVOD_PROFILE_Z_THRESHOLD",
+                                           c.profile_z_threshold)
+        c.profile_straggler_min_ms = _env_float(
+            "HOROVOD_PROFILE_STRAGGLER_MIN_MS", c.profile_straggler_min_ms)
+        c.peak_tflops = _env_float("HOROVOD_PEAK_TFLOPS", c.peak_tflops)
+        c.peak_hbm_gbs = _env_float("HOROVOD_PEAK_HBM_GBS", c.peak_hbm_gbs)
+        c.peak_ici_gbs = _env_float("HOROVOD_PEAK_ICI_GBS", c.peak_ici_gbs)
+        c.peak_dcn_gbs = _env_float("HOROVOD_PEAK_DCN_GBS", c.peak_dcn_gbs)
+        c.flash_block = _env_int("HVD_FLASH_BLOCK", c.flash_block)
+        c.flash_allow_padded = _env_bool("HVD_FLASH_ALLOW_PADDED",
+                                         c.flash_allow_padded)
+        c.bench_progress_file = os.environ.get("HVD_BENCH_PROGRESS_FILE",
+                                               c.bench_progress_file)
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
         c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
         c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",

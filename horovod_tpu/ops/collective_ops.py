@@ -63,6 +63,47 @@ Max = ReduceOp.MAX
 Product = ReduceOp.PRODUCT
 
 
+# ----------------------------------------------------------------------------
+# Static-analysis interception (horovod_tpu/analysis/program.py).
+#
+# ``hvd.check_program`` abstract-evals a user step function per simulated
+# rank with ZERO device execution; while it traces, every eager entry point
+# below routes through this hook, which records the would-be dispatch
+# (op, process set, signature) and returns an abstract stand-in result.
+# One ``is not None`` check on the hot path when no analysis is running.
+# ----------------------------------------------------------------------------
+
+_intercept = None
+
+
+def set_intercept(hook):
+    """Install (or clear, with ``None``) the eager-dispatch interceptor.
+    ``hook(kind, args, kwargs)`` may return ``NotImplemented`` to fall
+    through to the real dispatch. Analysis-only: not thread-safe by
+    design — the analyzer owns the process while tracing."""
+    global _intercept
+    prev = _intercept
+    _intercept = hook
+    return prev
+
+
+def _interceptable(kind):
+    def deco(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            hook = _intercept
+            if hook is not None:
+                out = hook(kind, args, kwargs)
+                if out is not NotImplemented:
+                    return out
+            return fn(*args, **kwargs)
+
+        wrapper.__wrapped__ = fn
+        return wrapper
+
+    return deco
+
+
 def _mesh_for(process_set):
     ps = process_set if process_set is not None else global_process_set
     return ps.mesh, ps
@@ -896,6 +937,7 @@ def allreduce(tensor, op=Average, prescale_factor=1.0, postscale_factor=1.0,
                              process_set=process_set, name=name)[0]
 
 
+@_interceptable("allreduce")
 def grouped_allreduce(tensors, op=Average, prescale_factor=1.0,
                       postscale_factor=1.0, process_set=None, name=None):
     """One fused dispatch for a group of tensors — completes atomically like
@@ -948,6 +990,7 @@ def allgather(tensor, process_set=None, name=None):
     return grouped_allgather([tensor], process_set=process_set, name=name)[0]
 
 
+@_interceptable("allgather")
 def grouped_allgather(tensors, process_set=None, name=None):
     mesh, ps = _mesh_for(process_set)
     sig = _plan_sig(tensors)
@@ -990,6 +1033,7 @@ def grouped_allgather(tensors, process_set=None, name=None):
         return _localize(list(prog(*tensors)), mesh)
 
 
+@_interceptable("allgather_ragged")
 def allgather_ragged(tensors, process_set=None, name=None,
                      return_sizes=False, _mirror=False):
     """Allgather of per-rank tensors with differing first dims.
@@ -1057,6 +1101,7 @@ def broadcast(tensor, root_rank, process_set=None, name=None):
                              name=name)[0]
 
 
+@_interceptable("broadcast")
 def grouped_broadcast(tensors, root_rank, process_set=None, name=None):
     mesh, ps = _mesh_for(process_set)
     sig = _plan_sig(tensors)
@@ -1113,6 +1158,7 @@ def reducescatter(tensor, op=Sum, prescale_factor=1.0, postscale_factor=1.0,
                                  process_set=process_set, name=name)[0]
 
 
+@_interceptable("reducescatter")
 def grouped_reducescatter(tensors, op=Sum, prescale_factor=1.0,
                           postscale_factor=1.0, process_set=None, name=None):
     mesh, ps = _mesh_for(process_set)
@@ -1151,6 +1197,7 @@ def grouped_reducescatter(tensors, op=Sum, prescale_factor=1.0,
         return _localize(list(prog(*tensors)), mesh)
 
 
+@_interceptable("alltoall")
 def alltoall(tensor, splits=None, process_set=None, name=None):
     """All-to-all exchange. Equal splits ride a single XLA AllToAll; uneven
     ``splits`` (per-rank row counts to send to each peer) use the padded path.
@@ -1281,6 +1328,7 @@ def _alltoall_pack_index(full_bytes, n, m, rows_global):
     return jnp.asarray(pack.reshape(n, n * block)[list(rows_global)])
 
 
+@_interceptable("barrier")
 def barrier(process_set=None, name=None):
     """Block until all ranks reach the barrier
     (reference: hvd.barrier operations.cc EnqueueBarrier, message.h BARRIER)."""
@@ -1710,6 +1758,7 @@ class Handle:
         return self._outputs
 
 
+@_interceptable("allreduce_async")
 def allreduce_async(tensor, op=Average, prescale_factor=1.0,
                     postscale_factor=1.0, process_set=None, name=None):
     """Async allreduce through the tensor-fusion runtime: small tensors
@@ -1737,6 +1786,7 @@ def allreduce_async(tensor, op=Average, prescale_factor=1.0,
                                            postscale_factor, name)
 
 
+@_interceptable("allreduce_async")
 def grouped_allreduce_async(tensors, op=Average, prescale_factor=1.0,
                             postscale_factor=1.0, process_set=None, name=None):
     """Async grouped allreduce through the fusion runtime: the group
@@ -1764,20 +1814,24 @@ def grouped_allreduce_async(tensors, op=Average, prescale_factor=1.0,
         ts, op, prescale_factor, postscale_factor, name)
 
 
+@_interceptable("allgather_async")
 def allgather_async(tensor, process_set=None, name=None):
     return Handle(allgather(tensor, process_set=process_set, name=name), name)
 
 
+@_interceptable("broadcast_async")
 def broadcast_async(tensor, root_rank, process_set=None, name=None):
     return Handle(broadcast(tensor, root_rank, process_set=process_set,
                             name=name), name)
 
 
+@_interceptable("alltoall_async")
 def alltoall_async(tensor, splits=None, process_set=None, name=None):
     return Handle(alltoall(tensor, splits=splits, process_set=process_set,
                            name=name), name)
 
 
+@_interceptable("reducescatter_async")
 def reducescatter_async(tensor, op=Sum, process_set=None, name=None):
     return Handle(reducescatter(tensor, op=op, process_set=process_set,
                                 name=name), name)

@@ -375,6 +375,20 @@ class _CompositeLM:
             else:
                 loss, grads = jax.value_and_grad(self._loss_local)(params,
                                                                    ids)
+                # With check_vma off, AD inserts NO cross-rank grad sync
+                # (psum's un-rewritten transpose seeds every rank with its
+                # own local cotangent): each rank's grads are d(local
+                # loss). Two explicit reductions make gpipe match 1f1b's
+                # hand-built ones: (1) embed/moe grads exist only on the
+                # stage-0 pp rank (the pipeline ingests microbatches
+                # there), so a pp psum replicates them; (2) every
+                # replicated-or-pp-sharded leaf needs the dp mean.
+                for k in ("embed", "moe"):
+                    if k in grads:
+                        grads[k] = jax.tree_util.tree_map(
+                            lambda g: lax.psum(g, PPL_AXIS), grads[k])
+                grads = jax.tree_util.tree_map(
+                    lambda g: lax.pmean(g, DP_AXIS), grads)
             updates, opt_state = self.optimizer.update(grads, opt_state,
                                                        params)
             params = optax.apply_updates(params, updates)

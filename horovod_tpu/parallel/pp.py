@@ -47,6 +47,42 @@ def _jaxpr_has_collectives(jaxpr) -> bool:
     return False
 
 
+import functools
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def _bcast_from_last(x, axis_name):
+    """Replicate the LAST pp rank's value to every rank: one masked psum.
+
+    custom_vjp because the psum's AD transpose over-delivers here: each
+    rank's (identical, replicated) loss cotangent re-enters through the
+    transpose, so the last stage receives the cotangent summed n_stages
+    times — gradients scale by the pp world size (observed as exactly-8x
+    grads on the 8-stage CPU tier). The backward hands the cotangent to
+    the last stage exactly once; other ranks' buffers never reach the
+    loss in forward (masked to zero), so their cotangent is zero."""
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    return lax.psum(jnp.where(stage == n - 1, x, jnp.zeros_like(x)),
+                    axis_name)
+
+
+def _bcast_from_last_fwd(x, axis_name):
+    return _bcast_from_last(x, axis_name), None
+
+
+def _bcast_from_last_bwd(axis_name, _res, ct):
+    from horovod_tpu.ops.in_jit import mark_varying
+
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    return (mark_varying(
+        jnp.where(stage == n - 1, ct, jnp.zeros_like(ct)), axis_name),)
+
+
+_bcast_from_last.defvjp(_bcast_from_last_fwd, _bcast_from_last_bwd)
+
+
 def stage_apply(layer_fn: Callable, stage_params, x):
     """Apply this stage's stacked layers sequentially: ``layer_fn(p_i, x)``
     scanned over the leading (layer) dim of ``stage_params``."""
@@ -111,8 +147,10 @@ def pipeline(layer_fn: Callable, stage_params, microbatches,
 
     (_, outputs), _ = lax.scan(tick, (state, outputs),
                                jnp.arange(n_micro + n_stages - 1))
-    # Broadcast the last stage's outputs to every rank.
-    return lax.psum(jnp.where(stage == n_stages - 1, outputs, 0.0), axis_name)
+    # Broadcast the last stage's outputs to every rank (grad-correct: a
+    # plain masked psum's transpose would deliver n_stages copies of the
+    # replicated loss cotangent — see _bcast_from_last).
+    return _bcast_from_last(outputs, axis_name)
 
 
 def pipeline_1f1b(layer_fn: Callable, head_loss_fn: Callable, stage_params,

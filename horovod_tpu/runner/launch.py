@@ -350,14 +350,31 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_PROFILE_PUBLISH_STEPS"):
         if os.environ.get(var):
             env.setdefault(var, os.environ[var])
-    # Cluster-telemetry knobs + the virtual-slice override ride through to
-    # every worker: slice membership must be computed identically on all
-    # ranks, and the health thresholds must agree with the leader's.
+    # Cluster-telemetry knobs + the virtual-slice override (slice
+    # membership and health thresholds must agree across ranks), and every
+    # remaining declared knob (common/config.py::Config) — logging,
+    # elastic control, profiler tuning, roofline peaks, flash kernels and
+    # the bench progress stream — ride through so a knob set on the
+    # launcher is never silently single-process. scripts/lint.py (HVL002)
+    # pins the "declared implies propagated" contract this loop exists
+    # for.
     for var in ("HOROVOD_TELEMETRY", "HOROVOD_TELEMETRY_INTERVAL",
                 "HOROVOD_TELEMETRY_METRICS", "HOROVOD_TELEMETRY_DEAD_AFTER",
                 "HOROVOD_TELEMETRY_STALL_AFTER",
                 "HOROVOD_TELEMETRY_STEP_LAG", "HOROVOD_TELEMETRY_SEQ_LAG",
-                "HOROVOD_MESH_SLICES"):
+                "HOROVOD_MESH_SLICES",
+                "HOROVOD_LOG_LEVEL", "HOROVOD_LOG_HIDE_TIME",
+                "HOROVOD_ELASTIC_HEARTBEAT_TIMEOUT",
+                "HOROVOD_BLACKLIST_COOLDOWN_RANGE",
+                "HOROVOD_ABORT_EXCLUDE_PORTS",
+                "HOROVOD_PROFILE_HISTORY",
+                "HOROVOD_PROFILE_PUBLISH_TIMEOUT_MS",
+                "HOROVOD_PROFILE_Z_THRESHOLD",
+                "HOROVOD_PROFILE_STRAGGLER_MIN_MS",
+                "HOROVOD_PEAK_TFLOPS", "HOROVOD_PEAK_HBM_GBS",
+                "HOROVOD_PEAK_ICI_GBS", "HOROVOD_PEAK_DCN_GBS",
+                "HVD_FLASH_BLOCK", "HVD_FLASH_ALLOW_PADDED",
+                "HVD_BENCH_PROGRESS_FILE"):
         if os.environ.get(var):
             env.setdefault(var, os.environ[var])
     # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU

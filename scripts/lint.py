@@ -1,0 +1,48 @@
+#!/usr/bin/env python
+"""Repo self-lint: hvdlint over the library, examples, scripts and tests.
+
+Thin wrapper over ``python -m horovod_tpu.analysis.lint`` pinned to the
+repo's default scope, so CI and humans run the identical check:
+
+    python scripts/lint.py            # lint the default scope
+    python scripts/lint.py --format json
+    python scripts/lint.py path/...   # lint specific paths instead
+
+Exit status 1 on any finding. The tier-1 gate
+(tests/test_analysis.py::TestSelfLint) runs this scope and asserts it
+stays clean and under the 30 s budget; suppress intentional violations
+inline with ``# hvdlint: disable=HVLxxx -- <reason>``
+(docs/static_analysis.md).
+"""
+
+import os
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DEFAULT_SCOPE = ("horovod_tpu", "examples", "scripts", "bench.py")
+
+
+def main(argv=None):
+    sys.path.insert(0, _REPO)
+    from horovod_tpu.analysis.lint import main as lint_main
+
+    argv = list(sys.argv[1:] if argv is None else argv)
+    value_flags = {"--rules", "--format", "--config"}
+    has_paths = False
+    skip_next = False
+    for a in argv:
+        if skip_next:
+            skip_next = False
+            continue
+        if a in value_flags:
+            skip_next = True
+        elif not a.startswith("-"):
+            has_paths = True
+    if not has_paths:
+        argv += [os.path.join(_REPO, p) for p in DEFAULT_SCOPE
+                 if os.path.exists(os.path.join(_REPO, p))]
+    return lint_main(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,585 @@
+"""hvdlint: the program analyzer (hvd.check_program) and the AST lint.
+
+Known-bad / known-good corpus: every rule class has a positive (flagged)
+and a negative (clean) case; plus the tier-1 self-lint gate over the repo
+scope and a multi-process cross-check that the analyzer's predicted
+collective sequence matches the flight recorder's recorded one."""
+
+import os
+import sys
+import time
+
+import cloudpickle
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax import lax
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+# Worker processes can't import this module by name; ship the cross-check
+# job (and anything else defined here) by value.
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+from horovod_tpu.analysis import events as an_events
+from horovod_tpu.analysis.lint import (declared_knobs, lint_paths,
+                                       lint_source)
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _codes(findings):
+    return {f.code for f in findings}
+
+
+# ---------------------------------------------------------------------------
+# Program analyzer (hvd.check_program)
+# ---------------------------------------------------------------------------
+
+
+class TestCheckProgram:
+    def test_rank_conditional_deadlock_flagged(self, hvd):
+        """Acceptance: the PR-4 chaos soak's failure shape — a collective
+        only rank 0 dispatches — is flagged statically with rank + seq +
+        op named; the equivalent unconditional program passes clean."""
+        x = np.ones((4, 8), np.float32)
+
+        def bad_step(x):
+            y = hvd.allreduce(x)
+            if hvd.rank() == 0:
+                y = y + hvd.allreduce(x * 2)
+            return y
+
+        def good_step(x):
+            y = hvd.allreduce(x)
+            y = y + hvd.allreduce(x * 2)
+            return y
+
+        rep = hvd.check_program(bad_step, (x,), world_size=4)
+        assert not rep.ok
+        err = rep.errors()[0]
+        assert err.code == "HVP101"
+        assert err.rank == 0
+        assert err.op == "allreduce"
+        assert err.seq == 2
+        assert err.ps == "global"
+        assert err.sig is not None
+        # the identity fields also appear in the rendered message
+        assert "allreduce" in err.message and "seq 2" in err.message
+
+        rep2 = hvd.check_program(good_step, (x,), world_size=4)
+        assert rep2.ok and not rep2.findings
+
+    def test_order_mismatch(self, hvd):
+        x = np.ones((4, 8), np.float32)
+
+        def bad(x):
+            if hvd.rank() % 2 == 0:
+                hvd.allreduce(x)
+                hvd.allgather(x)
+            else:
+                hvd.allgather(x)
+                hvd.allreduce(x)
+            return x
+
+        def good(x):
+            hvd.allreduce(x)
+            hvd.allgather(x)
+            return x
+
+        assert "HVP102" in _codes(
+            hvd.check_program(bad, (x,), world_size=4).findings)
+        rep = hvd.check_program(good, (x,), world_size=4)
+        assert rep.ok
+
+    def test_dtype_mismatch(self, hvd):
+        x = np.ones((4, 8), np.float32)
+
+        def bad(x):
+            y = x.astype(jnp.bfloat16) if hvd.rank() == 1 else x
+            return hvd.allreduce(y)
+
+        def good(x):
+            return hvd.allreduce(x.astype(jnp.bfloat16))
+
+        assert "HVP103" in _codes(
+            hvd.check_program(bad, (x,), world_size=4).findings)
+        assert hvd.check_program(good, (x,), world_size=4).ok
+
+    def test_degenerate_process_set(self, hvd):
+        x = np.ones((4, 8), np.float32)
+        ps1 = hvd.ProcessSet([0])
+        ps2 = hvd.ProcessSet([0, 1])
+
+        def bad(x):
+            return hvd.allreduce(x[:1], process_set=ps1)
+
+        def good(x):
+            return hvd.allreduce(x[:2], process_set=ps2)
+
+        assert "HVP104" in _codes(
+            hvd.check_program(bad, (x,), world_size=4).findings)
+        assert "HVP104" not in _codes(
+            hvd.check_program(good, (x,), world_size=4).findings)
+
+    def test_fusion_fill_advisory(self, hvd):
+        from horovod_tpu.common.config import Config
+        cfg = Config()
+        big = np.ones((4, 1024), np.float32)
+
+        def bad(x):
+            for _ in range(9):
+                x = hvd.allreduce(x) * 0 + x  # fresh buffer each round
+            return x
+
+        def good(x):
+            return hvd.allreduce(x)
+
+        rep = hvd.check_program(bad, (big,), world_size=4, config=cfg)
+        assert "HVP105" in _codes(rep.findings)
+        assert rep.ok  # advisory only
+        assert "HVP105" not in _codes(
+            hvd.check_program(good, (big,), world_size=4,
+                              config=cfg).findings)
+
+    def test_wire_dtype_advisory(self, hvd):
+        from horovod_tpu.common.config import Config
+        mesh = Mesh(np.array(jax.devices()[:4]), ("hvd",))
+        x = np.ones((4, 8), np.float32)
+
+        def jit_step(x):
+            def inner(xl):
+                return lax.psum(xl, "hvd")
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"), out_specs=P()))(x)
+
+        cfg = Config(wire_dtype="bf16")
+        assert "HVP106" in _codes(
+            hvd.check_program(jit_step, (x,), world_size=4,
+                              config=cfg).findings)
+        # no compression configured -> no advisory
+        assert "HVP106" not in _codes(
+            hvd.check_program(jit_step, (x,), world_size=4,
+                              config=Config()).findings)
+
+    def test_buffer_reuse_advisory(self, hvd):
+        from horovod_tpu.common.config import Config
+        x = np.ones((4, 8), np.float32)
+
+        def bad(x):
+            a = hvd.allreduce(x)
+            b = hvd.allgather(x)      # same buffer again
+            return a, b
+
+        def good(x):
+            a = hvd.allreduce(x)
+            b = hvd.allgather(a)
+            return a, b
+
+        cfg = Config()
+        cfg.donate_eager = True
+        rep = hvd.check_program(bad, (x,), world_size=4, config=cfg)
+        reuse = [f for f in rep.findings if f.code == "HVP107"]
+        assert reuse and reuse[0].severity == "warning"
+        cfg2 = Config()
+        rep2 = hvd.check_program(bad, (x,), world_size=4, config=cfg2)
+        reuse2 = [f for f in rep2.findings if f.code == "HVP107"]
+        assert reuse2 and reuse2[0].severity == "info"
+        assert "HVP107" not in _codes(
+            hvd.check_program(good, (x,), world_size=4,
+                              config=cfg).findings)
+
+    def test_cond_gated_jit_collective(self, hvd):
+        mesh = Mesh(np.array(jax.devices()[:4]), ("hvd",))
+        x = np.ones((4, 8), np.float32)
+
+        def bad(x):
+            def inner(xl):
+                return lax.cond(xl.sum() > 0,
+                                lambda: lax.psum(xl, "hvd"),
+                                lambda: xl * 0)
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
+                check_vma=False))(x)
+
+        def good(x):
+            def inner(xl):
+                return lax.psum(xl, "hvd")
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"), out_specs=P()))(x)
+
+        assert "HVP108" in _codes(
+            hvd.check_program(bad, (x,), world_size=4).findings)
+        assert "HVP108" not in _codes(
+            hvd.check_program(good, (x,), world_size=4).findings)
+
+    def test_jit_sequence_extraction(self, hvd):
+        """shard_map collectives land in the predicted sequence with the
+        canonical op names, in equation order."""
+        mesh = Mesh(np.array(jax.devices()[:4]), ("hvd",))
+        x = np.ones((4, 8), np.float32)
+
+        def step(x):
+            def inner(xl):
+                y = lax.psum(xl, "hvd")
+                z = lax.ppermute(
+                    xl, "hvd", [(0, 1), (1, 2), (2, 3), (3, 0)])
+                g = lax.all_gather(xl, "hvd")
+                return y + z + jnp.sum(g)
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"),
+                out_specs=P("hvd")))(x)
+
+        rep = hvd.check_program(step, (x,), world_size=4)
+        ops = [e.op for e in rep.sequences[0]]
+        assert ops == ["psum", "ppermute", "all_gather"]
+        assert all(e.ps == "axis:hvd" for e in rep.sequences[0])
+        assert rep.ok
+
+    def test_kwargs_and_positional_process_set(self, hvd):
+        """Interception must resolve operands/sets however they arrive:
+        `tensors=` by keyword, process_set positionally on async ops —
+        and size stub outputs by the SET, not the world."""
+        x = np.ones((2, 8), np.float32)
+        ps = hvd.ProcessSet([0, 1])
+
+        def step(x):
+            a = hvd.grouped_allreduce(tensors=[x])[0]
+            h = hvd.allgather_async(x, ps)          # positional ps
+            g = hvd.synchronize(h)
+            return a, g
+
+        rep = hvd.check_program(step, (x,), world_size=8)
+        events = rep.sequences[0]
+        assert [e.op for e in events] == ["allreduce", "allgather"]
+        # allreduce rode the global set (leading dim -> world size)...
+        assert events[0].shapes[0][0] == 8
+        # ...allgather rode the 2-member set: signature over (2, 8) and
+        # the stub output scaled by the set size (2*8 columns), which the
+        # trace would have crashed on (or mis-signed) had ps been lost.
+        assert events[1].shapes[0][0] == 2
+
+    def test_while_loop_collectives_excluded_from_hash(self, hvd):
+        """A while-loop body's collectives have no static trip count:
+        present in the sequence (repeat=0, diffed for presence) but
+        excluded from the exact sequence hash."""
+        from horovod_tpu.ops.in_jit import mark_varying
+        mesh = Mesh(np.array(jax.devices()[:4]), ("hvd",))
+        x = np.ones((4, 8), np.float32)
+
+        def step(x):
+            def inner(xl):
+                def cond(c):
+                    return jnp.sum(c[1]) < 100.0
+
+                def body(c):
+                    i, v = c
+                    return i + 1, lax.psum(v, "hvd") * 0 \
+                        + mark_varying(v, "hvd") + 1.0
+                _, out = lax.while_loop(
+                    cond, body,
+                    (jnp.zeros((), jnp.int32), mark_varying(xl, "hvd")))
+                return out
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"),
+                out_specs=P("hvd"), check_vma=False))(x)
+
+        rep = hvd.check_program(step, (x,), world_size=4)
+        loops = [e for e in rep.sequences[0] if e.repeat == 0]
+        assert loops and loops[0].op == "psum"
+        # the hash ignores the unknown-count event entirely
+        assert rep.sequence_hash(ps="axis:hvd") \
+            == an_events.sequence_hash([], ps="axis:hvd")
+
+    def test_sequence_hash_stable_and_rank_invariant(self, hvd):
+        x = np.ones((4, 8), np.float32)
+
+        def step(x):
+            y = hvd.allreduce(x)
+            hvd.barrier()
+            return y
+
+        rep = hvd.check_program(step, (x,), world_size=4)
+        hashes = {rep.sequence_hash(rank=r) for r in rep.ranks}
+        assert len(hashes) == 1
+        # deterministic across runs
+        rep2 = hvd.check_program(step, (x,), world_size=4)
+        assert rep2.sequence_hash() == rep.sequence_hash()
+
+    def test_large_world_sampled(self, hvd):
+        x = np.ones((4, 8), np.float32)
+
+        def step(x):
+            if hvd.rank() == hvd.size() - 1:
+                hvd.barrier()       # last-rank-only: must still be caught
+            return hvd.allreduce(x)
+
+        rep = hvd.check_program(step, (x,), world_size=1024)
+        assert rep.sampled
+        assert not rep.ok
+        assert any(f.code == "HVP101" for f in rep.findings)
+
+    def test_single_process_cross_check(self, hvd):
+        """Predicted identity tuples match the flight recorder's on a real
+        (single-process, 8-virtual-rank) run — per-event (op, ps, seq,
+        sig) and the whole-sequence hash."""
+        from horovod_tpu.analysis import cross_check
+        from horovod_tpu.flight import recorder
+
+        n = hvd.size()
+        x = np.ones((n, 4), np.float32)
+        z = np.ones((n, 2, 3), np.float32)
+
+        def step(x, z):
+            a = hvd.allreduce(x)
+            b = hvd.allgather(z)
+            c = hvd.allreduce(x * 2.0)
+            hvd.barrier()
+            return a, b, c
+
+        rep = hvd.check_program(step, (x, z), world_size=n)
+        assert rep.ok
+        # Fresh ring: the session-scoped singleton's per-set seq counter
+        # is cumulative across earlier tests, while a run's prediction
+        # starts at seq 1.
+        prev_ring, prev_armed = recorder._recorder, recorder.armed
+        recorder._recorder = recorder.FlightRecorder(capacity=64)
+        recorder.set_enabled(True)
+        try:
+            step(x, z)
+            ev = recorder.events()
+        finally:
+            recorder._recorder, recorder.armed = prev_ring, prev_armed
+        res = cross_check(rep, ev)
+        assert res["match"], res
+        assert res["predicted_hash"] == res["recorded_hash"]
+        assert res["n_predicted"] == 4
+
+
+def _xcheck_job():
+    """Worker side of the multi-process cross-check: run a short eager
+    program for real, return the flight ring's dispatch identities."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.flight import recorder
+
+    recorder.set_enabled(True)
+    nl = len(hvd.topology().local_device_ranks)
+    x = np.ones((nl, 6), np.float32)
+    z = np.ones((nl, 3, 2), np.float32)
+    before = recorder.get().appended()
+    hvd.allreduce(x, op=hvd.Sum)
+    hvd.allgather(z)
+    hvd.allreduce(x, op=hvd.Sum)
+    ev = [e for e in recorder.events()
+          if e["i"] >= before and e.get("kind") == "dispatch"]
+    return (hvd.cross_rank(), hvd.size(), ev)
+
+
+class TestMultiprocCrossCheck:
+    @pytest.mark.slow
+    def test_predicted_matches_recorded(self, hvd, shared_cluster):
+        """The analyzer's predicted collective sequence hash matches the
+        flight recorder's recorded sequence on a real 2-process CPU-tier
+        run — every (op, ps, seq, sig) identity lines up."""
+        results = shared_cluster("localhost:1,127.0.0.1:1",
+                                 extra_env={"HVD_XCHECK": "1"}).run(
+            _xcheck_job)
+        assert len(results) == 2
+        world = results[0][1]
+
+        def step(x, z):
+            hvd.allreduce(x, op=hvd.Sum)
+            hvd.allgather(z)
+            hvd.allreduce(x, op=hvd.Sum)
+
+        # what each worker passed locally: one row per local rank
+        nl = world // 2
+        x = np.ones((nl, 6), np.float32)
+        z = np.ones((nl, 3, 2), np.float32)
+        rep = hvd.check_program(step, (x, z), world_size=world)
+        assert rep.ok
+        predicted_hash = rep.sequence_hash(ps="global")
+        for rank, _, ev in results:
+            recorded_hash = an_events.sequence_hash(ev, ps="global")
+            assert recorded_hash == predicted_hash, (rank, ev)
+            assert [(e["op"], e["ps"], e["seq"], e["sig"]) for e in ev] \
+                == rep.predicted(rank=0)
+
+
+# ---------------------------------------------------------------------------
+# AST lint corpus: each rule class, positive + negative
+# ---------------------------------------------------------------------------
+
+_DECLARED = declared_knobs()
+
+
+def _lint(src, rel="horovod_tpu/ops/x.py"):
+    return lint_source(src, rel_path=rel, declared=_DECLARED)
+
+
+class TestLintRules:
+    def test_hvl001_lock_held_blocking_call(self):
+        bad = (
+            "def flush(self):\n"
+            "    with self._lock:\n"
+            "        self.client.allreduce(x)\n")
+        good = (
+            "def flush(self):\n"
+            "    with self._lock:\n"
+            "        pending = list(self._q)\n"
+            "    self.client.allreduce(pending)\n")
+        assert {"HVL001"} == _codes(_lint(bad))
+        assert not _lint(good)
+
+    def test_hvl001_dump_under_lock(self):
+        bad = ("with _dump_lock:\n"
+               "    dump('reason')\n")
+        good = ("with _dump_lock:\n"
+                "    n = seq\n"
+                "dump('reason')\n")
+        assert {"HVL001"} == _codes(_lint(bad))
+        assert not _lint(good)
+
+    def test_hvl002_undeclared_env_read(self):
+        bad = "import os\nv = os.environ.get('HOROVOD_NOT_A_KNOB')\n"
+        good = "import os\nv = os.environ.get('HOROVOD_FUSION_THRESHOLD')\n"
+        bootstrap = "import os\nv = os.environ.get('HOROVOD_KV_ADDR')\n"
+        helper = "v = _env_int('HOROVOD_ALSO_NOT_A_KNOB', 3)\n"
+        subscript = "import os\nv = os.environ['HOROVOD_SOME_KNOB']\n"
+        assert {"HVL002"} == _codes(_lint(bad))
+        assert not _lint(good)
+        assert not _lint(bootstrap)
+        assert {"HVL002"} == _codes(_lint(helper))
+        assert {"HVL002"} == _codes(_lint(subscript))
+        assert not _lint(
+            "import os\nv = os.environ['HOROVOD_KV_PORT']\n")
+
+    def test_hvl003_ambient_env_write(self):
+        bad = "import os\nos.environ['HOROVOD_FUSION_THRESHOLD'] = '1'\n"
+        assert {"HVL003"} == _codes(_lint(bad))
+        # launcher layer is allowed to export worker env
+        assert not _lint(bad, rel="horovod_tpu/runner/launch.py")
+        # non-knob env writes are out of scope
+        assert not _lint("import os\nos.environ['PATH'] = 'x'\n")
+
+    def test_hvl004_rank_conditional_collective(self):
+        bad = (
+            "def main():\n"
+            "    if hvd.rank() == 0:\n"
+            "        hvd.broadcast_object(state)\n")
+        good = (
+            "def main():\n"
+            "    if hvd.rank() == 0:\n"
+            "        print('saving checkpoint')\n"
+            "    hvd.broadcast_object(state)\n")
+        assert {"HVL004"} == _codes(_lint(bad, rel="examples/train.py"))
+        assert not _lint(good, rel="examples/train.py")
+        # library internals legitimately rank-branch (mirror dispatch)
+        assert "HVL004" not in _codes(
+            _lint(bad, rel="horovod_tpu/ops/collective_ops.py"))
+
+    def test_hvl005_non_daemon_thread(self):
+        bad = ("import threading\n"
+               "t = threading.Thread(target=loop)\n"
+               "t.start()\n")
+        good = ("import threading\n"
+                "t = threading.Thread(target=loop, daemon=True)\n"
+                "t.start()\n")
+        also_good = ("import threading\n"
+                     "t = threading.Thread(target=loop)\n"
+                     "t.daemon = True\n"
+                     "t.start()\n")
+        assert {"HVL005"} == _codes(_lint(bad))
+        assert not _lint(good)
+        assert not _lint(also_good)
+
+    def test_hvl006_lock_held_sleep(self):
+        bad = ("import time\n"
+               "with self._lock:\n"
+               "    time.sleep(0.1)\n")
+        good = ("import time\n"
+                "time.sleep(0.1)\n")
+        assert {"HVL006"} == _codes(_lint(bad))
+        assert not _lint(good)
+
+    def test_suppression_requires_reason(self):
+        suppressed = (
+            "with self._lock:\n"
+            "    dump('x')  # hvdlint: disable=HVL001 -- ring is private\n")
+        no_reason = (
+            "with self._lock:\n"
+            "    dump('x')  # hvdlint: disable=HVL001\n")
+        assert not _lint(suppressed)
+        codes = _codes(_lint(no_reason))
+        assert "HVL000" in codes and "HVL001" in codes
+
+    def test_suppression_on_with_line(self):
+        src = ("with self._lock:  # hvdlint: disable=HVL001 -- bounded\n"
+               "    dump('x')\n")
+        assert not _lint(src)
+
+    def test_skip_file_pragma(self):
+        src = ("# hvdlint: skip-file -- generated code\n"
+               "with self._lock:\n"
+               "    dump('x')\n")
+        assert not _lint(src)
+        bare = ("# hvdlint: skip-file\n"
+                "x = 1\n")
+        assert {"HVL000"} == _codes(_lint(bare))
+
+    def test_declared_knobs_parse_config(self):
+        assert "HOROVOD_FUSION_THRESHOLD" in _DECLARED
+        assert "HOROVOD_LOG_LEVEL" in _DECLARED       # ISSUE 9 satellite
+        assert "HVD_FLASH_ALLOW_PADDED" in _DECLARED
+        assert "HOROVOD_NOT_A_KNOB" not in _DECLARED
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 self-lint gate
+# ---------------------------------------------------------------------------
+
+
+class TestSelfLint:
+    def test_repo_tree_is_clean_and_fast(self):
+        """The repo's own scope (the scripts/lint.py default) lints clean
+        — undeclared knobs, lock-held calls etc. fail tier-1 fast — and
+        the full pass stays inside the 30 s budget."""
+        scope = [os.path.join(_REPO, p)
+                 for p in ("horovod_tpu", "examples", "scripts",
+                           "bench.py")
+                 if os.path.exists(os.path.join(_REPO, p))]
+        t0 = time.monotonic()
+        findings, n_files = lint_paths(scope, base=_REPO)
+        dt = time.monotonic() - t0
+        assert n_files > 100
+        assert not findings, "\n".join(f.render() for f in findings)
+        assert dt < 30.0, f"lint took {dt:.1f}s (budget 30s)"
+
+    def test_cli_entrypoint(self):
+        """`python -m horovod_tpu.analysis.lint <clean file>` exits 0 and
+        a bad file exits 1 (wired into CI shells)."""
+        import subprocess
+        import sys
+        import tempfile
+
+        with tempfile.TemporaryDirectory() as d:
+            bad = os.path.join(d, "bad.py")
+            with open(bad, "w") as f:
+                f.write("import os\n"
+                        "v = os.environ.get('HOROVOD_BOGUS_KNOB')\n")
+            good = os.path.join(d, "good.py")
+            with open(good, "w") as f:
+                f.write("x = 1\n")
+            env = dict(os.environ, PYTHONPATH=_REPO)
+            r0 = subprocess.run(
+                [sys.executable, "-m", "horovod_tpu.analysis.lint", good],
+                capture_output=True, env=env, cwd=_REPO)
+            r1 = subprocess.run(
+                [sys.executable, "-m", "horovod_tpu.analysis.lint", bad],
+                capture_output=True, env=env, cwd=_REPO)
+        assert r0.returncode == 0, r0.stderr
+        assert r1.returncode == 1
+        assert b"HVL002" in r1.stdout
