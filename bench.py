@@ -714,6 +714,85 @@ def _bench_image(hvd, name):
           round(per_chip / baseline, 3) if baseline else 0.0)
 
 
+def _bench_wire_sweep(hvd):
+    """Wire-dtype sweep: the SAME payload ladder through the eager
+    allreduce at fp32 / bf16-cast(fused) / int8 wire, reporting per-leg
+    dispatch time and the `wire_bytes_total` delta each leg put on the
+    wire — the provable off-chip evidence for the quantized tier
+    (docs/performance.md "Quantized wire tier"). Every (payload, dtype)
+    cell lands as a labeled `wire_sweep` record on the
+    HVD_BENCH_PROGRESS_FILE channel; the final BENCH record carries the
+    int8-vs-fp32 byte ratio on the largest rung."""
+    from horovod_tpu.metrics import instruments as ins
+    from horovod_tpu.ops import fusion, wire
+
+    n = hvd.size()
+    iters = int(os.environ.get("HVD_BENCH_ITERS", "10"))
+    # Per-rank element ladder (global payload = n * elems * 4 B).
+    ladder = [n * 1024, 128 * 1024, 1024 * 1024]
+    rng = np.random.default_rng(0)
+
+    def wire_bytes(dtype):
+        snap = ins.get_registry().snapshot()
+        for s in snap.get("wire_bytes_total", {}).get("series", ()):
+            if s["labels"].get("dtype") == dtype:
+                return s["value"]
+        return 0.0
+
+    rt = fusion.get_runtime()
+    results = {}
+    ratio_largest = 0.0
+    for elems in ladder:
+        x = jnp.asarray(rng.standard_normal((n, elems)), jnp.float32)
+        payload_mb = x.nbytes / 2**20
+        for leg in ("float32", "bfloat16", "int8"):
+            # float32/int8 ride the eager sync path (registry-steered);
+            # bfloat16 is a fused-bucket cast, so that leg rides the
+            # async fusion runtime where the cast applies.
+            fused = leg == "bfloat16"
+            label = leg
+            hvd.set_wire_dtype("" if leg == "float32" else leg)
+            prev_rt_wire = rt.wire_dtype
+            if fused:
+                rt.wire_dtype = jnp.bfloat16
+
+            def dispatch():
+                if fused:
+                    return hvd.allreduce_async(
+                        x, op=hvd.Sum, name="wire_sweep").synchronize()
+                return hvd.allreduce(x, op=hvd.Sum)
+
+            try:
+                jax.block_until_ready(dispatch())      # warm/compile
+                b0 = wire_bytes(label)
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = dispatch()
+                jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / iters
+                delta = wire_bytes(label) - b0
+            finally:
+                rt.wire_dtype = prev_rt_wire
+                hvd.set_wire_dtype("")
+            rec = {"payload_mb": round(payload_mb, 2), "wire": leg,
+                   "us_per_op": round(dt * 1e6, 1),
+                   "wire_bytes_per_op": delta / max(iters, 1),
+                   "path": "fused" if fused else "eager"}
+            results[(elems, leg)] = rec
+            _progress_record("wire_sweep", **rec)
+            _mark(f"wire_sweep {payload_mb:.1f}MB {leg}: "
+                  f"{dt * 1e6:.0f}us/op, "
+                  f"{delta / max(iters, 1) / 2**20:.2f} MB on wire")
+        fp32_b = results[(elems, "float32")]["wire_bytes_per_op"]
+        int8_b = results[(elems, "int8")]["wire_bytes_per_op"]
+        if fp32_b:
+            ratio_largest = int8_b / fp32_b
+    wire.reset_error_feedback()
+    _emit("wire_sweep_int8_bytes_ratio", round(ratio_largest, 4),
+          "int8/fp32 bytes-on-wire ratio (largest rung; <0.3 = the "
+          "quantized tier's contract)", 0.0)
+
+
 def _compression():
     """HVD_BENCH_COMPRESSION=none|bf16|fp16|int8|powersgd[:rank] — wire
     compression A/B for the training benches. On the single bench chip
@@ -815,6 +894,8 @@ _EXTRA_MODELS = {
            "tokens/sec/chip"),
     "spec": (_bench_spec, "gpt2_speculative_tokens_per_sec_per_chip",
              "tokens/sec/chip"),
+    "wire_sweep": (_bench_wire_sweep, "wire_sweep_int8_bytes_ratio",
+                   "int8/fp32 bytes-on-wire ratio"),
 }
 
 

@@ -18,13 +18,20 @@ Program-analysis codes (``HVP1xx``):
   threshold fill ratio (tiny sync collectives that would fuse, or tensors
   that overflow every bucket).
 - ``HVP106`` wire_dtype — advisory: fp32 on the wire inside jit while a
-  compressed wire dtype is configured (the cast covers eager/fused only).
+  compressed wire dtype is configured. Suppressed when the jaxpr shows
+  the block-scaled quantized exchange (int8/float8 collectives from
+  ops/wire.py) — that program is already quantizing in jit.
 - ``HVP107`` buffer_reuse — advisory: one input buffer dispatched to more
   than one collective (a hazard when eager donation is armed, a missed
   donation opportunity otherwise).
 - ``HVP108`` cond_collective — advisory: collective under a ``lax.cond``
   branch (subset participation deadlocks the rendezvous if the predicate
   varies across the mesh).
+- ``HVP109`` stale_residual — advisory: wire error feedback is configured
+  but the program runs in-jit quantized exchanges outside the runtime
+  residual store — residuals threaded through optimizer state must be
+  zeroed on elastic reset (a resized mesh must not replay stale
+  residuals), and residual-less in-jit exchanges get no feedback at all.
 
 Lint codes (``HVL0xx``) are documented in :mod:`horovod_tpu.analysis.lint`.
 """

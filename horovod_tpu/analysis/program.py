@@ -533,7 +533,15 @@ def _advisory_findings(events, rank, config, reuse_info):
                 rank=rank, op=e.op, ps=e.ps, seq=e.seq, sig=e.sig))
             break
     wire = getattr(config, "wire_dtype", "")
-    if wire:
+    # The block-scaled quantized exchange shows up in the jaxpr as 1-byte
+    # collectives (int8 / float8 all_to_all + all_gather, ops/wire.py):
+    # its presence means the program IS quantizing in jit — the small
+    # fp32 collectives alongside it are the exchange's own block scales,
+    # not an unquantized wire.
+    quant_jit = [e for e in events if e.origin == "jit"
+                 and any(d == "int8" or str(d).startswith("float8")
+                         for d in e.dtypes)]
+    if wire and not quant_jit:
         fp32_jit = [e for e in events if e.origin == "jit"
                     and any("float32" in d for d in e.dtypes)]
         if fp32_jit:
@@ -542,10 +550,33 @@ def _advisory_findings(events, rank, config, reuse_info):
                 code="HVP106", severity=INFO,
                 message=(f"wire_dtype={wire} is configured but "
                          f"{len(fp32_jit)} in-jit collective(s) move "
-                         "float32 on the wire — the wire cast covers "
-                         "only eager/fused dispatches; use "
-                         "Compression inside jit"),
+                         "float32 on the wire — the wire tier covers "
+                         "eager/fused dispatches; inside jit use "
+                         "Compression.int8 on the optimizer or "
+                         "strategies.allreduce_quantized"),
                 rank=rank, op=e.op, ps=e.ps))
+    if quant_jit and getattr(config, "wire_error_feedback", False) \
+            and wire in ("int8", "fp8"):
+        # The eager/fused paths keep their residuals in the runtime store,
+        # which clear_program_caches zeroes on every elastic reset. An
+        # IN-JIT quantized exchange is outside that store: either its
+        # error feedback is silently inactive, or the residual lives in
+        # user/optimizer state — where a resized mesh replays stale
+        # residuals unless the caller zeroes them on reset.
+        e = quant_jit[0]
+        findings.append(Finding(
+            code="HVP109", severity=INFO,
+            message=(f"error feedback is configured "
+                     f"(wire_dtype={wire}, wire_error_feedback=1) but "
+                     f"{len(quant_jit)} in-jit quantized exchange(s) are "
+                     "outside the runtime residual store — if the "
+                     "optimizer threads residuals "
+                     "(strategies.allreduce_quantized residual=...), it "
+                     "must zero them on elastic reset "
+                     "(clear_program_caches cannot reach jit state); "
+                     "otherwise error feedback is silently inactive on "
+                     "this path"),
+            rank=rank, op=e.op, ps=e.ps))
     if reused:
         first, second = reused[0]
         ev = events[first] if first < len(events) else None

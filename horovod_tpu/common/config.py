@@ -115,8 +115,19 @@ class Config:
     coordinator_port: int = 0
 
     # --- TPU-specific additions ---
-    # Reduction dtype on the wire for fused gradient buckets ("" = keep dtype).
+    # Wire dtype for collective payloads ("" = keep dtype): float16/bfloat16
+    # cast the fused buckets; int8/fp8 route eligible allreduces — eager,
+    # fused AND the in-jit entry points — through the block-scaled
+    # quantized exchange (ops/wire.py; fp8 falls back to bfloat16 when the
+    # dtype is missing). Overridable per process set at runtime via
+    # hvd.set_wire_dtype (the autotuner steers the global set through the
+    # same registry).
     wire_dtype: str = ""
+    # Error feedback for the quantized wire: keep each bucket's fp32
+    # quantization error and add it back before the next quantize
+    # (eager + fused paths; in-jit callers thread residuals themselves).
+    # Residuals are zeroed by clear_program_caches / elastic reset.
+    wire_error_feedback: bool = True
     # Donate fused buffers to XLA (buffer reuse).
     donate_buffers: bool = True
     # Donate SYNC eager-collective inputs that are already correctly-sharded
@@ -276,12 +287,14 @@ class Config:
                            "bf16": "bfloat16"}.get(self.wire_dtype,
                                                    self.wire_dtype)
         if self.wire_dtype and self.wire_dtype not in ("float16",
-                                                       "bfloat16", "int8"):
+                                                       "bfloat16", "int8",
+                                                       "fp8"):
             raise ValueError(
                 f"wire_dtype={self.wire_dtype!r}: float16/bfloat16 (cast) "
-                "or int8 (quantized exchange) are the wire options; the "
-                "jit-path analog of int8 is Compression.int8 on the "
-                "optimizer")
+                "or int8/fp8 (block-scaled quantized exchange) are the "
+                "wire options; inside jit the same tier is reachable via "
+                "Compression.int8 on the optimizer or "
+                "strategies.allreduce_quantized")
 
     @classmethod
     def from_env(cls):
@@ -341,6 +354,8 @@ class Config:
                                             c.coordinator_addr)
         c.coordinator_port = _env_int("HOROVOD_COORDINATOR_PORT", c.coordinator_port)
         c.wire_dtype = os.environ.get("HOROVOD_WIRE_DTYPE", c.wire_dtype)
+        c.wire_error_feedback = _env_bool("HOROVOD_WIRE_ERROR_FEEDBACK",
+                                          c.wire_error_feedback)
         c.__post_init__()  # re-normalize after the env override
         c.donate_buffers = _env_bool("HOROVOD_DONATE_BUFFERS", c.donate_buffers)
         # Eager-path donation only on an EXPLICIT opt-in (see field docs).

@@ -35,6 +35,11 @@ Catalogue (names shown without the ``HOROVOD_METRICS_PREFIX``, default
   step profiler's marker-to-marker windows (histogram)
 - ``step_profiler_events_total{kind}``              watchdog findings:
   straggler|regression (counter; horovod_tpu/profile)
+- ``wire_bytes_total{dtype}``                       estimated bytes on the
+  wire per collective at the effective wire dtype (counter; ops/wire.py
+  accounting — allreduces count both RS+AG legs)
+- ``wire_compression_events_total{path,dtype}``     dispatches that
+  actually compressed the wire (path=eager|fused|jit; counter)
 """
 
 import os
@@ -180,6 +185,21 @@ STEP_PROFILER_EVENTS = REGISTRY.counter(
     "Online watchdog findings from the step profiler "
     "(kind=straggler|regression; horovod_tpu/profile/watchdog.py).",
     ("kind",))
+WIRE_BYTES = REGISTRY.counter(
+    "wire_bytes_total",
+    "Estimated bytes-on-wire per collective at the effective wire dtype "
+    "(ops/wire.py accounting: allreduce counts both internal legs — "
+    "reduce-scatter + all-gather — at the wire width; quantized wires "
+    "count both 1-byte legs plus fp32 block scales and padding). The "
+    "int8-vs-float32 ratio here is the provable off-chip savings.",
+    ("dtype",))
+WIRE_COMPRESSION_EVENTS = REGISTRY.counter(
+    "wire_compression_events_total",
+    "Collective dispatches whose wire was actually compressed "
+    "(path=eager|fused|jit, dtype=int8|fp8|float16|bfloat16). jit-path "
+    "events are recorded at trace time: once per compiled program, not "
+    "per execution.",
+    ("path", "dtype"))
 TELEMETRY_RPCS = REGISTRY.counter(
     "telemetry_rpcs_total",
     "Telemetry-plane KV RPCs by phase (horovod_tpu/telemetry): the "
@@ -243,6 +263,18 @@ def record_fusion_kv(sets=0, gets=0, payload_bytes=0):
         CONTROL_PLANE_RPCS.labels("coord", "get").inc(gets)
     if payload_bytes:
         CONTROL_PLANE_PAYLOAD.labels("coord").inc(payload_bytes)
+
+
+def record_wire(path, dtype, nbytes, compressed=False):
+    """Wire accounting for one collective dispatch: bytes at the effective
+    wire dtype, plus a compression event when the wire was actually
+    narrowed (quantized exchange or 16-bit cast)."""
+    if not _enabled or not dtype:
+        return
+    if nbytes:
+        WIRE_BYTES.labels(str(dtype)).inc(float(nbytes))
+    if compressed:
+        WIRE_COMPRESSION_EVENTS.labels(path, str(dtype)).inc()
 
 
 def record_plan_cache(event):

@@ -38,6 +38,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.flight import recorder as _flight
+from horovod_tpu.ops import wire as _wire
 from horovod_tpu.profile import ledger as _profile
 from horovod_tpu.common.exceptions import TensorShapeMismatchError
 from horovod_tpu.common.process_sets import global_process_set
@@ -200,7 +201,7 @@ def _translate_dispatch_error(name, op_label, e):
 
 @contextlib.contextmanager
 def _timeline_op(name, op_kind, tensors=(), process_set=None,
-                 op_label=None, ps_label=None):
+                 op_label=None, ps_label=None, wire=None):
     """Timeline span + metrics + failure translation around one eager
     collective.
 
@@ -211,6 +212,12 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None,
     never had (its observability stops at the timeline trace).
     ``op_label``/``ps_label``: precomputed label strings (the dispatch-plan
     fast path passes them so nothing is re-formatted per call).
+
+    ``wire``: optional ``(path, dtype_label, wire_nbytes, compressed)``
+    override for the wire-byte accounting (the fused flush and the
+    quantized eager path pass their exact on-wire estimate); without it
+    the payload dtype/bytes are derived here (allreduce counts both
+    internal RS+AG legs).
 
     A collective that dies at runtime (peer process gone, transport torn
     down mid-op) must surface as :class:`HorovodInternalError` so the
@@ -243,6 +250,12 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None,
         t0 = time.perf_counter()
     if metrics_on:
         hvd_metrics.record_collective(op_label, nbytes, ps_label)
+        if wire is not None:
+            hvd_metrics.record_wire(wire[0], wire[1], wire[2], wire[3])
+        elif tensors:
+            hvd_metrics.record_wire(
+                "eager", str(_dtype_of(tensors[0])),
+                nbytes * (2 if op_kind == "ALLREDUCE" else 1))
     if flight_on:
         # SPMD contract: every process dispatches the same collectives in
         # the same order, so the per-process-set seq assigned here lines
@@ -375,6 +388,45 @@ def _allreduce_program(mesh, n, op, prescale, postscale, shapes, dtypes,
                    if donate else ())
 
 
+@functools.lru_cache(maxsize=1024)
+def _quantized_allreduce_program(mesh, n, op, prescale, postscale, shapes,
+                                 dtypes, wire_name, ef):
+    """Eager allreduce over the block-scaled quantized exchange
+    (ops/wire.py): the group's tensors are concatenated into ONE flat
+    fp32 buffer (minimizing the exchange's n×BLOCK padding, exactly like
+    the fused path), exchanged at 1 byte/element with per-block scales,
+    then split/cast back per tensor. With ``ef`` the program additionally
+    takes the bucket's fp32 residual — global ``(n, L)`` sharded rank-major
+    — and returns the new residual as its last output (error feedback:
+    residual added after prescale, before quantization)."""
+    sizes = [int(np.prod(s[1:])) for s in shapes]
+    flat_len = sum(sizes)
+
+    def body(*args):
+        xs = args[:len(shapes)]
+        flats = [x.reshape(-1).astype(jnp.float32) for x in xs]
+        buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
+        residual = args[-1].reshape(-1) if ef else None
+        red, new_res = _wire.block_scaled_allreduce(
+            buf, residual=residual, axis_name=HVD_AXIS, wire=wire_name,
+            average=(op == ReduceOp.AVERAGE), prescale_factor=prescale,
+            postscale_factor=postscale)
+        outs, off = [], 0
+        for x, sz in zip(xs, sizes):
+            piece = lax.slice_in_dim(red, off, off + sz).astype(x.dtype)
+            outs.append(piece.reshape(x.shape))
+            off += sz
+        if ef:
+            outs.append(new_res.reshape(1, flat_len))
+        return tuple(outs)
+
+    n_args = len(shapes) + (1 if ef else 0)
+    f = jax.shard_map(body, mesh=mesh,
+                      in_specs=tuple(P(HVD_AXIS) for _ in range(n_args)),
+                      out_specs=tuple(P(HVD_AXIS) for _ in range(n_args)))
+    return jax.jit(f)
+
+
 @functools.lru_cache(maxsize=4096)
 def _allgather_program(mesh, n, shapes, dtypes, active_mask=None,
                        hierarchical=False):
@@ -504,11 +556,15 @@ def clear_program_caches():
     elastic membership change (basics.teardown_distributed); the analog of
     the reference invalidating its response cache on world reconfig
     (response_cache.h:45, elastic abort path)."""
-    for prog in (_local_mesh_info, _allreduce_program, _allgather_program,
+    for prog in (_local_mesh_info, _allreduce_program,
+                 _quantized_allreduce_program, _allgather_program,
                  _broadcast_program, _reducescatter_program,
                  _alltoall_program, _barrier_program,
                  _alltoall_pack_index):
         prog.cache_clear()
+    # Error-feedback residuals are device arrays of the torn-down backend
+    # (and sized for the old world): a resized mesh must start clean.
+    _wire.reset_error_feedback()
     # Dispatch plans capture compiled programs + NamedShardings of the
     # torn-down backend; a stale hit after an elastic resize would dispatch
     # into a dead client.
@@ -722,8 +778,8 @@ class _DispatchPlan:
 
     __slots__ = ("kind", "op_kind", "op_label", "default_name", "program",
                  "donate_program", "mesh", "sharding", "ps", "ps_label",
-                 "multi", "global_shapes", "nbytes", "sig",
-                 "_localize_order", "_stage_memo")
+                 "multi", "global_shapes", "nbytes", "sig", "wire_label",
+                 "wire_nbytes", "_localize_order", "_stage_memo")
 
     _STAGE_MEMO_CAP = 16
 
@@ -748,6 +804,10 @@ class _DispatchPlan:
         # Flight-recorder signature: a plan constant (every key-matched
         # call shares shapes/dtypes), so the hot path never re-hashes.
         self.sig = _flight.signature(staged)
+        # Wire accounting constants (first tensor's dtype stands for the
+        # group; allreduce counts both internal RS+AG legs).
+        self.wire_label = str(staged[0].dtype) if staged else None
+        self.wire_nbytes = self.nbytes * (2 if op_kind == "ALLREDUCE" else 1)
         self._localize_order = None
         # id(src) -> (weakref(src), staged): re-sharding the SAME
         # immutable jax.Array every step (re-reducing a pinned buffer)
@@ -871,6 +931,8 @@ class _DispatchPlan:
         if metrics_on:
             hvd_metrics.record_collective(self.op_label, self.nbytes,
                                           self.ps_label)
+            hvd_metrics.record_wire("eager", self.wire_label,
+                                    self.wire_nbytes)
             t0 = time.perf_counter()
         if profile_on:
             t0p = time.perf_counter()
@@ -920,6 +982,133 @@ class _DispatchPlan:
         return res
 
 
+class _WireDispatchPlan(_DispatchPlan):
+    """Dispatch plan for eager allreduces riding the quantized wire tier
+    (ops/wire.py). Beyond the base plan it owns the bucket's error-feedback
+    residual — fetched from the wire store before the call, stored after —
+    and records the exchange's exact on-wire byte estimate. Keyed (like
+    every plan) on the wire dtype, so a per-process-set wire flip routes
+    the next call through a fresh plan with a fresh residual."""
+
+    __slots__ = ("wire_name", "ef", "ef_key", "flat_len")
+
+    def __init__(self, program, mesh, ps, staged, wire_name, ef, ef_key):
+        super().__init__("allreduce", "ALLREDUCE", program, mesh, ps,
+                         staged, "grouped_allreduce")
+        self.wire_name = wire_name
+        self.ef = ef
+        self.ef_key = ef_key
+        self.flat_len = sum(int(np.prod(s[1:])) for s in self.global_shapes)
+        n = self.global_shapes[0][0] if self.global_shapes else 1
+        self.wire_label = wire_name
+        self.wire_nbytes = _wire.exchange_wire_bytes(self.flat_len, n)
+
+    def _zero_residual(self):
+        return _wire.zero_residual(self.mesh, self.sharding,
+                                   self.global_shapes[0][0], self.flat_len)
+
+    def dispatch(self, staged, name=None, prog=None, t_api=None):
+        # Instrumentation inlined like the base fast path (no
+        # contextmanager frame, plan-constant labels/bytes): the wire
+        # tier's HOST cost over the fp32 plan is just the residual store
+        # round-trip — guarded at 2x by test_perf_guards.
+        from horovod_tpu.metrics import instruments as hvd_metrics
+        profile_on = _profile.armed
+        if profile_on and t_api is None:
+            t_api = time.perf_counter()
+        if _chaos.armed:
+            _chaos.fire("collective.dispatch")
+        args = list(staged)
+        ef = self.ef
+        if ef:
+            res = _wire.ef_get(self.ef_key)
+            if res is None:
+                res = self._zero_residual()
+            args.append(res)
+        metrics_on = hvd_metrics.enabled()
+        flight_on = _flight.armed
+        if flight_on:
+            fl_seq = _flight.record_dispatch(self.op_label, self.ps_label,
+                                             self.nbytes, self.sig, name)
+            t0f = time.perf_counter()
+        if metrics_on:
+            hvd_metrics.record_collective(self.op_label, self.nbytes,
+                                          self.ps_label)
+            hvd_metrics.record_wire("eager", self.wire_label,
+                                    self.wire_nbytes, True)
+            t0 = time.perf_counter()
+        if profile_on:
+            t0p = time.perf_counter()
+        tl = basics.timeline()
+        try:
+            if tl is not None:
+                with jax.profiler.TraceAnnotation(
+                        f"hvd::{self.op_kind}::{name or self.default_name}"):
+                    with tl.op_span(name or self.default_name,
+                                    self.op_kind):
+                        outs = self.program(*args)
+            else:
+                outs = self.program(*args)
+            if ef:
+                # The residual stays a DEVICE-RESIDENT global array
+                # between steps (never localized): it feeds straight
+                # back into the next key-matched dispatch.
+                _wire.ef_put(self.ef_key, outs[-1])
+                outs = outs[:-1]
+            if metrics_on:
+                hvd_metrics.record_collective_latency(
+                    self.op_label, time.perf_counter() - t0)
+            if flight_on:
+                _flight.record_complete(self.op_label, self.ps_label,
+                                        fl_seq, time.perf_counter() - t0f)
+        except (ValueError, RuntimeError) as e:
+            # Never resume error feedback over a failed exchange: the
+            # residual's pairing with the result stream is broken (and
+            # after an elastic recovery it would be a dead-backend array).
+            if ef:
+                _wire.ef_pop(self.ef_key)
+            _translate_dispatch_error(name or self.default_name,
+                                      self.op_label, e)
+        except Exception:
+            if ef:
+                _wire.ef_pop(self.ef_key)
+            raise
+        outs = self._localize(list(outs))
+        if profile_on:
+            _profile.record_dispatch(
+                self.op_label, time.perf_counter() - t0p,
+                t0p - t_api, self.nbytes)
+        return outs
+
+
+def _eager_wire_for(ps, op, sig, wire_req):
+    """Effective QUANTIZED wire dtype for one eager allreduce — ``(label,
+    error_feedback)`` with label None for the exact full-precision path.
+    The decision honors the one-shot compressor request, then the
+    per-process-set registry (autotuner / hvd.set_wire_dtype), then the
+    config knob; it quantizes only float Sum/Average groups big enough
+    that the exchange's n×BLOCK padding doesn't inflate the wire (below
+    one block per destination rank the exact psum moves fewer bytes)."""
+    st = basics._state
+    if st is None or sig is None:
+        return None, False
+    cfg = st.config
+    req = wire_req or _wire.wire_dtype_for(_ps_label(ps), cfg.wire_dtype)
+    label = _wire.quantized_label(req)
+    if label is None:
+        return None, False
+    if ReduceOp(op) not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        return None, False
+    total = 0
+    for shape, dt in sig:
+        if not _is_float(dt):
+            return None, False
+        total += int(np.prod(shape[1:])) if len(shape) >= 1 else 0
+    if total < ps.size() * _wire.BLOCK:
+        return None, False
+    return label, bool(cfg.wire_error_feedback)
+
+
 # ----------------------------------------------------------------------------
 # Public eager API
 # ----------------------------------------------------------------------------
@@ -942,12 +1131,18 @@ def grouped_allreduce(tensors, op=Average, prescale_factor=1.0,
                       postscale_factor=1.0, process_set=None, name=None):
     """One fused dispatch for a group of tensors — completes atomically like
     the reference's grouped ops (reference: EnqueueTensorAllreduces
-    operations.cc:1480, group_table.h:39)."""
+    operations.cc:1480, group_table.h:39). When the effective wire dtype
+    for this process set is quantized (int8/fp8 — config knob, per-set
+    registry, or a one-shot Compression.int8 request), eligible float
+    Sum/Average groups ride the block-scaled exchange with error feedback
+    instead of the exact psum (ops/wire.py)."""
     mesh, ps = _mesh_for(process_set)
     sig = _plan_sig(tensors)
+    wire_name, wire_ef = _eager_wire_for(ps, op, sig,
+                                         _wire.consume_wire_request())
     if sig is not None:
         key = ("allreduce", mesh, ps, int(op), float(prescale_factor),
-               float(postscale_factor), sig)
+               float(postscale_factor), sig, wire_name, wire_ef)
         plan = _plan_lookup(key, ps)
         if plan is not None:
             return plan.run(tensors, name)
@@ -962,10 +1157,31 @@ def grouped_allreduce(tensors, op=Average, prescale_factor=1.0,
         "slices": _slice_desc(tensors, mesh, n, "allreduce")})
     tensors = _prepare(tensors, mesh, n, "allreduce")
     shapes, dtypes = _signature(tensors)
+    st = basics._get_state()
+    if wire_name is not None and active_mask is None:
+        if _plan_eligible(st, active_mask):
+            prog = _quantized_allreduce_program(
+                mesh, n, ReduceOp(op), float(prescale_factor),
+                float(postscale_factor), shapes, dtypes, wire_name, wire_ef)
+            plan = _register_plan(key, _WireDispatchPlan(
+                prog, mesh, ps, tensors, wire_name, wire_ef, key))
+            return plan.dispatch(tensors, name)
+        # Non-plannable control path (debug order check, armed join mode):
+        # quantize without error feedback — there is no stable per-bucket
+        # residual identity to key the store on.
+        prog = _quantized_allreduce_program(
+            mesh, n, ReduceOp(op), float(prescale_factor),
+            float(postscale_factor), shapes, dtypes, wire_name, False)
+        flat_len = sum(int(np.prod(s[1:])) for s in shapes)
+        with _timeline_op(name or "grouped_allreduce", "ALLREDUCE", tensors,
+                          process_set=ps,
+                          wire=("eager", wire_name,
+                                _wire.exchange_wire_bytes(flat_len, n),
+                                True)):
+            return _localize(list(prog(*tensors)), mesh)
     prog = _allreduce_program(mesh, n, ReduceOp(op), float(prescale_factor),
                               float(postscale_factor), shapes, dtypes,
                               active_mask)
-    st = basics._get_state()
     if sig is not None and _plan_eligible(st, active_mask):
         donate_prog = _allreduce_program(
             mesh, n, ReduceOp(op), float(prescale_factor),
@@ -1782,8 +1998,21 @@ def allreduce_async(tensor, op=Average, prescale_factor=1.0,
     if op == Average and not _is_float(_dtype_of(t)):
         raise ValueError("Average is not supported for integer tensors; use "
                          "hvd.Sum (matches reference torch/mpi_ops.py checks).")
-    return get_runtime().enqueue_allreduce(t, op, prescale_factor,
-                                           postscale_factor, name)
+    rt = get_runtime()
+    req = _wire.consume_wire_request()
+    if req and _wire.quantized_label(req) is not None and \
+            _wire.quantized_label(getattr(rt, "wire_dtype", None)) is None:
+        # Compression.int8 on the async path while the fusion runtime's own
+        # wire is full precision: honor the request with a sync quantized
+        # dispatch (correctness over overlap — the runtime quantizes whole
+        # buckets only when its own wire knob is quantized, and a per-call
+        # request cannot retroactively re-key an open bucket).
+        _wire.request_wire_once(req)
+        return Handle(allreduce(t, op=op, prescale_factor=prescale_factor,
+                                postscale_factor=postscale_factor,
+                                name=name), name)
+    return rt.enqueue_allreduce(t, op, prescale_factor,
+                                postscale_factor, name)
 
 
 @_interceptable("allreduce_async")
@@ -1810,7 +2039,19 @@ def grouped_allreduce_async(tensors, op=Average, prescale_factor=1.0,
             raise ValueError(
                 "Average is not supported for integer tensors; use hvd.Sum "
                 "(matches reference torch/mpi_ops.py checks).")
-    return get_runtime().enqueue_grouped_allreduce(
+    rt = get_runtime()
+    req = _wire.consume_wire_request()
+    if req and _wire.quantized_label(req) is not None and \
+            _wire.quantized_label(getattr(rt, "wire_dtype", None)) is None:
+        # Same one-shot discipline as allreduce_async: the request must be
+        # consumed HERE (not leak to the next unrelated eager dispatch),
+        # and when the fusion runtime's own wire is full precision it is
+        # honored with a sync quantized grouped dispatch.
+        _wire.request_wire_once(req)
+        return Handle(grouped_allreduce(
+            ts, op=op, prescale_factor=prescale_factor,
+            postscale_factor=postscale_factor, name=name), name)
+    return rt.enqueue_grouped_allreduce(
         ts, op, prescale_factor, postscale_factor, name)
 
 

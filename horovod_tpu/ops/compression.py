@@ -65,33 +65,28 @@ class Int8Compressor(Compressor):
 
     Unlike the cast compressors, int8 cannot ride an ordinary psum (summing
     n int8s overflows and per-rank scales differ), so compress/decompress
-    are identity markers: the fused-allreduce path detects this compressor
-    and routes the bucket through the two-phase quantized exchange in
-    :func:`horovod_tpu.parallel.strategies.allreduce_int8` (int8
-    reduce-scatter + int8 all-gather, fp32 accumulation; EQuARX-style,
-    arXiv:2506.17615). Lossy: each wire leg adds error ≤ max|x|/254.
+    are routing markers into the quantized wire tier
+    (:mod:`horovod_tpu.ops.wire`): the quantization itself happens INSIDE
+    the collective, fused into its reduce-scatter→all-gather phases
+    (EQuARX-style, arXiv:2506.17615 — int8 both legs, fp32 accumulation,
+    per-block scales, error feedback on the eager/fused paths). All three
+    dispatch paths honor it: the fused jit tree (DistributedOptimizer /
+    ``fused_allreduce_tree``) detects the compressor and rides
+    ``strategies.scaled_allreduce_int8``; ``compress()`` arms a one-shot
+    wire request that the next EAGER allreduce dispatch consumes (the
+    compress→allreduce→decompress frontend pattern); the eager fusion
+    runtime quantizes whole buckets under ``HOROVOD_WIRE_DTYPE=int8``.
+    Lossy: each wire leg adds error ≤ its block's max/254, compensated
+    next round by the error-feedback residual where the path keeps one.
     Combinations the exchange can't express (explicit process sets,
-    non-Sum/Average ops) fall back to the uncompressed collective.
+    non-Sum/Average ops, sub-block payloads) fall back to the exact
+    collective.
     """
 
-    _warned = False
-
-    @classmethod
-    def compress(cls, tensor):
-        # Reaching compress() means a code path that does NOT special-case
-        # this compressor is about to run an ordinary full-precision
-        # collective (the fused tree path routes around compress()).
-        # Warn loudly instead of silently dropping the selected feature.
-        if not cls._warned:
-            import warnings
-            warnings.warn(
-                "Compression.int8 only takes effect in the fused jit "
-                "allreduce path (DistributedOptimizer / "
-                "fused_allreduce_tree with op=Sum/Average and no process "
-                "set); this collective runs UNCOMPRESSED. For the EAGER "
-                "fusion runtime use HOROVOD_WIRE_DTYPE=int8 instead.",
-                stacklevel=3)
-            Int8Compressor._warned = True
+    @staticmethod
+    def compress(tensor):
+        from horovod_tpu.ops import wire
+        wire.request_wire_once("int8")
         return tensor, None
 
     @staticmethod

@@ -35,9 +35,34 @@ from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.common.topology import HVD_AXIS
 from horovod_tpu.flight import recorder as _flight
+from horovod_tpu.ops import wire as _wire
 from horovod_tpu.profile import ledger as _profile
 from horovod_tpu.ops.collective_ops import (ReduceOp, _localize, _prepare,
                                             _reduce_shard)
+
+
+def _bucket_quant(wire_dtype, strategy, masked, op, sizes, dtypes, n):
+    """Quantized-exchange eligibility for ONE fusion bucket, computed from
+    STATIC bucket facts so the runtime (which must decide whether to pass
+    a residual) and the compiled program (which must declare the residual
+    argument) can never disagree. Returns the quantized wire label
+    (``int8``/``fp8``) or None: only flat-strategy float Sum/Average
+    buckets without a join mask, big enough that the exchange's n×BLOCK
+    padding doesn't inflate the wire. The 2-level strategies keep their
+    own wire schemes and tiny buckets keep the exact psum."""
+    label = _wire.quantized_label(wire_dtype)
+    if label is None or strategy != "flat" or masked \
+            or op not in (ReduceOp.SUM, ReduceOp.AVERAGE):
+        return None
+    if sum(sizes) < n * _wire.BLOCK:
+        return None
+    # jnp.issubdtype, NOT np.issubdtype: ml_dtypes bfloat16 is not
+    # np.floating, and bf16 buckets are the COMMON quantization target
+    # (the bucket key keeps quantized buckets in their original float
+    # dtype precisely so bf16 ones can ride the exchange).
+    if not all(jnp.issubdtype(jnp.dtype(d), jnp.floating) for d in dtypes):
+        return None
+    return label
 
 
 class FusedHandle:
@@ -92,7 +117,7 @@ class FusedHandle:
 @functools.lru_cache(maxsize=2048)
 def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
                    wire_dtype, active_mask=None, strategy="flat",
-                   donate=()):
+                   donate=(), ef=False):
     """One flat-buffer reduction for a whole bucket. ``active_mask`` carries
     join state so async collectives honor the same joined-rank exclusion as
     the sync path (reference: joined_size accounting). ``strategy``:
@@ -130,18 +155,23 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
         from horovod_tpu.ops.in_jit import mark_varying
         return mark_varying(mark_varying(out, CROSS_AXIS), LOCAL_AXIS)
 
-    # "int8" wire: the fused bucket rides the two-phase quantized exchange
-    # (EQuARX-style, parallel/strategies.allreduce_int8 — ~2 B/element vs
-    # 4) instead of a cast+psum. Only Sum/Average have exchange semantics,
-    # join masks can't ride it, tiny buckets would INFLATE (the exchange
-    # pads to n*1024 blocks), and the 2-level strategies keep their own
-    # wire schemes — all those cases quietly keep the exact psum.
-    int8_wire = (wire_dtype is not None
-                 and jnp.dtype(wire_dtype) == jnp.int8)
-    int8_ok = (int8_wire and strategy == "flat" and active is None
-               and op in (ReduceOp.SUM, ReduceOp.AVERAGE))
+    # Quantized wire (int8/fp8): the fused bucket rides the two-phase
+    # block-scaled exchange (EQuARX-style, ops/wire.py — ~2 B/element vs
+    # ~8 for an fp32 psum's internal RS+AG) instead of a cast+psum, with
+    # an optional per-bucket error-feedback residual (``ef``: the program
+    # takes the bucket's fp32 residual as its last input and returns the
+    # new one as its last output — the runtime owns the store). The
+    # eligibility verdict is STATIC (_bucket_quant) so runtime and
+    # program agree on the argument list; ineligible combinations
+    # quietly keep the exact psum (or the 16-bit cast wire).
+    quant_label = _bucket_quant(wire_dtype, strategy,
+                                active is not None, op, sizes, dtypes, n)
+    use_ef = bool(ef) and quant_label is not None
+    cast_wire = (wire_dtype is not None and quant_label is None
+                 and not _wire.is_quantized(wire_dtype))
+    total = sum(sizes)
 
-    def body(*xs):
+    def body(*args):
         # xs: local slices (1, ...). Flatten each, concat per the bucket
         # layout (the MemcpyInFusionBuffer analog, fused by XLA into the
         # collective's input), one psum, then split back out. Buckets are
@@ -149,6 +179,7 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
         # Adasum must normalize per-tensor (its coefficients are norms of the
         # individual gradients, reference: adasum.h:103+), so its tensors are
         # reduced individually inside the single dispatch instead of fused.
+        xs = args[:len(shapes)]
         if op == ReduceOp.ADASUM:
             return tuple(
                 _reduce_shard(x, op, n, prescale, postscale, HVD_AXIS, active)
@@ -156,20 +187,19 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
         flats = []
         for x in xs:
             f = x.reshape(-1)
-            if not int8_wire and wire_dtype is not None \
-                    and jnp.issubdtype(f.dtype, jnp.floating):
+            if cast_wire and jnp.issubdtype(f.dtype, jnp.floating):
                 f = f.astype(wire_dtype)
             flats.append(f)
         buf = jnp.concatenate(flats) if len(flats) > 1 else flats[0]
-        if int8_ok and buf.size >= n * 1024 \
-                and jnp.issubdtype(buf.dtype, jnp.floating):
+        new_res = None
+        if quant_label is not None:
             from horovod_tpu.ops.in_jit import mark_varying
-            from horovod_tpu.parallel.strategies import scaled_allreduce_int8
-            buf = mark_varying(scaled_allreduce_int8(
-                buf, axis_name=HVD_AXIS,
-                average=(op == ReduceOp.AVERAGE),
-                prescale_factor=prescale, postscale_factor=postscale),
-                HVD_AXIS)
+            residual = args[-1].reshape(-1) if use_ef else None
+            red, new_res = _wire.block_scaled_allreduce(
+                buf, residual=residual, axis_name=HVD_AXIS,
+                wire=quant_label, average=(op == ReduceOp.AVERAGE),
+                prescale_factor=prescale, postscale_factor=postscale)
+            buf = mark_varying(red, HVD_AXIS)
         else:
             buf = reduce_buf(buf)
         outs, off = [], 0
@@ -177,11 +207,14 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
             piece = lax.slice_in_dim(buf, off, off + sz).astype(x.dtype)
             outs.append(piece.reshape(x.shape))
             off += sz
+        if use_ef:
+            outs.append(new_res.reshape(1, total))
         return tuple(outs)
 
+    n_args = len(shapes) + (1 if use_ef else 0)
     f = jax.shard_map(body, mesh=mesh,
-                      in_specs=tuple(spec for _ in shapes),
-                      out_specs=tuple(spec for _ in shapes))
+                      in_specs=tuple(spec for _ in range(n_args)),
+                      out_specs=tuple(spec for _ in range(n_args)))
     # HOROVOD_DONATE_BUFFERS (default on): staged input stacks nobody
     # reads again are donated per-argument so XLA reuses their HBM for
     # the outputs (the reference's persistent fusion buffer is likewise
@@ -213,8 +246,13 @@ class FusionRuntime:
 
     def __init__(self, config):
         self.threshold = config.fusion_threshold
-        self.wire_dtype = jnp.dtype(config.wire_dtype).type \
-            if config.wire_dtype else None
+        # fp8 resolves through the wire tier (graceful bf16 fallback when
+        # the dtype doesn't exist in this jax build).
+        self.wire_dtype = _wire.wire_numpy_type(config.wire_dtype)
+        # Per-bucket error feedback for the quantized wire (residuals keyed
+        # by bucket signature in the wire tier's store, zeroed by
+        # clear_program_caches / elastic reset).
+        self._wire_ef = bool(config.wire_error_feedback)
         self._donate = bool(config.donate_buffers)
         self._lock = threading.RLock()
         self._pending = []  # (tid, tensor, op, prescale, postscale, handle)
@@ -262,15 +300,19 @@ class FusionRuntime:
             cats = {"strategy": [self.strategy] + [
                 s for s in ("flat", "hierarchical", "torus")
                 if s != self.strategy]}
-            if config.wire_dtype == "int8":
+            resolved = _wire.resolve_wire_dtype(config.wire_dtype)
+            if _wire.is_quantized(resolved):
                 # The user opted into the LOSSY quantized exchange;
                 # sweeping UP in precision is allowed (never down — that
-                # is precision policy, not a speed knob).
-                cats["wire_dtype"] = ["int8", "bfloat16", "float16"]
-            elif config.wire_dtype:
-                other = ("bfloat16" if config.wire_dtype == "float16"
+                # is precision policy, not a speed knob). The winner is
+                # adopted per process set (the boundary stream carries it
+                # to followers AND to the eager wire registry).
+                first = jnp.dtype(_wire.wire_numpy_type(resolved)).name
+                cats["wire_dtype"] = [first, "bfloat16", "float16"]
+            elif resolved:
+                other = ("bfloat16" if resolved == "float16"
                          else "float16")
-                cats["wire_dtype"] = [config.wire_dtype, other]
+                cats["wire_dtype"] = [resolved, other]
             self._parameter_manager = ParameterManager(
                 warmup_samples=config.autotune_warmup_samples,
                 steps_per_sample=config.autotune_steps_per_sample,
@@ -425,6 +467,14 @@ class FusionRuntime:
         seq = self._boundary_seq
         self._boundary_seq += 1
         wire = jnp.dtype(wire_dtype).name if wire_dtype else ""
+        if wire:
+            # The eager wire registry follows the SAME boundary stream the
+            # fused programs do: the coordinator adopts the snapshot when
+            # it publishes, followers when they apply — so at any sync
+            # eager dispatch (which fences fused work first) every process
+            # reads the same per-set wire dtype. Runtime sync defers to an
+            # explicit user pin (hvd.set_wire_dtype). See ops/wire.py.
+            _wire.runtime_sync_wire_dtype(wire, "global")
         self._publish_queue.put((seq, _json.dumps(
             {"t": int(last_tid), "s": strategy, "w": wire})))
 
@@ -477,10 +527,14 @@ class FusionRuntime:
                     block_ms = 1            # another consumer took it
                     continue
                 # Adopt the coordinator's program-shaping knobs for this
-                # prefix (its autotuner is the only decision maker).
+                # prefix (its autotuner is the only decision maker) — and
+                # mirror the wire dtype into the eager registry (the
+                # coordinator did the same when it published).
                 self.strategy = payload.get("s", self.strategy)
                 wire = payload.get("w", "")
                 self.wire_dtype = jnp.dtype(wire).type if wire else None
+                if wire:
+                    _wire.runtime_sync_wire_dtype(wire, "global")
                 # The local enqueue stream may lag the coordinator's:
                 # applying early would flush a SHORTER prefix and misalign
                 # every later collective. A boundary AHEAD of the local
@@ -598,12 +652,12 @@ class FusionRuntime:
         dt = jnp.dtype(tensor.dtype) if hasattr(tensor, "dtype") \
             else np.result_type(tensor)
         if self.wire_dtype is not None and jnp.issubdtype(dt, jnp.floating) \
-                and jnp.dtype(self.wire_dtype) != jnp.int8:
+                and not _wire.is_quantized(self.wire_dtype):
             # 16-bit casts make the bucket homogeneous at the wire dtype;
-            # int8 keeps each bucket in its ORIGINAL float dtype (the
-            # quantized exchange consumes/returns that dtype — folding
-            # fp32 and bf16 tensors into one "int8" bucket would make the
-            # concat heterogeneous).
+            # a QUANTIZED wire (int8/fp8) keeps each bucket in its
+            # ORIGINAL float dtype (the exchange consumes/returns that
+            # dtype — folding fp32 and bf16 tensors into one quantized
+            # bucket would make the concat heterogeneous).
             dt = jnp.dtype(self.wire_dtype)
         return (ReduceOp(op), float(prescale), float(postscale), str(dt))
 
@@ -762,6 +816,11 @@ class FusionRuntime:
                 return None
             return self._native.cache_stats()
 
+    def _zero_residual(self, mesh, n, flat_len):
+        from jax.sharding import NamedSharding
+        return _wire.zero_residual(mesh, NamedSharding(mesh, P(HVD_AXIS)),
+                                   n, flat_len)
+
     def _stage_local(self, raw, mesh):
         """Single-process staging for one flush bucket: already-sharded
         jax.Arrays pass through zero-copy; a mismatched jax.Array is
@@ -858,6 +917,12 @@ class FusionRuntime:
         # The one-flush lag on a sweep switch is absorbed by the
         # ParameterManager's per-combo compile-warmup discard.)
         strategy_now, wire_now = self.strategy, self.wire_dtype
+        if not self._multi and wire_now is not None:
+            # Single process: no boundary stream — adopt the snapshot into
+            # the eager wire registry here (multi-process does it at
+            # publish/apply time; see _publish_boundary). Defers to an
+            # explicit user pin like every runtime sync.
+            _wire.runtime_sync_wire_dtype(jnp.dtype(wire_now).name, "global")
         # Bucket assembly: tensors in one bucket share one flat reduction,
         # like responses fused up to the threshold (reference:
         # controller.h:170 FuseResponses). The native scheduler assigns
@@ -937,8 +1002,16 @@ class FusionRuntime:
                 # response cache and exposes hit-rate stats (cache_stats()).
                 self._native.cache_lookup(
                     hash((op, pre, post, shapes, dtypes)))
+            # Quantized-wire verdict for THIS bucket (static facts only —
+            # the compiled program reaches the same verdict from the same
+            # inputs, so the residual argument list always matches).
+            sizes = [int(np.prod(s[1:])) for s in shapes]
+            quant_label = _bucket_quant(wire_now, strategy,
+                                        active_mask is not None, op,
+                                        sizes, dtypes, n)
+            use_ef = self._wire_ef and quant_label is not None
             fkey = (mesh, op, pre, post, shapes, dtypes, wire_now,
-                    active_mask, strategy, donate)
+                    active_mask, strategy, donate, use_ef)
             prog = _flush_plans.get(fkey)
             if prog is None:
                 if len(_flush_plans) >= 2048:   # runaway-signature guard
@@ -946,7 +1019,27 @@ class FusionRuntime:
                 prog_mesh = topo.mesh2d if strategy != "flat" else mesh
                 prog = _flush_plans[fkey] = _fused_program(
                     prog_mesh, n, op, pre, post, shapes, dtypes, wire_now,
-                    active_mask, strategy, donate)
+                    active_mask, strategy, donate, use_ef)
+            args = list(tensors)
+            ef_key = ("fusion", fkey)
+            if use_ef:
+                res = _wire.ef_get(ef_key)
+                if res is None:
+                    res = self._zero_residual(mesh, n, sum(sizes))
+                args.append(res)
+            # Wire accounting for the bucket (buckets are dtype-
+            # homogeneous, so dtypes[0] stands for the payload).
+            bucket_bytes = sum(
+                int(np.prod(s)) * np.dtype(d).itemsize
+                for s, d in zip(shapes, dtypes))
+            eff_wire = quant_label or (
+                jnp.dtype(wire_now).name
+                if wire_now is not None
+                and not _wire.is_quantized(wire_now)
+                and np.issubdtype(np.dtype(dtypes[0]), np.floating)
+                else dtypes[0])
+            wire_nbytes = _wire.allreduce_wire_bytes(
+                bucket_bytes, np.dtype(dtypes[0]).itemsize, n, eff_wire)
             # _timeline_op supplies BOTH the timeline span and the
             # transport-failure → HorovodInternalError translation: a peer
             # dying mid fused collective must be recoverable by the elastic
@@ -958,15 +1051,28 @@ class FusionRuntime:
             from horovod_tpu.ops.collective_ops import _timeline_op
             try:
                 with _timeline_op(f"fused_allreduce[{len(items)}]",
-                                  "ALLREDUCE", tensors):
-                    outs = prog(*tensors)
+                                  "ALLREDUCE", tensors,
+                                  wire=("fused", eff_wire, wire_nbytes,
+                                        eff_wire != dtypes[0])):
+                    outs = prog(*args)
+                    if use_ef:
+                        # The residual stays a device-resident global
+                        # array between flushes; the next key-matched
+                        # bucket feeds it straight back.
+                        _wire.ef_put(ef_key, outs[-1])
+                        outs = outs[:-1]
                     # Multi-process: hand back this process's local rows,
                     # matching the sync ops' contract.
                     outs = _localize(list(outs), mesh)
             except Exception as e:  # noqa: BLE001
-                # A failed dispatch also evicts its flush plan: never pin
-                # a program that just raised (rebuild costs one lru hit).
+                # A failed dispatch also evicts its flush plan (never pin
+                # a program that just raised — rebuild costs one lru hit)
+                # and its residual (its pairing with the result stream is
+                # broken; after elastic recovery it would be a
+                # dead-backend array).
                 _flush_plans.pop(fkey, None)
+                if use_ef:
+                    _wire.ef_pop(ef_key)
                 for _, h in items:
                     h._set_error(e)
                 continue

@@ -61,9 +61,11 @@ def fused_allreduce_tree(tree, op=Average, axis_name=HVD_AXIS,
     op = ReduceOp(op)
     int8_route = (compression is Compression.int8 and process_set is None
                   and op in (Sum, Average))
-    if int8_route:
-        # Quantization happens inside the bucket exchange below; calling
-        # compress() would fire Int8Compressor's not-honored warning.
+    if compression is Compression.int8:
+        # Quantization happens inside the bucket exchange below (or not at
+        # all when the combination can't express it); compress() is the
+        # EAGER paths' routing hook and must not arm a one-shot wire
+        # request from inside a jit trace.
         compressed = [(jnp.asarray(l), None) for l in leaves]
     else:
         compressed = [compression.compress(jnp.asarray(l)) for l in leaves]

@@ -102,14 +102,26 @@ def allreduce_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
     return out
 
 
-def symmetric_int8_quantize(t):
-    """THE symmetric int8 quantizer (one definition for the wire exchange
-    AND the quantized KV cache): per-LAST-axis scale ``max|t|/127``
-    clamped at 1e-30, round + clip to ±127. Returns ``(q8, scale)`` with
-    ``scale.shape == t.shape[:-1]`` (fp32 math expected in ``t``)."""
-    scale = jnp.maximum(jnp.max(jnp.abs(t), axis=-1) / 127.0, 1e-30)
-    q = jnp.clip(jnp.round(t / scale[..., None]), -127, 127).astype(jnp.int8)
-    return q, scale
+# THE symmetric int8 quantizer lives in the wire tier now (one definition
+# for the wire exchange AND the quantized KV cache); re-exported here for
+# the existing import sites.
+from horovod_tpu.ops.wire import symmetric_int8_quantize  # noqa: F401,E402
+
+
+def _record_jit_wire(x, axis_name, wire):
+    """Trace-time wire accounting for the in-jit entry points: the shapes
+    are static during tracing, so this records once per compiled program
+    (documented in wire_compression_events_total's help text), never on
+    the device hot path."""
+    try:
+        from horovod_tpu.metrics import instruments as hvd_metrics
+        from horovod_tpu.ops import wire as _wire
+        n = int(lax.axis_size(axis_name))
+        hvd_metrics.record_wire(
+            "jit", wire, _wire.exchange_wire_bytes(int(x.size), n),
+            compressed=True)
+    except Exception:  # noqa: BLE001 — accounting must never break a trace
+        pass
 
 
 def scaled_allreduce_int8(x, axis_name="hvd", average=False,
@@ -118,65 +130,50 @@ def scaled_allreduce_int8(x, axis_name="hvd", average=False,
     around the exchange — the ONE wrapper both the jit fused path
     (optim/optimizer.py) and the eager fusion runtime (ops/fusion.py)
     call, so the scaling order can never diverge between them."""
-    if prescale_factor != 1.0:
-        x = x * jnp.asarray(prescale_factor, x.dtype)
-    x = allreduce_int8(x, axis_name=axis_name, average=average)
-    if postscale_factor != 1.0:
-        x = x * jnp.asarray(postscale_factor, x.dtype)
-    return x
+    from horovod_tpu.ops import wire as _wire
+    _record_jit_wire(x, axis_name, "int8")
+    out, _ = _wire.block_scaled_allreduce(
+        x, axis_name=axis_name, wire="int8", average=average,
+        prescale_factor=prescale_factor, postscale_factor=postscale_factor)
+    return out
 
 
 def allreduce_int8(x, axis_name="hvd", average=False):
     """Quantized allreduce: int8 on the wire, fp32 accumulation.
 
-    EQuARX-style (Efficient Quantized AllReduce in XLA, arXiv:2506.17615)
-    two-phase exchange built from XLA collectives — the reference's wire
-    compression stops at fp16 casts (horovod/torch/compression.py); this
-    halves the bytes again:
-
-    1. each rank splits its buffer into n destination shards and quantizes
-       symmetrically to int8 with one fp32 scale per 1024-element block,
-    2. one AllToAll moves int8 shards (+ a tiny fp32 scale AllToAll),
-    3. each rank dequantizes and accumulates its shard in fp32
-       (the reduce-scatter leg, 1 byte/element on the wire),
-    4. the reduced shard is requantized block-wise and AllGathered as int8
-       (+ fp32 scales), then dequantized (the all-gather leg, 1 B/el).
-
-    Total wire traffic ≈ 2 bytes/element vs 4 for a bf16 psum's internal
-    reduce-scatter + all-gather — at the cost of one quantization error per
-    leg, bounded per element by its own 1024-block's max/254 (block scales
-    keep small-magnitude tensors in a mixed fused bucket from rounding
-    to zero).
-
-    Works on any local shape; returns the same shape/dtype as ``x``.
+    The EQuARX-style two-phase exchange (arXiv:2506.17615) — int8 both
+    legs, one fp32 scale per 1024-element block, reduce in fp32 — now
+    implemented once in :func:`horovod_tpu.ops.wire.block_scaled_allreduce`
+    (which also offers the fp8 variant and the error-feedback form whose
+    residual the caller threads through its own state). This entry point
+    is the stable in-jit API; it keeps the exchange exact-shape/dtype
+    preserving and records trace-time wire accounting.
     """
-    n = lax.axis_size(axis_name)
-    orig_shape, orig_dtype = x.shape, x.dtype
-    flat = x.reshape(-1).astype(jnp.float32)
-    size = flat.size
-    # Block-wise scales (EQuARX's block quantization): one fp32 scale per
-    # 1024 elements, NOT per shard — a fused bucket mixes tensors of very
-    # different magnitudes (embedding vs layernorm grads), and a shard-wide
-    # scale would round the small ones to zero every step. 4 bytes per
-    # 1024 ≈ 0.4 % wire overhead.
-    block = 1024
-    pad = (-size) % (n * block)
-    if pad:
-        flat = jnp.pad(flat, (0, pad))
-    nb = flat.size // (n * block)                    # blocks per shard
-    blocks = flat.reshape(n, nb, block)              # [dest, block, elem]
-    q, scale = symmetric_int8_quantize(blocks)       # scale (n, nb)
-    # Row d goes to rank d; row r of the result came from rank r.
-    qt = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0)
-    st = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0)
-    part = jnp.sum(qt.astype(jnp.float32) * st[..., None],
-                   axis=0)                           # (nb, block) fp32
-    q2, s2 = symmetric_int8_quantize(part)           # s2 (nb,)
-    full_q = lax.all_gather(q2, axis_name, axis=0, tiled=False)  # (n,nb,blk)
-    full_s = lax.all_gather(s2, axis_name, axis=0, tiled=False)  # (n, nb)
-    out = (full_q.astype(jnp.float32) * full_s[..., None]).reshape(-1)
-    if pad:
-        out = out[:-pad]
-    if average:
-        out = out / jnp.asarray(n, out.dtype)
-    return out.reshape(orig_shape).astype(orig_dtype)
+    from horovod_tpu.ops import wire as _wire
+    _record_jit_wire(x, axis_name, "int8")
+    out, _ = _wire.block_scaled_allreduce(
+        x, axis_name=axis_name, wire="int8", average=average)
+    return out
+
+
+def allreduce_quantized(x, axis_name="hvd", wire_dtype="int8", average=False,
+                        prescale_factor=1.0, postscale_factor=1.0,
+                        residual=None):
+    """Generalized in-jit quantized allreduce: ``wire_dtype`` selects the
+    block format — ``int8``, or ``fp8`` where this jax build has the
+    dtype (an fp8-less build falls back to the int8 blocks: this function
+    promises a QUANTIZED wire, and the accounting records the format
+    actually used). With ``residual`` (an fp32 buffer of ``x``'s flat
+    size threaded through the caller's optimizer state) returns ``(out,
+    new_residual)`` — the in-jit error-feedback form; the caller MUST
+    zero the residual on elastic reset (hvdlint HVP109 flags
+    configurations that look like they won't). Without it returns just
+    ``out``."""
+    from horovod_tpu.ops import wire as _wire
+    label = _wire.quantized_label(wire_dtype) or "int8"
+    _record_jit_wire(x, axis_name, label)
+    out, new_res = _wire.block_scaled_allreduce(
+        x, residual=residual, axis_name=axis_name, wire=label,
+        average=average, prescale_factor=prescale_factor,
+        postscale_factor=postscale_factor)
+    return out if residual is None else (out, new_res)

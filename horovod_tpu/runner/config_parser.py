@@ -40,6 +40,8 @@ _ARG_ENV_MAP = [
     ("log_hide_timestamp", "HOROVOD_LOG_HIDE_TIME",
      lambda v: "1" if v else None),
     ("wire_dtype", "HOROVOD_WIRE_DTYPE", str),
+    ("no_wire_error_feedback", "HOROVOD_WIRE_ERROR_FEEDBACK",
+     lambda v: "0" if v else None),
     ("compile_cache_dir", "HOROVOD_COMPILE_CACHE_DIR", str),
     ("elastic_timeout", "HOROVOD_ELASTIC_TIMEOUT", str),
     ("gloo_timeout_seconds", "HOROVOD_GLOO_TIMEOUT_SECONDS", str),

@@ -108,7 +108,15 @@ def parse_args(argv=None):
                              "(fork knob HOROVOD_TORUS_ALLREDUCE)")
     tuning.add_argument("--wire-dtype", dest="wire_dtype",
                         choices=["", "bfloat16", "float16", "bf16", "fp16",
-                                 "int8"])
+                                 "int8", "fp8"],
+                        help="Collective wire dtype: 16-bit casts the fused "
+                             "buckets; int8/fp8 ride the block-scaled "
+                             "quantized exchange with error feedback "
+                             "(HOROVOD_WIRE_DTYPE; docs/performance.md)")
+    tuning.add_argument("--no-wire-error-feedback", action="store_true",
+                        dest="no_wire_error_feedback",
+                        help="Disable the quantized wire's error-feedback "
+                             "residuals (HOROVOD_WIRE_ERROR_FEEDBACK=0)")
     tuning.add_argument("--compile-cache-dir", dest="compile_cache_dir",
                         help="Persistent XLA compile-cache directory "
                              "exported to every worker "
@@ -374,7 +382,8 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_PEAK_TFLOPS", "HOROVOD_PEAK_HBM_GBS",
                 "HOROVOD_PEAK_ICI_GBS", "HOROVOD_PEAK_DCN_GBS",
                 "HVD_FLASH_BLOCK", "HVD_FLASH_ALLOW_PADDED",
-                "HVD_BENCH_PROGRESS_FILE"):
+                "HVD_BENCH_PROGRESS_FILE",
+                "HOROVOD_WIRE_DTYPE", "HOROVOD_WIRE_ERROR_FEEDBACK"):
         if os.environ.get(var):
             env.setdefault(var, os.environ[var])
     # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU

@@ -39,11 +39,29 @@ class _CastCompressor:
         return t.to(torch.float32 if ctx == np.float32 else torch.float64)
 
 
+class _Int8WireCompressor:
+    """Routes the next collective through the quantized wire tier
+    (horovod_tpu/ops/wire.py): the int8 block-scaled exchange happens
+    INSIDE the collective (compress() arms a one-shot request the eager
+    dispatch consumes), so the bridge array itself is untouched."""
+
+    @staticmethod
+    def compress(a):
+        from horovod_tpu.ops import wire
+        wire.request_wire_once("int8")
+        return a, None
+
+    @staticmethod
+    def decompress(t, ctx):
+        return t
+
+
 class Compression:
     """reference: hvd.Compression registry (torch/compression.py:64-74)."""
     none = _NoneCompressor()
     fp16 = _CastCompressor(lambda: np.float16)
     bf16 = _CastCompressor(lambda: __import__("ml_dtypes").bfloat16)
+    int8 = _Int8WireCompressor()
 
 
 class Compressor:

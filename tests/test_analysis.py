@@ -163,6 +163,64 @@ class TestCheckProgram:
             hvd.check_program(jit_step, (x,), world_size=4,
                               config=Config()).findings)
 
+    def test_wire_dtype_advisory_suppressed_by_quantized_exchange(
+            self, hvd):
+        """HVP106 must NOT fire when the jaxpr shows the block-scaled
+        exchange (int8 collectives from ops/wire.py): that program is
+        already quantizing in jit — the fp32 collectives alongside are
+        its own block scales."""
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.parallel.strategies import allreduce_quantized
+        mesh = Mesh(np.array(jax.devices()[:4]), ("hvd",))
+        x = np.ones((4, 4096), np.float32)
+
+        def quant_step(x):
+            def inner(xl):
+                return allreduce_quantized(
+                    xl.reshape(-1), axis_name="hvd").reshape(xl.shape)
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
+                check_vma=False))(x)
+
+        cfg = Config(wire_dtype="int8")
+        cfg.wire_error_feedback = False
+        codes = _codes(hvd.check_program(quant_step, (x,), world_size=4,
+                                         config=cfg).findings)
+        assert "HVP106" not in codes
+        assert "HVP109" not in codes   # EF off -> no residual advisory
+
+    def test_stale_residual_advisory_hvp109(self, hvd):
+        """HVP109: error feedback configured + in-jit quantized exchange
+        -> advisory that residuals live outside the runtime store (stale
+        on elastic reset unless the optimizer zeroes them). Advisory
+        only: the report stays ok."""
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.parallel.strategies import allreduce_quantized
+        mesh = Mesh(np.array(jax.devices()[:4]), ("hvd",))
+        x = np.ones((4, 4096), np.float32)
+
+        def quant_step(x):
+            def inner(xl):
+                return allreduce_quantized(
+                    xl.reshape(-1), axis_name="hvd").reshape(xl.shape)
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"), out_specs=P("hvd"),
+                check_vma=False))(x)
+
+        cfg = Config(wire_dtype="int8")
+        cfg.wire_error_feedback = True
+        rep = hvd.check_program(quant_step, (x,), world_size=4, config=cfg)
+        hits = [f for f in rep.findings if f.code == "HVP109"]
+        assert hits and hits[0].severity == "info"
+        assert rep.ok
+        # eager-only program under the same config: the runtime store owns
+        # those residuals (and clear_program_caches zeroes them) -> clean
+        def eager_step(x):
+            return hvd.allreduce(x)
+        assert "HVP109" not in _codes(
+            hvd.check_program(eager_step, (x,), world_size=4,
+                              config=cfg).findings)
+
     def test_buffer_reuse_advisory(self, hvd):
         from horovod_tpu.common.config import Config
         x = np.ones((4, 8), np.float32)

@@ -557,9 +557,10 @@ class TestDispatchPlanSemantics:
 
         x = jnp.full((hvd.size(), 23), 2.0, jnp.float32)
         np.asarray(hvd.allreduce(x, op=hvd.Sum))      # registers
+        # key layout: (kind, mesh, ps, op, pre, post, sig, wire, ef)
         key = [k for k in co._plans
                if k[0] == "allreduce" and k[3] == int(hvd.Sum)
-               and k[-1] and k[-1][0][0] == (hvd.size(), 23)]
+               and k[6] and k[6][0][0] == (hvd.size(), 23)]
         assert len(key) == 1
         plan = co._plans[key[0]]
         np.asarray(hvd.allreduce(x, op=hvd.Sum))      # memoizes staging

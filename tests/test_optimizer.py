@@ -101,21 +101,28 @@ class TestFusedTreeAllreduce:
         # The small region's signal survives (correlation, not zeros).
         assert np.abs(out[4096:]).sum() > 0.5 * np.abs(exact[4096:]).sum()
 
-    def test_int8_warns_on_unhonored_path(self, hvd):
-        """Any path that cannot quantize must warn, not silently degrade."""
+    def test_int8_compress_routes_wire_tier_without_warning(self, hvd):
+        """The old warn-and-skip eager path is gone: compress() arms a
+        one-shot wire-tier request for the next eager allreduce (consumed
+        read-and-clear), and no path warns."""
         import warnings
-        from horovod_tpu.ops.compression import Compression, Int8Compressor
-        Int8Compressor._warned = False
-        with pytest.warns(UserWarning, match="UNCOMPRESSED"):
+        from horovod_tpu.ops import wire
+        from horovod_tpu.ops.compression import Compression
+        wire.consume_wire_request()          # drain any stale state
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
             Compression.int8.compress(jnp.ones((4,)))
-        Int8Compressor._warned = False
-        # The honored fused route must NOT warn.
+        assert wire.consume_wire_request() == "int8"
+        assert wire.consume_wire_request() is None   # one-shot
+        # The fused jit route stays silent AND must not arm the one-shot
+        # from inside the trace (it quantizes in the bucket exchange).
         from horovod_tpu.optim import fused_allreduce_tree
         x = np.ones((N, 8), np.float32)
         with warnings.catch_warnings():
             warnings.simplefilter("error", UserWarning)
             np.asarray(_shard_step(hvd, lambda t: fused_allreduce_tree(
                 t, op=hvd.Sum, compression=Compression.int8), 1)(x))
+        assert wire.consume_wire_request() is None
 
     def test_compression_roundtrip(self, hvd, rng):
         from horovod_tpu.optim import fused_allreduce_tree
@@ -514,10 +521,10 @@ class TestGroupedAsyncFusion:
         orig = fusion._fused_program
 
         def spy(mesh, n, op, pre, post, shapes, dtypes, wire, mask=None,
-                strategy="flat", donate=()):
+                strategy="flat", donate=(), ef=False):
             calls.append(len(shapes))
             return orig(mesh, n, op, pre, post, shapes, dtypes, wire, mask,
-                        strategy, donate)
+                        strategy, donate, ef)
 
         try:
             fusion._fused_program = spy

@@ -483,6 +483,114 @@ class TestHostOverheadBudget:
                 f"(2x budget). If the machine changed, regenerate with "
                 f"HVD_UPDATE_PERF_BASELINE=1.")
 
+    @staticmethod
+    def _host_path_us(hvd, wire_name, x):
+        """Host-side cost of one eager allreduce dispatch with the XLA
+        program STUBBED OUT: plan lookup (wire-keyed), fusion fence,
+        metrics/flight/profile bookkeeping, EF residual store get/put,
+        localization — everything the wire tier adds on the HOST. The
+        real program's quantize/dequantize is device compute and is
+        measured by bench.py's wire sweep, not bounded here (on the CPU
+        tier the 'device' is the host, so a wall-clock bound would just
+        re-measure XLA's int8 all_to_all throughput)."""
+        from horovod_tpu.ops import collective_ops as C
+        from horovod_tpu.ops import wire
+
+        hvd.set_wire_dtype(wire_name)
+        jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))  # register
+        key = [k for k in C._plans
+               if k[0] == "allreduce" and len(k) > 8
+               and k[7] == (wire_name or None)][-1]
+        plan = C._plans[key]
+        staged = jax.device_put(x, plan.sharding)  # steady-state passthrough
+        args = [staged]
+        if getattr(plan, "ef", False):
+            r = wire.ef_get(plan.ef_key)
+            if r is None:
+                r = plan._zero_residual()
+            args.append(r)
+        real = plan.program
+        outs = real(*args)
+        jax.block_until_ready(outs)
+        plan.program = lambda *a, **k: outs
+        try:
+            best = float("inf")
+            for _ in range(3):
+                ts = []
+                for _ in range(50):
+                    t0 = time.perf_counter()
+                    hvd.allreduce(staged, op=hvd.Sum)
+                    ts.append(time.perf_counter() - t0)
+                best = min(best, sorted(ts)[len(ts) // 2])
+        finally:
+            plan.program = real
+        return best * 1e6
+
+    def test_wire_int8_host_cost_within_2x_fp32_leg(self, hvd):
+        """The wire=int8 leg: the quantized tier's HOST dispatch path
+        (wire-keyed plan hit + error-feedback store round-trip) must stay
+        within 2x the fp32 leg's host path, same-run A/B (the satellite
+        budget of docs/performance.md 'Quantized wire tier')."""
+        from horovod_tpu.ops import wire
+        n = hvd.size()
+        x = jnp.ones((n, n * wire.BLOCK), jnp.float32)
+        wire.clear_wire_registry()
+        wire.reset_error_feedback()
+        try:
+            fp32_us = self._host_path_us(hvd, "", x)
+            int8_us = self._host_path_us(hvd, "int8", x)
+        finally:
+            hvd.set_wire_dtype("")
+            wire.clear_wire_registry()
+            wire.reset_error_feedback()
+        assert int8_us <= 2.0 * fp32_us, (
+            f"int8 wire host path {int8_us:.0f}us vs fp32 {fp32_us:.0f}us "
+            f"— the wire tier's host-side cost (plan key, residual store) "
+            f"exceeds the 2x budget")
+
+    def test_wire_bytes_int8_below_0p3x_fp32(self, hvd):
+        """Acceptance guard: for a >=4 MB payload, wire_bytes_total shows
+        the int8 exchange moving <0.3x the fp32 allreduce's bytes — the
+        provable off-chip savings (both int8 legs + block scales vs both
+        fp32 RS+AG legs)."""
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.ops import wire
+
+        def wire_bytes(dtype):
+            snap = ins.get_registry().snapshot()
+            for s in snap.get("wire_bytes_total", {}).get("series", ()):
+                if s["labels"].get("dtype") == dtype:
+                    return s["value"]
+            return 0.0
+
+        n = hvd.size()
+        elems = max(4 * 1024 * 1024 // 4 // n, n * wire.BLOCK)
+        x = jnp.ones((n, elems), jnp.float32)   # >= 4 MB global payload
+        assert x.nbytes >= 4 * 1024 * 1024
+        prev = ins.enabled()
+        ins.set_enabled(True)
+        wire.clear_wire_registry()
+        try:
+            f0 = wire_bytes("float32")
+            jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))
+            fp32_delta = wire_bytes("float32") - f0
+            hvd.set_wire_dtype("int8")
+            q0 = wire_bytes("int8")
+            jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))
+            int8_delta = wire_bytes("int8") - q0
+        finally:
+            hvd.set_wire_dtype("")
+            wire.clear_wire_registry()
+            wire.reset_error_feedback()
+            ins.set_enabled(prev)
+        assert fp32_delta == 2 * x.nbytes, fp32_delta
+        assert int8_delta > 0
+        ratio = int8_delta / fp32_delta
+        assert ratio < 0.3, (
+            f"int8 wire bytes {int8_delta:.0f} vs fp32 {fp32_delta:.0f} "
+            f"(ratio {ratio:.3f}) — the quantized exchange must move "
+            f"<0.3x the fp32 bytes for a >=4MB payload")
+
 
 class TestMetricsOverheadBudget:
     """The metrics registry is ALWAYS ON in the eager hot path (one
