@@ -714,6 +714,50 @@ def _bench_image(hvd, name):
           round(per_chip / baseline, 3) if baseline else 0.0)
 
 
+def _static_cost_record(hvd, elems, n, measured):
+    """The hvdcost ride-along for the wire sweep: price the largest
+    rung's allreduce with the STATIC per-link-tier cost model
+    (analysis/cost.py) and record predicted per-tier bytes next to the
+    measured `wire_bytes_total` delta each leg actually put on the wire —
+    the static-vs-runtime cross-check as bench evidence on the
+    HVD_BENCH_PROGRESS_FILE channel. ``measured`` maps wire leg ->
+    measured bytes/op from the sweep."""
+    try:
+        from horovod_tpu.analysis import cost as an_cost
+        from horovod_tpu.analysis.program import check_program
+        from horovod_tpu.common.config import Config
+
+        x = np.zeros((n, elems), np.float32)
+
+        def step(x):
+            return hvd.allreduce(x, op=hvd.Sum)
+
+        rec = {"payload_mb": round(x.nbytes / 2**20, 2), "world": n}
+        for leg, wire in (("float32", ""), ("int8", "int8")):
+            cfg = Config(wire_dtype=wire)
+            rep = check_program(step, (x,), world_size=n, config=cfg)
+            # use_registry=False: counterfactual pricing against cfg
+            # alone — the sweep's own registry pins must not leak in.
+            cr = an_cost.cost_report(rep, config=cfg, use_registry=False)
+            predicted = float(sum(cr.bytes_by_dtype.values()))
+            got = measured.get(leg)
+            rec[leg] = {
+                "bytes_by_tier": dict(cr.bytes_by_tier),
+                "predicted_wire_bytes": predicted,
+                "measured_wire_bytes": got,
+                "delta": (got - predicted) if got is not None else None,
+            }
+        rec["num_slices"] = cr.num_slices
+        _progress_record("static_cost", static_cost=rec)
+        _mark(f"static_cost: int8 predicted "
+              f"{rec['int8']['predicted_wire_bytes']:.0f}B "
+              f"(ici={rec['int8']['bytes_by_tier']['ici']} "
+              f"dcn={rec['int8']['bytes_by_tier']['dcn']}) vs measured "
+              f"{rec['int8']['measured_wire_bytes']}")
+    except Exception as e:  # noqa: BLE001 — evidence must not fail bench
+        _progress_record("static_cost", error=str(e)[:160])
+
+
 def _bench_wire_sweep(hvd):
     """Wire-dtype sweep: the SAME payload ladder through the eager
     allreduce at fp32 / bf16-cast(fused) / int8 wire, reporting per-leg
@@ -787,6 +831,10 @@ def _bench_wire_sweep(hvd):
         int8_b = results[(elems, "int8")]["wire_bytes_per_op"]
         if fp32_b:
             ratio_largest = int8_b / fp32_b
+    largest = ladder[-1]
+    _static_cost_record(hvd, largest, n, {
+        leg: results[(largest, leg)]["wire_bytes_per_op"]
+        for leg in ("float32", "int8")})
     wire.reset_error_feedback()
     _emit("wire_sweep_int8_bytes_ratio", round(ratio_largest, 4),
           "int8/fp32 bytes-on-wire ratio (largest rung; <0.3 = the "

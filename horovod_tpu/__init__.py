@@ -56,7 +56,9 @@ from horovod_tpu.ops.collective_ops import (  # noqa: F401
 from horovod_tpu import callbacks  # noqa: F401
 from horovod_tpu import chaos  # noqa: F401
 from horovod_tpu import analysis  # noqa: F401
-from horovod_tpu.analysis.program import check_program  # noqa: F401
+from horovod_tpu.analysis.program import (  # noqa: F401
+    check_elastic, check_program,
+)
 from horovod_tpu.runner.api import run, run_elastic  # noqa: F401
 from horovod_tpu import checkpoint  # noqa: F401
 from horovod_tpu import elastic  # noqa: F401

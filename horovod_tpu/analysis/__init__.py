@@ -1,11 +1,19 @@
 """Static collective-correctness analysis + runtime lint (hvdlint).
 
-Two halves (ISSUE 9 / docs/static_analysis.md):
+Four pieces (ISSUEs 9/11, docs/static_analysis.md):
 
 - :func:`check_program` (exported as ``hvd.check_program``) — abstract-eval
   a step function per simulated rank and diff the collective sequences for
   desync hazards before the run, each finding carrying the flight
   recorder's ``(op, ps, seq, sig)`` identity;
+- :func:`check_elastic` — the elastic world-transition model checker:
+  re-run the per-rank abstract eval across a resize ladder and diff the
+  streams ACROSS generations (HVP110 world_dependent_signature);
+- :mod:`horovod_tpu.analysis.cost` (hvdcost) — the static per-link-tier
+  communication cost model: per-step ``bytes_by_tier{ici,dcn}``, the
+  HVP111 DCN budget gate, and :func:`cross_check_bytes` against the
+  runtime ``wire_bytes_total`` counters
+  (``python -m horovod_tpu.analysis.cost``);
 - :mod:`horovod_tpu.analysis.lint` — AST-based codebase lint
   (``python -m horovod_tpu.analysis.lint``, ``scripts/lint.py``) for the
   bug classes previous PRs fixed by hand.
@@ -16,18 +24,24 @@ from horovod_tpu.analysis.events import (  # noqa: F401
 )
 from horovod_tpu.analysis.findings import Finding  # noqa: F401
 from horovod_tpu.analysis.program import (  # noqa: F401
-    CheckReport, check_program, cross_check,
+    CheckReport, ElasticReport, check_elastic, check_program, cross_check,
 )
 
 _LINT_EXPORTS = ("LintFinding", "declared_knobs", "lint_paths",
                  "lint_source")
+_COST_EXPORTS = ("CostReport", "check_cost", "cost_report",
+                 "cross_check_bytes", "resolve_slices")
 
 
 def __getattr__(name):
-    # Lazy: `python -m horovod_tpu.analysis.lint` imports this package
-    # first, and an eager `from .lint import ...` would double-import the
-    # module it is about to execute (runpy RuntimeWarning).
+    # Lazy: `python -m horovod_tpu.analysis.lint` / `.cost` imports this
+    # package first, and an eager `from .lint import ...` would
+    # double-import the module it is about to execute (runpy
+    # RuntimeWarning).
     if name in _LINT_EXPORTS:
         from horovod_tpu.analysis import lint
         return getattr(lint, name)
+    if name in _COST_EXPORTS:
+        from horovod_tpu.analysis import cost
+        return getattr(cost, name)
     raise AttributeError(name)

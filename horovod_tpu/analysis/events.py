@@ -53,6 +53,16 @@ class CollectiveEvent:
     # sits in a while-loop body whose trip count is data-dependent —
     # diffed for presence across ranks, excluded from sequence hashes).
     repeat: int = 1
+    # Cost-model context (analysis/cost.py). Eager ops: the reduce-op
+    # name ("Sum"/"Average"/...; None for non-reductions) and, for a
+    # non-global process set, its member ranks — tier classification
+    # needs to know WHICH ranks exchange, not just how many. Jit ops:
+    # the named-axis sizes the collective communicates over (None =
+    # unknown axis). None of these enter key()/identity(), so cross-rank
+    # diffing and the flight cross-check are unchanged.
+    red_op: str = None
+    ps_ranks: tuple = None
+    axis_sizes: tuple = ()
 
     @property
     def sig(self):
@@ -66,6 +76,35 @@ class CollectiveEvent:
         """Identity *without* seq — what cross-rank diffing compares at
         each position."""
         return (self.op, self.ps, self.sig, self.repeat)
+
+    def per_rank_elems(self):
+        """Elements ONE participant contributes: eager shapes are global
+        rank-major stacks (leading axis = set size), jit shapes are the
+        per-device view already."""
+        total = 0
+        for s in self.shapes:
+            dims = s[1:] if self.origin != "jit" else s
+            cnt = 1
+            for d in dims:
+                cnt *= int(d)
+            total += cnt
+        return total
+
+    def group_size(self, world_size=None):
+        """Number of exchange participants: the leading stacked dim for
+        eager ops, the product of known axis sizes for jit ops (falling
+        back to ``world_size`` when the walker couldn't size the axis)."""
+        if self.origin != "jit":
+            if self.shapes and self.shapes[0]:
+                return int(self.shapes[0][0])
+            return len(self.ps_ranks) if self.ps_ranks else world_size
+        sizes = [s for s in self.axis_sizes if s]
+        if not sizes:
+            return world_size
+        p = 1
+        for s in sizes:
+            p *= int(s)
+        return p
 
     def describe(self):
         shp = ",".join(f"{tuple(s)}:{d}"

@@ -32,6 +32,17 @@ Program-analysis codes (``HVP1xx``):
   residual store — residuals threaded through optimizer state must be
   zeroed on elastic reset (a resized mesh must not replay stale
   residuals), and residual-less in-jit exchanges get no feedback at all.
+- ``HVP110`` world_dependent_signature — error (``check_elastic``): a
+  collective whose stream position, order, dtype, repeat count or payload
+  is a function of WORLD SIZE, so a resized mesh replays the step against
+  mismatched peers. Payloads that are an even reshard of one logical
+  buffer (ZeRO ``ceil(B/n)`` shards) or fully replicated pass clean.
+- ``HVP111`` tier_budget_exceeded — error (``analysis/cost.py``): the
+  predicted per-step DCN bytes exceed the declared budget
+  (``HOROVOD_DCN_BYTES_BUDGET`` / ``dcn_budget_bytes=``).
+- ``HVP112`` unbounded_repeat — advisory: a collective under a ``while``
+  whose trip count the walker cannot bound — cost totals and the elastic
+  generation diff are LOWER BOUNDS for it, not exact.
 
 Lint codes (``HVL0xx``) are documented in :mod:`horovod_tpu.analysis.lint`.
 """

@@ -40,7 +40,9 @@ class JitCollective:
     shapes: tuple           # operand shapes (per-device view)
     dtypes: tuple           # operand dtype strings
     axis_sizes: tuple       # size of each named axis (None = unknown)
-    repeat: int = 1         # static trip count (0 = unknown, while-loop)
+    repeat: int = 1         # static trip count (0 = unknown: the event
+    #                         sits under a while whose count is data-
+    #                         dependent — HVP112, lower-bound costing)
     in_cond: bool = False   # inside a lax.cond branch
     branch: int = None      # which branch, when in_cond
 
@@ -55,7 +57,9 @@ class JitCollective:
         return total
 
 
-def _dtype_width(dtype_str):
+def dtype_width(dtype_str):
+    """Byte width of a dtype string (shared by the event byte counts and
+    the analysis cost model's wire-byte formulas)."""
     s = str(dtype_str)
     for w, names in ((8, ("float64", "int64", "uint64", "complex64")),
                      (4, ("float32", "int32", "uint32")),
@@ -64,6 +68,9 @@ def _dtype_width(dtype_str):
         if any(n in s for n in names):
             return w
     return 4
+
+
+_dtype_width = dtype_width          # back-compat alias (pre-cost callers)
 
 
 def _axis_names(eqn):

@@ -83,7 +83,8 @@ class _TraceRecorder:
             else:
                 self.input_ids[key] = idx
 
-    def record(self, op, tensors, process_set, name, origin="eager"):
+    def record(self, op, tensors, process_set, name, origin="eager",
+               red_op=None):
         """Record one eager dispatch the way the runtime would: signature
         over the GLOBAL stacked tensors (leading axis = set size)."""
         n = _ps_size(process_set, self.world_size)
@@ -93,15 +94,18 @@ class _TraceRecorder:
             gshape = (n,) + tuple(shape[1:]) if shape else (n,)
             shapes.append(gshape)
             dtypes.append(dtype)
-            width = jaxpr_walk._dtype_width(dtype)
+            width = jaxpr_walk.dtype_width(dtype)
             cnt = 1
             for d in gshape:
                 cnt *= int(d)
             nbytes += cnt * width
         self._note_inputs(tensors)
+        ranks = getattr(process_set, "ranks", None)
         self.events.append(CollectiveEvent(
             op=op, ps=_ps_label(process_set), seq=0, shapes=tuple(shapes),
-            dtypes=tuple(dtypes), origin=origin, name=name, nbytes=nbytes))
+            dtypes=tuple(dtypes), origin=origin, name=name, nbytes=nbytes,
+            red_op=red_op,
+            ps_ranks=tuple(int(r) for r in ranks) if ranks else None))
 
 
 def _stub_outputs(kind, tensors, n, return_sizes=False):
@@ -169,13 +173,26 @@ def _make_hook(rec):
         ps = get("process_set", ps_pos)
         name = get("name", ps_pos + 1)
         n = _ps_size(ps, rec.world_size)
+        # Reduce-op name for the reductions (allreduce/reducescatter take
+        # `op` at position 1) — the cost model's wire-eligibility check
+        # mirrors the runtime's Sum/Average gate with it.
+        red_op = None
+        base_kind = kind[:-len("_async")] if kind.endswith("_async") \
+            else kind
+        if base_kind in ("allreduce", "reducescatter"):
+            from horovod_tpu.ops.collective_ops import Average, ReduceOp, Sum
+            default = Average if base_kind == "allreduce" else Sum
+            try:
+                red_op = ReduceOp(get("op", 1, default)).name.capitalize()
+            except ValueError:
+                red_op = None
         # The tensor operand may arrive positionally or by keyword; the
         # grouped ops spell it `tensors`, the singular ones `tensor`.
         first = get("tensors", 0, get("tensor", 0))
         was_list = isinstance(first, (list, tuple))
         tensors = list(first) if was_list else [first]
         if kind in _GROUPED_KINDS:
-            rec.record(kind, tensors, ps, name)
+            rec.record(kind, tensors, ps, name, red_op=red_op)
             return _stub_outputs(kind, tensors, n)
         if kind == "allgather_ragged":
             rec.record("allgather", tensors, ps, name)
@@ -193,7 +210,8 @@ def _make_hook(rec):
             # Async allreduce rides the fusion runtime: its flush order is
             # cycle-timed, so seq prediction is approximate -> "fused".
             origin = "fused" if base == "allreduce" else "eager"
-            rec.record(base, tensors, ps, name, origin=origin)
+            rec.record(base, tensors, ps, name, origin=origin,
+                       red_op=red_op)
             out = _stub_outputs(base, tensors, n)
             if not was_list and isinstance(out, list):
                 out = out[0]
@@ -309,7 +327,8 @@ def _jit_events(closed):
         events.append(CollectiveEvent(
             op=c.op, ps=f"axis:{ax}", seq=0, shapes=c.shapes,
             dtypes=c.dtypes, origin="jit", nbytes=c.nbytes,
-            repeat=max(c.repeat, 0)))  # 0 = unknown (while-loop body)
+            repeat=max(c.repeat, 0),   # 0 = unknown (while-loop body)
+            axis_sizes=tuple(c.axis_sizes)))
         if c.in_cond:
             cond_ops.append(c)
         if any(s == 1 for s in c.axis_sizes if s is not None):
@@ -351,8 +370,12 @@ def check_program(step_fn, args=(), kwargs=None, *, world_size=None,
             ranks = tuple(range(world_size))
         else:
             # Boundary ranks catch the usual rank-gated patterns (first,
-            # second, middle, last); sampling is reported on the report.
-            ranks = tuple(sorted({0, 1, world_size // 2,
+            # second, middle, last); mid is sampled WITH its neighbors —
+            # `rank == size // 2 + 1`-style gates (pipeline halves, the
+            # odd-world leader pick) otherwise escape HVP101 entirely.
+            # Sampling is reported on the report.
+            mid = world_size // 2
+            ranks = tuple(sorted({0, 1, mid - 1, mid, mid + 1,
                                   world_size - 2, world_size - 1}))
             sampled = True
     else:
@@ -599,6 +622,197 @@ def _advisory_findings(events, rank, config, reuse_info):
             seq=getattr(ev, "seq", None),
             sig=getattr(ev, "sig", None) if ev else None))
     return findings
+
+
+@dataclasses.dataclass
+class ElasticReport:
+    """Result of :func:`check_elastic`: one :class:`CheckReport` per
+    distinct world size in the ladder, plus the cross-GENERATION findings
+    (``HVP110`` world_dependent_signature / ``HVP112`` unbounded_repeat)
+    the per-world analysis cannot see."""
+
+    worlds: tuple                    # the resize ladder as given
+    reports: dict                    # world_size -> CheckReport
+    findings: list                   # cross-generation findings
+
+    @property
+    def ok(self):
+        return (not any(f.severity == ERROR for f in self.findings)
+                and all(r.ok for r in self.reports.values()))
+
+    def errors(self):
+        errs = [f for f in self.findings if f.severity == ERROR]
+        for w in sorted(self.reports):
+            errs += self.reports[w].errors()
+        return errs
+
+    def render(self):
+        lines = [f"check_elastic: ladder {'->'.join(map(str, self.worlds))}"]
+        for w in sorted(self.reports):
+            r = self.reports[w]
+            n_err = len(r.errors())
+            lines.append(f"  world {w}: {len(r.sequences[r.ranks[0]])} "
+                         f"collectives, "
+                         + ("clean" if r.ok else f"{n_err} error(s)"))
+        if not self.findings:
+            lines.append("  generations: streams are world-invariant — "
+                         "safe to resize across this ladder")
+        else:
+            lines.append(f"  generation findings: {len(self.findings)}")
+            for f in sort_findings(self.findings):
+                lines.append(f"    {f.render()}")
+        return "\n".join(lines)
+
+
+def _payload_consistent(ev_a, world_a, ev_b, world_b):
+    """True when two aligned events' payloads are explained by one
+    world-INDEPENDENT logical buffer: either each participant contributes
+    the same elements at both worlds (replicated payload, the
+    data-parallel gradient case), or the per-rank shares are an even
+    reshard of the same logical total — ``ceil(B/n)`` shards differ across
+    worlds by at most the ceil padding (plus a small relative slack for
+    block-aligned shards, e.g. the quantized wire's 1024 blocks)."""
+    pa, pb = ev_a.per_rank_elems(), ev_b.per_rank_elems()
+    if pa == pb:
+        return True
+    na = ev_a.group_size(world_a) or world_a
+    nb = ev_b.group_size(world_b) or world_b
+    ta, tb = na * pa, nb * pb
+    spread = abs(ta - tb)
+    return spread <= max(na, nb) or spread <= 0.02 * max(min(ta, tb), 1)
+
+
+def _diff_generations(base_world, base_events, world, events):
+    """Cross-generation stream diff (same rank, two world sizes): HVP110
+    when the collective stream is a function of world size — a resized
+    mesh would replay the step against mismatched peers."""
+    findings = []
+    all_ps = []
+    for e in list(base_events) + list(events):
+        if e.ps not in all_ps:
+            all_ps.append(e.ps)
+    for ps in all_ps:
+        a = [e for e in base_events if e.ps == ps]
+        b = [e for e in events if e.ps == ps]
+        if len(a) != len(b):
+            longer, lw = (a, base_world) if len(a) > len(b) else (b, world)
+            extra = longer[min(len(a), len(b))]
+            findings.append(Finding(
+                code="HVP110", severity=ERROR,
+                message=(f"world-dependent collective stream on {ps}: "
+                         f"{len(a)} event(s) at world {base_world} vs "
+                         f"{len(b)} at world {world} — first extra: "
+                         f"{extra.op} seq {extra.seq} (world {lw}); a "
+                         "world-size-gated collective desyncs the resized "
+                         "generation"),
+                op=extra.op, ps=ps, seq=extra.seq))
+            continue
+        for ea, eb in zip(a, b):
+            if ea.op != eb.op:
+                findings.append(Finding(
+                    code="HVP110", severity=ERROR,
+                    message=(f"world-dependent collective order on {ps} "
+                             f"at seq {ea.seq}: {ea.op} at world "
+                             f"{base_world} vs {eb.op} at world {world}"),
+                    op=eb.op, ps=ps, seq=eb.seq, sig=eb.sig))
+                break
+            if ea.dtypes != eb.dtypes:
+                findings.append(Finding(
+                    code="HVP110", severity=ERROR,
+                    message=(f"world-dependent signature: {ea.op} on {ps} "
+                             f"at seq {ea.seq} moves {ea.dtypes} at world "
+                             f"{base_world} but {eb.dtypes} at world "
+                             f"{world} — a resized mesh replays this "
+                             "collective against mismatched peers"),
+                    op=eb.op, ps=ps, seq=eb.seq, sig=eb.sig))
+                break
+            if ea.repeat != eb.repeat and ea.repeat > 0 and eb.repeat > 0:
+                findings.append(Finding(
+                    code="HVP110", severity=ERROR,
+                    message=(f"world-dependent repeat: {ea.op} on {ps} at "
+                             f"seq {ea.seq} runs x{ea.repeat} at world "
+                             f"{base_world} but x{eb.repeat} at world "
+                             f"{world} (a scan whose length tracks the "
+                             "world desyncs the resized generation)"),
+                    op=eb.op, ps=ps, seq=eb.seq, sig=eb.sig))
+                break
+            if not _payload_consistent(ea, base_world, eb, world):
+                findings.append(Finding(
+                    code="HVP110", severity=ERROR,
+                    message=(f"world-dependent signature: {ea.op} on {ps} "
+                             f"at seq {ea.seq} — per-rank payload "
+                             f"{ea.per_rank_elems()} elems at world "
+                             f"{base_world} vs {eb.per_rank_elems()} at "
+                             f"world {world}, not explained by an even "
+                             "reshard of one logical buffer (ZeRO-style "
+                             "ceil(B/n) shards and replicated payloads "
+                             "both pass); a resized mesh replays this "
+                             "collective against mismatched peers"),
+                    op=eb.op, ps=ps, seq=eb.seq, sig=eb.sig))
+                break
+    return findings
+
+
+def check_elastic(step_fn, args=(), kwargs=None, *, worlds=(8, 7, 4, 8),
+                  args_for=None, local_size=None, config=None,
+                  include_advisories=False, max_traced_ranks=16):
+    """Model-check a step program across an elastic resize ladder (the
+    shrink/grow set the chaos soaks exercise, e.g. ``8 -> 7 -> 4 -> 8``):
+    re-run the per-rank abstract eval at every distinct world size and
+    diff the collective streams *across generations*.
+
+    ``args_for(world_size)`` builds the generation's inputs — exactly what
+    the elastic driver does between generations (gather -> reshard -> new
+    mesh); return an args tuple or an ``(args, kwargs)`` pair. Without it
+    every generation traces the same ``args`` (correct for inputs whose
+    shapes don't track the world). Returns an :class:`ElasticReport`;
+    ``HVP110`` (error) marks any stream property that is a function of
+    world size, ``HVP112`` (advisory) marks while-loop collectives whose
+    presence-only diff makes the generation check a lower bound.
+    """
+    worlds = tuple(int(w) for w in worlds)
+    if len(worlds) < 2:
+        raise ValueError("check_elastic needs a ladder of >= 2 world "
+                         f"sizes, got {worlds}")
+    reports = {}
+    for w in worlds:
+        if w in reports:
+            continue
+        gen_args, gen_kwargs = args, kwargs
+        if args_for is not None:
+            built = args_for(w)
+            if (isinstance(built, tuple) and len(built) == 2
+                    and isinstance(built[0], tuple)
+                    and isinstance(built[1], dict)):
+                gen_args, gen_kwargs = built
+            else:
+                gen_args = built
+        reports[w] = check_program(
+            step_fn, gen_args, gen_kwargs, world_size=w,
+            local_size=local_size, config=config,
+            include_advisories=include_advisories,
+            max_traced_ranks=max_traced_ranks)
+    findings = []
+    base = worlds[0]
+    base_events = reports[base].sequences[reports[base].ranks[0]]
+    for w in dict.fromkeys(worlds[1:]):
+        if w == base:
+            continue
+        findings += _diff_generations(
+            base, base_events, w, reports[w].sequences[reports[w].ranks[0]])
+    seen_unbounded = set()
+    for e in base_events:
+        if e.repeat == 0 and (e.op, e.ps) not in seen_unbounded:
+            seen_unbounded.add((e.op, e.ps))
+            findings.append(Finding(
+                code="HVP112", severity=INFO,
+                message=(f"{e.op} on {e.ps} sits under a while loop with "
+                         "no static trip count: generations are diffed "
+                         "for its PRESENCE only, so the elastic check is "
+                         "a lower bound for this collective"),
+                op=e.op, ps=e.ps, seq=e.seq))
+    return ElasticReport(worlds=worlds, reports=reports,
+                         findings=sort_findings(findings))
 
 
 def cross_check(report, flight_events, rank=None, ps="global"):

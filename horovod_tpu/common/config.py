@@ -256,6 +256,13 @@ class Config:
     # path; off pending silicon sentinel evidence — ROADMAP item 4).
     flash_allow_padded: bool = False
 
+    # --- static cost model (horovod_tpu/analysis/cost.py) ---
+    # Per-step DCN byte budget for the static link-tier cost model: when
+    # > 0, `python -m horovod_tpu.analysis.cost` (and cost_report) raise
+    # HVP111 tier_budget_exceeded if the predicted cross-slice bytes of
+    # one step exceed it. 0 = no budget declared.
+    dcn_bytes_budget: int = 0
+
     # --- bench/progress plumbing (bench.py, chaos/soak.py) ---
     # JSONL progress stream consumed by the evidence sentinel ("" = off).
     bench_progress_file: str = ""
@@ -426,6 +433,8 @@ class Config:
         c.flash_block = _env_int("HVD_FLASH_BLOCK", c.flash_block)
         c.flash_allow_padded = _env_bool("HVD_FLASH_ALLOW_PADDED",
                                          c.flash_allow_padded)
+        c.dcn_bytes_budget = _env_int("HOROVOD_DCN_BYTES_BUDGET",
+                                      c.dcn_bytes_budget)
         c.bench_progress_file = os.environ.get("HVD_BENCH_PROGRESS_FILE",
                                                c.bench_progress_file)
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)

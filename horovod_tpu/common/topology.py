@@ -75,6 +75,34 @@ def _slice_id(d):
     return None
 
 
+def forced_slices():
+    """The ``HOROVOD_MESH_SLICES`` override, or 0 when unset (same
+    semantics as the mesh construction's own read)."""
+    from horovod_tpu.common.config import _env_int
+    return _env_int("HOROVOD_MESH_SLICES", 0)
+
+
+def slice_layout(size, num_slices=None):
+    """``(num_slices, slice_size)`` for a ``size``-rank world: the SAME
+    divisibility rules :func:`_build_dcn_mesh` applies when it builds the
+    real DCN mesh, so static consumers (the analysis cost model's tier
+    classifier) and the runtime hierarchy can never disagree. An explicit
+    ``num_slices`` wins over the forced env knob; an undivisible or <2
+    slice count collapses to the single-slice layout, exactly like the
+    mesh construction does."""
+    size = max(int(size), 1)
+    k = int(num_slices) if num_slices else forced_slices()
+    if k <= 1 or size % k != 0:
+        return 1, size
+    return k, size // k
+
+
+def slice_of_rank(rank, slice_size):
+    """Slice id of a rank under the rank-major (slice, chips-in-slice)
+    reshape — the layout :func:`_build_dcn_mesh` materializes."""
+    return int(rank) // max(int(slice_size), 1)
+
+
 def _build_dcn_mesh(devices, size):
     """(slice × chips-per-slice) mesh when the job spans multiple TPU
     slices — the factorization whose 'cross' axis is the DCN, which is what
@@ -85,13 +113,11 @@ def _build_dcn_mesh(devices, size):
     tier testing of the DCN path; also multi-slice setups whose devices
     don't expose slice ids).
     """
-    from horovod_tpu.common.config import _env_int
-    forced = _env_int("HOROVOD_MESH_SLICES", 0)
-    if forced:
-        k = forced
-        if k <= 1 or size % k != 0:
+    if forced_slices():
+        k, per = slice_layout(size)
+        if k <= 1:
             return 1, None
-        arr = np.array(devices, dtype=object).reshape(k, size // k)
+        arr = np.array(devices, dtype=object).reshape(k, per)
         return k, Mesh(arr, (CROSS_AXIS, LOCAL_AXIS))
     sids = [_slice_id(d) for d in devices]
     if any(s is None for s in sids):

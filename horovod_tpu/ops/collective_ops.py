@@ -1097,14 +1097,16 @@ def _eager_wire_for(ps, op, sig, wire_req):
     label = _wire.quantized_label(req)
     if label is None:
         return None, False
-    if ReduceOp(op) not in (ReduceOp.SUM, ReduceOp.AVERAGE):
-        return None, False
-    total = 0
-    for shape, dt in sig:
-        if not _is_float(dt):
-            return None, False
-        total += int(np.prod(shape[1:])) if len(shape) >= 1 else 0
-    if total < ps.size() * _wire.BLOCK:
+    # REAL floats only — _is_float admits complex (correct for Average
+    # validation), but the block quantizer's abs/round math silently
+    # drops the imaginary part; complex payloads keep the exact wire,
+    # matching the static cost model's float-only gate.
+    all_float = all(jnp.issubdtype(dt, jnp.floating) for _, dt in sig)
+    total = sum(int(np.prod(shape[1:])) if len(shape) >= 1 else 0
+                for shape, _ in sig)
+    if not _wire.quantized_eligible(
+            total, ps.size(), all_float,
+            ReduceOp(op) in (ReduceOp.SUM, ReduceOp.AVERAGE)):
         return None, False
     return label, bool(cfg.wire_error_feedback)
 

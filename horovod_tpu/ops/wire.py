@@ -411,15 +411,38 @@ def consume_wire_request():
 # Wire-byte accounting (the metrics registry's wire_bytes_total{dtype}).
 # ----------------------------------------------------------------------------
 
-def exchange_wire_bytes(per_rank_elems, n):
-    """Bytes on the wire for one block-scaled exchange over ``n`` ranks of
-    a ``per_rank_elems``-element buffer: both 1-byte legs plus the fp32
-    block scales, padding included (the exchange pads to n×BLOCK)."""
+def exchange_leg_bytes(per_rank_elems, n):
+    """Bytes on the wire for ONE leg of the block-scaled exchange over
+    ``n`` ranks of a ``per_rank_elems``-element buffer: the 1-byte payload
+    plus the fp32 block scales, padding included (the exchange pads to
+    n×BLOCK). Both legs move the same byte count, but over different
+    schedules — the first is an AllToAll, the second an AllGather — which
+    is why the analysis cost model splits them per leg when classifying
+    ICI vs DCN traffic."""
     per_rank_elems = int(per_rank_elems)
     n = max(int(n), 1)
     padded = -(-per_rank_elems // (n * BLOCK)) * n * BLOCK
     blocks = padded // BLOCK
-    return n * (2 * padded + 2 * blocks * 4)
+    return n * (padded + blocks * 4)
+
+
+def exchange_wire_bytes(per_rank_elems, n):
+    """Bytes on the wire for one block-scaled exchange over ``n`` ranks of
+    a ``per_rank_elems``-element buffer: both 1-byte legs plus the fp32
+    block scales, padding included (the exchange pads to n×BLOCK)."""
+    return 2 * exchange_leg_bytes(per_rank_elems, n)
+
+
+def quantized_eligible(total_per_rank_elems, n, all_float, sum_or_avg):
+    """THE quantized-wire eligibility predicate shared by the runtime
+    (``collective_ops._eager_wire_for``) and the static cost model
+    (``analysis/cost.py``), so the analyzer can never predict a wire the
+    dispatch layer would refuse: only float Sum/Average payloads of at
+    least one BLOCK per destination rank ride the exchange — below that
+    the n×BLOCK padding INFLATES the wire and the exact collective moves
+    fewer bytes."""
+    return bool(all_float and sum_or_avg
+                and int(total_per_rank_elems) >= max(int(n), 1) * BLOCK)
 
 
 def allreduce_wire_bytes(payload_bytes, itemsize, n, wire):

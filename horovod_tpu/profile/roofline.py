@@ -117,6 +117,30 @@ def mfu(flops_per_step, step_seconds, peaks=None):
     return (achieved / peak if peak > 0 else None), achieved
 
 
+def tier_time_estimate(bytes_by_tier, world_size, num_slices=1, peaks=None):
+    """Roofline LOWER-BOUND time for one step's per-link-tier byte totals
+    (the static cost model's ``bytes_by_tier``): the ICI total is spread
+    over the ``world_size`` chips against the per-chip ICI roof, the DCN
+    total over the ``num_slices`` cross-slice links against the DCN roof.
+    Returns ``{"ici_s", "dcn_s", "bound", "chip", "estimate"}`` — ``bound``
+    names the slower tier (the leg a hierarchical schedule must overlap or
+    quantize first). Chip peaks come from :func:`chip_peaks`, so the CPU
+    tier's placeholder roofs are flagged ``estimate``."""
+    peaks = peaks or chip_peaks()
+    world_size = max(int(world_size), 1)
+    num_slices = max(int(num_slices), 1)
+    ici = float(bytes_by_tier.get("ici", 0) or 0)
+    dcn = float(bytes_by_tier.get("dcn", 0) or 0)
+    ici_roof = (peaks.get("ici_gbs") or 0.0) * 1e9
+    dcn_roof = (peaks.get("dcn_gbs") or 0.0) * 1e9
+    t_ici = (ici / world_size / ici_roof) if ici_roof > 0 else None
+    t_dcn = (dcn / num_slices / dcn_roof) if dcn_roof > 0 else None
+    bound = "dcn" if (t_dcn or 0.0) >= (t_ici or 0.0) else "ici"
+    return {"ici_s": t_ici, "dcn_s": t_dcn, "bound": bound,
+            "chip": peaks.get("chip"),
+            "estimate": bool(peaks.get("estimate"))}
+
+
 def wire_utilization(bytes_on_wire, step_seconds, peaks=None,
                      cross_host=False):
     """Collective bytes/s against the interconnect roof (ICI within a

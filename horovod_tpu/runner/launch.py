@@ -382,7 +382,7 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_PEAK_TFLOPS", "HOROVOD_PEAK_HBM_GBS",
                 "HOROVOD_PEAK_ICI_GBS", "HOROVOD_PEAK_DCN_GBS",
                 "HVD_FLASH_BLOCK", "HVD_FLASH_ALLOW_PADDED",
-                "HVD_BENCH_PROGRESS_FILE",
+                "HVD_BENCH_PROGRESS_FILE", "HOROVOD_DCN_BYTES_BUDGET",
                 "HOROVOD_WIRE_DTYPE", "HOROVOD_WIRE_ERROR_FEEDBACK"):
         if os.environ.get(var):
             env.setdefault(var, os.environ[var])

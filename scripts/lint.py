@@ -7,12 +7,16 @@ repo's default scope, so CI and humans run the identical check:
     python scripts/lint.py            # lint the default scope
     python scripts/lint.py --format json
     python scripts/lint.py path/...   # lint specific paths instead
+    python scripts/lint.py --cost     # lint + the hvdcost CI gate
 
-Exit status 1 on any finding. The tier-1 gate
-(tests/test_analysis.py::TestSelfLint) runs this scope and asserts it
-stays clean and under the 30 s budget; suppress intentional violations
-inline with ``# hvdlint: disable=HVLxxx -- <reason>``
-(docs/static_analysis.md).
+Exit status 1 on any finding. ``--cost`` additionally runs
+``python -m horovod_tpu.analysis.cost`` (the static per-link-tier cost
+model + budget verdict, docs/static_analysis.md) after the lint, so ONE
+command runs both static gates; arguments after ``--cost-args`` are
+forwarded to it. The tier-1 gate (tests/test_analysis.py::TestSelfLint)
+runs this scope and asserts it stays clean and under the 30 s budget;
+suppress intentional violations inline with
+``# hvdlint: disable=HVLxxx -- <reason>`` (docs/static_analysis.md).
 """
 
 import os
@@ -27,6 +31,16 @@ def main(argv=None):
     from horovod_tpu.analysis.lint import main as lint_main
 
     argv = list(sys.argv[1:] if argv is None else argv)
+    run_cost = False
+    cost_argv = []
+    if "--cost-args" in argv:
+        i = argv.index("--cost-args")
+        cost_argv = argv[i + 1:]
+        argv = argv[:i]
+        run_cost = True
+    if "--cost" in argv:
+        argv.remove("--cost")
+        run_cost = True
     value_flags = {"--rules", "--format", "--config"}
     has_paths = False
     skip_next = False
@@ -41,7 +55,18 @@ def main(argv=None):
     if not has_paths:
         argv += [os.path.join(_REPO, p) for p in DEFAULT_SCOPE
                  if os.path.exists(os.path.join(_REPO, p))]
-    return lint_main(argv)
+    rc = lint_main(argv)
+    if run_cost:
+        from horovod_tpu.analysis.cost import main as cost_main
+        # Machine-readable lint output stays machine-readable: a JSON
+        # lint run forwards --json to the cost gate too, so stdout is a
+        # stream of JSON documents (jq -s / raw_decode), never JSON
+        # followed by human text.
+        if "--format" in argv and "json" in argv \
+                and "--json" not in cost_argv:
+            cost_argv = cost_argv + ["--json"]
+        rc = max(rc, cost_main(cost_argv))
+    return rc
 
 
 if __name__ == "__main__":

@@ -1,0 +1,140 @@
+#!/usr/bin/env python
+"""Reap leftover ``horovod_tpu.runner.task`` worker processes.
+
+Every timed-out tier-1 run on this box orphans its in-flight multi-process
+clusters: pytest dies under ``timeout -k``, the workers re-parent to init
+and keep polling their dead KV forever — and ten of them burning CPU skew
+every subsequent timing, perf baseline and bench number (ROADMAP re-anchor
+note @ PR 10). This script kills them:
+
+    python scripts/reap_workers.py              # orphans only (ppid 1)
+    python scripts/reap_workers.py --all        # any matching process
+    python scripts/reap_workers.py --dry-run    # list, don't kill
+
+``tests/conftest.py`` runs the orphans-only reap at session start, so a
+fresh tier-1 run never times itself against the corpses of the last one.
+Orphans-only is the safety line: a concurrently RUNNING suite's workers
+still have their live parent and are never touched. SIGTERM first (the
+workers' elastic teardown handles it), SIGKILL after a short grace.
+"""
+
+import argparse
+import os
+import sys
+import time
+
+MARKER = "horovod_tpu.runner.task"
+
+
+def _cmdline(pid):
+    try:
+        with open(f"/proc/{pid}/cmdline", "rb") as f:
+            return f.read().replace(b"\0", b" ").decode("utf-8", "replace")
+    except OSError:
+        return ""
+
+
+def _ppid(pid):
+    try:
+        with open(f"/proc/{pid}/stat") as f:
+            stat = f.read()
+        # field 4, after the parenthesized (possibly space-containing) comm
+        return int(stat.rpartition(")")[2].split()[1])
+    except (OSError, IndexError, ValueError):
+        return None
+
+
+def _ancestors():
+    """This process and its ancestry — never reap ourselves or the shell
+    that launched us."""
+    out = set()
+    pid = os.getpid()
+    while pid and pid > 1 and pid not in out:
+        out.add(pid)
+        pid = _ppid(pid)
+    return out
+
+
+def find_workers(pattern=MARKER, orphans_only=True):
+    """PIDs of matching worker processes. ``orphans_only`` keeps only
+    processes re-parented to init (ppid 1) — the timed-out-run corpses —
+    so live clusters of a concurrently running suite are never touched."""
+    if not os.path.isdir("/proc"):
+        return []                      # non-Linux: nothing to do
+    skip = _ancestors()
+    pids = []
+    for entry in os.listdir("/proc"):
+        if not entry.isdigit():
+            continue
+        pid = int(entry)
+        if pid in skip:
+            continue
+        if pattern not in _cmdline(pid):
+            continue
+        if orphans_only and _ppid(pid) != 1:
+            continue
+        pids.append(pid)
+    return sorted(pids)
+
+
+def reap(pattern=MARKER, orphans_only=True, grace_s=2.0, dry_run=False,
+         out=None):
+    """Kill matching workers (SIGTERM, then SIGKILL after ``grace_s``).
+    Returns the list of reaped PIDs."""
+    import signal
+
+    pids = find_workers(pattern, orphans_only=orphans_only)
+    if not pids:
+        return []
+    if out is not None:
+        kind = "orphaned" if orphans_only else "matching"
+        print(f"reap_workers: {len(pids)} {kind} '{pattern}' "
+              f"process(es): {pids}" + (" [dry-run]" if dry_run else ""),
+              file=out)
+    if dry_run:
+        return pids
+    for pid in pids:
+        try:
+            os.kill(pid, signal.SIGTERM)
+        except OSError:
+            pass
+    deadline = time.monotonic() + grace_s
+    remaining = set(pids)
+    while remaining and time.monotonic() < deadline:
+        for pid in list(remaining):
+            try:
+                os.kill(pid, 0)
+            except OSError:
+                remaining.discard(pid)
+        if remaining:
+            time.sleep(0.1)
+    for pid in remaining:
+        try:
+            os.kill(pid, signal.SIGKILL)
+        except OSError:
+            pass
+    return pids
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(
+        description="Kill leftover horovod_tpu.runner.task workers from "
+                    "prior timed-out runs (they skew every timing on the "
+                    "box).")
+    p.add_argument("--all", action="store_true",
+                   help="reap ANY matching process, not just orphans "
+                        "(ppid 1) — don't use while another suite runs")
+    p.add_argument("--pattern", default=MARKER,
+                   help=f"cmdline substring to match (default {MARKER!r})")
+    p.add_argument("--dry-run", action="store_true",
+                   help="list matching processes without killing")
+    args = p.parse_args(argv)
+    pids = reap(pattern=args.pattern, orphans_only=not args.all,
+                dry_run=args.dry_run, out=sys.stderr)
+    if not pids:
+        print("reap_workers: nothing to reap", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

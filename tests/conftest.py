@@ -54,7 +54,31 @@ import pytest  # noqa: E402
 _DEFAULT_TEST_TIMEOUT = float(os.environ.get("HVD_TEST_TIMEOUT", "300"))
 
 
+def _reap_orphaned_workers():
+    """Session-start hygiene: kill `horovod_tpu.runner.task` orphans left
+    by PRIOR timed-out runs (pytest dies under `timeout -k`, its worker
+    clusters re-parent to init and poll their dead KV forever — skewing
+    every timing, perf baseline and bench number on this 2-core box; see
+    the ROADMAP re-anchor note @ PR 10). Orphans-only (ppid 1), so a
+    concurrently running suite's live workers are never touched.
+    HVD_REAP_WORKERS=0 opts out."""
+    if os.environ.get("HVD_REAP_WORKERS", "1") != "1":
+        return
+    try:
+        import importlib.util
+        path = os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "scripts", "reap_workers.py")
+        spec = importlib.util.spec_from_file_location("_reap_workers", path)
+        mod = importlib.util.module_from_spec(spec)
+        spec.loader.exec_module(mod)
+        import sys
+        mod.reap(orphans_only=True, out=sys.stderr)
+    except Exception as e:  # noqa: BLE001 — hygiene must never fail tests
+        print(f"reap_workers skipped: {e}")
+
+
 def pytest_configure(config):
+    _reap_orphaned_workers()
     config.addinivalue_line(
         "markers", "timeout(seconds): per-test timeout override "
         "(default %ss, suite-wide env HVD_TEST_TIMEOUT)"

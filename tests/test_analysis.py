@@ -468,6 +468,596 @@ class TestMultiprocCrossCheck:
 
 
 # ---------------------------------------------------------------------------
+# hvdcost: the static per-link-tier cost model (analysis/cost.py)
+# ---------------------------------------------------------------------------
+
+
+class TestCostModel:
+    def test_tier_split_flat_allreduce(self, hvd):
+        """fp32 allreduce over the global set: total = 2x global bytes
+        (the runtime's RS+AG accounting), DCN share = S/n of each ring
+        leg; single-slice worlds put everything on ICI."""
+        from horovod_tpu.analysis import cost as an_cost
+
+        n = 8
+        x = np.ones((n, 64), np.float32)
+
+        def step(x):
+            return hvd.allreduce(x, op=hvd.Sum)
+
+        rep = hvd.check_program(step, (x,), world_size=n)
+        cr = an_cost.cost_report(rep, num_slices=2)
+        total = 2 * x.nbytes
+        row = cr.rows[0]
+        assert row.dtype == "float32"
+        assert row.total_bytes == total
+        assert row.dcn_bytes == int(round(total * 2 / n))
+        assert cr.bytes_by_tier["ici"] + cr.bytes_by_tier["dcn"] == total
+        # single slice: all ICI
+        cr1 = an_cost.cost_report(rep, num_slices=1)
+        assert cr1.bytes_by_tier == {"ici": total, "dcn": 0}
+        # non-divisible slice count collapses to single-slice (the mesh
+        # construction's own rule)
+        cr3 = an_cost.cost_report(rep, num_slices=3)
+        assert cr3.num_slices == 1 and cr3.bytes_by_tier["dcn"] == 0
+
+    def test_quantized_exchange_split_and_dtype_totals(self, hvd):
+        """int8 wire: bytes = the exchange's exact accounting (1-byte
+        legs + scales + padding); first leg priced as all-to-all
+        (1 - L/n cross), second as ring (S/n cross). Small fp32
+        collectives stay exact; per-dtype totals equal the tier sum."""
+        from horovod_tpu.analysis import cost as an_cost
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.ops import wire
+
+        n = 8
+        g = np.ones((n, 64 * 1024), np.float32)
+        s = np.ones((n, 8), np.float32)
+        m = np.ones((n, 8), np.float32)
+
+        def step(g, s, m):
+            a = hvd.allreduce(g, op=hvd.Sum)
+            b = hvd.allreduce(s)
+            c = hvd.allgather(m)
+            hvd.barrier()
+            return a, b, c
+
+        cfg = Config(wire_dtype="int8")
+        rep = hvd.check_program(step, (g, s, m), world_size=n, config=cfg)
+        cr = an_cost.cost_report(rep, config=cfg, num_slices=2)
+        leg = wire.exchange_leg_bytes(64 * 1024, n)
+        assert cr.bytes_by_dtype["int8"] == 2 * leg \
+            == wire.exchange_wire_bytes(64 * 1024, n)
+        q = [r for r in cr.rows if r.dtype == "int8"][0]
+        # a2a leg: 1 - 4/8 = 0.5 cross; ring leg: 2/8 = 0.25 cross
+        assert q.dcn_bytes == int(round(leg * 0.5)) + int(round(leg * 0.25))
+        assert cr.bytes_by_dtype["float32"] == 2 * s.nbytes + m.nbytes
+        assert sum(cr.bytes_by_tier.values()) \
+            == sum(cr.bytes_by_dtype.values())
+        # the hierarchical what-if moves the allreduce's DCN below flat
+        assert cr.hierarchical["dcn"] < cr.bytes_by_tier["dcn"]
+        assert cr.time_estimate["bound"] in ("ici", "dcn")
+
+    def test_runtime_refused_wires_stay_exact(self, hvd):
+        """The static eligibility gate mirrors the dispatch layer: a Min
+        reduction and a sub-block payload keep the exact fp32 wire even
+        with int8 configured (wire.quantized_eligible is THE shared
+        predicate)."""
+        from horovod_tpu.analysis import cost as an_cost
+        from horovod_tpu.common.config import Config
+
+        n = 8
+        big = np.ones((n, 64 * 1024), np.float32)
+        tiny = np.ones((n, 16), np.float32)
+
+        def step(big, tiny):
+            a = hvd.allreduce(big, op=hvd.Min)      # non-Sum/Average
+            b = hvd.allreduce(tiny, op=hvd.Sum)     # < 1 block/rank
+            return a, b
+
+        cfg = Config(wire_dtype="int8")
+        rep = hvd.check_program(step, (big, tiny), world_size=n,
+                                config=cfg)
+        cr = an_cost.cost_report(rep, config=cfg, num_slices=2)
+        assert "int8" not in cr.bytes_by_dtype
+        assert cr.bytes_by_dtype["float32"] \
+            == 2 * big.nbytes + 2 * tiny.nbytes
+
+    def test_use_registry_false_ignores_wire_pins(self, hvd):
+        """Counterfactual pricing: an explicit hvd.set_wire_dtype pin
+        steers the default cost model (it steers the runtime), but
+        use_registry=False prices against the given config alone — the
+        bench's static_cost record regression (a leftover '' pin from
+        the sweep silently priced the int8 leg as fp32)."""
+        from horovod_tpu.analysis import cost as an_cost
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.ops import wire
+
+        n = 8
+        x = np.ones((n, 64 * 1024), np.float32)
+
+        def step(x):
+            return hvd.allreduce(x, op=hvd.Sum)
+
+        cfg = Config(wire_dtype="int8")
+        rep = hvd.check_program(step, (x,), world_size=n, config=cfg)
+        hvd.set_wire_dtype("")           # user pin: full precision
+        try:
+            pinned = an_cost.cost_report(rep, config=cfg, num_slices=1)
+            counterfactual = an_cost.cost_report(
+                rep, config=cfg, num_slices=1, use_registry=False)
+        finally:
+            wire.clear_wire_registry()
+        assert "int8" not in pinned.bytes_by_dtype          # pin wins
+        assert "int8" in counterfactual.bytes_by_dtype      # config wins
+
+    def test_jit_axis_tier_classification(self, hvd):
+        """A psum over the DCN mesh's `cross` axis is pure DCN; over
+        `local` pure ICI; a world-spanning axis mixes at S/n."""
+        from horovod_tpu.analysis import cost as an_cost
+
+        devs = np.array(jax.devices()[:8]).reshape(2, 4)
+        mesh = Mesh(devs, ("cross", "local"))
+        x = np.ones((8, 16), np.float32)
+
+        def cross_step(x):
+            def inner(xl):
+                return lax.psum(xl, "cross")
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("cross"), out_specs=P(),
+                check_vma=False))(x)
+
+        def local_step(x):
+            def inner(xl):
+                return lax.psum(xl, "local")
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P(None, "local"),
+                out_specs=P(None), check_vma=False))(x)
+
+        repc = hvd.check_program(cross_step, (x,), world_size=8)
+        crc = an_cost.cost_report(repc, num_slices=2)
+        assert crc.bytes_by_tier["ici"] == 0
+        assert crc.bytes_by_tier["dcn"] > 0
+        assert crc.jit_bytes_by_dtype and not crc.bytes_by_dtype
+
+        repl = hvd.check_program(local_step, (x,), world_size=8)
+        crl = an_cost.cost_report(repl, num_slices=2)
+        assert crl.bytes_by_tier["dcn"] == 0
+        assert crl.bytes_by_tier["ici"] > 0
+
+    def test_dcn_budget_hvp111(self, hvd):
+        from horovod_tpu.analysis import cost as an_cost
+
+        n = 8
+        x = np.ones((n, 64 * 1024), np.float32)
+
+        def step(x):
+            return hvd.allreduce(x, op=hvd.Sum)
+
+        rep = hvd.check_program(step, (x,), world_size=n)
+        cr = an_cost.cost_report(rep, num_slices=2, dcn_budget_bytes=100)
+        assert not cr.ok
+        hit = [f for f in cr.findings if f.code == "HVP111"]
+        assert hit and hit[0].severity == "error"
+        assert "EXCEEDED" in cr.render()
+        ok = an_cost.cost_report(rep, num_slices=2,
+                                 dcn_budget_bytes=10**12)
+        assert ok.ok and "OK" in ok.render()
+
+
+class TestUnboundedRepeatCost:
+    def test_hvp112_and_lower_bound_totals(self, hvd):
+        """Satellite: a while-wrapped psum must raise HVP112 and flag the
+        cost totals as LOWER BOUNDS (counted once), not exact."""
+        from horovod_tpu.analysis import cost as an_cost
+        from horovod_tpu.ops.in_jit import mark_varying
+
+        mesh = Mesh(np.array(jax.devices()[:4]), ("hvd",))
+        x = np.ones((4, 8), np.float32)
+
+        def step(x):
+            def inner(xl):
+                def cond(c):
+                    return jnp.sum(c[1]) < 100.0
+
+                def body(c):
+                    i, v = c
+                    return i + 1, lax.psum(v, "hvd") * 0 \
+                        + mark_varying(v, "hvd") + 1.0
+                _, out = lax.while_loop(
+                    cond, body,
+                    (jnp.zeros((), jnp.int32), mark_varying(xl, "hvd")))
+                return out
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"),
+                out_specs=P("hvd"), check_vma=False))(x)
+
+        rep = hvd.check_program(step, (x,), world_size=4)
+        cr = an_cost.cost_report(rep, num_slices=2)
+        hits = [f for f in cr.findings if f.code == "HVP112"]
+        assert hits and hits[0].severity == "info" and cr.ok
+        assert not cr.exact
+        assert "lower bound" in cr.render()
+        # the while-body psum is priced exactly once
+        loops = [r for r in cr.rows if r.repeat == 0]
+        assert loops and loops[0].total_bytes == loops[0].wire_bytes
+        # the elastic checker marks the same limitation
+        er = hvd.check_elastic(step, (x,), worlds=(4, 2))
+        assert any(f.code == "HVP112" for f in er.findings)
+        assert er.ok     # advisory only
+
+
+class TestCrossCheckBytes:
+    def test_fused_quantized_step_within_5pct(self, hvd):
+        """Acceptance: the static bytes_by_tier prediction for a
+        representative fused+quantized step matches the runtime
+        wire_bytes_total{dtype} counters within 5% (exact in practice) on
+        a live 8-virtual-rank run."""
+        from horovod_tpu.analysis import cost as an_cost
+        from horovod_tpu.ops import fusion, wire
+
+        n = hvd.size()
+        g = np.ones((n, 32 * 1024), np.float32)   # quantized-eligible
+        s = np.ones((n, 16), np.float32)
+
+        # `sync` materializes the fused result BEFORE the next collective
+        # at runtime (np.asarray) — the cycle-thread flush executing
+        # concurrently with a later eager program deadlocks the
+        # in-process CPU rendezvous (the cross-program flavor of the
+        # conftest XLA_FLAGS note). Under check_program it stays the
+        # identity: the traced step must not materialize tracers.
+        def step(g, s, sync=lambda x: x):
+            h = hvd.allreduce_async(g, op=hvd.Sum, name="fused_q")
+            fused = sync(hvd.synchronize(h))
+            a = hvd.allreduce(g, op=hvd.Sum, name="eager_q")
+            b = hvd.allgather(s)
+            return fused, a, b
+
+        rt = fusion.get_runtime()
+        prev_rt = rt.wire_dtype
+        hvd.set_wire_dtype("int8")
+        rt.wire_dtype = jnp.int8
+        try:
+            step(g, s, sync=np.asarray)    # warm: compiles + plans
+            base = hvd.metrics_snapshot()
+            iters = 3
+            for _ in range(iters):
+                step(g, s, sync=np.asarray)
+            after = hvd.metrics_snapshot()
+            rep = hvd.check_program(step, (g, s), world_size=n)
+            cost = an_cost.cost_report(rep, num_slices=2)
+            res = an_cost.cross_check_bytes(cost, after, base, steps=iters)
+        finally:
+            rt.wire_dtype = prev_rt
+            wire.clear_wire_registry()
+            wire.reset_error_feedback()
+        assert set(cost.bytes_by_dtype) == {"int8", "float32"}
+        assert res["match"], res
+        for d in res["per_dtype"].values():
+            assert abs(d["delta"]) <= 0.05 * max(d["predicted"], 1.0), res
+        assert cost.bytes_by_tier["ici"] > 0
+        assert cost.bytes_by_tier["dcn"] > 0
+
+
+def _cost_xcheck_job():
+    """Worker side of the multi-process cost cross-check: run the
+    fused+quantized step for real under HOROVOD_MESH_SLICES=2 and return
+    the wire counter snapshots around a measured window."""
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import wire
+
+    n = hvd.size()
+    nl = len(hvd.topology().local_device_ranks)
+    g = np.ones((nl, 32 * 1024), np.float32)
+    s = np.ones((nl, 16), np.float32)
+    hvd.set_wire_dtype("int8")
+
+    def step():
+        a = hvd.allreduce(g, op=hvd.Sum)
+        b = hvd.allgather(s)
+        return a, b
+
+    try:
+        step()
+        base = hvd.metrics_snapshot()
+        iters = 3
+        for _ in range(iters):
+            step()
+        after = hvd.metrics_snapshot()
+    finally:
+        wire.clear_wire_registry()
+        wire.reset_error_feedback()
+    slices = hvd.topology().num_slices
+    return (hvd.cross_rank(), n, slices, iters, base, after)
+
+
+class TestMultiprocCostCrossCheck:
+    @pytest.mark.slow
+    def test_static_prediction_matches_cluster_counters(
+            self, hvd, shared_cluster):
+        """Acceptance: CPU-tier MULTI-PROCESS run with
+        HOROVOD_MESH_SLICES=2 — every worker's measured
+        wire_bytes_total{dtype} deltas match the static per-dtype
+        prediction within 5%."""
+        from horovod_tpu.analysis import cost as an_cost
+
+        # HOROVOD_MESH_SLICES both forces the DCN hierarchy under test
+        # and keys this cluster separately from the other cross-check's.
+        results = shared_cluster(
+            "localhost:1,127.0.0.1:1",
+            extra_env={"HOROVOD_MESH_SLICES": "2"}).run(_cost_xcheck_job)
+        assert len(results) == 2
+        world = results[0][1]
+        assert results[0][2] == 2          # the forced DCN hierarchy took
+        nl = world // 2
+        g = np.ones((nl, 32 * 1024), np.float32)
+        s = np.ones((nl, 16), np.float32)
+
+        def step(g, s):
+            a = hvd.allreduce(g, op=hvd.Sum)
+            b = hvd.allgather(s)
+            return a, b
+
+        from horovod_tpu.common.config import Config
+        cfg = Config(wire_dtype="int8")
+        rep = hvd.check_program(step, (g, s), world_size=world, config=cfg)
+        cost = an_cost.cost_report(rep, config=cfg, num_slices=2)
+        assert cost.bytes_by_tier["dcn"] > 0
+        for _, _, _, iters, base, after in results:
+            res = an_cost.cross_check_bytes(cost, after, base, steps=iters)
+            assert res["match"], res
+
+
+# ---------------------------------------------------------------------------
+# Elastic world-transition model checker (check_elastic, HVP110)
+# ---------------------------------------------------------------------------
+
+
+class TestElasticChecker:
+    def test_zero_reshard_scenario_passes_clean(self, hvd):
+        """The known-good elastic step: ZeRO-1 state resharded per
+        generation (the tests/test_elastic_reshard.py scenario — per-rank
+        moment shards are ceil(B/n), grads replicated) stays stream-
+        coherent across the chaos soaks' shrink/grow ladder."""
+        logical = 12 + 5                  # the reshard test's param count
+
+        def step(moment_shard, grads):
+            g = hvd.allreduce(grads, op=hvd.Sum)
+            full = hvd.allgather(moment_shard)
+            return g, full
+
+        def args_for(w):
+            shard = (logical + (-logical) % w) // w
+            return (np.zeros((w, shard), np.float32),
+                    np.zeros((w, logical), np.float32))
+
+        rep = hvd.check_elastic(step, worlds=(8, 7, 4, 8),
+                                args_for=args_for)
+        assert rep.ok, rep.render()
+        assert not rep.findings
+        assert set(rep.reports) == {8, 7, 4}
+        assert "safe to resize" in rep.render()
+
+    def test_world_gated_collective_hvp110(self, hvd):
+        """Known-bad corpus: a collective only dispatched at some world
+        sizes — the resized generation replays against mismatched
+        peers."""
+        def step(x):
+            a = hvd.allreduce(x, op=hvd.Sum)
+            if hvd.size() >= 8:
+                a = a + hvd.allreduce(x * 2, op=hvd.Sum)
+            return a
+
+        rep = hvd.check_elastic(
+            step, worlds=(8, 7, 4, 8),
+            args_for=lambda w: (np.zeros((w, 128), np.float32),))
+        assert not rep.ok
+        hits = [f for f in rep.findings if f.code == "HVP110"]
+        assert hits and hits[0].severity == "error"
+        assert "world" in hits[0].message
+
+    def test_world_dependent_payload_hvp110(self, hvd):
+        """Known-bad corpus: a per-rank payload that tracks world size
+        without being an even reshard of one logical buffer (seeded
+        world-size-dependent signature)."""
+        def step(x):
+            return hvd.allreduce(x, op=hvd.Sum)
+
+        rep = hvd.check_elastic(
+            step, worlds=(8, 4),
+            args_for=lambda w: (np.zeros((w, w * 16), np.float32),))
+        assert not rep.ok
+        assert any(f.code == "HVP110" and "signature" in f.message
+                   for f in rep.findings)
+
+    def test_world_dependent_dtype_hvp110(self, hvd):
+        def step(x):
+            y = x.astype(jnp.bfloat16) if hvd.size() > 4 else x
+            return hvd.allreduce(y, op=hvd.Sum)
+
+        rep = hvd.check_elastic(
+            step, worlds=(8, 4),
+            args_for=lambda w: (np.zeros((w, 256), np.float32),))
+        assert not rep.ok
+        assert any(f.code == "HVP110" and "moves" in f.message
+                   for f in rep.findings)
+
+    def test_per_world_errors_propagate(self, hvd):
+        """A rank-gated collective (HVP101) at any single generation
+        makes the elastic report not-ok even when the generations agree
+        with each other."""
+        def step(x):
+            if hvd.rank() == 0:
+                hvd.barrier()
+            return hvd.allreduce(x)
+
+        rep = hvd.check_elastic(
+            step, worlds=(4, 2),
+            args_for=lambda w: (np.zeros((w, 8), np.float32),))
+        assert not rep.ok
+        assert any(f.code == "HVP101" for f in rep.errors())
+
+
+class TestSamplingMidRank:
+    def test_mid_neighbor_rank_gate_caught(self, hvd):
+        """Satellite: worlds >16 sample boundary ranks only — a
+        collective gated on size//2 + 1 escaped HVP101 before the mid
+        neighborhood (mid-1, mid, mid+1) joined the sampled set."""
+        x = np.ones((4, 8), np.float32)
+
+        def step(x):
+            if hvd.rank() == hvd.size() // 2 + 1:
+                hvd.barrier()        # mid+1-only: must still be caught
+            return hvd.allreduce(x)
+
+        rep = hvd.check_program(step, (x,), world_size=1024)
+        assert rep.sampled
+        assert not rep.ok
+        assert any(f.code == "HVP101" for f in rep.findings)
+        mid = 1024 // 2
+        assert {mid - 1, mid, mid + 1} <= set(rep.ranks)
+
+
+# ---------------------------------------------------------------------------
+# The cost CLI / CI gate (python -m horovod_tpu.analysis.cost)
+# ---------------------------------------------------------------------------
+
+
+class TestCostCLI:
+    def _run(self, *extra):
+        import subprocess
+
+        env = dict(os.environ, PYTHONPATH=_REPO)
+        return subprocess.run(
+            [sys.executable, "-m", "horovod_tpu.analysis.cost",
+             "--world", "8", "--slices", "2", "--wire", "int8",
+             "--payload-kb", "256", *extra],
+            capture_output=True, text=True, env=env, cwd=_REPO)
+
+    def test_clean_run_exits_zero_within_budget(self):
+        t0 = time.monotonic()
+        r = self._run("--elastic", "8,7,4,8")
+        dt = time.monotonic() - t0
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "bytes_by_tier" in r.stdout
+        assert "hvdcost: OK" in r.stdout
+        assert "safe to resize" in r.stdout
+        assert dt < 30.0, f"cost CLI took {dt:.1f}s (budget 30s)"
+
+    def test_budget_violation_exits_one(self):
+        r = self._run("--dcn-budget", "1000")
+        assert r.returncode == 1, r.stdout + r.stderr
+        assert "HVP111" in r.stdout
+
+    def test_json_output_parses(self):
+        import json as _json
+
+        r = self._run("--json")
+        assert r.returncode == 0, r.stdout + r.stderr
+        out = _json.loads(r.stdout)
+        assert out["cost"]["bytes_by_tier"]["dcn"] > 0
+        assert out["cost"]["ok"] and out["check"]["ok"]
+
+    def test_lint_cost_mode_runs_both_gates(self):
+        """scripts/lint.py --cost: one command, both static gates."""
+        import subprocess
+
+        env = dict(os.environ, PYTHONPATH=_REPO)
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "scripts", "lint.py"),
+             "--cost", "--cost-args", "--world", "4", "--payload-kb",
+             "64"],
+            capture_output=True, text=True, env=env, cwd=_REPO)
+        assert r.returncode == 0, r.stdout + r.stderr
+        assert "hvdcost: OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# Orphan reaper (scripts/reap_workers.py + the conftest session hook)
+# ---------------------------------------------------------------------------
+
+
+def _load_reaper():
+    import importlib.util
+
+    path = os.path.join(_REPO, "scripts", "reap_workers.py")
+    spec = importlib.util.spec_from_file_location("_reap_test_mod", path)
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+class TestReapWorkers:
+    def test_finds_and_kills_matching_process(self):
+        """A decoy process carrying the marker in its argv is found by
+        pattern, skipped by the orphans-only default (its parent — us —
+        is alive), and killed by the explicit reap."""
+        import subprocess
+
+        reaper = _load_reaper()
+        marker = "hvd_reap_selftest_marker"
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(120)",
+             marker],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if proc.pid in reaper.find_workers(marker,
+                                                   orphans_only=False):
+                    break
+                time.sleep(0.05)
+            assert proc.pid in reaper.find_workers(marker,
+                                                   orphans_only=False)
+            # alive parent -> NOT an orphan -> the session-start default
+            # must never touch it
+            assert proc.pid not in reaper.find_workers(marker,
+                                                       orphans_only=True)
+            reaped = reaper.reap(pattern=marker, orphans_only=False,
+                                 grace_s=3.0)
+            assert proc.pid in reaped
+            assert proc.wait(timeout=10) is not None
+        finally:
+            if proc.poll() is None:
+                proc.kill()
+                proc.wait(timeout=10)
+
+    def test_dry_run_kills_nothing(self):
+        import subprocess
+
+        reaper = _load_reaper()
+        marker = "hvd_reap_selftest_dry"
+        proc = subprocess.Popen(
+            [sys.executable, "-c", "import time; time.sleep(120)",
+             marker],
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        try:
+            deadline = time.monotonic() + 10
+            while time.monotonic() < deadline:
+                if proc.pid in reaper.find_workers(marker,
+                                                   orphans_only=False):
+                    break
+                time.sleep(0.05)
+            listed = reaper.reap(pattern=marker, orphans_only=False,
+                                 dry_run=True)
+            assert proc.pid in listed
+            assert proc.poll() is None       # still alive
+        finally:
+            proc.kill()
+            proc.wait(timeout=10)
+
+    def test_never_reaps_itself(self):
+        reaper = _load_reaper()
+        # our own cmdline contains whatever pytest was invoked with; use
+        # a pattern guaranteed to match this process
+        import os as _os
+        assert _os.getpid() not in reaper.find_workers(
+            "python", orphans_only=False)
+
+
+# ---------------------------------------------------------------------------
 # AST lint corpus: each rule class, positive + negative
 # ---------------------------------------------------------------------------
 

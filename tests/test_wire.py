@@ -398,6 +398,25 @@ class TestConvergenceParity:
 
 
 class TestReviewRegressions:
+    def test_complex_payload_keeps_exact_wire(self, hvd, clean_wire):
+        """_is_float admits complexfloating (needed for Average
+        validation), but the block quantizer's abs/round math drops the
+        imaginary part — a complex Sum allreduce big enough to qualify
+        must REFUSE the quantized wire and stay exact (the static cost
+        model already prices it as exact; PR-11 review reproduction:
+        expected (1+2j), got (1+0j))."""
+        n = hvd.size()
+        x = jnp.full((n, n * wire.BLOCK), 1.0 + 2.0j, jnp.complex64)
+        key = (("dtype", "int8"), ("path", "eager"))
+        before = _wire_events(hvd).get(key, 0)
+        hvd.set_wire_dtype("int8")
+        try:
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        finally:
+            hvd.set_wire_dtype("")
+        np.testing.assert_allclose(out[0], n * (1.0 + 2.0j), rtol=1e-6)
+        assert _wire_events(hvd).get(key, 0) == before
+
     def test_bf16_bucket_rides_the_fused_exchange(self, hvd, clean_wire):
         """ml_dtypes bfloat16 is not np.floating — the fused eligibility
         check must use jnp.issubdtype or the COMMON bf16-training case
