@@ -102,46 +102,62 @@ def _member_ranks(event, world_size, num_slices, slice_size):
     return list(range(min(int(n), world_size)))
 
 
-def _ring_dcn_fraction(members, slice_size):
-    """Fraction of a rank-ordered ring's hops that cross a slice boundary
-    (wraparound included): ``S/n`` for the world-spanning global set."""
-    m = len(members)
-    if m <= 1:
-        return 0.0
-    crossings = sum(
-        slice_of_rank(members[i], slice_size)
-        != slice_of_rank(members[(i + 1) % m], slice_size)
-        for i in range(m))
-    return crossings / m
+# The ring / all-to-all slice-boundary fractions live in the WIRE tier now
+# (one definition for this static classifier AND the runtime counters'
+# default split — metrics.record_wire); re-exported here for the existing
+# call sites.
+_ring_dcn_fraction = _wire.ring_dcn_fraction
+_a2a_dcn_fraction = _wire.a2a_dcn_fraction
 
 
-def _a2a_dcn_fraction(members, slice_size):
-    """Fraction of all-to-all destination rows that land in a foreign
-    slice: ``1 - slice_size/n`` for the world-spanning global set."""
-    m = len(members)
-    if m <= 1:
-        return 0.0
-    counts = {}
-    for r in members:
-        s = slice_of_rank(r, slice_size)
-        counts[s] = counts.get(s, 0) + 1
-    same = sum(c * c for c in counts.values())
-    return (m * m - same) / (m * m)
+def _hier_for_event(event, config, num_slices, use_registry=True):
+    """Mirror of the runtime's hierarchical-dispatch verdict
+    (``collective_ops._eager_hier_for``) for one predicted eager/fused
+    allreduce: the effective cross-leg wire string when the dispatch
+    layer would decompose this event (local RS -> cross-slice -> local
+    AG), else None for the flat path. Shares the strategy registry /
+    ``HOROVOD_HIERARCHICAL_DISPATCH`` chain and the float-Sum/Average
+    single-dtype gates, so the analyzer can never predict a schedule the
+    dispatch layer would refuse."""
+    if event.op != "allreduce" or event.origin == "jit" \
+            or event.ps != "global" or num_slices <= 1:
+        return None
+    default = "hier_qcross" \
+        if getattr(config, "hierarchical_dispatch", False) else ""
+    strategy = _wire.dispatch_strategy_for(event.ps, default) \
+        if use_registry else default
+    if strategy not in ("hier", "hier_qcross"):
+        return None
+    if event.red_op not in (None, "Sum", "Average"):
+        return None
+    dtypes = set(event.dtypes)
+    if len(dtypes) != 1 or not all(_is_float_name(d) for d in dtypes):
+        return None
+    if strategy != "hier_qcross":
+        return ""
+    if use_registry:
+        return _wire.cross_wire_for(event.ps, config)
+    return _wire.resolve_wire_dtype(
+        getattr(config, "wire_dtype_dcn", "")
+        or getattr(config, "wire_dtype", ""))
 
 
-def _event_legs(event, world_size, config, use_registry=True):
-    """``(wire_label, legs)`` for one predicted event, where ``legs`` is a
-    list of ``(bytes, schedule)`` with schedule in ``{"ring", "a2a"}`` —
-    the SAME byte totals the runtime's ``wire_bytes_total{dtype}`` counter
-    would accumulate for this dispatch (``_timeline_op`` /
-    ``_DispatchPlan`` / the fused flush), split per transfer leg so the
-    tier classifier can price each leg's schedule separately.
-    ``use_registry=False`` prices against ``config.wire_dtype`` alone
-    (counterfactual "as if the wire were X" pricing), ignoring any live
-    per-process-set registry entry. Returns ``(None, [])`` for zero-byte
-    events (barrier)."""
+def _event_legs(event, world_size, config, use_registry=True,
+                num_slices=1):
+    """Transfer legs for one predicted event: a list of ``(bytes,
+    schedule, dtype_label)`` with schedule in ``{"ring", "a2a", "ici",
+    "dcn"}`` — the SAME byte totals the runtime's
+    ``wire_bytes_total{dtype,tier}`` counter would accumulate for this
+    dispatch (``_timeline_op`` / ``_DispatchPlan`` / the fused flush),
+    split per leg so the tier classifier can price each leg's schedule
+    separately. ``ici``/``dcn`` legs are tier-EXPLICIT (the hierarchical
+    decomposition's local and cross legs — no fraction applied);
+    ``ring``/``a2a`` legs are classified by the slice-boundary fractions.
+    ``use_registry=False`` prices against the config knobs alone
+    (counterfactual "as if" pricing), ignoring live registry entries.
+    Returns ``[]`` for zero-byte events (barrier)."""
     if event.op == "barrier" or not event.shapes:
-        return None, []
+        return []
     dtypes = event.dtypes
     width = jaxpr_walk.dtype_width(dtypes[0]) if dtypes else 4
     if event.origin == "jit":
@@ -150,33 +166,54 @@ def _event_legs(event, world_size, config, use_registry=True):
         if event.op in ("psum", "pmin", "pmax"):
             # participants x payload x both internal legs — the global-
             # payload convention the eager allreduce accounting uses.
-            return str(dtypes[0]), [(2 * p * e * width, "ring")]
+            return [(2 * p * e * width, "ring", str(dtypes[0]))]
         sched = "a2a" if event.op in _A2A_OPS else "ring"
-        return str(dtypes[0]), [(p * e * width, sched)]
+        return [(p * e * width, sched, str(dtypes[0]))]
     n = int(event.group_size(world_size) or world_size)
     if event.op == "allreduce":
         flat_len = event.per_rank_elems()
         cfg_wire = getattr(config, "wire_dtype", "")
         req = _wire.wire_dtype_for(event.ps, cfg_wire) if use_registry \
             else _wire.resolve_wire_dtype(cfg_wire)
-        quant = _wire.quantized_label(req)
         all_float = all(_is_float_name(d) for d in dtypes)
+        hier_cross = _hier_for_event(event, config, num_slices,
+                                     use_registry)
+        if hier_cross is not None:
+            # The hierarchical dispatch tier: local RS + AG at the
+            # payload dtype (explicit ici), the cross-slice allreduce at
+            # the per-tier wire (explicit dcn) — the same
+            # wire.hierarchical_wire_bytes integers the runtime records,
+            # which is what makes cross_check_bytes exact. One fused
+            # wrinkle mirrored from _fused_program's cast_wire: a 16-bit
+            # cast wire applies to the EXACT-cross strategy ("torus") —
+            # every leg then moves the cast dtype — while torus_qcross
+            # keeps the payload dtype on its ICI legs by design.
+            label, w = str(dtypes[0]), width
+            if event.origin == "fused" and not hier_cross and all_float \
+                    and req in ("float16", "bfloat16"):
+                label, w = req, 2
+            h = _wire.hierarchical_wire_bytes(flat_len, n, num_slices,
+                                              w,
+                                              cross_wire=hier_cross)
+            return [(h["ici"], "ici", label),
+                    (h["dcn"], "dcn", h["cross_label"] or label)]
+        quant = _wire.quantized_label(req)
         sum_avg = event.red_op in (None, "Sum", "Average")
         if quant and _wire.quantized_eligible(flat_len, n, all_float,
                                               sum_avg):
             leg = _wire.exchange_leg_bytes(flat_len, n)
             # First leg: AllToAll of the 1-byte shards (+ scales);
             # second: AllGather of the reduced shards (+ scales).
-            return quant, [(leg, "a2a"), (leg, "ring")]
+            return [(leg, "a2a", quant), (leg, "ring", quant)]
         if event.origin == "fused" and req in ("float16", "bfloat16") \
                 and all_float:
             # The fusion runtime casts float buckets to the 16-bit wire;
             # sync eager dispatches never cast (they record the payload
             # dtype), matching the runtime's accounting exactly.
-            return req, [(2 * n * flat_len * 2, "ring")]
-        return str(dtypes[0]), [(2 * event.nbytes, "ring")]
+            return [(2 * n * flat_len * 2, "ring", req)]
+        return [(2 * event.nbytes, "ring", str(dtypes[0]))]
     sched = "a2a" if event.op in _A2A_OPS else "ring"
-    return str(dtypes[0]), [(event.nbytes, sched)]
+    return [(event.nbytes, sched, str(dtypes[0]))]
 
 
 @dataclasses.dataclass
@@ -226,6 +263,10 @@ class CostReport:
     findings: list
     exact: bool                    # False when any repeat is unbounded
     dcn_budget_bytes: int = 0
+    # Runtime-metered (eager+fused) subset of bytes_by_tier — what the
+    # live wire_bytes_total{tier} counters accumulate per step; the
+    # per-tier side of cross_check_bytes diffs against THIS.
+    runtime_bytes_by_tier: dict = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self):
@@ -240,6 +281,7 @@ class CostReport:
             "num_slices": self.num_slices,
             "slice_size": self.slice_size,
             "bytes_by_tier": dict(self.bytes_by_tier),
+            "runtime_bytes_by_tier": dict(self.runtime_bytes_by_tier),
             "bytes_by_dtype": dict(self.bytes_by_dtype),
             "jit_bytes_by_dtype": dict(self.jit_bytes_by_dtype),
             "hierarchical": dict(self.hierarchical),
@@ -264,6 +306,12 @@ class CostReport:
             lines.append(f"    ... {len(self.rows) - 32} more")
         lines.append(f"  bytes_by_tier: ici={self.bytes_by_tier['ici']} "
                      f"dcn={self.bytes_by_tier['dcn']}{bound}")
+        if self.runtime_bytes_by_tier:
+            lines.append(
+                "  runtime bytes_by_tier (cross-checkable vs "
+                f"wire_bytes_total{{tier}}): "
+                f"ici={self.runtime_bytes_by_tier.get('ici', 0)} "
+                f"dcn={self.runtime_bytes_by_tier.get('dcn', 0)}")
         if self.bytes_by_dtype:
             lines.append("  bytes_by_dtype (cross-checkable vs "
                          "wire_bytes_total): "
@@ -329,12 +377,13 @@ def cost_report(report, *, config=None, num_slices=None,
     events = report.sequences[report.ranks[0]]
     rows = []
     tier = {"ici": 0, "dcn": 0}
+    runtime_tier = {"ici": 0, "dcn": 0}
     by_dtype, jit_by_dtype = {}, {}
     hier = {"ici": 0, "dcn": 0}
     findings = []
     seen_unbounded = set()
     for e in events:
-        label, legs = _event_legs(e, world, config, use_registry)
+        legs = _event_legs(e, world, config, use_registry, n_slices)
         if not legs:
             continue
         members = _member_ranks(e, world, n_slices, slice_size)
@@ -343,34 +392,55 @@ def cost_report(report, *, config=None, num_slices=None,
         a2a_f = _a2a_dcn_fraction(members, slice_size) \
             if n_slices > 1 else 0.0
         occurrences = max(e.repeat, 1)
-        wire_bytes = sum(b for b, _ in legs)
+        wire_bytes = sum(b for b, _, _ in legs)
         ici = dcn = 0
-        for leg_bytes, sched in legs:
-            frac = a2a_f if sched == "a2a" else ring_f
+        for leg_bytes, sched, leg_dtype in legs:
+            # Tier-explicit legs (the hierarchical decomposition) book
+            # whole; ring/a2a legs split on the slice-boundary fraction.
+            frac = {"ici": 0.0, "dcn": 1.0}.get(
+                sched, a2a_f if sched == "a2a" else ring_f)
             leg_total = leg_bytes * occurrences
             leg_dcn = int(round(leg_total * frac))
             dcn += leg_dcn
             ici += leg_total - leg_dcn
+            target = jit_by_dtype if e.origin == "jit" else by_dtype
+            target[leg_dtype] = target.get(leg_dtype, 0) + leg_total
+        label = "+".join(dict.fromkeys(d for _, _, d in legs))
         rows.append(EventCost(
             op=e.op, ps=e.ps, seq=e.seq, origin=e.origin, dtype=label,
             wire_bytes=wire_bytes, ici_bytes=ici, dcn_bytes=dcn,
             repeat=e.repeat))
         tier["ici"] += ici
         tier["dcn"] += dcn
-        target = jit_by_dtype if e.origin == "jit" else by_dtype
-        target[label] = target.get(label, 0) + wire_bytes * occurrences
-        # 2-level what-if: an allreduce over a multi-slice group runs
-        # local RS + local AG on ICI (the full flat volume) and only the
-        # slice-reduced shards over DCN — flat DCN divided by the slice
-        # width. Non-allreduce exchanges keep their flat split (their
-        # hierarchical decompositions are workload-specific).
-        total = ici + dcn
+        if e.origin != "jit":
+            # The runtime-metered subset: what wire_bytes_total{tier}
+            # accumulates per step (jit rows record at trace time only).
+            runtime_tier["ici"] += ici
+            runtime_tier["dcn"] += dcn
+        # 2-level what-if: an allreduce over a multi-slice group priced
+        # AS IF dispatched hierarchically — local RS + local AG on ICI
+        # (the full flat volume), only the slice-reduced shards (on the
+        # per-tier cross wire) over DCN: flat total divided by the slice
+        # width, via the SAME wire.hierarchical_wire_bytes integers the
+        # runtime records, so the what-if is exactly the counters the
+        # hierarchical dispatch tier produces. Non-allreduce exchanges
+        # keep their flat split (their hierarchical decompositions are
+        # workload-specific).
         slices_spanned = len({slice_of_rank(r, slice_size)
                               for r in members}) if members else 1
         if e.op in ("allreduce", "psum") and slices_spanned > 1:
-            per_slice = max(len(members) // slices_spanned, 1)
-            hier["ici"] += total
-            hier["dcn"] += total // per_slice
+            width = jaxpr_walk.dtype_width(e.dtypes[0]) if e.dtypes else 4
+            cross = ""
+            if e.origin != "jit":
+                cross = _wire.cross_wire_for(e.ps, config) if use_registry \
+                    else _wire.resolve_wire_dtype(
+                        getattr(config, "wire_dtype_dcn", "")
+                        or getattr(config, "wire_dtype", ""))
+            hh = _wire.hierarchical_wire_bytes(
+                e.per_rank_elems(), len(members), slices_spanned, width,
+                cross_wire=cross)
+            hier["ici"] += hh["ici"] * occurrences
+            hier["dcn"] += hh["dcn"] * occurrences
         else:
             hier["ici"] += ici
             hier["dcn"] += dcn
@@ -403,7 +473,8 @@ def cost_report(report, *, config=None, num_slices=None,
         rows=rows, bytes_by_tier=tier, bytes_by_dtype=by_dtype,
         jit_bytes_by_dtype=jit_by_dtype, hierarchical=hier,
         time_estimate=t, findings=sort_findings(findings), exact=exact,
-        dcn_budget_bytes=dcn_budget_bytes)
+        dcn_budget_bytes=dcn_budget_bytes,
+        runtime_bytes_by_tier=runtime_tier)
 
 
 def check_cost(step_fn, args=(), kwargs=None, *, world_size=None,
@@ -420,12 +491,27 @@ def check_cost(step_fn, args=(), kwargs=None, *, world_size=None,
 
 def _measured_wire_bytes(snapshot):
     """``dtype -> value`` from a metrics snapshot's ``wire_bytes_total``
-    family (``hvd.metrics_snapshot()`` shape)."""
+    family (``hvd.metrics_snapshot()`` shape), summed across the tier
+    label (the counter is ``{dtype, tier}`` since the hierarchical
+    dispatch tier split it)."""
     out = {}
     fam = (snapshot or {}).get("wire_bytes_total") or {}
     for s in fam.get("series", ()):
-        out[str(s.get("labels", {}).get("dtype"))] = float(s.get("value",
-                                                                 0.0))
+        dtype = str(s.get("labels", {}).get("dtype"))
+        out[dtype] = out.get(dtype, 0.0) + float(s.get("value", 0.0))
+    return out
+
+
+def _measured_tier_bytes(snapshot):
+    """``tier -> value`` from ``wire_bytes_total{dtype,tier}``, summed
+    across dtypes."""
+    out = {}
+    fam = (snapshot or {}).get("wire_bytes_total") or {}
+    for s in fam.get("series", ()):
+        t = s.get("labels", {}).get("tier")
+        if t:
+            out[str(t)] = out.get(str(t), 0.0) + float(s.get("value",
+                                                             0.0))
     return out
 
 
@@ -441,17 +527,26 @@ def cross_check_bytes(cost, metrics_snapshot, baseline_snapshot=None,
     (so compile-time jit accounting and earlier traffic subtract out);
     ``steps`` divides the measured deltas when the window ran the step
     more than once. Returns ``{"match", "rel_tol", "per_dtype": {dtype:
-    {"predicted", "measured", "delta", "within"}}, "unpredicted"}`` —
-    ``match`` is True when every predicted dtype lands within
-    ``rel_tol`` and the prediction is exact (no unbounded repeats)."""
+    {"predicted", "measured", "delta", "within"}}, "per_tier": {tier:
+    ...}, "unpredicted"}`` — ``match`` is True when every predicted
+    dtype AND tier lands within ``rel_tol`` and the prediction is exact
+    (no unbounded repeats). The per-tier side diffs the runtime-metered
+    prediction (``runtime_bytes_by_tier`` — under hierarchical dispatch
+    that IS the hierarchical what-if, leg for leg) against the
+    ``wire_bytes_total{tier}`` counters: delta 0 on the CPU tier."""
     if not isinstance(cost, CostReport):
         cost = cost_report(cost)
     measured = _measured_wire_bytes(metrics_snapshot)
+    measured_tier = _measured_tier_bytes(metrics_snapshot)
     if baseline_snapshot is not None:
         base = _measured_wire_bytes(baseline_snapshot)
         measured = {k: v - base.get(k, 0.0) for k, v in measured.items()}
+        base_t = _measured_tier_bytes(baseline_snapshot)
+        measured_tier = {k: v - base_t.get(k, 0.0)
+                         for k, v in measured_tier.items()}
     steps = max(int(steps), 1)
     measured = {k: v / steps for k, v in measured.items()}
+    measured_tier = {k: v / steps for k, v in measured_tier.items()}
     per_dtype = {}
     ok = cost.exact
     for dtype, predicted in sorted(cost.bytes_by_dtype.items()):
@@ -461,10 +556,29 @@ def cross_check_bytes(cost, metrics_snapshot, baseline_snapshot=None,
         per_dtype[dtype] = {"predicted": predicted, "measured": got,
                             "delta": delta, "within": within}
         ok = ok and within
+    # The per-tier diff gates `match` only when the LIVE slice layout is
+    # the one the report priced: a counterfactual what-if (e.g. priced at
+    # num_slices=2 against a 1-slice run) keeps its per-dtype gate but
+    # reports the tier rows informationally.
+    try:
+        from horovod_tpu.ops.collective_ops import _live_slices
+        tier_gates = _live_slices(cost.world_size)[0] == cost.num_slices
+    except Exception:  # noqa: BLE001 — uninitialized: dtype gate only
+        tier_gates = False
+    per_tier = {}
+    for t, predicted in sorted(cost.runtime_bytes_by_tier.items()):
+        got = measured_tier.get(t, 0.0)
+        delta = got - predicted
+        within = abs(delta) <= rel_tol * max(predicted, 1.0)
+        per_tier[t] = {"predicted": predicted, "measured": got,
+                       "delta": delta, "within": within,
+                       "gates_match": tier_gates}
+        if tier_gates:
+            ok = ok and within
     unpredicted = {k: v for k, v in measured.items()
                    if k not in cost.bytes_by_dtype and v > 0}
     return {"match": ok, "rel_tol": rel_tol, "per_dtype": per_dtype,
-            "unpredicted": unpredicted}
+            "per_tier": per_tier, "unpredicted": unpredicted}
 
 
 # ----------------------------------------------------------------------------

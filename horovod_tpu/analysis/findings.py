@@ -43,6 +43,10 @@ Program-analysis codes (``HVP1xx``):
 - ``HVP112`` unbounded_repeat — advisory: a collective under a ``while``
   whose trip count the walker cannot bound — cost totals and the elastic
   generation diff are LOWER BOUNDS for it, not exact.
+- ``HVP113`` hierarchical_one_slice — advisory: a hierarchical 2-level
+  allreduce (local RS -> cross -> local AG, ``hier_triads``) — or the
+  armed ``HOROVOD_HIERARCHICAL_DISPATCH`` tier — over a 1-slice layout:
+  two extra ICI legs for no DCN saving.
 
 Lint codes (``HVL0xx``) are documented in :mod:`horovod_tpu.analysis.lint`.
 """

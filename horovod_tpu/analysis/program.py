@@ -412,7 +412,7 @@ def check_program(step_fn, args=(), kwargs=None, *, world_size=None,
             rank=r0, op=c.op, ps=f"axis:{ax}"))
     if include_advisories:
         findings += _advisory_findings(sequences[r0], r0, config,
-                                       reuse_by_rank[r0])
+                                       reuse_by_rank[r0], world_size)
     return CheckReport(world_size=world_size, ranks=ranks,
                        sequences=sequences,
                        findings=sort_findings(findings), sampled=sampled)
@@ -513,6 +513,36 @@ def _degenerate_findings(sequences, ranks):
     return findings
 
 
+def hier_triads(events):
+    """Recognize the hierarchical 2-level exchange shape in a predicted
+    event stream: a ``reduce_scatter`` over the LOCAL mesh axis, followed
+    by cross-axis collective(s) (the DCN leg — exact psum or the
+    block-scaled exchange's int8/fp8 all_to_all+all_gather), followed by
+    an ``all_gather`` back over the LOCAL axis (``NCCLTorusAllreduce`` /
+    ``strategies.allreduce_torus``'s jaxpr footprint). Returns one dict
+    per decomposition: ``{"rs": event, "cross": [events],
+    "quantized": bool}`` — ``quantized`` when the cross leg moves a
+    block-scaled wire dtype, which is what suppresses HVP106 for the
+    decomposition's deliberately-full-precision ICI legs."""
+    from horovod_tpu.common.topology import CROSS_AXIS, LOCAL_AXIS
+    jit = [e for e in events if e.origin == "jit"]
+    local_ps, cross_ps = f"axis:{LOCAL_AXIS}", f"axis:{CROSS_AXIS}"
+    triads = []
+    for i, e in enumerate(jit):
+        if e.op != "reduce_scatter" or e.ps != local_ps:
+            continue
+        cross = [c for c in jit[i + 1:] if c.ps == cross_ps]
+        ag = [g for g in jit[i + 1:]
+              if g.op == "all_gather" and g.ps == local_ps]
+        if cross and ag:
+            quantized = any(
+                d == "int8" or str(d).startswith("float8")
+                for c in cross for d in c.dtypes)
+            triads.append({"rs": e, "cross": cross,
+                           "quantized": quantized})
+    return triads
+
+
 def _cond_findings(cond_ops, rank):
     findings = []
     for c in cond_ops:
@@ -528,7 +558,7 @@ def _cond_findings(cond_ops, rank):
     return findings
 
 
-def _advisory_findings(events, rank, config, reuse_info):
+def _advisory_findings(events, rank, config, reuse_info, world_size=None):
     findings = []
     rec, reused = reuse_info
     sync_eager = [e for e in events if e.origin == "eager"
@@ -556,28 +586,72 @@ def _advisory_findings(events, rank, config, reuse_info):
                 rank=rank, op=e.op, ps=e.ps, seq=e.seq, sig=e.sig))
             break
     wire = getattr(config, "wire_dtype", "")
+    cross_cfg = getattr(config, "wire_dtype_dcn", "")
     # The block-scaled quantized exchange shows up in the jaxpr as 1-byte
     # collectives (int8 / float8 all_to_all + all_gather, ops/wire.py):
     # its presence means the program IS quantizing in jit — the small
     # fp32 collectives alongside it are the exchange's own block scales,
-    # not an unquantized wire.
+    # not an unquantized wire. This check also covers a hierarchical
+    # decomposition whose CROSS leg is block-scaled (hier_triads'
+    # `quantized` flag is derived from the same int8/fp8 jit events):
+    # its full-precision local legs are the tier's deliberate ICI
+    # policy, not a missed wire.
     quant_jit = [e for e in events if e.origin == "jit"
                  and any(d == "int8" or str(d).startswith("float8")
                          for d in e.dtypes)]
-    if wire and not quant_jit:
+    if (wire or cross_cfg) and not quant_jit:
         fp32_jit = [e for e in events if e.origin == "jit"
                     and any("float32" in d for d in e.dtypes)]
         if fp32_jit:
             e = fp32_jit[0]
+            knob = f"wire_dtype={wire}" if wire \
+                else f"wire_dtype_dcn={cross_cfg}"
             findings.append(Finding(
                 code="HVP106", severity=INFO,
-                message=(f"wire_dtype={wire} is configured but "
+                message=(f"{knob} is configured but "
                          f"{len(fp32_jit)} in-jit collective(s) move "
                          "float32 on the wire — the wire tier covers "
                          "eager/fused dispatches; inside jit use "
-                         "Compression.int8 on the optimizer or "
-                         "strategies.allreduce_quantized"),
+                         "Compression.int8 on the optimizer, "
+                         "strategies.allreduce_quantized, or the "
+                         "2-level strategies.allreduce_tiered"),
                 rank=rank, op=e.op, ps=e.ps))
+    # HVP113: the hierarchical decomposition over a 1-slice layout is
+    # pure overhead — two extra ICI legs (local RS + AG) and no DCN to
+    # save, since every 'cross' hop is in-slice interconnect anyway.
+    if world_size:
+        from horovod_tpu.analysis.cost import resolve_slices
+        n_slices, _ = resolve_slices(world_size)
+        if n_slices <= 1:
+            # Only consulted on 1-slice layouts: the triad scan is
+            # O(RS x jit events) and would be pure waste on the common
+            # multi-slice path.
+            triads = hier_triads(events)
+            if triads:
+                t = triads[0]
+                findings.append(Finding(
+                    code="HVP113", severity=INFO,
+                    message=("hierarchical allreduce (local RS -> cross "
+                             "-> local AG) over a 1-slice layout: the "
+                             "cross leg rides the same ICI as the local "
+                             "legs, so the decomposition adds two extra "
+                             "legs for no DCN saving — use the flat "
+                             "allreduce, or set HOROVOD_MESH_SLICES to "
+                             "the real slice hierarchy"),
+                    rank=rank, op=t["rs"].op, ps=t["rs"].ps))
+            elif getattr(config, "hierarchical_dispatch", False) and any(
+                    e.op == "allreduce" and e.origin != "jit"
+                    for e in events):
+                findings.append(Finding(
+                    code="HVP113", severity=INFO,
+                    message=("HOROVOD_HIERARCHICAL_DISPATCH is on but "
+                             f"the {world_size}-rank world has a 1-slice "
+                             "layout — the dispatch layer will keep "
+                             "every allreduce flat (the decomposition "
+                             "would be pure overhead); set "
+                             "HOROVOD_MESH_SLICES / run multi-slice, or "
+                             "drop the knob"),
+                    rank=rank, op="allreduce", ps="global"))
     if quant_jit and getattr(config, "wire_error_feedback", False) \
             and wire in ("int8", "fp8"):
         # The eager/fused paths keep their residuals in the runtime store,

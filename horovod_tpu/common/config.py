@@ -123,6 +123,26 @@ class Config:
     # hvd.set_wire_dtype (the autotuner steers the global set through the
     # same registry).
     wire_dtype: str = ""
+    # Per-link-tier wire policy: the wire dtype of the CROSS-SLICE (DCN)
+    # leg of the hierarchical dispatch tier ("" = inherit wire_dtype).
+    # The ICI legs of the 2-level decomposition always stay exact — this
+    # knob quantizes only the scarce inter-slice hop (the EQuARX
+    # deployment shape). Overridable per process set via
+    # hvd.set_wire_dtype(dtype, tier="dcn").
+    wire_dtype_dcn: str = ""
+    # Hierarchical dispatch tier (ROADMAP item 3): when a slice hierarchy
+    # exists (HOROVOD_MESH_SLICES / multi-slice topology), eligible
+    # allreduces on all three dispatch paths decompose into local RS
+    # (exact, ICI) -> cross-slice allreduce (wire_dtype_dcn, DCN) ->
+    # local AG. Opt-in: a 1-slice layout would pay two extra ICI legs for
+    # no DCN saving (hvdlint HVP113).
+    hierarchical_dispatch: bool = False
+    # Cross-leg overlap in the fusion flush scheduler: the DCN leg of a
+    # hierarchical bucket is left in flight at flush return and only
+    # awaited when the next flush (or the step boundary / a sync
+    # collective's fence) needs it, booking the wait to the step
+    # profiler's cross_wait category instead of the flush critical path.
+    cross_overlap: bool = True
     # Error feedback for the quantized wire: keep each bucket's fp32
     # quantization error and add it back before the next quantize
     # (eager + fused paths; in-jit callers thread residuals themselves).
@@ -290,18 +310,18 @@ class Config:
         # while "int8" routes the fused bucket through the two-phase
         # quantized exchange (strategies.allreduce_int8) — any other value
         # would silently destroy gradients.
-        self.wire_dtype = {"fp16": "float16",
-                           "bf16": "bfloat16"}.get(self.wire_dtype,
-                                                   self.wire_dtype)
-        if self.wire_dtype and self.wire_dtype not in ("float16",
-                                                       "bfloat16", "int8",
-                                                       "fp8"):
-            raise ValueError(
-                f"wire_dtype={self.wire_dtype!r}: float16/bfloat16 (cast) "
-                "or int8/fp8 (block-scaled quantized exchange) are the "
-                "wire options; inside jit the same tier is reachable via "
-                "Compression.int8 on the optimizer or "
-                "strategies.allreduce_quantized")
+        for attr in ("wire_dtype", "wire_dtype_dcn"):
+            val = {"fp16": "float16",
+                   "bf16": "bfloat16"}.get(getattr(self, attr),
+                                           getattr(self, attr))
+            setattr(self, attr, val)
+            if val and val not in ("float16", "bfloat16", "int8", "fp8"):
+                raise ValueError(
+                    f"{attr}={val!r}: float16/bfloat16 (cast) "
+                    "or int8/fp8 (block-scaled quantized exchange) are the "
+                    "wire options; inside jit the same tier is reachable "
+                    "via Compression.int8 on the optimizer or "
+                    "strategies.allreduce_quantized")
 
     @classmethod
     def from_env(cls):
@@ -361,6 +381,12 @@ class Config:
                                             c.coordinator_addr)
         c.coordinator_port = _env_int("HOROVOD_COORDINATOR_PORT", c.coordinator_port)
         c.wire_dtype = os.environ.get("HOROVOD_WIRE_DTYPE", c.wire_dtype)
+        c.wire_dtype_dcn = os.environ.get("HOROVOD_WIRE_DTYPE_DCN",
+                                          c.wire_dtype_dcn)
+        c.hierarchical_dispatch = _env_bool("HOROVOD_HIERARCHICAL_DISPATCH",
+                                            c.hierarchical_dispatch)
+        c.cross_overlap = _env_bool("HOROVOD_CROSS_OVERLAP",
+                                    c.cross_overlap)
         c.wire_error_feedback = _env_bool("HOROVOD_WIRE_ERROR_FEEDBACK",
                                           c.wire_error_feedback)
         c.__post_init__()  # re-normalize after the env override

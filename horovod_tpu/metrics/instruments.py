@@ -35,9 +35,11 @@ Catalogue (names shown without the ``HOROVOD_METRICS_PREFIX``, default
   step profiler's marker-to-marker windows (histogram)
 - ``step_profiler_events_total{kind}``              watchdog findings:
   straggler|regression (counter; horovod_tpu/profile)
-- ``wire_bytes_total{dtype}``                       estimated bytes on the
-  wire per collective at the effective wire dtype (counter; ops/wire.py
-  accounting — allreduces count both RS+AG legs)
+- ``wire_bytes_total{dtype,tier}``                  estimated bytes on the
+  wire per collective at the effective wire dtype, split per link tier
+  (tier=ici|dcn — ops/wire.py accounting; allreduces count both RS+AG
+  legs; the flat default split books the ring/a2a slice-boundary
+  fraction to dcn, hierarchical dispatches book each leg's tier exactly)
 - ``wire_compression_events_total{path,dtype}``     dispatches that
   actually compressed the wire (path=eager|fused|jit; counter)
 """
@@ -187,12 +189,17 @@ STEP_PROFILER_EVENTS = REGISTRY.counter(
     ("kind",))
 WIRE_BYTES = REGISTRY.counter(
     "wire_bytes_total",
-    "Estimated bytes-on-wire per collective at the effective wire dtype "
-    "(ops/wire.py accounting: allreduce counts both internal legs — "
-    "reduce-scatter + all-gather — at the wire width; quantized wires "
-    "count both 1-byte legs plus fp32 block scales and padding). The "
-    "int8-vs-float32 ratio here is the provable off-chip savings.",
-    ("dtype",))
+    "Estimated bytes-on-wire per collective at the effective wire dtype, "
+    "split per link tier (tier=ici|dcn). ops/wire.py accounting: "
+    "allreduce counts both internal legs — reduce-scatter + all-gather — "
+    "at the wire width; quantized wires count both 1-byte legs plus fp32 "
+    "block scales and padding. Flat dispatches book the slice-boundary "
+    "ring/a2a fraction of their bytes to dcn (the static cost model's "
+    "tier-split rule, shared via wire.ring_dcn_fraction); hierarchical "
+    "dispatches book each decomposed leg's tier exactly. The "
+    "int8-vs-float32 ratio here is the provable off-chip savings; the "
+    "dcn-vs-flat ratio is the hierarchical tier's.",
+    ("dtype", "tier"))
 WIRE_COMPRESSION_EVENTS = REGISTRY.counter(
     "wire_compression_events_total",
     "Collective dispatches whose wire was actually compressed "
@@ -265,14 +272,70 @@ def record_fusion_kv(sets=0, gets=0, payload_bytes=0):
         CONTROL_PLANE_PAYLOAD.labels("coord").inc(payload_bytes)
 
 
-def record_wire(path, dtype, nbytes, compressed=False):
+# Default flat-schedule DCN fractions (per schedule: ring / a2a),
+# resolved lazily from the live slice layout and cached (reset by
+# collective_ops.clear_program_caches — an elastic resize must never
+# replay a stale slice split). None = not yet resolved.
+_tier_frac = None
+
+
+def _default_dcn_fraction(sched="ring"):
+    """Slice-boundary DCN fraction of the live topology for one flat
+    schedule — the static cost model's rules for flat dispatches, shared
+    via wire.ring_dcn_fraction / a2a_dcn_fraction: ``S/n`` for ring legs,
+    ``1 - L/n`` for all-to-all legs when a slice hierarchy exists, else 0
+    (single-slice worlds book everything to ici)."""
+    global _tier_frac
+    if _tier_frac is None:
+        fracs = {"ring": 0.0, "a2a": 0.0}
+        try:
+            from horovod_tpu.common import basics, topology
+            from horovod_tpu.ops import wire as _wire
+            if basics.is_initialized():
+                topo = basics.topology()
+                size = topo.size
+                k = topology.forced_slices()
+                slices, slice_size = topology.slice_layout(
+                    size, k or (topo.num_slices if topo.num_slices > 1
+                                else None))
+                if slices > 1:
+                    members = list(range(size))
+                    fracs = {
+                        "ring": _wire.ring_dcn_fraction(members,
+                                                        slice_size),
+                        "a2a": _wire.a2a_dcn_fraction(members,
+                                                      slice_size)}
+        except Exception:  # noqa: BLE001 — accounting must never break
+            fracs = {"ring": 0.0, "a2a": 0.0}   # a dispatch
+        _tier_frac = fracs
+    return _tier_frac.get(sched, _tier_frac["ring"])
+
+
+def reset_tier_split():
+    """Forget the cached flat-schedule tier split (topology changed)."""
+    global _tier_frac
+    _tier_frac = None
+
+
+def record_wire(path, dtype, nbytes, compressed=False, tiers=None,
+                sched="ring"):
     """Wire accounting for one collective dispatch: bytes at the effective
     wire dtype, plus a compression event when the wire was actually
-    narrowed (quantized exchange or 16-bit cast)."""
+    narrowed (quantized exchange or 16-bit cast). ``tiers`` (a
+    ``{"ici": b, "dcn": b}`` dict) books an explicit per-tier split (the
+    hierarchical dispatch paths and the quantized exchange's per-leg
+    schedules pass it); without it the flat split of the live slice
+    layout at this leg's ``sched`` (``"ring"``/``"a2a"``) applies —
+    all-ici on single-slice worlds."""
     if not _enabled or not dtype:
         return
-    if nbytes:
-        WIRE_BYTES.labels(str(dtype)).inc(float(nbytes))
+    if nbytes and tiers is None:
+        from horovod_tpu.ops import wire as _wire
+        tiers = _wire.split_tiers(nbytes, _default_dcn_fraction(sched))
+    if tiers:
+        for tier, b in tiers.items():
+            if b:
+                WIRE_BYTES.labels(str(dtype), tier).inc(float(b))
     if compressed:
         WIRE_COMPRESSION_EVENTS.labels(path, str(dtype)).inc()
 

@@ -65,6 +65,25 @@ def _bucket_quant(wire_dtype, strategy, masked, op, sizes, dtypes, n):
     return label
 
 
+def _hier_bucket_facts(hier_mesh, total, cross_wire, all_float=True):
+    """Static per-bucket facts of the torus_qcross decomposition over
+    ``hier_mesh`` — one call into wire.hierarchical_wire_bytes (the
+    shared integer formulas) so the runtime (residual sizing, per-tier
+    byte records) and the compiled program (residual argument) can never
+    disagree. ``all_float=False`` (an integer bucket) forces the exact
+    cross leg — the SAME refusal ``allreduce_torus`` applies in the
+    compiled program, so the accounting never claims a quantized wire the
+    program didn't ride. ``width`` here only affects byte totals, not the
+    cross-quantization verdict; callers re-price with the bucket's real
+    itemsize for accounting."""
+    from horovod_tpu.common.topology import CROSS_AXIS, LOCAL_AXIS
+    cross_n = int(hier_mesh.shape[CROSS_AXIS])
+    local_n = int(hier_mesh.shape[LOCAL_AXIS])
+    return _wire.hierarchical_wire_bytes(
+        int(total), cross_n * local_n, cross_n, 4,
+        cross_wire=(cross_wire or "") if all_float else "")
+
+
 class FusedHandle:
     """Handle for a tensor pending in the fusion queue. Resolves after the
     bucket it lands in is flushed (reference analog: HandleManager int handle
@@ -117,13 +136,17 @@ class FusedHandle:
 @functools.lru_cache(maxsize=2048)
 def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
                    wire_dtype, active_mask=None, strategy="flat",
-                   donate=(), ef=False):
+                   donate=(), ef=False, cross_wire=""):
     """One flat-buffer reduction for a whole bucket. ``active_mask`` carries
     join state so async collectives honor the same joined-rank exclusion as
     the sync path (reference: joined_size accounting). ``strategy``:
     "flat" runs the 1-D psum; "hierarchical"/"torus" run the 2-level
-    schemes of parallel/strategies.py — ``mesh`` must then be the
-    (cross, local) mesh2d (the autotuner's categorical knob; reference:
+    schemes of parallel/strategies.py; "torus_qcross" is the hierarchical
+    dispatch tier — local RS (exact, ICI) -> cross-slice allreduce on
+    ``cross_wire`` (DCN; per-bucket error feedback when ``ef``) -> local
+    AG. For every 2-level strategy ``mesh`` must be the (cross, local)
+    factorization (the DCN mesh when a slice hierarchy exists; the
+    autotuner's categorical knob — reference:
     HOROVOD_HIERARCHICAL_ALLREDUCE / HOROVOD_TORUS_ALLREDUCE)."""
     sizes = [int(np.prod(s[1:])) for s in shapes]
     active = None if active_mask is None else np.array(active_mask)
@@ -135,25 +158,39 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
     else:
         spec = P(HVD_AXIS)
 
-    def reduce_buf(buf):
-        # (flat_len,) chip-local buffer -> reduced buffer
+    def reduce_buf(buf, residual=None):
+        # (flat_len,) chip-local buffer -> reduced buffer (+ new residual
+        # for the torus_qcross cross leg's error feedback)
+        new_res = None
         if strategy == "torus":
             out = allreduce_torus(
                 buf * jnp.asarray(prescale, buf.dtype) if prescale != 1.0
-                else buf, average=(op == ReduceOp.AVERAGE))
+                else buf, average=(op == ReduceOp.AVERAGE), record=False)
+        elif strategy == "torus_qcross":
+            out = allreduce_torus(
+                buf * jnp.asarray(prescale, buf.dtype) if prescale != 1.0
+                else buf, average=(op == ReduceOp.AVERAGE),
+                cross_compression=cross_wire or None,
+                cross_residual=residual, record=False)
+            if residual is not None:
+                out, new_res = out
         elif strategy == "hierarchical":
             out = allreduce_hierarchical(
                 buf * jnp.asarray(prescale, buf.dtype) if prescale != 1.0
-                else buf, average=(op == ReduceOp.AVERAGE))
+                else buf, average=(op == ReduceOp.AVERAGE), record=False)
         else:
             return _reduce_shard(buf[None], op, n, prescale, postscale,
-                                 HVD_AXIS, active)[0]
+                                 HVD_AXIS, active)[0], None
         if postscale != 1.0:
             out = out * jnp.asarray(postscale, out.dtype)
         # the cross psum leaves the value cross-invariant; the stacked
         # out_specs need it typed varying over both mesh axes
         from horovod_tpu.ops.in_jit import mark_varying
-        return mark_varying(mark_varying(out, CROSS_AXIS), LOCAL_AXIS)
+        out = mark_varying(mark_varying(out, CROSS_AXIS), LOCAL_AXIS)
+        if new_res is not None:
+            new_res = mark_varying(mark_varying(new_res, CROSS_AXIS),
+                                   LOCAL_AXIS)
+        return out, new_res
 
     # Quantized wire (int8/fp8): the fused bucket rides the two-phase
     # block-scaled exchange (EQuARX-style, ops/wire.py — ~2 B/element vs
@@ -168,8 +205,17 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
                                 active is not None, op, sizes, dtypes, n)
     use_ef = bool(ef) and quant_label is not None
     cast_wire = (wire_dtype is not None and quant_label is None
+                 and strategy != "torus_qcross"
                  and not _wire.is_quantized(wire_dtype))
     total = sum(sizes)
+    # torus_qcross per-bucket error feedback covers the CROSS leg's shard
+    # only; the verdict is STATIC (shared wire.hierarchical_wire_bytes
+    # facts) so the runtime's residual argument always matches.
+    hier = _hier_bucket_facts(mesh, total, cross_wire) \
+        if strategy == "torus_qcross" else None
+    hier_ef = bool(ef) and hier is not None \
+        and hier["cross_label"] is not None
+    res_len = hier["shard_elems"] if hier_ef else total
 
     def body(*args):
         # xs: local slices (1, ...). Flatten each, concat per the bucket
@@ -201,17 +247,18 @@ def _fused_program(mesh, n, op, prescale, postscale, shapes, dtypes,
                 prescale_factor=prescale, postscale_factor=postscale)
             buf = mark_varying(red, HVD_AXIS)
         else:
-            buf = reduce_buf(buf)
+            residual = args[-1].reshape(-1) if hier_ef else None
+            buf, new_res = reduce_buf(buf, residual)
         outs, off = [], 0
         for x, sz in zip(xs, sizes):
             piece = lax.slice_in_dim(buf, off, off + sz).astype(x.dtype)
             outs.append(piece.reshape(x.shape))
             off += sz
-        if use_ef:
-            outs.append(new_res.reshape(1, total))
+        if use_ef or hier_ef:
+            outs.append(new_res.reshape(1, res_len))
         return tuple(outs)
 
-    n_args = len(shapes) + (1 if use_ef else 0)
+    n_args = len(shapes) + (1 if use_ef or hier_ef else 0)
     f = jax.shard_map(body, mesh=mesh,
                       in_specs=tuple(spec for _ in range(n_args)),
                       out_specs=tuple(spec for _ in range(n_args)))
@@ -278,10 +325,29 @@ class FusionRuntime:
         except Exception:
             self._native = None
         # Allreduce strategy for the fused buckets (a tunable categorical;
-        # the config knobs give the initial value — reference common.h:130-132)
-        self.strategy = ("torus" if config.torus_allreduce
+        # the config knobs give the initial value — reference common.h:130-132;
+        # torus_qcross is the hierarchical dispatch tier: 2-level with the
+        # cross-slice leg on the per-tier wire).
+        self.strategy = ("torus_qcross"
+                         if getattr(config, "hierarchical_dispatch", False)
+                         else "torus" if config.torus_allreduce
                          else "hierarchical" if config.hierarchical_allreduce
                          else "flat")
+        self._config = config
+        # Cross-slice (DCN) leg wire of the hierarchical strategies: the
+        # per-tier policy chain (registry @dcn -> HOROVOD_WIRE_DTYPE_DCN
+        # -> HOROVOD_WIRE_DTYPE). Coordinator re-resolves per flush;
+        # followers adopt the boundary payload's snapshot.
+        self.cross_wire = _wire.cross_wire_for("global", config)
+        # Cross-leg overlap (HOROVOD_CROSS_OVERLAP): hierarchical buckets'
+        # outputs are left in flight at flush return; the await point is
+        # steered per flush by the step profiler's collective-vs-compute
+        # attribution ("step" = widened to the fence/step boundary,
+        # "next_flush" = collapsed to the next flush; overlap off blocks
+        # inside the flush bracket itself).
+        self._overlap = bool(getattr(config, "cross_overlap", True))
+        self._overlap_mode = "step"
+        self._inflight_cross = []    # bucket outputs awaiting their wait
         self._multi = jax.process_count() > 1
         self._coord = jax.process_index() == 0
         self._parameter_manager = None
@@ -297,9 +363,17 @@ class FusionRuntime:
             # and — only when the user already opted into a 16-bit wire —
             # which 16-bit dtype (never tuned from full precision: that is
             # a precision policy, not a speed knob).
+            # torus_qcross (the hierarchical dispatch tier) joins the
+            # sweep only when a slice hierarchy exists — on a 1-slice
+            # layout it is pure overhead (hvdlint HVP113) and would only
+            # waste sweep samples.
+            from horovod_tpu.common.topology import forced_slices
+            topo0 = basics.topology()
+            has_slices = forced_slices() or topo0.num_slices > 1
+            choices = ("flat", "hierarchical", "torus") + (
+                ("torus_qcross",) if has_slices else ())
             cats = {"strategy": [self.strategy] + [
-                s for s in ("flat", "hierarchical", "torus")
-                if s != self.strategy]}
+                s for s in choices if s != self.strategy]}
             resolved = _wire.resolve_wire_dtype(config.wire_dtype)
             if _wire.is_quantized(resolved):
                 # The user opted into the LOSSY quantized exchange;
@@ -457,12 +531,36 @@ class FusionRuntime:
             except Exception:  # noqa: BLE001 — keep publishing
                 pass
 
-    def _publish_boundary(self, last_tid, strategy, wire_dtype):
+    # Fused strategy -> eager dispatch-strategy registry value: the
+    # autotuner's choice steers BOTH paths per process set at the same
+    # flush boundary. torus maps to the eager RS/cross/AG decomposition
+    # ("hier"); torus_qcross additionally quantizes the cross leg. The
+    # legacy "hierarchical" strategy (full local reduce then whole-buffer
+    # cross) has NO eager analog and must sync "flat" — mapping it to
+    # "hier" would make the static model price torus-shaped bytes the
+    # runtime never moves.
+    _EAGER_STRATEGY = {"flat": "flat", "torus": "hier",
+                       "hierarchical": "flat",
+                       "torus_qcross": "hier_qcross"}
+
+    def _sync_eager_policy(self, strategy, cross_wire):
+        """Adopt the flush snapshot's strategy + cross-wire into the eager
+        registries (runtime sync: defers to explicit user pins). 'flat'
+        is only synced once the registry has an entry — the default-flat
+        steady state must not grow a registry lookup on every eager
+        dispatch."""
+        mapped = self._EAGER_STRATEGY.get(strategy, "flat")
+        if mapped != "flat" or _wire.dispatch_strategy_for("global"):
+            _wire.runtime_sync_dispatch_strategy(mapped, "global")
+        if cross_wire:
+            _wire.runtime_sync_wire_dtype(cross_wire, "global", tier="dcn")
+
+    def _publish_boundary(self, last_tid, strategy, wire_dtype, cross_wire):
         """Coordinator: record that tids <= last_tid are flushed — and the
-        program-shaping knobs (strategy, wire dtype) in effect for that
-        flush, so followers build the identical programs for the identical
-        prefix. Called under self._lock — only the seq assignment happens
-        here; the RPCs run on the publisher thread."""
+        program-shaping knobs (strategy, wire dtype, cross-leg wire) in
+        effect for that flush, so followers build the identical programs
+        for the identical prefix. Called under self._lock — only the seq
+        assignment happens here; the RPCs run on the publisher thread."""
         import json as _json
         seq = self._boundary_seq
         self._boundary_seq += 1
@@ -475,8 +573,10 @@ class FusionRuntime:
             # reads the same per-set wire dtype. Runtime sync defers to an
             # explicit user pin (hvd.set_wire_dtype). See ops/wire.py.
             _wire.runtime_sync_wire_dtype(wire, "global")
+        self._sync_eager_policy(strategy, cross_wire)
         self._publish_queue.put((seq, _json.dumps(
-            {"t": int(last_tid), "s": strategy, "w": wire})))
+            {"t": int(last_tid), "s": strategy, "w": wire,
+             "cw": cross_wire or ""})))
 
     def _apply_ready_boundaries(self, block_ms):
         """Follower: consume and apply published boundaries in order;
@@ -533,8 +633,10 @@ class FusionRuntime:
                 self.strategy = payload.get("s", self.strategy)
                 wire = payload.get("w", "")
                 self.wire_dtype = jnp.dtype(wire).type if wire else None
+                self.cross_wire = payload.get("cw", "")
                 if wire:
                     _wire.runtime_sync_wire_dtype(wire, "global")
+                self._sync_eager_policy(self.strategy, self.cross_wire)
                 # The local enqueue stream may lag the coordinator's:
                 # applying early would flush a SHORTER prefix and misalign
                 # every later collective. A boundary AHEAD of the local
@@ -593,13 +695,65 @@ class FusionRuntime:
         until nothing is pending — the SPMD contract guarantees the
         coordinator's fence flushed the same pending set, so the covering
         boundary exists or is in flight. Single-process: device
-        submission order is program order already."""
+        submission order is program order already. The fence is also the
+        STEP-BOUNDARY await point of the cross-leg overlap: any
+        hierarchical bucket's DCN leg still in flight is waited on here,
+        booked to the profiler's cross_wait category (outside the flush
+        critical path)."""
+        if self._inflight_cross:     # unlocked peek: empty = no-op fence
+            self._await_cross()
         if not self._multi:
             return
         # Coordinator: flush_all; follower: drain boundaries until the
         # last enqueued tid is covered (== pending empty, since fence
         # runs on the enqueuing thread) — exactly ensure_flushed().
         self.ensure_flushed()
+
+    # ---- cross-leg overlap (hierarchical buckets) -----------------------
+
+    # Inflight-reference bound: beyond this many un-awaited buckets the
+    # oldest reference is dropped at append (see _flush_locked).
+    _INFLIGHT_CAP = 16
+
+    def _await_cross(self):
+        """Block on every in-flight hierarchical bucket's cross leg,
+        booking the wall time to the step profiler's ``cross_wait``
+        category — the overlap-on A/B's 'wait moved OUT of the flush
+        critical path' evidence. The inflight list is popped under the
+        lock; the blocking wait runs outside it (a gradient-hook enqueue
+        must never queue behind a DCN wait)."""
+        with self._lock:
+            inflight, self._inflight_cross = self._inflight_cross, []
+        if not inflight:
+            return
+        t0 = time.perf_counter()
+        for outs in inflight:
+            try:
+                jax.block_until_ready(outs)
+            except Exception:  # noqa: BLE001 — failures already reached
+                pass           # the bucket's handles at dispatch
+        _profile.record_cross_wait(time.perf_counter() - t0)
+
+    def _steer_overlap(self):
+        """Per-flush overlap steering from the step profiler's
+        collective-vs-compute attribution: compute-dominant steps WIDEN
+        the overlap window (await at the fence/step boundary — there is
+        backward compute to hide the DCN leg behind), communication-
+        dominant steps COLLAPSE it to the next flush (nothing to overlap
+        with; earlier backpressure keeps attribution honest). Returns the
+        mode in effect ("off" when the knob disables overlap)."""
+        if not self._overlap:
+            return "off"
+        if _profile.armed:
+            from horovod_tpu.profile import ledger as _ledger
+            rec = _ledger.step_report(1)
+            if rec:
+                att = rec.get("attribution", {})
+                comm = att.get("collective", 0.0) \
+                    + att.get("cross_wait", 0.0)
+                self._overlap_mode = "next_flush" \
+                    if comm > att.get("compute", 0.0) else "step"
+        return self._overlap_mode
 
     def ensure_flushed(self, tid=None, block=True):
         """Make sure the bucket containing ``tid`` has been dispatched.
@@ -758,6 +912,11 @@ class FusionRuntime:
             # flush would split the burst differently from process 0.
             self._apply_ready_boundaries(block_ms=1)
             return
+        if self._overlap_mode == "next_flush" and self._inflight_cross:
+            # Collapsed overlap: bucket k's DCN leg is awaited when bucket
+            # k+1's flush needs the wire (outside the lock and outside
+            # this flush's bracket — booked to cross_wait).
+            self._await_cross()
         with self._lock:
             self._flush_locked()
 
@@ -799,6 +958,8 @@ class FusionRuntime:
             if self._native is not None:
                 self._native.close()
                 self._native = None
+        # Drain any overlapped cross legs the final flush left in flight.
+        self._await_cross()
         if self._publisher_thread is not None:
             # Sentinel AFTER the final flush so its boundary reaches the
             # followers; the join bounds shutdown.
@@ -917,12 +1078,21 @@ class FusionRuntime:
         # The one-flush lag on a sweep switch is absorbed by the
         # ParameterManager's per-combo compile-warmup discard.)
         strategy_now, wire_now = self.strategy, self.wire_dtype
-        if not self._multi and wire_now is not None:
+        # Cross-slice leg wire snapshot: the coordinator (and single
+        # process) re-resolves the per-tier policy chain live; followers
+        # keep the value adopted from the boundary.
+        if not self._multi or self._coord:
+            self.cross_wire = _wire.cross_wire_for("global", self._config)
+        cross_now = self.cross_wire
+        if not self._multi:
             # Single process: no boundary stream — adopt the snapshot into
-            # the eager wire registry here (multi-process does it at
+            # the eager registries here (multi-process does it at
             # publish/apply time; see _publish_boundary). Defers to an
             # explicit user pin like every runtime sync.
-            _wire.runtime_sync_wire_dtype(jnp.dtype(wire_now).name, "global")
+            if wire_now is not None:
+                _wire.runtime_sync_wire_dtype(jnp.dtype(wire_now).name,
+                                              "global")
+            self._sync_eager_policy(strategy_now, cross_now)
         # Bucket assembly: tensors in one bucket share one flat reduction,
         # like responses fused up to the threshold (reference:
         # controller.h:170 FuseResponses). The native scheduler assigns
@@ -952,12 +1122,21 @@ class FusionRuntime:
         # BEFORE its window closes below.
         downgraded = False
         plan = []
+        from horovod_tpu.ops.collective_ops import _hier_mesh, _live_slices
+        slices_now, _ = _live_slices(n)
         for (op, pre, post, _), items in buckets.items():
             strategy = strategy_now
             if strategy != "flat" and (
                     op not in (ReduceOp.SUM, ReduceOp.AVERAGE)
                     or active_mask is not None
-                    or getattr(topo, "mesh2d", None) is None):
+                    or getattr(topo, "mesh2d", None) is None
+                    # torus_qcross requires a real slice hierarchy: over
+                    # a 1-slice layout the decomposition is pure overhead
+                    # and its lossy cross leg buys nothing (hvdlint
+                    # HVP113) — same refusal as the eager verdict and the
+                    # static model, so the per-tier cross-check stays
+                    # exact.
+                    or (strategy == "torus_qcross" and slices_now <= 1)):
                 strategy = "flat"
                 downgraded = True
             plan.append((op, pre, post, items, strategy))
@@ -979,7 +1158,9 @@ class FusionRuntime:
         if self._multi and self._coord:
             # Tell the followers to flush this exact prefix with the
             # knobs these programs really use (the snapshot).
-            self._publish_boundary(pending[-1][0], strategy_now, wire_now)
+            self._publish_boundary(pending[-1][0], strategy_now, wire_now,
+                                   cross_now)
+        overlap_mode = self._steer_overlap()
         # Pass 2: build + dispatch.
         for op, pre, post, items, strategy in plan:
             raw = [i[0] for i in items]
@@ -1010,22 +1191,57 @@ class FusionRuntime:
                                         active_mask is not None, op,
                                         sizes, dtypes, n)
             use_ef = self._wire_ef and quant_label is not None
+            # Hierarchical (2-level) bucket: resolve the decomposition
+            # mesh live (the forced/virtual slice hierarchy wins over the
+            # host-boundary mesh2d) and, for torus_qcross, the STATIC
+            # cross-leg facts the program reaches identically.
+            hier_bucket = strategy != "flat" and op != ReduceOp.ADASUM
+            bucket_cross = cross_now if strategy == "torus_qcross" else ""
+            prog_mesh = mesh
+            hier_facts = None
+            if strategy != "flat":
+                prog_mesh = _hier_mesh(mesh, slices_now) if slices_now > 1 \
+                    else topo.mesh2d
+                if strategy == "torus_qcross":
+                    all_float = all(
+                        jnp.issubdtype(jnp.dtype(d), jnp.floating)
+                        for d in dtypes)
+                    hier_facts = _hier_bucket_facts(prog_mesh, sum(sizes),
+                                                    bucket_cross,
+                                                    all_float)
+            use_hier_ef = self._wire_ef and hier_facts is not None \
+                and hier_facts["cross_label"] is not None
             fkey = (mesh, op, pre, post, shapes, dtypes, wire_now,
-                    active_mask, strategy, donate, use_ef)
+                    active_mask, strategy, donate, use_ef or use_hier_ef,
+                    bucket_cross, prog_mesh)
             prog = _flush_plans.get(fkey)
             if prog is None:
                 if len(_flush_plans) >= 2048:   # runaway-signature guard
                     _flush_plans.clear()
-                prog_mesh = topo.mesh2d if strategy != "flat" else mesh
                 prog = _flush_plans[fkey] = _fused_program(
                     prog_mesh, n, op, pre, post, shapes, dtypes, wire_now,
-                    active_mask, strategy, donate, use_ef)
+                    active_mask, strategy, donate, use_ef or use_hier_ef,
+                    bucket_cross)
             args = list(tensors)
             ef_key = ("fusion", fkey)
             if use_ef:
                 res = _wire.ef_get(ef_key)
                 if res is None:
                     res = self._zero_residual(mesh, n, sum(sizes))
+                args.append(res)
+            elif use_hier_ef:
+                # The torus_qcross residual covers the CROSS leg's shard
+                # only, sharded over the decomposition mesh.
+                res = _wire.ef_get(ef_key)
+                if res is None:
+                    from horovod_tpu.common.topology import (CROSS_AXIS,
+                                                             LOCAL_AXIS)
+                    from jax.sharding import NamedSharding
+                    res = _wire.zero_residual(
+                        prog_mesh,
+                        NamedSharding(prog_mesh, P((CROSS_AXIS,
+                                                    LOCAL_AXIS))),
+                        n, hier_facts["shard_elems"])
                 args.append(res)
             # Wire accounting for the bucket (buckets are dtype-
             # homogeneous, so dtypes[0] stands for the payload).
@@ -1036,10 +1252,47 @@ class FusionRuntime:
                 jnp.dtype(wire_now).name
                 if wire_now is not None
                 and not _wire.is_quantized(wire_now)
+                and strategy != "torus_qcross"
                 and np.issubdtype(np.dtype(dtypes[0]), np.floating)
                 else dtypes[0])
-            wire_nbytes = _wire.allreduce_wire_bytes(
-                bucket_bytes, np.dtype(dtypes[0]).itemsize, n, eff_wire)
+            if hier_bucket and slices_now > 1 \
+                    and strategy in ("torus", "torus_qcross"):
+                # Per-tier accounting of the decomposition (the same
+                # wire.hierarchical_wire_bytes integers the static
+                # model's hierarchical what-if predicts): ICI legs at the
+                # effective payload width, the DCN leg at the cross wire.
+                # Gated on a REAL slice hierarchy — over the 1-slice
+                # mesh2d fallback the "cross" axis is the host boundary
+                # inside one slice, where the static model (rightly)
+                # predicts zero DCN; the legacy "hierarchical" strategy
+                # keeps the flat-formula accounting below for the same
+                # reason (its whole-buffer cross has no static mirror).
+                from horovod_tpu.common.topology import CROSS_AXIS
+                width = np.dtype(eff_wire).itemsize
+                h = _wire.hierarchical_wire_bytes(
+                    sum(sizes), n, int(prog_mesh.shape[CROSS_AXIS]),
+                    width,
+                    cross_wire=(hier_facts or {}).get("cross_label") or "")
+                cross_label = h["cross_label"]
+                wire_recs = [
+                    ("fused", eff_wire, h["ici"],
+                     eff_wire != dtypes[0], {"ici": h["ici"]}),
+                    ("fused", cross_label or eff_wire, h["dcn"],
+                     cross_label is not None or eff_wire != dtypes[0],
+                     {"dcn": h["dcn"]})]
+                wire_nbytes = h["ici"] + h["dcn"]
+            else:
+                wire_nbytes = _wire.allreduce_wire_bytes(
+                    bucket_bytes, np.dtype(dtypes[0]).itemsize, n,
+                    eff_wire)
+                tiers = None
+                if quant_label is not None:
+                    from horovod_tpu.ops.collective_ops import \
+                        _quantized_wire_tiers
+                    tiers = _quantized_wire_tiers(sum(sizes), n,
+                                                  list(range(n)))
+                wire_recs = [("fused", eff_wire, wire_nbytes,
+                              eff_wire != dtypes[0], tiers)]
             # _timeline_op supplies BOTH the timeline span and the
             # transport-failure → HorovodInternalError translation: a peer
             # dying mid fused collective must be recoverable by the elastic
@@ -1049,13 +1302,12 @@ class FusionRuntime:
             # here — the flush may be running on the cycle thread, where
             # there is no caller.
             from horovod_tpu.ops.collective_ops import _timeline_op
+            any_ef = use_ef or use_hier_ef
             try:
                 with _timeline_op(f"fused_allreduce[{len(items)}]",
-                                  "ALLREDUCE", tensors,
-                                  wire=("fused", eff_wire, wire_nbytes,
-                                        eff_wire != dtypes[0])):
+                                  "ALLREDUCE", tensors, wire=wire_recs):
                     outs = prog(*args)
-                    if use_ef:
+                    if any_ef:
                         # The residual stays a device-resident global
                         # array between flushes; the next key-matched
                         # bucket feeds it straight back.
@@ -1064,6 +1316,11 @@ class FusionRuntime:
                     # Multi-process: hand back this process's local rows,
                     # matching the sync ops' contract.
                     outs = _localize(list(outs), mesh)
+                    if hier_bucket and overlap_mode == "off":
+                        # Overlap collapsed entirely: the cross leg's
+                        # wait lands INSIDE the flush bracket (booked to
+                        # collective — the A/B's baseline arm).
+                        jax.block_until_ready(outs)
             except Exception as e:  # noqa: BLE001
                 # A failed dispatch also evicts its flush plan (never pin
                 # a program that just raised — rebuild costs one lru hit)
@@ -1071,11 +1328,24 @@ class FusionRuntime:
                 # broken; after elastic recovery it would be a
                 # dead-backend array).
                 _flush_plans.pop(fkey, None)
-                if use_ef:
+                if any_ef:
                     _wire.ef_pop(ef_key)
                 for _, h in items:
                     h._set_error(e)
                 continue
+            if hier_bucket and overlap_mode != "off":
+                # Overlap on: leave the DCN leg in flight; the await
+                # happens at the mode's deferred sync point (next flush /
+                # fence / shutdown) and books to cross_wait. Runs under
+                # self._lock (we are inside _flush_locked). BOUNDED: a
+                # pure-async workload that never fences must not pin an
+                # unbounded tail of result buffers — beyond the cap the
+                # oldest entry is simply dropped (its handles own the
+                # arrays; only the cross_wait attribution for that bucket
+                # is forfeited, never correctness).
+                if len(self._inflight_cross) >= self._INFLIGHT_CAP:
+                    self._inflight_cross.pop(0)
+                self._inflight_cross.append(outs)
             for (_, h), o in zip(items, outs):
                 h._set(o)
         if profile_on:

@@ -8,8 +8,12 @@ quantized KV cache), the EQuARX-style two-phase quantized allreduce
 phases inside XLA), per-bucket **error-feedback** accumulators (residual
 kept in fp32, added back before the next quantize — the Horovod compression
 design of arXiv:1802.05799 pairs lossy wire formats with exactly this), the
-per-process-set wire-dtype registry the autotuner steers, and the
-wire-byte accounting behind ``wire_bytes_total{dtype}``.
+per-process-set wire-dtype registry the autotuner steers (with per-LINK-TIER
+keys — ``ps@dcn`` is the cross-slice leg's policy of the hierarchical
+dispatch tier — and the sibling dispatch-strategy registry), the
+slice-boundary tier-split math shared with the static cost model
+(``ring_dcn_fraction``/``a2a_dcn_fraction``/``hierarchical_wire_bytes``),
+and the wire-byte accounting behind ``wire_bytes_total{dtype,tier}``.
 
 Three dispatch paths consume it (each records
 ``wire_compression_events_total{path,dtype}``):
@@ -312,9 +316,22 @@ def reset_error_feedback():
 # ----------------------------------------------------------------------------
 
 _wire_lock = threading.RLock()
-_wire_registry = {}            # ps_label -> (value, source: "user"|"runtime")
+_wire_registry = {}            # key -> (value, source: "user"|"runtime")
+#                                key = ps_label, or "ps@tier" for the
+#                                per-link-tier policy (tier="dcn": the
+#                                cross-slice leg of the hierarchical
+#                                dispatch tier)
 
 _ACCEPTED = ("", "float16", "bfloat16", "int8", "fp8")
+
+# Link tiers of the slice hierarchy (the wire_bytes_total{tier} label
+# values): "ici" = in-slice interconnect, "dcn" = the scarce cross-slice
+# network the 2-level decomposition exists to relieve.
+TIERS = ("ici", "dcn")
+
+
+def _registry_key(ps_label, tier=None):
+    return str(ps_label) if not tier else f"{ps_label}@{tier}"
 
 
 def _normalize(dtype):
@@ -333,7 +350,7 @@ def _normalize(dtype):
     return resolve_wire_dtype(name)
 
 
-def set_wire_dtype(dtype, ps_label="global"):
+def set_wire_dtype(dtype, ps_label="global", tier=None):
     """Set the wire dtype for one process set ('' restores full
     precision). Returns the normalized value in effect. Dispatch plans are
     keyed on the wire dtype, so a flip simply routes subsequent eager
@@ -341,37 +358,116 @@ def set_wire_dtype(dtype, ps_label="global"):
     invalidation, no desync window. An explicit call here PINS the set:
     the fusion runtime's boundary sync (the autotuner's adoption path)
     no longer overwrites it — that is what makes the troubleshooting
-    'bisect with the registry' A/B stick while async flushes continue."""
+    'bisect with the registry' A/B stick while async flushes continue.
+    ``tier="dcn"`` sets the per-link-tier policy instead: the wire of the
+    cross-slice leg of the hierarchical dispatch tier."""
     name = _normalize(dtype)
     with _wire_lock:
-        _wire_registry[str(ps_label)] = (name, "user")
+        _wire_registry[_registry_key(ps_label, tier)] = (name, "user")
     return name
 
 
-def runtime_sync_wire_dtype(dtype, ps_label="global"):
+def runtime_sync_wire_dtype(dtype, ps_label="global", tier=None):
     """Fusion-boundary adoption of the runtime/autotuner wire snapshot:
     like :func:`set_wire_dtype` but it DEFERS to an explicit user pin
     (see above). Returns the value actually in effect."""
     name = _normalize(dtype)
     with _wire_lock:
-        cur = _wire_registry.get(str(ps_label))
+        key = _registry_key(ps_label, tier)
+        cur = _wire_registry.get(key)
         if cur is not None and cur[1] == "user":
             return cur[0]
-        _wire_registry[str(ps_label)] = (name, "runtime")
+        _wire_registry[key] = (name, "runtime")
     return name
 
 
-def wire_dtype_for(ps_label, default=""):
+def wire_dtype_for(ps_label, default="", tier=None):
     """Effective wire dtype for a process set: the registry's entry, else
-    ``default`` (normally the config knob)."""
+    ``default`` (normally the config knob). With ``tier`` the per-tier
+    entry (``ps@tier``) is consulted; absent one, ``default`` applies —
+    pass the resolved tier default (e.g. ``config.wire_dtype_dcn or
+    config.wire_dtype`` for the DCN leg)."""
     with _wire_lock:
-        v = _wire_registry.get(str(ps_label))
+        v = _wire_registry.get(_registry_key(ps_label, tier))
     return resolve_wire_dtype(default) if v is None else v[0]
+
+
+def cross_wire_for(ps_label, config):
+    """Effective wire dtype of the CROSS-SLICE (DCN) leg for one process
+    set — THE resolution chain runtime and static model share: per-tier
+    registry entry (``ps@dcn``), else ``HOROVOD_WIRE_DTYPE_DCN``, else
+    the flat wire knob (a job that quantizes its flat wire wants the
+    scarce leg quantized at least as much)."""
+    default = getattr(config, "wire_dtype_dcn", "") \
+        or getattr(config, "wire_dtype", "")
+    return wire_dtype_for(ps_label, default, tier="dcn")
 
 
 def clear_wire_registry():
     with _wire_lock:
         _wire_registry.clear()
+
+
+# ----------------------------------------------------------------------------
+# Per-process-set dispatch-strategy registry (flat / hier / hier_qcross).
+#
+# The autotuner's strategy categorical is adopted per process set at flush
+# boundaries exactly like the wire dtype above: the coordinator syncs when
+# it publishes a boundary, followers when they apply it, and an explicit
+# hvd.set_dispatch_strategy call pins the set against runtime sync.
+# ----------------------------------------------------------------------------
+
+STRATEGIES = ("", "flat", "hier", "hier_qcross")
+
+_strategy_registry = {}        # ps_label -> (value, source)
+
+
+def _normalize_strategy(strategy):
+    s = strategy or ""
+    if s not in STRATEGIES:
+        raise ValueError(
+            f"dispatch strategy {strategy!r}: expected one of {STRATEGIES}"
+            " ('' = config default; hier = 2-level RS/cross/AG; "
+            "hier_qcross = hierarchical with the cross leg on the "
+            "quantized wire)")
+    return s
+
+
+def set_dispatch_strategy(strategy, ps_label="global"):
+    """Pin the eager allreduce dispatch strategy for one process set
+    ('' restores the config default). Like :func:`set_wire_dtype`, plans
+    are keyed on the strategy, so a flip routes the next dispatch through
+    a differently-keyed plan with no desync window."""
+    s = _normalize_strategy(strategy)
+    with _wire_lock:
+        _strategy_registry[str(ps_label)] = (s, "user")
+    return s
+
+
+def runtime_sync_dispatch_strategy(strategy, ps_label="global"):
+    """Flush-boundary adoption of the autotuner's strategy choice; defers
+    to an explicit user pin like :func:`runtime_sync_wire_dtype`."""
+    s = _normalize_strategy(strategy)
+    with _wire_lock:
+        cur = _strategy_registry.get(str(ps_label))
+        if cur is not None and cur[1] == "user":
+            return cur[0]
+        _strategy_registry[str(ps_label)] = (s, "runtime")
+    return s
+
+
+def dispatch_strategy_for(ps_label, default=""):
+    """Effective dispatch strategy for a process set: registry entry,
+    else ``default`` (normally derived from
+    ``config.hierarchical_dispatch``)."""
+    with _wire_lock:
+        v = _strategy_registry.get(str(ps_label))
+    return (default or "") if v is None or not v[0] else v[0]
+
+
+def clear_strategy_registry():
+    with _wire_lock:
+        _strategy_registry.clear()
 
 
 def zero_residual(mesh, sharding, n, flat_len):
@@ -443,6 +539,85 @@ def quantized_eligible(total_per_rank_elems, n, all_float, sum_or_avg):
     fewer bytes."""
     return bool(all_float and sum_or_avg
                 and int(total_per_rank_elems) >= max(int(n), 1) * BLOCK)
+
+
+def ring_dcn_fraction(members, slice_size):
+    """Fraction of a rank-ordered ring's hops that cross a slice boundary
+    (wraparound included): ``S/n`` for the world-spanning global set. THE
+    tier-split rule shared by the runtime counters
+    (``metrics.record_wire``'s default split) and the static cost model
+    (``analysis/cost.py``), so the two can never disagree."""
+    m = len(members)
+    if m <= 1:
+        return 0.0
+    from horovod_tpu.common.topology import slice_of_rank
+    crossings = sum(
+        slice_of_rank(members[i], slice_size)
+        != slice_of_rank(members[(i + 1) % m], slice_size)
+        for i in range(m))
+    return crossings / m
+
+
+def a2a_dcn_fraction(members, slice_size):
+    """Fraction of all-to-all destination rows that land in a foreign
+    slice: ``1 - slice_size/n`` for the world-spanning global set (shared
+    with the static cost model like :func:`ring_dcn_fraction`)."""
+    m = len(members)
+    if m <= 1:
+        return 0.0
+    from horovod_tpu.common.topology import slice_of_rank
+    counts = {}
+    for r in members:
+        s = slice_of_rank(r, slice_size)
+        counts[s] = counts.get(s, 0) + 1
+    same = sum(c * c for c in counts.values())
+    return (m * m - same) / (m * m)
+
+
+def split_tiers(nbytes, frac_dcn):
+    """``{"ici": b, "dcn": b}`` for one leg's bytes at a DCN fraction —
+    one rounding rule (round-half-even on the DCN share) everywhere, so
+    runtime counters and static predictions agree to the byte."""
+    nbytes = int(nbytes)
+    dcn = int(round(nbytes * frac_dcn))
+    return {"ici": nbytes - dcn, "dcn": dcn}
+
+
+def hierarchical_wire_bytes(per_rank_elems, n, num_slices, itemsize,
+                            cross_wire=""):
+    """Per-tier byte accounting for ONE 2-level hierarchical allreduce
+    (local RS -> cross-slice allreduce -> local AG) of a
+    ``per_rank_elems``-element per-rank buffer over ``n`` ranks in
+    ``num_slices`` slices — the SAME integer formulas the runtime
+    dispatch records and the static model's hierarchical what-if
+    predicts, which is what makes ``cross_check_bytes`` exact (delta 0)
+    on the CPU tier.
+
+    Convention (matching the flat accounting): each leg counts
+    participants x per-participant payload x width; the exact cross
+    allreduce counts both its internal legs. Returns ``{"ici", "dcn",
+    "cross_label", "shard_elems", "local_size", "num_slices"}`` —
+    ``cross_label`` is the quantized label actually eligible on the
+    cross leg (None = exact: sub-block shards would INFLATE on the
+    exchange's S x BLOCK padding, same refusal as the flat wire)."""
+    n = max(int(n), 1)
+    num_slices = max(int(num_slices), 1)
+    itemsize = max(int(itemsize), 1)
+    local = max(n // num_slices, 1)
+    shard = -(-int(per_rank_elems) // local)         # ceil
+    padded = shard * local
+    ici = 2 * n * padded * itemsize                  # local RS + local AG
+    label = quantized_label(cross_wire)
+    if label is not None and not quantized_eligible(
+            shard, num_slices, True, True):
+        label = None
+    if label is not None:
+        dcn = local * exchange_wire_bytes(shard, num_slices)
+    else:
+        dcn = 2 * n * shard * itemsize               # exact cross RS+AG
+    return {"ici": ici, "dcn": dcn, "cross_label": label,
+            "shard_elems": shard, "local_size": local,
+            "num_slices": num_slices}
 
 
 def allreduce_wire_bytes(payload_bytes, itemsize, n, wire):

@@ -25,7 +25,8 @@ from horovod_tpu.common.topology import CROSS_AXIS, LOCAL_AXIS
 
 
 def allreduce_torus(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
-                    average=False, flatten=True, cross_compression=None):
+                    average=False, flatten=True, cross_compression=None,
+                    cross_residual=None, record=True):
     """2-level allreduce: ICI reduce-scatter, DCN shard allreduce, ICI
     all-gather. Bit-equivalent to a flat allreduce (UNLESS
     ``cross_compression`` is set); bandwidth-optimal when the cross link is
@@ -34,31 +35,60 @@ def allreduce_torus(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
     ``x`` is this chip's local value. Requires ``x.size`` divisible by the
     local axis size when ``flatten`` (pads otherwise).
 
-    ``cross_compression="int8"`` (lossy) quantizes ONLY the cross (DCN) leg
-    via :func:`allreduce_int8` — the ICI reduce-scatter/all-gather stay
-    full precision while the slow inter-slice hop moves ~2 bytes/element
-    (the EQuARX deployment shape: quantize where bandwidth hurts). Shards
-    too small to amortize the int8 exchange's cross_n×1024 block padding
-    fall back to the exact psum (compressing them would move MORE bytes).
+    ``cross_compression="int8"``/``"fp8"`` (lossy) quantizes ONLY the
+    cross (DCN) leg through the block-scaled exchange — the ICI
+    reduce-scatter/all-gather stay full precision while the slow
+    inter-slice hop moves ~2 bytes/element (the EQuARX deployment shape:
+    quantize where bandwidth hurts). Eligibility rides THE shared
+    :func:`horovod_tpu.ops.wire.quantized_eligible` predicate (the same
+    refusal the flat wire applies): shards below one BLOCK per cross rank
+    would INFLATE on the exchange's padding and stay exact.
+
+    ``cross_residual`` (per-bucket error feedback for the quantized cross
+    leg): an fp32 buffer of the local SHARD's size
+    (``ceil(x.size / local_n)``) holding the previous round's cross-leg
+    quantization error; when given, returns ``(out, new_residual)`` —
+    the residual passes through unchanged when the cross leg stays exact.
+
+    ``record=False`` suppresses the per-tier trace-time wire accounting:
+    the runtime's eager/fused hierarchical programs pass it because they
+    meter each dispatch themselves — double counting would break the
+    cost model's exact cross-check.
     """
+    from horovod_tpu.ops import wire as _wire
     local_n = lax.axis_size(local_axis)
+    cross_n = lax.axis_size(cross_axis)
     orig_shape = x.shape
     flat = x.reshape(-1)
     pad = (-flat.size) % local_n
     if pad:
         flat = jnp.pad(flat, (0, pad))
-    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0, tiled=True)
-    cross_n = lax.axis_size(cross_axis)
-    if cross_compression == "int8" and shard.size >= cross_n * 1024:
-        shard = allreduce_int8(shard, axis_name=cross_axis)
-    elif cross_compression == "int8":
-        # Below one 1024-block per cross rank the padded int8 exchange
-        # would move MORE bytes than the exact fp32 psum — stay exact.
-        shard = lax.psum(shard, cross_axis)
-    elif cross_compression is not None:
-        raise ValueError(
-            f"unknown cross_compression {cross_compression!r}; "
-            "use None or 'int8'")
+    label = None
+    if cross_compression is not None:
+        label = _wire.quantized_label(cross_compression)
+        if label is None and cross_compression not in (
+                "", "int8", "fp8", "float16", "bfloat16"):
+            raise ValueError(
+                f"unknown cross_compression {cross_compression!r}; "
+                "use None/'' (exact), 'int8' or 'fp8' (16-bit wire names "
+                "are accepted for policy-chain compatibility and keep the "
+                "cross leg exact — a cast cross wire is not implemented)")
+    shard = lax.psum_scatter(flat, local_axis, scatter_dimension=0,
+                             tiled=True)
+    all_float = jnp.issubdtype(x.dtype, jnp.floating)
+    if label is not None and not _wire.quantized_eligible(
+            shard.size, cross_n, all_float, True):
+        # Shared refusal with the flat wire tier: below one BLOCK per
+        # cross rank the padded exchange moves MORE bytes than the exact
+        # psum (and non-float payloads never quantize).
+        label = None
+    if record:
+        _record_jit_wire_tiered(x, flat.size, local_n, cross_n, label)
+    new_res = cross_residual
+    if label is not None:
+        shard, new_res = _wire.block_scaled_allreduce(
+            shard, residual=cross_residual, axis_name=cross_axis,
+            wire=label)
     else:
         shard = lax.psum(shard, cross_axis)
     full = lax.all_gather(shard, local_axis, axis=0, tiled=True)
@@ -66,17 +96,53 @@ def allreduce_torus(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
         full = full[:-pad]
     out = full.reshape(orig_shape)
     if average:
-        n = local_n * lax.axis_size(cross_axis)
+        n = local_n * cross_n
         out = out / jnp.asarray(n, out.dtype)
+    if cross_residual is not None:
+        return out, new_res
     return out
 
 
-def allgather_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS):
+def allreduce_tiered(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
+                     average=False, cross_wire=None, residual=None,
+                     prescale_factor=1.0, postscale_factor=1.0):
+    """The in-jit entry of the hierarchical dispatch tier: local RS
+    (exact, ICI) -> cross-slice allreduce on ``cross_wire`` (DCN) ->
+    local AG, with the reference's pre/postscale applied around the
+    decomposition. Delegates to :func:`allreduce_torus`; ``cross_wire``
+    defaults to the per-tier policy
+    (:func:`horovod_tpu.ops.wire.cross_wire_for` of the global set) so a
+    jit step follows the same HOROVOD_WIRE_DTYPE_DCN / registry chain as
+    the eager and fused paths. With ``residual`` (fp32, the local shard's
+    size, threaded through the caller's optimizer state — zero it on
+    elastic reset, hvdlint HVP109) returns ``(out, new_residual)``."""
+    if cross_wire is None:
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import wire as _wire
+        try:
+            cross_wire = _wire.cross_wire_for("global", basics.config())
+        except Exception:  # noqa: BLE001 — uninitialized: exact cross
+            cross_wire = ""
+    if prescale_factor != 1.0:
+        x = x * jnp.asarray(prescale_factor, x.dtype)
+    out = allreduce_torus(x, cross_axis=cross_axis, local_axis=local_axis,
+                          average=average, cross_compression=cross_wire or
+                          None, cross_residual=residual)
+    out, new_res = out if residual is not None else (out, None)
+    if postscale_factor != 1.0:
+        out = out * jnp.asarray(postscale_factor, out.dtype)
+    return out if residual is None else (out, new_res)
+
+
+def allgather_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
+                           record=True):
     """2-level allgather: gather within each host's chips first, then one
     cross-host gather of whole host-blocks (reference:
     MPIHierarchicalAllgather, mpi_operations.cc — node-local gather then
     cross-node exchange of node blocks; knob
-    HOROVOD_HIERARCHICAL_ALLGATHER common.h:131).
+    HOROVOD_HIERARCHICAL_ALLGATHER common.h:131). ``record=False``
+    suppresses the trace-time wire accounting (the runtime's eager
+    allgather program meters its own dispatches).
 
     ``x`` is this chip's local value; returns ``(n_total, *x.shape)`` in
     global rank-major order (rank = cross * local_size + local, matching
@@ -85,16 +151,47 @@ def allgather_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS):
     contiguous block per HOST instead of interleaving per-chip messages
     (the cross axis of mesh2d is the host boundary, like the reference's
     node boundary)."""
+    try:
+        if record:
+            local_n = int(lax.axis_size(local_axis))
+            cross_n = int(lax.axis_size(cross_axis))
+            n = local_n * cross_n
+            width = jnp.dtype(x.dtype).itemsize
+            # Local gather: n ranks each contribute x.size over ICI;
+            # cross gather: n ranks each move their whole local block
+            # (local_n * x.size) over DCN — the per-tier trace-time twin
+            # of _record_jit_wire.
+            _record_wire_tiers(str(jnp.dtype(x.dtype)), {
+                "ici": n * int(x.size) * width,
+                "dcn": n * local_n * int(x.size) * width})
+    except Exception:  # noqa: BLE001 — accounting must never break a trace
+        pass
     loc = lax.all_gather(x, local_axis, axis=0, tiled=False)
     full = lax.all_gather(loc, cross_axis, axis=0, tiled=False)
     return full.reshape((-1,) + x.shape)
 
 
 def allreduce_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
-                           average=False):
+                           average=False, record=True):
     """Hierarchical 2-phase allreduce: full local reduce then cross reduce.
     Moves the whole buffer on the cross link (unlike torus) but needs no
-    divisibility; matches NCCLHierarchicalAllreduce's structure."""
+    divisibility; matches NCCLHierarchicalAllreduce's structure.
+    ``record=False`` suppresses the trace-time wire accounting (the
+    fusion runtime meters its own bucket dispatches)."""
+    try:
+        if record:
+            local_n = int(lax.axis_size(local_axis))
+            cross_n = int(lax.axis_size(cross_axis))
+            n = local_n * cross_n
+            width = jnp.dtype(x.dtype).itemsize
+            # Both psum stages count both internal legs; the cross stage
+            # moves the WHOLE buffer per rank (the structural difference
+            # from torus this accounting makes visible).
+            _record_wire_tiers(str(jnp.dtype(x.dtype)), {
+                "ici": 2 * n * int(x.size) * width,
+                "dcn": 2 * n * int(x.size) * width})
+    except Exception:  # noqa: BLE001
+        pass
     out = lax.psum(lax.psum(x, local_axis), cross_axis)
     if average:
         n = lax.axis_size(local_axis) * lax.axis_size(cross_axis)
@@ -120,6 +217,39 @@ def _record_jit_wire(x, axis_name, wire):
         hvd_metrics.record_wire(
             "jit", wire, _wire.exchange_wire_bytes(int(x.size), n),
             compressed=True)
+    except Exception:  # noqa: BLE001 — accounting must never break a trace
+        pass
+
+
+def _record_wire_tiers(dtype_label, tiers, compressed=False):
+    """Record an explicit per-tier byte split on the jit path (trace-time,
+    like :func:`_record_jit_wire`)."""
+    from horovod_tpu.metrics import instruments as hvd_metrics
+    total = sum(tiers.values())
+    if total:
+        hvd_metrics.record_wire("jit", dtype_label, total,
+                                compressed=compressed, tiers=dict(tiers))
+
+
+def _record_jit_wire_tiered(x, padded_elems, local_n, cross_n, cross_label):
+    """Per-tier trace-time accounting for the 2-level torus/tiered
+    allreduce: ICI legs (local RS + AG) at the payload dtype, the DCN leg
+    at the cross wire — the SAME integer formulas as
+    :func:`horovod_tpu.ops.wire.hierarchical_wire_bytes`, so the runtime
+    counters and the static model's hierarchical what-if agree exactly."""
+    try:
+        from horovod_tpu.ops import wire as _wire
+        n = int(local_n) * int(cross_n)
+        width = jnp.dtype(x.dtype).itemsize
+        # hierarchical_wire_bytes expects the per-rank PRE-padding size;
+        # padded_elems is already local_n-aligned, so shard math matches.
+        h = _wire.hierarchical_wire_bytes(
+            int(padded_elems), n, int(cross_n), width,
+            cross_wire=cross_label or "")
+        _record_wire_tiers(str(jnp.dtype(x.dtype)), {"ici": h["ici"]})
+        _record_wire_tiers(cross_label or str(jnp.dtype(x.dtype)),
+                           {"dcn": h["dcn"]},
+                           compressed=cross_label is not None)
     except Exception:  # noqa: BLE001 — accounting must never break a trace
         pass
 

@@ -42,7 +42,14 @@ import time
 
 from horovod_tpu.common.config import _env_bool, _env_int
 
-CATEGORIES = ("host_dispatch", "collective", "fusion", "control_plane")
+CATEGORIES = ("host_dispatch", "collective", "fusion", "control_plane",
+              "cross_wait")
+# ``cross_wait`` — time the main thread spends awaiting a hierarchical
+# bucket's in-flight CROSS-SLICE (DCN) leg at a deferred sync point
+# (fence / next flush / shutdown) under the fusion runtime's cross-leg
+# overlap. Booked here — OUTSIDE the flush critical path — it is the
+# overlap-on A/B's measurable: with overlap collapsed the same wait lands
+# inside the flush bracket (collective/fusion) instead.
 
 
 def median(xs):
@@ -128,6 +135,10 @@ class StepLedger:
     def add_control_plane(self, dur_s):
         with self._lock:
             self._acc["control_plane"] += dur_s
+
+    def add_cross_wait(self, dur_s):
+        with self._lock:
+            self._acc["cross_wait"] += dur_s
 
     def collective_total(self):
         """Current window's accumulated collective seconds — the fusion
@@ -352,6 +363,14 @@ def record_control_plane(dur_s):
     if not armed:
         return
     _ledger.add_control_plane(dur_s)
+
+
+def record_cross_wait(dur_s):
+    """Await of an overlapped hierarchical bucket's cross-slice leg at a
+    deferred sync point (fusion cross-leg overlap)."""
+    if not armed:
+        return
+    _ledger.add_cross_wait(dur_s)
 
 
 def collective_total():

@@ -383,7 +383,9 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_PEAK_ICI_GBS", "HOROVOD_PEAK_DCN_GBS",
                 "HVD_FLASH_BLOCK", "HVD_FLASH_ALLOW_PADDED",
                 "HVD_BENCH_PROGRESS_FILE", "HOROVOD_DCN_BYTES_BUDGET",
-                "HOROVOD_WIRE_DTYPE", "HOROVOD_WIRE_ERROR_FEEDBACK"):
+                "HOROVOD_WIRE_DTYPE", "HOROVOD_WIRE_ERROR_FEEDBACK",
+                "HOROVOD_WIRE_DTYPE_DCN", "HOROVOD_HIERARCHICAL_DISPATCH",
+                "HOROVOD_CROSS_OVERLAP"):
         if os.environ.get(var):
             env.setdefault(var, os.environ[var])
     # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU

@@ -472,6 +472,105 @@ class TestMultiprocCrossCheck:
 # ---------------------------------------------------------------------------
 
 
+class TestHierarchicalExchangeShape:
+    """check_program recognition of the hierarchical 2-level exchange
+    (local RS -> cross -> local AG), the HVP113 1-slice advisory, and the
+    HVP106 suppression for a block-scaled cross leg — pos/neg corpus."""
+
+    @staticmethod
+    def _torus_step(cross_wire):
+        from horovod_tpu.parallel.strategies import allreduce_torus
+        mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                    ("cross", "local"))
+
+        def step(x):
+            def inner(xl):
+                return allreduce_torus(
+                    xl.reshape(-1),
+                    cross_compression=cross_wire).reshape(xl.shape)
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P(("cross", "local")),
+                out_specs=P(("cross", "local")), check_vma=False))(x)
+
+        return step
+
+    def test_triads_recognized_with_quantized_flag(self, hvd):
+        from horovod_tpu.analysis.program import hier_triads
+        x = np.ones((8, 2 * 8 * 1024), np.float32)
+        rep = hvd.check_program(self._torus_step("int8"), (x,),
+                                world_size=8)
+        triads = hier_triads(rep.sequences[rep.ranks[0]])
+        assert len(triads) == 1
+        assert triads[0]["quantized"]
+        rep_exact = hvd.check_program(self._torus_step(None), (x,),
+                                      world_size=8)
+        triads = hier_triads(rep_exact.sequences[rep_exact.ranks[0]])
+        assert len(triads) == 1
+        assert not triads[0]["quantized"]
+
+    def test_hvp113_hierarchical_over_one_slice(self, hvd, monkeypatch):
+        monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+        x = np.ones((8, 2 * 8 * 1024), np.float32)
+        rep = hvd.check_program(self._torus_step(None), (x,),
+                                world_size=8)
+        assert "HVP113" in _codes(rep.findings)
+        assert rep.ok          # advisory only
+
+    def test_hvp113_clean_on_multislice_layout(self, hvd, monkeypatch):
+        monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+        x = np.ones((8, 2 * 8 * 1024), np.float32)
+        rep = hvd.check_program(self._torus_step(None), (x,),
+                                world_size=8)
+        assert "HVP113" not in _codes(rep.findings)
+
+    def test_hvp113_armed_dispatch_tier_on_one_slice(self, hvd,
+                                                     monkeypatch):
+        """The eager side: HOROVOD_HIERARCHICAL_DISPATCH configured over
+        a 1-slice layout is inert pure-overhead config — advisory."""
+        from horovod_tpu.common.config import Config
+        monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+        x = np.ones((8, 8 * 1024), np.float32)
+
+        def step(x):
+            return hvd.allreduce(x, op=hvd.Sum)
+
+        cfg = Config(hierarchical_dispatch=True)
+        assert "HVP113" in _codes(
+            hvd.check_program(step, (x,), world_size=8,
+                              config=cfg).findings)
+        assert "HVP113" not in _codes(
+            hvd.check_program(step, (x,), world_size=8,
+                              config=Config()).findings)
+
+    def test_hvp106_cross_policy(self, hvd, monkeypatch):
+        """HVP106 fires for a configured DCN wire policy that the jit
+        program ignores (flat fp32 psum), names the wire_dtype_dcn knob —
+        and is suppressed when the program's cross leg IS block-scaled."""
+        from horovod_tpu.common.config import Config
+        monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+        mesh = Mesh(np.array(jax.devices()[:8]), ("hvd",))
+        x = np.ones((8, 2 * 8 * 1024), np.float32)
+
+        def flat_step(x):
+            def inner(xl):
+                return lax.psum(xl, "hvd")
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"), out_specs=P()))(x)
+
+        cfg = Config(wire_dtype_dcn="int8")
+        cfg.wire_error_feedback = False
+        findings = hvd.check_program(flat_step, (x,), world_size=8,
+                                     config=cfg).findings
+        assert "HVP106" in _codes(findings)
+        assert any("wire_dtype_dcn" in f.message for f in findings
+                   if f.code == "HVP106")
+        # quantized cross leg -> the fp32 local legs are the tier's
+        # deliberate ICI policy, not a missed wire
+        assert "HVP106" not in _codes(
+            hvd.check_program(self._torus_step("int8"), (x,),
+                              world_size=8, config=cfg).findings)
+
+
 class TestCostModel:
     def test_tier_split_flat_allreduce(self, hvd):
         """fp32 allreduce over the global set: total = 2x global bytes

@@ -1,0 +1,587 @@
+"""Hierarchical dispatch tier (ISSUE 12 / ROADMAP item 3): slice-aware
+2-level allreduce (local RS -> cross-slice -> local AG) across the eager,
+fused and jit dispatch paths, with the per-link-tier wire policy
+(HOROVOD_WIRE_DTYPE_DCN), split wire_bytes_total{tier,dtype} accounting,
+the strategy registry/autotuner flip, and the fusion flush scheduler's
+cross-leg overlap."""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops import wire
+
+# Cluster workers can't import this module by name; ship workers by value.
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+
+def _tier_bytes(hvd):
+    snap = hvd.metrics_snapshot()
+    out = {}
+    for s in snap.get("wire_bytes_total", {}).get("series", ()):
+        key = (s["labels"]["dtype"], s["labels"].get("tier"))
+        out[key] = out.get(key, 0.0) + s["value"]
+    return out
+
+
+def _delta(a, b):
+    return {k: b.get(k, 0.0) - a.get(k, 0.0)
+            for k in set(a) | set(b) if b.get(k, 0.0) != a.get(k, 0.0)}
+
+
+@pytest.fixture
+def hier(hvd, monkeypatch):
+    """Forced 2-slice layout + armed hierarchical dispatch with an int8
+    cross wire, registries/caches clean on both sides."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.metrics import instruments as ins
+    from horovod_tpu.ops import fusion
+    cfg = basics.config()
+    # Materialize the fusion runtime BEFORE arming the tier: a runtime
+    # first created under the armed config initializes strategy
+    # "torus_qcross" + the armed cross wire, and later flushes re-sync
+    # those into the eager registries AFTER this fixture's registry
+    # cleanup — test-order poison for any later fused test. Snapshot its
+    # tunables and restore them on the way out for the same reason.
+    rt = fusion.get_runtime()
+    prev_rt = rt.strategy, rt.cross_wire, rt.wire_dtype
+    monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+    monkeypatch.setattr(cfg, "hierarchical_dispatch", True)
+    monkeypatch.setattr(cfg, "wire_dtype_dcn", "int8")
+    wire.clear_wire_registry()
+    wire.clear_strategy_registry()
+    wire.reset_error_feedback()
+    ins.reset_tier_split()
+    yield cfg
+    rt.strategy, rt.cross_wire, rt.wire_dtype = prev_rt
+    wire.clear_wire_registry()
+    wire.clear_strategy_registry()
+    wire.reset_error_feedback()
+    ins.reset_tier_split()
+
+
+class TestEagerHierarchical:
+    def test_parity_and_exact_per_tier_bytes(self, hvd, hier):
+        """The eager hierarchical dispatch: value parity with the flat
+        path within the quantized-cross bound, and per-tier counters
+        matching wire.hierarchical_wire_bytes to the byte — the runtime
+        half of the cost model's exact cross-check."""
+        n = hvd.size()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((n, 2 * n * wire.BLOCK)),
+                        jnp.float32)
+        exact = np.asarray(x).mean(axis=0)
+        jax.block_until_ready(hvd.allreduce(x, op=hvd.Average))  # warm
+        t0 = _tier_bytes(hvd)
+        got = np.asarray(hvd.allreduce(x, op=hvd.Average))
+        t1 = _tier_bytes(hvd)
+        rel = np.abs(got[0] - exact).max() / (np.abs(exact).max() + 1e-9)
+        assert 0 < rel < 0.05, rel        # lossy cross leg, but close
+        h = wire.hierarchical_wire_bytes(x.shape[1], n, 2, 4,
+                                         cross_wire="int8")
+        assert h["cross_label"] == "int8"
+        d = _delta(t0, t1)
+        assert d == {("float32", "ici"): float(h["ici"]),
+                     ("int8", "dcn"): float(h["dcn"])}, d
+        # error feedback residual (cross-leg shard) is live in the store
+        assert wire.ef_keys(), "cross-leg EF residual should be stored"
+
+    def test_one_slice_layout_stays_flat(self, hvd, monkeypatch):
+        """A 1-slice layout must keep the flat path even with the tier
+        armed (the decomposition would be pure overhead — HVP113)."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.metrics import instruments as ins
+        cfg = basics.config()
+        monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+        monkeypatch.setattr(cfg, "hierarchical_dispatch", True)
+        ins.reset_tier_split()
+        n = hvd.size()
+        x = jnp.ones((n, n * wire.BLOCK), jnp.float32)
+        t0 = _tier_bytes(hvd)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+        d = _delta(t0, _tier_bytes(hvd))
+        assert np.array_equal(out, np.full_like(out, n))   # exact: flat
+        assert all(k[1] == "ici" for k in d), d            # no dcn series
+        ins.reset_tier_split()
+
+    def test_compression_one_shot_wins_over_hier(self, hvd, hier):
+        """Review regression: a one-shot Compression.int8 request is an
+        explicit per-dispatch opt-in to the FLAT quantized exchange — the
+        hierarchical verdict must not consume-and-drop it."""
+        n = hvd.size()
+        x = jnp.ones((n, n * wire.BLOCK), jnp.float32)
+        snap0 = {tuple(sorted(s["labels"].items())): s["value"]
+                 for s in hvd.metrics_snapshot().get(
+                     "wire_compression_events_total", {}).get("series", ())}
+        key = (("dtype", "int8"), ("path", "eager"))
+        t, ctx = hvd.Compression.int8.compress(x)
+        out = hvd.Compression.int8.decompress(
+            hvd.allreduce(t, op=hvd.Sum), ctx)
+        snap1 = {tuple(sorted(s["labels"].items())): s["value"]
+                 for s in hvd.metrics_snapshot().get(
+                     "wire_compression_events_total", {}).get("series", ())}
+        assert snap1.get(key, 0) == snap0.get(key, 0) + 1, \
+            "the one-shot request must ride the flat quantized exchange"
+        assert np.allclose(np.asarray(out), n, rtol=0.02)
+
+    def test_strategy_flip_via_registry_no_desync(self, hvd, hier):
+        """hvd.set_dispatch_strategy flips route through differently-keyed
+        plans with no invalidation; check_program's predicted streams are
+        rank- and flip-invariant (a flip is a program-key change, never a
+        stream change)."""
+        from horovod_tpu.analysis import events as an_events
+        n = hvd.size()
+        x = np.ones((n, n * wire.BLOCK), np.float32)
+        for strategy in ("flat", "hier_qcross", "flat"):
+            hvd.set_dispatch_strategy(strategy)
+            out = np.asarray(hvd.allreduce(x, op=hvd.Sum))
+            assert np.allclose(out, n, rtol=0.02), strategy
+
+        def step(x):
+            return hvd.allreduce(x, op=hvd.Sum)
+
+        hashes = {}
+        for strategy in ("flat", "hier_qcross"):
+            hvd.set_dispatch_strategy(strategy)
+            rep = hvd.check_program(step, (x,), world_size=n)
+            assert not rep.errors(), rep.findings
+            hs = {r: an_events.sequence_hash(seq)
+                  for r, seq in rep.sequences.items()}
+            assert len(set(hs.values())) == 1      # rank-invariant
+            hashes[strategy] = hs
+        assert hashes["flat"] == hashes["hier_qcross"]   # flip-invariant
+
+    def test_convergence_parity_int8_cross_vs_fp32(self, hvd, hier):
+        """CPU-tier parity acceptance (single-process leg; the 8-proc
+        cluster leg below runs the same scenario across processes):
+        hierarchical+int8-cross with error feedback tracks the flat fp32
+        trajectory within the PR-10 convergence bound."""
+        n, D = hvd.size(), 2 * hvd.size() * wire.BLOCK
+        rng = np.random.default_rng(7)
+        t = rng.standard_normal((n, D)).astype(np.float32)
+        s = (0.5 + rng.random((n, D))).astype(np.float32)
+        t_j, s_j = jnp.asarray(t), jnp.asarray(s)
+
+        def train(steps=40, lr=0.6):
+            w = jnp.zeros(D, jnp.float32)
+            for _ in range(steps):
+                grads = s_j * (w[None, :] - t_j)
+                g = hvd.allreduce(grads, op=hvd.Average)
+                w = w - lr * g[0]
+            return np.asarray(w)
+
+        hvd.set_dispatch_strategy("flat")
+        hvd.set_wire_dtype("", tier="dcn")
+        w_fp32 = train()
+        hvd.set_dispatch_strategy("hier_qcross")
+        hvd.set_wire_dtype("int8", tier="dcn")
+        wire.reset_error_feedback()
+        w_hier = train()
+        ref = np.linalg.norm(w_fp32) + 1e-12
+        d = float(np.linalg.norm(w_hier - w_fp32) / ref)
+        assert d < 0.05, f"hier+int8-cross diverged from flat fp32: {d}"
+
+    def test_clear_program_caches_covers_hierarchy_keys(self, hvd, hier):
+        """Elastic-reset contract: clear_program_caches drops the
+        hierarchy-keyed plans, the hier program/mesh caches AND the
+        cached flat tier split — a resized mesh must never replay a stale
+        slice layout."""
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.ops import collective_ops as C
+        n = hvd.size()
+        x = jnp.ones((n, n * wire.BLOCK), jnp.float32)
+        jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))
+        hier_keys = [k for k in C._plans if len(k) > 9 and k[9] is not None]
+        assert hier_keys, "expected a hierarchy-keyed dispatch plan"
+        assert C._hier_mesh.cache_info().currsize > 0
+        # resolve (and cache) the flat default split for this layout
+        assert ins._default_dcn_fraction() == 2 / n
+        assert ins._tier_frac is not None
+        C.clear_program_caches()
+        assert not C._plans
+        assert C._hier_mesh.cache_info().currsize == 0
+        assert C._hier_allreduce_program.cache_info().currsize == 0
+        assert ins._tier_frac is None
+        assert wire.ef_keys() == []
+
+
+class TestFusedHierarchical:
+    def test_fused_parity_tiers_and_boundary_sync(self, hvd, hier):
+        """torus_qcross fused buckets: value parity, per-tier counters
+        matching the shared formulas exactly, a per-bucket cross-leg EF
+        residual, and the flush snapshot adopting strategy + cross wire
+        into the eager registries (the autotuner's per-process-set
+        boundary discipline)."""
+        from horovod_tpu.ops import fusion
+        n = hvd.size()
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((n, 2 * n * wire.BLOCK)),
+                        jnp.float32)
+        exact = np.asarray(x).mean(axis=0)
+        rt = fusion.get_runtime()
+        prev_s, prev_cw = rt.strategy, rt.cross_wire
+        rt.strategy = "torus_qcross"
+        try:
+            h = hvd.allreduce_async(x, op=hvd.Average, name="hierf")
+            jax.block_until_ready(h.synchronize())       # warm
+            t0 = _tier_bytes(hvd)
+            h = hvd.allreduce_async(x, op=hvd.Average, name="hierf")
+            out = h.synchronize()
+            jax.block_until_ready(out)
+            rt.fence()                                   # drain overlap
+            d = _delta(t0, _tier_bytes(hvd))
+        finally:
+            rt.strategy, rt.cross_wire = prev_s, prev_cw
+        rel = np.abs(np.asarray(out)[0] - exact).max() \
+            / (np.abs(exact).max() + 1e-9)
+        assert rel < 0.05, rel
+        hh = wire.hierarchical_wire_bytes(x.shape[1], n, 2, 4,
+                                          cross_wire="int8")
+        assert d == {("float32", "ici"): float(hh["ici"]),
+                     ("int8", "dcn"): float(hh["dcn"])}, d
+        assert any(k[0] == "fusion" for k in wire.ef_keys())
+        # flush-boundary adoption into the eager registries
+        assert wire.dispatch_strategy_for("global") == "hier_qcross"
+
+    def test_cast_wire_policy_keeps_cross_exact(self, hvd, hier,
+                                                monkeypatch):
+        """Review regression: a 16-bit value reaching the cross-wire
+        policy chain (e.g. HOROVOD_WIRE_DTYPE=bf16 with no DCN override,
+        or fp8 degrading to bfloat16 on an fp8-less build) must keep the
+        cross leg EXACT — not crash allreduce_torus's
+        cross_compression validation."""
+        from horovod_tpu.ops import fusion
+        monkeypatch.setattr(hier, "wire_dtype_dcn", "bfloat16")
+        n = hvd.size()
+        x = jnp.ones((n, 2 * n * wire.BLOCK), jnp.float32)
+        out = np.asarray(hvd.allreduce(x, op=hvd.Sum))     # eager
+        assert np.array_equal(out, np.full_like(out, n)), \
+            "exact cross expected under a cast cross-wire policy"
+        rt = fusion.get_runtime()
+        prev_s, prev_cw = rt.strategy, rt.cross_wire
+        rt.strategy = "torus_qcross"
+        try:
+            fused = hvd.allreduce_async(x, op=hvd.Sum,
+                                        name="castcross").synchronize()
+        finally:
+            rt.strategy, rt.cross_wire = prev_s, prev_cw
+        assert np.array_equal(np.asarray(fused), np.full_like(out, n))
+
+    def test_fused_one_slice_downgrades_to_flat(self, hvd, monkeypatch):
+        """Review regression: a torus_qcross bucket over a 1-slice layout
+        must downgrade to the flat program (no lossy int8 round-trip over
+        a 1-member cross axis, no phantom dcn bytes) — same refusal as
+        the eager verdict and the static model."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.ops import fusion
+        monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+        ins.reset_tier_split()
+        cfg = basics.config()
+        monkeypatch.setattr(cfg, "wire_dtype_dcn", "int8")
+        n = hvd.size()
+        x = jnp.ones((n, 2 * n * wire.BLOCK), jnp.float32)
+        rt = fusion.get_runtime()
+        prev_s, prev_cw = rt.strategy, rt.cross_wire
+        rt.strategy = "torus_qcross"
+        try:
+            t0 = _tier_bytes(hvd)
+            out = hvd.allreduce_async(x, op=hvd.Sum,
+                                      name="oneslice").synchronize()
+            d = _delta(t0, _tier_bytes(hvd))
+        finally:
+            rt.strategy, rt.cross_wire = prev_s, prev_cw
+            ins.reset_tier_split()
+        assert np.array_equal(np.asarray(out),
+                              np.full((n, x.shape[1]), n, np.float32)), \
+            "1-slice bucket must stay EXACT (flat downgrade)"
+        assert all(k[1] == "ici" for k in d), d
+
+    def test_cross_leg_overlap_ab(self, hvd, hier):
+        """Overlap A/B on the same run: with overlap ON the cross leg's
+        wait is booked to the profiler's cross_wait category at the fence
+        (OUTSIDE the flush critical path) and the flush leaves work in
+        flight; with overlap OFF the flush blocks inline and nothing is
+        left in flight (no cross_wait)."""
+        from horovod_tpu.ops import fusion
+        from horovod_tpu.profile import ledger
+        n = hvd.size()
+        x = jnp.ones((n, 2 * n * wire.BLOCK), jnp.float32)
+        rt = fusion.get_runtime()
+        prev = (rt.strategy, rt.cross_wire, rt._overlap, rt._overlap_mode)
+        led = ledger.get()
+        rt.strategy = "torus_qcross"
+        try:
+            # --- overlap ON (widened to the step boundary) ---
+            rt._overlap, rt._overlap_mode = True, "step"
+            wait0 = led._acc["cross_wait"]
+            with rt.cycle_paused():
+                h = hvd.allreduce_async(x, op=hvd.Sum, name="olap")
+                rt.flush_all()
+                assert rt._inflight_cross, \
+                    "overlap on: the cross leg should be left in flight"
+                rt.fence()
+            assert not rt._inflight_cross
+            assert led._acc["cross_wait"] > wait0, \
+                "fence must book the cross wait to cross_wait"
+            h.synchronize()
+            # --- overlap OFF (collapsed into the flush bracket) ---
+            rt._overlap = False
+            wait1 = led._acc["cross_wait"]
+            with rt.cycle_paused():
+                h = hvd.allreduce_async(x, op=hvd.Sum, name="olap0")
+                rt.flush_all()
+                assert not rt._inflight_cross, \
+                    "overlap off: the flush must block inline"
+            h.synchronize()
+            assert led._acc["cross_wait"] == wait1
+        finally:
+            (rt.strategy, rt.cross_wire, rt._overlap,
+             rt._overlap_mode) = prev
+
+
+class TestCrossCheckHierarchical:
+    def test_cross_check_bytes_per_tier_exact(self, hvd, hier):
+        """Acceptance: cross_check_bytes diffs the hierarchical what-if
+        (== the as-dispatched prediction under the armed tier) against
+        the runtime wire_bytes_total{tier} counters EXACTLY — delta 0 on
+        the CPU tier, with the per-tier gate active (live layout ==
+        priced layout)."""
+        from horovod_tpu.analysis import cost as an_cost
+        n = hvd.size()
+        g = np.ones((n, 32 * 1024), np.float32)
+
+        def step(g):
+            return hvd.allreduce(g, op=hvd.Sum)
+
+        jax.block_until_ready(step(g))      # warm: compiles + plan
+        base = hvd.metrics_snapshot()
+        iters = 3
+        for _ in range(iters):
+            jax.block_until_ready(step(g))
+        after = hvd.metrics_snapshot()
+        rep = hvd.check_program(step, (g,), world_size=n)
+        cost = an_cost.cost_report(rep)     # slices from the forced env
+        assert cost.num_slices == 2
+        res = an_cost.cross_check_bytes(cost, after, base, steps=iters)
+        assert res["match"], res
+        assert res["per_tier"], res
+        for t, row in res["per_tier"].items():
+            assert row["gates_match"], res
+            assert row["delta"] == 0.0, (t, res)
+        # the hierarchical what-if IS the as-dispatched prediction here
+        assert cost.hierarchical["ici"] == cost.bytes_by_tier["ici"]
+        assert cost.hierarchical["dcn"] == cost.bytes_by_tier["dcn"]
+
+
+class TestJitTiered:
+    def test_allreduce_tiered_parity_and_small_shard_refusal(
+            self, hvd, hier):
+        """The in-jit entry (strategies.allreduce_tiered over the 2-level
+        mesh): int8-cross parity for block-sized shards; shards below one
+        BLOCK per cross rank refuse the exchange through the SHARED
+        wire.quantized_eligible predicate and stay exact."""
+        from horovod_tpu.ops import collective_ops as C
+        from horovod_tpu.parallel.strategies import allreduce_tiered
+        n = hvd.size()
+        hmesh = C._hier_mesh(hvd.global_process_set.mesh, 2)
+
+        def run(x, cross):
+            f = jax.jit(jax.shard_map(
+                lambda v: allreduce_tiered(
+                    v.reshape(-1), average=True,
+                    cross_wire=cross).reshape(v.shape),
+                mesh=hmesh, in_specs=P(("cross", "local")),
+                out_specs=P(("cross", "local")), check_vma=False))
+            return np.asarray(f(x))
+
+        rng = np.random.default_rng(5)
+        big = jnp.asarray(rng.standard_normal((n, 2 * n * wire.BLOCK)),
+                          jnp.float32)
+        exact = np.asarray(big).mean(axis=0)
+        got = run(big, "int8")
+        rel = np.abs(got[0] - exact).max() / (np.abs(exact).max() + 1e-9)
+        assert 0 < rel < 0.05, rel
+        # sub-block shard: ceil(size/local) < cross_n * BLOCK -> exact
+        small = jnp.ones((n, 8), jnp.float32)
+        got_small = run(small, "int8")
+        assert np.array_equal(got_small, np.ones((n, 8), np.float32))
+
+    def test_trace_time_per_tier_accounting(self, hvd, hier):
+        """Satellite: the jit 2-level path is metered too — compiling a
+        torus program records per-tier wire_bytes_total entries at trace
+        time (once per compiled program, like scaled_allreduce_int8)."""
+        from horovod_tpu.ops import collective_ops as C
+        from horovod_tpu.parallel.strategies import allreduce_torus
+        n = hvd.size()
+        hmesh = C._hier_mesh(hvd.global_process_set.mesh, 2)
+        x = jnp.ones((n, 2 * n * wire.BLOCK), jnp.float32)
+        t0 = _tier_bytes(hvd)
+        f = jax.jit(jax.shard_map(
+            lambda v: allreduce_torus(
+                v.reshape(-1), cross_compression="int8").reshape(v.shape),
+            mesh=hmesh, in_specs=P(("cross", "local")),
+            out_specs=P(("cross", "local")), check_vma=False))
+        jax.block_until_ready(f(x))
+        d = _delta(t0, _tier_bytes(hvd))
+        h = wire.hierarchical_wire_bytes(x.shape[1], n, 2, 4,
+                                         cross_wire="int8")
+        assert d.get(("float32", "ici")) == float(h["ici"]), d
+        assert d.get(("int8", "dcn")) == float(h["dcn"]), d
+
+
+def _hier_parity_worker(steps, lr):
+    """8-process leg of the parity acceptance under HOROVOD_MESH_SLICES=2:
+    hierarchical+int8-cross vs flat fp32 on BOTH the eager and fused
+    paths (importable by name like chaos.soak.soak_train)."""
+    import numpy as np
+
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import fusion, wire as _w
+
+    hvd.init()
+    n = hvd.size()
+    me = hvd.cross_rank()
+    D = 2 * n * _w.BLOCK
+    rng = np.random.default_rng(7)
+    t = rng.standard_normal((n, D)).astype(np.float32)
+    s = (0.5 + rng.random((n, D))).astype(np.float32)
+    rt = fusion.get_runtime()
+
+    def train(fused):
+        w = np.zeros(D, np.float32)
+        for _ in range(steps):
+            grads = jnp.asarray(s[me:me + 1] * (w[None, :] - t[me:me + 1]))
+            if fused:
+                g = hvd.allreduce_async(grads, op=hvd.Average,
+                                        name="hp").synchronize()
+            else:
+                g = hvd.allreduce(grads, op=hvd.Average)
+            w = w - lr * np.asarray(g)[0]
+        return w
+
+    out = {"rank": me, "slices": hvd.topology().num_slices}
+    hvd.set_dispatch_strategy("flat")
+    hvd.set_wire_dtype("", tier="dcn")
+    w_fp32 = train(fused=False)
+    ref = float(np.linalg.norm(w_fp32)) + 1e-12
+    hvd.set_dispatch_strategy("hier_qcross")
+    hvd.set_wire_dtype("int8", tier="dcn")
+    _w.reset_error_feedback()
+    out["d_eager"] = float(np.linalg.norm(train(fused=False) - w_fp32)) \
+        / ref
+    hvd.set_dispatch_strategy("flat")      # fused path drives its own
+    if hvd.cross_rank() == 0:              # strategy via the coordinator
+        rt.strategy = "torus_qcross"
+    _w.reset_error_feedback()
+    out["d_fused"] = float(np.linalg.norm(train(fused=True) - w_fp32)) \
+        / ref
+    snap = hvd.metrics_snapshot()
+    dcn = sum(ser["value"]
+              for ser in snap.get("wire_bytes_total", {}).get("series", ())
+              if ser["labels"].get("tier") == "dcn")
+    out["dcn_bytes"] = dcn
+    return out
+
+
+class TestReviewRegressions:
+    def test_fused_torus_cast_wire_cross_check_exact(self, hvd, hier,
+                                                     monkeypatch):
+        """Review regression: a fused 'torus' bucket under a 16-bit cast
+        wire casts EVERY leg to the wire dtype (_fused_program's
+        cast_wire applies to the exact-cross strategy), so the static
+        model must price the hierarchical legs at the cast width/label —
+        it previously predicted float32-width integers and
+        cross_check_bytes reported match=False on a correctly-behaving
+        torus+float16 job."""
+        from horovod_tpu.analysis import cost as an_cost
+        from horovod_tpu.ops import fusion
+        monkeypatch.setattr(hier, "wire_dtype_dcn", "")
+        monkeypatch.setattr(hier, "wire_dtype", "float16")
+        n = hvd.size()
+        x = jnp.ones((n, 2 * n * wire.BLOCK), jnp.float32)
+        rt = fusion.get_runtime()
+        prev = rt.strategy, rt.cross_wire, rt.wire_dtype
+        rt.strategy, rt.cross_wire = "torus", ""
+        rt.wire_dtype = np.float16
+
+        def step(g):
+            return hvd.allreduce_async(g, op=hvd.Sum,
+                                       name="castf").synchronize()
+
+        try:
+            jax.block_until_ready(step(x))   # warm: compile + policy sync
+            rt.fence()
+            base = hvd.metrics_snapshot()
+            jax.block_until_ready(step(x))
+            rt.fence()
+            after = hvd.metrics_snapshot()
+            rep = hvd.check_program(step, (x,), world_size=n)
+            cost = an_cost.cost_report(rep)
+            res = an_cost.cross_check_bytes(cost, after, base, steps=1)
+        finally:
+            rt.strategy, rt.cross_wire, rt.wire_dtype = prev
+        assert res["match"], res
+        assert "float16" in res["per_dtype"], res
+        for t, row in res["per_tier"].items():
+            assert row["delta"] == 0.0, (t, res)
+        # every leg moved the cast wire: the tier split is the float16
+        # hierarchical integers, not a float32 repricing
+        h = wire.hierarchical_wire_bytes(x.shape[1], n, 2, 2)
+        assert res["per_tier"]["ici"]["measured"] == float(h["ici"]), res
+        assert res["per_tier"]["dcn"]["measured"] == float(h["dcn"]), res
+
+    def test_subslice_set_fallback_books_zero_dcn(self, hvd, hier):
+        """Review regression: the NON-planned eager fallback's tier split
+        must classify by the process set's member ranks like the plan
+        path and the static model — a set confined to one slice books
+        zero dcn even though the world-level default fraction is > 0."""
+        from horovod_tpu.ops import collective_ops as C
+
+        class _FakeSet:
+            def __init__(self, ranks):
+                self.ranks = None if ranks is None else tuple(ranks)
+
+            def rank_list(self):
+                return list(self.ranks)
+
+        wb = 1 << 20
+        # one slice of the 2x4 layout: every ring hop is ICI
+        tiers = C._set_wire_tiers(_FakeSet([0, 1, 2, 3]), wb, "ring")
+        assert tiers == {"ici": wb, "dcn": 0}, tiers
+        # a set straddling the boundary books its real crossing fraction
+        tiers = C._set_wire_tiers(_FakeSet([0, 4]), wb, "ring")
+        assert tiers == {"ici": 0, "dcn": wb}, tiers
+        # global set defers to record_wire's world-level default (None)
+        assert C._set_wire_tiers(_FakeSet(None), wb, "ring") is None
+        assert C._set_wire_tiers(None, wb, "ring") is None
+
+
+@pytest.mark.slow
+class TestHierarchicalParity8Proc:
+    def test_cluster_parity_hier_int8_cross_vs_fp32(self, shared_cluster):
+        """Acceptance: 8-proc CPU-tier cluster under
+        HOROVOD_MESH_SLICES=2 — every worker's hierarchical+int8-cross
+        trajectory (eager AND fused, the fused strategy flipped by the
+        coordinator and adopted at a flush boundary) matches its flat
+        fp32 one within the PR-10 convergence bound, with DCN-tier bytes
+        actually metered."""
+        cluster = shared_cluster(
+            "localhost:1,127.0.0.1:1,127.0.0.2:1,127.0.0.3:1,"
+            "127.0.0.4:1,127.0.0.5:1,127.0.0.6:1,127.0.0.7:1",
+            extra_env={"HOROVOD_MESH_SLICES": "2"})
+        out = cluster.run(_hier_parity_worker, args=(20, 0.6), timeout=600)
+        assert len(out) == 8
+        for r in out:
+            assert r["slices"] == 2, r
+            assert r["d_eager"] < 0.05, r
+            assert r["d_fused"] < 0.05, r
+            assert r["dcn_bytes"] > 0, r

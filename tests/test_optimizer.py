@@ -521,10 +521,10 @@ class TestGroupedAsyncFusion:
         orig = fusion._fused_program
 
         def spy(mesh, n, op, pre, post, shapes, dtypes, wire, mask=None,
-                strategy="flat", donate=(), ef=False):
+                strategy="flat", donate=(), ef=False, cross_wire=""):
             calls.append(len(shapes))
             return orig(mesh, n, op, pre, post, shapes, dtypes, wire, mask,
-                        strategy, donate, ef)
+                        strategy, donate, ef, cross_wire)
 
         try:
             fusion._fused_program = spy

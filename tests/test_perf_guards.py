@@ -548,6 +548,145 @@ class TestHostOverheadBudget:
             f"— the wire tier's host-side cost (plan key, residual store) "
             f"exceeds the 2x budget")
 
+    def test_wire_hier_host_cost_within_2x_flat_plan(self, hvd):
+        """The hierarchical dispatch tier's HOST path (hierarchy-keyed
+        plan hit + cross-leg residual store round-trip + two-tier wire
+        records) must stay within 2x the flat plan's host path, same-run
+        A/B with the XLA program stubbed out — the 3-leg decomposition's
+        compute is device work, not host overhead."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.ops import collective_ops as C
+        from horovod_tpu.ops import wire
+
+        cfg = basics.config()
+        n = hvd.size()
+        x = jnp.ones((n, n * wire.BLOCK), jnp.float32)
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        wire.reset_error_feedback()
+        prev_env = os.environ.get("HOROVOD_MESH_SLICES")
+        prev_hd, prev_cw = cfg.hierarchical_dispatch, cfg.wire_dtype_dcn
+        os.environ["HOROVOD_MESH_SLICES"] = "2"
+        cfg.hierarchical_dispatch, cfg.wire_dtype_dcn = True, "int8"
+        ins.reset_tier_split()
+
+        def host_path_us(strategy):
+            hvd.set_dispatch_strategy(strategy)
+            jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))  # register
+            want_hier = strategy == "hier_qcross"
+            key = [k for k in C._plans
+                   if k[0] == "allreduce" and len(k) > 9
+                   and (k[9] is not None) == want_hier][-1]
+            plan = C._plans[key]
+            staged = jax.device_put(x, plan.sharding)
+            args = [staged]
+            if getattr(plan, "ef", False):
+                r = wire.ef_get(plan.ef_key)
+                if r is None:
+                    r = plan._zero_residual()
+                args.append(r)
+            real = plan.program
+            outs = real(*args)
+            jax.block_until_ready(outs)
+            plan.program = lambda *a, **k: outs
+            try:
+                best = float("inf")
+                for _ in range(3):
+                    ts = []
+                    for _ in range(50):
+                        t0 = time.perf_counter()
+                        hvd.allreduce(staged, op=hvd.Sum)
+                        ts.append(time.perf_counter() - t0)
+                    best = min(best, sorted(ts)[len(ts) // 2])
+            finally:
+                plan.program = real
+            return best * 1e6
+
+        try:
+            flat_us = host_path_us("flat")
+            hier_us = host_path_us("hier_qcross")
+        finally:
+            cfg.hierarchical_dispatch, cfg.wire_dtype_dcn = prev_hd, prev_cw
+            if prev_env is None:
+                os.environ.pop("HOROVOD_MESH_SLICES", None)
+            else:
+                os.environ["HOROVOD_MESH_SLICES"] = prev_env
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+            wire.reset_error_feedback()
+            ins.reset_tier_split()
+        assert hier_us <= 2.0 * flat_us, (
+            f"hierarchical plan host path {hier_us:.0f}us vs flat "
+            f"{flat_us:.0f}us — the 3-leg plan's host-side cost (hier "
+            f"key, residual store, two-tier records) exceeds the 2x "
+            f"budget")
+
+    def test_dcn_bytes_hierarchical_divides_by_slice_width(self, hvd):
+        """Acceptance guard: under a forced 2-slice layout the
+        hierarchical path's wire_bytes_total{tier=dcn} equals the flat
+        dispatch's TOTAL bytes divided by the slice width (exact cross),
+        and the int8 cross leg takes it below 0.3x of that."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.ops import wire
+
+        def tier_bytes():
+            out = {}
+            snap = ins.get_registry().snapshot()
+            for s in snap.get("wire_bytes_total", {}).get("series", ()):
+                key = (s["labels"]["dtype"], s["labels"].get("tier"))
+                out[key] = out.get(key, 0.0) + s["value"]
+            return out
+
+        def delta(f):
+            b0 = tier_bytes()
+            jax.block_until_ready(f())
+            b1 = tier_bytes()
+            return {k: b1.get(k, 0.0) - b0.get(k, 0.0)
+                    for k in set(b0) | set(b1)
+                    if b1.get(k, 0.0) != b0.get(k, 0.0)}
+
+        cfg = basics.config()
+        n = hvd.size()
+        local = n // 2
+        x = jnp.ones((n, 2 * n * wire.BLOCK), jnp.float32)
+        prev_env = os.environ.get("HOROVOD_MESH_SLICES")
+        prev_hd, prev_cw = cfg.hierarchical_dispatch, cfg.wire_dtype_dcn
+        prev_metrics = ins.enabled()
+        os.environ["HOROVOD_MESH_SLICES"] = "2"
+        cfg.hierarchical_dispatch, cfg.wire_dtype_dcn = True, "int8"
+        ins.set_enabled(True)
+        ins.reset_tier_split()
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        try:
+            hvd.set_dispatch_strategy("flat")
+            jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))  # warm
+            flat = delta(lambda: hvd.allreduce(x, op=hvd.Sum))
+            flat_total = sum(flat.values())
+            assert flat_total == 2 * x.nbytes
+            hvd.set_dispatch_strategy("hier")
+            jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))
+            hier = delta(lambda: hvd.allreduce(x, op=hvd.Sum))
+            assert hier[("float32", "dcn")] == flat_total / local, (
+                hier, flat_total)
+            hvd.set_dispatch_strategy("hier_qcross")
+            jax.block_until_ready(hvd.allreduce(x, op=hvd.Sum))
+            q = delta(lambda: hvd.allreduce(x, op=hvd.Sum))
+            assert q[("int8", "dcn")] < 0.3 * flat_total / local, q
+        finally:
+            cfg.hierarchical_dispatch, cfg.wire_dtype_dcn = prev_hd, prev_cw
+            if prev_env is None:
+                os.environ.pop("HOROVOD_MESH_SLICES", None)
+            else:
+                os.environ["HOROVOD_MESH_SLICES"] = prev_env
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+            wire.reset_error_feedback()
+            ins.reset_tier_split()
+            ins.set_enabled(prev_metrics)
+
     def test_wire_bytes_int8_below_0p3x_fp32(self, hvd):
         """Acceptance guard: for a >=4 MB payload, wire_bytes_total shows
         the int8 exchange moving <0.3x the fp32 allreduce's bytes — the
@@ -557,11 +696,12 @@ class TestHostOverheadBudget:
         from horovod_tpu.ops import wire
 
         def wire_bytes(dtype):
+            # summed across the tier label (the counter is {dtype, tier})
             snap = ins.get_registry().snapshot()
-            for s in snap.get("wire_bytes_total", {}).get("series", ()):
-                if s["labels"].get("dtype") == dtype:
-                    return s["value"]
-            return 0.0
+            return sum(
+                s["value"]
+                for s in snap.get("wire_bytes_total", {}).get("series", ())
+                if s["labels"].get("dtype") == dtype)
 
         n = hvd.size()
         elems = max(4 * 1024 * 1024 // 4 // n, n * wire.BLOCK)

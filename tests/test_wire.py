@@ -29,11 +29,11 @@ def _wire_events(hvd):
 
 
 def _wire_bytes(hvd, dtype):
+    # summed across the tier label (the counter is {dtype, tier})
     snap = hvd.metrics_snapshot()
-    for s in snap.get("wire_bytes_total", {}).get("series", ()):
-        if s["labels"].get("dtype") == dtype:
-            return s["value"]
-    return 0.0
+    return sum(s["value"]
+               for s in snap.get("wire_bytes_total", {}).get("series", ())
+               if s["labels"].get("dtype") == dtype)
 
 
 @pytest.fixture
