@@ -1065,6 +1065,98 @@ def _bench_spec(hvd):
           round(toks / dt, 1), "tokens/sec/chip", 0.0)
 
 
+def _bench_serving_sweep(hvd):
+    """Continuous-batching serving bench (`HVD_BENCH_MODEL=serving_sweep`):
+    a request-rate ladder through the serving engine — requests arrive
+    paced at each rung's rate, the engine packs them into its fixed-slot
+    decode batch, and every cell reports p50/p99 time-to-first-token,
+    p50/p99 per-token latency, tokens/sec and peak queue depth as a
+    labeled `serving_sweep` record on the HVD_BENCH_PROGRESS_FILE
+    channel (the tunnel-window evidence path). The final BENCH record is
+    the peak tokens/sec across rungs. Single-chip like the spec bench:
+    the decode path is not mesh-sharded. Knobs: HVD_BENCH_SERVING_RATES
+    (req/s ladder), HVD_BENCH_SERVING_REQUESTS (per rung),
+    HVD_BENCH_SERVING_SLOTS, HVD_BENCH_GENLEN, HVD_BENCH_SERVING_GPT2=1
+    for the full GPT-2-small (default: tiny config — the CPU tier
+    measures the engine, not the matmuls)."""
+    from horovod_tpu.models import GPT, GPTConfig
+    from horovod_tpu.serving import ServingEngine
+
+    if hvd.size() > 1:
+        _mark(f"note: serving bench is single-chip; {hvd.size() - 1} "
+              f"other chip(s) idle")
+    gen_len = int(os.environ.get("HVD_BENCH_GENLEN", "32"))
+    slots = int(os.environ.get("HVD_BENCH_SERVING_SLOTS", "4"))
+    n_req = int(os.environ.get("HVD_BENCH_SERVING_REQUESTS", "24"))
+    rates = [float(r) for r in os.environ.get(
+        "HVD_BENCH_SERVING_RATES", "4,16,64").split(",")]
+    plen = max(1, min(8, gen_len // 4))
+    max_len = plen + gen_len + 1
+    if os.environ.get("HVD_BENCH_SERVING_GPT2", "0") == "1":
+        cfg = GPTConfig(vocab_size=50304, hidden_size=768, num_layers=12,
+                        num_heads=12, intermediate_size=3072,
+                        max_position_embeddings=max_len,
+                        dtype=jnp.bfloat16, tp_axis=None, ep_axis=None)
+    else:
+        cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                             max_position_embeddings=max_len)
+    model = GPT(cfg)
+    rng = np.random.default_rng(0)
+    params = jax.jit(model.init)(
+        jax.random.PRNGKey(0),
+        jnp.zeros((1, plen), jnp.int32))["params"]
+    _mark("serving init done")
+    prompts = [[int(t) for t in rng.integers(0, cfg.vocab_size, plen)]
+               for _ in range(n_req)]
+
+    peak_tps = 0.0
+    for rate in rates:
+        engine = ServingEngine(model, params, num_slots=slots,
+                               max_len=max_len, mark_steps=False)
+        # Warm the three compiled programs outside the timed window.
+        w = engine.submit(prompts[0], max_new=2)
+        engine.run_until_idle()
+        w.result(0)
+        t0 = time.perf_counter()
+        reqs, nxt, peak_q = [], 0, 0
+        while len(reqs) < n_req or not engine.idle():
+            now = time.perf_counter() - t0
+            while nxt < n_req and now >= nxt / rate:
+                reqs.append(engine.submit(prompts[nxt], max_new=gen_len))
+                nxt += 1
+            peak_q = max(peak_q, engine.queue_depth())
+            if not engine.step() and nxt < n_req:
+                time.sleep(min(0.001, max(0.0, nxt / rate - now)))
+        elapsed = time.perf_counter() - t0
+        ttft = np.asarray([r.t_first - r.t_submit for r in reqs])
+        tok_lat = np.asarray([
+            (r.t_done - r.t_first) / max(len(r.committed) - 1, 1)
+            for r in reqs])
+        toks = sum(len(r.committed) for r in reqs)
+        tps = toks / elapsed
+        peak_tps = max(peak_tps, tps)
+        cell = {
+            "rate_rps": rate, "requests": n_req, "slots": slots,
+            "gen_len": gen_len,
+            "ttft_p50_ms": round(float(np.percentile(ttft, 50)) * 1e3, 2),
+            "ttft_p99_ms": round(float(np.percentile(ttft, 99)) * 1e3, 2),
+            "tok_p50_ms": round(float(np.percentile(tok_lat, 50)) * 1e3,
+                                3),
+            "tok_p99_ms": round(float(np.percentile(tok_lat, 99)) * 1e3,
+                                3),
+            "tokens_per_sec": round(tps, 1),
+            "peak_queue_depth": peak_q,
+        }
+        _progress_record("serving_sweep", **cell)
+        _mark(f"serving_sweep {rate:g} req/s: ttft p50/p99 "
+              f"{cell['ttft_p50_ms']}/{cell['ttft_p99_ms']}ms, "
+              f"tok p50/p99 {cell['tok_p50_ms']}/{cell['tok_p99_ms']}ms, "
+              f"{tps:.1f} tok/s, peak queue {peak_q}")
+    _emit("serving_sweep_peak_tokens_per_sec", round(peak_tps, 1),
+          "tokens/sec/chip (continuous-batching engine, peak across the "
+          "request-rate ladder)", 0.0)
+
+
 # Non-image benchmarks: selector -> (bench fn, metric name, unit). One
 # registry so dispatch and failure records can never disagree.
 _EXTRA_MODELS = {
@@ -1085,6 +1177,9 @@ _EXTRA_MODELS = {
     "hierarchy_sweep": (_bench_hierarchy_sweep,
                         "hierarchy_sweep_dcn_bytes_ratio",
                         "hier-int8/flat DCN bytes ratio"),
+    "serving_sweep": (_bench_serving_sweep,
+                      "serving_sweep_peak_tokens_per_sec",
+                      "tokens/sec/chip"),
 }
 
 

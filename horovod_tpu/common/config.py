@@ -287,6 +287,38 @@ class Config:
     # JSONL progress stream consumed by the evidence sentinel ("" = off).
     bench_progress_file: str = ""
 
+    # --- serving (horovod_tpu/serving; docs/inference.md) ---
+    # Serving mode: `hvdrun --serving` sets it; `python -m
+    # horovod_tpu.serving` is the reference worker it launches.
+    serving: bool = False
+    # Request-frontend base port (each process binds port + local_rank,
+    # like the metrics endpoint; 0 = bind a free port).
+    serving_port: int = 0
+    # Decode-batch slot count — the continuous batch's fixed width (one
+    # compiled decode program; slots retire/refill independently).
+    serving_slots: int = 4
+    # KV-cache capacity per slot in tokens (prompt + generation; 0 =
+    # the model config's max_position_embeddings).
+    serving_max_len: int = 0
+    # Chunked-prefill feed width in tokens (time-to-first-token costs
+    # ~P/chunk forwards; the fp32 score transient scales with it).
+    serving_prefill_chunk: int = 64
+    # Admission-queue capacity (0 = unbounded). At the limit submits are
+    # rejected with backpressure (HTTP 503 + Retry-After) and the
+    # /serving/health frame reports saturated — the readiness gate's
+    # stop-routing-here signal.
+    serving_queue_limit: int = 0
+    # Migrate in-flight KV caches through elastic membership changes as
+    # host snapshots (graceful scale up/down resumes decoding without
+    # re-prefill). Off: in-flight requests re-queue from their last
+    # committed token and re-prefill — same token streams either way.
+    serving_migrate_kv: bool = False
+    # Reference-worker model selector (gpt_tiny | gpt2 | llama_tiny).
+    serving_model: str = "gpt_tiny"
+    # Elastic commit cadence in engine steps (the requeue granularity: a
+    # disruption replays at most this many tokens per in-flight request).
+    serving_commit_steps: int = 1
+
     # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
     # the reference's observability stops at timeline + stall inspector).
     # Always-on by default: the registry hot path is O(1) and lock-light
@@ -463,6 +495,22 @@ class Config:
                                       c.dcn_bytes_budget)
         c.bench_progress_file = os.environ.get("HVD_BENCH_PROGRESS_FILE",
                                                c.bench_progress_file)
+        c.serving = _env_bool("HOROVOD_SERVING", c.serving)
+        c.serving_port = _env_int("HOROVOD_SERVING_PORT", c.serving_port)
+        c.serving_slots = _env_int("HOROVOD_SERVING_SLOTS",
+                                   c.serving_slots)
+        c.serving_max_len = _env_int("HOROVOD_SERVING_MAX_LEN",
+                                     c.serving_max_len)
+        c.serving_prefill_chunk = _env_int("HOROVOD_SERVING_PREFILL_CHUNK",
+                                           c.serving_prefill_chunk)
+        c.serving_queue_limit = _env_int("HOROVOD_SERVING_QUEUE_LIMIT",
+                                         c.serving_queue_limit)
+        c.serving_migrate_kv = _env_bool("HOROVOD_SERVING_MIGRATE_KV",
+                                         c.serving_migrate_kv)
+        c.serving_model = os.environ.get("HOROVOD_SERVING_MODEL",
+                                         c.serving_model)
+        c.serving_commit_steps = _env_int("HOROVOD_SERVING_COMMIT_STEPS",
+                                          c.serving_commit_steps)
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
         c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
         c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",

@@ -1,5 +1,5 @@
 from horovod_tpu.elastic.sharded import (  # noqa: F401
-    fsdp_reshard, gather_to_host, zero_reshard,
+    fsdp_reshard, gather_to_host, kv_reshard, zero_reshard,
 )
 from horovod_tpu.elastic.state import (  # noqa: F401
     State, ObjectState, TpuState, run,

@@ -92,6 +92,39 @@ def zero_reshard(state_host, mesh, axis_name=HVD_AXIS):
         opt_state=jax.tree_util.tree_map(leaf, state_host.opt_state))
 
 
+def kv_reshard(cache_host, mesh, axis_name=HVD_AXIS):
+    """Re-place a host-side decode KV-cache tree (the serving engine's
+    ``(slots, cache_len, kv_heads, head_dim)`` leaves) on ``mesh`` after
+    a membership change — the serving fleet's migration leg.
+
+    Like :func:`fsdp_reshard`, a KV cache's leaf SHAPES are
+    mesh-independent; only placement changes. Unlike the optimizer
+    moments :func:`zero_reshard` handles, cache leaves must NOT be
+    flattened/re-padded to the new shard grid — their K/V rows are
+    position-addressed, so re-partitioning is a pure layout move: slot
+    rows shard over the mesh when the slot count divides it, everything
+    else (including the scalar cursor) comes back replicated — exactly
+    how a fresh engine on the new mesh would lay them out. Values are
+    unchanged: decoding continues token-for-token identically
+    (tests/test_elastic_reshard.py round-trips 8→4→8 and asserts
+    stream equality)."""
+    import jax
+    from jax.sharding import NamedSharding
+    from jax.sharding import PartitionSpec as P
+
+    n = _axis_size(mesh, axis_name)
+
+    def leaf(x):
+        x = np.asarray(x)
+        spec = P()
+        if x.ndim >= 1 and x.shape[0] % n == 0:
+            spec = P(axis_name)
+        from horovod_tpu.parallel.fsdp import _place
+        return _place(x, NamedSharding(mesh, spec))
+
+    return jax.tree_util.tree_map(leaf, cache_host)
+
+
 def fsdp_reshard(tree_host, mesh, axis_name=HVD_AXIS, min_size=16384):
     """Re-place a host-side FSDP pytree (params or optimizer state) with
     the shardings :func:`horovod_tpu.parallel.fsdp.fsdp_shardings` derives

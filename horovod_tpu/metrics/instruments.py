@@ -42,6 +42,19 @@ Catalogue (names shown without the ``HOROVOD_METRICS_PREFIX``, default
   fraction to dcn, hierarchical dispatches book each leg's tier exactly)
 - ``wire_compression_events_total{path,dtype}``     dispatches that
   actually compressed the wire (path=eager|fused|jit; counter)
+- ``serving_requests_total{event}``                 request lifecycle
+  (event=submitted|admitted|completed|requeued|rejected; counter)
+- ``serving_ttft_seconds``                          submit → first
+  generated token (histogram; the SLO p50/p99 source)
+- ``serving_token_latency_seconds``                 decode-step wall time
+  = inter-token latency for every active request (histogram)
+- ``serving_tokens_total``                          generated tokens
+  (counter; rate() = tokens/sec)
+- ``serving_queue_depth``                           admission queue depth
+  (gauge)
+- ``serving_batch_fill_ratio``                      active slots / total
+  slots per decode step (histogram; low values mean the fleet is
+  over-provisioned or admission is starved)
 """
 
 import os
@@ -207,6 +220,39 @@ WIRE_COMPRESSION_EVENTS = REGISTRY.counter(
     "events are recorded at trace time: once per compiled program, not "
     "per execution.",
     ("path", "dtype"))
+SERVING_REQUESTS = REGISTRY.counter(
+    "serving_requests_total",
+    "Serving-engine request lifecycle events (horovod_tpu/serving): "
+    "submitted|admitted|completed|requeued (re-queued from the last "
+    "committed token after an elastic disruption)|rejected (queue full).",
+    ("event",))
+SERVING_TTFT = REGISTRY.histogram(
+    "serving_ttft_seconds",
+    "Time-to-first-token per request: submit() to the first generated "
+    "token's commit (includes queue wait + prefill — the user-facing "
+    "p50/p99 SLO).",
+    buckets=exponential_buckets(1e-4, 2.0, 22))        # 100us .. ~3.5min
+SERVING_TOKEN_LATENCY = REGISTRY.histogram(
+    "serving_token_latency_seconds",
+    "Decode-step wall time — the inter-token latency every active "
+    "request observed on that step (admission/prefill excluded; they "
+    "land in serving_ttft_seconds).",
+    buckets=exponential_buckets(1e-5, 2.0, 22))        # 10us .. ~21s
+SERVING_TOKENS = REGISTRY.counter(
+    "serving_tokens_total",
+    "Generated tokens committed by the serving engine (rate() is the "
+    "fleet tokens/sec).")
+SERVING_QUEUE_DEPTH = REGISTRY.gauge(
+    "serving_queue_depth",
+    "Requests waiting for a slot in the serving admission queue "
+    "(sampled at every submit/admit; the first thing to read when "
+    "requests time out — docs/troubleshooting.md).")
+SERVING_FILL = REGISTRY.histogram(
+    "serving_batch_fill_ratio",
+    "Active slots / total slots at each decode step (1.0 = the "
+    "continuous batch is full; persistently low fill under a deep queue "
+    "means admission is starved — a scheduler bug).",
+    buckets=_RATIO_BUCKETS)
 TELEMETRY_RPCS = REGISTRY.counter(
     "telemetry_rpcs_total",
     "Telemetry-plane KV RPCs by phase (horovod_tpu/telemetry): the "
@@ -456,6 +502,41 @@ def record_profiler_kv(sets=0, gets=0):
         CONTROL_PLANE_RPCS.labels("coord", "prof_set").inc(sets)
     if gets:
         CONTROL_PLANE_RPCS.labels("coord", "prof_get").inc(gets)
+
+
+def record_serving_request(event):
+    """One serving request lifecycle event (event=submitted|admitted|
+    completed|requeued|rejected). Requeues also land in the flight ring:
+    they are exactly the events a zero-drop post-mortem needs."""
+    if _flight.armed and event in ("requeued", "rejected"):
+        _flight.record_event("serving", what=event)
+    if not _enabled:
+        return
+    SERVING_REQUESTS.labels(event).inc()
+
+
+def record_serving_ttft(seconds):
+    if not _enabled:
+        return
+    SERVING_TTFT.observe(seconds)
+
+
+def record_serving_step(seconds, active, slots, tokens=0):
+    """One serving decode step: inter-token latency, fill ratio, and the
+    tokens it committed."""
+    if not _enabled:
+        return
+    SERVING_TOKEN_LATENCY.observe(seconds)
+    if slots:
+        SERVING_FILL.observe(active / float(slots))
+    if tokens:
+        SERVING_TOKENS.inc(tokens)
+
+
+def record_serving_queue(depth):
+    if not _enabled:
+        return
+    SERVING_QUEUE_DEPTH.set(depth)
 
 
 def record_telemetry_rpc(phase, n=1):

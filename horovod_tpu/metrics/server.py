@@ -83,6 +83,21 @@ class _MetricsHandler(BaseHTTPRequestHandler):
             self.end_headers()
             self.wfile.write(body)
             return
+        if path == "/serving/health":
+            # The serving engine's readiness frame: queue depth, slot
+            # fill, served counts and a saturation flag — what a load
+            # balancer (or `telemetry top --once --serving`) reads to
+            # decide whether to keep routing traffic here. 503 when no
+            # engine runs in this process: an LB probe must fail closed.
+            from horovod_tpu.serving.engine import serving_snapshot
+            snap = serving_snapshot()
+            if snap is None:
+                self._send_json(_json.dumps(
+                    {"error": "no serving engine in this process"}),
+                    code=503)
+                return
+            self._send_json(_json.dumps(snap))
+            return
         if path == "/cluster/health":
             # The hierarchical telemetry plane's job view: per-rank
             # health states, per-slice digest counts, step progress and

@@ -61,7 +61,9 @@ class GPTEmbed(nn.Module):
     FIRST token in ``input_ids`` (shape (B, s)); the table is sliced
     dynamically at positions ``pos..pos+s-1`` instead of by the static
     prefix (s=1 is the classic one-token step; s>1 is the chunked feed
-    the speculative verifier uses).
+    the speculative verifier uses). A (B,) VECTOR ``pos`` is the
+    continuous-batching serving path: every batch row (slot) sits at its
+    own position, so the table is gathered per row.
     """
     config: GPTConfig
 
@@ -76,6 +78,10 @@ class GPTEmbed(nn.Module):
                            jnp.float32)
         if pos is not None:
             import jax
+            if jnp.ndim(pos) == 1:            # per-row (serving) positions
+                rows = pos.astype(jnp.int32)[:, None] + jnp.arange(L)
+                sl = jnp.take(table, rows, axis=0)          # (B, s, H)
+                return tok + jnp.asarray(sl, c.dtype)
             sl = jax.lax.dynamic_slice_in_dim(table, pos, L)   # (s, H)
             return tok + jnp.asarray(sl, c.dtype)[None]
         pos = table  # legacy local name for the static paths below
@@ -188,7 +194,8 @@ class GPT(nn.Module):
                     sp_impl=c.sp_impl, decode=self.decode,
                     cache_len=c.max_position_embeddings,
                     kv_cache_int8=c.kv_cache_int8,
-                    name=f"layer_{i}")(x)
+                    name=f"layer_{i}")(
+                        x, pos=pos if self.decode else None)
         if features_only:
             return x
         return GPTHead(c, name="head")(x)

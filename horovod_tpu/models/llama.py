@@ -89,7 +89,7 @@ class LlamaBlock(nn.Module):
     decode: bool = False
 
     @nn.compact
-    def __call__(self, x):
+    def __call__(self, x, pos=None):
         c = self.config
         a = TPSelfAttention(
             c.num_heads, c.hidden_size, dtype=c.dtype, axis_name=c.tp_axis,
@@ -100,7 +100,7 @@ class LlamaBlock(nn.Module):
             num_kv_heads=c.num_kv_heads, rope_theta=c.rope_theta,
             use_bias=False, name="attention")(
                 nn.RMSNorm(epsilon=c.rms_eps, dtype=c.dtype,
-                           name="ln_attn")(x))
+                           name="ln_attn")(x), pos=pos)
         x = x + a
         h = TPSwiGLUMlp(c.intermediate_size, c.hidden_size, dtype=c.dtype,
                         axis_name=c.tp_axis, name="mlp")(
@@ -157,7 +157,8 @@ class Llama(nn.Module):
         block_cls = (nn.remat(LlamaBlock) if c.remat and not self.decode
                      else LlamaBlock)
         for i in range(c.num_layers):
-            x = block_cls(c, decode=self.decode, name=f"layer_{i}")(x)
+            x = block_cls(c, decode=self.decode, name=f"layer_{i}")(
+                x, pos=pos if self.decode else None)
         if features_only:
             return x
         return LlamaHead(c, name="head")(x)

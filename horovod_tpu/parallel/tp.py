@@ -144,7 +144,9 @@ def apply_rope(x, positions, theta):
     """Rotary position embedding (rotate-half pairing), fp32 rotation.
 
     ``x``: (B, L, h, d) with d even; ``positions``: (L,) int32 GLOBAL token
-    positions (under sequence parallelism pass the shard's global offsets).
+    positions (under sequence parallelism pass the shard's global offsets),
+    or (B, L) PER-ROW positions — the continuous-batching decode path,
+    where each batch row sits at its own sequence offset.
     Rotation is position-absolute, so pre-rotated keys stay correct when a
     ring/Ulysses scheme later moves them between chips.
     """
@@ -152,9 +154,11 @@ def apply_rope(x, positions, theta):
     if d % 2:
         raise ValueError(f"rope needs an even head_dim, got {d}")
     inv = theta ** (-jnp.arange(0, d, 2, dtype=jnp.float32) / d)    # (d/2,)
-    ang = positions.astype(jnp.float32)[:, None] * inv              # (L, d/2)
-    cos = jnp.cos(ang)[None, :, None, :]
-    sin = jnp.sin(ang)[None, :, None, :]
+    ang = positions.astype(jnp.float32)[..., None] * inv   # ([B,] L, d/2)
+    cos = jnp.cos(ang)[..., None, :]                       # (+ head axis)
+    sin = jnp.sin(ang)[..., None, :]
+    if positions.ndim == 1:
+        cos, sin = cos[None], sin[None]                    # broadcast batch
     x1, x2 = jnp.split(x.astype(jnp.float32), 2, axis=-1)
     out = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin],
                           axis=-1)
@@ -212,7 +216,7 @@ class TPSelfAttention(nn.Module):
     rope_theta: Optional[float] = None   # None -> no rotary embedding
     use_bias: bool = True
 
-    def _decode_attend(self, q, k, v, bias=None):
+    def _decode_attend(self, q, k, v, bias=None, pos=None):
         """Cached decode against the KV cache: ``s`` query tokens per call
         (s=1 is the classic one-token step; s>1 is a CHUNK — the
         speculative-verification path scores gamma+1 proposals in one
@@ -225,6 +229,15 @@ class TPSelfAttention(nn.Module):
         Cache variables are created on the first call (B and capacity fix
         the shapes; flax initializes them lazily under
         mutable=['cache']).
+
+        ``pos`` as a (B,) int32 VECTOR switches to explicit per-row
+        positions — the continuous-batching serving path, where every
+        batch row (slot) decodes at its own sequence offset: K/V rows are
+        scattered at ``pos[b] + i``, RoPE rotates by the same per-row
+        positions, and the causal mask bounds each row by its own cursor.
+        The internal scalar cursor is bypassed (the caller owns the
+        per-row cursors); scalar/None ``pos`` keeps the classic
+        shared-cursor semantics unchanged.
 
         ``kv_cache_int8``: rows are stored int8 with one fp32 scale per
         (batch, position, kv-head) — ~1/2 the HBM of a bf16 cache (1/4 of
@@ -246,10 +259,17 @@ class TPSelfAttention(nn.Module):
             cvs = self.variable("cache", "v_scale", jnp.zeros,
                                 (B, L, kv), jnp.float32)
         idx = ci.value
+        per_row = pos is not None and jnp.ndim(pos) == 1
+        if per_row:
+            if bias is not None:
+                raise ValueError("per-row decode positions do not compose "
+                                 "with an attention bias (T5 relative "
+                                 "positions feed the shared-cursor path)")
+            posm = pos.astype(jnp.int32)[:, None] + jnp.arange(s)   # (B, s)
         if self.rope_theta is not None:
-            pos = idx + jnp.arange(s)                 # the chunk's positions
-            q = apply_rope(q, pos, self.rope_theta)
-            k = apply_rope(k, pos, self.rope_theta)   # cache holds rotated K
+            rp = posm if per_row else idx + jnp.arange(s)
+            q = apply_rope(q, rp, self.rope_theta)
+            k = apply_rope(k, rp, self.rope_theta)    # cache holds rotated K
 
         if int8c:
             from horovod_tpu.parallel.strategies import \
@@ -261,14 +281,30 @@ class TPSelfAttention(nn.Module):
 
             k8, ks = quant(k)
             v8, vs_ = quant(v)
-            ck.value = lax.dynamic_update_slice(ck.value, k8, (0, idx, 0, 0))
-            cv.value = lax.dynamic_update_slice(cv.value, v8, (0, idx, 0, 0))
-            cks.value = lax.dynamic_update_slice(cks.value, ks, (0, idx, 0))
-            cvs.value = lax.dynamic_update_slice(cvs.value, vs_, (0, idx, 0))
+            if per_row:
+                b_ix = jnp.arange(B)[:, None]                     # (B, 1)
+                ck.value = ck.value.at[b_ix, posm].set(k8)
+                cv.value = cv.value.at[b_ix, posm].set(v8)
+                cks.value = cks.value.at[b_ix, posm].set(ks)
+                cvs.value = cvs.value.at[b_ix, posm].set(vs_)
+            else:
+                ck.value = lax.dynamic_update_slice(ck.value, k8,
+                                                    (0, idx, 0, 0))
+                cv.value = lax.dynamic_update_slice(cv.value, v8,
+                                                    (0, idx, 0, 0))
+                cks.value = lax.dynamic_update_slice(cks.value, ks,
+                                                     (0, idx, 0))
+                cvs.value = lax.dynamic_update_slice(cvs.value, vs_,
+                                                     (0, idx, 0))
             keys = (ck.value.astype(jnp.float32)
                     * cks.value[..., None]).astype(q.dtype)
             vals = (cv.value.astype(jnp.float32)
                     * cvs.value[..., None]).astype(q.dtype)
+        elif per_row:
+            b_ix = jnp.arange(B)[:, None]                         # (B, 1)
+            ck.value = ck.value.at[b_ix, posm].set(k)
+            cv.value = cv.value.at[b_ix, posm].set(v)
+            keys, vals = ck.value, cv.value
         else:
             ck.value = lax.dynamic_update_slice(ck.value, k, (0, idx, 0, 0))
             cv.value = lax.dynamic_update_slice(cv.value, v, (0, idx, 0, 0))
@@ -284,10 +320,15 @@ class TPSelfAttention(nn.Module):
             scores = scores + bias.reshape(kv, g, 1, L)[None].astype(
                 scores.dtype)
         # causal within the chunk, bounded by the filled prefix: query row
-        # i attends cache positions <= idx + i
-        valid = jnp.arange(L)[None, :] <= idx + jnp.arange(s)[:, None]
-        scores = jnp.where(valid[None, None, None, :, :], scores,
-                           jnp.asarray(-1e9, scores.dtype))
+        # i attends cache positions <= idx + i (per-row: <= pos[b] + i)
+        if per_row:
+            valid = jnp.arange(L)[None, None, :] <= posm[:, :, None]
+            scores = jnp.where(valid[:, None, None, :, :], scores,
+                               jnp.asarray(-1e9, scores.dtype))
+        else:
+            valid = jnp.arange(L)[None, :] <= idx + jnp.arange(s)[:, None]
+            scores = jnp.where(valid[None, None, None, :, :], scores,
+                               jnp.asarray(-1e9, scores.dtype))
         probs = jax.nn.softmax(scores.astype(jnp.float32)).astype(self.dtype)
         out = jnp.einsum("bngqk,bknd->bqngd", probs, vals)
         return out.reshape(B, s, h, d)
@@ -344,7 +385,7 @@ class TPSelfAttention(nn.Module):
                                bias=bias, causal=self.causal)
 
     @nn.compact
-    def __call__(self, x, mask=None, bias=None):
+    def __call__(self, x, mask=None, bias=None, pos=None):
         n = axis_size_or_1(self.axis_name)
         kv_heads = self.num_kv_heads or self.num_heads
         if self.num_heads % n != 0 or kv_heads % n != 0:
@@ -387,8 +428,9 @@ class TPSelfAttention(nn.Module):
             if self.cache_len < 1:
                 raise ValueError("decode=True requires cache_len >= 1")
             # RoPE + grouped KV handled inside; bias is this step's
-            # relative-position row over the cache
-            out = self._decode_attend(q, k, v, bias=bias)
+            # relative-position row over the cache; a (B,) pos vector
+            # switches to explicit per-row (continuous-batching) cursors
+            out = self._decode_attend(q, k, v, bias=bias, pos=pos)
         else:
             if self.rope_theta is not None:
                 # Global token positions: under sequence parallelism x holds
@@ -534,7 +576,7 @@ class TPTransformerBlock(nn.Module):
     kv_cache_int8: bool = False
 
     @nn.compact
-    def __call__(self, x, mask=None):
+    def __call__(self, x, mask=None, pos=None):
         a = TPSelfAttention(self.num_heads, self.hidden_size,
                             dtype=self.dtype, axis_name=self.axis_name,
                             causal=self.causal, use_flash=self.use_flash,
@@ -543,7 +585,8 @@ class TPTransformerBlock(nn.Module):
                             kv_cache_int8=self.kv_cache_int8,
                             name="attention")(
                                 nn.LayerNorm(dtype=self.dtype,
-                                             name="ln_attn")(x), mask)
+                                             name="ln_attn")(x), mask,
+                                pos=pos)
         x = x + a
         h = TPMlp(self.intermediate_size, self.hidden_size, dtype=self.dtype,
                   axis_name=self.axis_name, name="mlp")(

@@ -68,6 +68,10 @@ _ARG_ENV_MAP = [
     ("profile_steps", "HOROVOD_PROFILE_STEPS", str),
     ("profile_dir", "HOROVOD_PROFILE_DIR", str),
     ("profile_publish_steps", "HOROVOD_PROFILE_PUBLISH_STEPS", str),
+    ("serving", "HOROVOD_SERVING", lambda v: "1" if v else None),
+    ("serving_port", "HOROVOD_SERVING_PORT", str),
+    ("serving_slots", "HOROVOD_SERVING_SLOTS", str),
+    ("serving_queue_limit", "HOROVOD_SERVING_QUEUE_LIMIT", str),
 ]
 
 

@@ -226,6 +226,32 @@ def parse_args(argv=None):
                        help="Directory for the per-rank JSONL injection "
                             "ledgers (HOROVOD_CHAOS_LEDGER).")
 
+    serving = p.add_argument_group("serving")
+    serving.add_argument("--serving", action="store_true", dest="serving",
+                         default=False,
+                         help="Serving mode (HOROVOD_SERVING=1): workers "
+                              "run the continuous-batching inference "
+                              "engine instead of a training loop — e.g. "
+                              "`hvdrun --serving -np 8 python -m "
+                              "horovod_tpu.serving`. Composes with the "
+                              "elastic flags for zero-drop rolling "
+                              "restarts (docs/inference.md).")
+    serving.add_argument("--serving-port", type=int, dest="serving_port",
+                         help="Request-frontend base port "
+                              "(HOROVOD_SERVING_PORT); each process binds "
+                              "port + local_rank, like --metrics-port.")
+    serving.add_argument("--serving-slots", type=int, dest="serving_slots",
+                         help="Decode-batch slot count "
+                              "(HOROVOD_SERVING_SLOTS; the continuous "
+                              "batch's fixed width).")
+    serving.add_argument("--serving-queue-limit", type=int,
+                         dest="serving_queue_limit",
+                         help="Admission-queue capacity "
+                              "(HOROVOD_SERVING_QUEUE_LIMIT; 0 = "
+                              "unbounded). At the limit the frontend "
+                              "answers 503 and /serving/health reports "
+                              "saturated.")
+
     elastic = p.add_argument_group("elastic")
     elastic.add_argument("--min-np", "--min-num-proc", type=int,
                          dest="min_np")
@@ -385,7 +411,15 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HVD_BENCH_PROGRESS_FILE", "HOROVOD_DCN_BYTES_BUDGET",
                 "HOROVOD_WIRE_DTYPE", "HOROVOD_WIRE_ERROR_FEEDBACK",
                 "HOROVOD_WIRE_DTYPE_DCN", "HOROVOD_HIERARCHICAL_DISPATCH",
-                "HOROVOD_CROSS_OVERLAP"):
+                "HOROVOD_CROSS_OVERLAP",
+                "HOROVOD_SERVING", "HOROVOD_SERVING_PORT",
+                "HOROVOD_SERVING_SLOTS", "HOROVOD_SERVING_MAX_LEN",
+                "HOROVOD_SERVING_PREFILL_CHUNK",
+                "HOROVOD_SERVING_QUEUE_LIMIT",
+                "HOROVOD_SERVING_MIGRATE_KV", "HOROVOD_SERVING_MODEL",
+                "HOROVOD_SERVING_COMMIT_STEPS",
+                "HOROVOD_METRICS", "HOROVOD_METRICS_PORT",
+                "HOROVOD_METRICS_ADDR", "HOROVOD_METRICS_PREFIX"):
         if os.environ.get(var):
             env.setdefault(var, os.environ[var])
     # On the virtual-CPU tier (tests, dry runs) a rank is a virtual XLA CPU

@@ -1,0 +1,19 @@
+"""Elastic continuous-batching inference (docs/inference.md).
+
+A request queue + slot scheduler front a sharded causal LM: admitted
+requests pack into a fixed-slot decode batch with a real per-slot KV
+cache, slots retire and refill independently, and the whole state rides
+the elastic rendezvous machinery so scale up/down (or a worker kill)
+drops zero in-flight requests. SLO metrics land on the standard scrape
+endpoint; ``telemetry top --once --serving`` is the load-balancer
+readiness gate.
+"""
+
+from horovod_tpu.serving.engine import (  # noqa: F401
+    ServingEngine, get_engine, sample_token, serving_snapshot,
+)
+from horovod_tpu.serving.request import Request  # noqa: F401
+from horovod_tpu.serving.scheduler import (  # noqa: F401
+    QueueFull, SlotScheduler,
+)
+from horovod_tpu.serving.state import ServingState  # noqa: F401

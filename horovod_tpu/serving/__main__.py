@@ -1,0 +1,111 @@
+"""``python -m horovod_tpu.serving`` — the reference serving worker.
+
+What ``hvdrun --serving`` launches when you have no app of your own::
+
+    hvdrun --serving --serving-port 9000 -np 8 \
+        python -m horovod_tpu.serving
+
+Each worker initializes the collective runtime, builds the configured
+model (``HOROVOD_SERVING_MODEL``: ``gpt_tiny`` [default, random weights
+— a smoke/load-test target], ``gpt2`` or ``llama_tiny``; point real
+deployments at a checkpoint via ``--serving`` + your own script), and
+serves ``POST /generate`` on ``HOROVOD_SERVING_PORT + local_rank``. The
+metrics endpoint (``HOROVOD_METRICS_PORT``) carries ``/serving/health``
+and the SLO series; under ``HOROVOD_ELASTIC`` the engine state rides a
+:class:`~horovod_tpu.serving.state.ServingState` so membership changes
+drop zero in-flight requests.
+"""
+
+import sys
+import time
+
+
+def build_model(name, max_len):
+    import jax
+    import jax.numpy as jnp
+
+    from horovod_tpu import models
+
+    if name == "gpt2":
+        cfg = models.GPTConfig(max_position_embeddings=max_len,
+                               tp_axis=None, ep_axis=None)
+        model = models.GPT(cfg)
+    elif name == "llama_tiny":
+        cfg = models.LlamaConfig.tiny(tp_axis=None,
+                                      max_position_embeddings=max_len)
+        model = models.Llama(cfg)
+    else:
+        cfg = models.GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                                    max_position_embeddings=max_len)
+        model = models.GPT(cfg)
+    params = jax.jit(model.init)(jax.random.PRNGKey(0),
+                                 jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params
+
+
+def main():
+    import horovod_tpu as hvd
+    from horovod_tpu.common.config import Config
+    from horovod_tpu.serving import ServingEngine, ServingState
+    from horovod_tpu.serving.server import ServingFrontend
+
+    hvd.init()
+    cfg = Config.from_env()
+    name = cfg.serving_model
+    max_len = cfg.serving_max_len or 256
+    model, params = build_model(name, max_len)
+    engine = ServingEngine(
+        model, params, num_slots=cfg.serving_slots, max_len=max_len,
+        prefill_chunk=cfg.serving_prefill_chunk,
+        queue_limit=cfg.serving_queue_limit,
+        migrate_kv=cfg.serving_migrate_kv)
+    port = cfg.serving_port + hvd.local_rank() if cfg.serving_port else 0
+    fe = ServingFrontend(engine, port=port, addr=cfg.metrics_addr,
+                         drive=not cfg.elastic)
+    bound = fe.start()
+    print(f"# serving {name} on :{bound} "
+          f"(slots={engine.num_slots}, max_len={engine.max_len})",
+          file=sys.stderr, flush=True)
+
+    if cfg.elastic:
+        from horovod_tpu import elastic
+
+        state = ServingState(engine, step=0)
+        elastic.attach_listener(state)
+
+        @elastic.run
+        def serve(state):
+            # One thread owns stepping AND committing (the frontend only
+            # enqueues): a commit must never race a half-applied step.
+            cadence = max(cfg.serving_commit_steps, 1)
+            idle_commit_s = 0.25
+            last_commit = time.monotonic()
+            while True:
+                if engine.step():
+                    state.step += 1
+                    if state.step % cadence == 0:
+                        state.commit()
+                        last_commit = time.monotonic()
+                else:
+                    # Idle: nothing new to snapshot — but commit() is
+                    # also the membership poll (check_host_updates), so
+                    # keep a low-rate heartbeat instead of spinning
+                    # full-cadence params snapshots at ~500/s.
+                    time.sleep(0.002)
+                    now = time.monotonic()
+                    if now - last_commit >= idle_commit_s:
+                        state.commit()
+                        last_commit = now
+
+        serve(state)
+        return 0
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        fe.stop()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

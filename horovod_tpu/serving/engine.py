@@ -1,0 +1,521 @@
+"""Continuous-batching inference engine over the collective runtime.
+
+The serving shape (ROADMAP item 2 — "millions of users"): a FIFO request
+queue fronts a sharded causal LM; admitted requests are packed into a
+FIXED-SLOT decode batch whose per-slot KV caches live in one device
+tree, and slots retire/refill independently — continuous batching, not
+static batches. Three compiled programs cover the whole hot path:
+
+- **prefill** — a batch-1 chunked feed at explicit positions (the
+  per-row ``pos`` vector path of ``TPSelfAttention._decode_attend``)
+  builds the new request's K/V rows without touching its neighbours;
+- **install** — scatters the batch-1 cache into the admitted slot of the
+  big ``(num_slots, ...)`` cache tree (dynamic_update_slice per leaf);
+- **decode step** — ONE token for every slot per call at per-slot
+  positions (each row masked by its own cursor), cache donated so XLA
+  updates it in place.
+
+Sampling runs on host from the step's ``(S, V)`` logits: per-request
+temperature/top-k/top-p with draws keyed on ``(seed, position)``, so a
+request re-queued from its last committed token after an elastic
+disruption reproduces its exact remaining token stream — the zero-drop
+invariant the chaos soak asserts. Greedy parity with
+``models.generate`` is exact (same argmax over the same logits).
+
+Elasticity rides :class:`horovod_tpu.serving.state.ServingState`
+(a ``TpuState``): request-level state commits per step-group, in-flight
+caches either migrate through rendezvous as host snapshots
+(``HOROVOD_SERVING_MIGRATE_KV``) or re-queue from the last committed
+token and re-prefill. Observability: every lifecycle event and decode
+step lands in the SLO series of ``metrics/instruments.py`` (TTFT,
+inter-token latency, tokens/sec, queue depth, batch fill), per-step
+attribution in the step profiler (``mark_steps``), and request
+transitions in the flight ring.
+"""
+
+import dataclasses
+import functools
+import threading
+import time
+
+import numpy as np
+
+from horovod_tpu.flight import recorder as _flight
+from horovod_tpu.metrics import instruments as _metrics
+from horovod_tpu.serving.request import Request
+from horovod_tpu.serving.scheduler import SlotScheduler
+
+# The newest engine, for the /serving/health endpoint and telemetry gate.
+_current = None
+
+
+def get_engine():
+    return _current
+
+
+def serving_snapshot():
+    """JSON-able engine state for ``/serving/health`` (None when no
+    engine runs in this process)."""
+    eng = _current
+    return None if eng is None else eng.snapshot()
+
+
+def _host_filter_logits(logits, top_k, top_p):
+    """numpy mirror of ``models.generate._filter_logits`` for one (V,)
+    row (same keep-set semantics; host-side because per-request k/p are
+    data, not static program constants)."""
+    if top_k:
+        k = min(top_k, logits.size)
+        kth = np.partition(logits, -k)[-k]
+        logits = np.where(logits >= kth, logits, -np.inf)
+    if top_p < 1.0:
+        srt = np.sort(logits)[::-1]
+        z = srt - srt[0]
+        probs = np.exp(z) / np.exp(z).sum()
+        cum = np.cumsum(probs)
+        keep = cum - probs < top_p
+        thresh = srt[keep][-1] if keep.any() else srt[0]
+        logits = np.where(logits >= thresh, logits, -np.inf)
+    return logits
+
+
+def sample_token(logits, temperature, top_k, top_p, seed, position):
+    """Next token from one (V,) float row — greedy at temperature 0, else
+    a tempered categorical over the filtered distribution, drawn from a
+    generator keyed on ``(seed, position)``: position-keyed draws are
+    what make a re-queued request's remaining stream identical to the
+    uninterrupted one."""
+    if temperature == 0.0:
+        return int(np.argmax(logits))
+    z = _host_filter_logits(logits.astype(np.float64) / temperature,
+                            top_k, top_p)
+    z = z - np.max(z)
+    p = np.exp(z)
+    p = p / p.sum()
+    rng = np.random.default_rng((int(seed) & 0x7FFFFFFF, int(position)))
+    return int(rng.choice(p.size, p=p))
+
+
+class ServingEngine:
+    """See the module docstring. ``model`` is any causal LM supporting the
+    decode-mode per-row ``pos`` protocol (GPT / LLaMA zoo — LoRA-merged
+    and speculative-target params serve unchanged: the engine only calls
+    ``apply``).
+
+    ``step_fn`` / ``prefill_fn`` / ``install_fn`` are test seams: the
+    perf guard stubs the device programs to bound the pure host cost of
+    enqueue → schedule → dispatch.
+    """
+
+    def __init__(self, model, params, num_slots=4, max_len=None,
+                 prefill_chunk=64, queue_limit=0, migrate_kv=False,
+                 mark_steps=True, step_fn=None, prefill_fn=None,
+                 install_fn=None):
+        import jax.numpy as jnp
+
+        self.model = model
+        self.params = params
+        cap = getattr(getattr(model, "config", None),
+                      "max_position_embeddings", None)
+        self.max_len = int(max_len or cap or 0)
+        if self.max_len < 2:
+            raise ValueError("need max_len >= 2 (model config carries none)")
+        if cap is not None and self.max_len > cap:
+            raise ValueError(f"max_len {self.max_len} exceeds the model's "
+                             f"position capacity ({cap})")
+        self.num_slots = int(num_slots)
+        self.prefill_chunk = max(1, int(prefill_chunk))
+        self.migrate_kv = bool(migrate_kv)
+        self.mark_steps = bool(mark_steps)
+        # Guards submission (HTTP handler threads) against the elastic
+        # restore's scheduler swap on the serve thread: a submit must
+        # land either in the old scheduler BEFORE the restore captures
+        # its contents, or in the rebuilt one — never in a discarded
+        # deque (a silently dropped request).
+        self._submit_lock = threading.Lock()
+        self._decoder = dataclasses.replace(model, decode=True)
+        self._sched = SlotScheduler(self.num_slots, queue_limit=queue_limit)
+        self._requests = {}          # rid -> Request (live registry)
+        self._step_count = 0
+        self._served = 0
+        self._tokens = np.zeros((self.num_slots,), np.int32)
+        self._pos = np.zeros((self.num_slots,), np.int32)
+        self._cache_valid = True
+        self._stub = (step_fn, prefill_fn, install_fn)
+        self._zero = jnp.zeros            # kept for runtime rebuilds
+        self._build_runtime()
+        global _current
+        _current = self
+
+    # --- compiled programs ----------------------------------------------
+
+    def _build_runtime(self):
+        """(Re)build the cache tree and the three jitted programs — called
+        at construction and after an elastic backend rebuild (old
+        executables and buffers die with the old PJRT client)."""
+        import jax
+        import jax.numpy as jnp
+
+        from horovod_tpu.models.generate import init_decode_cache
+
+        decoder = self._decoder
+        S = self.num_slots
+        step_fn, prefill_fn, install_fn = self._stub
+
+        if step_fn is None:
+            @functools.partial(jax.jit, donate_argnums=(1,))
+            def step_fn(params, cache, toks, pos):
+                logits, upd = decoder.apply(
+                    {"params": params, "cache": cache}, toks[:, None],
+                    pos=pos, mutable=["cache"])
+                return logits[:, 0], upd["cache"]
+
+        if prefill_fn is None:
+            @jax.jit
+            def prefill_fn(params, cache, toks, t):
+                # batch-1 chunked feed at explicit positions (pos vector
+                # path); logits discarded — prefill wants the K/V rows.
+                _, upd = decoder.apply(
+                    {"params": params, "cache": cache}, toks,
+                    pos=jnp.full((1,), t, jnp.int32), mutable=["cache"])
+                return upd["cache"]
+
+        if install_fn is None:
+            @functools.partial(jax.jit, donate_argnums=(0,))
+            def install_fn(big, small, slot):
+                def leaf(b, s_):
+                    if getattr(b, "ndim", 0) >= 1 and b.shape[0] == S:
+                        return jax.lax.dynamic_update_slice_in_dim(
+                            b, s_.astype(b.dtype), slot, axis=0)
+                    return b                 # scalar bookkeeping (cursor)
+                return jax.tree_util.tree_map(leaf, big, small)
+
+        self._step_fn = step_fn
+        self._prefill_fn = prefill_fn
+        self._install_fn = install_fn
+        if self._stub[0] is not None:
+            # Stubbed runtime (perf guard): no device trees at all.
+            self._cache = {}
+            self._small_zero = {}
+            return
+        self._cache = init_decode_cache(
+            decoder, jnp.zeros((S, 1), jnp.int32),
+            pos=jnp.zeros((S,), jnp.int32))
+        self._small_zero = init_decode_cache(
+            decoder, jnp.zeros((1, 1), jnp.int32),
+            pos=jnp.zeros((1,), jnp.int32))
+
+    # --- submission ------------------------------------------------------
+
+    def submit(self, prompt, max_new, temperature=0.0, top_k=0, top_p=1.0,
+               eos_id=None, seed=0):
+        """Enqueue one request; returns the :class:`Request` (its
+        ``result()`` blocks until completion). Raises
+        :class:`~horovod_tpu.serving.scheduler.QueueFull` at the queue
+        limit and ValueError when prompt + budget exceed the cache."""
+        req = Request(prompt, max_new, temperature=temperature,
+                      top_k=top_k, top_p=top_p, eos_id=eos_id, seed=seed)
+        if len(req.prompt) + req.max_new > self.max_len:
+            raise ValueError(
+                f"prompt ({len(req.prompt)}) + max_new ({req.max_new}) "
+                f"exceeds the engine's cache capacity ({self.max_len})")
+        with self._submit_lock:
+            self._sched.submit(req)      # raises QueueFull after reject()
+            # Registered only once actually queued: rejected requests
+            # must not pin their prompt in the live registry forever.
+            self._requests[req.rid] = req
+        _flight.record_event("serving", what="submit", name=f"r{req.rid}")
+        return req
+
+    # --- the serve loop ---------------------------------------------------
+
+    def _prefill_into(self, slot, req):
+        """Teacher-force the request's effective prompt (prompt + any
+        committed tokens from a previous incarnation) into its slot."""
+        import jax.numpy as jnp
+
+        toks = req.full_tokens()
+        P = len(toks)
+        end = P - 1                       # last token is the decode input
+        small = self._small_zero          # reusable zero template: the
+        c = self.prefill_chunk            # un-donated feed never mutates it
+        t = 0
+        while t < end:
+            s = min(c, end - t)           # exact remainder: no pad rows
+            chunk = jnp.asarray([toks[t:t + s]], jnp.int32)
+            small = self._prefill_fn(self.params, small, chunk, t)
+            t += s
+        self._cache = self._install_fn(self._cache, small,
+                                       np.int32(slot))
+        self._tokens[slot] = toks[-1]
+        self._pos[slot] = P - 1
+        # A rollback always empties the slot table before invalidating,
+        # so every active slot after it reaches the cache through THIS
+        # prefill — the first admission makes the cache live again (the
+        # readiness gate must not report a recovered engine CACHE-STALE
+        # forever).
+        self._cache_valid = True
+        _flight.record_event("serving", what="admit", name=f"r{req.rid}",
+                             seq=slot)
+
+    def step(self):
+        """One engine iteration: admit + prefill free slots, then one
+        decode step for every active slot. Returns True when any work
+        happened (False = idle)."""
+        import jax.numpy as jnp
+
+        for slot, req in self._sched.admit():
+            self._prefill_into(slot, req)
+        active = self._sched.active()
+        if not active:
+            return False
+        t0 = time.perf_counter()
+        logits, self._cache = self._step_fn(
+            self.params, self._cache, jnp.asarray(self._tokens),
+            jnp.asarray(self._pos))
+        logits_np = np.asarray(logits)        # device sync
+        dt = time.perf_counter() - t0
+        committed = 0
+        for slot, req in active.items():
+            tok = sample_token(logits_np[slot], req.temperature,
+                               req.top_k, req.top_p, req.seed,
+                               len(req.committed))
+            first = not req.committed
+            finished = req.commit_token(tok)
+            if first:
+                _metrics.record_serving_ttft(req.t_first - req.t_submit)
+            self._tokens[slot] = tok
+            self._pos[slot] += 1
+            committed += 1
+            if finished:
+                self._sched.retire(slot)
+                req.finish()
+                # The registry holds only live (restorable) requests —
+                # without the prune, a long-running server leaks every
+                # prompt + token list it ever served. A restore that
+                # rolls back PAST this completion re-materializes the
+                # request from the snapshot; the caller's already
+                # resolved future keeps the identical (deterministic)
+                # stream.
+                self._requests.pop(req.rid, None)
+                self._served += 1
+                _metrics.record_serving_request("completed")
+                _flight.record_event("serving", what="complete",
+                                     name=f"r{req.rid}",
+                                     dur=req.t_done - req.t_submit)
+        _metrics.record_serving_step(dt, len(active), self.num_slots,
+                                     committed)
+        self._step_count += 1
+        if self.mark_steps:
+            _flight.step_marker(self._step_count)
+        return True
+
+    def run_until_idle(self, max_steps=100000, commit=None):
+        """Drive :meth:`step` until queue and slots drain; ``commit`` (an
+        optional callable) runs after every step — the elastic commit
+        hook the soak worker uses."""
+        steps = 0
+        while not self.idle():
+            progressed = self.step()
+            if commit is not None:
+                commit()
+            steps += 1
+            if steps >= max_steps:
+                raise RuntimeError(
+                    f"serving engine did not drain in {max_steps} steps "
+                    f"(queue={self._sched.queue_depth()}, "
+                    f"active={self._sched.n_active()})")
+            if not progressed and self.idle():
+                break
+        return steps
+
+    def idle(self):
+        return self._sched.idle()
+
+    def queue_depth(self):
+        """Admission-queue depth — cheap (no snapshot frame): hot loops
+        (bench pacing, backpressure probes) poll it per iteration."""
+        return self._sched.queue_depth()
+
+    # --- elastic integration ----------------------------------------------
+
+    def request_snapshot(self):
+        """Picklable request-level state: active slots first (they re-admit
+        ahead of the queue — FIFO completion order survives), then the
+        queue, oldest first."""
+        return {
+            "active": [self._sched.active()[s].snapshot()
+                       for s in sorted(self._sched.active())],
+            "queued": [r.snapshot() for r in self._sched.queued()],
+            "served": self._served,
+        }
+
+    def kv_snapshot(self):
+        """Host snapshot of the live slot caches + cursors (the migration
+        payload; None when the runtime is stubbed or caches are stale)."""
+        import jax
+        if not self._cache_valid or self._stub[0] is not None:
+            return None
+        return {"cache": jax.device_get(self._cache),
+                "pos": self._pos.copy(), "tokens": self._tokens.copy(),
+                "slots": {s: r.rid
+                          for s, r in self._sched.active().items()}}
+
+    def load_request_snapshot(self, snap):
+        """Restore request-level state from :meth:`request_snapshot`.
+        Known rids keep their live Request objects (callers' futures stay
+        wired); unknown rids (a worker that joined after submission)
+        materialize fresh ones. Active-at-snapshot requests re-queue at
+        the head — the cache that backed them is declared stale. Live
+        requests submitted AFTER the snapshot was taken are merged in
+        behind it (a restore must not drop work that arrived since the
+        last commit)."""
+        if snap is None:
+            return
+        with self._submit_lock:
+            self._load_request_snapshot_locked(snap)
+
+    def _load_request_snapshot_locked(self, snap):
+        snap_rids = {rs["rid"]
+                     for rs in list(snap.get("active", ()))
+                     + list(snap.get("queued", ()))}
+        # Requests running in THIS engine right now are the ones the
+        # rollback actually re-queues (the sync that follows a restore
+        # replays the same snapshot over an already-queued set — that
+        # second pass must not double-count).
+        was_active = {r.rid for r in self._sched.active().values()}
+        later = [r for r in list(self._sched.active().values())
+                 + self._sched.queued()
+                 if r.rid not in snap_rids and not r.done()]
+        self._sched = SlotScheduler(self.num_slots,
+                                    queue_limit=self._sched.queue_limit)
+        self._served = int(snap.get("served", 0))
+        for rs in list(snap.get("active", ())) + list(snap.get("queued",
+                                                               ())):
+            req = self._requests.get(rs["rid"])
+            if req is not None \
+                    and req.identity() != Request.snapshot_identity(rs):
+                # Cross-process rid collision: rids are process-local
+                # counters, so a broadcast snapshot (scale-up sync) can
+                # carry another worker's request under a rid a DIFFERENT
+                # local request already owns. Never graft the foreign
+                # committed tokens onto it — materialize the snapshot's
+                # request separately and leave the local one's registry
+                # slot (and its caller's future) alone.
+                req = None
+                register = False
+            else:
+                register = True
+            if req is None:
+                req = Request(rs["prompt"], rs["max_new"],
+                              temperature=rs["temperature"],
+                              top_k=rs["top_k"], top_p=rs["top_p"],
+                              eos_id=rs["eos_id"], seed=rs["seed"],
+                              rid=rs["rid"])
+                if register:
+                    self._requests[req.rid] = req
+            req.restore_committed(rs["committed"])
+            # Monotonic: the committed snapshot's count can only LAG the
+            # live one (the bump below, or an eviction that preceded this
+            # sync) — a replay of the same snapshot must never roll the
+            # disruption accounting back.
+            req.requeues = max(req.requeues, int(rs.get("requeues", 0)))
+            if req.rid in was_active:
+                req.requeues += 1
+                _metrics.record_serving_request("requeued")
+                _flight.record_event("serving", what="requeue",
+                                     name=f"r{req.rid}")
+            self._sched.enqueue_restored(req)
+        for req in later:
+            self._sched.enqueue_restored(req)
+        self._cache_valid = False
+        self._pos[:] = 0
+        self._tokens[:] = 0
+
+    def invalidate_cache(self):
+        """Mark slot caches unusable (a restore rolled requests behind the
+        cache's cursors)."""
+        self._cache_valid = False
+
+    def detach_to_host(self):
+        """Pull the cache tree to host memory before a backend teardown
+        (the graceful-migration path: buffers of the dying PJRT client
+        must not leak, but the K/V VALUES survive as numpy)."""
+        import jax
+        if self._stub[0] is None and self._cache_valid:
+            self._cache = jax.device_get(self._cache)
+
+    def reset_runtime(self, kv=None):
+        """Rebuild programs + caches on the (possibly new) backend after
+        an elastic membership change.
+
+        Priority: an explicit ``kv`` snapshot (committed migration
+        payload) > the live detached cache (graceful host-update with
+        ``migrate_kv``) > evict-and-requeue (in-flight requests re-enter
+        the queue from their last committed token and re-prefill)."""
+        import jax
+        import jax.numpy as jnp
+
+        live = None
+        if kv is not None:
+            live = kv
+        elif self.migrate_kv and self._cache_valid \
+                and self._stub[0] is None:
+            live = {"cache": self._cache, "pos": self._pos.copy(),
+                    "tokens": self._tokens.copy(),
+                    "slots": {s: r.rid
+                              for s, r in self._sched.active().items()}}
+        self._build_runtime()
+        if live is not None and self._stub[0] is None:
+            # Re-place the migrated K/V rows on the new backend. Slot
+            # assignments and cursors resume exactly where the snapshot
+            # left them — no re-prefill, zero recompute.
+            self._cache = jax.tree_util.tree_map(jnp.asarray,
+                                                 live["cache"])
+            self._pos[:] = live["pos"]
+            self._tokens[:] = live["tokens"]
+            active = {r.rid: r for r in self._sched.active().values()}
+            want = live.get("slots", {})
+            if set(want.values()) != set(active):
+                # Snapshot and scheduler disagree (snapshot predates a
+                # load_request_snapshot eviction): fall back to requeue.
+                self._evict_all()
+            self._cache_valid = True
+            return
+        self._evict_all()
+        self._cache_valid = True
+
+    def _evict_all(self):
+        for req in self._sched.evict_active():
+            _flight.record_event("serving", what="requeue",
+                                 name=f"r{req.rid}")
+        self._pos[:] = 0
+        self._tokens[:] = 0
+
+    # --- observability ----------------------------------------------------
+
+    def snapshot(self):
+        """One JSON-able frame for ``/serving/health`` and the telemetry
+        readiness gate."""
+        active = self._sched.active()
+        return {
+            "t": time.time(),
+            "slots": self.num_slots,
+            "active": len(active),
+            "queue_depth": self._sched.queue_depth(),
+            "queue_limit": self._sched.queue_limit,
+            "fill_ratio": round(self._sched.fill_ratio(), 4),
+            "served": self._served,
+            "steps": self._step_count,
+            "max_len": self.max_len,
+            "cache_valid": self._cache_valid,
+            "requests": {
+                str(s): {"rid": r.rid, "generated": len(r.committed),
+                         "budget": r.max_new, "requeues": r.requeues}
+                for s, r in active.items()},
+            # Saturation = queue at (or beyond) its declared limit: the
+            # load balancer should stop sending here.
+            "saturated": bool(self._sched.queue_limit
+                              and self._sched.queue_depth()
+                              >= self._sched.queue_limit),
+        }

@@ -1,0 +1,105 @@
+"""ServingState: elastic commit/restore/sync for a live serving engine.
+
+Extends :class:`horovod_tpu.elastic.TpuState` so the serving fleet rides
+the SAME rendezvous machinery as training: ``commit()`` snapshots the
+model params (a tracked tree) plus the request-level state (queue order
+and every in-flight request's committed tokens — a picklable attr that
+``sync()`` broadcasts to workers joining at scale-up); on a collective
+failure ``restore()`` rolls requests back to the last commit and marks
+the slot caches stale; on a membership change ``reset()`` rebuilds the
+engine runtime on the new backend, either MIGRATING the in-flight K/V
+caches (graceful host updates detach them to host first —
+``HOROVOD_SERVING_MIGRATE_KV``) or re-queuing every in-flight request
+from its last committed token for re-prefill.
+
+Either way the zero-drop invariant holds: a request is never lost and
+never skips ahead — its remaining tokens are reproduced exactly
+(position-keyed sampling), so a rolling restart or worker kill is
+invisible in the token streams.
+
+Usage (the chaos soak's shape)::
+
+    engine = ServingEngine(model, params, num_slots=4)
+    reqs = [engine.submit(p, max_new=8) for p in prompts]
+    state = ServingState(engine, step=0)
+    elastic.attach_listener(state)
+
+    @elastic.run
+    def serve(state):
+        def commit():
+            state.step += 1
+            state.commit()
+        engine.run_until_idle(commit=commit)
+        return [r.result(0) for r in reqs]
+"""
+
+from horovod_tpu.elastic.state import TpuState
+
+
+class ServingState(TpuState):
+    def __init__(self, engine, trees=None, **kwargs):
+        self._engine = engine
+        self._params_src = None      # identity of the last-saved params
+        all_trees = {"params": engine.params}
+        all_trees.update(trees or {})
+        kwargs.setdefault("reqs", engine.request_snapshot())
+        super().__init__(trees=all_trees, **kwargs)
+
+    def save(self):
+        self.reqs = self._engine.request_snapshot()
+        # Keep the tracked params tree pointed at the engine's live one
+        # (LoRA hot-swaps replace engine.params between commits).
+        self._trees["params"] = self._engine.params
+        # Serving commits run per step GROUP (default cadence 1 = per
+        # generated token): re-snapshotting the params tree every commit
+        # would device_get the whole model per token even though serving
+        # never mutates it. Reuse the previous host copy while the live
+        # tree is the SAME object (the engine never donates params; a
+        # LoRA hot-swap replaces the object and forces a fresh copy).
+        prev = self._saved_trees.get("params") \
+            if self._params_src is self._engine.params else None
+        if prev is not None:
+            del self._trees["params"]
+            try:
+                super().save()
+            finally:
+                self._trees["params"] = self._engine.params
+            self._saved_trees["params"] = prev
+        else:
+            super().save()
+        self._params_src = self._engine.params
+
+    def restore(self):
+        super().restore()
+        self._engine.params = self._trees["params"]
+        self._params_src = None      # restored copy: re-snapshot next save
+        # Requests roll back to the last commit; the device caches are now
+        # AHEAD of the committed streams, so they are stale by definition.
+        self._engine.load_request_snapshot(self.reqs)
+        self._engine.invalidate_cache()
+
+    def sync(self):
+        super().sync()
+        self._engine.params = self._trees["params"]
+        self._params_src = None      # broadcast copy: re-snapshot next save
+        # Joining workers materialize the broadcast request set; existing
+        # workers merge (known rids keep their caller futures). A worker
+        # whose live request state ALREADY equals the broadcast snapshot
+        # — the graceful-migration boundary: commit, membership change,
+        # sync — skips the merge: rolling back to an identical snapshot
+        # would evict the freshly migrated slot caches for nothing.
+        if self._engine.request_snapshot() != self.reqs:
+            self._engine.load_request_snapshot(self.reqs)
+
+    def detach_to_host(self):
+        # Engine first: the K/V migration payload must leave the dying
+        # backend before TpuState detaches the params.
+        self._engine.detach_to_host()
+        super().detach_to_host()
+
+    def reset(self):
+        # New backend, new (possibly resized) world: rebuild the runtime.
+        # The engine migrates its detached live cache when armed for it
+        # and the slot table survived; otherwise it evicts-and-requeues.
+        self._engine.reset_runtime()
+        super().reset()

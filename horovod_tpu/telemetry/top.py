@@ -41,6 +41,13 @@ def _fetch_kv(addr_port):
     return json.loads(raw) if raw is not None else None
 
 
+def _fetch_serving(base):
+    from urllib import request as urlrequest
+    with urlrequest.urlopen(base.rstrip("/") + "/serving/health",
+                            timeout=5) as r:
+        return json.loads(r.read())
+
+
 def _age(now, t):
     return f"{now - t:5.1f}s" if t else "    ?"
 
@@ -63,6 +70,33 @@ def gate(view, now=None):
     health = view.get("health") or {}
     return bool(health) and all(s.get("state") == "healthy"
                                 for s in health.values())
+
+
+def serving_ready(snap):
+    """The serving half of the readiness gate (``--once --serving``):
+    True iff a serving engine answered AND it can absorb traffic — the
+    admission queue is below its declared limit and the slot caches are
+    live (a post-disruption engine whose caches are still stale must not
+    take load-balancer traffic yet). Pure so tests drive it with
+    synthetic frames."""
+    if not snap or snap.get("error"):
+        return False
+    if snap.get("saturated"):
+        return False
+    return bool(snap.get("cache_valid", True))
+
+
+def render_serving(snap):
+    """One-line serving frame appended under the cluster view."""
+    if not snap or snap.get("error"):
+        return "serving: no engine answered"
+    return (f"serving: {snap.get('active', 0)}/{snap.get('slots', '?')} "
+            f"slots  queue={snap.get('queue_depth', 0)}"
+            + (f"/{snap['queue_limit']}" if snap.get("queue_limit") else "")
+            + f"  served={snap.get('served', 0)}"
+            f"  fill={snap.get('fill_ratio', 0.0):.2f}"
+            + ("  SATURATED" if snap.get("saturated") else "")
+            + ("" if snap.get("cache_valid", True) else "  CACHE-STALE"))
 
 
 def render(view, now=None):
@@ -130,7 +164,15 @@ def main(argv=None):
     p.add_argument("--interval", type=float, default=2.0)
     p.add_argument("--once", action="store_true",
                    help="print one frame; exit 0 iff all ranks healthy")
+    p.add_argument("--serving", action="store_true",
+                   help="additionally account serving health (the "
+                        "/serving/health frame of --url): the --once "
+                        "gate then also requires an unsaturated engine "
+                        "with live caches — the load-balancer readiness "
+                        "probe (docs/inference.md). Requires --url.")
     args = p.parse_args(argv)
+    if args.serving and not args.url:
+        p.error("--serving reads /serving/health and needs --url")
     if not args.url and not args.kv:
         import os
         addr, port = os.environ.get("HOROVOD_KV_ADDR"), \
@@ -148,10 +190,23 @@ def main(argv=None):
             print(f"fetch failed: {e}", file=sys.stderr)
             return None
 
+    def fetch_serving():
+        if not args.serving:
+            return None
+        try:
+            return _fetch_serving(args.url)
+        except Exception as e:  # noqa: BLE001 — a dead engine = not ready
+            print(f"serving fetch failed: {e}", file=sys.stderr)
+            return None
+
     if args.once:
         view = fetch()
         print(render(view))
         ok = gate(view)
+        if args.serving:
+            snap = fetch_serving()
+            print(render_serving(snap))
+            ok = ok and serving_ready(snap)
         if not ok and view is not None \
                 and all(s.get("state") == "healthy"
                         for s in (view.get("health") or {}).values()):
@@ -161,6 +216,8 @@ def main(argv=None):
     try:
         while True:
             frame = render(fetch())
+            if args.serving:
+                frame += "\n" + render_serving(fetch_serving())
             # Clear + home, like watch(1); plain newline when not a tty.
             if sys.stdout.isatty():
                 sys.stdout.write("\x1b[2J\x1b[H")

@@ -24,7 +24,8 @@ class TestElasticExports:
     def test_sharded_helpers_exported(self):
         from horovod_tpu import elastic
 
-        for name in ("gather_to_host", "zero_reshard", "fsdp_reshard"):
+        for name in ("gather_to_host", "zero_reshard", "fsdp_reshard",
+                     "kv_reshard"):
             assert callable(getattr(elastic, name)), name
 
 
@@ -93,6 +94,92 @@ class TestZeroReshardResize:
             lambda a, b: np.testing.assert_allclose(
                 np.asarray(a), np.asarray(b), rtol=1e-6),
             stepped.params, stepped_ref.params)
+
+
+class TestKvCacheReshardResize:
+    def test_kv_tree_round_trip_8_4_8_token_stream_equality(self, hvd):
+        """The serving fleet's migration leg: decode N tokens into a
+        slot-sharded KV cache on an 8-chip mesh, gather it to host,
+        re-place it for a 4-chip mesh (``kv_reshard`` — a pure layout
+        move, NOT ``zero_reshard``'s flatten/re-pad, which would destroy
+        position-addressed K/V rows), continue decoding, reshard back to
+        8, and finish: the full token streams must equal an unresized
+        run's exactly."""
+        import dataclasses
+
+        from horovod_tpu import elastic
+        from horovod_tpu.models import GPT, GPTConfig
+        from horovod_tpu.models.generate import init_decode_cache
+
+        cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                             max_position_embeddings=24)
+        model = GPT(cfg)
+        dec = dataclasses.replace(model, decode=True)
+        B, P, total = 8, 4, 14
+        rng = np.random.default_rng(7)
+        prompt = jnp.asarray(rng.integers(0, cfg.vocab_size, (B, P)),
+                             jnp.int32)
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+
+        def feed(cache, toks, pos):
+            logits, upd = dec.apply({"params": params, "cache": cache},
+                                    toks[:, None], pos=pos,
+                                    mutable=["cache"])
+            return upd["cache"], jnp.argmax(logits[:, 0],
+                                            axis=-1).astype(jnp.int32)
+
+        def prefill(cache):
+            pos = jnp.zeros((B,), jnp.int32)
+            for t in range(P - 1):
+                cache, _ = feed(cache, prompt[:, t], pos)
+                pos = pos + 1
+            return cache, pos, prompt[:, P - 1]
+
+        def decode(cache, pos, tok, n):
+            out = []
+            for _ in range(n):
+                cache, tok = feed(cache, tok, pos)
+                pos = pos + 1
+                out.append(np.asarray(tok))
+            return cache, pos, tok, out
+
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P_
+
+        def hop(cache, pos, tok, k):
+            """One migration hop: KV tree to host, re-placed for the
+            k-chip mesh; the decode cursors re-place replicated alongside
+            it (what the engine's reset_runtime does on a new backend)."""
+            mesh = _submesh(k)
+            cache = elastic.kv_reshard(elastic.gather_to_host(cache),
+                                       mesh)
+            rep = NamedSharding(mesh, P_())
+            return (cache, jax.device_put(jax.device_get(pos), rep),
+                    jax.device_put(jax.device_get(tok), rep), mesh)
+
+        # Unresized reference stream.
+        cache, pos, tok = prefill(init_decode_cache(
+            dec, prompt[:, :1], pos=jnp.zeros((B,), jnp.int32)))
+        _, _, _, ref = decode(cache, pos, tok, total - P)
+
+        # Resized run: 8 → 4 → 8 with a host round-trip at each hop.
+        cache, pos, tok = prefill(init_decode_cache(
+            dec, prompt[:, :1], pos=jnp.zeros((B,), jnp.int32)))
+        cache, pos, tok, mesh = hop(cache, pos, tok, 8)
+        cache, pos, tok, s1 = decode(cache, pos, tok, 4)
+        # Slot rows actually shard over the 8-way mesh (B=8 divides it).
+        k0 = jax.tree_util.tree_leaves(cache)[0]
+        assert {d.id for d in k0.sharding.device_set} == \
+            {d.id for d in jax.devices()[:8]}
+        cache, pos, tok, mesh = hop(cache, pos, tok, 4)
+        cache, pos, tok, s2 = decode(cache, pos, tok, 3)
+        k0 = jax.tree_util.tree_leaves(cache)[0]
+        assert {d.id for d in k0.sharding.device_set} == \
+            {d.id for d in jax.devices()[:4]}
+        cache, pos, tok, mesh = hop(cache, pos, tok, 8)
+        cache, pos, tok, s3 = decode(cache, pos, tok, total - P - 7)
+        np.testing.assert_array_equal(np.asarray(s1 + s2 + s3),
+                                      np.asarray(ref))
 
 
 class TestFsdpReshardResize:

@@ -334,6 +334,64 @@ class TestGenerate:
             with pytest.raises(ValueError, match="position capacity"):
                 call()
 
+    @pytest.mark.parametrize("family", ["gpt", "llama"])
+    def test_per_row_decode_positions_match_scalar_cursor(self, hvd, rng,
+                                                          family):
+        """The serving engine's per-row ``pos`` vector path (each batch
+        row decodes at its OWN cursor — continuous batching) must produce
+        the same logits as independent scalar-cursor decodes, including
+        STAGGERED rows that park and rewrite a position while waiting
+        (the idle-slot pattern). Covers GPT (learned positions) and
+        LLaMA (RoPE + GQA)."""
+        import dataclasses
+
+        from horovod_tpu.models import (GPT, GPTConfig, Llama,
+                                        LlamaConfig)
+        from horovod_tpu.models.generate import init_decode_cache
+
+        if family == "gpt":
+            cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None, num_layers=2,
+                                 max_position_embeddings=16)
+            model = GPT(cfg)
+        else:
+            cfg = LlamaConfig.tiny(tp_axis=None, num_layers=2,
+                                   max_position_embeddings=16)
+            model = Llama(cfg)
+        prompt = jnp.asarray(np.asarray(
+            rng.integers(0, 256, (2, 6)), np.int32))
+        params = model.init(jax.random.PRNGKey(0), prompt)["params"]
+        dec = dataclasses.replace(model, decode=True)
+
+        def scalar_row(row):
+            cache = init_decode_cache(dec, row[None, :1], pos=0)
+            logits = None
+            for t in range(row.shape[0]):
+                out, upd = dec.apply({"params": params, "cache": cache},
+                                     row[None, t:t + 1], pos=t,
+                                     mutable=["cache"])
+                cache, logits = upd["cache"], out[:, 0]
+            return logits
+
+        ref = jnp.concatenate([scalar_row(prompt[0]),
+                               scalar_row(prompt[1])])
+        # Staggered per-row feed: row 1 starts 2 steps late, parked at
+        # position 0 (re-fed, re-written — never attended ahead of its
+        # cursor) while row 0 advances.
+        cache = init_decode_cache(dec, prompt[:, :1],
+                                  pos=jnp.zeros((2,), jnp.int32))
+        P = prompt.shape[1]
+        last = None
+        for step in range(P + 2):
+            t0 = min(step, P - 1)
+            t1 = max(0, min(step - 2, P - 1))
+            feed = jnp.stack([prompt[0, t0], prompt[1, t1]])[:, None]
+            pos = jnp.asarray([t0, t1], jnp.int32)
+            out, upd = dec.apply({"params": params, "cache": cache},
+                                 feed, pos=pos, mutable=["cache"])
+            cache, last = upd["cache"], out[:, 0]
+        np.testing.assert_allclose(np.asarray(last), np.asarray(ref),
+                                   rtol=2e-5, atol=2e-5)
+
     def test_eos_stops_generation(self, hvd):
         """eos_id semantics on every decode path: generation freezes at
         the first GENERATED eos and pads with it (fixed shapes); beams

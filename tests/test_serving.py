@@ -1,0 +1,509 @@
+"""Fast tier-1 units for the serving subsystem (horovod_tpu/serving).
+
+Coverage map (the chaos-soak acceptance leg lives in
+tests/test_serving_soak.py, slow-marked):
+
+- scheduler slot lifecycle: admission order, retire/refill, eviction
+  requeue ordering, queue limits and backpressure — pure host logic;
+- engine correctness: continuous-batching greedy parity against
+  ``models.generate(use_cache=True)`` across staggered lengths, EOS,
+  per-request sampling determinism;
+- requeue-from-committed-token: a mid-flight snapshot/restore/reset
+  reproduces the exact token streams;
+- SLO metrics: the serving series populate (TTFT, token latency,
+  tokens, queue depth, fill ratio) and ride the scrape endpoint;
+- /serving/health + the ``telemetry top --once --serving`` gate;
+- the HTTP frontend end-to-end;
+- knob declaration + launcher propagation (the HVL002 / running.md
+  contract).
+"""
+
+import json
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+
+@pytest.fixture(scope="module")
+def tiny_serving():
+    from horovod_tpu.models import GPT, GPTConfig
+
+    cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                         max_position_embeddings=32)
+    model = GPT(cfg)
+    params = model.init(jax.random.PRNGKey(1),
+                        jnp.zeros((1, 4), jnp.int32))["params"]
+    return model, params, cfg
+
+
+class TestSlotScheduler:
+    def _req(self, **kw):
+        from horovod_tpu.serving import Request
+        kw.setdefault("prompt", [1, 2])
+        kw.setdefault("max_new", 4)
+        return Request(**kw)
+
+    def test_admission_fifo_and_retire_refill(self):
+        from horovod_tpu.serving import SlotScheduler
+
+        s = SlotScheduler(2)
+        r = [self._req() for _ in range(4)]
+        for x in r:
+            s.submit(x)
+        assert s.queue_depth() == 4 and s.n_active() == 0
+        placed = s.admit()
+        assert [x.rid for _, x in placed] == [r[0].rid, r[1].rid]
+        assert s.fill_ratio() == 1.0 and s.queue_depth() == 2
+        # Continuous batching: retiring ONE slot refills from the queue
+        # head while the other slot keeps its request.
+        assert s.retire(0) is r[0]
+        placed = s.admit()
+        assert placed == [(0, r[2])]
+        assert s.active()[1] is r[1]
+
+    def test_evict_requeues_ahead_of_queue_in_slot_order(self):
+        from horovod_tpu.serving import SlotScheduler
+
+        s = SlotScheduler(2)
+        r = [self._req() for _ in range(3)]
+        for x in r:
+            s.submit(x)
+        s.admit()
+        evicted = s.evict_active()
+        assert [x.rid for x in evicted] == [r[0].rid, r[1].rid]
+        # Evicted requests precede the still-queued one, in slot order.
+        assert [x.rid for x in s.queued()] == \
+            [r[0].rid, r[1].rid, r[2].rid]
+        assert all(x.requeues == 1 for x in evicted)
+
+    def test_queue_limit_rejects_with_backpressure(self):
+        from horovod_tpu.serving import QueueFull, SlotScheduler
+
+        s = SlotScheduler(1, queue_limit=2)
+        s.submit(self._req())
+        s.submit(self._req())
+        victim = self._req()
+        with pytest.raises(QueueFull):
+            s.submit(victim)
+        assert victim.done()
+        with pytest.raises(RuntimeError, match="rejected"):
+            victim.result(0)
+
+    def test_request_validation(self):
+        from horovod_tpu.serving import Request
+
+        with pytest.raises(ValueError):
+            Request([], 4)
+        with pytest.raises(ValueError):
+            Request([1], 0)
+        with pytest.raises(ValueError):
+            Request([1], 4, temperature=-1.0)
+        with pytest.raises(ValueError):
+            Request([1], 4, top_p=0.0)
+
+
+class TestServingEngineParity:
+    def test_greedy_parity_with_generate_across_staggered_lengths(
+            self, hvd, tiny_serving):
+        """Six prompts of different lengths through 3 slots — every
+        stream must equal the cached generate() loop's exactly, even
+        though slots retire and refill mid-flight (continuous
+        batching)."""
+        from horovod_tpu.models import generate
+        from horovod_tpu.serving import ServingEngine
+
+        model, params, cfg = tiny_serving
+        rng = np.random.default_rng(0)
+        prompts = [[int(t) for t in rng.integers(0, cfg.vocab_size, n)]
+                   for n in (3, 5, 1, 7, 4, 2)]
+        eng = ServingEngine(model, params, num_slots=3, prefill_chunk=4,
+                            mark_steps=False)
+        reqs = [eng.submit(p, max_new=6) for p in prompts]
+        eng.run_until_idle()
+        for p, r in zip(prompts, reqs):
+            ref = np.asarray(generate(
+                model, params, jnp.asarray([p], jnp.int32),
+                max_len=len(p) + 6, use_cache=True))[0]
+            assert r.result(0) == [int(t) for t in ref], p
+        snap = eng.snapshot()
+        assert snap["served"] == len(prompts) and snap["active"] == 0
+
+    def test_eos_finishes_early_and_frees_the_slot(self, hvd,
+                                                   tiny_serving):
+        from horovod_tpu.models import generate
+        from horovod_tpu.serving import ServingEngine
+
+        model, params, cfg = tiny_serving
+        prompt = [5, 9, 11]
+        # Pick the first greedily generated token as the EOS: the request
+        # must finish after exactly one generated token.
+        ref = np.asarray(generate(model, params,
+                                  jnp.asarray([prompt], jnp.int32),
+                                  max_len=len(prompt) + 4,
+                                  use_cache=True))[0]
+        eos = int(ref[len(prompt)])
+        eng = ServingEngine(model, params, num_slots=2, mark_steps=False)
+        r = eng.submit(prompt, max_new=8, eos_id=eos)
+        eng.run_until_idle()
+        out = r.result(0)
+        assert out == prompt + [eos]
+
+    def test_sampled_streams_deterministic_per_seed(self, hvd,
+                                                    tiny_serving):
+        from horovod_tpu.serving import ServingEngine
+
+        model, params, cfg = tiny_serving
+        prompt = [3, 1, 4]
+
+        def run(seed):
+            eng = ServingEngine(model, params, num_slots=2,
+                                mark_steps=False)
+            r = eng.submit(prompt, max_new=6, temperature=0.9, top_k=16,
+                           seed=seed)
+            eng.run_until_idle()
+            return r.result(0)
+
+        assert run(7) == run(7)
+        assert run(7) != run(8) or run(7) != run(9)  # astronomically sure
+
+    def test_requeue_from_committed_token_reproduces_stream(
+            self, hvd, tiny_serving):
+        """The zero-drop invariant, single-process: interrupt a request
+        mid-generation (snapshot → restore → runtime reset, what an
+        elastic disruption does), finish it, and the stream equals the
+        uninterrupted run's."""
+        from horovod_tpu.serving import ServingEngine
+
+        model, params, cfg = tiny_serving
+        prompt = [2, 7, 1, 8]
+        eng = ServingEngine(model, params, num_slots=2, mark_steps=False)
+        r0 = eng.submit(prompt, max_new=7, temperature=0.7, seed=3)
+        eng.run_until_idle()
+        expected = r0.result(0)
+
+        eng2 = ServingEngine(model, params, num_slots=2,
+                             mark_steps=False)
+        r = eng2.submit(prompt, max_new=7, temperature=0.7, seed=3)
+        for _ in range(3):                 # a few committed tokens
+            eng2.step()
+        snap = eng2.request_snapshot()
+        assert snap["active"], "request should be mid-flight"
+        committed_at_snap = len(snap["active"][0]["committed"])
+        eng2.step()                        # uncommitted progress, rolled
+        eng2.load_request_snapshot(snap)   # back by the restore
+        eng2.reset_runtime()               # new-backend analog
+        # The rollback counts as one requeue (it was in flight) and the
+        # generated tokens rolled back to the committed prefix.
+        assert r.requeues == 1 and len(r.committed) == committed_at_snap
+        eng2.run_until_idle()
+        assert r.result(0) == expected
+        assert eng2.snapshot()["served"] == 1
+
+    def test_submit_validates_capacity(self, hvd, tiny_serving):
+        from horovod_tpu.serving import ServingEngine
+
+        model, params, cfg = tiny_serving
+        eng = ServingEngine(model, params, num_slots=1, mark_steps=False)
+        with pytest.raises(ValueError, match="capacity"):
+            eng.submit(list(range(20)), max_new=20)
+
+
+class TestServingSloMetrics:
+    def test_slo_series_populate_and_scrape(self, hvd, tiny_serving):
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.serving import ServingEngine
+
+        model, params, cfg = tiny_serving
+        reg = ins.get_registry()
+        before = {
+            "tokens": _counter_value(reg, "serving_tokens_total"),
+            "completed": _counter_value(reg, "serving_requests_total",
+                                        {"event": "completed"}),
+            "ttft": _hist_count(reg, "serving_ttft_seconds"),
+            "lat": _hist_count(reg, "serving_token_latency_seconds"),
+            "fill": _hist_count(reg, "serving_batch_fill_ratio"),
+        }
+        eng = ServingEngine(model, params, num_slots=2, mark_steps=False)
+        reqs = [eng.submit([1, 2, 3], max_new=4) for _ in range(3)]
+        eng.run_until_idle()
+        for r in reqs:
+            r.result(0)
+        assert _counter_value(reg, "serving_tokens_total") \
+            >= before["tokens"] + 12
+        assert _counter_value(reg, "serving_requests_total",
+                              {"event": "completed"}) \
+            >= before["completed"] + 3
+        assert _hist_count(reg, "serving_ttft_seconds") \
+            >= before["ttft"] + 3
+        assert _hist_count(reg, "serving_token_latency_seconds") \
+            > before["lat"]
+        assert _hist_count(reg, "serving_batch_fill_ratio") \
+            > before["fill"]
+        # The series ride the standard text exposition (scrape endpoint).
+        text = reg.render_text()
+        for name in ("serving_ttft_seconds", "serving_tokens_total",
+                     "serving_queue_depth", "serving_batch_fill_ratio",
+                     "serving_token_latency_seconds"):
+            assert name in text, name
+
+
+class TestServingHealthEndpointAndGate:
+    def test_health_endpoint_and_top_serving_gate(self, hvd,
+                                                  tiny_serving):
+        from urllib import request as urlrequest
+
+        from horovod_tpu.metrics.server import MetricsServer
+        from horovod_tpu.serving import ServingEngine
+        from horovod_tpu.telemetry import top
+
+        model, params, cfg = tiny_serving
+        eng = ServingEngine(model, params, num_slots=2, queue_limit=2,
+                            mark_steps=False)
+        srv = MetricsServer(port=0, addr="127.0.0.1")
+        srv.start()
+        try:
+            base = f"http://127.0.0.1:{srv.port}"
+            with urlrequest.urlopen(base + "/serving/health",
+                                    timeout=5) as resp:
+                snap = json.loads(resp.read())
+            assert snap["slots"] == 2 and snap["queue_depth"] == 0
+            assert not snap["saturated"]
+            assert top.serving_ready(snap)
+            # Saturate the queue: the gate must flip not-ready.
+            for _ in range(2):
+                eng.submit([1, 2], max_new=2)
+            with urlrequest.urlopen(base + "/serving/health",
+                                    timeout=5) as resp:
+                snap = json.loads(resp.read())
+            assert snap["saturated"] and not top.serving_ready(snap)
+            assert "SATURATED" in top.render_serving(snap)
+            eng.run_until_idle()
+            # Stale caches (post-disruption, pre-reset) fail the gate too.
+            eng.invalidate_cache()
+            assert not top.serving_ready(eng.snapshot())
+            eng.reset_runtime()
+            assert top.serving_ready(eng.snapshot())
+            # No engine at all = fail closed (a dead worker must not
+            # take LB traffic).
+            assert not top.serving_ready(None)
+            assert not top.serving_ready({"error": "no serving engine"})
+        finally:
+            srv.stop()
+
+    def test_http_frontend_end_to_end(self, hvd, tiny_serving):
+        from urllib import request as urlrequest
+
+        from horovod_tpu.models import generate
+        from horovod_tpu.serving import ServingEngine
+        from horovod_tpu.serving.server import ServingFrontend
+
+        model, params, cfg = tiny_serving
+        eng = ServingEngine(model, params, num_slots=2, mark_steps=False)
+        fe = ServingFrontend(eng, port=0, addr="127.0.0.1",
+                             request_timeout=60)
+        fe.start()
+        try:
+            prompt = [4, 2, 9]
+            body = json.dumps({"prompt": prompt,
+                               "max_new": 5}).encode()
+            req = urlrequest.Request(
+                f"http://127.0.0.1:{fe.port}/generate", data=body,
+                headers={"Content-Type": "application/json"})
+            with urlrequest.urlopen(req, timeout=60) as resp:
+                out = json.loads(resp.read())
+            ref = np.asarray(generate(
+                model, params, jnp.asarray([prompt], jnp.int32),
+                max_len=len(prompt) + 5, use_cache=True))[0]
+            assert out["tokens"] == [int(t) for t in ref]
+            assert out["generated"] == 5 and out["ttft_s"] is not None
+            with urlrequest.urlopen(
+                    f"http://127.0.0.1:{fe.port}/health",
+                    timeout=5) as resp:
+                assert json.loads(resp.read())["served"] >= 1
+        finally:
+            fe.stop()
+
+
+class TestServingStateElastic:
+    def test_commit_restore_rolls_requests_back(self, hvd, tiny_serving):
+        from horovod_tpu.serving import ServingEngine, ServingState
+
+        model, params, cfg = tiny_serving
+        eng = ServingEngine(model, params, num_slots=2, mark_steps=False)
+        r1 = eng.submit([1, 2, 3], max_new=6)
+        r2 = eng.submit([4, 5], max_new=6)
+        state = ServingState(eng, step=0)
+        for _ in range(3):
+            eng.step()
+            state.step += 1
+            state.save()                       # commit() minus chaos/KV
+        committed = {r1.rid: list(r1.committed),
+                     r2.rid: list(r2.committed)}
+        eng.step()                             # past the commit
+        assert len(r1.committed) > len(committed[r1.rid])
+        state.restore()
+        assert list(r1.committed) == committed[r1.rid]
+        assert list(r2.committed) == committed[r2.rid]
+        # The restore declared the caches stale; a reset re-queues the
+        # in-flight work and the engine finishes correctly.
+        assert not eng.snapshot()["cache_valid"]
+        state.reset()
+        eng.run_until_idle()
+        assert r1.done() and r2.done()
+
+    def test_late_submissions_survive_a_restore(self, hvd, tiny_serving):
+        """A request submitted AFTER the last commit must not be dropped
+        by the rollback (the merge leg of load_request_snapshot)."""
+        from horovod_tpu.serving import ServingEngine, ServingState
+
+        model, params, cfg = tiny_serving
+        eng = ServingEngine(model, params, num_slots=1, mark_steps=False)
+        r1 = eng.submit([1, 2], max_new=4)
+        state = ServingState(eng, step=0)
+        state.save()
+        late = eng.submit([7, 7, 7], max_new=3)
+        state.restore()
+        state.reset()
+        eng.run_until_idle()
+        assert r1.done() and late.done()
+        assert len(late.committed) == 3
+
+    def test_kv_migration_graceful_resize_skips_reprefill(
+            self, hvd, tiny_serving):
+        """migrate_kv: a graceful membership change (detach → reset, no
+        restore) keeps the in-flight caches — the request finishes
+        without a requeue, and the stream matches the undisturbed run."""
+        from horovod_tpu.serving import ServingEngine
+
+        model, params, cfg = tiny_serving
+        prompt = [6, 3, 2, 9]
+        ref_eng = ServingEngine(model, params, num_slots=2,
+                                mark_steps=False)
+        ref = ref_eng.submit(prompt, max_new=6)
+        ref_eng.run_until_idle()
+        expected = ref.result(0)
+
+        eng = ServingEngine(model, params, num_slots=2, migrate_kv=True,
+                            mark_steps=False)
+        r = eng.submit(prompt, max_new=6)
+        for _ in range(3):
+            eng.step()
+        eng.detach_to_host()               # graceful: cache stays valid
+        eng.reset_runtime()                # new-backend rebuild
+        assert r.requeues == 0, "migration must not requeue"
+        eng.run_until_idle()
+        assert r.result(0) == expected
+
+    def test_kv_snapshot_payload_restores_runtime(self, hvd,
+                                                  tiny_serving):
+        """The explicit-payload migration leg: ``kv_snapshot()`` →
+        ``reset_runtime(kv=...)`` (an orchestrator moving committed
+        in-flight caches) resumes decoding mid-stream with no requeue
+        and an unchanged token stream — independent of the
+        ``migrate_kv`` live-detach path."""
+        from horovod_tpu.serving import ServingEngine
+
+        model, params, cfg = tiny_serving
+        prompt = [5, 1, 8]
+        ref_eng = ServingEngine(model, params, num_slots=2,
+                                mark_steps=False)
+        ref = ref_eng.submit(prompt, max_new=6)
+        ref_eng.run_until_idle()
+        expected = ref.result(0)
+
+        eng = ServingEngine(model, params, num_slots=2, mark_steps=False)
+        r = eng.submit(prompt, max_new=6)
+        for _ in range(3):
+            eng.step()
+        kv = eng.kv_snapshot()
+        assert kv is not None and r.rid in kv["slots"].values()
+        eng.reset_runtime(kv=kv)
+        assert r.requeues == 0, "an explicit payload must not requeue"
+        eng.run_until_idle()
+        assert r.result(0) == expected
+
+    def test_prefill_revalidates_cache_after_rollback(self, hvd,
+                                                      tiny_serving):
+        """The readiness gate must not report a RECOVERED engine
+        CACHE-STALE forever: a rollback invalidates the caches, and the
+        first post-rollback admission (which re-prefills into the
+        rebuilt slot table) makes them live again."""
+        from horovod_tpu.serving import ServingEngine, ServingState
+
+        model, params, cfg = tiny_serving
+        eng = ServingEngine(model, params, num_slots=1, mark_steps=False)
+        r = eng.submit([3, 1, 4], max_new=5)
+        state = ServingState(eng, step=0)
+        for _ in range(2):
+            eng.step()
+            state.save()
+        state.restore()
+        state.reset()
+        state.sync()                       # the elastic.run recovery order
+        assert not eng.snapshot()["cache_valid"]
+        eng.step()                         # re-admits + prefills
+        assert eng.snapshot()["cache_valid"]
+        eng.run_until_idle()
+        assert r.done()
+
+
+class TestServingKnobContract:
+    def test_knobs_declared_and_propagated(self):
+        """Every HOROVOD_SERVING_* knob is a Config field (HVL002) and
+        rides build_worker_env to the workers (running.md propagation
+        contract), and `hvdrun --serving` maps flags to env."""
+        from horovod_tpu.analysis.lint import declared_knobs
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.runner.hosts import (get_host_assignments,
+                                              parse_hosts)
+        from horovod_tpu.runner.launch import build_worker_env, parse_args
+
+        knobs = ("HOROVOD_SERVING", "HOROVOD_SERVING_PORT",
+                 "HOROVOD_SERVING_SLOTS", "HOROVOD_SERVING_MAX_LEN",
+                 "HOROVOD_SERVING_PREFILL_CHUNK",
+                 "HOROVOD_SERVING_QUEUE_LIMIT",
+                 "HOROVOD_SERVING_MIGRATE_KV", "HOROVOD_SERVING_MODEL",
+                 "HOROVOD_SERVING_COMMIT_STEPS")
+        declared = declared_knobs()
+        for k in knobs:
+            assert k in declared, f"{k} not declared in Config"
+        cfg = Config.from_env()
+        assert cfg.serving_slots >= 1 and cfg.serving_prefill_chunk >= 1
+
+        args = parse_args(["-np", "2", "--serving", "--serving-port",
+                           "9000", "--serving-slots", "8",
+                           "--serving-queue-limit", "64",
+                           "python", "-m", "horovod_tpu.serving"])
+        slots = get_host_assignments(parse_hosts("h1:1,h2:1"), 2)
+        import os
+        os.environ["HOROVOD_SERVING_MODEL"] = "llama_tiny"
+        try:
+            env = build_worker_env(
+                {}, [s for s in slots if s.hostname == "h2"],
+                "coord", 1234, 5678, args)
+        finally:
+            del os.environ["HOROVOD_SERVING_MODEL"]
+        assert env["HOROVOD_SERVING"] == "1"
+        assert env["HOROVOD_SERVING_PORT"] == "9000"
+        assert env["HOROVOD_SERVING_SLOTS"] == "8"
+        assert env["HOROVOD_SERVING_QUEUE_LIMIT"] == "64"
+        # Ambient serving knobs ride through like every declared knob.
+        assert env["HOROVOD_SERVING_MODEL"] == "llama_tiny"
+
+
+def _counter_value(reg, name, labels=None):
+    total = 0.0
+    for s in reg.snapshot().get(name, {}).get("series", ()):
+        if labels is None or all(s["labels"].get(k) == v
+                                 for k, v in labels.items()):
+            total += s.get("value", 0)
+    return total
+
+
+def _hist_count(reg, name):
+    total = 0
+    for s in reg.snapshot().get(name, {}).get("series", ()):
+        total += s.get("count", 0)
+    return total

@@ -1,0 +1,42 @@
+"""The 8-process serving chaos soak — the acceptance leg of the serving
+subsystem (ISSUE 13 / ROADMAP item 2).
+
+Marked ``slow`` (a clean single-process reference plus one full
+8-process elastic serving run with two staggered kills); run it
+explicitly with::
+
+    pytest tests/test_serving_soak.py -m slow
+    # or: python -m horovod_tpu.serving.soak
+
+Asserts (inside horovod_tpu.serving.soak.run_serving_soak): every
+submitted request completes on every surviving worker with token
+streams identical to the clean run (zero drops — requests re-queue from
+their last committed token through the elastic restore), resets stay
+within the kill budget, and the flight-recorder dumps let
+``horovod_tpu.flight.analyze`` name each killed rank, the first
+unmatched heartbeat-collective seq, and the causing injection.
+"""
+
+import pytest
+
+
+@pytest.mark.slow
+@pytest.mark.timeout(1500)
+class TestServingChaosSoak:
+    def test_rolling_kills_drop_zero_requests(self, hvd, tmp_path):
+        from horovod_tpu.serving import soak
+
+        evidence = soak.run_serving_soak(procs=8, n_requests=10,
+                                         max_new=5, slots=2, seed=123,
+                                         workdir=str(tmp_path))
+        # Two kills → the world shrank twice and stayed serving.
+        assert evidence["kill_budget"] == 2
+        assert all(r["final_world"] == 6 for r in evidence["results"])
+        # The forensics named both victims.
+        flight = evidence["flight_report"]
+        assert sorted(flight["killed_ranks"]) == \
+            sorted(set(evidence["victims"]))
+        assert all(c["site"] == "elastic.commit"
+                   for c in flight["causes"])
+        assert any(d.get("first_unmatched_seq") is not None
+                   for d in flight["desync"].values())
