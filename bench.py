@@ -1157,6 +1157,58 @@ def _bench_serving_sweep(hvd):
           "request-rate ladder)", 0.0)
 
 
+def _bench_control_sweep(hvd):
+    """Control-plane sweep (`HVD_BENCH_MODEL=control_sweep`): negotiation
+    rounds / blocking gets / payload bytes per round across a
+    world x slices ladder, flat vs hierarchical, measured by driving the
+    REAL exchange implementations at virtual world sizes (one thread per
+    simulated rank over an in-memory KV —
+    ``common/control_plane.simulate_exchange``, the same harness the
+    n=128-512 dryrun guard in tests/test_multiproc.py uses). Every
+    (world, slices, strategy) cell lands as a labeled `control_sweep`
+    record on the HVD_BENCH_PROGRESS_FILE channel; the final BENCH
+    record carries the hier-vs-flat worst-rank gets ratio at the largest
+    world — the host-side fan-out collapse the hierarchy buys."""
+    from horovod_tpu.common import control_plane as cp
+
+    rounds = max(int(os.environ.get("HVD_BENCH_ITERS", "3")), 1)
+    ladder = [(8, 2), (32, 4), (128, 8), (512, 16)]
+    ratio_largest = 1.0
+    for world, slices in ladder:
+        cells = {}
+        for strategy, k in (("flat", 0), ("hier", slices)):
+            t0 = time.perf_counter()
+            r = cp.simulate_exchange(world, k, rounds=rounds,
+                                     strategy=strategy)
+            wall = time.perf_counter() - t0
+            worst = max(c["gets"] for c in r["per_proc"]) / rounds
+            cell = {
+                "world": world, "slices": r["num_slices"],
+                "strategy": r["strategy"], "rounds": rounds,
+                "identical": r["identical"],
+                "gets_total_per_round": r["gets_total"] / rounds,
+                "worst_rank_gets_per_round": worst,
+                "member_gets_per_round": r["member_gets_per_round"],
+                "leader_gets_per_round": r["leader_gets_per_round"],
+                "payload_bytes_per_round": r["payload_bytes"] / rounds,
+                "wall_s": round(wall, 3),
+            }
+            cells[r["strategy"]] = cell
+            _progress_record("control_sweep", **cell)
+            _mark(f"control_sweep w={world} s={slices} "
+                  f"{r['strategy']}: worst-rank gets/round {worst:.0f}, "
+                  f"member {cell['member_gets_per_round']:.0f}")
+        if "hier" in cells and "flat" in cells:
+            ratio_largest = cells["hier"]["worst_rank_gets_per_round"] \
+                / max(cells["flat"]["worst_rank_gets_per_round"], 1.0)
+    _progress_record("control_sweep_summary",
+                     hier_vs_flat_worst_rank_gets_ratio=round(
+                         ratio_largest, 4))
+    _emit("control_sweep_worst_rank_gets_ratio", round(ratio_largest, 4),
+          "hier/flat worst-rank negotiation gets ratio", 0.0)
+    return 0
+
+
 # Non-image benchmarks: selector -> (bench fn, metric name, unit). One
 # registry so dispatch and failure records can never disagree.
 _EXTRA_MODELS = {
@@ -1180,6 +1232,9 @@ _EXTRA_MODELS = {
     "serving_sweep": (_bench_serving_sweep,
                       "serving_sweep_peak_tokens_per_sec",
                       "tokens/sec/chip"),
+    "control_sweep": (_bench_control_sweep,
+                      "control_sweep_worst_rank_gets_ratio",
+                      "hier/flat worst-rank negotiation gets ratio"),
 }
 
 

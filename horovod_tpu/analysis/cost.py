@@ -267,6 +267,12 @@ class CostReport:
     # live wire_bytes_total{tier} counters accumulate per step; the
     # per-tier side of cross_check_bytes diffs against THIS.
     runtime_bytes_by_tier: dict = dataclasses.field(default_factory=dict)
+    # Control-plane load prediction: negotiation rounds per step and the
+    # per-role KV RPC counts of one round under the resolved strategy
+    # (control_plane.exchange_plan — the same layout math the runtime
+    # decomposes with), so the static model predicts host-side fan-out
+    # alongside wire bytes.
+    control_plane: dict = dataclasses.field(default_factory=dict)
 
     @property
     def ok(self):
@@ -286,6 +292,7 @@ class CostReport:
             "jit_bytes_by_dtype": dict(self.jit_bytes_by_dtype),
             "hierarchical": dict(self.hierarchical),
             "time_estimate": dict(self.time_estimate),
+            "control_plane": dict(self.control_plane),
             "exact": self.exact,
             "dcn_budget_bytes": self.dcn_budget_bytes,
             "rows": [dataclasses.asdict(r) for r in self.rows],
@@ -327,6 +334,21 @@ class CostReport:
                 f"  hierarchical what-if (local RS -> cross-slice -> "
                 f"local AG): ici={h['ici']} dcn={h['dcn']} "
                 f"(DCN x{h['dcn_vs_flat']:.3f} of the flat schedule)")
+        cp = self.control_plane
+        if cp:
+            per = cp.get("per_round", {})
+            if cp.get("strategy") == "hier":
+                detail = (f"member gets {cp['member_gets']} "
+                          f"(flat would be {cp['flat_gets']}), leader "
+                          f"gets {cp['leader_gets']} (local "
+                          f"{per.get('leader_local_gets', 0)} + cross "
+                          f"{per.get('leader_cross_gets', 0)} per round)")
+            else:
+                detail = f"per-rank gets {cp['member_gets']}"
+            lines.append(
+                f"  control plane ({cp.get('strategy')}): "
+                f"{cp.get('rounds_per_step', 0)} negotiation round(s)/"
+                f"step — {detail}")
         t = self.time_estimate
         if t.get("ici_s") is not None or t.get("dcn_s") is not None:
             est = " [placeholder peaks]" if t.get("estimate") else ""
@@ -474,7 +496,41 @@ def cost_report(report, *, config=None, num_slices=None,
         jit_bytes_by_dtype=jit_by_dtype, hierarchical=hier,
         time_estimate=t, findings=sort_findings(findings), exact=exact,
         dcn_budget_bytes=dcn_budget_bytes,
-        runtime_bytes_by_tier=runtime_tier)
+        runtime_bytes_by_tier=runtime_tier,
+        control_plane=_control_plane_cost(events, world, n_slices,
+                                          config))
+
+
+def _control_plane_cost(events, world, num_slices, config):
+    """Predicted control-plane load of one step: negotiation rounds (the
+    dynamic-shape exchanges — ragged allgather / uneven alltoall — plus
+    the per-dispatch join/order-check rounds when those modes are armed)
+    priced with :func:`control_plane.exchange_plan` under the resolved
+    strategy. Each rank is priced as one process — the launcher's
+    worst-case (1 chip per process) and exactly the CPU-tier test
+    layout, so the guard's measured counters are directly comparable."""
+    from horovod_tpu.common import control_plane as _cp
+    negotiated = sum(max(e.repeat, 1) for e in events
+                     if e.origin != "jit"
+                     and e.op in ("alltoall", "allgather_ragged"))
+    extra = (1 if getattr(config, "join_mode", False) else 0) \
+        + (1 if getattr(config, "order_check", False) else 0)
+    if extra:
+        negotiated += extra * sum(
+            max(e.repeat, 1) for e in events if e.origin != "jit")
+    strategy = "flat" if _cp.configured() == "flat" or num_slices <= 1 \
+        else "hier"
+    plan = _cp.exchange_plan(world, num_slices if strategy == "hier"
+                             else 1)
+    return {
+        "strategy": plan["strategy"], "rounds_per_step": negotiated,
+        "per_round": plan,
+        # Per-step blocking gets by role, vs the flat fan-out — the
+        # O(slice_size + num_slices) vs O(world) claim as numbers.
+        "member_gets": negotiated * plan["member_gets"],
+        "leader_gets": negotiated * plan["leader_gets"],
+        "flat_gets": negotiated * (world - 1),
+    }
 
 
 def check_cost(step_fn, args=(), kwargs=None, *, world_size=None,

@@ -75,7 +75,8 @@ _BOOTSTRAP_VARS = frozenset({
     "HOROVOD_RANK", "HOROVOD_SIZE", "HOROVOD_LOCAL_RANK",
     "HOROVOD_LOCAL_SIZE", "HOROVOD_CROSS_RANK", "HOROVOD_CROSS_SIZE",
     "HOROVOD_COORDINATOR_ADDR", "HOROVOD_COORDINATOR_PORT",
-    "HOROVOD_KV_ADDR", "HOROVOD_KV_PORT", "HOROVOD_SECRET_KEY",
+    "HOROVOD_KV_ADDR", "HOROVOD_KV_PORT", "HOROVOD_KV_SHARD_PORTS",
+    "HOROVOD_SECRET_KEY",
     "HOROVOD_HOSTNAME", "HOROVOD_HOST_KEY",
     "HOROVOD_ELASTIC_INIT_VERSION",
     # test-harness only

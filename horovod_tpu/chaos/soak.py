@@ -327,6 +327,15 @@ def run_leader_kill_soak(procs=8, slices=2, steps=8, seed=321,
             "HOROVOD_CHAOS_LEDGER": os.path.join(workdir, "ledger"),
             "HOROVOD_FLIGHT_DIR": os.path.join(workdir, "flight"),
             "HOROVOD_MESH_SLICES": str(slices),
+            # The HIERARCHICAL control plane rides the same leader-kill
+            # transition: the victim is a slice's lowest rank — its
+            # negotiation leadership and fusion-boundary re-publish role
+            # die with it, and the post-shrink world (procs-1, usually
+            # undivisible) must degrade to the flat strategy on every
+            # survivor identically. A short boundary lease keeps the
+            # takeover window inside the kill-to-rendezvous gap.
+            "HOROVOD_CONTROL_PLANE": "hier",
+            "HOROVOD_CONTROL_LEASE_MS": "500",
             # Tight beacon cadence: the old generation's job view must
             # exist before the kill, and the new generation must converge
             # within the post-loop wait.

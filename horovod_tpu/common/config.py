@@ -163,6 +163,25 @@ class Config:
     # a perf metric too. See docs/performance.md.
     compile_cache_dir: str = ""
 
+    # --- hierarchical control plane (common/control_plane.py) ---
+    # flat | hier | "" = auto (hier whenever the slice layout has >1
+    # slice). hier decomposes negotiation.exchange into slice-local +
+    # leaders-only rounds, mirrors fusion boundaries through slice
+    # leaders, and (launcher-side) shards the HTTP-KV per slice — member
+    # ranks issue O(1) blocking control-plane reads instead of O(world).
+    control_plane: str = ""
+    # Per-slice HTTP-KV shard listeners started by the launcher (0 =
+    # one per slice when the hierarchical control plane is armed; an
+    # explicit count overrides the slice layout).
+    kv_shard_count: int = 0
+    # First shard listener port; shard k binds base + k (0 = ephemeral
+    # ports, propagated to workers via HOROVOD_KV_SHARD_PORTS).
+    kv_shard_port_base: int = 0
+    # Leader lease for the hierarchical fusion-boundary stream: a member
+    # that observes a root boundary its slice leader has not re-published
+    # within this window takes the re-publish role over.
+    control_lease_ms: float = 2000.0
+
     # --- control-plane resilience (runner/http_kv.py KVStoreClient) ---
     # A single transient connection reset mid-negotiation used to kill the
     # caller; the client now retries transient transport faults (URLError,
@@ -354,6 +373,11 @@ class Config:
                     "wire options; inside jit the same tier is reachable "
                     "via Compression.int8 on the optimizer or "
                     "strategies.allreduce_quantized")
+        self.control_plane = (self.control_plane or "").strip().lower()
+        if self.control_plane not in ("", "flat", "hier"):
+            raise ValueError(
+                f"control_plane={self.control_plane!r}: flat, hier, or "
+                "empty (auto: hier when the slice layout has >1 slice)")
 
     @classmethod
     def from_env(cls):
@@ -421,6 +445,14 @@ class Config:
                                     c.cross_overlap)
         c.wire_error_feedback = _env_bool("HOROVOD_WIRE_ERROR_FEEDBACK",
                                           c.wire_error_feedback)
+        c.control_plane = os.environ.get("HOROVOD_CONTROL_PLANE",
+                                         c.control_plane)
+        c.kv_shard_count = _env_int("HOROVOD_KV_SHARD_COUNT",
+                                    c.kv_shard_count)
+        c.kv_shard_port_base = _env_int("HOROVOD_KV_SHARD_PORT_BASE",
+                                        c.kv_shard_port_base)
+        c.control_lease_ms = _env_float("HOROVOD_CONTROL_LEASE_MS",
+                                        c.control_lease_ms)
         c.__post_init__()  # re-normalize after the env override
         c.donate_buffers = _env_bool("HOROVOD_DONATE_BUFFERS", c.donate_buffers)
         # Eager-path donation only on an EXPLICIT opt-in (see field docs).

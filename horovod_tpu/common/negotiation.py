@@ -42,15 +42,31 @@ _lock = threading.Lock()
 # record_fusion_kv() — otherwise the guard would be blind to the async
 # path's KV traffic.
 _stats = {"rounds": 0, "gets": 0, "payload_bytes": 0,
-          "fusion_sets": 0, "fusion_gets": 0, "fusion_payload_bytes": 0}
+          # Per-tier breakdown of the hierarchical control plane
+          # (HOROVOD_CONTROL_PLANE=hier / auto on a multi-slice layout):
+          # rounds that decomposed, the leader's slice-local member
+          # reads, the leaders-only cross-slice (DCN) reads, and the
+          # members' O(1) fan-back reads. gets == gets_local + gets_cross
+          # + gets_fanback for hier rounds, world-1 for flat rounds.
+          "hier_rounds": 0, "gets_local": 0, "gets_cross": 0,
+          "gets_fanback": 0,
+          "fusion_sets": 0, "fusion_gets": 0, "fusion_payload_bytes": 0,
+          # Fusion boundary reads by tier: root = the coordinator's
+          # store key (leaders + flat followers), slice = the leader
+          # re-publish keys (members). The hierarchy guard asserts a
+          # member's root gets stay ZERO.
+          "fusion_root_gets": 0, "fusion_slice_gets": 0}
 
 
 def stats_snapshot():
     """Copy of this process's cumulative KV-traffic counters: ``rounds``
     (exchange() calls that hit the KV store — one set each), ``gets``
-    (peer reads issued; world-1 per round), ``payload_bytes`` (serialized
+    (peer reads issued; world-1 per flat round, per-tier sums for
+    hierarchical rounds — see ``hier_rounds``/``gets_local``/
+    ``gets_cross``/``gets_fanback``), ``payload_bytes`` (serialized
     local payload), and the fusion runtime's boundary traffic
-    (``fusion_sets``/``fusion_gets``/``fusion_payload_bytes``)."""
+    (``fusion_sets``/``fusion_gets``/``fusion_payload_bytes`` plus the
+    ``fusion_root_gets``/``fusion_slice_gets`` tier split)."""
     with _lock:
         return dict(_stats)
 
@@ -61,16 +77,21 @@ def stats_reset():
             _stats[k] = 0
 
 
-def record_fusion_kv(sets=0, gets=0, payload_bytes=0):
+def record_fusion_kv(sets=0, gets=0, payload_bytes=0, tier=None):
     """Report a fusion-runtime boundary KV operation (ops/fusion.py) into
     the shared traffic counters AND the metrics registry
     (``fusion_kv_rpcs_total`` / ``control_plane_rpcs_total``) — the
     hot-poll class of regression is a visible counter, not a code-review
-    catch."""
+    catch. ``tier`` ("root"/"slice") additionally books gets against the
+    hierarchical boundary stream's per-tier counters."""
     with _lock:
         _stats["fusion_sets"] += sets
         _stats["fusion_gets"] += gets
         _stats["fusion_payload_bytes"] += payload_bytes
+        if tier == "root":
+            _stats["fusion_root_gets"] += gets
+        elif tier == "slice":
+            _stats["fusion_slice_gets"] += gets
     _metrics.record_fusion_kv(sets=sets, gets=gets,
                               payload_bytes=payload_bytes)
 # Epoch namespace for the KV keys: bumped when an init REUSES a live
@@ -139,6 +160,15 @@ def exchange(tag, payload, procs=None):
     must call with the same ``tag`` in the same order (SPMD contract);
     non-participants must not call at all — scoping the exchange to the
     set's owners keeps them out of the rendezvous entirely.
+
+    When a slice hierarchy exists (``HOROVOD_CONTROL_PLANE`` hier/auto,
+    multi-slice layout), the round decomposes into a slice-local gather,
+    ONE leaders-only cross-slice round, and a leader->member fan-back
+    (``common/control_plane.py``): members issue O(1) blocking gets,
+    leaders O(slice_size + num_slices) — never O(world). The returned
+    payload list is bit-identical to the flat path's. The strategy is
+    resolved per call from the (propagated) env + layout, so every
+    participant decomposes — or doesn't — identically.
     """
     if procs is None:
         procs = list(range(jax.process_count()))
@@ -149,6 +179,7 @@ def exchange(tag, payload, procs=None):
         raise RuntimeError(
             f"process {me} is not a participant of negotiation '{tag}' "
             f"(participants: {procs})")
+    from horovod_tpu.common import control_plane as _cp
     proc_tag = ",".join(str(p) for p in procs)
     seq = _next_seq((tag, proc_tag))
     # Step-profiler bracket: the whole round — publish + blocking peer
@@ -161,34 +192,46 @@ def exchange(tag, payload, procs=None):
         _chaos.fire("negotiation.exchange")
     base = f"hvd/neg/e{_epoch}/{tag}/{proc_tag}/{seq}"
     blob = json.dumps(payload)
-    with _lock:
-        _stats["rounds"] += 1
-        _stats["gets"] += len(procs) - 1
-        _stats["payload_bytes"] += len(blob)
-    _metrics.record_negotiation(gets=len(procs) - 1, payload_bytes=len(blob))
+    groups = _cp.exchange_groups(procs)
     if _flight.armed:
         # Negotiation rounds are SPMD-ordered like collectives, so a rank
         # wedged INSIDE an exchange shows as the last event before the gap.
         _flight.record_event("negotiation", name=tag, seq=seq,
                              nbytes=len(blob))
-    client.key_value_set(f"{base}/{me}", blob)
+    kv = _cp.CoordKV(client)
+    if groups is None:
+        got, counters = _cp.flat_exchange(kv, me, procs, base, blob,
+                                          _TIMEOUT_MS)
+        out = [payload if p == me else json.loads(got[p]) for p in procs]
+    else:
+        out, counters = _cp.hier_exchange(kv, me, procs, base, blob,
+                                          groups, _TIMEOUT_MS)
+    with _lock:
+        _stats["rounds"] += 1
+        _stats["gets"] += counters["gets"]
+        _stats["payload_bytes"] += len(blob)
+        if groups is not None:
+            _stats["hier_rounds"] += 1
+            _stats["gets_local"] += counters["gets_local"]
+            _stats["gets_cross"] += counters["gets_cross"]
+            _stats["gets_fanback"] += counters["gets_fanback"]
+    _metrics.record_negotiation(
+        gets=counters["attempts"], payload_bytes=len(blob),
+        sets=counters["sets"],
+        tier_gets={"local": counters["gets_local"],
+                   "cross": counters["gets_cross"],
+                   "fanback": counters["gets_fanback"]}
+        if groups is not None else None)
     # Bound coordinator memory on long jobs: reaching seq s implies this
     # process completed exchange s-1, which required reading every peer's
-    # s-1 key — so every peer had *started* s-1 and therefore finished s-2.
-    # Nobody can still read an s-2 key: delete our own.
+    # s-1 key (or, hierarchically, the covering aggregate/fan-back) — so
+    # every peer had *started* s-1 and therefore finished s-2. Nobody can
+    # still read an s-2 key: delete our own (and, as a leader, the
+    # aggregate/fan-back blobs we published that round).
     if seq >= 2:
-        try:
-            client.key_value_delete(
-                f"hvd/neg/e{_epoch}/{tag}/{proc_tag}/{seq - 2}/{me}")
-        except Exception:  # deletion is best-effort housekeeping
-            pass
-    out = []
-    for p in procs:
-        if p == me:
-            out.append(payload)
-            continue
-        raw = client.blocking_key_value_get(f"{base}/{p}", _TIMEOUT_MS)
-        out.append(json.loads(raw))
+        _cp.gc_exchange_keys(
+            kv, me, f"hvd/neg/e{_epoch}/{tag}/{proc_tag}/{seq - 2}",
+            groups)
     if t_cp is not None:
         _profile.record_control_plane(time.perf_counter() - t_cp)
     return out

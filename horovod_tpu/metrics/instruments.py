@@ -423,14 +423,21 @@ def install_compile_cache_listener():
     _compile_listener_installed = True
 
 
-def record_negotiation(gets, payload_bytes):
-    """One negotiation.exchange() round: 1 set + ``gets`` peer reads."""
+def record_negotiation(gets, payload_bytes, sets=1, tier_gets=None):
+    """One negotiation.exchange() round: ``sets`` publishes + ``gets``
+    peer-read RPC attempts. Hierarchical rounds additionally pass
+    ``tier_gets`` ({"local","cross","fanback"}) so the scrape shows the
+    per-tier fan-out the slice-leader decomposition is supposed to bound
+    (kind=neg_get_<tier>)."""
     if not _enabled:
         return
     NEGOTIATION_ROUNDS.inc()
-    CONTROL_PLANE_RPCS.labels("coord", "set").inc()
+    CONTROL_PLANE_RPCS.labels("coord", "set").inc(max(int(sets), 1))
     if gets:
         CONTROL_PLANE_RPCS.labels("coord", "get").inc(gets)
+    for tier, n in (tier_gets or {}).items():
+        if n:
+            CONTROL_PLANE_RPCS.labels("coord", f"neg_get_{tier}").inc(n)
     if payload_bytes:
         CONTROL_PLANE_PAYLOAD.labels("coord").inc(payload_bytes)
 

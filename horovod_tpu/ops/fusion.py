@@ -279,6 +279,15 @@ _flush_plans = {}
 
 
 class FusionRuntime:
+    # Boundary-consumer role defaults (hierarchical control plane): also
+    # the flat-layout behavior, and what partially-constructed runtimes
+    # (tests drive _apply_ready_boundaries via __new__) fall back to.
+    _cp_role = "root"
+    _cp_slice = 0
+    _cp_members = 0
+    _cp_lease_s = 2.0
+    _lease_wait0 = None
+
     # Forwarded to the native scheduler so runtime threshold changes (the
     # autotuner, tests) affect its flush decision too.
     @property
@@ -350,6 +359,24 @@ class FusionRuntime:
         self._inflight_cross = []    # bucket outputs awaiting their wait
         self._multi = jax.process_count() > 1
         self._coord = jax.process_index() == 0
+        # Hierarchical boundary sync (HOROVOD_CONTROL_PLANE): the
+        # coordinator publishes each flush boundary ONCE to the root key;
+        # slice leaders re-publish to their slice key; members read only
+        # the slice key — so blocking reads against the coordinator's
+        # store scale with slice count, not world size. Members hold a
+        # lease on their leader's promptness: a root boundary their
+        # leader hasn't re-published within HOROVOD_CONTROL_LEASE_MS
+        # triggers takeover (see _fetch_boundary).
+        self._cp_slice, self._cp_role, self._cp_members = 0, "root", 0
+        self._cp_lease_s = max(
+            float(getattr(config, "control_lease_ms", 2000.0)), 100.0) \
+            / 1000.0
+        self._lease_wait0 = None
+        if self._multi:
+            from horovod_tpu.common import control_plane as _cp
+            groups = _cp.exchange_groups(list(range(jax.process_count())))
+            self._cp_slice, self._cp_role, self._cp_members = \
+                _cp.boundary_role(jax.process_index(), groups)
         self._parameter_manager = None
         # Autotune decisions are the COORDINATOR's alone under multi-process
         # launches: strategy/wire_dtype change the compiled program, and
@@ -494,6 +521,11 @@ class FusionRuntime:
         from horovod_tpu.common import negotiation
         return f"hvd/fusion/e{negotiation._epoch}/b{seq}"
 
+    def _slice_boundary_key(self, seq):
+        from horovod_tpu.common import negotiation
+        return (f"hvd/fusion/e{negotiation._epoch}/"
+                f"s{self._cp_slice}/b{seq}")
+
     # Boundary keys older than this many flushes are GC'd. Unlike
     # negotiation.exchange's lag-2 (safe there because exchange is a
     # blocking all-rank rendezvous), boundary publishing is one-way — a
@@ -578,6 +610,96 @@ class FusionRuntime:
             {"t": int(last_tid), "s": strategy, "w": wire,
              "cw": cross_wire or ""})))
 
+    def _republish_boundary(self, client, seq, raw):
+        """Slice leader: mirror the root boundary onto the slice key so
+        members never read the root store. Idempotent (overwrite-allowed
+        — a lease takeover may race the returning leader with the same
+        payload) and fail-soft: a failed re-publish costs the members one
+        lease window, never the stream."""
+        if self._cp_role != "leader" or self._cp_members <= 0:
+            return
+        from horovod_tpu.common import control_plane as _cp
+        from horovod_tpu.common import negotiation
+        try:
+            # CoordKV owns the one allow_overwrite compatibility shim.
+            _cp.CoordKV(client).set(self._slice_boundary_key(seq), raw,
+                                    overwrite=True)
+            negotiation.record_fusion_kv(sets=1, payload_bytes=len(raw))
+            if seq >= self._BOUNDARY_GC_LAG:
+                try:
+                    client.key_value_delete(self._slice_boundary_key(
+                        seq - self._BOUNDARY_GC_LAG))
+                except Exception:  # noqa: BLE001 — GC is best-effort
+                    pass
+        except Exception:  # noqa: BLE001 — keep consuming
+            pass
+
+    def _fetch_boundary(self, client, seq, block_ms):
+        """Role-aware boundary fetch. Leaders (and every follower on a
+        flat layout) block on the ROOT key and re-publish to their slice;
+        members block on the SLICE key under a leader lease: when the
+        root demonstrably holds a boundary the leader hasn't mirrored
+        within the lease window, the member promotes itself to leader
+        (the takeover the leader-kill test exercises) and serves the
+        slice from then on. Returns the raw payload, or None when no new
+        boundary is available yet."""
+        from horovod_tpu.common import negotiation
+        if self._cp_role != "member":
+            try:
+                raw = client.blocking_key_value_get(
+                    self._boundary_key(seq), block_ms)
+            except Exception:  # noqa: BLE001 — no new boundary yet
+                return None
+            negotiation.record_fusion_kv(gets=1, payload_bytes=len(raw),
+                                         tier="root")
+            self._republish_boundary(client, seq, raw)
+            return raw
+        try:
+            raw = client.blocking_key_value_get(
+                self._slice_boundary_key(seq), block_ms)
+            self._lease_wait0 = None
+            negotiation.record_fusion_kv(gets=1, payload_bytes=len(raw),
+                                         tier="slice")
+            return raw
+        except Exception:  # noqa: BLE001 — slice key not mirrored yet
+            pass
+        now = time.perf_counter()
+        if self._lease_wait0 is None:
+            self._lease_wait0 = now
+            return None
+        if now - self._lease_wait0 < self._cp_lease_s:
+            return None
+        # Lease expired: is there actually a root boundary the leader
+        # failed to mirror? A short probe — an empty root means there is
+        # nothing to re-publish and the lease simply renews.
+        try:
+            raw = client.blocking_key_value_get(self._boundary_key(seq),
+                                                50)
+        except Exception:  # noqa: BLE001 — nothing published anywhere
+            self._lease_wait0 = now
+            return None
+        negotiation.record_fusion_kv(gets=1, payload_bytes=len(raw),
+                                     tier="root")
+        # Takeover: this member is its slice's boundary re-publisher from
+        # now on (multiple members promoting concurrently is harmless —
+        # the re-publish is overwrite-idempotent with the same payload).
+        self._cp_role = "leader"
+        self._cp_members = max(self._cp_members - 1, 1)
+        self._lease_wait0 = None
+        from horovod_tpu import metrics as hvd_metrics
+        hvd_metrics.record_boundary("takeover")
+        if _flight.armed:
+            _flight.record_event("fusion_flush", seq=seq,
+                                 name="boundary_lease_takeover",
+                                 what=f"slice{self._cp_slice}")
+        from horovod_tpu.common import logging as hvd_logging
+        hvd_logging.warning(
+            "fusion boundary leader for slice %d stale past %.1fs — "
+            "taking over the slice re-publish at seq %d",
+            self._cp_slice, self._cp_lease_s, seq)
+        self._republish_boundary(client, seq, raw)
+        return raw
+
     def _apply_ready_boundaries(self, block_ms):
         """Follower: consume and apply published boundaries in order;
         waits up to ``block_ms`` for the FIRST one (later ones drain with a
@@ -611,14 +733,11 @@ class FusionRuntime:
                     time.sleep(min(max(int(block_ms), 1), 50) / 1000.0)
                     return applied
             else:
-                try:
-                    raw = client.blocking_key_value_get(
-                        self._boundary_key(seq), max(int(block_ms), 1))
-                except Exception:
+                raw = self._fetch_boundary(client, seq,
+                                           max(int(block_ms), 1))
+                if raw is None:
                     return applied          # no new boundary yet
                 import json as _json
-                from horovod_tpu.common import negotiation
-                negotiation.record_fusion_kv(gets=1, payload_bytes=len(raw))
                 payload = _json.loads(raw)
             last_tid = int(payload["t"])
             with self._boundary_lock:

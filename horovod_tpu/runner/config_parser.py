@@ -43,6 +43,10 @@ _ARG_ENV_MAP = [
     ("no_wire_error_feedback", "HOROVOD_WIRE_ERROR_FEEDBACK",
      lambda v: "0" if v else None),
     ("compile_cache_dir", "HOROVOD_COMPILE_CACHE_DIR", str),
+    ("control_plane", "HOROVOD_CONTROL_PLANE", str),
+    ("kv_shard_count", "HOROVOD_KV_SHARD_COUNT", str),
+    ("kv_shard_port_base", "HOROVOD_KV_SHARD_PORT_BASE", str),
+    ("control_lease_ms", "HOROVOD_CONTROL_LEASE_MS", str),
     ("elastic_timeout", "HOROVOD_ELASTIC_TIMEOUT", str),
     ("gloo_timeout_seconds", "HOROVOD_GLOO_TIMEOUT_SECONDS", str),
     ("nics", "HOROVOD_NICS", str),

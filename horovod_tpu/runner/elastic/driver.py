@@ -250,7 +250,15 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
         "HOROVOD_FLIGHT_DIR",
         _flight.default_collection_dir(
             getattr(args, "output_filename", None)))
-    kv = KVStoreServer()
+    # Elastic launches shard the KV plane too (slice-local scopes off the
+    # root listener); the shard count keys off the LARGEST world the job
+    # may reach — membership changes must not restart listeners.
+    from horovod_tpu.common import control_plane as _cp
+    from horovod_tpu.common.config import _env_int
+    kv = KVStoreServer(
+        shards=_cp.kv_shard_count(args.max_np or args.np or args.min_np
+                                  or 1),
+        shard_port_base=_env_int("HOROVOD_KV_SHARD_PORT_BASE", 0))
     kv_port = kv.start()
     for (scope, key), value in (kv_preload or {}).items():
         kv.put(scope, key, value)
@@ -396,7 +404,8 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
                 continue  # stays alive; re-inits in place on the bump
             env = build_worker_env(
                 {**(extra_env or {}), "HOROVOD_ELASTIC": "1"}, slots,
-                coordinator_addr, coordinator_port, kv_port, args)
+                coordinator_addr, coordinator_port, kv_port, args,
+                kv_shard_ports=kv.shard_ports)
             env["HOROVOD_HOST_KEY"] = host
             # Workers key their results by the membership version they run
             # under (updated in-place on re-init), so a survivor finishing

@@ -110,71 +110,121 @@ class _KVHandler(BaseHTTPRequestHandler):
 
 
 class KVStoreServer:
-    """reference: http_server.py KVStoreServer (threaded, scoped KV)."""
+    """reference: http_server.py KVStoreServer (threaded, scoped KV).
 
-    def __init__(self, port=0, secret=None):
+    ``shards`` > 0 additionally starts that many per-slice shard
+    listeners (the sharded control plane: slice-local scopes — the
+    ``scope@s<k>`` spelling from :mod:`horovod_tpu.common.control_plane`
+    — are served by shard ``k % shards``, so no single HTTP listener
+    carries O(world) traffic). The in-process accessors route through
+    the same scope resolver the :class:`KVStoreClient` uses, so
+    driver-side reads see one coherent store regardless of sharding."""
+
+    def __init__(self, port=0, secret=None, shards=0, shard_port_base=0):
         self._httpd = ThreadingHTTPServer(("0.0.0.0", port), _KVHandler)
         self._httpd.store = {}
         self._httpd.lock = threading.Lock()
         self._httpd.secret = secret if secret is not None \
             else os.environ.get(SECRET_ENV)
         self._thread = None
+        self._shards = [
+            KVStoreServer(port=(shard_port_base + i) if shard_port_base
+                          else 0, secret=secret)
+            for i in range(max(int(shards), 0))]
 
     @property
     def port(self):
         return self._httpd.server_address[1]
 
+    @property
+    def shard_ports(self):
+        return [s.port for s in self._shards]
+
     def start(self):
         self._thread = threading.Thread(target=self._httpd.serve_forever,
                                         daemon=True)
         self._thread.start()
+        for s in self._shards:
+            s.start()
         return self.port
 
     def stop(self):
         # shutdown() handshakes with serve_forever and would block
         # forever if start() was never called (in-process users drive
         # get/put directly); close the listener socket either way.
+        for s in self._shards:
+            s.stop()
         if self._thread is not None:
             self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
             self._thread.join(timeout=2)
 
-    # Direct (in-process) access for the driver side.
+    def _server_for(self, scope):
+        """The shard (or root: self) whose store owns ``scope``."""
+        from horovod_tpu.common.control_plane import shard_of_scope
+        k = shard_of_scope(scope, len(self._shards))
+        return self if k is None else self._shards[k]
+
+    # Direct (in-process) access for the driver side — scope-routed.
     def get(self, scope, key):
-        with self._httpd.lock:
-            return self._httpd.store.get(scope, {}).get(key)
+        srv = self._server_for(scope)
+        with srv._httpd.lock:
+            return srv._httpd.store.get(scope, {}).get(key)
 
     def put(self, scope, key, value):
-        with self._httpd.lock:
-            self._httpd.store.setdefault(scope, {})[key] = value
+        srv = self._server_for(scope)
+        with srv._httpd.lock:
+            srv._httpd.store.setdefault(scope, {})[key] = value
 
     def delete(self, scope, key=None):
-        with self._httpd.lock:
+        srv = self._server_for(scope)
+        with srv._httpd.lock:
             if key is None:
-                self._httpd.store.pop(scope, None)
+                srv._httpd.store.pop(scope, None)
             else:
-                self._httpd.store.get(scope, {}).pop(key, None)
+                srv._httpd.store.get(scope, {}).pop(key, None)
 
     def prune_scope(self, scope, keep_prefixes):
-        """Drop every key in ``scope`` not starting with one of
-        ``keep_prefixes`` (garbage collection for version-scoped keys)."""
-        with self._httpd.lock:
-            d = self._httpd.store.get(scope)
-            if not d:
-                return
-            for k in [k for k in d
-                      if not any(k.startswith(p) for p in keep_prefixes)]:
-                del d[k]
+        """Drop every key in ``scope`` — and in its slice-scoped family
+        ``scope@s*`` across all shards — not starting with one of
+        ``keep_prefixes`` (garbage collection for version-scoped keys).
+        The family sweep keeps generation pruning correct when a scope's
+        slice-local keys live on shard listeners (or, unsharded, as
+        sibling scopes in the root store)."""
+        family_prefix = scope + "@s"
+        for srv in [self] + self._shards:
+            with srv._httpd.lock:
+                for sc in [s for s in srv._httpd.store
+                           if s == scope or s.startswith(family_prefix)]:
+                    d = srv._httpd.store.get(sc)
+                    if not d:
+                        continue
+                    for k in [k for k in d
+                              if not any(k.startswith(p)
+                                         for p in keep_prefixes)]:
+                        del d[k]
 
 
 class KVStoreClient:
     """reference: http_client.py read_data_from_kvstore/put_data_into_kvstore,
-    with per-job HMAC signing (network.py:306)."""
+    with per-job HMAC signing (network.py:306).
+
+    Scope->shard resolver: when the launcher started per-slice shard
+    listeners (``KVStoreServer(shards=...)``, ports propagated via
+    ``HOROVOD_KV_SHARD_PORTS``), slice-local scopes (the ``scope@s<k>``
+    spelling) resolve to their shard's port and job-global scopes to the
+    root — every KV user (elastic rendezvous, the telemetry agent, the
+    task bootstrap) routes through this one resolver."""
 
     def __init__(self, addr, port, timeout=30, secret=None, retries=None,
-                 backoff_ms=None, backoff_max_ms=None):
+                 backoff_ms=None, backoff_max_ms=None, shard_ports=None):
+        self._addr = addr
         self._base = f"http://{addr}:{port}"
+        if shard_ports is None:
+            raw = os.environ.get("HOROVOD_KV_SHARD_PORTS", "")
+            shard_ports = [int(p) for p in raw.split(",") if p.strip()]
+        self._shard_ports = list(shard_ports or [])
         self._timeout = timeout
         self._secret = secret if secret is not None \
             else os.environ.get(SECRET_ENV)
@@ -192,14 +242,22 @@ class KVStoreClient:
             else _env_float("HOROVOD_KV_RETRY_BACKOFF_MAX_MS",
                             Config.kv_retry_backoff_max_ms)) / 1000.0
 
-    def _request(self, method, path, body=None):
-        req = urlrequest.Request(self._base + path, data=body, method=method)
+    def _base_for(self, scope):
+        from horovod_tpu.common.control_plane import shard_of_scope
+        k = shard_of_scope(scope, len(self._shard_ports))
+        if k is None:
+            return self._base
+        return f"http://{self._addr}:{self._shard_ports[k]}"
+
+    def _request(self, method, path, body=None, base=None):
+        req = urlrequest.Request((base or self._base) + path, data=body,
+                                 method=method)
         if self._secret:
             req.add_header(SIG_HEADER, compute_digest(
                 self._secret, method.encode(), path.encode(), body or b""))
         return req
 
-    def _open(self, method, path, body=None):
+    def _open(self, method, path, body=None, base=None):
         """One KV RPC with bounded retry on TRANSIENT transport faults
         (connection reset/refused mid-negotiation, HTTP 5xx) under
         jittered exponential backoff. Safe because every KV verb is
@@ -215,9 +273,11 @@ class KVStoreClient:
                 # Chaos site: each ATTEMPT is one site call, so a plan
                 # dropping calls [0, 1] exercises exactly two retries.
                 if _chaos.armed:
-                    _chaos.fire("http_kv.request", url=self._base + path)
-                return urlrequest.urlopen(self._request(method, path, body),
-                                          timeout=self._timeout)
+                    _chaos.fire("http_kv.request",
+                                url=(base or self._base) + path)
+                return urlrequest.urlopen(
+                    self._request(method, path, body, base=base),
+                    timeout=self._timeout)
             except urlerror.HTTPError as e:
                 if e.code < 500 or attempt == self._retries:
                     # The 404 that get() maps to "absent" is a semantic
@@ -245,7 +305,7 @@ class KVStoreClient:
         path = f"/{scope}/{key}"
         _metrics.record_http_kv("get")
         try:
-            with self._open("GET", path) as r:
+            with self._open("GET", path, base=self._base_for(scope)) as r:
                 value = r.read()
                 if self._secret and not check_digest(
                         self._secret, r.headers.get(SIG_HEADER, ""),
@@ -265,23 +325,36 @@ class KVStoreClient:
 
     def put(self, scope, key, value: bytes):
         _metrics.record_http_kv("put", payload_bytes=len(value))
-        with self._open("PUT", f"/{scope}/{key}", value):
+        with self._open("PUT", f"/{scope}/{key}", value,
+                        base=self._base_for(scope)):
             pass
 
     def delete(self, scope, key="*"):
         _metrics.record_http_kv("delete")
-        with self._open("DELETE", f"/{scope}/{key}"):
+        with self._open("DELETE", f"/{scope}/{key}",
+                        base=self._base_for(scope)):
             pass
 
-    def wait_for(self, scope, key, timeout=60, interval=0.1):
-        # Counted once as a "wait" on top of the per-iteration gets, so the
-        # scrape distinguishes intentional polling waits from raw get storms.
+    def wait_for(self, scope, key, timeout=60, interval=0.1,
+                 max_interval=2.0):
+        """Poll until ``scope/key`` appears: capped exponential backoff
+        with jitter from ``interval`` up to ``max_interval`` (a fixed
+        0.1 s cadence used to hammer the store for the whole wait), every
+        poll counted under ``control_plane_rpcs_total{http,wait_poll}``
+        so a hot-wait is a visible counter, not a mystery load."""
+        # Counted once as a "wait" on top of the per-iteration polls, so
+        # the scrape distinguishes waits from raw get storms.
         _metrics.record_http_kv("wait")
-        import time
         deadline = time.time() + timeout
-        while time.time() < deadline:
+        delay = max(float(interval), 0.01)
+        while True:
+            _metrics.record_http_kv("wait_poll")
             v = self.get(scope, key)
             if v is not None:
                 return v
-            time.sleep(interval)
-        raise TimeoutError(f"KV key {scope}/{key} not set within {timeout}s")
+            remaining = deadline - time.time()
+            if remaining <= 0:
+                raise TimeoutError(
+                    f"KV key {scope}/{key} not set within {timeout}s")
+            time.sleep(min(delay * (0.5 + random.random()), remaining))
+            delay = min(delay * 2, float(max_interval))

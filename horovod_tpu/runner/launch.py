@@ -117,6 +117,33 @@ def parse_args(argv=None):
                         dest="no_wire_error_feedback",
                         help="Disable the quantized wire's error-feedback "
                              "residuals (HOROVOD_WIRE_ERROR_FEEDBACK=0)")
+    tuning.add_argument("--control-plane", dest="control_plane",
+                        choices=["flat", "hier"],
+                        help="Control-plane strategy "
+                             "(HOROVOD_CONTROL_PLANE): hier decomposes "
+                             "negotiation + fusion-boundary sync into "
+                             "slice-local rounds with leaders-only "
+                             "cross-slice (DCN) rendezvous and shards "
+                             "the HTTP-KV per slice; default is hier "
+                             "whenever the slice layout has >1 slice. "
+                             "See docs/scale_validation.md.")
+    tuning.add_argument("--kv-shard-count", type=int,
+                        dest="kv_shard_count",
+                        help="Per-slice HTTP-KV shard listeners "
+                             "(HOROVOD_KV_SHARD_COUNT; 0 = one per "
+                             "slice when the hierarchical control "
+                             "plane is armed).")
+    tuning.add_argument("--kv-shard-port-base", type=int,
+                        dest="kv_shard_port_base",
+                        help="First shard listener port; shard k binds "
+                             "base + k (HOROVOD_KV_SHARD_PORT_BASE; "
+                             "0 = ephemeral).")
+    tuning.add_argument("--control-lease-ms", type=float,
+                        dest="control_lease_ms",
+                        help="Boundary-stream leader lease in ms "
+                             "(HOROVOD_CONTROL_LEASE_MS): a member "
+                             "whose slice leader goes stale past this "
+                             "window takes the re-publish over.")
     tuning.add_argument("--compile-cache-dir", dest="compile_cache_dir",
                         help="Persistent XLA compile-cache directory "
                              "exported to every worker "
@@ -329,7 +356,8 @@ def _resolve_launcher(args):
 
 
 def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
-                     coordinator_port, kv_port, args):
+                     coordinator_port, kv_port, args,
+                     kv_shard_ports=None):
     """Per-host env (reference: gloo_run.py:66-78, 203-227 — the rank/size env
     contract between launcher and core)."""
     first = slot_infos_for_host[0]
@@ -346,6 +374,12 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
         "HOROVOD_KV_ADDR": coordinator_addr,
         "HOROVOD_KV_PORT": str(kv_port),
     })
+    # Sharded KV plane: slice-local scopes resolve to these per-slice
+    # listeners through the KVStoreClient scope router (the hierarchical
+    # control plane's HTTP tier — common/control_plane.py).
+    if kv_shard_ports:
+        env["HOROVOD_KV_SHARD_PORTS"] = ",".join(
+            str(p) for p in kv_shard_ports)
     if os.environ.get(SECRET_ENV):
         env[SECRET_ENV] = os.environ[SECRET_ENV]
     # Persistent XLA compile cache: propagate the launcher's dir; elastic
@@ -412,6 +446,8 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_WIRE_DTYPE", "HOROVOD_WIRE_ERROR_FEEDBACK",
                 "HOROVOD_WIRE_DTYPE_DCN", "HOROVOD_HIERARCHICAL_DISPATCH",
                 "HOROVOD_CROSS_OVERLAP",
+                "HOROVOD_CONTROL_PLANE", "HOROVOD_KV_SHARD_COUNT",
+                "HOROVOD_KV_SHARD_PORT_BASE", "HOROVOD_CONTROL_LEASE_MS",
                 "HOROVOD_SERVING", "HOROVOD_SERVING_PORT",
                 "HOROVOD_SERVING_SLOTS", "HOROVOD_SERVING_MAX_LEN",
                 "HOROVOD_SERVING_PREFILL_CHUNK",
@@ -449,7 +485,15 @@ def _start_rendezvous(args):
     # Mint a per-job secret so all KV control-plane traffic is HMAC-signed
     # (reference: secret.py per-job key + network.py:306 signed messages).
     os.environ.setdefault(SECRET_ENV, make_secret_key())
-    kv = KVStoreServer()
+    # Per-slice shard listeners when the hierarchical control plane is
+    # armed (HOROVOD_CONTROL_PLANE + slice layout / explicit
+    # HOROVOD_KV_SHARD_COUNT): slice-local scopes never touch the root
+    # listener, so no single HTTP socket carries O(world) traffic.
+    from horovod_tpu.common import control_plane as _cp
+    from horovod_tpu.common.config import _env_int
+    kv = KVStoreServer(
+        shards=_cp.kv_shard_count(slot_infos[0].size),
+        shard_port_base=_env_int("HOROVOD_KV_SHARD_PORT_BASE", 0))
     kv_port = kv.start()
     kv.put("global", "size", str(slot_infos[0].size).encode())
     return slot_infos, by_host, coordinator_addr, coordinator_port, kv, kv_port
@@ -477,6 +521,9 @@ def _run_static_mpi(args, launcher, extra_env=None):
         "HOROVOD_KV_ADDR": coordinator_addr,
         "HOROVOD_KV_PORT": str(kv_port),
     })
+    if kv.shard_ports:
+        env["HOROVOD_KV_SHARD_PORTS"] = ",".join(
+            str(p) for p in kv.shard_ports)
     if os.environ.get(SECRET_ENV):
         env[SECRET_ENV] = os.environ[SECRET_ENV]
     config_parser.set_env_from_args(env, args)
@@ -537,7 +584,8 @@ def _run_static(args, extra_env=None, harvest=None, kv_preload=None):
         for host, slots in by_host.items():
             env = build_worker_env(dict(extra_env or {}), slots,
                                    coordinator_addr, coordinator_port,
-                                   kv_port, args)
+                                   kv_port, args,
+                                   kv_shard_ports=kv.shard_ports)
             workers.append(WorkerProcess(
                 host, args.command, env, tag=f"{host}",
                 ssh_port=args.ssh_port,

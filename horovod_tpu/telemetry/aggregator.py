@@ -147,10 +147,23 @@ class TelemetryAgent:
         except Exception:  # noqa: BLE001
             pass
 
-    def _get_json(self, key, phase, scoped=True):
+    def _scope(self, sid=None):
+        """Slice-local keys (rank beacons, slice summaries) live under a
+        slice-scoped spelling so the KV resolver (KVStoreClient /
+        KVStoreServer scope router) lands them on the per-slice shard
+        listener when the launcher sharded the plane — beacon fan-in off
+        the root store. Job-global keys (``sid=None``) stay on the
+        root."""
+        if sid is None:
+            return SCOPE
+        from horovod_tpu.common.control_plane import slice_scope
+        return slice_scope(SCOPE, sid)
+
+    def _get_json(self, key, phase, scoped=True, sid=None):
         try:
             self._count(phase)
-            raw = self.kv.get(SCOPE, self._key(key) if scoped else key)
+            raw = self.kv.get(self._scope(sid),
+                              self._key(key) if scoped else key)
         except Exception:  # noqa: BLE001 — a KV blip is one missed round
             return None
         if raw is None:
@@ -160,10 +173,11 @@ class TelemetryAgent:
         except (ValueError, TypeError):
             return None
 
-    def _put_json(self, key, obj, phase, scoped=True):
+    def _put_json(self, key, obj, phase, scoped=True, sid=None):
         try:
             self._count(phase)
-            self.kv.put(SCOPE, self._key(key) if scoped else key,
+            self.kv.put(self._scope(sid),
+                        self._key(key) if scoped else key,
                         json.dumps(obj).encode())
             return True
         except Exception:  # noqa: BLE001
@@ -187,19 +201,21 @@ class TelemetryAgent:
             # forever) plus the inherited event state (the next
             # acquisition must re-read the then-current view).
             for m in lower:
-                if self._fresh(self._get_json(f"rank/{m}", "probe_get"),
-                               now):
+                if self._fresh(self._get_json(f"rank/{m}", "probe_get",
+                                              sid=self.slice), now):
                     self._acting_slice_leader = False
                     self._acting_job_leader = False
                     self._inherited = False
                     return False
             return True
-        s = self._get_json(f"slice/{self.slice}", "probe_get")
+        s = self._get_json(f"slice/{self.slice}", "probe_get",
+                           sid=self.slice)
         if s is not None and self._fresh(s, now):
             return False
         # Summary stale or absent: the next live member takes over.
         for m in lower:
-            if self._fresh(self._get_json(f"rank/{m}", "probe_get"), now):
+            if self._fresh(self._get_json(f"rank/{m}", "probe_get",
+                                          sid=self.slice), now):
                 return False
         self._acting_slice_leader = True
         return True
@@ -212,7 +228,8 @@ class TelemetryAgent:
             return True
         if self._acting_job_leader:
             for s in lower:
-                if self._fresh(self._get_json(f"slice/{s}", "probe_get"),
+                if self._fresh(self._get_json(f"slice/{s}", "probe_get",
+                                              sid=s),
                                now):
                     self._acting_job_leader = False
                     self._inherited = False
@@ -223,7 +240,8 @@ class TelemetryAgent:
                 and self._fresh(j, now):
             return False
         for s in lower:
-            if self._fresh(self._get_json(f"slice/{s}", "probe_get"), now):
+            if self._fresh(self._get_json(f"slice/{s}", "probe_get",
+                                          sid=s), now):
                 return False
         self._acting_job_leader = True
         return True
@@ -254,12 +272,14 @@ class TelemetryAgent:
                             include_metrics=self.include_metrics)
         d["t"] = round(now, 6)
         self._last_digest = d
-        self._put_json(f"rank/{self.rank}", d, "beacon_put")
+        self._put_json(f"rank/{self.rank}", d, "beacon_put",
+                       sid=self.slice)
         if self._lead_slice(now):
             summary = self._compose_slice(now)
             if summary is not None:
                 self._last_slice_summary = summary
-                self._put_json(f"slice/{self.slice}", summary, "slice_put")
+                self._put_json(f"slice/{self.slice}", summary, "slice_put",
+                               sid=self.slice)
                 if self._lead_job(now):
                     view = self._compose_job(now, summary)
                     if view is not None:
@@ -273,7 +293,8 @@ class TelemetryAgent:
             if m == self.rank:
                 dig = self._last_digest      # own copy: no self-GET
             else:
-                dig = self._get_json(f"rank/{m}", "slice_get")
+                dig = self._get_json(f"rank/{m}", "slice_get",
+                                     sid=self.slice)
             if dig is None:
                 rows[str(m)] = None
                 continue
@@ -301,7 +322,7 @@ class TelemetryAgent:
             if own_summary is not None and s == self.slice:
                 out[s] = own_summary
             else:
-                out[s] = self._get_json(f"slice/{s}", phase)
+                out[s] = self._get_json(f"slice/{s}", phase, sid=s)
         return out
 
     def _inherit_previous_view(self):

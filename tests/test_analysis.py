@@ -600,6 +600,36 @@ class TestCostModel:
         cr3 = an_cost.cost_report(rep, num_slices=3)
         assert cr3.num_slices == 1 and cr3.bytes_by_tier["dcn"] == 0
 
+    def test_control_plane_rpcs_priced_per_tier(self, hvd):
+        """ISSUE 14: the static model prices negotiation RPCs alongside
+        wire bytes — a dynamic-shape alltoall costs one round, whose
+        per-role gets follow control_plane.exchange_plan under the
+        resolved hierarchy (member O(1), leader slice_size-1 +
+        num_slices-1), vs the flat O(world) fan-out."""
+        from horovod_tpu.analysis import cost as an_cost
+
+        n = 8
+        x = np.ones((n, n), np.float32)
+        splits = np.ones((n, n), int)
+
+        def step(x):
+            return hvd.alltoall(x, splits=splits)[0]
+
+        rep = hvd.check_program(step, (x,), world_size=n)
+        cr = an_cost.cost_report(rep, num_slices=2)
+        cp = cr.control_plane
+        assert cp["strategy"] == "hier"
+        assert cp["rounds_per_step"] == 1
+        assert cp["member_gets"] == 1
+        assert cp["leader_gets"] == (4 - 1) + (2 - 1)
+        assert cp["flat_gets"] == n - 1
+        assert cr.to_dict()["control_plane"] == cp
+        assert "control plane (hier)" in cr.render()
+        # Single-slice layout: the flat plan, priced at O(world).
+        cp1 = an_cost.cost_report(rep, num_slices=1).control_plane
+        assert cp1["strategy"] == "flat"
+        assert cp1["member_gets"] == n - 1 == cp1["leader_gets"]
+
     def test_quantized_exchange_split_and_dtype_totals(self, hvd):
         """int8 wire: bytes = the exchange's exact accounting (1-byte
         legs + scales + padding); first leg priced as all-to-all

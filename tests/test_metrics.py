@@ -374,6 +374,100 @@ class TestDeferHotPollRegression:
         assert fake.gets == 1
 
 
+class _HierFakeKV:
+    """Coordination-service stub for the hierarchical boundary stream:
+    a pre-seeded store (the coordinator's root publish), counting root vs
+    slice-key reads. The dead leader simply never mirrors the slice
+    key."""
+
+    def __init__(self):
+        self.store = {}
+        self.root_gets = 0
+        self.slice_gets = 0
+
+    def blocking_key_value_get(self, key, timeout_ms):
+        if "/s" in key:
+            self.slice_gets += 1
+        else:
+            self.root_gets += 1
+        if key in self.store:
+            return self.store[key]
+        raise TimeoutError(f"no key {key}")
+
+    def key_value_set(self, key, value, allow_overwrite=False):
+        self.store[key] = value
+
+    def key_value_delete(self, key):
+        self.store.pop(key, None)
+
+
+class TestBoundaryLeaseTakeover:
+    """ISSUE 14 satellite: a slice member whose boundary leader dies
+    mid-round must recover via lease takeover — once the leader lease
+    expires AND the root demonstrably holds the boundary, the member
+    promotes itself, applies the payload, and serves the slice's
+    re-publish from then on."""
+
+    def _member(self, lease_s=0.05):
+        rt = TestDeferHotPollRegression._follower(
+            TestDeferHotPollRegression())
+        rt._cp_role = "member"
+        rt._cp_slice = 1
+        rt._cp_members = 2
+        rt._cp_lease_s = lease_s
+        rt._lease_wait0 = None
+        rt._next_tid = 10          # local stream already covers tid 5
+        rt._pending = [(6, None, 0, 1.0, 1.0, None)]
+        return rt
+
+    def test_member_takes_over_dead_leader_after_lease(self):
+        rt = self._member()
+        kv = _HierFakeKV()
+        kv.store[rt._boundary_key(0)] = json.dumps(
+            {"t": 5, "s": "flat", "w": ""})
+        rt._kv_client = lambda: kv
+
+        def takeovers():
+            return instruments.FUSION_BOUNDARY_OUTCOMES.labels(
+                "takeover").get()
+
+        t0 = takeovers()
+        # Round 1: slice key missing — the lease arms, no root contact.
+        assert rt._apply_ready_boundaries(block_ms=1) is False
+        assert kv.root_gets == 0 and rt._cp_role == "member"
+        time.sleep(0.06)
+        # Round 2 (lease expired): root probe finds the boundary the
+        # leader never mirrored — promote, apply, re-publish.
+        assert rt._apply_ready_boundaries(block_ms=1) is True
+        assert rt._cp_role == "leader"
+        assert rt._boundary_seq == 1
+        assert rt._flushed_tid == 5
+        assert kv.root_gets >= 1
+        assert rt._slice_boundary_key(0) in kv.store, \
+            "takeover did not re-publish for the remaining members"
+        assert takeovers() - t0 == 1
+
+    def test_lease_renews_when_root_has_no_boundary(self):
+        """No boundary anywhere = the leader is NOT stale (there is
+        nothing to mirror): the member must keep its role and keep
+        waiting instead of promoting on silence."""
+        rt = self._member()
+        kv = _HierFakeKV()          # empty store: nothing published
+        rt._kv_client = lambda: kv
+        assert rt._apply_ready_boundaries(block_ms=1) is False
+        time.sleep(0.06)
+        assert rt._apply_ready_boundaries(block_ms=1) is False
+        assert rt._cp_role == "member"
+        assert rt._lease_wait0 is not None     # renewed, still armed
+        time.sleep(0.06)
+        # The coordinator finally publishes: the next expiry probe finds
+        # it and the takeover proceeds as usual.
+        kv.store[rt._boundary_key(0)] = json.dumps(
+            {"t": 5, "s": "flat", "w": ""})
+        assert rt._apply_ready_boundaries(block_ms=1) is True
+        assert rt._cp_role == "leader"
+
+
 class TestRecordHelpersDisabled:
     def test_disabled_helpers_are_noops(self):
         from horovod_tpu.metrics import instruments as ins

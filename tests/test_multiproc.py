@@ -722,6 +722,177 @@ class TestControlPlaneScaling:
             assert r2[op][0] == r8[op][0], (op, r2[op], r8[op])
 
 
+def _hier_kv_probe(reps):
+    """Per-tier control-plane traffic from this process's view under the
+    cluster's forced slice layout, plus flat-vs-hier payload parity:
+    returns ``(proc, groups, stats, parity_ok, hier_out)``."""
+    import os
+
+    import jax
+    import numpy as np
+
+    import horovod_tpu as hvd
+    from horovod_tpu.common import control_plane, negotiation
+
+    me = jax.process_index()
+    procs = list(range(jax.process_count()))
+    groups = control_plane.exchange_groups(procs)
+    lr = hvd.topology().local_device_ranks
+    ragged = [np.full((r + 1, 2), float(r), np.float32) for r in lr]
+    x = np.ones((len(lr), 3), np.float32)
+    # Warm: compile + first boundary publish/consume.
+    hvd.allgather_ragged(ragged)
+    hvd.allreduce_async(x, op=hvd.Sum).synchronize()
+    negotiation.stats_reset()
+    for _ in range(reps):
+        hvd.allgather_ragged(ragged)          # 1 negotiation round each
+        hvd.allreduce_async(x, op=hvd.Sum).synchronize()  # boundary sync
+    stats = negotiation.stats_snapshot()
+    # Bit-identical payload orderings: the SAME payload exchanged under
+    # hier then flat (every process flips the knob at the same point —
+    # SPMD) must produce the identical ordered list.
+    payload = {"p": me, "sizes": [me + 1, 2 * me, 7]}
+    os.environ["HOROVOD_CONTROL_PLANE"] = "hier"
+    hier_out = negotiation.exchange("cp_parity", payload)
+    os.environ["HOROVOD_CONTROL_PLANE"] = "flat"
+    flat_out = negotiation.exchange("cp_parity", payload)
+    os.environ.pop("HOROVOD_CONTROL_PLANE", None)
+    return (me, groups, stats, flat_out == hier_out, hier_out)
+
+
+class TestHierControlPlane:
+    """The hierarchical control plane (ISSUE 14 tentpole): when a slice
+    hierarchy exists, negotiation decomposes into slice-local + leaders-
+    only rounds — member gets are O(1) per round, leader gets are
+    O(slice_size + num_slices), never O(world) — and the fusion boundary
+    stream reaches members through their slice leader's re-publish (a
+    member's blocking reads of the ROOT boundary key are ZERO)."""
+
+    W4 = "localhost:1,127.0.0.1:1,127.0.0.2:1,127.0.0.3:1"
+    W8 = ",".join(f"127.0.0.{i}:1" for i in range(1, 9))
+
+    def _roles(self, groups, coordinator=0):
+        """(negotiation leaders, fusion leaders, fusion members)."""
+        neg_leaders = {g[0] for g in groups}
+        fus_leaders, fus_members = set(), set()
+        for g in groups:
+            followers = [p for p in g if p != coordinator]
+            if followers:
+                fus_leaders.add(followers[0])
+                fus_members.update(followers[1:])
+        return neg_leaders, fus_leaders, fus_members
+
+    def _check_hier(self, per_rank, world, slices, reps):
+        per = world // slices
+        groups0 = per_rank[0][1]
+        assert groups0 is not None and len(groups0) == slices, groups0
+        neg_leaders, fus_leaders, fus_members = self._roles(groups0)
+        for me, groups, stats, parity_ok, hier_out in per_rank:
+            assert groups == groups0, (me, groups)
+            assert parity_ok, (me, "flat and hier payloads diverged")
+            assert hier_out == per_rank[0][4], (me, "hier_out diverged")
+            assert stats["hier_rounds"] == reps, (me, stats)
+            if me in neg_leaders:
+                # Slice-local gather + ONE leaders-only DCN round.
+                assert stats["gets_local"] == (per - 1) * reps, (me, stats)
+                assert stats["gets_cross"] == (slices - 1) * reps, \
+                    (me, stats)
+                assert stats["gets_fanback"] == 0, (me, stats)
+                # The headline bound: never O(world).
+                assert stats["gets"] == ((per - 1) + (slices - 1)) * reps
+                assert stats["gets"] < (world - 1) * reps
+            else:
+                # Members: O(1) blocking gets per round.
+                assert stats["gets_fanback"] == reps, (me, stats)
+                assert stats["gets"] == reps, (me, stats)
+            if me in fus_members:
+                # Boundary stream through the slice leader's re-publish:
+                # member load on the coordinator's root key is ZERO.
+                assert stats["fusion_root_gets"] == 0, (me, stats)
+                assert stats["fusion_slice_gets"] > 0, (me, stats)
+            elif me in fus_leaders:
+                assert stats["fusion_root_gets"] > 0, (me, stats)
+                assert stats["fusion_slice_gets"] == 0, (me, stats)
+        return per_rank[0][2]
+
+    @pytest.mark.timeout(600)
+    def test_world4_slices2_member_gets_o1(self, shared_cluster):
+        per_rank = shared_cluster(
+            self.W4, extra_env={"HOROVOD_MESH_SLICES": "2"}).run(
+            _hier_kv_probe, args=(3,))
+        self._check_hier(per_rank, 4, 2, 3)
+
+    @pytest.mark.slow
+    @pytest.mark.timeout(600)
+    def test_world8_leader_gets_scale_with_slices_not_world(
+            self, shared_cluster):
+        """ISSUE 14 guard leg: world 8 under slices 2 vs 4 — member gets
+        stay constant (O(1)); leader cross gets move with the slice
+        count (1 vs 3 per round), never the world size (7)."""
+        r2 = self._check_hier(shared_cluster(
+            self.W8, extra_env={"HOROVOD_MESH_SLICES": "2"}).run(
+            _hier_kv_probe, args=(3,)), 8, 2, 3)
+        r4 = self._check_hier(shared_cluster(
+            self.W8, extra_env={"HOROVOD_MESH_SLICES": "4"}).run(
+            _hier_kv_probe, args=(3,)), 8, 4, 3)
+        # Proc 0 leads its slice in both layouts: its cross fan-out
+        # follows num_slices - 1 exactly (1 vs 3 per round), its local
+        # fan-out the slice size (3 vs 1) — neither follows world - 1.
+        assert r2["gets_cross"] == 1 * 3 and r4["gets_cross"] == 3 * 3, \
+            (r2, r4)
+        assert r2["gets_local"] == 3 * 3 and r4["gets_local"] == 1 * 3, \
+            (r2, r4)
+
+
+class TestControlPlaneDryrun:
+    """n=128-512 virtual-world dryrun (docs/scale_validation.md): the
+    REAL exchange implementations driven by one thread per virtual rank
+    over an in-memory KV. The perf guard: KV RPCs per negotiation round
+    scale with slice count, not world size, and member-rank gets are
+    constant across worlds at fixed slice size."""
+
+    @pytest.mark.timeout(120)
+    def test_n128_member_o1_leader_scales_with_slices(self):
+        from horovod_tpu.common import control_plane as cp
+        r = cp.simulate_exchange(128, 8, rounds=2)
+        assert r["identical"], "ranks disagreed on the payload ordering"
+        assert r["member_gets_per_round"] == 1
+        assert r["leader_gets_per_round"] == (128 // 8 - 1) + (8 - 1)
+        plan = cp.exchange_plan(128, 8)
+        assert plan["member_gets"] == 1
+        assert plan["leader_gets"] == r["leader_gets_per_round"]
+        # The flat schedule at the same world: the cliff being removed.
+        assert plan["leader_gets"] < 127
+
+    @pytest.mark.timeout(300)
+    def test_n512_green_member_gets_constant_at_fixed_slice_size(self):
+        from horovod_tpu.common import control_plane as cp
+        # slice_size 32 at both worlds: member gets constant, leader
+        # LOCAL gets constant, only the cross fan-out moves (4 -> 16
+        # slices), and it moves with the slice count.
+        r128 = cp.simulate_exchange(128, 4, rounds=1)
+        r512 = cp.simulate_exchange(512, 16, rounds=1)
+        assert r128["identical"] and r512["identical"]
+        assert r128["slice_size"] == r512["slice_size"] == 32
+        assert r128["member_gets_per_round"] == \
+            r512["member_gets_per_round"] == 1
+        assert r512["leader_gets_per_round"] - \
+            r128["leader_gets_per_round"] == (16 - 1) - (4 - 1)
+        # Total round RPCs grew sub-linearly: 4x world, < 4x gets would
+        # hold even flat — assert the per-rank MAX is what collapsed.
+        assert max(c["gets"] for c in r512["per_proc"]) == 31 + 15
+
+    @pytest.mark.timeout(120)
+    def test_flat_vs_hier_bit_identical_payloads(self):
+        from horovod_tpu.common import control_plane as cp
+        f = cp.simulate_exchange(128, 0, rounds=1, strategy="flat")
+        h = cp.simulate_exchange(128, 8, rounds=1)
+        assert f["result"] == h["result"]
+        # And the flat baseline really is the O(world) schedule the
+        # hierarchy removes.
+        assert f["member_gets_per_round"] == 127
+
+
 def _frontend_battery():
     """Frontend eager ops across a real process boundary: the stacked-rows
     and splits-matrix contracts (local rows only) for torch/tf/mxnet."""

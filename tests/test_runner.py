@@ -114,6 +114,89 @@ class TestKVStore:
         finally:
             srv.stop()
 
+    def test_sharded_scope_routing(self):
+        """ISSUE 14 sharded KV plane: slice-scoped scopes land on their
+        per-slice shard LISTENER (not just a sibling scope in the root
+        store), the in-process accessors and the HTTP client resolve the
+        same cell, job-global scopes stay on the root, and prune_scope
+        sweeps the whole scope family across shards."""
+        from horovod_tpu.common.control_plane import slice_scope
+        from horovod_tpu.runner.http_kv import (KVStoreClient,
+                                                KVStoreServer)
+        srv = KVStoreServer(shards=2)
+        port = srv.start()
+        try:
+            assert len(srv.shard_ports) == 2
+            assert all(p not in (0, port) for p in srv.shard_ports)
+            cli = KVStoreClient("localhost", port,
+                                shard_ports=srv.shard_ports)
+            s0, s1 = slice_scope("telemetry", 0), slice_scope(
+                "telemetry", 1)
+            cli.put(s0, "g0/rank/0", b"beacon0")
+            cli.put(s1, "g0/rank/4", b"beacon4")
+            cli.put("telemetry", "job", b"view")
+            # Each cell is readable back through the router...
+            assert cli.get(s0, "g0/rank/0") == b"beacon0"
+            assert cli.get(s1, "g0/rank/4") == b"beacon4"
+            assert cli.get("telemetry", "job") == b"view"
+            # ...lives PHYSICALLY on its shard's listener (a direct
+            # unrouted client per port sees exactly its own shard's key)
+            raw0 = KVStoreClient("localhost", srv.shard_ports[0])
+            raw1 = KVStoreClient("localhost", srv.shard_ports[1])
+            assert raw0.get(s0, "g0/rank/0") == b"beacon0"
+            assert raw0.get(s1, "g0/rank/4") is None
+            assert raw1.get(s1, "g0/rank/4") == b"beacon4"
+            root = KVStoreClient("localhost", port)
+            assert root.get(s0, "g0/rank/0") is None
+            assert root.get("telemetry", "job") == b"view"
+            # ...and the driver-side in-process accessor routes the same.
+            assert srv.get(s1, "g0/rank/4") == b"beacon4"
+            # Generation pruning sweeps root + every shard in one call.
+            srv.prune_scope("telemetry", ("g1/", "job"))
+            assert cli.get(s0, "g0/rank/0") is None
+            assert cli.get(s1, "g0/rank/4") is None
+            assert cli.get("telemetry", "job") == b"view"
+        finally:
+            srv.stop()
+
+    def test_wait_for_backoff_counts_polls(self):
+        """ISSUE 14 satellite: wait_for backs off exponentially (capped,
+        jittered) instead of the fixed 0.1 s hammer, and every poll is a
+        visible counter (control_plane_rpcs_total{http,wait_poll})."""
+        import time as _time
+
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.runner.http_kv import (KVStoreClient,
+                                                KVStoreServer)
+        srv = KVStoreServer()
+        port = srv.start()
+        try:
+            cli = KVStoreClient("localhost", port)
+
+            def polls():
+                return ins.CONTROL_PLANE_RPCS.labels(
+                    "http", "wait_poll").get()
+
+            p0 = polls()
+            with pytest.raises(TimeoutError):
+                cli.wait_for("s", "never", timeout=0.9, interval=0.05)
+            spent = polls() - p0
+            # Backoff: 0.05 -> 0.1 -> 0.2 -> 0.4 ... with 0.5-1.5x
+            # jitter — far fewer polls than the old fixed-interval
+            # 0.9/0.05 = 18, but at least the first few fired.
+            assert 2 <= spent <= 12, spent
+            # A late publish is still caught within the window.
+            p1 = polls()
+            import threading as _th
+            _th.Timer(0.25, lambda: srv.put("s", "late", b"v")).start()
+            t0 = _time.perf_counter()
+            assert cli.wait_for("s", "late", timeout=5,
+                                interval=0.05) == b"v"
+            assert _time.perf_counter() - t0 < 4.0
+            assert polls() - p1 >= 2
+        finally:
+            srv.stop()
+
     def test_hmac_signed_roundtrip(self):
         from horovod_tpu.runner.http_kv import KVStoreClient, KVStoreServer
         from horovod_tpu.runner.secret import make_secret_key
