@@ -1209,6 +1209,104 @@ def _bench_control_sweep(hvd):
     return 0
 
 
+def _bench_autopilot_sweep(hvd):
+    """Autopilot convergence sweep (`HVD_BENCH_MODEL=autopilot_sweep`):
+    start the runtime deliberately detuned (tiny fusion threshold, flat
+    dispatch, full-precision cross wire), then let the
+    horovod_tpu/autopilot controller drive its decision epochs over a
+    fixed async-allreduce workload. Every epoch's decisions land as
+    labeled `autopilot_sweep` records on the HVD_BENCH_PROGRESS_FILE
+    channel (epoch, lever, outcome, knobs, score, per-tier DCN bytes) —
+    the ROADMAP item-5 sentinel pattern — and the final BENCH record
+    carries the converged-vs-detuned score ratio."""
+    from horovod_tpu.common import basics
+    from horovod_tpu.ops import fusion, wire
+    from horovod_tpu.autopilot.controller import AutopilotController
+
+    # A virtual slice hierarchy when the backend has none (the forced
+    # layout resolves live — PR-12 seam), so the strategy/cross-wire
+    # levers have something to steer on single-slice boxes too.
+    forced_env = False
+    if "HOROVOD_MESH_SLICES" not in os.environ:
+        from horovod_tpu.common.topology import forced_slices
+        topo = basics.topology()
+        if not forced_slices() and topo.num_slices <= 1 \
+                and hvd.size() % 2 == 0 and hvd.size() > 1:
+            os.environ["HOROVOD_MESH_SLICES"] = "2"  # hvdlint: disable=HVL003 -- bench-local virtual hierarchy for its own process; never exported to workers
+            forced_env = True
+
+    cfg = basics.config()
+    prev_cfg = (cfg.autotune_warmup_samples,
+                cfg.autotune_bayes_opt_max_samples)
+    cfg.autotune_warmup_samples = 0
+    cfg.autotune_bayes_opt_max_samples = int(
+        os.environ.get("HVD_BENCH_ITERS", "6"))
+    rt = fusion.get_runtime()
+    prev = (rt.threshold, rt._cycle_s, rt.strategy, rt.cross_wire,
+            rt.wire_dtype, rt._overlap_mode, rt._overlap_pinned)
+    rt.threshold = 64 * 1024
+    rt.strategy = "flat"
+    ctrl = AutopilotController(cfg)
+
+    n = hvd.size()
+    rng = np.random.default_rng(0)
+    xs = [jnp.asarray(rng.standard_normal((n, 64 * 1024)), jnp.float32)
+          for _ in range(6)]
+    step = [0]
+
+    def run_epoch():
+        for _ in range(2):
+            hvd.grouped_allreduce_async(
+                xs, op=hvd.Average, name="autopilot_sweep").synchronize()
+            step[0] += 1
+            hvd.step_marker(step[0])
+
+    first_score = None
+    last_score = None
+    max_epochs = 48
+    try:
+        for _ in range(max_epochs):
+            run_epoch()
+            for rec in ctrl.tick():
+                row = {k: rec.get(k) for k in
+                       ("epoch", "lever", "outcome", "threshold",
+                        "cycle_ms", "categoricals", "score")}
+                row["signal"] = rec.get("signal")
+                _progress_record("autopilot_sweep", **row)
+                if rec.get("score") is not None:
+                    if first_score is None:
+                        first_score = rec["score"]
+                    last_score = rec["score"]
+            if ctrl.frozen and ctrl._cross_trial is None:
+                break
+        _progress_record(
+            "autopilot_sweep_summary", frozen=ctrl.frozen,
+            epochs=ctrl.epoch, threshold=rt.threshold,
+            strategy=rt.strategy, cross_wire=rt.cross_wire,
+            decisions=len(ctrl.decisions()))
+        _mark(f"autopilot_sweep: frozen={ctrl.frozen} after "
+              f"{ctrl.epoch} epochs -> threshold={rt.threshold} "
+              f"strategy={rt.strategy} cross={rt.cross_wire or 'exact'}")
+    finally:
+        ctrl.stop()
+        (rt.threshold, rt._cycle_s, rt.strategy, rt.cross_wire,
+         rt.wire_dtype, rt._overlap_mode, rt._overlap_pinned) = prev
+        (cfg.autotune_warmup_samples,
+         cfg.autotune_bayes_opt_max_samples) = prev_cfg
+        if forced_env:
+            os.environ.pop("HOROVOD_MESH_SLICES", None)
+        wire.clear_strategy_registry()
+        wire.clear_wire_registry()
+        wire.reset_error_feedback()
+        from horovod_tpu.metrics import instruments as _ins
+        _ins.reset_tier_split()
+    ratio = (last_score / first_score) if first_score else 0.0
+    _emit("autopilot_sweep_score_ratio", round(ratio, 4),
+          "converged/detuned autopilot score ratio (reduced bytes/sec, "
+          "DCN-priced; >1 = the controller improved the config)", 0.0)
+    return 0
+
+
 # Non-image benchmarks: selector -> (bench fn, metric name, unit). One
 # registry so dispatch and failure records can never disagree.
 _EXTRA_MODELS = {
@@ -1235,6 +1333,9 @@ _EXTRA_MODELS = {
     "control_sweep": (_bench_control_sweep,
                       "control_sweep_worst_rank_gets_ratio",
                       "hier/flat worst-rank negotiation gets ratio"),
+    "autopilot_sweep": (_bench_autopilot_sweep,
+                        "autopilot_sweep_score_ratio",
+                        "converged/detuned autopilot score ratio"),
 }
 
 

@@ -1,1 +1,3 @@
-from horovod_tpu.autotune.parameter_manager import ParameterManager  # noqa: F401
+from horovod_tpu.autotune.parameter_manager import (  # noqa: F401
+    ParameterManager, sweep_categoricals,
+)

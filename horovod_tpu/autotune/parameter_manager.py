@@ -31,6 +31,34 @@ from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.autotune.bayesian_optimization import BayesianOptimization
 
 
+def sweep_categoricals(current_strategy, config_wire_dtype, has_slices):
+    """THE categorical knob set of the strategy/wire sweep — one
+    definition for the flush-window tuner (FusionRuntime) and the
+    autopilot controller, so the two can never sweep different spaces.
+    ``current_strategy`` goes first (the tie-break winner);
+    ``torus_qcross`` joins only when a slice hierarchy exists (on a
+    1-slice layout it is pure overhead — hvdlint HVP113). The wire
+    categorical exists only when the user already opted into a 16-bit or
+    quantized wire, and sweeps UP in precision only (precision policy is
+    never a speed knob)."""
+    import jax.numpy as jnp
+
+    from horovod_tpu.ops import wire as _wire
+
+    choices = ("flat", "hierarchical", "torus") + (
+        ("torus_qcross",) if has_slices else ())
+    cats = {"strategy": [current_strategy] + [
+        s for s in choices if s != current_strategy]}
+    resolved = _wire.resolve_wire_dtype(config_wire_dtype)
+    if _wire.is_quantized(resolved):
+        first = jnp.dtype(_wire.wire_numpy_type(resolved)).name
+        cats["wire_dtype"] = [first, "bfloat16", "float16"]
+    elif resolved:
+        cats["wire_dtype"] = [
+            resolved, "bfloat16" if resolved == "float16" else "float16"]
+    return cats
+
+
 class ParameterManager:
     """reference: parameter_manager.h:42-252 ParameterManager."""
 
@@ -46,10 +74,20 @@ class ParameterManager:
     def __init__(self, warmup_samples=3, steps_per_sample=10,
                  bayes_opt_max_samples=20, gaussian_process_noise=0.8,
                  log_file=None, initial_threshold=64 * 1024 * 1024,
-                 initial_cycle_ms=1.0, categorical_knobs=None):
+                 initial_cycle_ms=1.0, categorical_knobs=None,
+                 max_move_log2=None):
         self._warmup_remaining = warmup_samples
         self._steps_per_sample = steps_per_sample
         self._max_samples = bayes_opt_max_samples
+        # Bounded move per sample (the autopilot's per-epoch guardrail):
+        # the BO proposal is clamped to within +-max_move_log2 of the
+        # knobs ACTUALLY in effect, and _current always records the
+        # applied point — the GP is fed what really ran, never an
+        # unapplied proposal. None = unbounded (the offline default).
+        # `is not None`, not truthiness: an explicit 0 means FROZEN
+        # numerics (clamp every move to zero), not unbounded.
+        self._max_move = None if max_move_log2 is None \
+            else float(max_move_log2)
         self._bo = BayesianOptimization(
             bounds=[list(self._LOG2_THR), list(self._LOG2_CYC)],
             alpha=gaussian_process_noise)
@@ -126,17 +164,45 @@ class ParameterManager:
         self._window_steps += 1
         if self._window_steps < self._steps_per_sample:
             return None
-        return self._end_sample()
-
-    def _knobs(self):
-        return self.fusion_threshold, self.cycle_time_ms, self.categoricals
-
-    def _end_sample(self):
         elapsed = max(time.perf_counter() - self._window_start, 1e-9)
         score = self._window_bytes / elapsed
         self._window_bytes = 0
         self._window_steps = 0
         self._window_start = time.perf_counter()
+        return self._end_sample(score)
+
+    def suggest(self):
+        """The knobs currently proposed/in effect, WITHOUT advancing the
+        tuner: ``(fusion_threshold, cycle_time_ms, categoricals)``. The
+        autopilot applies these for one decision epoch and feeds the
+        measured result back through :meth:`observe`."""
+        return self._knobs()
+
+    def observe(self, score):
+        """Online increment decoupled from the tensor-byte ``update``/
+        ``record`` path: feed one externally-computed sample score (the
+        autopilot's signal-plane bytes/sec for a whole decision epoch)
+        and advance the same warmup → categorical sweep → BO → freeze
+        machinery. Non-finite scores (a partially-observed first epoch:
+        zero elapsed time, missing counters → NaN/inf) are clamped to
+        0.0 so they can never poison the GP or win the sweep. Returns
+        the next knobs like :meth:`record`, or None once frozen."""
+        if not self._tuning:
+            return None
+        try:
+            score = float(score)
+        except (TypeError, ValueError):
+            score = 0.0
+        if not np.isfinite(score):
+            score = 0.0
+        return self._end_sample(score)
+
+    def _knobs(self):
+        return self.fusion_threshold, self.cycle_time_ms, self.categoricals
+
+    def _end_sample(self, score):
+        if not np.isfinite(score):
+            score = 0.0
         invalid, self._window_invalid = self._window_invalid, False
 
         if self._warmup_remaining > 0:
@@ -212,5 +278,11 @@ class ParameterManager:
                 "categoricals=%s (%.1f MB/s)", self.fusion_threshold,
                 self.cycle_time_ms, self.categoricals, self._best[1] / 1e6)
         else:
-            self._current = np.asarray(self._bo.next_sample(), float)
+            prop = np.asarray(self._bo.next_sample(), float)
+            if self._max_move is not None:
+                prop = np.clip(prop, self._current - self._max_move,
+                               self._current + self._max_move)
+                prop[0] = np.clip(prop[0], *self._LOG2_THR)
+                prop[1] = np.clip(prop[1], *self._LOG2_CYC)
+            self._current = prop
         return self._knobs()

@@ -91,11 +91,16 @@ def install(plan_obj):
 
 
 def uninstall():
-    global armed, _plan, _installed_env
+    global armed, _plan, _installed_env, _role
     with _lock:
         armed = False
         _plan = None
         _installed_env = None
+        # Role reverts to the default with the plan: an in-process elastic
+        # driver run (tests, run_soak) set "driver", and leaving it would
+        # mislabel every later same-process workload's ledger entries
+        # (the test_runner → test_chaos full-suite ordering leak).
+        _role = "worker"
         _close_ledger()
 
 

@@ -375,6 +375,110 @@ def run_leader_kill_soak(procs=8, slices=2, steps=8, seed=321,
             "results": results, "workdir": workdir}
 
 
+def autopilot_straggler_plan(procs, seed, delay_ms=120):
+    """A PERMANENT straggler: every collective dispatch on the LAST rank
+    is delayed. The last rank so that after the autopilot removes its
+    host and the survivors renumber 0..procs-2, no process inherits the
+    victim's rank and the fault dies with the host."""
+    victim = procs - 1
+    return victim, {
+        "seed": seed,
+        "note": f"autopilot soak: permanent {delay_ms}ms straggler "
+                f"r{victim}",
+        "faults": [
+            {"site": "collective.dispatch", "kind": "delay",
+             "delay_ms": delay_ms, "rank": victim, "every": 1},
+        ],
+    }
+
+
+def run_autopilot_soak(procs=8, steps=56, seed=777, workdir=None,
+                       delay_ms=120):
+    """ROADMAP item 4's acceptance soak: an elastic run with a seeded
+    permanent straggler is recovered by the AUTOPILOT — the step-profiler
+    watchdog names the delayed rank online, the controller's remediation
+    policy passes hysteresis/rate/floor and publishes the removal, the
+    driver arm blacklists the host through the cooldown path, and the
+    job re-rendezvouses and reaches the target step — with zero human or
+    harness intervention (this harness only starts the run). Asserted:
+
+    1. every survivor reaches the target step at world ``procs - 1``;
+    2. the removal really was controller-initiated: the flight analyzer
+       (`report["autopilot"]`) names the removed rank and the causing
+       decision (cause ``straggler``), correlated with the driver's
+       disruption marker;
+    3. the straggler is actually GONE: the post-shrink worlds in every
+       survivor's step log are ``procs - 1``.
+    """
+    import tempfile
+    workdir = workdir or tempfile.mkdtemp(prefix="hvd_autopilot_soak_")
+    os.makedirs(workdir, exist_ok=True)
+    victim, plan_dict = autopilot_straggler_plan(procs, seed,
+                                                 delay_ms=delay_ms)
+    plan_path = os.path.join(workdir, "plan.yaml")
+    with open(plan_path, "w") as f:
+        json.dump(plan_dict, f)
+    flight_dir = os.path.join(workdir, "flight")
+    ledger_dir = os.path.join(workdir, "ledger")
+    victim_host = "localhost" if victim == 0 else f"127.0.0.{victim + 1}"
+    _progress("autopilot soak start", procs=procs, steps=steps,
+              victim=victim)
+    try:
+        results = _elastic_run(steps, procs, procs - 1, workdir, {
+            "HOROVOD_CHAOS_PLAN": plan_path,
+            "HOROVOD_CHAOS_SEED": str(seed),
+            "HOROVOD_CHAOS_LEDGER": ledger_dir,
+            "HOROVOD_FLIGHT_DIR": flight_dir,
+            # The autopilot, tuned for a short soak: 1 s decision epochs,
+            # 2-epoch hysteresis, one removal, floor at the survivor
+            # count (the job must never shrink past one straggler).
+            "HOROVOD_AUTOPILOT": "1",
+            "HOROVOD_AUTOPILOT_INTERVAL": "1.0",
+            "HOROVOD_AUTOPILOT_HYSTERESIS": "2",
+            "HOROVOD_AUTOPILOT_MAX_REMOVALS": "1",
+            "HOROVOD_AUTOPILOT_MIN_WORLD": str(procs - 1),
+            # Fast naming + fresh host mapping: watchdog publish round
+            # every 2 steps, telemetry beacons at 0.5 s.
+            "HOROVOD_PROFILE_PUBLISH_STEPS": "2",
+            "HOROVOD_TELEMETRY_INTERVAL": "0.5",
+        })
+    finally:
+        from horovod_tpu import chaos
+        chaos.uninstall()
+    survivors = procs - 1
+    # (1) recovered with no human/harness help.
+    assert all(r["steps"] == steps for r in results), \
+        f"autopilot soak fell short of {steps} steps: {results}"
+    assert all(r["final_world"] == survivors for r in results), results
+    # (3) the straggler's host really left: the tail of every step log
+    # ran at the shrunk world.
+    assert all(r["worlds"][-1] == survivors for r in results), \
+        [r["worlds"][-5:] for r in results]
+    # (2) the forensics name the removal and its cause.
+    from horovod_tpu.flight import analyze as flight_analyze
+    events, metas, marks = flight_analyze.load_dir(flight_dir,
+                                                   ledger_dir=ledger_dir)
+    assert events, f"soak left no flight dumps under {flight_dir}"
+    report = flight_analyze.analyze(events, metas, marks)
+    ap = report["autopilot"]
+    rem = [r for r in ap["remediations"] if r.get("cause") == "straggler"]
+    assert rem, f"no straggler remediation in the flight trail: {ap}"
+    assert any(r.get("rank") == victim for r in rem), (victim, rem)
+    assert any(r.get("host") == victim_host for r in rem), \
+        (victim_host, rem)
+    # ...and the driver executed it: a disruption marker removed the host.
+    assert report["driver_disruptions"], "driver left no disruption marker"
+    assert any(victim_host in (m.get("removed") or ())
+               for m in report["driver_disruptions"]), \
+        (victim_host, report["driver_disruptions"])
+    _progress("autopilot soak done", ok=True,
+              remediation=rem[0])
+    return {"procs": procs, "steps": steps, "victim": victim,
+            "victim_host": victim_host, "remediations": rem,
+            "report_autopilot": ap, "results": results,
+            "workdir": workdir}
+
+
 def run_soak(procs=8, steps=8, seed=123, workdir=None, plan_dict=None,
              loss_tol=1e-5, reruns=1):
     """Run clean + chaos (+ ``reruns`` same-seed repeats), assert the

@@ -234,6 +234,18 @@ def init(comm=None, process_sets=None, devices=None):
         except Exception as e:  # noqa: BLE001 — telemetry must not block init
             hvd_logging.warning("telemetry plane failed to start: %s", e)
 
+        # Autopilot (HOROVOD_AUTOPILOT): the online controller closing the
+        # signal plane → knobs loop, coordinator rank only (followers
+        # adopt flips at flush boundaries). Armed AFTER telemetry so its
+        # first frame can already read the health plane. An elastic
+        # re-init restarts it under the new membership like the
+        # telemetry agent.
+        try:
+            from horovod_tpu.autopilot import controller as _autopilot
+            _autopilot.start_from_config(config)
+        except Exception as e:  # noqa: BLE001 — must not block init
+            hvd_logging.warning("autopilot failed to start: %s", e)
+
         hvd_logging.info(
             "horovod_tpu initialized: size=%d local_size=%d cross_size=%d",
             topology.size, topology.local_size, topology.cross_size)
@@ -523,6 +535,13 @@ def shutdown():
             from horovod_tpu.telemetry import aggregator as _telemetry
             _telemetry.stop()
         except Exception:  # noqa: BLE001 — telemetry must not block exit
+            pass
+        # Autopilot control thread: stopped with the runtime it steers
+        # (an elastic re-init re-arms it under the new membership).
+        try:
+            from horovod_tpu.autopilot import controller as _autopilot
+            _autopilot.stop()
+        except Exception:  # noqa: BLE001 — must not block exit
             pass
         # Step profiler: discard the OPEN window and bump the record
         # epoch — an elastic reset's recovery traffic must not be

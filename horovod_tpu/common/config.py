@@ -70,6 +70,26 @@ class Config:
     autotune_bayes_opt_max_samples: int = 20
     autotune_gaussian_process_noise: float = 0.8
 
+    # --- autopilot (horovod_tpu/autopilot; ROADMAP item 4 — the online
+    # self-driving controller: closed-loop tuning over the signal plane
+    # plus automated straggler/dead-rank remediation through the elastic
+    # driver). Opt-in: decisions change compiled programs and can remove
+    # hosts; see docs/performance.md for the levers/guardrails and the
+    # docs/troubleshooting.md "the controller removed my rank" runbook.
+    autopilot: bool = False
+    # Decision-epoch cadence in seconds (the controller thread's tick).
+    autopilot_interval: float = 10.0
+    # Remediation rate limiter: at most this many controller-initiated
+    # host removals per rolling window (autopilot/remediate.WINDOW_S).
+    autopilot_max_removals: int = 1
+    # Hysteresis: a rank must be named (watchdog straggler / telemetry
+    # dead/stalled) this many CONSECUTIVE decision epochs before the
+    # controller may act on it.
+    autopilot_hysteresis: int = 3
+    # Do-not-shrink floor: never remediate below this world size
+    # (0 = derive: the elastic launch's --min-np, else 1).
+    autopilot_min_world: int = 0
+
     # --- timeline (reference common.h:117-118) ---
     timeline_filename: str = ""
     timeline_mark_cycles: bool = False
@@ -400,6 +420,15 @@ class Config:
             "HOROVOD_AUTOTUNE_BAYES_OPT_MAX_SAMPLES", c.autotune_bayes_opt_max_samples)
         c.autotune_gaussian_process_noise = _env_float(
             "HOROVOD_AUTOTUNE_GAUSSIAN_PROCESS_NOISE", c.autotune_gaussian_process_noise)
+        c.autopilot = _env_bool("HOROVOD_AUTOPILOT", c.autopilot)
+        c.autopilot_interval = _env_float("HOROVOD_AUTOPILOT_INTERVAL",
+                                          c.autopilot_interval)
+        c.autopilot_max_removals = _env_int(
+            "HOROVOD_AUTOPILOT_MAX_REMOVALS", c.autopilot_max_removals)
+        c.autopilot_hysteresis = _env_int("HOROVOD_AUTOPILOT_HYSTERESIS",
+                                          c.autopilot_hysteresis)
+        c.autopilot_min_world = _env_int("HOROVOD_AUTOPILOT_MIN_WORLD",
+                                         c.autopilot_min_world)
         c.timeline_filename = os.environ.get("HOROVOD_TIMELINE", c.timeline_filename)
         c.timeline_mark_cycles = _env_bool("HOROVOD_TIMELINE_MARK_CYCLES",
                                            c.timeline_mark_cycles)

@@ -71,12 +71,15 @@ class HostUpdateListener:
         return True
 
 
-def _kv_client():
+def _kv_client(timeout=30):
+    """THE env-to-launcher-KV-client helper (HOROVOD_KV_ADDR/PORT; None
+    outside hvdrun launches) — the autopilot's remediation arm reuses it
+    with a bounded timeout."""
     addr = os.environ.get("HOROVOD_KV_ADDR")
     port = os.environ.get("HOROVOD_KV_PORT")
     if not (addr and port):
         return None
-    return KVStoreClient(addr, int(port))
+    return KVStoreClient(addr, int(port), timeout=timeout)
 
 
 def _configured_version(client):

@@ -365,6 +365,74 @@ def analyze_chaos(events):
     return out
 
 
+def analyze_autopilot(events, driver_marks=()):
+    """The autopilot's decision trail: tuning decisions summarized per
+    (lever, outcome), remediations listed with the rank/cause the
+    controller named — and, when the driver's disruption markers are
+    available, each remediation correlated with the membership change
+    that executed it (the "the controller removed my rank — why?"
+    runbook's evidence, docs/troubleshooting.md)."""
+    from horovod_tpu.autopilot.remediate import CAUSES
+    decisions = [e for e in events if e["kind"] == "autopilot_decision"]
+    remediations = [e for e in events
+                    if e["kind"] == "autopilot_remediate"]
+    by_lever = {}
+    for e in decisions:
+        key = f"{e.get('name')}:{e.get('what')}"
+        by_lever[key] = by_lever.get(key, 0) + 1
+
+    def _rank_of(e):
+        name = e.get("name") or ""
+        if name.startswith("rank"):
+            try:
+                return int(name[4:])
+            except ValueError:
+                pass
+        return None
+
+    # Two event flavors share the kind: coordinator REQUESTS carry the
+    # cause in `what`; driver-arm ACKS carry the outcome. One removal =
+    # one row — the ack attaches to the newest outcome-less request for
+    # the same rank instead of fabricating a second remediation. Sorted
+    # by wall time first: load_dir emits events grouped per dump FILE
+    # (a driver dump sorts before the worker dumps), which would
+    # otherwise iterate acks before their requests.
+    rows = []
+    for e in sorted(remediations, key=lambda e: e.get("t") or 0.0):
+        what = e.get("what")
+        rank = _rank_of(e)
+        if what in CAUSES:
+            row = {"rank": rank, "cause": what,
+                   "host": e.get("op"), "epoch": e.get("seq"),
+                   "observer": e.get("rank"), "t": e.get("t")}
+            # The membership change that executed this request: the
+            # first driver disruption marker at or after it.
+            t0 = e.get("t") or 0.0
+            mark = next((m for m in driver_marks
+                         if (m.get("t") or 0.0) >= t0), None)
+            if mark is not None:
+                row["disruption"] = {
+                    "version": mark.get("version"),
+                    "removed_hosts": mark.get("removed"),
+                    "gap_s": round((mark.get("t") or t0) - t0, 3)}
+            rows.append(row)
+            continue
+        target = next((r for r in reversed(rows)
+                       if r["rank"] == rank and "outcome" not in r), None)
+        if target is not None:
+            target["outcome"] = what
+        else:
+            # an ack whose request event was lost (ring wrap): still a
+            # row, explicitly outcome-only
+            rows.append({"rank": rank, "cause": None, "outcome": what,
+                         "host": e.get("op"), "t": e.get("t")})
+    return {"decisions": len(decisions), "by_lever": by_lever,
+            "frozen": any(e.get("name") == "tuner"
+                          and e.get("what") == "frozen"
+                          for e in decisions),
+            "remediations": rows}
+
+
 def analyze(events, metas=(), driver_marks=()):
     killed = sorted({e["rank"] for e in events
                      if e["kind"] == "chaos" and e.get("what") == "crash"})
@@ -382,6 +450,7 @@ def analyze(events, metas=(), driver_marks=()):
         "steps": analyze_steps(events),
         "chaos": analyze_chaos(events),
         "driver_disruptions": list(driver_marks),
+        "autopilot": analyze_autopilot(events, driver_marks),
     }
     return report
 

@@ -397,7 +397,12 @@ def summary():
 # Includes "chaos": a run that only saw injections still deserves an
 # atexit dump. analyze.py keeps a narrower set under the same name —
 # there, injections must not match themselves as downstream anomalies.
-_ANOMALY_KINDS = ("error", "stall", "kv_error", "chaos")
+# "autopilot_remediate" likewise: a controller-initiated removal is
+# post-mortem material, and the driver's ring may hold ONLY its ack
+# trail (no crash/stall) — without this the runbook's rejected_*/applied
+# evidence never reaches disk on a remediation-only run.
+_ANOMALY_KINDS = ("error", "stall", "kv_error", "chaos",
+                  "autopilot_remediate")
 _ANOMALY_ELASTIC = ("abort", "restore")
 
 

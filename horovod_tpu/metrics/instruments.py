@@ -55,6 +55,11 @@ Catalogue (names shown without the ``HOROVOD_METRICS_PREFIX``, default
 - ``serving_batch_fill_ratio``                      active slots / total
   slots per decode step (histogram; low values mean the fleet is
   over-provisioned or admission is starved)
+- ``autopilot_decisions_total{lever,outcome}``      autopilot control
+  decisions (lever=tuner|overlap|cross_wire|remediate; counter)
+- ``autopilot_remediations_total{cause,outcome}``   autopilot-initiated
+  removals (cause=dead|stalled|straggler; outcome=requested|applied|
+  rejected_*; counter)
 """
 
 import os
@@ -253,6 +258,21 @@ SERVING_FILL = REGISTRY.histogram(
     "continuous batch is full; persistently low fill under a deep queue "
     "means admission is starved — a scheduler bug).",
     buckets=_RATIO_BUCKETS)
+AUTOPILOT_DECISIONS = REGISTRY.counter(
+    "autopilot_decisions_total",
+    "Autopilot controller decisions per lever and outcome "
+    "(horovod_tpu/autopilot: lever=tuner|overlap|cross_wire|remediate; "
+    "outcome=adopt|hold|frozen|reverted|no_signal|baseline|"
+    "drift_detected|trial|adopted|requested|unreachable). Every decision "
+    "also lands in the flight ring as an autopilot_decision event.",
+    ("lever", "outcome"))
+AUTOPILOT_REMEDIATIONS = REGISTRY.counter(
+    "autopilot_remediations_total",
+    "Autopilot remediation requests and their driver-side outcomes "
+    "(cause=dead|stalled|straggler; outcome=requested|no_driver|"
+    "publish_failed on the coordinator, applied|rejected_floor|"
+    "rejected_rate|rejected_unknown_host on the driver arm).",
+    ("cause", "outcome"))
 TELEMETRY_RPCS = REGISTRY.counter(
     "telemetry_rpcs_total",
     "Telemetry-plane KV RPCs by phase (horovod_tpu/telemetry): the "
@@ -544,6 +564,22 @@ def record_serving_queue(depth):
     if not _enabled:
         return
     SERVING_QUEUE_DEPTH.set(depth)
+
+
+def record_autopilot_decision(lever, outcome):
+    """One autopilot controller decision (horovod_tpu/autopilot)."""
+    if not _enabled:
+        return
+    AUTOPILOT_DECISIONS.labels(lever, outcome).inc()
+
+
+def record_autopilot_remediation(cause, outcome):
+    """One autopilot remediation lifecycle event (request or driver-arm
+    outcome). The flight-ring mirror is recorded at the call sites —
+    they carry the rank/host detail this counter aggregates away."""
+    if not _enabled:
+        return
+    AUTOPILOT_REMEDIATIONS.labels(cause, outcome).inc()
 
 
 def record_telemetry_rpc(phase, n=1):

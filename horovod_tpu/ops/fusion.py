@@ -356,6 +356,12 @@ class FusionRuntime:
         # inside the flush bracket itself).
         self._overlap = bool(getattr(config, "cross_overlap", True))
         self._overlap_mode = "step"
+        # True while the autopilot pins the overlap mode at decision-
+        # epoch granularity: the per-flush steering below then defers —
+        # without this the controller's pin would be overwritten at the
+        # very next flush and a single outlier step could flap the
+        # await point mid-epoch.
+        self._overlap_pinned = False
         self._inflight_cross = []    # bucket outputs awaiting their wait
         self._multi = jax.process_count() > 1
         self._coord = jax.process_index() == 0
@@ -384,36 +390,20 @@ class FusionRuntime:
         # different winners — mismatched collectives. Followers adopt the
         # knobs published with each flush boundary instead.
         if config.autotune and (not self._multi or self._coord):
-            from horovod_tpu.autotune import ParameterManager
             # Categorical knobs (reference: CategoricalParameter sweep,
-            # parameter_manager.h:42-252): the 2-level allreduce strategy,
-            # and — only when the user already opted into a 16-bit wire —
-            # which 16-bit dtype (never tuned from full precision: that is
-            # a precision policy, not a speed knob).
-            # torus_qcross (the hierarchical dispatch tier) joins the
-            # sweep only when a slice hierarchy exists — on a 1-slice
-            # layout it is pure overhead (hvdlint HVP113) and would only
-            # waste sweep samples.
+            # parameter_manager.h:42-252): ONE definition shared with
+            # the autopilot controller (autotune.sweep_categoricals) —
+            # strategy choices, the torus_qcross-needs-slices rule, and
+            # the opted-into wire sweep (up in precision only). The
+            # winner is adopted per process set (the boundary stream
+            # carries it to followers AND to the eager wire registry).
+            from horovod_tpu.autotune import (ParameterManager,
+                                              sweep_categoricals)
             from horovod_tpu.common.topology import forced_slices
             topo0 = basics.topology()
             has_slices = forced_slices() or topo0.num_slices > 1
-            choices = ("flat", "hierarchical", "torus") + (
-                ("torus_qcross",) if has_slices else ())
-            cats = {"strategy": [self.strategy] + [
-                s for s in choices if s != self.strategy]}
-            resolved = _wire.resolve_wire_dtype(config.wire_dtype)
-            if _wire.is_quantized(resolved):
-                # The user opted into the LOSSY quantized exchange;
-                # sweeping UP in precision is allowed (never down — that
-                # is precision policy, not a speed knob). The winner is
-                # adopted per process set (the boundary stream carries it
-                # to followers AND to the eager wire registry).
-                first = jnp.dtype(_wire.wire_numpy_type(resolved)).name
-                cats["wire_dtype"] = [first, "bfloat16", "float16"]
-            elif resolved:
-                other = ("bfloat16" if resolved == "float16"
-                         else "float16")
-                cats["wire_dtype"] = [resolved, other]
+            cats = sweep_categoricals(self.strategy, config.wire_dtype,
+                                      has_slices)
             self._parameter_manager = ParameterManager(
                 warmup_samples=config.autotune_warmup_samples,
                 steps_per_sample=config.autotune_steps_per_sample,
@@ -863,6 +853,8 @@ class FusionRuntime:
         mode in effect ("off" when the knob disables overlap)."""
         if not self._overlap:
             return "off"
+        if self._overlap_pinned:
+            return self._overlap_mode
         if _profile.armed:
             from horovod_tpu.profile import ledger as _ledger
             rec = _ledger.step_report(1)

@@ -61,6 +61,19 @@ def median(xs):
     import statistics
     return statistics.median(xs)
 
+
+def robust_z(x, xs):
+    """THE robust z of the profile/autopilot planes: ``x`` against
+    median/MAD of ``xs``, denominator floored (5% of the median, 100us
+    absolute) so microsecond-noise windows cannot fabricate infinite z.
+    Returns ``(z, median)``. One definition — the watchdog's
+    regression/straggler naming and the autopilot's
+    revert-on-regression/drift checks must score identically."""
+    med = median(xs)
+    mad = median([abs(v - med) for v in xs])
+    denom = max(1.4826 * mad, 0.05 * abs(med), 1e-4)
+    return (x - med) / denom, med
+
 DEFAULT_HISTORY = 512
 
 # The one-word hot-path gate (the flight-recorder idiom).

@@ -31,6 +31,7 @@ import time
 
 from horovod_tpu.common.config import _env_float, _env_int
 from horovod_tpu.profile.ledger import median as _median
+from horovod_tpu.profile.ledger import robust_z as _robust_z
 
 _MAX_FINDINGS = 64
 
@@ -80,16 +81,6 @@ def findings(last=None):
     with _lock:
         out = list(_findings)
     return out if last is None else out[-last:]
-
-
-def _robust_z(x, xs):
-    """z of ``x`` against median/MAD of ``xs``; the denominator is floored
-    (5% of the median, 100us absolute) so microsecond-noise windows cannot
-    fabricate infinite z."""
-    med = _median(xs)
-    mad = _median([abs(v - med) for v in xs])
-    denom = max(1.4826 * mad, 0.05 * abs(med), 1e-4)
-    return (x - med) / denom, med
 
 
 def _emit(finding):

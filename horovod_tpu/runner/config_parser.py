@@ -72,6 +72,9 @@ _ARG_ENV_MAP = [
     ("profile_steps", "HOROVOD_PROFILE_STEPS", str),
     ("profile_dir", "HOROVOD_PROFILE_DIR", str),
     ("profile_publish_steps", "HOROVOD_PROFILE_PUBLISH_STEPS", str),
+    ("autopilot", "HOROVOD_AUTOPILOT", lambda v: "1" if v else None),
+    ("no_autopilot", "HOROVOD_AUTOPILOT", lambda v: "0" if v else None),
+    ("autopilot_interval", "HOROVOD_AUTOPILOT_INTERVAL", str),
     ("serving", "HOROVOD_SERVING", lambda v: "1" if v else None),
     ("serving_port", "HOROVOD_SERVING_PORT", str),
     ("serving_slots", "HOROVOD_SERVING_SLOTS", str),

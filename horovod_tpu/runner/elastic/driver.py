@@ -35,12 +35,22 @@ DISCOVER_INTERVAL_SECS = 1.0
 
 class ElasticDriver:
     def __init__(self, discovery, min_np, max_np=None, reset_limit=None,
-                 spawn_fn=None, shutdown_fn=None):
+                 spawn_fn=None, shutdown_fn=None, remediation_fn=None):
         """``spawn_fn(assignment, version)`` starts workers for the host set;
         ``shutdown_fn(reason)`` stops them. Injected for testability — the
         reference tests drive ``_update_host_assignments`` the same way
-        (reference: test_elastic_driver.py:46-509)."""
+        (reference: test_elastic_driver.py:46-509).
+
+        ``remediation_fn(hosts)`` is the autopilot's driver arm
+        (horovod_tpu/autopilot/remediate.DriverArm.poll): called on every
+        discovery poll with the freshly discovered host dict, it applies
+        any pending controller-requested blacklists through the
+        HostManager cooldown path and returns the hosts it removed this
+        poll — which are then excluded from this round's assignment
+        immediately (the cooldown keeps them out of later rounds until
+        re-admission)."""
         self._host_manager = HostManager(discovery)
+        self._remediation_fn = remediation_fn
         self._min_np = min_np
         self._max_np = max_np
         self._reset_limit = reset_limit
@@ -97,6 +107,11 @@ class ElasticDriver:
                     # preemption, recovered through the exact reassignment
                     # path a real removal takes.
                     hosts = _chaos.filter_hosts("driver.discovery", hosts)
+                if self._remediation_fn is not None:
+                    removed = self._remediation_fn(hosts) or ()
+                    if removed:
+                        hosts = {h: s for h, s in hosts.items()
+                                 if h not in removed}
                 self._maybe_update(hosts)
             except Exception as e:  # discovery script hiccup: keep going
                 if self._shutdown.is_set():
@@ -451,9 +466,27 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
             state["rc"] = max(state["rc"], 1)
         state["done"].set()
 
+    # Autopilot driver arm: controller-requested removals ride the
+    # discovery loop exactly like chaos host_remove — blacklist via the
+    # HostManager cooldown, then the normal reassignment re-rendezvouses
+    # the survivors. The arm exists whether or not workers run the
+    # controller (requests only appear when they do); floor/rate are
+    # re-validated here with the driver's authoritative world view.
+    from horovod_tpu.autopilot import remediate as _ap_remediate
+    _arm_box = []
+
+    def _remediation_poll(hosts):
+        return _arm_box[0].poll(hosts) if _arm_box else ()
+
     driver = ElasticDriver(discovery, args.min_np or 1, args.max_np,
                            args.reset_limit, spawn_fn=spawn,
-                           shutdown_fn=shutdown)
+                           shutdown_fn=shutdown,
+                           remediation_fn=_remediation_poll)
+    _arm_box.append(_ap_remediate.DriverArm(
+        kv, driver._host_manager,
+        min_world=max(_env_int("HOROVOD_AUTOPILOT_MIN_WORLD", 0),
+                      args.min_np or 1),
+        max_removals=_env_int("HOROVOD_AUTOPILOT_MAX_REMOVALS", 1)))
     driver.start()
     try:
         driver.wait_for_available_slots(args.min_np or 1,
@@ -469,3 +502,9 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
     finally:
         driver.stop()
         kv.stop()
+        # The driver may run IN-PROCESS (tests, run_elastic API): restore
+        # the chaos/flight roles claimed above, or the next in-process
+        # workload's ledger entries and dumps are mislabeled "driver"
+        # (the PR-14 test_runner → test_chaos ordering leak).
+        _chaos_api.set_role("worker")
+        _flight.set_role("worker")

@@ -166,6 +166,28 @@ def parse_args(argv=None):
     autotune.add_argument("--autotune-gaussian-process-noise", type=float,
                           dest="autotune_gaussian_process_noise")
 
+    autopilot = p.add_argument_group("autopilot")
+    autopilot.add_argument("--autopilot", action="store_true",
+                           dest="autopilot", default=False,
+                           help="Arm the online self-driving controller "
+                                "(HOROVOD_AUTOPILOT=1): closed-loop "
+                                "tuning of fusion threshold/cycle, "
+                                "dispatch strategy and wire dtype from "
+                                "the signal plane, plus automated "
+                                "straggler/dead-rank blacklist + "
+                                "re-rendezvous under elastic launches. "
+                                "See docs/performance.md.")
+    autopilot.add_argument("--no-autopilot", action="store_true",
+                           dest="no_autopilot",
+                           help="Explicitly disarm the autopilot "
+                                "(HOROVOD_AUTOPILOT=0), overriding an "
+                                "ambient env opt-in.")
+    autopilot.add_argument("--autopilot-interval", type=float,
+                           dest="autopilot_interval",
+                           help="Decision-epoch cadence in seconds "
+                                "(HOROVOD_AUTOPILOT_INTERVAL, "
+                                "default 10).")
+
     timeline = p.add_argument_group("timeline")
     timeline.add_argument("--timeline-filename", dest="timeline_filename")
     timeline.add_argument("--no-timeline-mark-cycles", action="store_false",
@@ -448,6 +470,10 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_CROSS_OVERLAP",
                 "HOROVOD_CONTROL_PLANE", "HOROVOD_KV_SHARD_COUNT",
                 "HOROVOD_KV_SHARD_PORT_BASE", "HOROVOD_CONTROL_LEASE_MS",
+                "HOROVOD_AUTOPILOT", "HOROVOD_AUTOPILOT_INTERVAL",
+                "HOROVOD_AUTOPILOT_MAX_REMOVALS",
+                "HOROVOD_AUTOPILOT_HYSTERESIS",
+                "HOROVOD_AUTOPILOT_MIN_WORLD",
                 "HOROVOD_SERVING", "HOROVOD_SERVING_PORT",
                 "HOROVOD_SERVING_SLOTS", "HOROVOD_SERVING_MAX_LEN",
                 "HOROVOD_SERVING_PREFILL_CHUNK",

@@ -1,0 +1,830 @@
+"""Autopilot (ISSUE 15 / ROADMAP item 4): the online self-driving
+controller — signal frames, the remediation policy's fake-clock
+guardrails, the driver arm, and the CPU-tier convergence guard (detuned
+start → within-bound of the hand-tuned reference, decisions on the
+flight ring)."""
+
+import time
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from horovod_tpu.autopilot import remediate as ap_remediate
+from horovod_tpu.autopilot import signals as ap_signals
+from horovod_tpu.autopilot.controller import AutopilotController
+from horovod_tpu.autopilot.remediate import DriverArm, RemediationPolicy
+
+
+class _Clock:
+    def __init__(self, t=1000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+
+
+def _verdict(rank, cause="straggler", host=None):
+    return {rank: {"cause": cause,
+                   "host": host or f"host{rank}"}}
+
+
+class TestRemediationPolicy:
+    def test_hysteresis_consecutive_epochs(self):
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=3, max_removals=4, min_world=1,
+                              time_fn=clk)
+        assert p.observe(_verdict(5), world=8) == []
+        assert p.observe(_verdict(5), world=8) == []
+        acts = p.observe(_verdict(5), world=8)
+        assert [a["rank"] for a in acts] == [5]
+        assert acts[0]["streak"] == 3
+        assert acts[0]["cause"] == "straggler"
+
+    def test_streak_resets_on_a_healthy_epoch(self):
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=2, max_removals=4, min_world=1,
+                              time_fn=clk)
+        assert p.observe(_verdict(5), world=8) == []
+        assert p.observe({}, world=8) == []          # healthy epoch
+        assert p.observe(_verdict(5), world=8) == []  # streak restarted
+        assert p.observe(_verdict(5), world=8) != []
+
+    def test_rate_limit_rolls_with_the_window(self):
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=1, min_world=1,
+                              window_s=100.0, time_fn=clk)
+        both = {**_verdict(5), **_verdict(6)}
+        acts = p.observe(both, world=8)
+        assert len(acts) == 1                        # budget 1/window
+        assert p.observe(both, world=8) == []        # budget spent
+        clk.advance(101.0)
+        assert len(p.observe(both, world=8)) == 1    # window rolled
+
+    def test_do_not_shrink_floor(self):
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=8, min_world=7,
+                              time_fn=clk)
+        assert p.observe(_verdict(5), world=7) == []  # already at floor
+        assert p.observe(_verdict(5), world=8) != []  # one above: ok
+
+    def test_floor_counts_same_epoch_removals(self):
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=8, min_world=7,
+                              time_fn=clk)
+        both = {**_verdict(5), **_verdict(6)}
+        acts = p.observe(both, world=8)
+        assert len(acts) == 1                        # second would breach
+
+    def test_protected_rank_never_actioned(self):
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=8, min_world=1,
+                              protected=(0,), time_fn=clk)
+        assert p.observe(_verdict(0, cause="dead"), world=8) == []
+
+    def test_protected_host_covers_colocated_ranks(self):
+        """Review regression: removal is per-HOST — a verdict on a rank
+        colocated with the coordinator must not evict its host."""
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=8, min_world=1,
+                              protected=(0,), protected_hosts=("hostA",),
+                              time_fn=clk)
+        assert p.observe(_verdict(1, host="hostA"), world=8) == []
+        assert p.observe(_verdict(2, host="hostB"), world=8) != []
+
+    def test_hostless_verdict_keeps_streak_without_burning_budget(self):
+        """A target the telemetry plane cannot place must emit nothing
+        (a host-less request would only burn the driver's rate budget)
+        while the streak keeps accumulating — the action fires the first
+        epoch the host resolves."""
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=2, max_removals=1, min_world=1,
+                              time_fn=clk)
+        nohost = {5: {"cause": "straggler", "host": None}}
+        assert p.observe(nohost, world=8) == []
+        assert p.observe(nohost, world=8) == []       # streak=2, no host
+        acts = p.observe(_verdict(5), world=8)        # host resolved
+        assert [a["rank"] for a in acts] == [5]
+        # the host-less epochs burned nothing:
+        assert len(p.observe(_verdict(6), world=8)) == 0  # hysteresis
+        clk.advance(ap_remediate.WINDOW_S + 1)
+
+    def test_cooldown_no_rerequest_within_window(self):
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=8, min_world=1,
+                              window_s=100.0, time_fn=clk)
+        assert p.observe(_verdict(5), world=8) != []
+        # the same host named again (re-admitted, still slow): within the
+        # window the policy defers to the driver-side cooldown...
+        assert p.observe(_verdict(5), world=8) == []
+        clk.advance(101.0)
+        # ...after it, re-admission + re-naming may act again.
+        assert p.observe(_verdict(5), world=8) != []
+
+    def test_floor_debits_the_victim_hosts_rank_count(self):
+        """Review regression: removal is per HOST — the policy floor
+        must debit the victim host's whole rank count (from the
+        telemetry view), not 1."""
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=8, min_world=13,
+                              time_fn=clk)
+        sizes = {"hostB": 4}
+        # world 16, removing hostB loses 4 -> 12 < 13: vetoed
+        assert p.observe(_verdict(5, host="hostB"), world=16,
+                         host_sizes=sizes) == []
+        p2 = RemediationPolicy(hysteresis=1, max_removals=8, min_world=12,
+                               time_fn=clk)
+        assert p2.observe(_verdict(5, host="hostB"), world=16,
+                          host_sizes=sizes) != []
+
+    def test_floor_veto_skips_not_breaks(self):
+        """Review regression: a floor veto rejects THIS victim only — an
+        oversized host ahead in severity order must not starve a smaller
+        eligible host behind it."""
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=8, min_world=14,
+                              time_fn=clk)
+        verdicts = {1: {"cause": "dead", "host": "big"},
+                    9: {"cause": "dead", "host": "small"}}
+        acts = p.observe(verdicts, world=16,
+                         host_sizes={"big": 4, "small": 1})
+        assert [a["host"] for a in acts] == ["small"]
+
+    def test_refund_returns_budget_and_cooldown(self):
+        """Review regression: a driver-rejected request executed nothing
+        — refund() returns its rate-budget slot and host cooldown so the
+        arm isn't starved for a whole window (streak is NOT restored:
+        re-accumulating is the anti-ping-pong damping)."""
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=1, min_world=1,
+                              window_s=100.0, time_fn=clk)
+        assert p.observe(_verdict(5, host="hostB"), world=8) != []
+        # budget spent and host cooling: another target is vetoed
+        assert p.observe(_verdict(6, host="hostC"), world=8) == []
+        p.refund("hostB")
+        # budget + cooldown returned: the next epoch may act again
+        acts = p.observe(_verdict(6, host="hostC"), world=8)
+        assert [a["rank"] for a in acts] == [6]
+
+    def test_severity_order_dead_over_straggler(self):
+        clk = _Clock()
+        p = RemediationPolicy(hysteresis=1, max_removals=1, min_world=1,
+                              time_fn=clk)
+        verdicts = {**_verdict(3, cause="straggler"),
+                    **_verdict(6, cause="dead")}
+        acts = p.observe(verdicts, world=8)
+        assert [a["rank"] for a in acts] == [6]
+
+
+class _FakeKV:
+    def __init__(self):
+        self.d = {}
+
+    def get(self, scope, key):
+        return self.d.get((scope, key))
+
+    def put(self, scope, key, value):
+        self.d[(scope, key)] = value
+
+
+def _request(kv, idx, rank, host, cause="straggler"):
+    import json
+    kv.put("autopilot", f"req/{idx}", json.dumps(
+        {"id": f"t-{idx}", "rank": rank, "host": host,
+         "cause": cause}).encode())
+    kv.put("autopilot", "head", str(idx + 1).encode())
+
+
+class TestDriverArm:
+    def _arm(self, hosts, **kw):
+        from horovod_tpu.runner.elastic.discovery import HostManager
+        from horovod_tpu.runner.hosts import HostInfo
+
+        class _Disc:
+            def find_available_hosts_and_slots(self):
+                return dict(hosts)
+
+        kv = _FakeKV()
+        hm = HostManager(_Disc())
+        del HostInfo
+        args = dict(min_world=1, max_removals=1)
+        args.update(kw)
+        return kv, hm, DriverArm(kv, hm, **args)
+
+    def test_applies_through_the_cooldown_path(self, monkeypatch):
+        monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN_RANGE", "600,600")
+        hosts = {"hostA": 1, "hostB": 1, "hostC": 1}
+        kv, hm, arm = self._arm(hosts)
+        _request(kv, 0, rank=2, host="hostC")
+        removed = arm.poll(dict(hosts))
+        assert removed == {"hostC"}
+        assert kv.get("autopilot", "ack/t-0") == b"applied"
+        # the HostManager cooldown now excludes it from discovery
+        assert "hostC" not in hm.current_hosts()
+        # the same request is never re-applied
+        assert arm.poll(dict(hosts)) == set()
+
+    def test_floor_and_rate_rejections(self):
+        hosts = {"hostA": 1, "hostB": 1}
+        kv, hm, arm = self._arm(hosts, min_world=2, max_removals=1)
+        _request(kv, 0, rank=1, host="hostB")
+        assert arm.poll(dict(hosts)) == set()
+        assert kv.get("autopilot", "ack/t-0") == b"rejected_floor"
+
+        kv2, hm2, arm2 = self._arm({"a": 1, "b": 1, "c": 1, "d": 1},
+                                   min_world=1, max_removals=1)
+        _request(kv2, 0, rank=1, host="b")
+        _request(kv2, 1, rank=2, host="c")
+        removed = arm2.poll({"a": 1, "b": 1, "c": 1, "d": 1})
+        assert removed == {"b"}
+        assert kv2.get("autopilot", "ack/t-1") == b"rejected_rate"
+
+    def test_unknown_host_rejected(self):
+        hosts = {"hostA": 1}
+        kv, hm, arm = self._arm(hosts, min_world=0)
+        _request(kv, 0, rank=9, host="nosuch")
+        assert arm.poll(dict(hosts)) == set()
+        assert kv.get("autopilot", "ack/t-0") == b"rejected_unknown_host"
+
+    def test_floor_counts_slots_not_hosts(self):
+        """Review regression: min_world is in PROCESSES (--min-np units).
+        4 hosts x 4 slots (world 16) with min_world=8: removing one host
+        leaves 12 >= 8 — a host-count comparison would veto every
+        removal on any multi-slot deployment."""
+        hosts = {"a": 4, "b": 4, "c": 4, "d": 4}
+        kv, hm, arm = self._arm(hosts, min_world=8, max_removals=1)
+        _request(kv, 0, rank=15, host="d")
+        assert arm.poll(dict(hosts)) == {"d"}
+        assert kv.get("autopilot", "ack/t-0") == b"applied"
+        # ...but removing a host that would breach the slot floor is
+        # still rejected (16 - 4 = 12 slots < 13).
+        kv2, hm2, arm2 = self._arm(hosts, min_world=13, max_removals=1)
+        _request(kv2, 0, rank=15, host="d")
+        assert arm2.poll(dict(hosts)) == set()
+        assert kv2.get("autopilot", "ack/t-0") == b"rejected_floor"
+
+    def test_transient_get_failure_retries_not_drops(self):
+        """Review regression: a transient KV fault while reading a
+        request must leave the index unconsumed — the next poll retries
+        instead of dropping the removal forever."""
+        hosts = {"hostA": 1, "hostB": 1, "hostC": 1}
+        kv, hm, arm = self._arm(hosts)
+        _request(kv, 0, rank=2, host="hostC")
+        real_get = kv.get
+        fails = {"n": 1}
+
+        def flaky_get(scope, key):
+            if key.startswith("req/") and fails["n"]:
+                fails["n"] -= 1
+                raise OSError("transient")
+            return real_get(scope, key)
+
+        kv.get = flaky_get
+        assert arm.poll(dict(hosts)) == set()      # fault: retried later
+        assert arm.poll(dict(hosts)) == {"hostC"}  # next poll applies
+        assert kv.d[("autopilot", "ack/t-0")] == b"applied"
+
+    def test_cooldown_readmission(self, monkeypatch):
+        """After the blacklist cooldown lapses the host is discoverable
+        again — re-admission is the existing exponential-cooldown
+        lifecycle, not autopilot code."""
+        monkeypatch.setenv("HOROVOD_BLACKLIST_COOLDOWN_RANGE",
+                           "0.05,0.05")
+        hosts = {"hostA": 1, "hostB": 1}
+        kv, hm, arm = self._arm(hosts)
+        _request(kv, 0, rank=1, host="hostB")
+        assert arm.poll(dict(hosts)) == {"hostB"}
+        assert "hostB" not in hm.current_hosts()
+        time.sleep(0.1)
+        assert "hostB" in hm.current_hosts()
+
+
+class TestSignalFrames:
+    def _snap(self, t, bytes_total=0.0, findings=()):
+        return {
+            "t": t, "wall_t": t,
+            "counters": {"collective_bytes_total": {
+                (("op", "allreduce"), ("process_set", "global")):
+                    bytes_total},
+                "wire_bytes_total": {
+                    (("dtype", "float32"), ("tier", "dcn")):
+                        bytes_total / 4}},
+            "histograms": {},
+            "last_step_key": None, "step_records": [],
+            "findings": list(findings),
+        }
+
+    def test_deltas_and_dcn_split(self):
+        f = ap_signals.frame(self._snap(0.0, 100.0),
+                             self._snap(2.0, 500.0))
+        assert f["elapsed_s"] == 2.0
+        assert f["reduced_bytes"] == 400.0
+        assert f["dcn_bytes"] == 100.0
+        assert f["steps"] == 0 and f["wall_mean_s"] is None
+
+    def test_straggler_namings_are_new_only(self):
+        old = {"kind": "straggler", "rank": 7, "step": 10}
+        new = {"kind": "straggler", "rank": 7, "step": 20}
+        f = ap_signals.frame(self._snap(0.0, findings=[old]),
+                             self._snap(1.0, findings=[old, new]))
+        assert f["straggler_namings"] == {7: 1}
+
+    def test_unhealthy_from_cluster_view(self):
+        view = {"counts": {"healthy": 7, "dead": 1},
+                "health": {"3": {"state": "dead", "why": "beacon_stale",
+                                 "host": "127.0.0.4"},
+                           "0": {"state": "healthy"}}}
+        f = ap_signals.frame(self._snap(0.0), self._snap(1.0), view)
+        assert f["unhealthy"] == {3: {"state": "dead",
+                                      "why": "beacon_stale",
+                                      "host": "127.0.0.4"}}
+
+    def test_live_snapshot_is_frameable(self, hvd):
+        s0 = ap_signals.snapshot()
+        jnp.asarray(np.zeros(4))
+        s1 = ap_signals.snapshot()
+        f = ap_signals.frame(s0, s1, ap_signals.cluster_view())
+        assert f["elapsed_s"] > 0
+        assert "straggler_namings" in f
+
+
+class TestControllerUnits:
+    def _cfg(self, **kw):
+        from horovod_tpu.common.config import Config
+        c = Config(autopilot=True, autotune_warmup_samples=0,
+                   autotune_bayes_opt_max_samples=3)
+        for k, v in kw.items():
+            setattr(c, k, v)
+        return c
+
+    def test_first_tick_is_baseline_only(self, hvd):
+        ctrl = AutopilotController(self._cfg())
+        recs = ctrl.tick()
+        assert [r["outcome"] for r in recs] == ["baseline"]
+        assert ctrl.epoch == 0
+
+    def test_idle_epoch_is_no_signal(self, hvd):
+        ctrl = AutopilotController(self._cfg())
+        ctrl.tick()
+        # monkey-free idle epoch: no dispatches between ticks
+        recs = [r for r in ctrl.tick() if r["lever"] == "tuner"]
+        assert [r["outcome"] for r in recs] == ["no_signal"]
+        assert not ctrl.frozen
+
+    def test_remediation_without_driver_is_unreachable(self, hvd,
+                                                       monkeypatch):
+        """Verdicts flow through the policy; with no launcher KV the
+        request records 'unreachable' (and the metric outcome
+        no_driver) instead of pretending."""
+        monkeypatch.delenv("HOROVOD_KV_ADDR", raising=False)
+        monkeypatch.delenv("HOROVOD_KV_PORT", raising=False)
+        cfg = self._cfg(autopilot_hysteresis=1)
+        ctrl = AutopilotController(cfg)
+        view = {"world": 8, "counts": {"healthy": 7, "dead": 1},
+                "health": {"5": {"state": "dead", "why": "beacon_stale",
+                                 "host": "127.0.0.6"}}}
+        monkeypatch.setattr(ap_signals, "cluster_view", lambda: view)
+        ctrl.tick()
+        recs = ctrl.tick()
+        rem = [r for r in recs if r["lever"] == "remediate"]
+        assert rem and rem[0]["outcome"] == "unreachable"
+        assert rem[0]["rank"] == 5 and rem[0]["cause"] == "dead"
+        # ...and the decision is on the flight ring
+        from horovod_tpu.flight import recorder
+        evs = [e for e in recorder.get().events()
+               if e.get("kind") == "autopilot_remediate"]
+        assert evs and evs[-1].get("name") == "rank5"
+
+    def test_static_launch_is_no_driver_not_requested(self, hvd,
+                                                      monkeypatch):
+        """Review regression: a STATIC hvdrun launch has the launcher KV
+        but no DriverArm polling it — publishing would record a
+        `requested` nothing can execute, and the runbook would read the
+        missing `applied` as a driver veto."""
+        kv = _FakeKV()
+        monkeypatch.setattr(ap_remediate, "_launcher_kv", lambda: kv)
+        monkeypatch.delenv("HOROVOD_ELASTIC", raising=False)
+        req = ap_remediate.publish_request(
+            {"rank": 5, "host": "hostB", "cause": "dead"}, epoch=1)
+        assert req is None
+        assert not kv.d            # nothing written to the KV
+
+    def test_decision_score_zero_is_recorded_as_zero(self, hvd):
+        """Review regression (falsy-zero): a legitimate 0.0 score must
+        reach the flight event's dur field, not fall through to the
+        wall mean."""
+        from horovod_tpu.flight import recorder
+        ctrl = AutopilotController(self._cfg())
+        frame = ap_signals.SignalFrame(wall_mean_s=0.5)
+        ctrl._record("tuner", "adopt", frame, score=0.0)
+        ev = [e for e in recorder.get().events()
+              if e.get("kind") == "autopilot_decision"][-1]
+        assert ev.get("dur", "absent") in (0.0, "absent")  # never 0.5
+        assert ev.get("dur", 0.0) == 0.0
+
+    def test_rejected_ack_refunds_the_policy(self, hvd, monkeypatch):
+        """Review regression: a driver veto (rejected_*) must flow back
+        into the policy — budget/cooldown refunded, the outcome on the
+        decision trail — instead of silently disabling the arm for a
+        whole rate window."""
+        kv = _FakeKV()
+        monkeypatch.setattr(ap_remediate, "_launcher_kv", lambda: kv)
+        monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+        cfg = self._cfg(autopilot_hysteresis=1)
+        ctrl = AutopilotController(cfg)
+        view = {"world": 8, "counts": {"healthy": 7, "dead": 1},
+                "health": {"5": {"state": "dead", "why": "beacon_stale",
+                                 "host": "127.0.0.6"}}}
+        monkeypatch.setattr(ap_signals, "cluster_view", lambda: view)
+        ctrl.tick()
+        recs = ctrl.tick()
+        rem = [r for r in recs if r["lever"] == "remediate"]
+        assert rem and rem[0]["outcome"] == "requested"
+        req_id = rem[0]["request"]
+        assert ctrl._pending_acks
+        # the driver vetoes it
+        kv.put("autopilot", f"ack/{req_id}", b"rejected_floor")
+        recs = ctrl.tick()
+        rem = [r for r in recs if r["lever"] == "remediate"]
+        assert any(r["outcome"] == "rejected_floor" for r in rem), rem
+        # the vetoed request is no longer pending, and the refund
+        # re-enabled the arm: the still-dead rank is re-requested (the
+        # re-accumulated streak hit hysteresis=1 in the same epoch)
+        assert req_id not in ctrl._pending_acks
+        assert any(r["outcome"] == "requested" for r in rem), \
+            "refund did not re-enable the arm"
+
+
+class TestCrossWireRevert:
+    def test_trial_without_dcn_collapse_is_reverted(self, hvd,
+                                                    monkeypatch):
+        """The revert-on-regression guardrail of the controller-owned
+        cross-wire lever: a trial whose epoch did NOT collapse DCN bytes
+        is rolled back — registry entry, runtime cross wire and strategy
+        all restored."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import fusion, wire
+        rt = fusion.get_runtime()
+        prev = (rt.strategy, rt.cross_wire)
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        try:
+            cfg = basics.config()
+            ctrl = AutopilotController(cfg)
+            rt.strategy = "torus"
+            rt.cross_wire = ""
+            monkeypatch.setattr(ctrl, "_slices", lambda: 2)
+            frame = ap_signals.SignalFrame(
+                flushes=1, steps=1, dcn_bytes=1000.0, wall_mean_s=0.01,
+                elapsed_s=1.0, reduced_bytes=1.0)
+            ctrl._maybe_try_cross(frame, rt)
+            assert ctrl._cross_trial is not None
+            assert rt.strategy == "torus_qcross"
+            assert rt.cross_wire == "int8"
+            # next epoch: DCN did not shrink (>= 0.75x of baseline)
+            judge = ap_signals.SignalFrame(
+                flushes=1, steps=1, dcn_bytes=990.0, wall_mean_s=0.01,
+                elapsed_s=1.0, reduced_bytes=1.0)
+            ctrl._judge_cross_trial(judge, rt)
+            assert ctrl._cross_trial is None and not ctrl._cross_adopted
+            assert rt.strategy == "torus" and rt.cross_wire == ""
+            assert wire.wire_dtype_for("global", tier="dcn") == ""
+            outcomes = [d["outcome"] for d in ctrl.decisions()
+                        if d["lever"] == "cross_wire"]
+            assert outcomes == ["trial", "reverted"]
+        finally:
+            rt.strategy, rt.cross_wire = prev
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+
+    def test_revert_restores_a_cast_cross_wire_and_strategy(self, hvd,
+                                                            monkeypatch):
+        """Review regression: the revert restores the SAVED pre-trial
+        strategy — inferring it from the wire left torus_qcross behind
+        whenever the pre-trial cross wire was a non-empty cast."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import fusion, wire
+        rt = fusion.get_runtime()
+        prev = (rt.strategy, rt.cross_wire)
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        try:
+            ctrl = AutopilotController(basics.config())
+            rt.strategy, rt.cross_wire = "torus", "bfloat16"
+            wire.runtime_sync_wire_dtype("bfloat16", "global", tier="dcn")
+            monkeypatch.setattr(ctrl, "_slices", lambda: 2)
+            ctrl._maybe_try_cross(ap_signals.SignalFrame(
+                flushes=1, steps=1, dcn_bytes=1000.0), rt)
+            assert rt.strategy == "torus_qcross"
+            ctrl._judge_cross_trial(ap_signals.SignalFrame(
+                flushes=1, steps=1, dcn_bytes=990.0), rt)
+            assert rt.strategy == "torus"            # saved, not guessed
+            assert rt.cross_wire == "bfloat16"
+            assert wire.wire_dtype_for("global", tier="dcn") == "bfloat16"
+        finally:
+            rt.strategy, rt.cross_wire = prev
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+
+    def test_zero_dcn_baseline_is_not_a_collapse(self, hvd, monkeypatch):
+        """Review regression: a trial armed off a zero-DCN baseline has
+        NO before/after evidence — it must revert, not silently keep the
+        lossy cross wire."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import fusion, wire
+        rt = fusion.get_runtime()
+        prev = (rt.strategy, rt.cross_wire)
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        try:
+            ctrl = AutopilotController(basics.config())
+            rt.strategy, rt.cross_wire = "torus", ""
+            monkeypatch.setattr(ctrl, "_slices", lambda: 2)
+            ctrl._maybe_try_cross(ap_signals.SignalFrame(
+                flushes=1, steps=1, dcn_bytes=0.0, wall_mean_s=0.01), rt)
+            assert ctrl._cross_trial is not None
+            ctrl._judge_cross_trial(ap_signals.SignalFrame(
+                flushes=1, steps=1, dcn_bytes=0.0, wall_mean_s=0.01), rt)
+            assert not ctrl._cross_adopted
+            assert rt.strategy == "torus" and rt.cross_wire == ""
+        finally:
+            rt.strategy, rt.cross_wire = prev
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+
+
+class TestQcrossSweepHygiene:
+    def test_wire_armed_for_a_sample_leaves_with_it(self, hvd,
+                                                    monkeypatch):
+        """Review regression: the int8 DCN wire the controller arms FOR
+        a torus_qcross sweep sample must be reverted when the sweep
+        moves off the strategy — a leftover registry entry would read as
+        a user opt-in (skipping the guarded trial) and price a lossy DCN
+        leg the runtime never moves."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import fusion, wire
+        rt = fusion.get_runtime()
+        prev = (rt.strategy, rt.cross_wire)
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        try:
+            ctrl = AutopilotController(basics.config())
+            rt.strategy, rt.cross_wire = "flat", ""
+            ctrl._apply(rt, rt.threshold, rt._cycle_s * 1000.0,
+                        {"strategy": "torus_qcross"})
+            assert rt.cross_wire == "int8"
+            assert wire.wire_dtype_for("global", tier="dcn") == "int8"
+            ctrl._apply(rt, rt.threshold, rt._cycle_s * 1000.0,
+                        {"strategy": "torus"})
+            assert rt.cross_wire == ""
+            assert wire.wire_dtype_for("global", tier="dcn") == ""
+            assert ctrl._qcross_armed is None
+        finally:
+            rt.strategy, rt.cross_wire = prev
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+
+
+class TestOverlapPin:
+    def test_pin_survives_per_flush_steering(self, hvd):
+        """Review regression: the controller's epoch-granular overlap
+        mode used to be overwritten by the fusion runtime's per-flush
+        steering at the very next flush — while pinned, the runtime must
+        defer."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import fusion
+        rt = fusion.get_runtime()
+        prev = (rt._overlap, rt._overlap_mode, rt._overlap_pinned)
+        try:
+            rt._overlap = True
+            ctrl = AutopilotController(basics.config())
+            frame = ap_signals.SignalFrame(attribution_mean_s={
+                "collective": 1.0, "cross_wait": 0.0, "compute": 0.1})
+            ctrl._steer_overlap(frame, rt)
+            assert rt._overlap_mode == "next_flush"
+            assert rt._overlap_pinned
+            # per-flush steering (profiler armed, whatever the last step
+            # said) must NOT recompute while pinned
+            assert rt._steer_overlap() == "next_flush"
+            assert rt._overlap_mode == "next_flush"
+            # a stopped controller hands steering back
+            ctrl.stop()
+            assert not rt._overlap_pinned
+        finally:
+            (rt._overlap, rt._overlap_mode, rt._overlap_pinned) = prev
+
+
+class TestAnalyzeAutopilot:
+    def test_ack_attaches_to_request_row(self):
+        """Review regression: one executed removal = ONE remediation row
+        — the driver-arm ack's outcome attaches to the coordinator's
+        request row instead of fabricating a second 'remediation' whose
+        cause is an outcome string."""
+        from horovod_tpu.flight import analyze as flight_analyze
+        events = [
+            {"kind": "autopilot_remediate", "rank": 0, "t": 10.0,
+             "name": "rank7", "what": "straggler", "op": "127.0.0.8",
+             "seq": 4},
+            {"kind": "autopilot_remediate", "rank": 0, "t": 11.0,
+             "name": "rank7", "what": "applied", "op": "127.0.0.8"},
+        ]
+        report = flight_analyze.analyze_autopilot(
+            events, [{"version": 2, "removed": ["127.0.0.8"], "t": 11.5}])
+        rows = report["remediations"]
+        assert len(rows) == 1, rows
+        assert rows[0]["cause"] == "straggler"
+        assert rows[0]["outcome"] == "applied"
+        assert rows[0]["rank"] == 7 and rows[0]["epoch"] == 4
+        assert rows[0]["disruption"]["version"] == 2
+
+    def test_ack_listed_before_request_still_pairs(self):
+        """Review regression: load_dir groups events per dump FILE (a
+        driver dump sorts before worker dumps), so acks can arrive
+        list-ordered before their requests — pairing is by wall time."""
+        from horovod_tpu.flight import analyze as flight_analyze
+        events = [
+            {"kind": "autopilot_remediate", "rank": 0, "t": 11.0,
+             "name": "rank7", "what": "applied", "op": "127.0.0.8"},
+            {"kind": "autopilot_remediate", "rank": 0, "t": 10.0,
+             "name": "rank7", "what": "straggler", "op": "127.0.0.8",
+             "seq": 4},
+        ]
+        rows = flight_analyze.analyze_autopilot(events)["remediations"]
+        assert len(rows) == 1, rows
+        assert rows[0]["cause"] == "straggler"
+        assert rows[0]["outcome"] == "applied"
+
+    def test_orphan_ack_is_outcome_only(self):
+        from horovod_tpu.flight import analyze as flight_analyze
+        events = [{"kind": "autopilot_remediate", "rank": 0, "t": 11.0,
+                   "name": "rank3", "what": "rejected_floor"}]
+        rows = flight_analyze.analyze_autopilot(events)["remediations"]
+        assert rows == [{"rank": 3, "cause": None,
+                         "outcome": "rejected_floor", "host": None,
+                         "t": 11.0}]
+
+
+class TestTickRecordsPastDequeCap:
+    def test_tick_returns_records_after_256_decisions(self, hvd):
+        """Review regression: tick() used to slice the bounded decisions
+        deque by its pre-tick length — after 256 lifetime decisions it
+        returned [] forever."""
+        from horovod_tpu.common.config import Config
+        ctrl = AutopilotController(Config())
+        ctrl.tick()                      # baseline
+        for _ in range(300):             # idle no_signal epochs
+            recs = ctrl.tick()
+            assert recs and recs[0]["outcome"] == "no_signal"
+        assert len(ctrl.decisions()) == 256   # deque stayed bounded
+
+
+@pytest.fixture
+def detuned(hvd, monkeypatch):
+    """Deliberately detuned runtime on a forced 2-slice layout: tiny
+    fusion threshold, flat dispatch, full-precision wire — plus a scarce
+    modeled DCN (HOROVOD_PEAK_DCN_GBS) so the controller's DCN-priced
+    score separates the hierarchy levers the way real cross-slice
+    hardware would. Same restore hygiene as test_hierarchy's `hier`
+    fixture (registry/caches clean both sides)."""
+    from horovod_tpu.metrics import instruments as ins
+    from horovod_tpu.ops import fusion, wire
+    rt = fusion.get_runtime()
+    prev = (rt.threshold, rt._cycle_s, rt.strategy, rt.cross_wire,
+            rt.wire_dtype, rt._parameter_manager, rt._overlap_mode,
+            rt._overlap_pinned)
+    monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+    monkeypatch.setenv("HOROVOD_PEAK_DCN_GBS", "0.05")
+    wire.clear_wire_registry()
+    wire.clear_strategy_registry()
+    wire.reset_error_feedback()
+    ins.reset_tier_split()
+    rt.threshold = 64 * 1024
+    rt._cycle_s = 0.001
+    rt.strategy = "flat"
+    rt.cross_wire = ""
+    rt.wire_dtype = None
+    yield rt
+    (rt.threshold, rt._cycle_s, rt.strategy, rt.cross_wire,
+     rt.wire_dtype, rt._parameter_manager, rt._overlap_mode,
+     rt._overlap_pinned) = prev
+    wire.clear_wire_registry()
+    wire.clear_strategy_registry()
+    wire.reset_error_feedback()
+    ins.reset_tier_split()
+
+
+def _dcn_bytes(hvd):
+    snap = hvd.metrics_snapshot()
+    return sum(s["value"]
+               for s in snap.get("wire_bytes_total", {}).get("series", ())
+               if s["labels"].get("tier") == "dcn")
+
+
+class TestConvergenceGuard:
+    """ISSUE 15 acceptance: from the detuned start the controller must
+    converge within K decision epochs to a config whose measured step
+    wall AND DCN bytes are within 1.25x of the hand-tuned reference,
+    with the decisions post-hoc on the flight ring."""
+
+    K = 28                       # decision-epoch budget
+    REF = dict(threshold=4 * 1024 * 1024, strategy="torus_qcross",
+               cross_wire="int8")
+
+    def _epoch(self, hvd, xs, step):
+        for _ in range(2):
+            hvd.grouped_allreduce_async(
+                xs, op=hvd.Average, name="autopilot_guard").synchronize()
+            step[0] += 1
+            hvd.step_marker(step[0])
+
+    def _measure(self, hvd, xs, step, epochs=5):
+        walls, dcns = [], []
+        for _ in range(epochs):
+            d0 = _dcn_bytes(hvd)
+            t0 = time.perf_counter()
+            self._epoch(hvd, xs, step)
+            walls.append(time.perf_counter() - t0)
+            dcns.append(_dcn_bytes(hvd) - d0)
+        import statistics
+        return statistics.median(walls), statistics.median(dcns)
+
+    def test_converges_to_within_bound_of_hand_tuned(self, hvd, detuned,
+                                                     monkeypatch):
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import wire
+        rt = detuned
+        cfg = basics.config()
+        monkeypatch.setattr(cfg, "autotune_warmup_samples", 0)
+        monkeypatch.setattr(cfg, "autotune_bayes_opt_max_samples", 4)
+        ctrl = AutopilotController(cfg)
+
+        n = hvd.size()
+        rng = np.random.default_rng(0)
+        xs = [jnp.asarray(rng.standard_normal((n, 64 * 1024)),
+                          jnp.float32) for _ in range(6)]
+        step = [0]
+
+        for _ in range(self.K):
+            self._epoch(hvd, xs, step)
+            ctrl.tick()
+            if ctrl.frozen and ctrl._cross_trial is None:
+                break
+        assert ctrl.frozen, \
+            f"controller did not converge within {self.K} epochs: " \
+            f"{ctrl.decisions()}"
+        assert ctrl.epoch <= self.K
+
+        # The converged config must have found the hierarchical tier
+        # with the quantized cross leg (the only way DCN collapses).
+        assert rt.strategy == "torus_qcross", ctrl.decisions()
+        assert rt.cross_wire == "int8", ctrl.decisions()
+
+        # Measure converged vs the hand-tuned reference, interleaved
+        # (A/B per round) so box-load drift cancels; warm both first.
+        frozen = (rt.threshold, rt._cycle_s, rt.strategy, rt.cross_wire)
+
+        def apply_ref():
+            rt.threshold = self.REF["threshold"]
+            rt.strategy = self.REF["strategy"]
+            rt.cross_wire = self.REF["cross_wire"]
+            wire.runtime_sync_wire_dtype("int8", "global", tier="dcn")
+
+        def apply_frozen():
+            (rt.threshold, rt._cycle_s, rt.strategy,
+             rt.cross_wire) = frozen
+
+        apply_ref()
+        self._epoch(hvd, xs, step)       # warm the ref programs
+        apply_frozen()
+        self._epoch(hvd, xs, step)       # re-warm the frozen programs
+        ref_w, conv_w, ref_d, conv_d = [], [], [], []
+        for _ in range(5):
+            apply_ref()
+            w, d = self._measure(hvd, xs, step, epochs=1)
+            ref_w.append(w)
+            ref_d.append(d)
+            apply_frozen()
+            w, d = self._measure(hvd, xs, step, epochs=1)
+            conv_w.append(w)
+            conv_d.append(d)
+        import statistics
+        wall_ratio = statistics.median(conv_w) / statistics.median(ref_w)
+        dcn_ratio = statistics.median(conv_d) / max(
+            statistics.median(ref_d), 1.0)
+        assert dcn_ratio <= 1.25, (dcn_ratio, conv_d, ref_d)
+        assert wall_ratio <= 1.25, (wall_ratio, conv_w, ref_w)
+
+        # Post-hoc: the whole decision trail is on the flight ring.
+        from horovod_tpu.flight import analyze as flight_analyze
+        from horovod_tpu.flight import recorder
+        evs = [e for e in recorder.get().events()
+               if e.get("kind", "").startswith("autopilot")]
+        report = flight_analyze.analyze_autopilot(evs)
+        assert report["frozen"], report
+        assert report["decisions"] >= ctrl.epoch, report
+        assert any(k.startswith("tuner:adopt")
+                   for k in report["by_lever"]), report

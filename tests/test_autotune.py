@@ -1,0 +1,104 @@
+"""ParameterManager online hardening (ISSUE 15 satellite): the
+``suggest()``/``observe(score)`` increments that decouple the tuner from
+the tensor-byte ``record()`` path, non-finite score clamping, and the
+bounded-move guardrail the autopilot arms."""
+
+import math
+
+import numpy as np
+
+from horovod_tpu.autotune import ParameterManager
+
+
+def _pm(**kw):
+    args = dict(warmup_samples=0, steps_per_sample=1,
+                bayes_opt_max_samples=4, initial_threshold=4 * 1024 * 1024,
+                initial_cycle_ms=1.0)
+    args.update(kw)
+    return ParameterManager(**args)
+
+
+class TestSuggestObserve:
+    def test_suggest_does_not_advance(self):
+        pm = _pm()
+        first = pm.suggest()
+        for _ in range(10):
+            assert pm.suggest() == first
+        assert pm.tuning
+
+    def test_observe_decoupled_from_record_window(self):
+        """observe() closes one sample per call regardless of
+        steps_per_sample — the autopilot's epoch granularity — while
+        record() still needs its full step window."""
+        pm = _pm(steps_per_sample=10)
+        assert pm.record(1024) is None          # window not full
+        assert pm.observe(100.0) is not None    # one sample, immediately
+
+    def test_observe_runs_the_full_machinery_to_freeze(self):
+        pm = _pm(categorical_knobs={"strategy": ["flat", "torus"]})
+        seen = []
+        for i in range(40):
+            out = pm.observe(100.0 + (10.0 if i % 7 == 3 else 0.0))
+            if out is not None:
+                seen.append(out)
+            if not pm.tuning:
+                break
+        assert not pm.tuning, "observe() alone must reach the freeze"
+        assert pm.observe(1.0) is None          # frozen: no more updates
+        # the frozen categorical is one of the swept choices
+        assert pm.categoricals["strategy"] in ("flat", "torus")
+
+    def test_non_finite_scores_clamped(self):
+        """A partially-observed first epoch (zero elapsed, missing
+        counters) produces NaN/inf scores; they must never poison the GP
+        or win the sweep."""
+        pm = _pm(categorical_knobs={"strategy": ["flat", "torus"]})
+        # 'torus' windows score inf/NaN, 'flat' windows score finitely:
+        # the sweep must crown 'flat'.
+        for _ in range(40):
+            cat = pm.categoricals["strategy"]
+            pm.observe(float("inf") if cat == "torus" else 50.0)
+            if pm._cat_done:
+                break
+        assert pm._cat_done
+        assert pm.categoricals["strategy"] == "flat"
+
+    def test_nan_and_none_are_zero(self):
+        pm = _pm(bayes_opt_max_samples=10)
+        for bad in (float("nan"), float("inf"), float("-inf"), None,
+                    "not-a-number"):
+            out = pm.observe(bad)
+            assert out is not None
+        # the GP holds only finite samples
+        assert all(math.isfinite(y) for y in pm._bo.y_samples)
+
+
+class TestBoundedMove:
+    def test_numeric_proposals_clamped_per_epoch(self):
+        """max_move_log2=1: every applied threshold/cycle moves at most
+        one octave per observed sample, and _current always records the
+        APPLIED point."""
+        pm = _pm(max_move_log2=1.0, bayes_opt_max_samples=8)
+        prev = np.log2([pm.fusion_threshold, pm.cycle_time_ms])
+        for i in range(8):
+            out = pm.observe(100.0 + i)
+            if out is None or not pm.tuning:
+                break
+            cur = np.log2([pm.fusion_threshold, pm.cycle_time_ms])
+            # 1e-5 slack: fusion_threshold round-trips through int(2**x)
+            assert np.all(np.abs(cur - prev) <= 1.0 + 1e-5), (prev, cur)
+            prev = cur
+
+    def test_unbounded_by_default(self):
+        pm = _pm()
+        assert pm._max_move is None
+
+    def test_zero_means_frozen_numerics_not_unbounded(self):
+        """Review regression (falsy-zero): max_move_log2=0 pins the
+        numeric knobs entirely — every proposal clamps to zero move."""
+        pm = _pm(max_move_log2=0, bayes_opt_max_samples=6)
+        thr0, cyc0 = pm.fusion_threshold, pm.cycle_time_ms
+        for i in range(5):
+            if pm.observe(100.0 + i) is None:
+                break
+            assert (pm.fusion_threshold, pm.cycle_time_ms) == (thr0, cyc0)

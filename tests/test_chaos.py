@@ -338,6 +338,37 @@ class TestLauncherPropagation:
         assert env["HOROVOD_CHAOS_LEDGER"] == "/tmp/led"
 
 
+class TestRoleHygiene:
+    def test_uninstall_resets_role(self):
+        """PR-14 full-suite ordering leak: an in-process elastic driver
+        run (test_runner) tagged this process's chaos role 'driver', and
+        every later same-process test's ledger entries inherited it —
+        the role must revert with the plan."""
+        chaos.set_role("driver")
+        chaos.uninstall()
+        assert injector._role == "worker"
+
+    def test_in_process_driver_run_restores_roles(self, tmp_path):
+        """run_elastic_driver claims the driver roles (chaos + flight)
+        for its own process; in-process runs must hand them back even
+        when no chaos plan was armed (install_from_env with an empty env
+        never calls uninstall)."""
+        import argparse
+
+        from horovod_tpu.flight import recorder as flight_recorder
+        from horovod_tpu.runner.elastic.driver import run_elastic_driver
+
+        args = argparse.Namespace(
+            host_discovery_script=None, hosts="localhost:1",
+            command=[sys.executable, "-c", "pass"], min_np=1, max_np=1,
+            np=1, reset_limit=None, start_timeout=30,
+            output_filename=str(tmp_path / "out"))
+        rc = run_elastic_driver(args)
+        assert rc == 0
+        assert injector._role == "worker"
+        assert flight_recorder._role == "worker"
+
+
 class TestDriverHostRemove:
     def test_discovery_window_removes_then_restores(self, monkeypatch):
         """host_remove drops the victim from the discovered set for its

@@ -216,13 +216,24 @@ class TestDumpOnStall:
         insp = StallInspector(warning_secs=0.01)
         try:
             insp.record_enqueue("orphan")
+            # Wait for dump CONTENT, not just the directory: the writer
+            # creates the file before streaming the (possibly large —
+            # the ring is process-global) event body, and reading the
+            # first line mid-write raced on loaded runs.
             deadline = time.monotonic() + 10
-            while time.monotonic() < deadline and not os.path.isdir(d):
-                time.sleep(0.05)
-            names = os.listdir(d) if os.path.isdir(d) else []
-            assert names, "stall warning left no flight dump"
-            rows = [json.loads(line)
-                    for line in open(os.path.join(d, names[0]))]
+            rows = []
+            while time.monotonic() < deadline and not rows:
+                names = os.listdir(d) if os.path.isdir(d) else []
+                if names:
+                    with open(os.path.join(d, names[0])) as f:
+                        for line in f:
+                            try:
+                                rows.append(json.loads(line))
+                            except ValueError:
+                                pass    # torn mid-write line: retry
+                if not rows:
+                    time.sleep(0.05)
+            assert rows, "stall warning left no flight dump"
             assert rows[0]["reason"] == "stall_warning"
             # the stall finding itself is on the ring via record_stall
             assert any(e["kind"] == "stall" and e.get("what") == "warning"
