@@ -1072,8 +1072,12 @@ def _bench_serving_sweep(hvd):
     decode batch, and every cell reports p50/p99 time-to-first-token,
     p50/p99 per-token latency, tokens/sec and peak queue depth as a
     labeled `serving_sweep` record on the HVD_BENCH_PROGRESS_FILE
-    channel (the tunnel-window evidence path). The final BENCH record is
-    the peak tokens/sec across rungs. Single-chip like the spec bench:
+    channel (the tunnel-window evidence path), followed by a
+    `serving_trace` record per rung: mean queue/prefill/decode/stream
+    fractions + coverage from each request's span tree and the SLO
+    burn rates over the rung (bench-local HVD_BENCH_SLO_TTFT_MS
+    objective when no HOROVOD_SLO_* is declared). The final BENCH
+    record is the peak tokens/sec across rungs. Single-chip like the spec bench:
     the decode path is not mesh-sharded. Knobs: HVD_BENCH_SERVING_RATES
     (req/s ladder), HVD_BENCH_SERVING_REQUESTS (per rung),
     HVD_BENCH_SERVING_SLOTS, HVD_BENCH_GENLEN, HVD_BENCH_SERVING_GPT2=1
@@ -1108,6 +1112,21 @@ def _bench_serving_sweep(hvd):
     _mark("serving init done")
     prompts = [[int(t) for t in rng.integers(0, cfg.vocab_size, plen)]
                for _ in range(n_req)]
+
+    # Per-request trace summaries + SLO burn (ISSUE 16): declare a
+    # bench-local SLO when none is configured so every rung's record
+    # carries a burn-rate column (HVD_BENCH_SLO_TTFT_MS / _TPS override).
+    import types
+
+    from horovod_tpu import trace as _trace
+    from horovod_tpu.telemetry import slo as _slo
+    from horovod_tpu.trace import analyze as _trace_analyze
+    if not _slo._get().configured():
+        _slo.configure(types.SimpleNamespace(
+            slo_ttft_p99_ms=float(os.environ.get(
+                "HVD_BENCH_SLO_TTFT_MS", "250")),
+            slo_tps=float(os.environ.get("HVD_BENCH_SLO_TPS", "0")),
+            slo_window_s=300.0))
 
     peak_tps = 0.0
     for rate in rates:
@@ -1148,10 +1167,33 @@ def _bench_serving_sweep(hvd):
             "peak_queue_depth": peak_q,
         }
         _progress_record("serving_sweep", **cell)
+        # Where the rung's latency went: per-request phase fractions
+        # (queue/prefill/decode/stream of each root duration) from the
+        # live span store, plus the window's burn rates — the same
+        # summary `python -m horovod_tpu.trace.analyze` computes from
+        # dumped shards, emitted on the progress channel per rung.
+        summaries = [s for s in (_trace.get(r.tid) for r in reqs)
+                     if s is not None]
+        summaries = [_trace_analyze.summarize(s) for s in summaries]
+        phase_mean = {
+            n: round(float(np.mean([s["fractions"][n]
+                                    for s in summaries])), 4)
+            for n in _trace_analyze.PHASES} if summaries else {}
+        burn = _slo.burn_rates()
+        _progress_record(
+            "serving_trace", rate_rps=rate,
+            requests_traced=len(summaries),
+            mean_fractions=phase_mean,
+            mean_coverage=round(float(np.mean(
+                [s["coverage"] for s in summaries])), 4)
+            if summaries else 0.0,
+            slo_burn=burn,
+            per_request=summaries[:4])
         _mark(f"serving_sweep {rate:g} req/s: ttft p50/p99 "
               f"{cell['ttft_p50_ms']}/{cell['ttft_p99_ms']}ms, "
               f"tok p50/p99 {cell['tok_p50_ms']}/{cell['tok_p99_ms']}ms, "
-              f"{tps:.1f} tok/s, peak queue {peak_q}")
+              f"{tps:.1f} tok/s, peak queue {peak_q}, "
+              f"burn {burn or '{}'}")
     _emit("serving_sweep_peak_tokens_per_sec", round(peak_tps, 1),
           "tokens/sec/chip (continuous-batching engine, peak across the "
           "request-rate ladder)", 0.0)

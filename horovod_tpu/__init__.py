@@ -28,6 +28,7 @@ from horovod_tpu.common.basics import (  # noqa: F401
     metrics_snapshot, metrics_text, cluster_snapshot,
 )
 from horovod_tpu import metrics  # noqa: F401
+from horovod_tpu import trace  # noqa: F401
 from horovod_tpu import flight  # noqa: F401
 from horovod_tpu import profile  # noqa: F401
 from horovod_tpu import telemetry  # noqa: F401

@@ -73,6 +73,13 @@ def snapshot():
         snap["findings"] = list(_watchdog.findings())
     except Exception:  # noqa: BLE001
         snap["findings"] = []
+    try:
+        # Declared-SLO burn (absolute, not a delta: a burn rate is
+        # already windowed) — ROADMAP item 1's resize-on-SLO input.
+        from horovod_tpu.telemetry import slo as _slo
+        snap["slo_burn"] = _slo.burn_rates()
+    except Exception:  # noqa: BLE001
+        snap["slo_burn"] = {}
     return snap
 
 
@@ -119,6 +126,8 @@ class SignalFrame(dict):
     - ``health_counts``       live telemetry state counts (absolute)
     - ``unhealthy``           {rank: {"state", "why"}} non-healthy ranks
     - ``straggler_namings``   {rank: count} new watchdog namings
+    - ``slo_burn``            {objective: burn} declared-SLO burn rates
+                              (absolute; {} when no SLO is declared)
     """
 
 
@@ -197,6 +206,8 @@ def frame(prev, cur, cluster_view=None):
         if r is not None:
             namings[int(r)] = namings.get(int(r), 0) + 1
     f["straggler_namings"] = namings
+
+    f["slo_burn"] = dict(cur.get("slo_burn", {}))
 
     f["health_counts"] = {}
     f["unhealthy"] = {}

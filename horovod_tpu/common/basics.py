@@ -108,6 +108,14 @@ def init(comm=None, process_sets=None, devices=None):
         from horovod_tpu.profile import ledger as _profile_ledger
         _profile_ledger.configure(config)
 
+        # Request/step tracing + declared SLOs: armed next to the flight
+        # recorder (the trace store survives re-init for the same reason
+        # the ring does — a requeued request's spans ARE its history).
+        from horovod_tpu import trace as _trace
+        _trace.configure(config)
+        from horovod_tpu.telemetry import slo as _slo
+        _slo.configure(config)
+
         # Decide on distributed bootstrap from the env alone: probing
         # jax.process_count() here would initialize the local backend and
         # forbid jax.distributed.initialize afterwards.
@@ -557,9 +565,29 @@ def shutdown():
             _profile_capture.shutdown()
         except Exception:  # noqa: BLE001 — profiling must not block exit
             pass
+        trace_dir = _state.config.trace_dir
+        t = _state.topology
+        trace_rank = t.local_device_ranks[0] if t.local_device_ranks \
+            else 0
         from horovod_tpu.common import negotiation
         negotiation.reset()
         _state = None
+    # Trace shard: a configured HOROVOD_TRACE_DIR gets this process's
+    # span store on the way out (trace_r<rank>.json, merged by
+    # `python -m horovod_tpu.trace.analyze`) — written AFTER releasing
+    # the state lock: a dump is file I/O and must not sit in the
+    # critical section (the PR-5 signal-handler deadlock class).
+    if trace_dir:
+        try:
+            import os as _os
+
+            from horovod_tpu import trace as _trace
+            _os.makedirs(trace_dir, exist_ok=True)
+            _trace.dump(_os.path.join(trace_dir,
+                                      f"trace_r{trace_rank}.json"),
+                        rank=trace_rank)
+        except Exception:  # noqa: BLE001 — must not block exit
+            pass
 
 
 def is_initialized():

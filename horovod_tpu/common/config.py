@@ -358,6 +358,28 @@ class Config:
     # disruption replays at most this many tokens per in-flight request).
     serving_commit_steps: int = 1
 
+    # --- request tracing + SLO burn rate (horovod_tpu/trace,
+    # telemetry/slo.py; docs/observability.md) ---
+    # Request/step-level span tracing: every serving request carries a
+    # trace id from admission through requeue to completion, read live
+    # at GET /debug/trace/<rid>; training steps trace negotiation /
+    # flush / cross_wait spans. Always-on like the flight recorder (the
+    # perf guard bounds the dispatch host cost at <= 2x tracing-off).
+    trace: bool = True
+    # Live request traces kept per process (bounded store; step traces
+    # have their own smaller cap).
+    trace_capacity: int = 256
+    # Directory for per-rank trace shard dumps ("" = no dumps): the
+    # serving frontend writes trace_r<rank>.json on stop, merged by
+    # `python -m horovod_tpu.trace.analyze`.
+    trace_dir: str = ""
+    # Declared SLO objectives (0 = not declared): p99 TTFT target in ms
+    # and a generated-tokens/sec floor. Burn rates are computed over
+    # slo_window_s and exported as slo_burn_rate{objective}.
+    slo_ttft_p99_ms: float = 0.0
+    slo_tps: float = 0.0
+    slo_window_s: float = 60.0
+
     # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
     # the reference's observability stops at timeline + stall inspector).
     # Always-on by default: the registry hot path is O(1) and lock-light
@@ -398,6 +420,19 @@ class Config:
             raise ValueError(
                 f"control_plane={self.control_plane!r}: flat, hier, or "
                 "empty (auto: hier when the slice layout has >1 slice)")
+        if self.trace_capacity < 1:
+            raise ValueError(
+                f"trace_capacity={self.trace_capacity}: need >= 1 (the "
+                "trace store must hold at least the request being read)")
+        if self.slo_ttft_p99_ms < 0.0 or self.slo_tps < 0.0:
+            raise ValueError(
+                f"SLO targets must be >= 0 (0 = not declared), got "
+                f"slo_ttft_p99_ms={self.slo_ttft_p99_ms}, "
+                f"slo_tps={self.slo_tps}")
+        if self.slo_window_s <= 0.0:
+            raise ValueError(
+                f"slo_window_s={self.slo_window_s}: the burn-rate "
+                "window must be positive")
 
     @classmethod
     def from_env(cls):
@@ -572,6 +607,15 @@ class Config:
                                          c.serving_model)
         c.serving_commit_steps = _env_int("HOROVOD_SERVING_COMMIT_STEPS",
                                           c.serving_commit_steps)
+        c.trace = _env_bool("HOROVOD_TRACE", c.trace)
+        c.trace_capacity = _env_int("HOROVOD_TRACE_CAPACITY",
+                                    c.trace_capacity)
+        c.trace_dir = os.environ.get("HOROVOD_TRACE_DIR", c.trace_dir)
+        c.slo_ttft_p99_ms = _env_float("HOROVOD_SLO_TTFT_P99_MS",
+                                       c.slo_ttft_p99_ms)
+        c.slo_tps = _env_float("HOROVOD_SLO_TPS", c.slo_tps)
+        c.slo_window_s = _env_float("HOROVOD_SLO_WINDOW_S",
+                                    c.slo_window_s)
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
         c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
         c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",

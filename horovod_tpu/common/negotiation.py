@@ -24,6 +24,7 @@ import time
 
 import jax
 
+from horovod_tpu import trace as _trace
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.metrics import instruments as _metrics
@@ -185,6 +186,7 @@ def exchange(tag, payload, procs=None):
     # Step-profiler bracket: the whole round — publish + blocking peer
     # reads — is control-plane time in the step attribution.
     t_cp = time.perf_counter() if _profile.armed else None
+    t_round = time.time()
     client = _client()
     if _chaos.armed:
         # Chaos site: a delay here stalls this rank's publish, making every
@@ -234,6 +236,12 @@ def exchange(tag, payload, procs=None):
             groups)
     if t_cp is not None:
         _profile.record_control_plane(time.perf_counter() - t_cp)
+    # Negotiation-round span under the active step trace: the whole
+    # publish + blocking-peer-read round, tagged so a slow round in the
+    # merged Perfetto view names its exchange.
+    _trace.add_span(_trace.get_active(), "negotiation", t_round,
+                    time.time() - t_round, cat="train",
+                    args={"tag": tag, "seq": seq})
     return out
 
 

@@ -451,8 +451,52 @@ def analyze(events, metas=(), driver_marks=()):
         "chaos": analyze_chaos(events),
         "driver_disruptions": list(driver_marks),
         "autopilot": analyze_autopilot(events, driver_marks),
+        "traces": analyze_traces(events),
     }
     return report
+
+
+def analyze_traces(events):
+    """Reconstruct one request/step per trace ref across ranks: every
+    ring event now carries the ACTIVE trace id (``trace``), so grouping
+    by it recovers which ranks touched a request (or which collectives a
+    training step dispatched) without any per-request logging. Keyed
+    summaries only — the span trees themselves live in the trace shards
+    (``python -m horovod_tpu.trace.analyze``)."""
+    by_trace = {}
+    for e in events:
+        tid = e.get("trace")
+        if tid is None:
+            continue
+        rec = by_trace.setdefault(tid, {
+            "trace": tid, "ranks": set(), "events": 0, "kinds": {},
+            "t_first": e.get("t"), "t_last": e.get("t"), "seq_span": {}})
+        rec["ranks"].add(e.get("rank"))
+        rec["events"] += 1
+        rec["kinds"][e["kind"]] = rec["kinds"].get(e["kind"], 0) + 1
+        t = e.get("t")
+        if t is not None:
+            rec["t_first"] = t if rec["t_first"] is None \
+                else min(rec["t_first"], t)
+            rec["t_last"] = t if rec["t_last"] is None \
+                else max(rec["t_last"], t)
+        # Per-process-set collective seq window: the cross-rank join key
+        # (a desync inside one request shows as unequal windows).
+        if e["kind"] in ("dispatch", "complete") and e.get("seq") \
+                is not None:
+            ps = e.get("ps")
+            lo, hi = rec["seq_span"].get(ps, (e["seq"], e["seq"]))
+            rec["seq_span"][ps] = (min(lo, e["seq"]), max(hi, e["seq"]))
+    out = []
+    for tid in sorted(by_trace):
+        rec = by_trace[tid]
+        rec["ranks"] = sorted(r for r in rec["ranks"] if r is not None)
+        rec["seq_span"] = {str(ps): list(span)
+                           for ps, span in rec["seq_span"].items()}
+        if rec["t_first"] is not None and rec["t_last"] is not None:
+            rec["span_s"] = round(rec["t_last"] - rec["t_first"], 6)
+        out.append(rec)
+    return out
 
 
 def write_trace(events, path):
@@ -483,7 +527,8 @@ def write_trace(events, path):
                 "ph": "X", "pid": rank, "tid": 0, "cat": "collective",
                 "name": f"{e.get('op', '?')}#{e.get('seq', '?')}",
                 "ts": ts_us - dur_us, "dur": dur_us,
-                "args": {k: e[k] for k in ("ps", "sig") if k in e}})
+                "args": {k: e[k] for k in ("ps", "sig", "trace")
+                         if k in e}})
         elif e["kind"] == "dispatch":
             # Matched dispatches ride their complete's span; an UNMATCHED
             # one is the wedged collective the post-mortem is after —
@@ -495,15 +540,16 @@ def write_trace(events, path):
                 "cat": "collective",
                 "name": f"unfinished:{e.get('op', '?')}#{e.get('seq', '?')}",
                 "ts": ts_us,
-                "args": {k: e[k] for k in ("ps", "sig") if k in e}})
+                "args": {k: e[k] for k in ("ps", "sig", "trace")
+                         if k in e}})
         else:
             trace_events.append({
                 "ph": "i", "s": "p", "pid": rank, "tid": 0,
                 "cat": e["kind"], "ts": ts_us,
                 "name": f"{e['kind']}:"
                         f"{e.get('what') or e.get('name') or e.get('seq')}",
-                "args": {k: e[k] for k in ("op", "seq", "what", "name")
-                         if k in e}})
+                "args": {k: e[k] for k in ("op", "seq", "what", "name",
+                                           "trace") if k in e}})
     with open(path, "w") as f:
         json.dump({"traceEvents": trace_events, "displayTimeUnit": "ms"}, f)
     return len(trace_events)

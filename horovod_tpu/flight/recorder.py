@@ -63,6 +63,11 @@ import zlib
 # import-time arming and init-time configure() must read
 # HOROVOD_FLIGHT_RECORDER identically.
 from horovod_tpu.common.config import _env_bool, _env_int
+# Trace-context injection (no cycle: trace imports only common.config).
+# Every ring event carries the ACTIVE trace ref, so flight.analyze can
+# reconstruct one request/step across ranks keyed by the per-process-set
+# collective seq.
+from horovod_tpu import trace as _trace
 
 DEFAULT_CAPACITY = 4096
 DEFAULT_DUMP_DIR = "flight_dumps"
@@ -88,10 +93,10 @@ _BOOT = format(int(time.time() * 1e6) & 0xffffffff, "08x")
 
 # Slot layout (fixed-length lists, preallocated):
 _F_TS, _F_KIND, _F_OP, _F_PS, _F_SEQ, _F_BYTES, _F_SIG, _F_NAME, _F_DUR, \
-    _F_WHAT = range(10)
-_N_FIELDS = 10
+    _F_WHAT, _F_TRACE = range(11)
+_N_FIELDS = 11
 _KEYS = ("t", "kind", "op", "ps", "seq", "bytes", "sig", "name", "dur",
-         "what")
+         "what", "trace")
 
 
 def _env_capacity():
@@ -163,6 +168,7 @@ class FlightRecorder:
             s[_F_NAME] = name
             s[_F_DUR] = None
             s[_F_WHAT] = None
+            s[_F_TRACE] = _trace.get_active()
             s[_F_KIND] = "dispatch"
         return seq
 
@@ -172,7 +178,7 @@ class FlightRecorder:
         self.record_event("complete", op=op, ps=ps, seq=seq, dur=dur)
 
     def record_event(self, kind, op=None, ps=None, seq=None, nbytes=None,
-                     sig=None, name=None, dur=None, what=None):
+                     sig=None, name=None, dur=None, what=None, trace=None):
         with self._lock:
             s = self._slots[self._idx % self.capacity]
             s[_F_KIND] = None       # commit marker: see record_dispatch
@@ -186,6 +192,11 @@ class FlightRecorder:
             s[_F_NAME] = name
             s[_F_DUR] = dur
             s[_F_WHAT] = what
+            # Explicit ref (the serving engine passes its request's tid —
+            # handler threads never hold the active ref) beats the
+            # thread-local active trace.
+            s[_F_TRACE] = trace if trace is not None \
+                else _trace.get_active()
             s[_F_KIND] = kind
 
     # --- reading -------------------------------------------------------
@@ -367,13 +378,19 @@ def step_marker(step=None):
         # Tagged so analyzers can drop auto marks when explicit ones
         # exist: under torch+elastic the optimizer's auto mark for step 1
         # lands BEFORE the first commit sets saw_explicit_step.
-        r.record_event("step", seq=r.next_auto_step(), what="auto")
+        seq = r.next_auto_step()
+        # Rotate the per-step training trace BEFORE recording the marker,
+        # so the step event itself (and every ops-layer span/dispatch
+        # until the next marker) lands under the NEW step's trace.
+        _trace.step_trace(seq)
+        r.record_event("step", seq=seq, what="auto")
     else:
         try:
             step = int(step)
         except (TypeError, ValueError):
             return          # forensics must never fail the job
         r.saw_explicit_step = True
+        _trace.step_trace(step)
         r.record_event("step", seq=step)
 
 

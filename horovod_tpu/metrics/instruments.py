@@ -55,6 +55,11 @@ Catalogue (names shown without the ``HOROVOD_METRICS_PREFIX``, default
 - ``serving_batch_fill_ratio``                      active slots / total
   slots per decode step (histogram; low values mean the fleet is
   over-provisioned or admission is starved)
+- ``slo_burn_rate{objective}``                      rolling-window SLO
+  error-budget burn (objective=ttft_p99|tps; gauge — 0 inside the SLO,
+  1.0 = consuming the budget exactly, > 1 = burning; computed by
+  horovod_tpu/telemetry/slo.py from the declared
+  HOROVOD_SLO_TTFT_P99_MS / HOROVOD_SLO_TPS objectives)
 - ``autopilot_decisions_total{lever,outcome}``      autopilot control
   decisions (lever=tuner|overlap|cross_wire|remediate; counter)
 - ``autopilot_remediations_total{cause,outcome}``   autopilot-initiated
@@ -252,6 +257,14 @@ SERVING_QUEUE_DEPTH = REGISTRY.gauge(
     "Requests waiting for a slot in the serving admission queue "
     "(sampled at every submit/admit; the first thing to read when "
     "requests time out — docs/troubleshooting.md).")
+SLO_BURN = REGISTRY.gauge(
+    "slo_burn_rate",
+    "Rolling-window SLO error-budget burn per declared objective "
+    "(objective=ttft_p99|tps; horovod_tpu/telemetry/slo.py). 0 = inside "
+    "the SLO, 1.0 = consuming the error budget exactly, > 1 = burning "
+    "it — the admission/scale-up signal the autopilot SignalFrame and "
+    "`telemetry top --serving` read.",
+    ("objective",))
 SERVING_FILL = REGISTRY.histogram(
     "serving_batch_fill_ratio",
     "Active slots / total slots at each decode step (1.0 = the "
@@ -564,6 +577,14 @@ def record_serving_queue(depth):
     if not _enabled:
         return
     SERVING_QUEUE_DEPTH.set(depth)
+
+
+def record_slo_burn(objective, burn):
+    """Current burn rate of one declared SLO objective (set by
+    telemetry/slo.py whenever a window is recomputed)."""
+    if not _enabled:
+        return
+    SLO_BURN.labels(objective).set(float(burn))
 
 
 def record_autopilot_decision(lever, outcome):

@@ -35,6 +35,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
 
+from horovod_tpu import trace as _trace
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.flight import recorder as _flight
@@ -314,6 +315,12 @@ def _timeline_op(name, op_kind, tensors=(), process_set=None,
             hvd_metrics.record_collective_latency(op_label, dur)
         if flight_on:
             _flight.record_complete(op_label, ps_label, fl_seq, dur)
+            # Dispatch span under the ACTIVE step trace (rotated by
+            # step_marker); correlates with the flight ring via the seq
+            # the dispatch event carries.
+            _trace.add_span(_trace.get_active(), "dispatch",
+                            time.time() - dur, dur, cat="train",
+                            args={"op": op_label, "seq": fl_seq})
         if profile_on:
             # dur covers the program call (+ localize on the caller side
             # of the yield) = `collective`; everything else between the

@@ -31,6 +31,7 @@ import numpy as np
 from jax import lax
 from jax.sharding import PartitionSpec as P
 
+from horovod_tpu import trace as _trace
 from horovod_tpu.chaos import injector as _chaos
 from horovod_tpu.common import basics
 from horovod_tpu.common.topology import HVD_AXIS
@@ -836,12 +837,16 @@ class FusionRuntime:
         if not inflight:
             return
         t0 = time.perf_counter()
+        t0_wall = time.time()
         for outs in inflight:
             try:
                 jax.block_until_ready(outs)
             except Exception:  # noqa: BLE001 — failures already reached
                 pass           # the bucket's handles at dispatch
-        _profile.record_cross_wait(time.perf_counter() - t0)
+        dt = time.perf_counter() - t0
+        _profile.record_cross_wait(dt)
+        _trace.add_span(_trace.get_active(), "cross_wait", t0_wall, dt,
+                        cat="train", args={"buckets": len(inflight)})
 
     def _steer_overlap(self):
         """Per-flush overlap steering from the step profiler's
@@ -1125,6 +1130,7 @@ class FusionRuntime:
         coordinator flushed when it published that boundary."""
         if not self._pending:
             return
+        t_flush_wall = time.time()
         # Step-profiler bracket: the flush's wall time minus the fused
         # program dispatches recorded inside it (they book under
         # `collective` via _timeline_op) is the fusion runtime's own
@@ -1473,6 +1479,11 @@ class FusionRuntime:
         tl = basics.timeline()
         if tl is not None:
             hvd_metrics.maybe_emit_timeline_counters(tl)
+        # Whole-flush span under the active step trace (the per-bucket
+        # dispatch spans above nest beside it in the same tree).
+        _trace.add_span(_trace.get_active(), "fusion_flush", t_flush_wall,
+                        time.time() - t_flush_wall, cat="train",
+                        args={"n": len(pending), "bytes": flushed_bytes})
 
 
 class GroupedFusedHandle:

@@ -79,6 +79,9 @@ _ARG_ENV_MAP = [
     ("serving_port", "HOROVOD_SERVING_PORT", str),
     ("serving_slots", "HOROVOD_SERVING_SLOTS", str),
     ("serving_queue_limit", "HOROVOD_SERVING_QUEUE_LIMIT", str),
+    ("trace", "HOROVOD_TRACE", lambda v: "1" if v else None),
+    ("no_trace", "HOROVOD_TRACE", lambda v: "0" if v else None),
+    ("trace_dir", "HOROVOD_TRACE_DIR", str),
 ]
 
 

@@ -188,6 +188,23 @@ def parse_args(argv=None):
                                 "(HOROVOD_AUTOPILOT_INTERVAL, "
                                 "default 10).")
 
+    tracing = p.add_argument_group("tracing")
+    tracing.add_argument("--trace", action="store_true", dest="trace",
+                         default=False,
+                         help="Arm request/step span tracing "
+                              "(HOROVOD_TRACE=1; the default — this flag "
+                              "overrides an ambient env opt-out). Serving "
+                              "requests expose their span tree at "
+                              "GET /debug/trace/<rid>; merge per-rank "
+                              "shards with `python -m "
+                              "horovod_tpu.trace.analyze`.")
+    tracing.add_argument("--no-trace", action="store_true",
+                         dest="no_trace",
+                         help="Disarm tracing (HOROVOD_TRACE=0).")
+    tracing.add_argument("--trace-dir", dest="trace_dir",
+                         help="Directory for per-rank trace shard dumps "
+                              "(HOROVOD_TRACE_DIR).")
+
     timeline = p.add_argument_group("timeline")
     timeline.add_argument("--timeline-filename", dest="timeline_filename")
     timeline.add_argument("--no-timeline-mark-cycles", action="store_false",
@@ -480,6 +497,10 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_SERVING_QUEUE_LIMIT",
                 "HOROVOD_SERVING_MIGRATE_KV", "HOROVOD_SERVING_MODEL",
                 "HOROVOD_SERVING_COMMIT_STEPS",
+                "HOROVOD_TRACE", "HOROVOD_TRACE_CAPACITY",
+                "HOROVOD_TRACE_DIR",
+                "HOROVOD_SLO_TTFT_P99_MS", "HOROVOD_SLO_TPS",
+                "HOROVOD_SLO_WINDOW_S",
                 "HOROVOD_METRICS", "HOROVOD_METRICS_PORT",
                 "HOROVOD_METRICS_ADDR", "HOROVOD_METRICS_PREFIX"):
         if os.environ.get(var):

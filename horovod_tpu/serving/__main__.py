@@ -16,6 +16,7 @@ and the SLO series; under ``HOROVOD_ELASTIC`` the engine state rides a
 drop zero in-flight requests.
 """
 
+import signal
 import sys
 import time
 
@@ -50,6 +51,14 @@ def main():
     from horovod_tpu.serving.server import ServingFrontend
 
     hvd.init()
+    # SIGTERM (how hvdrun's elastic driver and any orchestrator stop a
+    # worker) must unwind like Ctrl-C: only fe.stop() persists the
+    # HOROVOD_TRACE_DIR shard, and the default disposition skips it. The
+    # 5 s terminate→kill escalation in runner/exec.py bounds the drain.
+    def _term(signum, frame):
+        raise KeyboardInterrupt
+
+    signal.signal(signal.SIGTERM, _term)
     cfg = Config.from_env()
     name = cfg.serving_model
     max_len = cfg.serving_max_len or 256
@@ -97,7 +106,10 @@ def main():
                         state.commit()
                         last_commit = now
 
-        serve(state)
+        try:
+            serve(state)
+        except KeyboardInterrupt:
+            fe.stop()
         return 0
     try:
         while True:

@@ -40,10 +40,12 @@ import time
 
 import numpy as np
 
+from horovod_tpu import trace
 from horovod_tpu.flight import recorder as _flight
 from horovod_tpu.metrics import instruments as _metrics
 from horovod_tpu.serving.request import Request
 from horovod_tpu.serving.scheduler import SlotScheduler
+from horovod_tpu.telemetry import slo as _slo
 
 # The newest engine, for the /serving/health endpoint and telemetry gate.
 _current = None
@@ -224,7 +226,12 @@ class ServingEngine:
             # Registered only once actually queued: rejected requests
             # must not pin their prompt in the live registry forever.
             self._requests[req.rid] = req
-        _flight.record_event("serving", what="submit", name=f"r{req.rid}")
+        # Root of the request's span tree: admission wall time. Rejected
+        # requests never register — the trace store holds queued work.
+        trace.register(req.tid, rid=req.rid, t0=req.t_wall)
+        trace.add_instant(req.tid, "submit", t=req.t_wall, cat="serving")
+        _flight.record_event("serving", what="submit", name=f"r{req.rid}",
+                             trace=req.tid)
         return req
 
     # --- the serve loop ---------------------------------------------------
@@ -239,14 +246,25 @@ class ServingEngine:
         end = P - 1                       # last token is the decode input
         small = self._small_zero          # reusable zero template: the
         c = self.prefill_chunk            # un-donated feed never mutates it
+        # Close the CURRENT incarnation's queue phase: t_queued restarts
+        # at submit and at every requeue, so the span tree shows one
+        # queue span per incarnation (before and after an elastic kill).
+        now = time.time()
+        trace.add_span(req.tid, "queue", t0=req.t_queued,
+                       dur=max(now - req.t_queued, 0.0), cat="serving",
+                       args={"slot": slot})
         t = 0
         while t < end:
             s = min(c, end - t)           # exact remainder: no pad rows
             chunk = jnp.asarray([toks[t:t + s]], jnp.int32)
-            small = self._prefill_fn(self.params, small, chunk, t)
+            with trace.span("chunk", parent="prefill", cat="serving",
+                            tid=req.tid):
+                small = self._prefill_fn(self.params, small, chunk, t)
             t += s
-        self._cache = self._install_fn(self._cache, small,
-                                       np.int32(slot))
+        with trace.span("install", parent="prefill", cat="serving",
+                        tid=req.tid):
+            self._cache = self._install_fn(self._cache, small,
+                                           np.int32(slot))
         self._tokens[slot] = toks[-1]
         self._pos[slot] = P - 1
         # A rollback always empties the slot table before invalidating,
@@ -256,7 +274,7 @@ class ServingEngine:
         # forever).
         self._cache_valid = True
         _flight.record_event("serving", what="admit", name=f"r{req.rid}",
-                             seq=slot)
+                             seq=slot, trace=req.tid)
 
     def step(self):
         """One engine iteration: admit + prefill free slots, then one
@@ -275,15 +293,24 @@ class ServingEngine:
             jnp.asarray(self._pos))
         logits_np = np.asarray(logits)        # device sync
         dt = time.perf_counter() - t0
+        # One decode_step span per batched slot, sharing the step's wall
+        # window — the synthesized "decode" phase of each request's tree
+        # is the envelope of its decode_step children, so the phase
+        # covers the whole resident-in-batch stretch, gaps included.
+        t_wall = time.time() - dt
         committed = 0
         for slot, req in active.items():
+            trace.add_span(req.tid, "decode_step", t0=t_wall, dur=dt,
+                           parent="decode", cat="serving")
             tok = sample_token(logits_np[slot], req.temperature,
                                req.top_k, req.top_p, req.seed,
                                len(req.committed))
             first = not req.committed
             finished = req.commit_token(tok)
             if first:
-                _metrics.record_serving_ttft(req.t_first - req.t_submit)
+                ttft = req.t_first - req.t_submit
+                _metrics.record_serving_ttft(ttft)
+                _slo.observe_ttft(ttft)
             self._tokens[slot] = tok
             self._pos[slot] += 1
             committed += 1
@@ -300,11 +327,21 @@ class ServingEngine:
                 self._requests.pop(req.rid, None)
                 self._served += 1
                 _metrics.record_serving_request("completed")
+                # Terminal stream phase (final-token delivery: host
+                # sampling + future resolution), then close the root —
+                # the trace's duration is the request's true wall time.
+                t_end = t_wall + dt
+                trace.add_span(req.tid, "stream", t0=t_end,
+                               dur=max(time.time() - t_end, 0.0),
+                               cat="serving")
+                trace.finish(req.tid)
                 _flight.record_event("serving", what="complete",
                                      name=f"r{req.rid}",
-                                     dur=req.t_done - req.t_submit)
+                                     dur=req.t_done - req.t_submit,
+                                     trace=req.tid)
         _metrics.record_serving_step(dt, len(active), self.num_slots,
                                      committed)
+        _slo.observe_tokens(committed)
         self._step_count += 1
         if self.mark_steps:
             _flight.step_marker(self._step_count)
@@ -343,6 +380,11 @@ class ServingEngine:
         """Picklable request-level state: active slots first (they re-admit
         ahead of the queue — FIFO completion order survives), then the
         queue, oldest first."""
+        for req in self._sched.active().values():
+            # Commit marker (NOT a barrier: it must not break the decode
+            # phase chain); the span cap bounds a long decode's markers.
+            trace.add_instant(req.tid, "commit", cat="elastic",
+                              args={"committed": len(req.committed)})
         return {
             "active": [self._sched.active()[s].snapshot()
                        for s in sorted(self._sched.active())],
@@ -407,11 +449,15 @@ class ServingEngine:
             else:
                 register = True
             if req is None:
+                # The snapshot's tid keeps the trace ONE contiguous tree
+                # across the kill: the restored request re-registers
+                # under the id minted at original admission (idempotent —
+                # spans recorded before the disruption survive).
                 req = Request(rs["prompt"], rs["max_new"],
                               temperature=rs["temperature"],
                               top_k=rs["top_k"], top_p=rs["top_p"],
                               eos_id=rs["eos_id"], seed=rs["seed"],
-                              rid=rs["rid"])
+                              rid=rs["rid"], tid=rs.get("tid"))
                 if register:
                     self._requests[req.rid] = req
             req.restore_committed(rs["committed"])
@@ -420,11 +466,23 @@ class ServingEngine:
             # sync) — a replay of the same snapshot must never roll the
             # disruption accounting back.
             req.requeues = max(req.requeues, int(rs.get("requeues", 0)))
+            req.t_queued = time.time()
+            trace.register(req.tid, rid=req.rid, t0=rs.get("t0"))
             if req.rid in was_active:
                 req.requeues += 1
                 _metrics.record_serving_request("requeued")
+                # Barrier instant: spans after it open a FRESH incarnation
+                # of their phase (queue/prefill again) instead of nesting
+                # under the pre-kill one.
+                trace.add_instant(req.tid, "requeue", cat="elastic",
+                                  barrier=True,
+                                  args={"committed": len(req.committed),
+                                        "requeues": req.requeues})
                 _flight.record_event("serving", what="requeue",
-                                     name=f"r{req.rid}")
+                                     name=f"r{req.rid}", trace=req.tid)
+            else:
+                trace.add_instant(req.tid, "restore", cat="elastic",
+                                  barrier=True)
             self._sched.enqueue_restored(req)
         for req in later:
             self._sched.enqueue_restored(req)
@@ -487,8 +545,11 @@ class ServingEngine:
 
     def _evict_all(self):
         for req in self._sched.evict_active():
+            req.t_queued = time.time()
+            trace.add_instant(req.tid, "requeue", cat="elastic",
+                              barrier=True)
             _flight.record_event("serving", what="requeue",
-                                 name=f"r{req.rid}")
+                                 name=f"r{req.rid}", trace=req.tid)
         self._pos[:] = 0
         self._tokens[:] = 0
 
@@ -518,4 +579,7 @@ class ServingEngine:
             "saturated": bool(self._sched.queue_limit
                               and self._sched.queue_depth()
                               >= self._sched.queue_limit),
+            # {} unless SLO objectives are declared (HOROVOD_SLO_*); the
+            # read also refreshes the slo_burn_rate{objective} gauges.
+            "slo": _slo.burn_rates(),
         }

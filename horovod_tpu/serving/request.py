@@ -20,6 +20,8 @@ import itertools
 import threading
 import time
 
+from horovod_tpu import trace
+
 QUEUED = "queued"
 ACTIVE = "active"
 DONE = "done"
@@ -40,7 +42,7 @@ class Request:
     """
 
     def __init__(self, prompt, max_new, temperature=0.0, top_k=0,
-                 top_p=1.0, eos_id=None, seed=0, rid=None):
+                 top_p=1.0, eos_id=None, seed=0, rid=None, tid=None):
         prompt = [int(t) for t in prompt]
         if not prompt:
             raise ValueError("empty prompt")
@@ -52,6 +54,10 @@ class Request:
             raise ValueError(f"need top_k >= 0 and 0 < top_p <= 1, got "
                              f"top_k={top_k}, top_p={top_p}")
         self.rid = rid if rid is not None else next(_rid_counter)
+        # Trace id: minted at admission, carried through every elastic
+        # snapshot/restore so the span tree stays ONE trace across
+        # disruptions (horovod_tpu/trace).
+        self.tid = tid if tid is not None else trace.mint("request")
         self.prompt = prompt
         self.max_new = int(max_new)
         self.temperature = float(temperature)
@@ -65,6 +71,12 @@ class Request:
         self.t_submit = time.monotonic()
         self.t_first = None          # first generated token commit
         self.t_done = None
+        # Wall-clock twins for the trace layer: monotonic stamps cannot
+        # cross processes, and the span tree is wall-time based. t_queued
+        # restarts at every (re)entry into the admission queue — it is
+        # the start of the CURRENT incarnation's queue span.
+        self.t_wall = time.time()
+        self.t_queued = self.t_wall
         self._done = threading.Event()
 
     # --- engine-side transitions ---------------------------------------
@@ -132,7 +144,8 @@ class Request:
     def snapshot(self):
         """Picklable state for an elastic commit (threading.Event and
         timestamps stay process-local)."""
-        return {"rid": self.rid, "prompt": list(self.prompt),
+        return {"rid": self.rid, "tid": self.tid, "t0": self.t_wall,
+                "prompt": list(self.prompt),
                 "max_new": self.max_new, "temperature": self.temperature,
                 "top_k": self.top_k, "top_p": self.top_p,
                 "eos_id": self.eos_id, "seed": self.seed,

@@ -6,7 +6,10 @@ endpoint and the runner KV store: ``POST /generate`` with
 "top_p": p, "eos_id": e, "seed": s}`` blocks until the request
 completes and answers ``{"rid", "tokens", "generated", "ttft_s"}``;
 ``GET /health`` returns the engine snapshot (503 + ``Retry-After`` when
-the queue is saturated — load balancers read this as backpressure).
+the queue is saturated — load balancers read this as backpressure);
+``GET /debug/trace/<rid>`` returns the request's live span tree (queue /
+prefill / decode / stream phases, requeue/restore markers — see
+``horovod_tpu/trace`` and docs/troubleshooting.md's latency runbook).
 
 A background drive thread owns every device interaction
 (:meth:`ServingEngine.step`); handler threads only enqueue and wait on
@@ -42,6 +45,17 @@ class _Handler(BaseHTTPRequestHandler):
         self.wfile.write(data)
 
     def do_GET(self):
+        if self.path.startswith("/debug/trace/"):
+            from horovod_tpu import trace
+            rid = self.path[len("/debug/trace/"):]
+            tree = trace.tree_for_rid(rid)
+            if tree is None:
+                # Unknown OR already evicted from the bounded store —
+                # the rid in the body tells the caller which id missed.
+                self._send({"error": "no trace", "rid": rid}, code=404)
+                return
+            self._send(tree)
+            return
         if self.path not in ("/health", "/serving/health"):
             self._send({"error": "not found"}, code=404)
             return
@@ -90,6 +104,7 @@ class _Handler(BaseHTTPRequestHandler):
             return
         self._send({
             "rid": req.rid,
+            "tid": req.tid,
             "tokens": [int(t) for t in tokens],
             "generated": len(req.committed),
             "ttft_s": None if req.t_first is None
@@ -149,3 +164,18 @@ class ServingFrontend:
         for t in self._threads:
             t.join(timeout=5)
         self._threads = []
+        # Persist this process's request traces when a dump dir is
+        # configured (trace_r<rank>.json, merged by
+        # `python -m horovod_tpu.trace.analyze`): the live
+        # /debug/trace/<rid> store dies with the frontend.
+        import os
+        trace_dir = os.environ.get("HOROVOD_TRACE_DIR", "")
+        if trace_dir:
+            try:
+                from horovod_tpu import trace
+                rank = int(os.environ.get("HOROVOD_RANK", "0") or 0)
+                os.makedirs(trace_dir, exist_ok=True)
+                trace.dump(os.path.join(trace_dir,
+                                        f"trace_r{rank}.json"), rank=rank)
+            except Exception:  # noqa: BLE001 — dumps must not block stop
+                pass

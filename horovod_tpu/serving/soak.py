@@ -13,7 +13,12 @@ the zero-drop invariants:
 3. elastic resets stay within the plan's kill budget (no flapping),
 4. the flight-recorder dumps localize each kill: the victim's rank, the
    first unmatched heartbeat-collective sequence number, and the
-   causing injection (:func:`chaos.soak._assert_flight_forensics`).
+   causing injection (:func:`chaos.soak._assert_flight_forensics`),
+5. trace continuity: every request's span tree is one contiguous trace
+   id from admission through requeue-from-committed-tokens to
+   completion, with the requeue/restore barrier markers present
+   (horovod_tpu/trace; the mid-flight-kill steps make this a real
+   through-the-disruption check, not a clean-path one).
 
 The heartbeat allreduce is not test scaffolding only: serving fleets
 exchange load/SLO accounting the same way, and it is what makes every
@@ -103,6 +108,24 @@ def serving_soak_worker(n_requests, max_new, slots):
         engine.run_until_idle(commit=commit)
         snap = hvd.metrics_snapshot()
 
+        from horovod_tpu import trace as _trace
+        req_traces = []
+        for r in reqs:
+            rec = _trace.get(r.tid) or {}
+            names = [s["name"] for s in rec.get("spans", ())]
+            req_traces.append({
+                "rid": r.rid, "tid": r.tid,
+                # one contiguous id: the rid still resolves to the tid
+                # minted at original admission, across every kill.
+                "same_tid": _trace.for_rid(r.rid) == r.tid,
+                "done": bool(rec.get("done")),
+                "requeue_marks": names.count("requeue"),
+                "restore_marks": names.count("restore"),
+                "queue_spans": names.count("queue"),
+                "stream_spans": names.count("stream"),
+                "requeues": r.requeues,
+            })
+
         def count(name, labels=None):
             total = 0
             for s in snap.get(name, {}).get("series", ()):
@@ -123,6 +146,7 @@ def serving_soak_worker(n_requests, max_new, slots):
             "requeued_events": count("serving_requests_total",
                                      {"event": "requeued"}),
             "ttft_count": count("serving_ttft_seconds"),
+            "req_traces": req_traces,
             "cluster": _base.wait_cluster_view(),
         }
 
@@ -225,6 +249,22 @@ def run_serving_soak(procs=8, n_requests=10, max_new=5, slots=2,
     # The disruption actually forced requeues on at least one survivor.
     assert any(r["requeued_events"] > 0 or r["requeues"] > 0
                for r in results), results
+    # (5) trace continuity across the kills: every request's span tree
+    # is ONE contiguous trace — the rid resolves to the id minted at
+    # original admission and the root closed — and at least one
+    # mid-flight victim's request shows the requeue barrier followed by
+    # a fresh queue incarnation under the SAME tid, with restore
+    # markers present on the replayed queued set.
+    for r in results:
+        for t in r["req_traces"]:
+            assert t["same_tid"] and t["done"] and t["stream_spans"] >= 1, \
+                (r["cross_rank"], t)
+    assert any(t["requeue_marks"] > 0 and t["queue_spans"] >= 2
+               for r in results for t in r["req_traces"]), \
+        [r["req_traces"] for r in results]
+    assert any(t["restore_marks"] > 0
+               for r in results for t in r["req_traces"]), \
+        [r["req_traces"] for r in results]
     # Both kills fired, exactly once each.
     from horovod_tpu.chaos import injector
     entries = injector.read_ledger(ledger_dir)

@@ -90,11 +90,19 @@ def render_serving(snap):
     """One-line serving frame appended under the cluster view."""
     if not snap or snap.get("error"):
         return "serving: no engine answered"
+    # Declared-SLO burn column (absent when no HOROVOD_SLO_* objective
+    # is set): burn >= 1 means the error budget is being consumed at or
+    # beyond its sustainable rate — flagged so the one-shot gate output
+    # is greppable.
+    slo = snap.get("slo") or {}
+    burn = "".join(f"  burn[{obj}]={b:.2f}" + ("!" if b >= 1.0 else "")
+                   for obj, b in sorted(slo.items()))
     return (f"serving: {snap.get('active', 0)}/{snap.get('slots', '?')} "
             f"slots  queue={snap.get('queue_depth', 0)}"
             + (f"/{snap['queue_limit']}" if snap.get("queue_limit") else "")
             + f"  served={snap.get('served', 0)}"
             f"  fill={snap.get('fill_ratio', 0.0):.2f}"
+            + burn
             + ("  SATURATED" if snap.get("saturated") else "")
             + ("" if snap.get("cache_valid", True) else "  CACHE-STALE"))
 

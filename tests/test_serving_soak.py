@@ -40,3 +40,14 @@ class TestServingChaosSoak:
                    for c in flight["causes"])
         assert any(d.get("first_unmatched_seq") is not None
                    for d in flight["desync"].values())
+        # Trace continuity (ISSUE 16): run_serving_soak already asserts
+        # the invariant; re-check the shape of the evidence here so a
+        # soak refactor can't silently drop the leg — one contiguous
+        # trace id per request, closed root, and a mid-flight requeue
+        # barrier followed by a second queue incarnation somewhere.
+        all_traces = [t for r in evidence["results"]
+                      for t in r["req_traces"]]
+        assert len(all_traces) == 10 * len(evidence["results"])
+        assert all(t["same_tid"] and t["done"] for t in all_traces)
+        assert any(t["requeue_marks"] > 0 and t["queue_spans"] >= 2
+                   for t in all_traces)
