@@ -17,6 +17,18 @@ Typical use mirrors ``import horovod.torch as hvd``:
 
 from horovod_tpu.version import __version__  # noqa: F401
 
+# HVD_LOCK_WITNESS=1: swap threading.Lock/RLock for hvdrace's recording
+# proxies BEFORE any package module allocates a lock, so every
+# acquisition edge lands in the witness log
+# (docs/static_analysis.md#concurrency-analysis-hvdrace).
+import os as _os  # noqa: E402
+
+if _os.environ.get("HVD_LOCK_WITNESS", "").strip() in ("1", "true", "on"):
+    from horovod_tpu.analysis import race as _race
+
+    _race.maybe_install_from_env()
+del _os
+
 from horovod_tpu.common import compat as _compat  # noqa: F401  (shims first)
 
 from horovod_tpu.common.basics import (  # noqa: F401

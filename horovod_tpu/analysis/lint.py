@@ -3,11 +3,6 @@
 ``python -m horovod_tpu.analysis.lint [paths...]`` (or ``scripts/lint.py``)
 checks Python sources for the bug classes previous PRs fixed by hand:
 
-- **HVL001 lock-held blocking call** — a collective / KV / network /
-  dump / flush call inside a ``with <lock>:`` block. The runtime's known
-  locks (flight-recorder ring + dump budget, fusion flush, profiler
-  ledger, metrics registry, basics init) are exactly where the PR-5
-  signal-handler deadlock hardening had to move work OUTSIDE the lock.
 - **HVL002 undeclared env knob** — an ``os.environ`` /
   ``_env_bool/int/float`` read of a ``HOROVOD_*``/``HVD_*`` name that
   ``common/config.py::Config`` does not declare. Undeclared knobs are
@@ -25,11 +20,20 @@ checks Python sources for the bug classes previous PRs fixed by hand:
 - **HVL005 non-daemon thread** — ``threading.Thread(...)`` without
   ``daemon=True``: a forgotten thread blocks interpreter exit (the
   elastic teardown wedges the PR-4 soak chased).
-- **HVL006 lock-held sleep** — ``time.sleep`` / ``Event.wait`` /
-  ``.join`` inside a ``with <lock>:`` block: every other participant
-  queues behind the snooze.
+- **HVL007 unpropagated knob** — a knob declared in
+  ``common/config.py::Config`` whose env spelling never appears in the
+  launcher's worker-env plumbing (``runner/launch.py`` +
+  ``runner/config_parser.py``): set on the driver, silently absent on
+  every worker. The inverse of HVL002.
 
-Suppression: ``# hvdlint: disable=HVL001 -- <reason>`` on the offending
+HVL001 (lock-held blocking call) and HVL006 (lock-held sleep) are
+RETIRED: both only saw a hard-coded call list under a syntactically
+visible ``with lock:``. hvdrace's HVR202
+(``python -m horovod_tpu.analysis.race``) subsumes them with a
+call-graph-aware hold analysis that follows the lock across function
+and module boundaries.
+
+Suppression: ``# hvdlint: disable=HVL002 -- <reason>`` on the offending
 line or its enclosing ``with``/``def`` line; the reason is REQUIRED (a
 bare disable is itself reported). ``# hvdlint: skip-file -- <reason>``
 at the top of a file skips it entirely.
@@ -43,15 +47,6 @@ import os
 import re
 import sys
 import time
-
-# Calls that block (or dispatch work that must not run under a lock).
-_BLOCKING_CALLS = frozenset({
-    "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
-    "allgather_ragged", "broadcast", "grouped_broadcast", "reducescatter",
-    "grouped_reducescatter", "alltoall", "barrier", "synchronize",
-    "urlopen", "dump", "wait_for_key", "kv_get", "kv_put", "negotiate",
-})
-_SLEEP_CALLS = frozenset({"sleep"})
 
 _COLLECTIVE_CALLS = frozenset({
     "allreduce", "grouped_allreduce", "allgather", "grouped_allgather",
@@ -88,7 +83,16 @@ _BOOTSTRAP_VARS = frozenset({
 # the single process it runs on — nothing to propagate or document in the
 # runtime knob catalogue). HOROVOD_FUSION_THRESHOLD-style runtime knobs
 # must NOT move here.
-_HARNESS_PREFIXES = ("HVD_BENCH_", "HVD_SENTINEL_")
+# HVD_LOCK_* is the hvdrace runtime-witness namespace (HVD_LOCK_WITNESS,
+# HVD_LOCK_WITNESS_FILE): diagnostic instrumentation toggled per-process
+# by the person debugging, never launcher-propagated config.
+_HARNESS_PREFIXES = ("HVD_BENCH_", "HVD_SENTINEL_", "HVD_LOCK_")
+
+# HVL001/HVL006 (lock-held blocking call / sleep) are retired: hvdrace's
+# HVR202 subsumes both with call-graph-aware hold propagation
+# (horovod_tpu/analysis/race.py, docs/static_analysis.md).
+_DEFAULT_RULES = frozenset(
+    {"HVL002", "HVL003", "HVL004", "HVL005", "HVL007"})
 
 # Modules allowed to WRITE ambient HOROVOD_*/HVD_* env (HVL003): the
 # launcher stack (its whole job is exporting worker env), config
@@ -143,6 +147,33 @@ def declared_knobs(config_path=None):
     return frozenset(names)
 
 
+def propagated_knobs(launch_path=None, parser_path=None):
+    """Every ``HOROVOD_*``/``HVD_*`` literal in the launcher's worker-env
+    plumbing — ``runner/launch.py`` (build_worker_env's propagation
+    tuple) plus ``runner/config_parser.py`` (the CLI arg → env map).
+    That union is exactly the set of knobs a worker process can actually
+    receive; HVL007 diffs the declared set against it."""
+    here = os.path.dirname(__file__)
+    if launch_path is None:
+        launch_path = os.path.join(here, os.pardir, "runner", "launch.py")
+    if parser_path is None:
+        parser_path = os.path.join(here, os.pardir, "runner",
+                                   "config_parser.py")
+    names = set()
+    for path in (launch_path, parser_path):
+        try:
+            with open(path) as f:
+                tree = ast.parse(f.read())
+        except (OSError, SyntaxError):
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and _KNOB_RE.match(node.value):
+                names.add(node.value)
+    return frozenset(names)
+
+
 def _call_name(node):
     """Terminal name of a call: ``f(...)`` -> f, ``a.b.c(...)`` -> c."""
     fn = node.func
@@ -151,22 +182,6 @@ def _call_name(node):
     if isinstance(fn, ast.Name):
         return fn.id
     return None
-
-
-def _is_lock_expr(node):
-    """Does a ``with`` context expression look like a lock? Matches names
-    or attributes (possibly behind ``.acquire_timeout()``-style calls)
-    containing "lock" — the runtime's lock map: ``_lock`` (recorder ring,
-    basics, ledger, registry), ``_dump_lock``, ``_recorder_lock``,
-    ``_flush_lock``, ``self._lock``..."""
-    if isinstance(node, ast.Call):
-        # with lock_factory() / with self._lock.acquire_ctx()
-        return _is_lock_expr(node.func)
-    if isinstance(node, ast.Attribute):
-        return "lock" in node.attr.lower() or _is_lock_expr(node.value)
-    if isinstance(node, ast.Name):
-        return "lock" in node.id.lower()
-    return False
 
 
 def _env_read_name(node):
@@ -206,7 +221,6 @@ class _FileLinter(ast.NodeVisitor):
         self.findings = []
         self.suppressions = {}      # line -> (codes or None=all, reason)
         self.bad_suppressions = []
-        self._lock_depth = 0
         self._def_lines = []        # enclosing def/with lines (suppression)
         self._collect_suppressions()
 
@@ -250,29 +264,12 @@ class _FileLinter(ast.NodeVisitor):
     visit_AsyncFunctionDef = visit_FunctionDef
 
     def visit_With(self, node):
-        is_lock = any(_is_lock_expr(item.context_expr)
-                      for item in node.items)
         self._def_lines.append(node.lineno)
-        if is_lock:
-            self._lock_depth += 1
         self.generic_visit(node)
-        if is_lock:
-            self._lock_depth -= 1
         self._def_lines.pop()
 
     def visit_Call(self, node):
         name = _call_name(node)
-        if self._lock_depth:
-            if name in _SLEEP_CALLS:
-                self._emit("HVL006", node,
-                           f"{name}() while holding a lock: every other "
-                           "participant queues behind the snooze")
-            elif name in _BLOCKING_CALLS:
-                self._emit("HVL001", node,
-                           f"{name}() while holding a lock: collective/"
-                           "KV/network/dump work must move outside the "
-                           "critical section (the PR-5 signal-handler "
-                           "deadlock class)")
         env_name = _env_read_name(node)
         if env_name and _KNOB_RE.match(env_name) \
                 and env_name not in self.declared \
@@ -369,11 +366,14 @@ class _FileLinter(ast.NodeVisitor):
 
 
 def lint_source(source, rel_path="<string>", declared=None, rules=None,
-                path=None):
-    """Lint one source string; returns a list of :class:`LintFinding`."""
+                path=None, propagated=None):
+    """Lint one source string; returns a list of :class:`LintFinding`.
+
+    ``propagated`` is the launcher-exported knob set for HVL007 (parsed
+    from the real launch/config_parser files when None); it is only
+    consulted when ``rel_path`` is the Config module itself."""
     declared = declared if declared is not None else declared_knobs()
-    rules = frozenset(rules) if rules else frozenset(
-        {"HVL001", "HVL002", "HVL003", "HVL004", "HVL005", "HVL006"})
+    rules = frozenset(rules) if rules else _DEFAULT_RULES
     first = source.split("\n", 2)[:2]
     for line in first:
         m = _SKIP_FILE_RE.search(line)
@@ -392,6 +392,31 @@ def lint_source(source, rel_path="<string>", declared=None, rules=None,
     linter = _FileLinter(path or rel_path, rel_path, source, declared,
                          rules)
     linter.visit(tree)
+    if "HVL007" in rules \
+            and rel_path.replace(os.sep, "/").endswith("common/config.py"):
+        if propagated is None:
+            propagated = propagated_knobs()
+        # First declaring line per knob: the anchor the fix lands on.
+        decl_lines = {}
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Constant) \
+                    and isinstance(node.value, str) \
+                    and _KNOB_RE.match(node.value):
+                decl_lines.setdefault(node.value, node.lineno)
+        for knob in sorted(decl_lines):
+            if knob in propagated or knob in _BOOTSTRAP_VARS \
+                    or knob.startswith(_HARNESS_PREFIXES):
+                continue
+            line = decl_lines[knob]
+            if linter._suppressed("HVL007", line):
+                continue
+            linter.findings.append(LintFinding(
+                code="HVL007", path=rel_path, line=line,
+                message=f"knob {knob} is declared in Config but never "
+                        "exported by build_worker_env / the CLI arg map: "
+                        "set on the driver, it silently stays unset on "
+                        "every worker (add it to launch.py's propagation "
+                        "tuple)"))
     for ln in linter.bad_suppressions:
         linter.findings.append(LintFinding(
             code="HVL000", path=rel_path, line=ln,

@@ -205,7 +205,7 @@ def init(comm=None, process_sets=None, devices=None):
         ps._init_table(_state, process_sets)
 
         if config.timeline_filename:
-            start_timeline(config.timeline_filename,
+            start_timeline(config.timeline_filename,  # hvdrace: disable=HVR202 -- one-shot native lib build at init, bounded by subprocess timeout=120 and cached by native._tried
                            mark_cycles=config.timeline_mark_cycles)
 
         # Metrics: arm the always-on registry with this job's knobs and
@@ -572,6 +572,13 @@ def shutdown():
         from horovod_tpu.common import negotiation
         negotiation.reset()
         _state = None
+    # Membership watchdog: terminate it on the way out (it joins a thread,
+    # so — like the trace dump below — it runs after releasing the lock).
+    try:
+        from horovod_tpu.elastic import worker as _elastic_worker
+        _elastic_worker.stop_collective_abort()
+    except Exception:  # noqa: BLE001 — must not block exit
+        pass
     # Trace shard: a configured HOROVOD_TRACE_DIR gets this process's
     # span store on the way out (trace_r<rank>.json, merged by
     # `python -m horovod_tpu.trace.analyze`) — written AFTER releasing

@@ -259,6 +259,7 @@ _WATCH_INTERVAL = 0.5
 
 _watch_lock = threading.Lock()
 _watch_thread = None
+_watch_stop = threading.Event()
 _armed_version = None          # membership version the training run is at
 _last_abort_version = 0        # never abort the same bump twice
 
@@ -274,6 +275,7 @@ def arm_collective_abort(version):
     with _watch_lock:
         _armed_version = int(version)
         if _watch_thread is None or not _watch_thread.is_alive():
+            _watch_stop.clear()
             _watch_thread = threading.Thread(
                 target=_watch_loop, name="hvd-membership-watchdog",
                 daemon=True)
@@ -286,6 +288,20 @@ def disarm_collective_abort():
     global _armed_version
     with _watch_lock:
         _armed_version = None
+
+
+def stop_collective_abort(timeout=2.0):
+    """Terminate the watchdog thread (shutdown path). Unlike
+    :func:`disarm_collective_abort` — which idles the loop so a re-arm is
+    cheap — this ends it: a torn-down process must not keep a thread
+    polling the KV store for a membership that no longer includes it."""
+    global _watch_thread
+    _watch_stop.set()
+    with _watch_lock:
+        t = _watch_thread
+        _watch_thread = None
+    if t is not None and t.is_alive():
+        t.join(timeout=timeout)
 
 
 def _removed_since(client, armed, current):
@@ -305,9 +321,7 @@ def _watch_loop():
     client = _kv_client()
     if client is None:
         return
-    while True:
-        import time
-        time.sleep(_WATCH_INTERVAL)
+    while not _watch_stop.wait(_WATCH_INTERVAL):
         with _watch_lock:
             armed = _armed_version
         if armed is None:

@@ -246,7 +246,7 @@ class FlightRecorder:
             if locked:
                 self._lock.release()
 
-    def appended(self):
+    def appended(self):  # hvdrace: disable=HVR203 -- deliberate torn-read-tolerant counter peek; dump()'s emptiness check runs from signal handlers and must not acquire
         return self._idx
 
     def dropped(self):
@@ -469,7 +469,15 @@ def digest():
 def render_jsonl(reason=None):
     """Meta line + every ring event as JSONL (the ``/debug/flight``
     payload and the dump file body)."""
-    r = get()
+    return _render(get(), reason)
+
+
+def _render(r, reason=None):
+    # Renders from a recorder reference the caller already holds: dump()
+    # runs from signal handlers, and routing it through get() would reach
+    # the unbounded `with _recorder_lock:` in the create-on-first-use
+    # path — a self-deadlock if the signal lands while the main thread
+    # holds _recorder_lock inside configure().
     lines = [json.dumps(r.meta(reason))]
     lines.extend(json.dumps(e) for e in r.events())
     return "\n".join(lines) + "\n"
@@ -550,7 +558,7 @@ def dump(reason, directory=None, force=False):
                 d, f"flight_{_role}_r{_rank()}_p{os.getpid()}"
                    f"_b{_BOOT}_{n:02d}.jsonl")
             with open(path, "w") as f:
-                f.write(render_jsonl(reason))
+                f.write(_render(r, reason))
             return path
         except Exception:  # noqa: BLE001
             # Failed writes must not burn the dump budget or the 1s

@@ -299,7 +299,7 @@ class FusionRuntime:
     def threshold(self, value):
         self._threshold = value
         if getattr(self, "_native", None) is not None:
-            self._native.set_threshold(value)
+            self._native.set_threshold(value)  # hvdrace: disable=HVR203 -- _native is set once at init before worker threads start; this is an atomic-ref read
 
     def __init__(self, config):
         self.threshold = config.fusion_threshold
@@ -473,7 +473,7 @@ class FusionRuntime:
                 name="hvd-fusion-follower")
             self._cycle_thread.start()
 
-    def _cycle_loop(self):
+    def _cycle_loop(self):  # hvdrace: disable=HVR203 -- debounce heuristic reads (_cycle_s/_pending/_last_enqueue) tolerate staleness; the flush itself re-checks under _lock
         while not self._cycle_stop.wait(self._cycle_s):
             # Debounced: flush only after a full cycle with NO new
             # enqueues. Flushing mid-burst would split the pending set at
@@ -770,7 +770,7 @@ class FusionRuntime:
                         return applied       # ahead of us: defer
                     self._deferred_boundary = None
                     self._boundary_seq += 1
-                    self._flush_locked(up_to=last_tid)
+                    self._flush_locked(up_to=last_tid)  # hvdrace: disable=HVR202 -- chaos fault injection (chaos.injector fire) deliberately delays/crashes inside the flush; the perturbation under the lock IS the injected fault
                     hvd_metrics.record_boundary("applied")
             applied = True
             block_ms = 1
@@ -883,7 +883,7 @@ class FusionRuntime:
             self.flush_all()
             return
         if tid is None:
-            tid = self._next_tid - 1
+            tid = self._next_tid - 1  # hvdrace: disable=HVR203 -- _next_tid increments only on the enqueueing (caller) thread; reading our own counter needs no lock
         if not block:
             self._apply_ready_boundaries(block_ms=1)
             return
@@ -1028,7 +1028,7 @@ class FusionRuntime:
             # flush would split the burst differently from process 0.
             self._apply_ready_boundaries(block_ms=1)
             return
-        if self._overlap_mode == "next_flush" and self._inflight_cross:
+        if self._overlap_mode == "next_flush" and self._inflight_cross:  # hvdrace: disable=HVR203 -- overlap mode is a config string set at init (tuned only between steps on this same thread); stale read is benign
             # Collapsed overlap: bucket k's DCN leg is awaited when bucket
             # k+1's flush needs the wire (outside the lock and outside
             # this flush's bracket — booked to cross_wait).

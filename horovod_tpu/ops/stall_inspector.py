@@ -67,7 +67,9 @@ class StallInspector:
                     continue
                 age = time.monotonic() - self._oldest_enqueue
                 names = list(self._pending_names[:8])
-            if age > self.warning_secs and not self._warned:
+                warned = self._warned
+                flagged = self.shutdown_flagged
+            if age > self.warning_secs and not warned:
                 # Counted as well as logged: stall_events_total makes the
                 # finding scrapeable instead of a log-grep-only signal.
                 _metrics.record_stall("warning")
@@ -80,9 +82,14 @@ class StallInspector:
                     "%.0fs ago were never reduced — missing synchronize()? "
                     "Pending: %s (reference: stall_inspector.cc "
                     "CheckForStalledTensors)", age, names)
-                self._warned = True
+                # record_flush clears _warned under the lock from caller
+                # threads; the set must pair with it (dump/log above stay
+                # outside the critical section).
+                with self._lock:
+                    self._warned = True
             if self.shutdown_secs > 0 and age > self.shutdown_secs:
-                if not self.shutdown_flagged:
+                if not flagged:
                     _metrics.record_stall("shutdown")
                     _flight.dump("stall_shutdown")
-                self.shutdown_flagged = True
+                with self._lock:
+                    self.shutdown_flagged = True

@@ -281,11 +281,17 @@ class StepLedger:
         if self._peaks is None:
             self._peaks = roofline.chip_peaks()
         rec["chip"] = self._peaks["chip"]
-        if self._flops_per_step:
-            frac, achieved = roofline.mfu(self._flops_per_step, wall,
+        # _build_record runs outside the hot-path lock by design (see
+        # record_step); snapshot the flops pair so a concurrent
+        # set_flops_per_step can't tear value/source between reads.
+        with self._lock:
+            flops_per_step = self._flops_per_step
+            flops_source = self._flops_source
+        if flops_per_step:
+            frac, achieved = roofline.mfu(flops_per_step, wall,
                                           self._peaks)
-            rec["flops_per_step"] = self._flops_per_step
-            rec["flops_source"] = self._flops_source
+            rec["flops_per_step"] = flops_per_step
+            rec["flops_source"] = flops_source
             if frac is not None:
                 rec["mfu"] = round(frac, 5)
             if achieved is not None:

@@ -125,7 +125,11 @@ class ElasticDriver:
         # RayHostDiscovery, can resize a host in place). Comparing against
         # the assignment would churn whenever max_np clamps it below the
         # available slots.
-        if hosts == self._last_hosts and self._assignment:
+        # _assignment is published under _assignment_cv (workers block on
+        # it in wait_for_available_slots); read it under the same guard.
+        with self._assignment_cv:
+            have_assignment = bool(self._assignment)
+        if hosts == self._last_hosts and have_assignment:
             return
         if sum(hosts.values()) < self._min_np:
             hvd_logging.warning(
@@ -246,265 +250,272 @@ def run_elastic_driver(args, kv_preload=None, harvest=None,
     # Arm the driver-side chaos plan (host_remove rides the discovery
     # loop); workers arm their own copy from the propagated env at init.
     from horovod_tpu import chaos as _chaos_api
-    _chaos_api.set_role("driver")
-    _chaos_api.install_from_env()
     from horovod_tpu.flight import recorder as _flight
+    _chaos_api.set_role("driver")
     _flight.set_role("driver")
-    # recorder.armed was fixed at import time — before set_env_from_args
-    # above applied --no-flight-recorder to this process's env. Re-read it
-    # (the chaos install_from_env() parallel), or the driver would write
-    # disruption markers for a run the operator opted out of.
-    from horovod_tpu.common.config import _env_bool
-    _flight.set_enabled(_env_bool("HOROVOD_FLIGHT_RECORDER", True))
-    # Same flight-dir default the workers get (launch.build_worker_env):
-    # the driver's disruption markers must land in the SAME directory as
-    # the worker dumps or the analyzer loses the kill-to-membership-change
-    # correlation. set_env_from_args above only covers an explicit
-    # --flight-dir; this covers the defaulted elastic launch.
-    _os.environ.setdefault(
-        "HOROVOD_FLIGHT_DIR",
-        _flight.default_collection_dir(
-            getattr(args, "output_filename", None)))
-    # Elastic launches shard the KV plane too (slice-local scopes off the
-    # root listener); the shard count keys off the LARGEST world the job
-    # may reach — membership changes must not restart listeners.
-    from horovod_tpu.common import control_plane as _cp
-    from horovod_tpu.common.config import _env_int
-    kv = KVStoreServer(
-        shards=_cp.kv_shard_count(args.max_np or args.np or args.min_np
-                                  or 1),
-        shard_port_base=_env_int("HOROVOD_KV_SHARD_PORT_BASE", 0))
-    kv_port = kv.start()
-    for (scope, key), value in (kv_preload or {}).items():
-        kv.put(scope, key, value)
-    coordinator_addr = socket.gethostname()
-    state = {"workers": {}, "slots": {}, "done": threading.Event(), "rc": 0,
-             "version": 0, "completing": False, "lock": threading.Lock(),
-             "spawn_lock": threading.Lock()}
+    # The roles claimed above MUST be restored on every exit path —
+    # including KV/driver startup failures, not just after
+    # driver.start() succeeds — so the try covers everything from the
+    # claim onward (regression: the PR-14 test_runner -> test_chaos
+    # ordering leak came back through the startup-failure window).
+    try:
+        _chaos_api.install_from_env()
+        # recorder.armed was fixed at import time — before set_env_from_args
+        # above applied --no-flight-recorder to this process's env. Re-read it
+        # (the chaos install_from_env() parallel), or the driver would write
+        # disruption markers for a run the operator opted out of.
+        from horovod_tpu.common.config import _env_bool
+        _flight.set_enabled(_env_bool("HOROVOD_FLIGHT_RECORDER", True))
+        # Same flight-dir default the workers get (launch.build_worker_env):
+        # the driver's disruption markers must land in the SAME directory as
+        # the worker dumps or the analyzer loses the kill-to-membership-change
+        # correlation. set_env_from_args above only covers an explicit
+        # --flight-dir; this covers the defaulted elastic launch.
+        _os.environ.setdefault(
+            "HOROVOD_FLIGHT_DIR",
+            _flight.default_collection_dir(
+                getattr(args, "output_filename", None)))
+        # Elastic launches shard the KV plane too (slice-local scopes off the
+        # root listener); the shard count keys off the LARGEST world the job
+        # may reach — membership changes must not restart listeners.
+        from horovod_tpu.common import control_plane as _cp
+        from horovod_tpu.common.config import _env_int
+        kv = KVStoreServer(
+            shards=_cp.kv_shard_count(args.max_np or args.np or args.min_np
+                                      or 1),
+            shard_port_base=_env_int("HOROVOD_KV_SHARD_PORT_BASE", 0))
+        kv_port = kv.start()
+        for (scope, key), value in (kv_preload or {}).items():
+            kv.put(scope, key, value)
+        coordinator_addr = socket.gethostname()
+        state = {"workers": {}, "slots": {}, "done": threading.Event(), "rc": 0,
+                 "version": 0, "completing": False, "lock": threading.Lock(),
+                 "spawn_lock": threading.Lock()}
 
-    def spawn(assignment, version):
-        """Differential (re)spawn: workers on surviving hosts keep running
-        and re-initialize in place when they observe the version bump
-        (reference: surviving ranks re-rendezvous without restarting,
-        §3.4 / elastic/driver.py:284-302 only spawns NEW slots); workers on
-        removed hosts are terminated; workers on added hosts are started."""
-        # Serialize whole (re)spawns: discovery-thread updates and
-        # worker-crash updates (record_worker_exit from a _watch thread)
-        # can race, and an older version's KV writes landing after a newer
-        # one's would roll the membership backwards.
-        with state["spawn_lock"]:
-            with state["lock"]:
-                if version < state["version"]:
+        def spawn(assignment, version):
+            """Differential (re)spawn: workers on surviving hosts keep running
+            and re-initialize in place when they observe the version bump
+            (reference: surviving ranks re-rendezvous without restarting,
+            §3.4 / elastic/driver.py:284-302 only spawns NEW slots); workers on
+            removed hosts are terminated; workers on added hosts are started."""
+            # Serialize whole (re)spawns: discovery-thread updates and
+            # worker-crash updates (record_worker_exit from a _watch thread)
+            # can race, and an older version's KV writes landing after a newer
+            # one's would roll the membership backwards.
+            with state["spawn_lock"]:
+                with state["lock"]:
+                    if version < state["version"]:
+                        hvd_logging.info(
+                            "dropping superseded spawn v%d (current v%d)",
+                            version, state["version"])
+                        return
+                    completing = state.get("completing")
+                # The KV marker closes the window between a worker's final
+                # result write and its _watch thread observing the exit
+                # (runner/task.py writes it just before exiting).
+                if completing or kv.get("elastic", "finished"):
+                    # A worker already finished cleanly: rebalancing now
+                    # would wedge the new membership waiting on exited
+                    # peers. Let the remaining workers drain.
                     hvd_logging.info(
-                        "dropping superseded spawn v%d (current v%d)",
-                        version, state["version"])
+                        "dropping spawn v%d: job is completing", version)
                     return
-                completing = state.get("completing")
-            # The KV marker closes the window between a worker's final
-            # result write and its _watch thread observing the exit
-            # (runner/task.py writes it just before exiting).
-            if completing or kv.get("elastic", "finished"):
-                # A worker already finished cleanly: rebalancing now
-                # would wedge the new membership waiting on exited
-                # peers. Let the remaining workers drain.
+                with state["lock"]:
+                    if version < state["version"]:
+                        return
+                    state["version"] = version
+                _spawn_locked(assignment, version)
+
+        def _spawn_locked(assignment, version):
+            import json
+
+            coordinator_port = _free_port()
+            by_host = host_assignment_by_host(assignment)
+            with state["lock"]:
+                # Pop removed hosts first so their _watch threads see them as
+                # stale and don't report the termination as a host failure.
+                # A host whose slot count changed in place cannot re-init
+                # in-process (its XLA local device count was pinned at spawn):
+                # treat it as removed + added.
+                removed = [h for h in list(state["workers"])
+                           if h not in by_host
+                           or state["slots"].get(h) != len(by_host[h])]
+                removed_workers = [state["workers"].pop(h) for h in removed]
+                for h in removed:
+                    state["slots"].pop(h, None)
+                survivors = set(state["workers"])
+            # terminate() blocks until each removed worker is reaped, so no
+            # stale process can write results/mark itself ready after the KV
+            # reset below.
+            for w in removed_workers:
+                w.terminate()
+            # Results are version-scoped (a stale write can't pollute the final
+            # harvest); pruning here is garbage collection of superseded
+            # memberships' results — NOT a blanket delete: a worker finishing
+            # under the previous version concurrently with this rebalance must
+            # not lose its result row (its finished marker may land between
+            # spawn()'s probe and now). Assignment rows and ready marks are
+            # pruned to the previous + new version likewise — a worker that
+            # read the previous version string just before this bump can still
+            # fetch its row — bounding KV growth under membership churn.
+            keep = (f"{version}/", f"{version - 1}/")
+            for scope in ("results", "assignment", "new_rank_ready"):
+                kv.prune_scope(scope, keep)
+            # Telemetry keys are generation-scoped the same way (rank
+            # numbering changes across memberships); the unscoped "job" view
+            # survives so the new generation's leader can diff the previous
+            # membership's hosts and record who was lost.
+            kv.prune_scope("telemetry",
+                           (f"g{version}/", f"g{version - 1}/", "job"))
+            # Assignment rows and nhosts must land before the version bump:
+            # surviving workers re-rendezvous the moment they observe the bump
+            # (elastic/worker.py refresh_assignment_env), and the
+            # new-rank-ready barrier keys off the observed version.
+            for host, slots in by_host.items():
+                first = slots[0]
+                # Two-segment key (not scope) so HTTP clients — whose paths
+                # parse as /scope/rest-of-path — resolve the same cell.
+                kv.put("assignment", f"{version}/{host}", json.dumps({
+                    "rank": first.rank, "size": first.size,
+                    "local_size": first.local_size,
+                    "cross_rank": first.cross_rank,
+                    "cross_size": first.cross_size,
+                    "coordinator_port": coordinator_port,
+                }).encode())
+            # Last-moment finished re-check, atomic with the bump from the
+            # workers' perspective (they only act on the version write): a
+            # worker that completed during this rebalance must not be counted
+            # as a survivor of a membership it will never join — that would
+            # wedge the others at the new-rank barrier. The nhosts writes come
+            # AFTER this check: an aborted spawn must not leave the unscoped
+            # count describing a membership that never activated (the final
+            # harvest sizes itself from it).
+            if kv.get("elastic", "finished"):
                 hvd_logging.info(
-                    "dropping spawn v%d: job is completing", version)
+                    "aborting spawn v%d: job finished during rebalance", version)
                 return
+            # Version-scoped host count: a worker configured for version v must
+            # never pair v's ready marks with v+1's count (premature barrier
+            # release on scale-down). The unscoped key serves the final harvest
+            # (api._elastic_harvester).
+            kv.put("elastic", f"nhosts/{version}", str(len(by_host)).encode())
+            kv.delete("elastic", f"nhosts/{version - 2}")
+            # Update kind for this version: removal-only changes let survivors
+            # skip the state re-sync and keep uncommitted progress (reference:
+            # HostUpdateResult.removed -> skip_sync, common/elastic.py).
+            kind = b"add" if any(h not in survivors for h in by_host) \
+                else b"removal"
+            kv.put("elastic", f"update_kind/{version}", kind)
+            kv.delete("elastic", f"update_kind/{version - 2}")
+            # Disruption marker for the worker-side membership watchdog
+            # (elastic/worker.py): a version whose membership change makes
+            # in-flight collectives uncompletable (host removed / resized)
+            # must ABORT them on every survivor; a pure addition leaves them
+            # completable and is picked up at the next commit boundary.
+            disrupted = driver.version_disrupted(version)
+            kv.put("elastic", f"removed/{version}",
+                   b"1" if disrupted else b"0")
+            kv.delete("elastic", f"removed/{version - 2}")
+            if disrupted:
+                # Collection-point marker: workers dump their rings into
+                # HOROVOD_FLIGHT_DIR (one directory for the whole launch —
+                # the env is propagated to every worker), and this line ties
+                # those dumps to the membership change that triggered them.
+                _flight.driver_mark(version, removed, list(by_host))
+            kv.put("elastic", "nhosts", str(len(by_host)).encode())
+            kv.put("elastic", "version", str(version).encode())
+            for host, slots in by_host.items():
+                if host in survivors:
+                    continue  # stays alive; re-inits in place on the bump
+                env = build_worker_env(
+                    {**(extra_env or {}), "HOROVOD_ELASTIC": "1"}, slots,
+                    coordinator_addr, coordinator_port, kv_port, args,
+                    kv_shard_ports=kv.shard_ports)
+                env["HOROVOD_HOST_KEY"] = host
+                # Workers key their results by the membership version they run
+                # under (updated in-place on re-init), so a survivor finishing
+                # against a superseded membership can never pollute the final
+                # harvest.
+                env["HOROVOD_ELASTIC_INIT_VERSION"] = str(version)
+                w = WorkerProcess(host, args.command, env, tag=f"{host}@v{version}")
+                with state["lock"]:
+                    state["workers"][host] = w
+                    state["slots"][host] = len(slots)
+                threading.Thread(target=_watch, args=(host, w),
+                                 daemon=True).start()
+
+        def _watch(host, worker):
+            rc = worker.wait()
             with state["lock"]:
-                if version < state["version"]:
-                    return
-                state["version"] = version
-            _spawn_locked(assignment, version)
-
-    def _spawn_locked(assignment, version):
-        import json
-
-        coordinator_port = _free_port()
-        by_host = host_assignment_by_host(assignment)
-        with state["lock"]:
-            # Pop removed hosts first so their _watch threads see them as
-            # stale and don't report the termination as a host failure.
-            # A host whose slot count changed in place cannot re-init
-            # in-process (its XLA local device count was pinned at spawn):
-            # treat it as removed + added.
-            removed = [h for h in list(state["workers"])
-                       if h not in by_host
-                       or state["slots"].get(h) != len(by_host[h])]
-            removed_workers = [state["workers"].pop(h) for h in removed]
-            for h in removed:
-                state["slots"].pop(h, None)
-            survivors = set(state["workers"])
-        # terminate() blocks until each removed worker is reaped, so no
-        # stale process can write results/mark itself ready after the KV
-        # reset below.
-        for w in removed_workers:
-            w.terminate()
-        # Results are version-scoped (a stale write can't pollute the final
-        # harvest); pruning here is garbage collection of superseded
-        # memberships' results — NOT a blanket delete: a worker finishing
-        # under the previous version concurrently with this rebalance must
-        # not lose its result row (its finished marker may land between
-        # spawn()'s probe and now). Assignment rows and ready marks are
-        # pruned to the previous + new version likewise — a worker that
-        # read the previous version string just before this bump can still
-        # fetch its row — bounding KV growth under membership churn.
-        keep = (f"{version}/", f"{version - 1}/")
-        for scope in ("results", "assignment", "new_rank_ready"):
-            kv.prune_scope(scope, keep)
-        # Telemetry keys are generation-scoped the same way (rank
-        # numbering changes across memberships); the unscoped "job" view
-        # survives so the new generation's leader can diff the previous
-        # membership's hosts and record who was lost.
-        kv.prune_scope("telemetry",
-                       (f"g{version}/", f"g{version - 1}/", "job"))
-        # Assignment rows and nhosts must land before the version bump:
-        # surviving workers re-rendezvous the moment they observe the bump
-        # (elastic/worker.py refresh_assignment_env), and the
-        # new-rank-ready barrier keys off the observed version.
-        for host, slots in by_host.items():
-            first = slots[0]
-            # Two-segment key (not scope) so HTTP clients — whose paths
-            # parse as /scope/rest-of-path — resolve the same cell.
-            kv.put("assignment", f"{version}/{host}", json.dumps({
-                "rank": first.rank, "size": first.size,
-                "local_size": first.local_size,
-                "cross_rank": first.cross_rank,
-                "cross_size": first.cross_size,
-                "coordinator_port": coordinator_port,
-            }).encode())
-        # Last-moment finished re-check, atomic with the bump from the
-        # workers' perspective (they only act on the version write): a
-        # worker that completed during this rebalance must not be counted
-        # as a survivor of a membership it will never join — that would
-        # wedge the others at the new-rank barrier. The nhosts writes come
-        # AFTER this check: an aborted spawn must not leave the unscoped
-        # count describing a membership that never activated (the final
-        # harvest sizes itself from it).
-        if kv.get("elastic", "finished"):
-            hvd_logging.info(
-                "aborting spawn v%d: job finished during rebalance", version)
-            return
-        # Version-scoped host count: a worker configured for version v must
-        # never pair v's ready marks with v+1's count (premature barrier
-        # release on scale-down). The unscoped key serves the final harvest
-        # (api._elastic_harvester).
-        kv.put("elastic", f"nhosts/{version}", str(len(by_host)).encode())
-        kv.delete("elastic", f"nhosts/{version - 2}")
-        # Update kind for this version: removal-only changes let survivors
-        # skip the state re-sync and keep uncommitted progress (reference:
-        # HostUpdateResult.removed -> skip_sync, common/elastic.py).
-        kind = b"add" if any(h not in survivors for h in by_host) \
-            else b"removal"
-        kv.put("elastic", f"update_kind/{version}", kind)
-        kv.delete("elastic", f"update_kind/{version - 2}")
-        # Disruption marker for the worker-side membership watchdog
-        # (elastic/worker.py): a version whose membership change makes
-        # in-flight collectives uncompletable (host removed / resized)
-        # must ABORT them on every survivor; a pure addition leaves them
-        # completable and is picked up at the next commit boundary.
-        disrupted = driver.version_disrupted(version)
-        kv.put("elastic", f"removed/{version}",
-               b"1" if disrupted else b"0")
-        kv.delete("elastic", f"removed/{version - 2}")
-        if disrupted:
-            # Collection-point marker: workers dump their rings into
-            # HOROVOD_FLIGHT_DIR (one directory for the whole launch —
-            # the env is propagated to every worker), and this line ties
-            # those dumps to the membership change that triggered them.
-            _flight.driver_mark(version, removed, list(by_host))
-        kv.put("elastic", "nhosts", str(len(by_host)).encode())
-        kv.put("elastic", "version", str(version).encode())
-        for host, slots in by_host.items():
-            if host in survivors:
-                continue  # stays alive; re-inits in place on the bump
-            env = build_worker_env(
-                {**(extra_env or {}), "HOROVOD_ELASTIC": "1"}, slots,
-                coordinator_addr, coordinator_port, kv_port, args,
-                kv_shard_ports=kv.shard_ports)
-            env["HOROVOD_HOST_KEY"] = host
-            # Workers key their results by the membership version they run
-            # under (updated in-place on re-init), so a survivor finishing
-            # against a superseded membership can never pollute the final
-            # harvest.
-            env["HOROVOD_ELASTIC_INIT_VERSION"] = str(version)
-            w = WorkerProcess(host, args.command, env, tag=f"{host}@v{version}")
+                stale = state["workers"].get(host) is not worker
+                if not stale:
+                    state["workers"].pop(host, None)
+                    if rc == 0:
+                        # A clean finish means the job is winding down: further
+                        # membership bumps must not respawn/rebalance (peers
+                        # that already exited can never re-join a rendezvous).
+                        state["completing"] = True
+            if stale:
+                return  # superseded/removed assignment; expected termination
+            driver.record_worker_exit(host, rc)
+            # Only after record_worker_exit: a crash may have just respawned a
+            # replacement (blacklist -> reassign -> spawn); a pre-exit snapshot
+            # of the worker table would declare the job dead mid-recovery.
             with state["lock"]:
-                state["workers"][host] = w
-                state["slots"][host] = len(slots)
-            threading.Thread(target=_watch, args=(host, w),
-                             daemon=True).start()
+                remaining = bool(state["workers"])
+            if not remaining:
+                state["rc"] = max(abs(rc or 0), state["rc"])
+                state["done"].set()
 
-    def _watch(host, worker):
-        rc = worker.wait()
-        with state["lock"]:
-            stale = state["workers"].get(host) is not worker
-            if not stale:
-                state["workers"].pop(host, None)
-                if rc == 0:
-                    # A clean finish means the job is winding down: further
-                    # membership bumps must not respawn/rebalance (peers
-                    # that already exited can never re-join a rendezvous).
-                    state["completing"] = True
-        if stale:
-            return  # superseded/removed assignment; expected termination
-        driver.record_worker_exit(host, rc)
-        # Only after record_worker_exit: a crash may have just respawned a
-        # replacement (blacklist -> reassign -> spawn); a pre-exit snapshot
-        # of the worker table would declare the job dead mid-recovery.
-        with state["lock"]:
-            remaining = bool(state["workers"])
-        if not remaining:
-            state["rc"] = max(abs(rc or 0), state["rc"])
+        def shutdown(reason):
+            with state["lock"]:
+                workers = list(state["workers"].values())
+            for w in workers:
+                w.terminate()
+            if reason != "driver stop":
+                state["rc"] = max(state["rc"], 1)
             state["done"].set()
 
-    def shutdown(reason):
-        with state["lock"]:
-            workers = list(state["workers"].values())
-        for w in workers:
-            w.terminate()
-        if reason != "driver stop":
-            state["rc"] = max(state["rc"], 1)
-        state["done"].set()
+        # Autopilot driver arm: controller-requested removals ride the
+        # discovery loop exactly like chaos host_remove — blacklist via the
+        # HostManager cooldown, then the normal reassignment re-rendezvouses
+        # the survivors. The arm exists whether or not workers run the
+        # controller (requests only appear when they do); floor/rate are
+        # re-validated here with the driver's authoritative world view.
+        from horovod_tpu.autopilot import remediate as _ap_remediate
+        _arm_box = []
 
-    # Autopilot driver arm: controller-requested removals ride the
-    # discovery loop exactly like chaos host_remove — blacklist via the
-    # HostManager cooldown, then the normal reassignment re-rendezvouses
-    # the survivors. The arm exists whether or not workers run the
-    # controller (requests only appear when they do); floor/rate are
-    # re-validated here with the driver's authoritative world view.
-    from horovod_tpu.autopilot import remediate as _ap_remediate
-    _arm_box = []
+        def _remediation_poll(hosts):
+            return _arm_box[0].poll(hosts) if _arm_box else ()
 
-    def _remediation_poll(hosts):
-        return _arm_box[0].poll(hosts) if _arm_box else ()
-
-    driver = ElasticDriver(discovery, args.min_np or 1, args.max_np,
-                           args.reset_limit, spawn_fn=spawn,
-                           shutdown_fn=shutdown,
-                           remediation_fn=_remediation_poll)
-    _arm_box.append(_ap_remediate.DriverArm(
-        kv, driver._host_manager,
-        min_world=max(_env_int("HOROVOD_AUTOPILOT_MIN_WORLD", 0),
-                      args.min_np or 1),
-        max_removals=_env_int("HOROVOD_AUTOPILOT_MAX_REMOVALS", 1)))
-    driver.start()
-    try:
-        driver.wait_for_available_slots(args.min_np or 1,
-                                        timeout=args.start_timeout)
-        state["done"].wait()
-        # Halt discovery BEFORE harvesting: a membership change landing in
-        # this window would call spawn(), whose kv.delete("results") wipes
-        # the finished run's results mid-harvest.
-        driver.stop()
-        if state["rc"] == 0 and harvest is not None:
-            harvest(kv)
-        return state["rc"]
+        driver = ElasticDriver(discovery, args.min_np or 1, args.max_np,
+                               args.reset_limit, spawn_fn=spawn,
+                               shutdown_fn=shutdown,
+                               remediation_fn=_remediation_poll)
+        _arm_box.append(_ap_remediate.DriverArm(
+            kv, driver._host_manager,
+            min_world=max(_env_int("HOROVOD_AUTOPILOT_MIN_WORLD", 0),
+                          args.min_np or 1),
+            max_removals=_env_int("HOROVOD_AUTOPILOT_MAX_REMOVALS", 1)))
+        driver.start()
+        try:
+            driver.wait_for_available_slots(args.min_np or 1,
+                                            timeout=args.start_timeout)
+            state["done"].wait()
+            # Halt discovery BEFORE harvesting: a membership change landing in
+            # this window would call spawn(), whose kv.delete("results") wipes
+            # the finished run's results mid-harvest.
+            driver.stop()
+            if state["rc"] == 0 and harvest is not None:
+                harvest(kv)
+            return state["rc"]
+        finally:
+            driver.stop()
+            kv.stop()
     finally:
-        driver.stop()
-        kv.stop()
         # The driver may run IN-PROCESS (tests, run_elastic API): restore
         # the chaos/flight roles claimed above, or the next in-process
         # workload's ledger entries and dumps are mislabeled "driver"
-        # (the PR-14 test_runner → test_chaos ordering leak).
+        # (the PR-14 test_runner -> test_chaos ordering leak).
         _chaos_api.set_role("worker")
         _flight.set_role("worker")

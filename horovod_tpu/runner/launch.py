@@ -499,6 +499,10 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_SERVING_COMMIT_STEPS",
                 "HOROVOD_TRACE", "HOROVOD_TRACE_CAPACITY",
                 "HOROVOD_TRACE_DIR",
+                "HOROVOD_DONATE_BUFFERS", "HOROVOD_DYNAMIC_PROCESS_SETS",
+                "HOROVOD_JOIN_MODE", "HOROVOD_FLIGHT_CAPACITY",
+                "HOROVOD_KV_RETRIES", "HOROVOD_KV_RETRY_BACKOFF_MS",
+                "HOROVOD_KV_RETRY_BACKOFF_MAX_MS",
                 "HOROVOD_SLO_TTFT_P99_MS", "HOROVOD_SLO_TPS",
                 "HOROVOD_SLO_WINDOW_S",
                 "HOROVOD_METRICS", "HOROVOD_METRICS_PORT",

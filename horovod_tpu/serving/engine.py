@@ -236,7 +236,7 @@ class ServingEngine:
 
     # --- the serve loop ---------------------------------------------------
 
-    def _prefill_into(self, slot, req):
+    def _prefill_into(self, slot, req):  # hvdrace: disable=HVR203 -- _tokens/_pos/_cache_valid are serve-thread-owned; the restore path only writes them under _submit_lock while serving is quiesced
         """Teacher-force the request's effective prompt (prompt + any
         committed tokens from a previous incarnation) into its slot."""
         import jax.numpy as jnp
@@ -276,7 +276,7 @@ class ServingEngine:
         _flight.record_event("serving", what="admit", name=f"r{req.rid}",
                              seq=slot, trace=req.tid)
 
-    def step(self):
+    def step(self):  # hvdrace: disable=HVR203 -- the serve loop is the scheduler's single consumer: _sched/_step_count reads here race nothing; _submit_lock guards only the submit-vs-commit/restore swap
         """One engine iteration: admit + prefill free slots, then one
         decode step for every active slot. Returns True when any work
         happened (False = idle)."""
@@ -324,8 +324,9 @@ class ServingEngine:
                 # request from the snapshot; the caller's already
                 # resolved future keeps the identical (deterministic)
                 # stream.
-                self._requests.pop(req.rid, None)
-                self._served += 1
+                with self._submit_lock:
+                    self._requests.pop(req.rid, None)
+                    self._served += 1
                 _metrics.record_serving_request("completed")
                 # Terminal stream phase (final-token delivery: host
                 # sampling + future resolution), then close the root —
@@ -380,17 +381,23 @@ class ServingEngine:
         """Picklable request-level state: active slots first (they re-admit
         ahead of the queue — FIFO completion order survives), then the
         queue, oldest first."""
-        for req in self._sched.active().values():
+        # The commit runs on the elastic coordination path while HTTP
+        # submit threads race it; collect a consistent frame under the
+        # same lock submit() takes, emit trace markers after release (the
+        # trace store has its own lock — see load_request_snapshot).
+        with self._submit_lock:
+            active = dict(self._sched.active())
+            snap = {
+                "active": [active[s].snapshot() for s in sorted(active)],
+                "queued": [r.snapshot() for r in self._sched.queued()],
+                "served": self._served,
+            }
+        for req in active.values():
             # Commit marker (NOT a barrier: it must not break the decode
             # phase chain); the span cap bounds a long decode's markers.
             trace.add_instant(req.tid, "commit", cat="elastic",
                               args={"committed": len(req.committed)})
-        return {
-            "active": [self._sched.active()[s].snapshot()
-                       for s in sorted(self._sched.active())],
-            "queued": [r.snapshot() for r in self._sched.queued()],
-            "served": self._served,
-        }
+        return snap
 
     def kv_snapshot(self):
         """Host snapshot of the live slot caches + cursors (the migration
@@ -415,9 +422,16 @@ class ServingEngine:
         if snap is None:
             return
         with self._submit_lock:
-            self._load_request_snapshot_locked(snap)
+            emissions = self._load_request_snapshot_locked(snap)
+        # Trace/flight/metrics sinks each take their own lock; emitting
+        # them while holding _submit_lock would order _submit_lock before
+        # every sink lock on this path while other paths (submit, step)
+        # build the opposite nesting — run them after the swap publishes.
+        for emit in emissions:
+            emit()
 
     def _load_request_snapshot_locked(self, snap):
+        emissions = []
         snap_rids = {rs["rid"]
                      for rs in list(snap.get("active", ()))
                      + list(snap.get("queued", ()))}
@@ -467,28 +481,32 @@ class ServingEngine:
             # disruption accounting back.
             req.requeues = max(req.requeues, int(rs.get("requeues", 0)))
             req.t_queued = time.time()
-            trace.register(req.tid, rid=req.rid, t0=rs.get("t0"))
+            emissions.append(lambda req=req, rs=rs: trace.register(
+                req.tid, rid=req.rid, t0=rs.get("t0")))
             if req.rid in was_active:
                 req.requeues += 1
-                _metrics.record_serving_request("requeued")
+                emissions.append(
+                    lambda: _metrics.record_serving_request("requeued"))
                 # Barrier instant: spans after it open a FRESH incarnation
                 # of their phase (queue/prefill again) instead of nesting
                 # under the pre-kill one.
-                trace.add_instant(req.tid, "requeue", cat="elastic",
-                                  barrier=True,
-                                  args={"committed": len(req.committed),
-                                        "requeues": req.requeues})
-                _flight.record_event("serving", what="requeue",
-                                     name=f"r{req.rid}", trace=req.tid)
+                emissions.append(lambda req=req: trace.add_instant(
+                    req.tid, "requeue", cat="elastic", barrier=True,
+                    args={"committed": len(req.committed),
+                          "requeues": req.requeues}))
+                emissions.append(lambda req=req: _flight.record_event(
+                    "serving", what="requeue", name=f"r{req.rid}",
+                    trace=req.tid))
             else:
-                trace.add_instant(req.tid, "restore", cat="elastic",
-                                  barrier=True)
+                emissions.append(lambda req=req: trace.add_instant(
+                    req.tid, "restore", cat="elastic", barrier=True))
             self._sched.enqueue_restored(req)
         for req in later:
             self._sched.enqueue_restored(req)
         self._cache_valid = False
         self._pos[:] = 0
         self._tokens[:] = 0
+        return emissions
 
     def invalidate_cache(self):
         """Mark slot caches unusable (a restore rolled requests behind the
@@ -558,28 +576,33 @@ class ServingEngine:
     def snapshot(self):
         """One JSON-able frame for ``/serving/health`` and the telemetry
         readiness gate."""
-        active = self._sched.active()
-        return {
-            "t": time.time(),
-            "slots": self.num_slots,
-            "active": len(active),
-            "queue_depth": self._sched.queue_depth(),
-            "queue_limit": self._sched.queue_limit,
-            "fill_ratio": round(self._sched.fill_ratio(), 4),
-            "served": self._served,
-            "steps": self._step_count,
-            "max_len": self.max_len,
-            "cache_valid": self._cache_valid,
-            "requests": {
-                str(s): {"rid": r.rid, "generated": len(r.committed),
-                         "budget": r.max_new, "requeues": r.requeues}
-                for s, r in active.items()},
-            # Saturation = queue at (or beyond) its declared limit: the
-            # load balancer should stop sending here.
-            "saturated": bool(self._sched.queue_limit
-                              and self._sched.queue_depth()
-                              >= self._sched.queue_limit),
-            # {} unless SLO objectives are declared (HOROVOD_SLO_*); the
-            # read also refreshes the slo_burn_rate{objective} gauges.
-            "slo": _slo.burn_rates(),
-        }
+        # HTTP threads race submit/restore here; read the scheduler frame
+        # under the lock, compute the (lock-taking) SLO read outside it.
+        with self._submit_lock:
+            sched = self._sched
+            active = dict(sched.active())
+            frame = {
+                "t": time.time(),
+                "slots": self.num_slots,
+                "active": len(active),
+                "queue_depth": sched.queue_depth(),
+                "queue_limit": sched.queue_limit,
+                "fill_ratio": round(sched.fill_ratio(), 4),
+                "served": self._served,
+                "steps": self._step_count,
+                "max_len": self.max_len,
+                "cache_valid": self._cache_valid,
+                "requests": {
+                    str(s): {"rid": r.rid, "generated": len(r.committed),
+                             "budget": r.max_new, "requeues": r.requeues}
+                    for s, r in active.items()},
+                # Saturation = queue at (or beyond) its declared limit:
+                # the load balancer should stop sending here.
+                "saturated": bool(sched.queue_limit
+                                  and sched.queue_depth()
+                                  >= sched.queue_limit),
+            }
+        # {} unless SLO objectives are declared (HOROVOD_SLO_*); the
+        # read also refreshes the slo_burn_rate{objective} gauges.
+        frame["slo"] = _slo.burn_rates()
+        return frame

@@ -70,7 +70,10 @@ def configure(config):
     armed = bool(getattr(config, "trace", armed))
     cap = int(getattr(config, "trace_capacity", 0) or 0)
     if cap > 0:
-        _capacity["request"] = cap
+        # _evict_locked reads _capacity under _lock; an unlocked write
+        # here could race a concurrent register()'s eviction decision.
+        with _lock:
+            _capacity["request"] = cap
 
 
 # --- ids and the active context -----------------------------------------

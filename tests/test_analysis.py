@@ -5,6 +5,7 @@ and a negative (clean) case; plus the tier-1 self-lint gate over the repo
 scope and a multi-process cross-check that the analyzer's predicted
 collective sequence matches the flight recorder's recorded one."""
 
+import json
 import os
 import sys
 import time
@@ -1198,27 +1199,23 @@ def _lint(src, rel="horovod_tpu/ops/x.py"):
 
 
 class TestLintRules:
-    def test_hvl001_lock_held_blocking_call(self):
-        bad = (
+    def test_hvl001_hvl006_retired_in_favor_of_hvdrace(self):
+        """Lock-discipline linting moved to the call-graph-aware hvdrace
+        (HVR202 sees holds across function boundaries; the old per-with
+        HVL001/HVL006 could not).  hvdlint no longer emits either code —
+        the same patterns now land as HVR202 (TestRaceRules)."""
+        held_blocking = (
             "def flush(self):\n"
             "    with self._lock:\n"
             "        self.client.allreduce(x)\n")
-        good = (
-            "def flush(self):\n"
-            "    with self._lock:\n"
-            "        pending = list(self._q)\n"
-            "    self.client.allreduce(pending)\n")
-        assert {"HVL001"} == _codes(_lint(bad))
-        assert not _lint(good)
-
-    def test_hvl001_dump_under_lock(self):
-        bad = ("with _dump_lock:\n"
-               "    dump('reason')\n")
-        good = ("with _dump_lock:\n"
-                "    n = seq\n"
-                "dump('reason')\n")
-        assert {"HVL001"} == _codes(_lint(bad))
-        assert not _lint(good)
+        held_sleep = ("import time\n"
+                      "with self._lock:\n"
+                      "    time.sleep(0.1)\n")
+        assert not _lint(held_blocking)
+        assert not _lint(held_sleep)
+        from horovod_tpu.analysis.lint import _DEFAULT_RULES
+        assert "HVL001" not in _DEFAULT_RULES
+        assert "HVL006" not in _DEFAULT_RULES
 
     def test_hvl002_undeclared_env_read(self):
         bad = "import os\nv = os.environ.get('HOROVOD_NOT_A_KNOB')\n"
@@ -1273,35 +1270,75 @@ class TestLintRules:
         assert not _lint(good)
         assert not _lint(also_good)
 
-    def test_hvl006_lock_held_sleep(self):
-        bad = ("import time\n"
-               "with self._lock:\n"
-               "    time.sleep(0.1)\n")
-        good = ("import time\n"
-                "time.sleep(0.1)\n")
-        assert {"HVL006"} == _codes(_lint(bad))
-        assert not _lint(good)
+    def test_hvl007_declared_but_not_propagated(self):
+        cfg_rel = "horovod_tpu/common/config.py"
+        src = ("KNOBS = {\n"
+               "    'HOROVOD_PROPAGATED_KNOB': 1,\n"
+               "    'HOROVOD_ORPHANED_KNOB': 2,\n"
+               "}\n")
+        findings = lint_source(
+            src, rel_path=cfg_rel, declared=_DECLARED,
+            propagated=frozenset({"HOROVOD_PROPAGATED_KNOB"}))
+        assert [(f.code, f.line) for f in findings] == [("HVL007", 3)]
+        assert "HOROVOD_ORPHANED_KNOB" in findings[0].message
+
+    def test_hvl007_exemptions_and_scope(self):
+        cfg_rel = "horovod_tpu/common/config.py"
+        # bootstrap vars and harness-namespace knobs are launcher-exempt
+        exempt = ("A = 'HOROVOD_KV_ADDR'\n"
+                  "B = 'HVD_BENCH_SOMETHING'\n"
+                  "C = 'HVD_LOCK_WITNESS'\n")
+        assert not lint_source(exempt, rel_path=cfg_rel,
+                               declared=_DECLARED, propagated=frozenset())
+        # only the Config module is in scope for HVL007
+        orphan = "K = 'HOROVOD_ORPHANED_KNOB'\n"
+        assert not _lint(orphan)
+        # inline suppression works like every other rule
+        suppressed = ("K = 'HOROVOD_ORPHANED_KNOB'  "
+                      "# hvdlint: disable=HVL007 -- driver-side only\n")
+        assert not lint_source(suppressed, rel_path=cfg_rel,
+                               declared=_DECLARED, propagated=frozenset())
+
+    def test_hvl007_live_config_is_fully_propagated(self):
+        """Every knob Config declares is exported by build_worker_env /
+        the CLI arg map (or explicitly exempt) — the real files, not a
+        corpus."""
+        from horovod_tpu.analysis.lint import propagated_knobs
+        prop = propagated_knobs()
+        assert "HOROVOD_FUSION_THRESHOLD" in prop
+        assert "HOROVOD_KV_RETRIES" in prop          # ISSUE 17 satellite
+        cfg = os.path.join(_REPO, "horovod_tpu", "common", "config.py")
+        with open(cfg) as f:
+            findings = lint_source(f.read(),
+                                   rel_path="horovod_tpu/common/config.py",
+                                   declared=_DECLARED)
+        assert not [f for f in findings if f.code == "HVL007"], \
+            "\n".join(f.render() for f in findings)
 
     def test_suppression_requires_reason(self):
         suppressed = (
-            "with self._lock:\n"
-            "    dump('x')  # hvdlint: disable=HVL001 -- ring is private\n")
+            "import os\n"
+            "v = os.environ.get('HOROVOD_BOGUS')"
+            "  # hvdlint: disable=HVL002 -- probe for a foreign build\n")
         no_reason = (
-            "with self._lock:\n"
-            "    dump('x')  # hvdlint: disable=HVL001\n")
+            "import os\n"
+            "v = os.environ.get('HOROVOD_BOGUS')"
+            "  # hvdlint: disable=HVL002\n")
         assert not _lint(suppressed)
         codes = _codes(_lint(no_reason))
-        assert "HVL000" in codes and "HVL001" in codes
+        assert "HVL000" in codes and "HVL002" in codes
 
-    def test_suppression_on_with_line(self):
-        src = ("with self._lock:  # hvdlint: disable=HVL001 -- bounded\n"
-               "    dump('x')\n")
+    def test_suppression_on_enclosing_line(self):
+        src = ("import threading\n"
+               "def arm():  # hvdlint: disable=HVL005 -- joined in stop()\n"
+               "    t = threading.Thread(target=loop)\n"
+               "    t.start()\n")
         assert not _lint(src)
 
     def test_skip_file_pragma(self):
         src = ("# hvdlint: skip-file -- generated code\n"
-               "with self._lock:\n"
-               "    dump('x')\n")
+               "import os\n"
+               "v = os.environ.get('HOROVOD_BOGUS')\n")
         assert not _lint(src)
         bare = ("# hvdlint: skip-file\n"
                 "x = 1\n")
@@ -1360,3 +1397,446 @@ class TestSelfLint:
         assert r0.returncode == 0, r0.stderr
         assert r1.returncode == 1
         assert b"HVL002" in r1.stdout
+
+
+# ---------------------------------------------------------------------------
+# hvdrace corpus: lock-graph rule classes, positive + negative
+# ---------------------------------------------------------------------------
+
+
+def _race(sources, rules=None):
+    from horovod_tpu.analysis import race
+    if isinstance(sources, str):
+        sources = {"horovod_tpu/ops/x.py": sources}
+    rep = race.analyze_sources(sources, rules=rules)
+    return rep
+
+
+def _race_codes(sources, rules=None):
+    return {f.code for f in _race(sources, rules).findings}
+
+
+class TestRaceRules:
+    def test_hvr201_lock_order_inversion(self):
+        bad = ("import threading\n"
+               "_a = threading.Lock()\n"
+               "_b = threading.Lock()\n"
+               "def f():\n"
+               "    with _a:\n"
+               "        with _b:\n"
+               "            pass\n"
+               "def g():\n"
+               "    with _b:\n"
+               "        with _a:\n"
+               "            pass\n")
+        rep = _race(bad)
+        assert {f.code for f in rep.findings} == {"HVR201"}
+        # both witness paths are in the message
+        msg = rep.findings[0].message
+        assert "f" in msg and "g" in msg
+        good = bad.replace("    with _b:\n        with _a:",
+                           "    with _a:\n        with _b:")
+        assert not _race(good).findings
+
+    def test_hvr201_inversion_through_call_graph(self):
+        """f holds _a then calls h (which takes _b); g nests the other
+        way — only visible with hold propagation across calls."""
+        bad = ("import threading\n"
+               "_a = threading.Lock()\n"
+               "_b = threading.Lock()\n"
+               "def h():\n"
+               "    with _b:\n"
+               "        pass\n"
+               "def f():\n"
+               "    with _a:\n"
+               "        h()\n"
+               "def g():\n"
+               "    with _b:\n"
+               "        with _a:\n"
+               "            pass\n")
+        assert _race_codes(bad) == {"HVR201"}
+
+    def test_hvr202_blocking_call_under_lock(self):
+        bad = ("import threading\n"
+               "import time\n"
+               "_l = threading.Lock()\n"
+               "def f():\n"
+               "    with _l:\n"
+               "        time.sleep(0.1)\n")
+        rep = _race(bad)
+        assert [(f.code, f.line) for f in rep.findings] == [("HVR202", 6)]
+        good = ("import threading\n"
+                "import time\n"
+                "_l = threading.Lock()\n"
+                "def f():\n"
+                "    with _l:\n"
+                "        n = 1\n"
+                "    time.sleep(0.1)\n")
+        assert not _race(good).findings
+
+    def test_hvr202_propagated_hold_anchors_at_root_call(self):
+        """The lock is held in f; the sleep lives in g.  The finding
+        anchors at f's call into the held region — the line a human
+        must fix — not inside g."""
+        bad = ("import threading\n"
+               "import time\n"
+               "_l = threading.Lock()\n"
+               "def g():\n"
+               "    time.sleep(0.5)\n"
+               "def f():\n"
+               "    with _l:\n"
+               "        g()\n")
+        rep = _race(bad)
+        assert [(f.code, f.line) for f in rep.findings] == [("HVR202", 8)]
+
+    def test_hvr203_guarded_field_escape(self):
+        bad = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._n = 0\n"
+               "    def inc(self):\n"
+               "        with self._lock:\n"
+               "            self._n += 1\n"
+               "    def peek(self):\n"
+               "        return self._n\n")
+        rep = _race(bad)
+        assert {f.code for f in rep.findings} == {"HVR203"}
+        assert "_n" in rep.findings[0].message
+        good = bad.replace("        return self._n",
+                           "        with self._lock:\n"
+                           "            return self._n")
+        assert not _race(good).findings
+
+    def test_hvr203_init_writes_exempt(self):
+        src = ("import threading\n"
+               "class C:\n"
+               "    def __init__(self):\n"
+               "        self._lock = threading.Lock()\n"
+               "        self._n = 0\n"
+               "    def inc(self):\n"
+               "        with self._lock:\n"
+               "            self._n += 1\n")
+        assert not _race(src).findings
+
+    def test_hvr203_module_global(self):
+        bad = ("import threading\n"
+               "_lock = threading.Lock()\n"
+               "_table = {}\n"
+               "def put(k, v):\n"
+               "    with _lock:\n"
+               "        _table[k] = v\n"
+               "def drop(k):\n"
+               "    _table.pop(k, None)\n")
+        assert _race_codes(bad) == {"HVR203"}
+
+    def test_hvr204_signal_handler_unbounded_acquire(self):
+        bad = ("import signal\n"
+               "import threading\n"
+               "_l = threading.Lock()\n"
+               "def dump():\n"
+               "    with _l:\n"
+               "        pass\n"
+               "def handler(signum, frame):\n"
+               "    dump()\n"
+               "signal.signal(signal.SIGTERM, handler)\n")
+        rep = _race(bad)
+        assert {f.code for f in rep.findings} == {"HVR204"}
+        assert "handler" in rep.findings[0].message
+        good = bad.replace("    with _l:\n        pass",
+                           "    if _l.acquire(timeout=0.5):\n"
+                           "        _l.release()")
+        assert not _race(good).findings
+
+    def test_hvr205_thread_leak_vs_shutdown_closure(self):
+        bad = ("import threading\n"
+               "def arm_watch():\n"
+               "    t = threading.Thread(target=_loop, daemon=True)\n"
+               "    t.start()\n"
+               "def _loop():\n"
+               "    pass\n")
+        assert _race_codes(bad) == {"HVR205"}
+        good = ("import atexit\n"
+                "import threading\n"
+                "_stop = threading.Event()\n"
+                "def arm_watch():\n"
+                "    t = threading.Thread(target=_loop, daemon=True)\n"
+                "    t.start()\n"
+                "def stop_watch():\n"
+                "    _stop.set()\n"
+                "def _loop():\n"
+                "    pass\n"
+                "def _cleanup():\n"
+                "    stop_watch()\n"
+                "atexit.register(_cleanup)\n")
+        assert not _race(good).findings
+
+    def test_suppression_semantics(self):
+        base = ("import threading\n"
+                "import time\n"
+                "_l = threading.Lock()\n"
+                "def f():\n"
+                "    with _l:\n"
+                "        time.sleep(0.1){}\n")
+        reasoned = base.format(
+            "  # hvdrace: disable=HVR202 -- bounded poll, test-only")
+        assert not _race(reasoned).findings
+        bare = base.format("  # hvdrace: disable=HVR202")
+        codes = _race_codes(bare)
+        assert "HVR200" in codes and "HVR202" in codes
+        on_def = base.format("").replace(
+            "def f():",
+            "def f():  # hvdrace: disable=HVR202 -- whole-function waiver")
+        assert not _race(on_def).findings
+
+    def test_skip_file_and_syntax_error(self):
+        skipped = ("# hvdrace: skip-file -- vendored\n"
+                   "import threading\n"
+                   "import time\n"
+                   "_l = threading.Lock()\n"
+                   "def f():\n"
+                   "    with _l:\n"
+                   "        time.sleep(1)\n")
+        assert not _race(skipped).findings
+        assert _race_codes("def f(:\n") == {"HVR999"}
+
+
+# ---------------------------------------------------------------------------
+# witness cross-check: synthetic log vs the static graph
+# ---------------------------------------------------------------------------
+
+
+class TestWitnessCrossCheck:
+    _SRC = {
+        "horovod_tpu/alpha.py": (
+            "import threading\n"
+            "from horovod_tpu import beta\n"
+            "_outer = threading.Lock()\n"
+            "def work():\n"
+            "    with _outer:\n"
+            "        beta.record()\n"),
+        "horovod_tpu/beta.py": (
+            "import threading\n"
+            "_inner = threading.Lock()\n"
+            "def record():\n"
+            "    with _inner:\n"
+            "        pass\n"),
+    }
+
+    def test_predicted_edge_is_green(self):
+        from horovod_tpu.analysis import race
+        rep = _race(dict(self._SRC))
+        assert not rep.findings
+        assert ("alpha:_outer", "beta:_inner") in rep.edges
+        ok = race.cross_check(rep, {("alpha:_outer", "beta:_inner"): 3})
+        assert ok == []
+
+    def test_unpredicted_edge_is_hvr210(self):
+        from horovod_tpu.analysis import race
+        rep = _race(dict(self._SRC))
+        bad = race.cross_check(rep, {("beta:_inner", "alpha:_outer"): 1})
+        assert [f.code for f in bad] == ["HVR210"]
+        assert "beta:_inner -> alpha:_outer" in bad[0].message
+
+    def test_unknown_lock_is_hvr211(self):
+        from horovod_tpu.analysis import race
+        rep = _race(dict(self._SRC))
+        bad = race.cross_check(rep, {("gamma:_mystery", "beta:_inner"): 1})
+        assert [f.code for f in bad] == ["HVR211"]
+
+    def test_site_ident_resolves_via_lock_table(self):
+        """Factory-created locks report allocation sites
+        ('<rel>.py:<line>'); cross_check maps them back through the
+        static lock table."""
+        from horovod_tpu.analysis import race
+        rep = _race(dict(self._SRC))
+        assert rep.lock_table[("horovod_tpu/alpha.py", 3)] == "alpha:_outer"
+        site_edges = {("horovod_tpu/alpha.py:3", "horovod_tpu/beta.py:2"): 2}
+        assert race.cross_check(rep, site_edges) == []
+
+    def test_dump_load_roundtrip(self, tmp_path):
+        from horovod_tpu.analysis import race
+        race.uninstall_witness()
+        race.reset_witness_edges()
+        race._witness_edges[("alpha:_outer", "beta:_inner")] = 5
+        p = str(tmp_path / "witness.jsonl")
+        race.dump_witness(p)
+        loaded = race.load_witness(p)
+        assert loaded == {("alpha:_outer", "beta:_inner"): 5}
+        race.reset_witness_edges()
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 self-race gate + live witness cross-check
+# ---------------------------------------------------------------------------
+
+
+class TestSelfRace:
+    def test_repo_tree_is_clean_and_fast(self):
+        """The package's lock graph analyzes clean — order inversions,
+        blocking-under-lock, guarded-field escapes etc. fail tier-1
+        fast — and the whole-package pass stays inside the 30 s
+        budget."""
+        from horovod_tpu.analysis import race
+        t0 = time.monotonic()
+        rep = race.analyze_paths(
+            [os.path.join(_REPO, "horovod_tpu")], base=_REPO)
+        dt = time.monotonic() - t0
+        assert rep.n_files > 100
+        assert len(rep.edges) > 20          # the graph is real, not empty
+        assert not rep.findings, "\n".join(f.render() for f in rep.findings)
+        assert dt < 30.0, f"hvdrace took {dt:.1f}s (budget 30s)"
+
+    def test_cli_entrypoint(self):
+        """`python -m horovod_tpu.analysis.race <bad file>` exits 1 with
+        the rule id on stdout; a clean file exits 0."""
+        import subprocess
+        import tempfile
+
+        bad_src = ("import threading\n"
+                   "_a = threading.Lock()\n"
+                   "_b = threading.Lock()\n"
+                   "def f():\n"
+                   "    with _a:\n"
+                   "        with _b:\n"
+                   "            pass\n"
+                   "def g():\n"
+                   "    with _b:\n"
+                   "        with _a:\n"
+                   "            pass\n")
+        with tempfile.TemporaryDirectory() as d:
+            bad = os.path.join(d, "bad.py")
+            with open(bad, "w") as f:
+                f.write(bad_src)
+            good = os.path.join(d, "good.py")
+            with open(good, "w") as f:
+                f.write("x = 1\n")
+            env = dict(os.environ, PYTHONPATH=_REPO)
+            r0 = subprocess.run(
+                [sys.executable, "-m", "horovod_tpu.analysis.race", good],
+                capture_output=True, env=env, cwd=_REPO)
+            r1 = subprocess.run(
+                [sys.executable, "-m", "horovod_tpu.analysis.race", bad],
+                capture_output=True, env=env, cwd=_REPO)
+        assert r0.returncode == 0, r0.stderr
+        assert r1.returncode == 1
+        assert b"HVR201" in r1.stdout
+
+    def test_lint_script_race_mode_json_stream(self):
+        """`scripts/lint.py --race --format json` runs hvdlint AND
+        hvdrace and stdout stays a parseable stream of JSON
+        documents."""
+        import subprocess
+
+        r = subprocess.run(
+            [sys.executable, os.path.join(_REPO, "scripts", "lint.py"),
+             "--race", "--format", "json"],
+            capture_output=True, text=True,
+            env=dict(os.environ, PYTHONPATH=_REPO), cwd=_REPO)
+        assert r.returncode == 0, r.stdout + r.stderr
+        docs = []
+        dec = json.JSONDecoder()
+        buf = r.stdout.strip()
+        while buf:
+            doc, idx = dec.raw_decode(buf)
+            docs.append(doc)
+            buf = buf[idx:].lstrip()
+        assert len(docs) == 2
+        race_doc = docs[-1]
+        assert race_doc["files"] > 100
+        assert len(race_doc["edges"]) > 20
+        assert race_doc["findings"] == []
+
+
+class TestLockWitnessLive:
+    def test_cross_check_live_serving_autopilot_telemetry(self, hvd):
+        """Runtime acquisition-order witness over a real multi-threaded
+        scenario — re-init, a serving engine fed from submitter threads
+        through a commit/restore cycle, a telemetry agent, an autopilot
+        controller, all in ONE process — then every observed edge must
+        be predicted by the static may-hold-before graph."""
+        import threading
+
+        from horovod_tpu.analysis import race
+
+        race.install_witness()
+        kv = agent = None
+        try:
+            race.reset_witness_edges()
+            # Full re-init under the witness: basics._lock -> recorder /
+            # telemetry / trace edges are recorded live.
+            hvd.shutdown()
+            hvd.init()
+
+            from horovod_tpu.models import GPT, GPTConfig
+            from horovod_tpu.serving import ServingEngine
+
+            cfg = GPTConfig.tiny(tp_axis=None, ep_axis=None,
+                                 max_position_embeddings=32)
+            model = GPT(cfg)
+            params = model.init(jax.random.PRNGKey(0),
+                                jnp.zeros((1, 4), jnp.int32))["params"]
+            eng = ServingEngine(model, params, num_slots=2,
+                                mark_steps=False)
+            assert type(eng._submit_lock).__name__ == "_WitnessProxy"
+
+            reqs = []
+            submit_lock = threading.Lock()   # test-owned, not witnessed
+
+            def submitter(seed):
+                rng = np.random.default_rng(seed)
+                for _ in range(3):
+                    p = [int(t) for t in
+                         rng.integers(0, cfg.vocab_size, 3)]
+                    r = eng.submit(p, max_new=3)
+                    with submit_lock:
+                        reqs.append(r)
+
+            threads = [threading.Thread(target=submitter, args=(s,))
+                       for s in (1, 2)]
+            for t in threads:
+                t.start()
+            for t in threads:
+                t.join()
+            for _ in range(3):
+                eng.step()
+            snap = eng.request_snapshot()        # commit (trace emits)
+            eng.load_request_snapshot(snap)      # restore
+            eng.run_until_idle()
+            assert all(r.done() for r in reqs)
+
+            from horovod_tpu.runner.http_kv import KVStoreServer
+            from horovod_tpu.telemetry.aggregator import TelemetryAgent
+
+            kv = KVStoreServer(secret="")
+            clock = [1000.0]
+            agent = TelemetryAgent(kv, rank=0, world=1, num_slices=1,
+                                   interval=1.0, gen="0",
+                                   include_metrics=False,
+                                   time_fn=lambda: clock[0])
+            for _ in range(3):
+                clock[0] += 1.0
+                agent.tick()
+
+            from horovod_tpu.autopilot.controller import AutopilotController
+            from horovod_tpu.common.config import Config
+
+            ctrl = AutopilotController(Config(
+                autopilot=True, autotune_warmup_samples=0,
+                autotune_bayes_opt_max_samples=3))
+            ctrl.tick()
+            ctrl.tick()
+        finally:
+            if agent is not None:
+                agent.stop()
+            if kv is not None:
+                kv.stop()
+            race.uninstall_witness()
+
+        edges = race.witness_edges()
+        assert edges, "witness recorded no acquisition edges"
+        rep = race.analyze_paths(
+            [os.path.join(_REPO, "horovod_tpu")], base=_REPO)
+        assert not rep.findings
+        bad = race.cross_check(rep, edges)
+        assert not bad, "\n".join(f.render() for f in bad)

@@ -368,6 +368,33 @@ class TestRoleHygiene:
         assert injector._role == "worker"
         assert flight_recorder._role == "worker"
 
+    def test_roles_restored_when_kv_startup_fails(self, tmp_path,
+                                                  monkeypatch):
+        """The role claim precedes KV/driver startup; a bind failure (or
+        any construction error) must still hand the roles back — the
+        try/finally covers everything from the claim onward, not just
+        the post-start wait (the startup-failure window of the PR-14
+        leak)."""
+        import argparse
+
+        from horovod_tpu.flight import recorder as flight_recorder
+        from horovod_tpu.runner.elastic.driver import run_elastic_driver
+        from horovod_tpu.runner.http_kv import KVStoreServer
+
+        def boom(self):
+            raise RuntimeError("kv bind failed")
+
+        monkeypatch.setattr(KVStoreServer, "start", boom)
+        args = argparse.Namespace(
+            host_discovery_script=None, hosts="localhost:1",
+            command=[sys.executable, "-c", "pass"], min_np=1, max_np=1,
+            np=1, reset_limit=None, start_timeout=5,
+            output_filename=str(tmp_path / "out"))
+        with pytest.raises(RuntimeError, match="kv bind failed"):
+            run_elastic_driver(args)
+        assert injector._role == "worker"
+        assert flight_recorder._role == "worker"
+
 
 class TestDriverHostRemove:
     def test_discovery_window_removes_then_restores(self, monkeypatch):

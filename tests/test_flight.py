@@ -578,3 +578,56 @@ class TestMultiprocSmoke:
         assert seqs, "no dispatches recorded"
         for rank in range(4):
             assert report["steps"][str(rank)]["steps_marked"] == 3
+
+
+class TestDumpSignalSafety:
+    def test_dump_renders_without_calling_get(self, tmp_path, monkeypatch):
+        """hvdrace HVR204 regression: dump() runs from signal handlers
+        and already holds its own recorder reference; rendering through
+        get() would re-acquire the recorder lock unboundedly — a SIGTERM
+        landing inside events() self-deadlocks."""
+        recorder.set_enabled(True)
+        recorder.record_event("test", what="signal_safety")
+
+        def trap():
+            raise AssertionError("dump() must not call get()")
+
+        monkeypatch.setattr(recorder, "get", trap)
+        p = recorder.dump("signal_safety_test", directory=str(tmp_path),
+                          force=True)
+        assert p and os.path.exists(p)
+        rows = [json.loads(line) for line in open(p)]
+        assert rows[0]["reason"] == "signal_safety_test"
+
+
+class TestWatchdogLifecycle:
+    def test_stop_collective_abort_ends_thread_and_rearm_works(
+            self, monkeypatch):
+        """hvdrace HVR205 regression: the membership watchdog used to be
+        an unstoppable `while True: sleep` daemon; shutdown must end it
+        (a torn-down process must not keep polling the KV store), and a
+        later elastic run must be able to re-arm."""
+        from horovod_tpu.elastic import worker
+        from horovod_tpu.runner.http_kv import KVStoreServer
+
+        srv = KVStoreServer()
+        port = srv.start()
+        monkeypatch.setenv("HOROVOD_ELASTIC", "1")
+        monkeypatch.setenv("HOROVOD_KV_ADDR", "127.0.0.1")
+        monkeypatch.setenv("HOROVOD_KV_PORT", str(port))
+        monkeypatch.setattr(worker, "_WATCH_INTERVAL", 0.05)
+        try:
+            worker.arm_collective_abort(1)
+            t = worker._watch_thread
+            assert t is not None and t.is_alive()
+            worker.stop_collective_abort()
+            assert worker._watch_thread is None
+            t.join(2.0)
+            assert not t.is_alive()
+            # re-arm after stop: the stop event must have been cleared
+            worker.arm_collective_abort(2)
+            t2 = worker._watch_thread
+            assert t2 is not None and t2.is_alive()
+        finally:
+            worker.stop_collective_abort()
+            srv.stop()

@@ -507,3 +507,53 @@ def _hist_count(reg, name):
     for s in reg.snapshot().get(name, {}).get("series", ()):
         total += s.get("count", 0)
     return total
+
+
+class TestEngineLockDiscipline:
+    """hvdrace HVR201 regressions: the engine's commit/restore paths must
+    emit into the trace/flight/metrics sinks AFTER releasing _submit_lock
+    (submit/step nest _submit_lock -> sink locks; emitting under the lock
+    on the restore path would build the opposite nesting)."""
+
+    def test_commit_restore_emit_trace_outside_submit_lock(
+            self, hvd, tiny_serving, monkeypatch):
+        from horovod_tpu.serving import ServingEngine
+        from horovod_tpu.serving import engine as engine_mod
+
+        model, params, cfg = tiny_serving
+        eng = ServingEngine(model, params, num_slots=2, mark_steps=False)
+        reqs = [eng.submit([1, 2, 3], max_new=4) for _ in range(3)]
+        for _ in range(2):
+            eng.step()                      # admit into slots
+        calls = []
+        real = engine_mod.trace.add_instant
+
+        def probe(*a, **k):
+            assert not eng._submit_lock.locked(), \
+                "trace sink invoked while _submit_lock held"
+            calls.append(a)
+            return real(*a, **k)
+
+        monkeypatch.setattr(engine_mod.trace, "add_instant", probe)
+        snap = eng.request_snapshot()
+        eng.load_request_snapshot(snap)
+        assert calls, "commit/restore markers must still emit"
+        eng.run_until_idle()
+        assert all(r.done() for r in reqs)
+
+    def test_snapshot_reads_slo_outside_submit_lock(
+            self, hvd, tiny_serving, monkeypatch):
+        from horovod_tpu.serving import ServingEngine
+        from horovod_tpu.serving import engine as engine_mod
+
+        model, params, cfg = tiny_serving
+        eng = ServingEngine(model, params, num_slots=2, mark_steps=False)
+
+        def probe():
+            assert not eng._submit_lock.locked(), \
+                "slo.burn_rates() called while _submit_lock held"
+            return {}
+
+        monkeypatch.setattr(engine_mod._slo, "burn_rates", probe)
+        frame = eng.snapshot()
+        assert "slo" in frame

@@ -520,3 +520,32 @@ class TestTracingOverheadGuard:
                 pass
         per = (time.perf_counter() - t0) / N * 1e6
         assert per < 10.0, f"disarmed trace.span cost {per:.2f} us"
+
+
+class TestConfigureLockDiscipline:
+    def test_capacity_write_holds_trace_lock(self):
+        """hvdrace HVR203 regression: _evict_locked reads _capacity under
+        _lock; configure()'s capacity write must take the same lock or it
+        races a concurrent register()'s eviction decision."""
+        import types
+
+        class SpyDict(dict):
+            def __init__(self, base):
+                super().__init__(base)
+                self.held_at_write = []
+
+            def __setitem__(self, key, value):
+                self.held_at_write.append(trace._lock.locked())
+                super().__setitem__(key, value)
+
+        orig = dict(trace._capacity)
+        spy = SpyDict(trace._capacity)
+        trace._capacity = spy
+        try:
+            trace.configure(types.SimpleNamespace(trace=trace.armed,
+                                                  trace_capacity=64))
+            assert spy.held_at_write == [True]
+            assert trace._capacity["request"] == 64
+        finally:
+            restored = dict(orig)
+            trace._capacity = restored
