@@ -979,6 +979,165 @@ def _bench_hierarchy_sweep(hvd):
           "cross leg takes it ~4x below)", 0.0)
 
 
+def _moe_static_cost(hvd, shape, n, slices, measured):
+    """The hvdcost ride-along for the MoE sweep: price the largest rung's
+    expert-dispatch alltoall flat AND hierarchically (counterfactual
+    pricing — use_registry=False so the sweep's own strategy/cross pins
+    don't leak in) and record the per-tier prediction next to the
+    measured `wire_bytes_total{tier}` deltas. The hierarchical legs must
+    land at delta 0: the static model and _HierAlltoallPlan book the
+    same wire.hierarchical_a2a_bytes integers."""
+    try:
+        from horovod_tpu.analysis import cost as an_cost
+        from horovod_tpu.analysis.program import check_program
+        from horovod_tpu.common.config import Config
+
+        x = np.zeros((n,) + shape, np.float32)
+
+        def step(x):
+            return hvd.alltoall(x)
+
+        rec = {"payload_mb": round(x.nbytes / 2**20, 2), "world": n,
+               "num_slices": slices}
+        legs = (("flat", Config()),
+                ("hier", Config(hierarchical_alltoall=True)),
+                ("hier_int8", Config(hierarchical_alltoall=True,
+                                     alltoall_cross_dtype="int8")))
+        for leg, cfg in legs:
+            rep = check_program(step, (x,), world_size=n, config=cfg)
+            cr = an_cost.cost_report(rep, config=cfg, num_slices=slices,
+                                     use_registry=False)
+            got = measured.get(leg)
+            predicted = dict(cr.runtime_bytes_by_tier)
+            rec[leg] = {
+                "predicted_bytes_by_tier": predicted,
+                "measured_bytes_by_tier": got,
+                "delta_dcn": (got["dcn"] - predicted["dcn"])
+                if got else None,
+                "delta_ici": (got["ici"] - predicted["ici"])
+                if got else None,
+            }
+        _progress_record("static_cost", static_cost=rec)
+        _mark(f"static_cost moe: hier_int8 predicted "
+              f"dcn={rec['hier_int8']['predicted_bytes_by_tier']['dcn']}B "
+              f"vs measured "
+              f"{(rec['hier_int8']['measured_bytes_by_tier'] or {}).get('dcn')}"
+              f" (delta {rec['hier_int8']['delta_dcn']})")
+    except Exception as e:  # noqa: BLE001 — evidence must not fail bench
+        _progress_record("static_cost", error=str(e)[:160])
+
+
+def _bench_moe_sweep(hvd):
+    """Hierarchical expert-dispatch sweep (`HVD_BENCH_MODEL=moe_sweep`):
+    the MoE dispatch alltoall — per-rank (tokens, hidden) expert slots,
+    the shape parallel/moe.py exchanges — over a token/expert ladder at
+    flat / hierarchical / hierarchical+int8-cross strategy under a
+    forced 2-slice hierarchy, reporting per-leg dispatch time and the
+    PER-TIER `wire_bytes_total{tier}` deltas. The provable evidence
+    (docs/performance.md "Hierarchical expert dispatch"): the exact
+    decomposition's DCN bytes equal the flat exchange's TOTAL divided by
+    the slice width, and the block-scaled int8 cross leg takes them ~4x
+    below that. Every (ladder, strategy) cell lands as a labeled
+    `moe_sweep` record on HVD_BENCH_PROGRESS_FILE, plus a `static_cost`
+    cross-check record (delta 0 on the hierarchical legs); the final
+    BENCH record carries the int8-cross-vs-exact-hier DCN ratio on the
+    largest rung."""
+    from horovod_tpu.metrics import instruments as ins
+    from horovod_tpu.ops import collective_ops as C, wire
+
+    n = hvd.size()
+    slices, _ = C._live_slices(n)
+    if slices <= 1:
+        os.environ["HOROVOD_MESH_SLICES"] = "2"  # hvdlint: disable=HVL003 -- bench-local virtual hierarchy for its own process; never exported to workers
+        ins.reset_tier_split()
+        C.clear_program_caches()
+        slices, _ = C._live_slices(n)
+    if slices <= 1:
+        _emit_failure("moe_sweep_dcn_bytes_ratio",
+                      "int8-cross/exact-hier DCN bytes ratio",
+                      f"no slice hierarchy possible at world={n}")
+        return 1
+    iters = int(os.environ.get("HVD_BENCH_ITERS", "10"))
+    # Token/expert ladder: capacity rows per (expert, peer) at a fixed
+    # hidden size — per-rank payload (n*capacity, hidden), the dispatch
+    # slots parallel/moe.py reshapes into (experts, capacity, hidden).
+    hidden = 64
+    ladder = [16, 128, 512]            # capacity rungs
+    rng = np.random.default_rng(0)
+
+    def tier_bytes():
+        out = {"ici": 0.0, "dcn": 0.0}
+        snap = ins.get_registry().snapshot()
+        for s in snap.get("wire_bytes_total", {}).get("series", ()):
+            t = s["labels"].get("tier")
+            if t in out:
+                out[t] += s["value"]
+        return out
+
+    legs = (("flat", "flat", ""),
+            ("hier", "hier", ""),
+            ("hier_int8", "hier_qcross", "int8"))
+    results = {}
+    ratio_largest = 0.0
+    parity_largest = None
+    for cap in ladder:
+        x = jnp.asarray(
+            rng.standard_normal((n, n * cap, hidden)), jnp.float32)
+        payload_mb = x.nbytes / 2**20
+        for leg, strategy, cross in legs:
+            hvd.set_alltoall_strategy(strategy)
+            hvd.set_alltoall_cross_dtype(cross)
+            try:
+                jax.block_until_ready(hvd.alltoall(x))   # warm/compile
+                b0 = tier_bytes()
+                t0 = time.perf_counter()
+                for _ in range(iters):
+                    out = hvd.alltoall(x)
+                jax.block_until_ready(out)
+                dt = (time.perf_counter() - t0) / iters
+                b1 = tier_bytes()
+            finally:
+                hvd.set_alltoall_strategy("")
+                hvd.set_alltoall_cross_dtype("")
+            delta = {t: (b1[t] - b0[t]) / max(iters, 1)
+                     for t in ("ici", "dcn")}
+            rec = {"capacity": cap, "hidden": hidden,
+                   "payload_mb": round(payload_mb, 2), "strategy": leg,
+                   "num_slices": slices,
+                   "us_per_op": round(dt * 1e6, 1),
+                   "ici_bytes_per_op": delta["ici"],
+                   "dcn_bytes_per_op": delta["dcn"]}
+            results[(cap, leg)] = {**rec, "tiers": delta}
+            _progress_record("moe_sweep", **rec)
+            _mark(f"moe_sweep cap={cap} {leg}: {dt * 1e6:.0f}us/op, "
+                  f"dcn {delta['dcn'] / 2**20:.3f} MB/op, "
+                  f"ici {delta['ici'] / 2**20:.3f} MB/op")
+        flat = results[(cap, "flat")]["tiers"]
+        hier_dcn = results[(cap, "hier")]["tiers"]["dcn"]
+        int8_dcn = results[(cap, "hier_int8")]["tiers"]["dcn"]
+        # The acceptance identities: exact-hier DCN == flat TOTAL / S
+        # (the cross leg's (S-1)/S split of the undivided exchange),
+        # int8 cross well below that.
+        parity_largest = hier_dcn - (flat["ici"] + flat["dcn"]) / slices
+        if hier_dcn:
+            ratio_largest = int8_dcn / hier_dcn
+    largest = ladder[-1]
+    _progress_record(
+        "moe_sweep_summary", capacity=largest,
+        dcn_parity_delta=parity_largest,
+        int8_vs_hier_dcn_ratio=round(ratio_largest, 4))
+    _moe_static_cost(hvd, (n * largest, hidden), n, slices, {
+        leg: results[(largest, leg)]["tiers"]
+        for leg, _, _ in legs})
+    wire.clear_strategy_registry()
+    wire.clear_wire_registry()
+    wire.reset_error_feedback()
+    _emit("moe_sweep_dcn_bytes_ratio", round(ratio_largest, 4),
+          "int8-cross/exact-hier DCN bytes-on-wire ratio (largest rung; "
+          "exact hierarchical dispatch holds DCN at flat-total/slices "
+          "and the block-scaled int8 cross leg takes it ~4x below)", 0.0)
+
+
 def _compression():
     """HVD_BENCH_COMPRESSION=none|bf16|fp16|int8|powersgd[:rank] — wire
     compression A/B for the training benches. On the single bench chip
@@ -1369,6 +1528,8 @@ _EXTRA_MODELS = {
     "hierarchy_sweep": (_bench_hierarchy_sweep,
                         "hierarchy_sweep_dcn_bytes_ratio",
                         "hier-int8/flat DCN bytes ratio"),
+    "moe_sweep": (_bench_moe_sweep, "moe_sweep_dcn_bytes_ratio",
+                  "int8-cross/exact-hier DCN bytes ratio"),
     "serving_sweep": (_bench_serving_sweep,
                       "serving_sweep_peak_tokens_per_sec",
                       "tokens/sec/chip"),

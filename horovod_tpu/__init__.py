@@ -78,7 +78,9 @@ from horovod_tpu import elastic  # noqa: F401
 from horovod_tpu.ops import in_jit  # noqa: F401
 from horovod_tpu.ops import wire  # noqa: F401
 from horovod_tpu.ops.wire import (set_dispatch_strategy,  # noqa: F401
-                                  set_wire_dtype, wire_dtype_for)
+                                  set_wire_dtype, wire_dtype_for,
+                                  set_alltoall_strategy,
+                                  set_alltoall_cross_dtype)
 from horovod_tpu.ops.compression import Compression  # noqa: F401
 from horovod_tpu.ops.sync_batch_norm import SyncBatchNorm  # noqa: F401
 from horovod_tpu.optim import (  # noqa: F401

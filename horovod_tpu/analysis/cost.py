@@ -142,6 +142,39 @@ def _hier_for_event(event, config, num_slices, use_registry=True):
         or getattr(config, "wire_dtype", ""))
 
 
+def _a2a_hier_for_event(event, config, num_slices, use_registry=True):
+    """Mirror of the runtime's hierarchical-ALLTOALL verdict
+    (``collective_ops._eager_a2a_hier_for``) for one predicted eager
+    alltoall: the effective cross-leg wire string when the dispatch layer
+    would decompose this event (slice-local a2a -> cross-slice a2a), else
+    None for the flat path. Shares the a2a strategy registry /
+    ``HOROVOD_HIERARCHICAL_ALLTOALL`` chain and the single-tensor
+    equal-splits gates — and, deliberately, the no-inherit cross-wire
+    policy: ``alltoall_cross_dtype`` only, never the allreduce wire
+    knobs."""
+    if event.op != "alltoall" or event.origin == "jit" \
+            or event.ps != "global" or num_slices <= 1:
+        return None
+    default = "hier_qcross" \
+        if getattr(config, "hierarchical_alltoall", False) else ""
+    strategy = _wire.alltoall_strategy_for(event.ps, default) \
+        if use_registry else default
+    if strategy not in ("hier", "hier_qcross"):
+        return None
+    if len(event.shapes) != 1:
+        return None
+    shape = event.shapes[0]
+    n = int(shape[0]) if shape else 0
+    if len(shape) < 2 or n < 2 or int(shape[1]) % n != 0:
+        return None
+    if strategy != "hier_qcross":
+        return ""
+    if use_registry:
+        return _wire.alltoall_cross_wire_for(event.ps, config)
+    return _wire.resolve_wire_dtype(
+        getattr(config, "alltoall_cross_dtype", ""))
+
+
 def _event_legs(event, world_size, config, use_registry=True,
                 num_slices=1):
     """Transfer legs for one predicted event: a list of ``(bytes,
@@ -212,6 +245,27 @@ def _event_legs(event, world_size, config, use_registry=True,
             # dtype), matching the runtime's accounting exactly.
             return [(2 * n * flat_len * 2, "ring", req)]
         return [(2 * event.nbytes, "ring", str(dtypes[0]))]
+    if event.op == "alltoall":
+        hier_cross = _a2a_hier_for_event(event, config, num_slices,
+                                         use_registry)
+        if hier_cross is not None:
+            # The hierarchical a2a tier: slice-local exchange at the
+            # payload dtype (explicit ici), cross-slice exchange on the
+            # expert cross wire split by its own (S-1)/S foreign-slice
+            # fraction — the same wire.hierarchical_a2a_bytes integers
+            # _HierAlltoallPlan records, which is what makes
+            # cross_check_bytes exact. Non-float payloads keep the cross
+            # leg exact (the runtime verdict's float gate).
+            all_float = all(_is_float_name(d) for d in dtypes)
+            h = _wire.hierarchical_a2a_bytes(
+                event.per_rank_elems(), n, num_slices, width,
+                cross_wire=hier_cross if all_float else "")
+            label = str(dtypes[0])
+            cross_label = h["cross_label"] or label
+            ct = h["cross_tiers"]
+            return [(h["local"], "ici", label),
+                    (ct["ici"], "ici", cross_label),
+                    (ct["dcn"], "dcn", cross_label)]
     sched = "a2a" if event.op in _A2A_OPS else "ring"
     return [(event.nbytes, sched, str(dtypes[0]))]
 
@@ -459,6 +513,28 @@ def cost_report(report, *, config=None, num_slices=None,
                         getattr(config, "wire_dtype_dcn", "")
                         or getattr(config, "wire_dtype", ""))
             hh = _wire.hierarchical_wire_bytes(
+                e.per_rank_elems(), len(members), slices_spanned, width,
+                cross_wire=cross)
+            hier["ici"] += hh["ici"] * occurrences
+            hier["dcn"] += hh["dcn"] * occurrences
+        elif e.op == "alltoall" and e.origin != "jit" \
+                and slices_spanned > 1:
+            # The a2a twin: an eager alltoall priced AS IF dispatched
+            # hierarchically (slice-local leg all-ICI, cross leg on the
+            # expert cross wire split (S-1)/S) — the same
+            # wire.hierarchical_a2a_bytes integers _HierAlltoallPlan
+            # records, so when the tier is armed the what-if IS the
+            # as-dispatched prediction. Cross dtype resolves through the
+            # a2a chain only (alltoall_cross_dtype — activations never
+            # inherit the allreduce wire knobs).
+            width = jaxpr_walk.dtype_width(e.dtypes[0]) if e.dtypes else 4
+            all_float = all(_is_float_name(d) for d in e.dtypes)
+            cross = ""
+            if all_float:
+                cross = _wire.alltoall_cross_wire_for(e.ps, config) \
+                    if use_registry else _wire.resolve_wire_dtype(
+                        getattr(config, "alltoall_cross_dtype", ""))
+            hh = _wire.hierarchical_a2a_bytes(
                 e.per_rank_elems(), len(members), slices_spanned, width,
                 cross_wire=cross)
             hier["ici"] += hh["ici"] * occurrences

@@ -587,6 +587,7 @@ def _advisory_findings(events, rank, config, reuse_info, world_size=None):
             break
     wire = getattr(config, "wire_dtype", "")
     cross_cfg = getattr(config, "wire_dtype_dcn", "")
+    a2a_cross_cfg = getattr(config, "alltoall_cross_dtype", "")
     # The block-scaled quantized exchange shows up in the jaxpr as 1-byte
     # collectives (int8 / float8 all_to_all + all_gather, ops/wire.py):
     # its presence means the program IS quantizing in jit — the small
@@ -599,13 +600,14 @@ def _advisory_findings(events, rank, config, reuse_info, world_size=None):
     quant_jit = [e for e in events if e.origin == "jit"
                  and any(d == "int8" or str(d).startswith("float8")
                          for d in e.dtypes)]
-    if (wire or cross_cfg) and not quant_jit:
+    if (wire or cross_cfg or a2a_cross_cfg) and not quant_jit:
         fp32_jit = [e for e in events if e.origin == "jit"
                     and any("float32" in d for d in e.dtypes)]
         if fp32_jit:
             e = fp32_jit[0]
             knob = f"wire_dtype={wire}" if wire \
-                else f"wire_dtype_dcn={cross_cfg}"
+                else (f"wire_dtype_dcn={cross_cfg}" if cross_cfg
+                      else f"alltoall_cross_dtype={a2a_cross_cfg}")
             findings.append(Finding(
                 code="HVP106", severity=INFO,
                 message=(f"{knob} is configured but "
@@ -614,7 +616,8 @@ def _advisory_findings(events, rank, config, reuse_info, world_size=None):
                          "eager/fused dispatches; inside jit use "
                          "Compression.int8 on the optimizer, "
                          "strategies.allreduce_quantized, or the "
-                         "2-level strategies.allreduce_tiered"),
+                         "2-level strategies.allreduce_tiered / "
+                         "alltoall_tiered"),
                 rank=rank, op=e.op, ps=e.ps))
     # HVP113: the hierarchical decomposition over a 1-slice layout is
     # pure overhead — two extra ICI legs (local RS + AG) and no DCN to
@@ -652,6 +655,27 @@ def _advisory_findings(events, rank, config, reuse_info, world_size=None):
                              "HOROVOD_MESH_SLICES / run multi-slice, or "
                              "drop the knob"),
                     rank=rank, op="allreduce", ps="global"))
+            # The a2a twin: the hierarchical alltoall tier armed (knob or
+            # registry pin) over a 1-slice layout — the slice-local leg
+            # duplicates the whole exchange on the same ICI the 'cross'
+            # leg rides, pure overhead with no DCN to save.
+            from horovod_tpu.ops import wire as _wire_mod
+            a2a_armed = getattr(config, "hierarchical_alltoall", False) \
+                or _wire_mod.alltoall_strategy_for("global") \
+                in ("hier", "hier_qcross")
+            if a2a_armed and any(e.op == "alltoall" and e.origin != "jit"
+                                 for e in events):
+                findings.append(Finding(
+                    code="HVP113", severity=INFO,
+                    message=("HOROVOD_HIERARCHICAL_ALLTOALL is armed but "
+                             f"the {world_size}-rank world has a 1-slice "
+                             "layout — the dispatch layer will keep "
+                             "every alltoall flat (the slice-local leg "
+                             "would duplicate the exchange on the same "
+                             "ICI for no DCN saving); set "
+                             "HOROVOD_MESH_SLICES / run multi-slice, or "
+                             "drop the knob"),
+                    rank=rank, op="alltoall", ps="global"))
     if quant_jit and getattr(config, "wire_error_feedback", False) \
             and wire in ("int8", "fp8"):
         # The eager/fused paths keep their residuals in the runtime store,

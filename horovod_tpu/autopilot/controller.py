@@ -91,6 +91,13 @@ class AutopilotController:
         # (previous cross wire, dcn bytes baseline) to revert to.
         self._cross_trial = None
         self._cross_adopted = False
+        # The a2a (expert-dispatch) twins of the two states above: the
+        # previous expert cross wire when the sweep armed int8 for a
+        # hier_qcross sample, and the guarded one-epoch trial of the
+        # quantized expert leg after freeze.
+        self._a2a_qcross_armed = None
+        self._a2a_cross_trial = None
+        self._a2a_cross_adopted = False
         self._pending_acks = {}    # req_id -> action awaiting driver ack
         self._stop = threading.Event()
         self._thread = None
@@ -130,9 +137,19 @@ class AutopilotController:
         from horovod_tpu.autotune import (ParameterManager,
                                           sweep_categoricals)
 
-        cats = sweep_categoricals(runtime.strategy,
-                                  self._config.wire_dtype,
-                                  self._slices() > 1)
+        from horovod_tpu.ops import wire as _wire
+
+        # The hierarchical-alltoall tier joins the sweep only when it is
+        # armed (knob or registry pin): a job with no expert dispatch
+        # must not spend scored epochs on a lever it cannot move.
+        a2a_default = "hier_qcross" \
+            if getattr(self._config, "hierarchical_alltoall", False) else ""
+        a2a_cur = _wire.alltoall_strategy_for("global", a2a_default)
+        cats = sweep_categoricals(
+            runtime.strategy, self._config.wire_dtype,
+            self._slices() > 1, a2a_strategy=a2a_cur or None,
+            a2a_cross_dtype=getattr(self._config, "alltoall_cross_dtype",
+                                    ""))
         return ParameterManager(
             warmup_samples=0,
             steps_per_sample=1,
@@ -244,6 +261,7 @@ class AutopilotController:
             # post-mortem-able, never silently absorbed).
             runtime = self._runtime()
             self._judge_cross_trial(frame, runtime)
+            self._judge_a2a_cross_trial(frame, runtime)
             self._steer_overlap(frame, runtime)
             if frame["wall_mean_s"] is not None:
                 if len(self._walls) >= _MIN_HISTORY:
@@ -289,6 +307,7 @@ class AutopilotController:
                          threshold=thr, cycle_ms=round(cyc, 3),
                          categoricals=dict(cats))
             self._maybe_try_cross(frame, runtime)
+            self._maybe_try_a2a_cross(frame, runtime)
             return
         thr, cyc, cats = update
         changed = self._apply(runtime, thr, cyc, cats)
@@ -347,6 +366,38 @@ class AutopilotController:
             new_wire = jnp.dtype(wire).type
             if new_wire is not runtime.wire_dtype:
                 runtime.wire_dtype = new_wire
+                changed = True
+        a2a = cats.get("a2a_strategy")
+        if a2a:
+            if _wire.alltoall_strategy_for("global") != a2a:
+                _wire.runtime_sync_alltoall_strategy(a2a, "global")
+                changed = True
+            if a2a == "hier_qcross":
+                # hier_qcross MEANS a quantized expert cross leg — same
+                # rule as torus_qcross above: when the a2a cross chain
+                # resolves to full precision the sweep must arm the wire
+                # that defines the strategy, and restore it the moment
+                # the sweep moves off (a leftover int8 pin would read as
+                # a user opt-in and lossy-quantize activations the user
+                # never asked to quantize).
+                acw = _wire.alltoall_cross_wire_for("global", self._config)
+                label = _wire.quantized_label("int8")
+                if not _wire.is_quantized(acw) and label \
+                        and self._a2a_qcross_armed is None:
+                    self._a2a_qcross_armed = acw or ""
+                    _wire.runtime_sync_alltoall_cross_dtype(label,
+                                                            "global")
+                    changed = True
+            elif self._a2a_qcross_armed is not None:
+                prev = self._a2a_qcross_armed
+                self._a2a_qcross_armed = None
+                _wire.runtime_sync_alltoall_cross_dtype(prev, "global")
+                changed = True
+        a2a_cw = cats.get("a2a_cross_dtype")
+        if a2a_cw is not None and self._a2a_qcross_armed is None:
+            cur = _wire.alltoall_cross_wire_for("global", self._config)
+            if cur != _wire.resolve_wire_dtype(a2a_cw):
+                _wire.runtime_sync_alltoall_cross_dtype(a2a_cw, "global")
                 changed = True
         if changed:
             # Mirror the flush-snapshot adoption into the eager
@@ -452,6 +503,65 @@ class AutopilotController:
             return
         self._cross_adopted = True
         self._record("cross_wire", "adopted", frame, dcn_bytes=dcn_now)
+
+    def _maybe_try_a2a_cross(self, frame, runtime):
+        """The expert-dispatch twin of :meth:`_maybe_try_cross`: after
+        the tuner froze, if the hierarchical alltoall tier won (or is
+        pinned) and its cross leg still runs full precision, trial the
+        quantized expert cross wire for one epoch. Activations carry no
+        error feedback, so the guardrail is strict: kept only if DCN
+        bytes actually collapse and the wall does not regress."""
+        from horovod_tpu.ops import wire as _wire
+        if self._a2a_cross_adopted or self._a2a_cross_trial is not None:
+            return
+        default = "hier_qcross" \
+            if getattr(self._config, "hierarchical_alltoall", False) else ""
+        strategy = _wire.alltoall_strategy_for("global", default)
+        if strategy not in ("hier", "hier_qcross") or self._slices() <= 1:
+            return
+        current = _wire.alltoall_cross_wire_for("global", self._config)
+        if _wire.is_quantized(current):
+            self._a2a_cross_adopted = True
+            return                     # already quantized by config/user
+        label = _wire.quantized_label("int8")
+        if label is None:
+            return
+        prev = current or ""
+        _wire.runtime_sync_alltoall_strategy("hier_qcross", "global")
+        _wire.runtime_sync_alltoall_cross_dtype(label, "global")
+        self._a2a_cross_trial = (prev, frame.get("dcn_bytes") or 0.0,
+                                 strategy)
+        self._record("a2a_cross_wire", "trial", frame, wire=label)
+
+    def _judge_a2a_cross_trial(self, frame, runtime):
+        """Revert-on-regression for the expert cross-wire trial — same
+        judge as :meth:`_judge_cross_trial` (robust-z on the wall,
+        DCN-bytes collapse below 0.75x the pre-trial baseline), reverting
+        BOTH the wire and the strategy to their saved pre-trial
+        values."""
+        from horovod_tpu.ops import wire as _wire
+        if self._a2a_cross_trial is None:
+            return
+        if not frame["flushes"] and not frame["steps"]:
+            return                      # nothing measured yet; keep waiting
+        prev_wire, prev_dcn, prev_strategy = self._a2a_cross_trial
+        self._a2a_cross_trial = None
+        wall = frame.get("wall_mean_s")
+        regressed = False
+        if wall is not None and len(self._walls) >= _MIN_HISTORY:
+            z, _ = _robust_z(wall, list(self._walls))
+            regressed = z >= self._z_threshold
+        dcn_now = frame.get("dcn_bytes") or 0.0
+        shrunk = prev_dcn > 0.0 and dcn_now < 0.75 * prev_dcn
+        if regressed or not shrunk:
+            _wire.runtime_sync_alltoall_cross_dtype(prev_wire, "global")
+            _wire.runtime_sync_alltoall_strategy(prev_strategy, "global")
+            self._record("a2a_cross_wire", "reverted", frame,
+                         dcn_bytes=dcn_now, regressed=regressed)
+            return
+        self._a2a_cross_adopted = True
+        self._record("a2a_cross_wire", "adopted", frame,
+                     dcn_bytes=dcn_now)
 
     # --- remediation arm ------------------------------------------------
 

@@ -31,7 +31,8 @@ from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.autotune.bayesian_optimization import BayesianOptimization
 
 
-def sweep_categoricals(current_strategy, config_wire_dtype, has_slices):
+def sweep_categoricals(current_strategy, config_wire_dtype, has_slices,
+                       a2a_strategy=None, a2a_cross_dtype=""):
     """THE categorical knob set of the strategy/wire sweep — one
     definition for the flush-window tuner (FusionRuntime) and the
     autopilot controller, so the two can never sweep different spaces.
@@ -40,7 +41,16 @@ def sweep_categoricals(current_strategy, config_wire_dtype, has_slices):
     1-slice layout it is pure overhead — hvdlint HVP113). The wire
     categorical exists only when the user already opted into a 16-bit or
     quantized wire, and sweeps UP in precision only (precision policy is
-    never a speed knob)."""
+    never a speed knob).
+
+    ``a2a_strategy`` (the hierarchical-alltoall tier's current strategy,
+    None = tier disarmed / no alltoalls to steer) adds the expert-
+    dispatch levers: the a2a strategy sweeps flat | hier | hier_qcross —
+    again only over a real slice hierarchy — and, when the user already
+    opted into a QUANTIZED expert cross wire (``a2a_cross_dtype``), the
+    cross-leg dtype sweeps up to the exact leg (``""``). The sweep never
+    quantizes activations on its own — that is the autopilot's guarded
+    one-epoch trial (revert unless DCN collapses), not a category."""
     import jax.numpy as jnp
 
     from horovod_tpu.ops import wire as _wire
@@ -56,6 +66,13 @@ def sweep_categoricals(current_strategy, config_wire_dtype, has_slices):
     elif resolved:
         cats["wire_dtype"] = [
             resolved, "bfloat16" if resolved == "float16" else "float16"]
+    if a2a_strategy and has_slices:
+        cats["a2a_strategy"] = [a2a_strategy] + [
+            s for s in ("flat", "hier", "hier_qcross")
+            if s != a2a_strategy]
+        resolved_a2a = _wire.resolve_wire_dtype(a2a_cross_dtype)
+        if _wire.is_quantized(resolved_a2a):
+            cats["a2a_cross_dtype"] = [resolved_a2a, ""]
     return cats
 
 

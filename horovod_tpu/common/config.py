@@ -157,6 +157,20 @@ class Config:
     # local AG. Opt-in: a 1-slice layout would pay two extra ICI legs for
     # no DCN saving (hvdlint HVP113).
     hierarchical_dispatch: bool = False
+    # Hierarchical ALLTOALL tier (MoE expert dispatch, ISSUE 18): when a
+    # slice hierarchy exists, eligible equal-splits alltoalls (eager) and
+    # the MoE layer's dispatch/combine (jit) decompose into a slice-local
+    # a2a (ICI) -> cross-slice a2a on the per-tier wire (DCN). Opt-in for
+    # the same reason as hierarchical_dispatch (hvdlint HVP113). Distinct
+    # from the allreduce knob on purpose: a2a moves activations.
+    hierarchical_alltoall: bool = False
+    # Wire dtype of the hierarchical alltoall's CROSS-SLICE (DCN) leg
+    # ("" = exact). Deliberately does NOT inherit wire_dtype/
+    # wire_dtype_dcn: alltoall payloads are activations without error
+    # feedback, so quantizing them is an explicit choice
+    # (docs/performance.md "when NOT to quantize the expert leg").
+    # Overridable per process set via hvd.set_alltoall_cross_dtype.
+    alltoall_cross_dtype: str = ""
     # Cross-leg overlap in the fusion flush scheduler: the DCN leg of a
     # hierarchical bucket is left in flight at flush return and only
     # awaited when the next flush (or the step boundary / a sync
@@ -403,7 +417,8 @@ class Config:
         # while "int8" routes the fused bucket through the two-phase
         # quantized exchange (strategies.allreduce_int8) — any other value
         # would silently destroy gradients.
-        for attr in ("wire_dtype", "wire_dtype_dcn"):
+        for attr in ("wire_dtype", "wire_dtype_dcn",
+                     "alltoall_cross_dtype"):
             val = {"fp16": "float16",
                    "bf16": "bfloat16"}.get(getattr(self, attr),
                                            getattr(self, attr))
@@ -505,6 +520,10 @@ class Config:
                                           c.wire_dtype_dcn)
         c.hierarchical_dispatch = _env_bool("HOROVOD_HIERARCHICAL_DISPATCH",
                                             c.hierarchical_dispatch)
+        c.hierarchical_alltoall = _env_bool("HOROVOD_HIERARCHICAL_ALLTOALL",
+                                            c.hierarchical_alltoall)
+        c.alltoall_cross_dtype = os.environ.get(
+            "HOROVOD_ALLTOALL_CROSS_DTYPE", c.alltoall_cross_dtype)
         c.cross_overlap = _env_bool("HOROVOD_CROSS_OVERLAP",
                                     c.cross_overlap)
         c.wire_error_feedback = _env_bool("HOROVOD_WIRE_ERROR_FEEDBACK",

@@ -32,6 +32,10 @@ class GPTConfig:
     num_experts: int = 0            # 0 -> dense MLP blocks only
     moe_k: int = 1
     capacity_factor: float = 2.0
+    # Hierarchical expert dispatch over the ep axis: None = auto (the
+    # HOROVOD_HIERARCHICAL_ALLTOALL / a2a strategy registry chain),
+    # True/False force it (parallel/moe.py).
+    moe_hierarchical: Optional[bool] = None
     dtype: Any = jnp.float32
     tp_axis: Optional[str] = "tp"   # None -> no tensor parallelism
     ep_axis: Optional[str] = "ep"   # axis carrying the experts (often = dp)
@@ -140,7 +144,8 @@ class GPTMoEBlock(nn.Module):
         x = x + a
         h, aux = MoEMlp(c.num_experts, c.hidden_size, c.intermediate_size,
                         k=c.moe_k, capacity_factor=c.capacity_factor,
-                        dtype=c.dtype, axis_name=c.ep_axis, name="moe")(
+                        dtype=c.dtype, axis_name=c.ep_axis,
+                        hierarchical=c.moe_hierarchical, name="moe")(
                             nn.LayerNorm(dtype=c.dtype, name="ln_mlp")(x))
         self.sow("losses", "moe_aux", aux)
         return x + h

@@ -689,6 +689,38 @@ def _alltoall_program(mesh, n, shapes, dtypes):
     return jax.jit(f)
 
 
+@functools.lru_cache(maxsize=1024)
+def _hier_alltoall_program(hier_mesh, n, shapes, dtypes, cross_wire):
+    """Eager equal-splits alltoall through the hierarchical dispatch
+    tier: slice-local a2a (ICI) then ONE cross-slice a2a on the per-tier
+    wire (DCN; ``""`` = exact, ``int8``/``fp8`` = block-scaled), compiled
+    over the (slice x chips-per-slice) mesh
+    (``strategies.alltoall_tiered`` — the a2a twin of
+    :func:`_hier_allreduce_program`)."""
+    from horovod_tpu.common.topology import CROSS_AXIS, LOCAL_AXIS
+    from horovod_tpu.ops.in_jit import mark_varying
+    from horovod_tpu.parallel.strategies import alltoall_tiered
+    spec = P((CROSS_AXIS, LOCAL_AXIS))
+
+    def body(*xs):
+        out = []
+        for x in xs:
+            x = jnp.squeeze(x, 0)  # (m, ...), m divisible by n
+            # record=False: this eager program's dispatches are metered
+            # per call by the plan — trace-time recording on top would
+            # double-count.
+            y = alltoall_tiered(x, cross_wire=cross_wire or None,
+                                record=False)
+            y = mark_varying(mark_varying(y, CROSS_AXIS), LOCAL_AXIS)
+            out.append(y[None])
+        return tuple(out)
+
+    f = jax.shard_map(body, mesh=hier_mesh,
+                      in_specs=tuple(spec for _ in shapes),
+                      out_specs=tuple(spec for _ in shapes))
+    return jax.jit(f)
+
+
 def clear_program_caches():
     """Drop all compiled eager-collective programs (and the mesh/device
     objects they capture). Needed when the backend is rebuilt — e.g. an
@@ -699,8 +731,9 @@ def clear_program_caches():
                  _quantized_allreduce_program, _hier_allreduce_program,
                  _hier_mesh, _allgather_program,
                  _broadcast_program, _reducescatter_program,
-                 _alltoall_program, _barrier_program,
-                 _alltoall_pack_index, _hier_verdict):
+                 _alltoall_program, _hier_alltoall_program,
+                 _barrier_program,
+                 _alltoall_pack_index, _hier_verdict, _a2a_hier_verdict):
         prog.cache_clear()
     # The cached flat-schedule tier split reads the slice layout; a
     # resized/re-sliced mesh must re-resolve it (like the hierarchy-keyed
@@ -1340,6 +1373,63 @@ class _HierDispatchPlan(_WireDispatchPlan):
              self.cross_label is not None, {"dcn": h["dcn"]})]
 
 
+class _HierAlltoallPlan(_WireDispatchPlan):
+    """Dispatch plan for eager equal-splits alltoalls riding the
+    HIERARCHICAL dispatch tier: slice-local a2a (ICI) -> cross-slice a2a
+    on the per-tier wire (DCN), compiled over the (slice x
+    chips-per-slice) mesh. Byte accounting books the local leg all-ICI
+    and splits the cross leg by its own ``(S-1)/S`` foreign-slice
+    fraction (``wire.hierarchical_a2a_bytes`` — the same integers the
+    static model's hierarchical a2a what-if predicts, keeping
+    ``cross_check_bytes`` at delta 0). NO error feedback: an alltoall
+    moves data without reducing, so there is no accumulated sum for a
+    residual to correct — each element pays one bounded round-off on the
+    quantized cross leg. Keyed on the slice layout and cross wire, so a
+    strategy flip (or an elastic resize through clear_program_caches)
+    routes through a fresh plan."""
+
+    __slots__ = ("cross_label", "num_slices")
+
+    @staticmethod
+    def _spec_for(mesh):
+        from horovod_tpu.common.topology import CROSS_AXIS, LOCAL_AXIS
+        return P((CROSS_AXIS, LOCAL_AXIS))
+
+    def __init__(self, program, hier_mesh, ps, staged, hier):
+        # Slots the _init_wire_records hook needs; assigned before the
+        # base init that precedes it. _WireDispatchPlan.__init__ is
+        # bypassed on purpose: its wire/ef plumbing is allreduce-shaped
+        # (residual store, exchange_wire_bytes); only its multi-record
+        # dispatch() is shared.
+        self.cross_label = hier["cross"]
+        self.num_slices = hier["slices"]
+        _DispatchPlan.__init__(self, "alltoall", "ALLTOALL", program,
+                               hier_mesh, ps, staged, "alltoall")
+        self.wire_name = hier["cross"]
+        self.ef = False
+        self.ef_key = None
+        self.flat_len = sum(int(np.prod(s[1:])) for s in self.global_shapes)
+        self.res_len = 0
+        n = self.global_shapes[0][0] if self.global_shapes else 1
+        self._init_wire_records(n, staged)
+
+    def _init_wire_records(self, n, staged):
+        payload_dtype = str(staged[0].dtype) if staged else "float32"
+        width = np.dtype(staged[0].dtype).itemsize if staged else 4
+        h = _wire.hierarchical_a2a_bytes(
+            self.flat_len, n, self.num_slices, width,
+            cross_wire=self.cross_label or "")
+        self.cross_label = h["cross_label"]
+        self.wire_label = self.cross_label or payload_dtype
+        self.wire_nbytes = h["local"] + h["cross"]
+        self.wire_sched = "a2a"
+        self.wire_records = [
+            ("eager", payload_dtype, h["local"], False,
+             {"ici": h["local"]}),
+            ("eager", self.cross_label or payload_dtype, h["cross"],
+             self.cross_label is not None, dict(h["cross_tiers"]))]
+
+
 @functools.lru_cache(maxsize=4096)
 def _hier_verdict(strategy, cross, op, sig, n, slices, ef_cfg):
     """Memoized tail of the hierarchical-dispatch verdict: everything
@@ -1396,6 +1486,62 @@ def _eager_hier_for(ps, op, sig):
         cross = _wire.cross_wire_for(_ps_label(ps), cfg)
     return _hier_verdict(strategy, cross, ReduceOp(op), sig, n, slices,
                          bool(cfg.wire_error_feedback))
+
+
+@functools.lru_cache(maxsize=4096)
+def _a2a_hier_verdict(strategy, cross, sig, n, slices):
+    """Memoized tail of the hierarchical-ALLTOALL verdict (the a2a twin
+    of :func:`_hier_verdict`): single-tensor equal-splits calls whose
+    per-rank dim divides the world. The cross wire label survives only
+    for float payloads the shared eligibility predicate accepts — below
+    one BLOCK per destination slice the exchange padding would inflate
+    the wire and the cross leg stays exact."""
+    if len(sig) != 1:
+        return None
+    (shape, dtype), = sig
+    if len(shape) < 2 or shape[1] % n != 0:
+        return None
+    per = int(np.prod(shape[1:]))
+    label = _wire.quantized_label(cross) if cross else None
+    if label is not None and not (
+            jnp.issubdtype(np.dtype(dtype), jnp.floating)
+            and _wire.quantized_eligible(per, slices, True, True)):
+        label = None
+    return {"strategy": strategy, "cross": label, "slices": slices}
+
+
+def _eager_a2a_hier_for(ps, sig):
+    """Hierarchical-dispatch verdict for one eager equal-splits alltoall:
+    a dict (strategy facts the program/plan need) or None for the flat
+    path — the a2a twin of :func:`_eager_hier_for`, sharing its
+    eligibility philosophy (global process set only, live slice
+    hierarchy, hvdlint HVP113 on 1-slice layouts) but keyed on the a2a
+    strategy registry / ``HOROVOD_HIERARCHICAL_ALLTOALL`` default, with
+    the expert cross wire resolved through
+    :func:`horovod_tpu.ops.wire.alltoall_cross_wire_for` — NEVER the
+    allreduce wire knobs: alltoall payloads are activations and quantize
+    only by explicit choice (docs/performance.md)."""
+    st = basics._state
+    if st is None or sig is None:
+        return None
+    cfg = st.config
+    hier_cfg = getattr(cfg, "hierarchical_alltoall", False)
+    if not hier_cfg and not _wire._a2a_strategy_registry:
+        return None          # hot-path fast exit: tier disarmed everywhere
+    default = "hier_qcross" if hier_cfg else ""
+    strategy = _wire.alltoall_strategy_for(_ps_label(ps), default)
+    if strategy not in ("hier", "hier_qcross"):
+        return None
+    if ps.ranks is not None:
+        return None
+    n = ps.size()
+    slices, _ = _live_slices(n)
+    if slices <= 1:
+        return None
+    cross = ""
+    if strategy == "hier_qcross":
+        cross = _wire.alltoall_cross_wire_for(_ps_label(ps), cfg)
+    return _a2a_hier_verdict(strategy, cross, sig, n, slices)
 
 
 def _eager_wire_for(ps, op, sig, wire_req):
@@ -1778,8 +1924,13 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
     mesh, ps = _mesh_for(process_set)
     n = ps.size()
     sig = _plan_sig((tensor,)) if splits is None else None
+    hier = _eager_a2a_hier_for(ps, sig) if sig is not None else None
     if sig is not None:
-        key = ("alltoall", mesh, ps, sig)
+        # The hierarchy facts join the key: a strategy/cross-wire flip (or
+        # a slice-layout change through clear_program_caches) routes the
+        # next call through a differently-keyed plan — no desync window.
+        key = ("alltoall", mesh, ps, sig,
+               None if hier is None else (hier["slices"], hier["cross"]))
         plan = _plan_lookup(key, ps)
         if plan is not None:
             return plan.run([tensor], name)[0]
@@ -1799,8 +1950,17 @@ def alltoall(tensor, splits=None, process_set=None, name=None):
                 f"divisible by {n}")
         (tt,) = _prepare([t], mesh, n, "alltoall")
         shapes, dtypes = _signature([tt])
-        prog = _alltoall_program(mesh, n, shapes, dtypes)
         st = basics._get_state()
+        if hier is not None and _plan_eligible(st, None):
+            hmesh = _hier_mesh(mesh, hier["slices"])
+            prog = _hier_alltoall_program(hmesh, n, shapes, dtypes,
+                                          hier["cross"] or "")
+            plan = _register_plan(key, _HierAlltoallPlan(
+                prog, hmesh, ps, (tt,), hier))
+            return plan.dispatch([tt], name)[0]
+        # Non-plannable control paths (debug order check, join armed)
+        # fall back to the exact flat program, like the allreduce tier.
+        prog = _alltoall_program(mesh, n, shapes, dtypes)
         if sig is not None and _plan_eligible(st, None):
             plan = _register_plan(key, _DispatchPlan(
                 "alltoall", "ALLTOALL", prog, mesh, ps, (tt,),

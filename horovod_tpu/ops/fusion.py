@@ -566,17 +566,26 @@ class FusionRuntime:
                        "hierarchical": "flat",
                        "torus_qcross": "hier_qcross"}
 
-    def _sync_eager_policy(self, strategy, cross_wire):
+    def _sync_eager_policy(self, strategy, cross_wire, a2a_strategy="",
+                           a2a_cross=""):
         """Adopt the flush snapshot's strategy + cross-wire into the eager
         registries (runtime sync: defers to explicit user pins). 'flat'
         is only synced once the registry has an entry — the default-flat
         steady state must not grow a registry lookup on every eager
-        dispatch."""
+        dispatch. The same rule governs the hierarchical-alltoall policy
+        (``a2a_strategy`` / ``a2a_cross``, carried by the boundary stream
+        so the autopilot's expert-dispatch flips land on followers at the
+        same flush boundary as the allreduce levers)."""
         mapped = self._EAGER_STRATEGY.get(strategy, "flat")
         if mapped != "flat" or _wire.dispatch_strategy_for("global"):
             _wire.runtime_sync_dispatch_strategy(mapped, "global")
         if cross_wire:
             _wire.runtime_sync_wire_dtype(cross_wire, "global", tier="dcn")
+        if a2a_strategy and (a2a_strategy != "flat"
+                             or _wire.alltoall_strategy_for("global")):
+            _wire.runtime_sync_alltoall_strategy(a2a_strategy, "global")
+        if a2a_cross:
+            _wire.runtime_sync_alltoall_cross_dtype(a2a_cross, "global")
 
     def _publish_boundary(self, last_tid, strategy, wire_dtype, cross_wire):
         """Coordinator: record that tids <= last_tid are flushed — and the
@@ -597,9 +606,16 @@ class FusionRuntime:
             # explicit user pin (hvd.set_wire_dtype). See ops/wire.py.
             _wire.runtime_sync_wire_dtype(wire, "global")
         self._sync_eager_policy(strategy, cross_wire)
+        # The hierarchical-alltoall policy rides the same boundary: the
+        # coordinator's registries (autopilot / runtime sync) are the
+        # source of truth, and followers adopt whatever was in effect for
+        # this flushed prefix.
+        a2a_s = _wire.alltoall_strategy_for("global")
+        a2a_cw = _wire.wire_dtype_for("a2a:global", "", tier="dcn")
         self._publish_queue.put((seq, _json.dumps(
             {"t": int(last_tid), "s": strategy, "w": wire,
-             "cw": cross_wire or ""})))
+             "cw": cross_wire or "", "as": a2a_s or "",
+             "acw": a2a_cw or ""})))
 
     def _republish_boundary(self, client, seq, raw):
         """Slice leader: mirror the root boundary onto the slice key so
@@ -746,7 +762,9 @@ class FusionRuntime:
                 self.cross_wire = payload.get("cw", "")
                 if wire:
                     _wire.runtime_sync_wire_dtype(wire, "global")
-                self._sync_eager_policy(self.strategy, self.cross_wire)
+                self._sync_eager_policy(self.strategy, self.cross_wire,
+                                        payload.get("as", ""),
+                                        payload.get("acw", ""))
                 # The local enqueue stream may lag the coordinator's:
                 # applying early would flush a SHORTER prefix and misalign
                 # every later collective. A boundary AHEAD of the local

@@ -468,6 +468,81 @@ def dispatch_strategy_for(ps_label, default=""):
 def clear_strategy_registry():
     with _wire_lock:
         _strategy_registry.clear()
+        _a2a_strategy_registry.clear()
+
+
+# ----------------------------------------------------------------------------
+# Per-process-set ALLTOALL strategy + cross-wire registry.
+#
+# The hierarchical alltoall tier (MoE expert dispatch) has its own lever
+# pair — strategy (flat / hier / hier_qcross) and cross-slice wire dtype —
+# steered by the autopilot at flush boundaries exactly like the allreduce
+# pair above. It is a SEPARATE registry: alltoall moves activations, not
+# error-fed gradients, so its quantization policy must never ride the
+# allreduce knobs implicitly (docs/performance.md: when NOT to quantize
+# the expert leg). The cross dtype reuses the wire registry under the
+# namespaced ``a2a:<ps>@dcn`` key so user pins / runtime sync / clear all
+# behave identically.
+# ----------------------------------------------------------------------------
+
+_a2a_strategy_registry = {}    # ps_label -> (value, source)
+
+
+def set_alltoall_strategy(strategy, ps_label="global"):
+    """Pin the eager/moe alltoall dispatch strategy for one process set
+    ('' restores the config default). Plans are keyed on the strategy, so
+    a flip routes the next dispatch through a differently-keyed plan with
+    no desync window — the same contract as
+    :func:`set_dispatch_strategy`."""
+    s = _normalize_strategy(strategy)
+    with _wire_lock:
+        _a2a_strategy_registry[str(ps_label)] = (s, "user")
+    return s
+
+
+def runtime_sync_alltoall_strategy(strategy, ps_label="global"):
+    """Flush-boundary adoption of the autotuner's alltoall strategy
+    choice; defers to an explicit user pin like
+    :func:`runtime_sync_dispatch_strategy`."""
+    s = _normalize_strategy(strategy)
+    with _wire_lock:
+        cur = _a2a_strategy_registry.get(str(ps_label))
+        if cur is not None and cur[1] == "user":
+            return cur[0]
+        _a2a_strategy_registry[str(ps_label)] = (s, "runtime")
+    return s
+
+
+def alltoall_strategy_for(ps_label, default=""):
+    """Effective alltoall dispatch strategy for a process set: registry
+    entry, else ``default`` (normally derived from
+    ``config.hierarchical_alltoall``)."""
+    with _wire_lock:
+        v = _a2a_strategy_registry.get(str(ps_label))
+    return (default or "") if v is None or not v[0] else v[0]
+
+
+def set_alltoall_cross_dtype(dtype, ps_label="global"):
+    """Pin the wire dtype of the hierarchical alltoall's cross-slice
+    (DCN) leg for one process set ('' restores the config default)."""
+    return set_wire_dtype(dtype, f"a2a:{ps_label}", tier="dcn")
+
+
+def runtime_sync_alltoall_cross_dtype(dtype, ps_label="global"):
+    """Flush-boundary adoption of the autotuner's expert cross-wire
+    choice; defers to an explicit user pin."""
+    return runtime_sync_wire_dtype(dtype, f"a2a:{ps_label}", tier="dcn")
+
+
+def alltoall_cross_wire_for(ps_label, config):
+    """Effective wire dtype of the hierarchical alltoall's CROSS-SLICE
+    (DCN) leg — THE resolution chain runtime and static model share:
+    per-set registry entry (``a2a:<ps>@dcn``), else
+    ``HOROVOD_ALLTOALL_CROSS_DTYPE``. Deliberately does NOT fall back to
+    the allreduce DCN wire: alltoall payloads are activations without
+    error feedback, so quantizing them must be an explicit choice."""
+    default = getattr(config, "alltoall_cross_dtype", "")
+    return wire_dtype_for(f"a2a:{ps_label}", default, tier="dcn")
 
 
 def zero_residual(mesh, sharding, n, flat_len):
@@ -617,6 +692,52 @@ def hierarchical_wire_bytes(per_rank_elems, n, num_slices, itemsize,
         dcn = 2 * n * shard * itemsize               # exact cross RS+AG
     return {"ici": ici, "dcn": dcn, "cross_label": label,
             "shard_elems": shard, "local_size": local,
+            "num_slices": num_slices}
+
+
+def hierarchical_a2a_bytes(per_rank_elems, n, num_slices, itemsize,
+                           cross_wire=""):
+    """Per-tier byte accounting for ONE 2-level hierarchical alltoall
+    (slice-local a2a on ICI -> cross-slice a2a on the per-tier wire) of a
+    ``per_rank_elems``-element per-rank buffer over ``n`` ranks in
+    ``num_slices`` slices — the SAME integer formulas the runtime dispatch
+    records and the static model's a2a what-if predicts, which is what
+    keeps ``cross_check_bytes`` at delta 0 on the CPU tier.
+
+    Convention (matching the flat accounting): each leg counts
+    participants x per-participant payload x width, self-destined chunks
+    included. The local leg is entirely in-slice (all ici). The cross leg
+    runs one a2a over ``num_slices`` participants per local group — its
+    members sit in ``num_slices`` DISTINCT slices, so its own
+    :func:`a2a_dcn_fraction` is ``(S-1)/S`` and :func:`split_tiers` books
+    that share to dcn (the genuinely cross-slice rows move exactly once,
+    the information-theoretic floor). Returns ``{"local", "cross",
+    "cross_tiers", "ici", "dcn", "cross_label", "local_size",
+    "num_slices"}`` — ``cross_label`` is the quantized label actually
+    eligible on the cross leg (None = exact: payloads below one BLOCK per
+    destination slice would INFLATE on the exchange's S x BLOCK padding,
+    the same refusal as the flat wire)."""
+    n = max(int(n), 1)
+    num_slices = max(int(num_slices), 1)
+    itemsize = max(int(itemsize), 1)
+    local_size = max(n // num_slices, 1)
+    per = int(per_rank_elems)
+    local_leg = n * per * itemsize
+    label = quantized_label(cross_wire)
+    if label is not None and not quantized_eligible(
+            per, num_slices, True, True):
+        label = None
+    if label is not None:
+        cross_leg = local_size * exchange_leg_bytes(per, num_slices)
+    else:
+        cross_leg = n * per * itemsize
+    frac = (num_slices - 1) / num_slices if num_slices > 1 else 0.0
+    cross_tiers = split_tiers(cross_leg, frac)
+    return {"local": local_leg, "cross": cross_leg,
+            "cross_tiers": cross_tiers,
+            "ici": local_leg + cross_tiers["ici"],
+            "dcn": cross_tiers["dcn"],
+            "cross_label": label, "local_size": local_size,
             "num_slices": num_slices}
 
 

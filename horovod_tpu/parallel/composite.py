@@ -424,10 +424,16 @@ class CompositeGPT(_CompositeLM):
             sp_axis=c.sp_axis, sp_impl=getattr(c, "sp_impl", "ring"))
         self.moe = None
         if c.num_experts:
+            # moe_hierarchical: None = auto (the
+            # HOROVOD_HIERARCHICAL_ALLTOALL / a2a-registry chain) — the
+            # composite dp axis routes expert dispatch through the
+            # 2-level alltoall whenever a slice hierarchy exists.
             self.moe = MoEMlp(c.num_experts, c.hidden_size,
                               c.intermediate_size, k=c.moe_k,
                               capacity_factor=c.capacity_factor,
-                              dtype=c.dtype, axis_name=DP_AXIS)
+                              dtype=c.dtype, axis_name=DP_AXIS,
+                              hierarchical=getattr(c, "moe_hierarchical",
+                                                   None))
 
 
 @dataclasses.dataclass

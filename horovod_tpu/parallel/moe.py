@@ -34,6 +34,38 @@ from horovod_tpu.parallel.tp import axis_size_or_1, shard_init
 EP_AXIS = "ep"
 
 
+def _hier_dispatch(slots, axis_name, num_slices, cross_label):
+    """Expert-major ``(E, C, d)`` slots -> source-major ``(e_local, n*C,
+    d)`` via the 2-level alltoall: the reference's split0/concat1 tiled
+    exchange reduces to the canonical split0/concat0 form plus a local
+    transpose, which then decomposes into slice-local (ICI) and
+    cross-slice (DCN, optionally block-scaled) legs
+    (``strategies.alltoall_tiered_groups``). Bit-equivalent to the flat
+    ``lax.all_to_all`` route UNLESS the cross leg quantizes."""
+    from horovod_tpu.parallel.strategies import alltoall_tiered_groups
+    n = int(lax.axis_size(axis_name))
+    E, C, d = slots.shape
+    e_local = E // n
+    z = alltoall_tiered_groups(slots, axis_name, num_slices,
+                               cross_wire=cross_label)
+    return z.reshape(n, e_local, C, d).transpose(1, 0, 2, 3) \
+            .reshape(e_local, n * C, d)
+
+
+def _hier_combine(y, axis_name, num_slices, cross_label):
+    """Inverse of :func:`_hier_dispatch`: source-major ``(e_local, n*C,
+    d)`` expert outputs back to the expert-major ``(E, C, d)`` layout,
+    through the same 2-level exchange."""
+    from horovod_tpu.parallel.strategies import alltoall_tiered_groups
+    n = int(lax.axis_size(axis_name))
+    e_local, nC, d = y.shape
+    C = nC // n
+    z = y.reshape(e_local, n, C, d).transpose(1, 0, 2, 3) \
+         .reshape(n * e_local, C, d)
+    return alltoall_tiered_groups(z, axis_name, num_slices,
+                                  cross_wire=cross_label)
+
+
 def _router(x, probs, k: int, capacity: int):
     """Compute dispatch/combine tensors for top-k capacity routing.
 
@@ -90,6 +122,11 @@ class MoEMlp(nn.Module):
     capacity_factor: float = 2.0
     dtype: Any = jnp.float32
     axis_name: Optional[str] = EP_AXIS
+    # Hierarchical expert dispatch: None = auto (the
+    # HOROVOD_HIERARCHICAL_ALLTOALL / a2a strategy registry chain via
+    # strategies.a2a_hierarchy_for), True = force when a slice hierarchy
+    # exists, False = always flat.
+    hierarchical: Optional[bool] = None
 
     @nn.compact
     def __call__(self, x):
@@ -113,12 +150,24 @@ class MoEMlp(nn.Module):
         slots = jnp.einsum("tec,td->ecd", dispatch.astype(self.dtype),
                            xt.astype(self.dtype))
 
+        hier = None
         if n > 1:
+            from horovod_tpu.parallel.strategies import (
+                _record_jit_a2a_flat, a2a_hierarchy_for)
+            hier = a2a_hierarchy_for(self.axis_name, self.hierarchical)
+
+        if n > 1 and hier is not None:
+            # 2-level route: slice-local a2a (ICI) + cross-slice leg on
+            # the per-tier wire (DCN) — expert dispatch pays DCN only for
+            # genuinely cross-slice token slots.
+            slots = _hier_dispatch(slots, self.axis_name, hier[0], hier[1])
+        elif n > 1:
             # Send each expert block to its owner shard; receive all source
             # shards' slots for OUR local experts: (E, C, d) -> (e_local,
             # n*C, d), source-major along the slot axis. Tiled all_to_all is
             # a pure inter-device transpose — no reshapes, clean transpose
             # rule for AD.
+            _record_jit_a2a_flat(slots, n)
             slots = lax.all_to_all(slots, self.axis_name, split_axis=0,
                                    concat_axis=1, tiled=True)
         else:
@@ -139,9 +188,12 @@ class MoEMlp(nn.Module):
         h = nn.gelu(h)
         y = jnp.einsum("ecf,efd->ecd", h, jnp.asarray(w_out, self.dtype))
 
-        if n > 1:
+        if n > 1 and hier is not None:
+            y = _hier_combine(y, self.axis_name, hier[0], hier[1])
+        elif n > 1:
             # Inverse transpose: source-major slots go back to their source
             # shard, restoring the expert-major (E, C, d) layout.
+            _record_jit_a2a_flat(y, n)
             y = lax.all_to_all(y, self.axis_name, split_axis=1,
                                concat_axis=0, tiled=True)
 

@@ -18,6 +18,9 @@ parallel — the property the fork's custom NCCL code buys — while moving only
 1/local_size of the bytes over the slow cross link.
 """
 
+import functools
+
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -132,6 +135,218 @@ def allreduce_tiered(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
     if postscale_factor != 1.0:
         out = out * jnp.asarray(postscale_factor, out.dtype)
     return out if residual is None else (out, new_res)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3, 4))
+def _quantized_a2a(x, axis_name, num_participants, wire,
+                   axis_index_groups=None):
+    """One block-scaled alltoall leg (the EQuARX exchange's first-leg
+    shape): ``x``'s leading dim holds one destination row per participant;
+    each row is quantized block-wise (one fp32 scale per
+    :data:`horovod_tpu.ops.wire.BLOCK` elements), the 1-byte rows plus
+    their scales move on an AllToAll, receivers dequantize. Returns the
+    exchanged array in ``x``'s shape/dtype.
+
+    Deliberately STATELESS — an alltoall moves data without reducing, so
+    there is no accumulated sum for an error-feedback residual to correct
+    (unlike the allreduce exchange): each element pays one bounded
+    round-off (``block max/254`` for int8) exactly once.
+
+    Differentiation is straight-through: the backward exchange is the
+    a2a's own transpose (split0/concat0 is an involution) run EXACT —
+    ``round``'s a.e.-zero derivative would otherwise kill every gradient
+    crossing a slice, and quantizing gradients without error feedback is
+    precisely what the expert-leg policy refuses (docs/performance.md)."""
+    from horovod_tpu.ops import wire as _wire
+    s = int(num_participants)
+    orig_shape, orig_dtype = x.shape, x.dtype
+    rows = x.reshape(s, -1).astype(jnp.float32)
+    pad = (-rows.shape[1]) % _wire.BLOCK
+    if pad:
+        rows = jnp.pad(rows, ((0, 0), (0, pad)))
+    blocks = rows.reshape(s, rows.shape[1] // _wire.BLOCK, _wire.BLOCK)
+    q, scale = _wire.quantize_blocks(blocks, wire)
+    qt = lax.all_to_all(q, axis_name, split_axis=0, concat_axis=0,
+                        axis_index_groups=axis_index_groups)
+    st = lax.all_to_all(scale, axis_name, split_axis=0, concat_axis=0,
+                        axis_index_groups=axis_index_groups)
+    out = _wire.dequantize(qt, st).reshape(s, -1)
+    if pad:
+        out = out[:, :-pad]
+    return out.reshape(orig_shape).astype(orig_dtype)
+
+
+def _quantized_a2a_fwd(x, axis_name, num_participants, wire,
+                       axis_index_groups):
+    return _quantized_a2a(x, axis_name, num_participants, wire,
+                          axis_index_groups), None
+
+
+def _quantized_a2a_bwd(axis_name, num_participants, wire, axis_index_groups,
+                       _res, g):
+    xbar = lax.all_to_all(g, axis_name, split_axis=0, concat_axis=0,
+                          axis_index_groups=axis_index_groups)
+    return (xbar,)
+
+
+_quantized_a2a.defvjp(_quantized_a2a_fwd, _quantized_a2a_bwd)
+
+
+def alltoall_tiered(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
+                    cross_wire=None, record=True):
+    """2-level alltoall over a (cross × local) mesh: slice-local a2a (ICI)
+    first, then one cross-slice a2a (DCN) of already-grouped rows — with
+    the cross leg optionally block-scaled (``cross_wire="int8"``/
+    ``"fp8"``). Bit-equivalent to the flat
+    ``lax.all_to_all(x, axis, split_axis=0, concat_axis=0, tiled=True)``
+    over the rank-major flattened (cross, local) pair UNLESS the cross leg
+    quantizes.
+
+    ``x``'s leading dim must divide by ``cross_n * local_n`` (the same
+    equal-splits contract as the flat tiled a2a). The genuinely
+    cross-slice rows move over DCN exactly once — the decomposition's win
+    is that the ``1/cross_n`` slice-internal share of every payload never
+    leaves the ICI, and the rest can ride the 1-byte wire.
+
+    Eligibility of the quantized cross leg rides THE shared
+    :func:`horovod_tpu.ops.wire.quantized_eligible` predicate (per-rank
+    payload below one BLOCK per destination slice would inflate on the
+    exchange padding and stays exact) — the same refusal
+    :func:`horovod_tpu.ops.wire.hierarchical_a2a_bytes` applies, so
+    recorded bytes always match the wire.
+
+    ``record=False`` suppresses the per-tier trace-time accounting (the
+    runtime's eager hierarchical program meters each dispatch itself)."""
+    from horovod_tpu.ops import wire as _wire
+    cross_n = int(lax.axis_size(cross_axis))
+    local_n = int(lax.axis_size(local_axis))
+    n = cross_n * local_n
+    m = x.shape[0]
+    if m % n:
+        raise ValueError(
+            f"alltoall_tiered: leading dim {m} not divisible by the "
+            f"{cross_n}x{local_n} mesh size {n}")
+    label = _wire.quantized_label(cross_wire) if cross_wire else None
+    all_float = jnp.issubdtype(x.dtype, jnp.floating)
+    if label is not None and not _wire.quantized_eligible(
+            x.size, cross_n, all_float, True):
+        label = None
+    if record:
+        _record_jit_a2a_tiered(x, n, cross_n, label)
+    blocks = x.reshape((cross_n, local_n, m // n) + x.shape[1:])
+    blocks = lax.all_to_all(blocks, local_axis, split_axis=1,
+                            concat_axis=1, tiled=True)
+    if label is not None:
+        blocks = _quantized_a2a(blocks, cross_axis, cross_n, label, None)
+    else:
+        blocks = lax.all_to_all(blocks, cross_axis, split_axis=0,
+                                concat_axis=0, tiled=True)
+    return blocks.reshape((m,) + x.shape[1:])
+
+
+def alltoall_tiered_groups(x, axis_name, num_slices, cross_wire=None,
+                           record=True):
+    """The flat-axis form of :func:`alltoall_tiered` for meshes that do
+    not factor the axis: the SAME 2-level schedule expressed with
+    ``axis_index_groups`` over one flat ``axis_name`` in rank-major
+    (slice, chips-in-slice) layout — phase 1 exchanges within each slice's
+    contiguous group (ICI), phase 2 across slices between same-local-index
+    ranks (DCN, optionally block-scaled). This is what
+    ``parallel/moe.py`` routes expert dispatch/combine through inside an
+    arbitrary named mesh (the composite dp×pp scenario's dp axis
+    included), where no (cross, local) axis pair exists to shard over."""
+    from horovod_tpu.ops import wire as _wire
+    n = int(lax.axis_size(axis_name))
+    s = int(num_slices)
+    if s <= 1 or n % s:
+        raise ValueError(
+            f"alltoall_tiered_groups: {s} slices do not divide the "
+            f"{n}-rank axis {axis_name!r} (resolve the hierarchy with "
+            "a2a_hierarchy_for first)")
+    local_n = n // s
+    m = x.shape[0]
+    if m % n:
+        raise ValueError(
+            f"alltoall_tiered_groups: leading dim {m} not divisible by "
+            f"axis size {n}")
+    # Tuples: the quantized leg's custom_vjp carries the groups as a
+    # non-differentiable (hashable) argument.
+    local_groups = tuple(tuple(c * local_n + l for l in range(local_n))
+                         for c in range(s))
+    cross_groups = tuple(tuple(c * local_n + l for c in range(s))
+                         for l in range(local_n))
+    label = _wire.quantized_label(cross_wire) if cross_wire else None
+    all_float = jnp.issubdtype(x.dtype, jnp.floating)
+    if label is not None and not _wire.quantized_eligible(
+            x.size, s, all_float, True):
+        label = None
+    if record:
+        _record_jit_a2a_tiered(x, n, s, label)
+    blocks = x.reshape((s, local_n, m // n) + x.shape[1:])
+    blocks = lax.all_to_all(blocks, axis_name, split_axis=1, concat_axis=1,
+                            tiled=True, axis_index_groups=local_groups)
+    if label is not None:
+        blocks = _quantized_a2a(blocks, axis_name, s, label, cross_groups)
+    else:
+        blocks = lax.all_to_all(blocks, axis_name, split_axis=0,
+                                concat_axis=0, tiled=True,
+                                axis_index_groups=cross_groups)
+    return blocks.reshape((m,) + x.shape[1:])
+
+
+def a2a_hierarchy_for(axis_name, hierarchical=None):
+    """Trace-time hierarchy resolution for an in-jit alltoall over
+    ``axis_name``: ``(num_slices, cross_label_or_None)`` when the 2-level
+    route applies, else ``None``. THE resolution chain the MoE layer and
+    the static cost model share: explicit ``hierarchical`` override from
+    the layer, else the a2a strategy registry /
+    ``HOROVOD_HIERARCHICAL_ALLTOALL`` default; slice count from the
+    forced ``HOROVOD_MESH_SLICES`` layout (or the initialized topology's
+    DCN hierarchy when the axis spans the whole world), through
+    ``topology.slice_layout``'s divisibility rules; the cross wire from
+    :func:`horovod_tpu.ops.wire.alltoall_cross_wire_for` — a plain
+    ``hier`` pin keeps the cross leg exact, ``hier_qcross`` (the default
+    when the knob is on) follows the expert cross-dtype chain."""
+    try:
+        from horovod_tpu.common import basics
+        from horovod_tpu.common import topology as _topology
+        from horovod_tpu.ops import wire as _wire
+        n = int(lax.axis_size(axis_name))
+        if n <= 1:
+            return None
+        try:
+            cfg = basics.config()
+        except Exception:  # noqa: BLE001 — uninitialized: flat dispatch
+            return None
+        if hierarchical is None:
+            default = ("hier_qcross"
+                       if getattr(cfg, "hierarchical_alltoall", False)
+                       else "")
+            strategy = _wire.alltoall_strategy_for("global", default)
+            if strategy not in ("hier", "hier_qcross"):
+                return None
+        elif not hierarchical:
+            return None
+        else:
+            strategy = "hier_qcross"
+        k = _topology.forced_slices()
+        if not k:
+            st = basics._state
+            topo = st.topology if st is not None else None
+            if topo is not None and topo.num_slices > 1 and topo.size == n:
+                k = topo.num_slices
+        if not k:
+            return None
+        num_slices, _ = _topology.slice_layout(n, k)
+        if num_slices <= 1:
+            return None
+        cross = None
+        if strategy == "hier_qcross":
+            cross = _wire.quantized_label(
+                _wire.alltoall_cross_wire_for("global", cfg))
+        return num_slices, cross
+    except Exception:  # noqa: BLE001 — resolution must never break a trace
+        return None
 
 
 def allgather_hierarchical(x, cross_axis=CROSS_AXIS, local_axis=LOCAL_AXIS,
@@ -250,6 +465,46 @@ def _record_jit_wire_tiered(x, padded_elems, local_n, cross_n, cross_label):
         _record_wire_tiers(cross_label or str(jnp.dtype(x.dtype)),
                            {"dcn": h["dcn"]},
                            compressed=cross_label is not None)
+    except Exception:  # noqa: BLE001 — accounting must never break a trace
+        pass
+
+
+def _record_jit_a2a_flat(x, n):
+    """Trace-time wire accounting for a FLAT in-jit alltoall of a
+    per-rank buffer ``x`` over ``n`` ranks: ``n * size * width`` total
+    (self-destined chunks included, the a2a convention), split by the
+    live topology's a2a foreign-destination fraction — the baseline the
+    hierarchical records are compared against in the moe_sweep bench."""
+    try:
+        from horovod_tpu.metrics import instruments as hvd_metrics
+        width = jnp.dtype(x.dtype).itemsize
+        hvd_metrics.record_wire("jit", str(jnp.dtype(x.dtype)),
+                                int(n) * int(x.size) * width, sched="a2a")
+    except Exception:  # noqa: BLE001 — accounting must never break a trace
+        pass
+
+
+def _record_jit_a2a_tiered(x, n, num_slices, cross_label):
+    """Per-tier trace-time accounting for the 2-level alltoall: the local
+    (ICI) leg at the payload dtype, the cross leg at its wire dtype with
+    the ``(S-1)/S`` genuinely-cross-slice share booked to DCN — the SAME
+    integer formulas as
+    :func:`horovod_tpu.ops.wire.hierarchical_a2a_bytes`, so the runtime
+    counters and the static model's hierarchical a2a what-if agree
+    exactly (``cross_check_bytes`` delta 0)."""
+    try:
+        from horovod_tpu.metrics import instruments as hvd_metrics
+        from horovod_tpu.ops import wire as _wire
+        width = jnp.dtype(x.dtype).itemsize
+        h = _wire.hierarchical_a2a_bytes(int(x.size), int(n),
+                                         int(num_slices), width,
+                                         cross_wire=cross_label or "")
+        hvd_metrics.record_wire("jit", str(jnp.dtype(x.dtype)), h["local"],
+                                tiers={"ici": h["local"]}, sched="a2a")
+        hvd_metrics.record_wire(
+            "jit", h["cross_label"] or str(jnp.dtype(x.dtype)), h["cross"],
+            compressed=h["cross_label"] is not None,
+            tiers=dict(h["cross_tiers"]), sched="a2a")
     except Exception:  # noqa: BLE001 — accounting must never break a trace
         pass
 

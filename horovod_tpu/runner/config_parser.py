@@ -40,6 +40,9 @@ _ARG_ENV_MAP = [
     ("log_hide_timestamp", "HOROVOD_LOG_HIDE_TIME",
      lambda v: "1" if v else None),
     ("wire_dtype", "HOROVOD_WIRE_DTYPE", str),
+    ("hierarchical_alltoall", "HOROVOD_HIERARCHICAL_ALLTOALL",
+     lambda v: "1" if v else None),
+    ("alltoall_cross_dtype", "HOROVOD_ALLTOALL_CROSS_DTYPE", str),
     ("no_wire_error_feedback", "HOROVOD_WIRE_ERROR_FEEDBACK",
      lambda v: "0" if v else None),
     ("compile_cache_dir", "HOROVOD_COMPILE_CACHE_DIR", str),

@@ -113,6 +113,23 @@ def parse_args(argv=None):
                              "buckets; int8/fp8 ride the block-scaled "
                              "quantized exchange with error feedback "
                              "(HOROVOD_WIRE_DTYPE; docs/performance.md)")
+    tuning.add_argument("--hierarchical-alltoall", action="store_true",
+                        dest="hierarchical_alltoall",
+                        help="2-level ICI/DCN alltoall for eligible "
+                             "equal-splits exchanges and MoE expert "
+                             "dispatch/combine when a slice hierarchy "
+                             "exists (HOROVOD_HIERARCHICAL_ALLTOALL; "
+                             "docs/performance.md)")
+    tuning.add_argument("--alltoall-cross-dtype",
+                        dest="alltoall_cross_dtype",
+                        choices=["", "bfloat16", "float16", "bf16", "fp16",
+                                 "int8", "fp8"],
+                        help="Wire dtype of the hierarchical alltoall's "
+                             "cross-slice (DCN) leg; int8/fp8 ride the "
+                             "block-scaled exchange, 16-bit names keep it "
+                             "exact (HOROVOD_ALLTOALL_CROSS_DTYPE). "
+                             "Deliberately independent of --wire-dtype: "
+                             "alltoall payloads are activations.")
     tuning.add_argument("--no-wire-error-feedback", action="store_true",
                         dest="no_wire_error_feedback",
                         help="Disable the quantized wire's error-feedback "
@@ -484,7 +501,8 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HVD_BENCH_PROGRESS_FILE", "HOROVOD_DCN_BYTES_BUDGET",
                 "HOROVOD_WIRE_DTYPE", "HOROVOD_WIRE_ERROR_FEEDBACK",
                 "HOROVOD_WIRE_DTYPE_DCN", "HOROVOD_HIERARCHICAL_DISPATCH",
-                "HOROVOD_CROSS_OVERLAP",
+                "HOROVOD_CROSS_OVERLAP", "HOROVOD_HIERARCHICAL_ALLTOALL",
+                "HOROVOD_ALLTOALL_CROSS_DTYPE",
                 "HOROVOD_CONTROL_PLANE", "HOROVOD_KV_SHARD_COUNT",
                 "HOROVOD_KV_SHARD_PORT_BASE", "HOROVOD_CONTROL_LEASE_MS",
                 "HOROVOD_AUTOPILOT", "HOROVOD_AUTOPILOT_INTERVAL",

@@ -572,6 +572,106 @@ class TestHierarchicalExchangeShape:
                               world_size=8, config=cfg).findings)
 
 
+class TestA2AHierarchyLint:
+    """ISSUE 18 corpus: HVP113 extended to the armed hierarchical
+    ALLTOALL tier over a 1-slice layout, and HVP106 extended to the
+    expert cross-dtype knob with the block-scaled a2a suppression."""
+
+    def test_hvp113_a2a_armed_over_one_slice(self, hvd, monkeypatch):
+        from horovod_tpu.common.config import Config
+        monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+        x = np.ones((8, 8 * 64), np.float32)
+
+        def step(x):
+            return hvd.alltoall(x)
+
+        cfg = Config(hierarchical_alltoall=True)
+        rep = hvd.check_program(step, (x,), world_size=8, config=cfg)
+        assert "HVP113" in _codes(rep.findings)
+        assert rep.ok                         # advisory only
+        assert any(f.op == "alltoall" for f in rep.findings
+                   if f.code == "HVP113")
+        # knob off -> clean
+        assert "HVP113" not in _codes(
+            hvd.check_program(step, (x,), world_size=8,
+                              config=Config()).findings)
+
+    def test_hvp113_a2a_clean_on_multislice_layout(self, hvd,
+                                                   monkeypatch):
+        from horovod_tpu.common.config import Config
+        monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+        x = np.ones((8, 8 * 64), np.float32)
+
+        def step(x):
+            return hvd.alltoall(x)
+
+        assert "HVP113" not in _codes(
+            hvd.check_program(step, (x,), world_size=8,
+                              config=Config(
+                                  hierarchical_alltoall=True)).findings)
+
+    def test_hvp113_a2a_registry_pin_counts_as_armed(self, hvd,
+                                                     monkeypatch):
+        """The registry pin (hvd.set_alltoall_strategy) arms the tier
+        exactly like the knob — a pinned 1-slice job gets the same
+        advisory."""
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.ops import wire as _wire
+        monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+        x = np.ones((8, 8 * 64), np.float32)
+
+        def step(x):
+            return hvd.alltoall(x)
+
+        _wire.set_alltoall_strategy("hier")
+        try:
+            assert "HVP113" in _codes(
+                hvd.check_program(step, (x,), world_size=8,
+                                  config=Config()).findings)
+        finally:
+            _wire.clear_strategy_registry()
+
+    def test_hvp106_names_a2a_cross_knob(self, hvd, monkeypatch):
+        """An armed HOROVOD_ALLTOALL_CROSS_DTYPE that the jit program
+        ignores (flat fp32 psum) is a missed wire — the advisory names
+        the a2a knob; a program whose expert cross leg IS block-scaled
+        (strategies.alltoall_tiered int8) suppresses it."""
+        from horovod_tpu.common.config import Config
+        from horovod_tpu.parallel.strategies import alltoall_tiered
+        monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+        mesh = Mesh(np.array(jax.devices()[:8]), ("hvd",))
+        x = np.ones((8, 2 * 8 * 1024), np.float32)
+
+        def flat_step(x):
+            def inner(xl):
+                return lax.psum(xl, "hvd")
+            return jax.jit(jax.shard_map(
+                inner, mesh=mesh, in_specs=P("hvd"), out_specs=P()))(x)
+
+        cfg = Config(alltoall_cross_dtype="int8")
+        cfg.wire_error_feedback = False
+        findings = hvd.check_program(flat_step, (x,), world_size=8,
+                                     config=cfg).findings
+        assert "HVP106" in _codes(findings)
+        assert any("alltoall_cross_dtype" in f.message for f in findings
+                   if f.code == "HVP106")
+
+        hmesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                     ("cross", "local"))
+        xa = np.ones((8 * 8, 2048), np.float32)   # shard (8, 2048)
+
+        def tiered_step(x):
+            def inner(xl):
+                return alltoall_tiered(xl, cross_wire="int8")
+            return jax.jit(jax.shard_map(
+                inner, mesh=hmesh, in_specs=P(("cross", "local")),
+                out_specs=P(("cross", "local")), check_vma=False))(x)
+
+        assert "HVP106" not in _codes(
+            hvd.check_program(tiered_step, (xa,), world_size=8,
+                              config=cfg).findings)
+
+
 class TestCostModel:
     def test_tier_split_flat_allreduce(self, hvd):
         """fp32 allreduce over the global set: total = 2x global bytes

@@ -588,6 +588,117 @@ class TestQcrossSweepHygiene:
             wire.clear_wire_registry()
             wire.clear_strategy_registry()
 
+    def test_a2a_wire_armed_for_a_sample_leaves_with_it(self, hvd):
+        """The expert-dispatch twin: a hier_qcross a2a sweep sample over
+        an unquantized cross chain arms the int8 expert wire, and moving
+        the sweep off the strategy restores it — a leftover a2a:global
+        pin would lossy-quantize activations the user never opted into."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import fusion, wire
+        rt = fusion.get_runtime()
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        try:
+            cfg = basics.config()
+            ctrl = AutopilotController(cfg)
+            ctrl._apply(rt, rt.threshold, rt._cycle_s * 1000.0,
+                        {"a2a_strategy": "hier_qcross"})
+            assert wire.alltoall_strategy_for("global") == "hier_qcross"
+            assert wire.alltoall_cross_wire_for("global", cfg) == "int8"
+            ctrl._apply(rt, rt.threshold, rt._cycle_s * 1000.0,
+                        {"a2a_strategy": "hier"})
+            assert wire.alltoall_strategy_for("global") == "hier"
+            assert wire.alltoall_cross_wire_for("global", cfg) == ""
+            assert ctrl._a2a_qcross_armed is None
+        finally:
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+
+
+class TestA2ACrossWireRevert:
+    """The guarded one-epoch trial of the quantized expert cross wire
+    (controller lever ``a2a_cross_wire``): activations carry no error
+    feedback, so adoption demands a genuine DCN collapse."""
+
+    def _frame(self, dcn, wall=0.01):
+        return ap_signals.SignalFrame(flushes=1, steps=1, dcn_bytes=dcn,
+                                      wall_mean_s=wall, elapsed_s=1.0,
+                                      reduced_bytes=1.0)
+
+    def test_trial_without_dcn_collapse_reverts_wire_and_strategy(
+            self, hvd, monkeypatch):
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import fusion, wire
+        rt = fusion.get_runtime()
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        try:
+            cfg = basics.config()
+            ctrl = AutopilotController(cfg)
+            monkeypatch.setattr(ctrl, "_slices", lambda: 2)
+            wire.runtime_sync_alltoall_strategy("hier", "global")
+            ctrl._maybe_try_a2a_cross(self._frame(1000.0), rt)
+            assert ctrl._a2a_cross_trial is not None
+            assert wire.alltoall_strategy_for("global") == "hier_qcross"
+            assert wire.alltoall_cross_wire_for("global", cfg) == "int8"
+            # next epoch: DCN did not collapse below 0.75x the baseline
+            ctrl._judge_a2a_cross_trial(self._frame(990.0), rt)
+            assert ctrl._a2a_cross_trial is None
+            assert not ctrl._a2a_cross_adopted
+            assert wire.alltoall_strategy_for("global") == "hier"
+            assert wire.alltoall_cross_wire_for("global", cfg) == ""
+            outcomes = [d["outcome"] for d in ctrl.decisions()
+                        if d["lever"] == "a2a_cross_wire"]
+            assert outcomes == ["trial", "reverted"]
+        finally:
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+
+    def test_dcn_collapse_adopts(self, hvd, monkeypatch):
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import fusion, wire
+        rt = fusion.get_runtime()
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        try:
+            cfg = basics.config()
+            ctrl = AutopilotController(cfg)
+            monkeypatch.setattr(ctrl, "_slices", lambda: 2)
+            wire.runtime_sync_alltoall_strategy("hier", "global")
+            ctrl._maybe_try_a2a_cross(self._frame(1000.0), rt)
+            ctrl._judge_a2a_cross_trial(self._frame(260.0), rt)
+            assert ctrl._a2a_cross_adopted
+            assert wire.alltoall_strategy_for("global") == "hier_qcross"
+            assert wire.alltoall_cross_wire_for("global", cfg) == "int8"
+            outcomes = [d["outcome"] for d in ctrl.decisions()
+                        if d["lever"] == "a2a_cross_wire"]
+            assert outcomes == ["trial", "adopted"]
+        finally:
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+
+    def test_no_trial_when_tier_disarmed_or_one_slice(self, hvd,
+                                                      monkeypatch):
+        """No hierarchical a2a strategy armed, or a 1-slice layout: the
+        lever must not move (nothing to quantize / pure overhead)."""
+        from horovod_tpu.common import basics
+        from horovod_tpu.ops import fusion, wire
+        rt = fusion.get_runtime()
+        wire.clear_wire_registry()
+        wire.clear_strategy_registry()
+        try:
+            ctrl = AutopilotController(basics.config())
+            monkeypatch.setattr(ctrl, "_slices", lambda: 2)
+            ctrl._maybe_try_a2a_cross(self._frame(1000.0), rt)
+            assert ctrl._a2a_cross_trial is None       # tier disarmed
+            wire.runtime_sync_alltoall_strategy("hier", "global")
+            monkeypatch.setattr(ctrl, "_slices", lambda: 1)
+            ctrl._maybe_try_a2a_cross(self._frame(1000.0), rt)
+            assert ctrl._a2a_cross_trial is None       # 1-slice layout
+        finally:
+            wire.clear_wire_registry()
+            wire.clear_strategy_registry()
+
 
 class TestOverlapPin:
     def test_pin_survives_per_flush_steering(self, hvd):

@@ -1,0 +1,494 @@
+"""Hierarchical quantized alltoall (ISSUE 18): the 2-level expert
+dispatch — slice-local a2a (ICI) -> cross-slice leg on the per-tier wire
+(DCN, optionally block-scaled int8) — across the eager dispatch tier
+(hierarchy-keyed plans), the jit tier (strategies.alltoall_tiered*), the
+MoE layer and the composite dp x pp x moe scenario, with exact per-leg
+wire_bytes_total accounting mirrored by the static cost model."""
+
+import sys
+
+import cloudpickle
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh
+from jax.sharding import PartitionSpec as P
+
+from horovod_tpu.ops import wire
+
+# Cluster workers can't import this module by name; ship workers by value.
+cloudpickle.register_pickle_by_value(sys.modules[__name__])
+
+N = 8
+
+
+def _tier_bytes(hvd):
+    snap = hvd.metrics_snapshot()
+    out = {}
+    for s in snap.get("wire_bytes_total", {}).get("series", ()):
+        key = (s["labels"]["dtype"], s["labels"].get("tier"))
+        out[key] = out.get(key, 0.0) + s["value"]
+    return out
+
+
+def _delta(a, b):
+    return {k: b.get(k, 0.0) - a.get(k, 0.0)
+            for k in set(a) | set(b) if b.get(k, 0.0) != a.get(k, 0.0)}
+
+
+@pytest.fixture
+def a2a_hier(hvd, monkeypatch):
+    """Forced 2-slice layout with both wire registries and the
+    hierarchy-keyed caches clean on both sides. The a2a cross-dtype pin
+    lives in the WIRE registry (``a2a:global@dcn``), not the strategy
+    registry — teardown must clear both (the moe_sweep bench lesson)."""
+    from horovod_tpu.metrics import instruments as ins
+    from horovod_tpu.ops import collective_ops as C
+    monkeypatch.setenv("HOROVOD_MESH_SLICES", "2")
+    wire.clear_wire_registry()
+    wire.clear_strategy_registry()
+    ins.reset_tier_split()
+    C.clear_program_caches()
+    yield
+    wire.clear_wire_registry()
+    wire.clear_strategy_registry()
+    ins.reset_tier_split()
+    C.clear_program_caches()
+
+
+class TestEagerHierarchicalAlltoall:
+    def test_exact_parity_and_dcn_is_flat_total_over_slices(self, hvd,
+                                                            a2a_hier):
+        """Acceptance: the exact hierarchical route is bit-equal to the
+        flat alltoall, and its measured DCN bytes equal the flat
+        dispatch's TOTAL bytes divided by the slice width, exactly."""
+        n = hvd.size()
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((n, n * 512)), jnp.float32)
+        per = int(np.prod(x.shape[1:]))
+        flat_total = n * per * 4
+
+        jax.block_until_ready(hvd.alltoall(x))            # warm flat
+        t0 = _tier_bytes(hvd)
+        ref = np.asarray(hvd.alltoall(x))
+        d_flat = _delta(t0, _tier_bytes(hvd))
+        # flat a2a books total bytes at the live (S-1)/S cross fraction
+        assert d_flat == {("float32", "ici"): flat_total / 2,
+                          ("float32", "dcn"): flat_total / 2}, d_flat
+
+        hvd.set_alltoall_strategy("hier")
+        jax.block_until_ready(hvd.alltoall(x))            # warm hier
+        t0 = _tier_bytes(hvd)
+        got = np.asarray(hvd.alltoall(x))
+        d_hier = _delta(t0, _tier_bytes(hvd))
+        np.testing.assert_array_equal(got, ref)           # bit-equal
+        h = wire.hierarchical_a2a_bytes(per, n, 2, 4)
+        assert h["cross_label"] is None
+        assert d_hier == {("float32", "ici"): float(h["ici"]),
+                          ("float32", "dcn"): float(h["dcn"])}, d_hier
+        assert d_hier[("float32", "dcn")] == flat_total / 2   # EXACT
+
+    def test_int8_cross_leg_ratio_and_bounded_error(self, hvd, a2a_hier):
+        """hier_qcross + int8 expert cross wire: DCN bytes fall below
+        0.3x the exact hierarchical leg; values stay close (block-scaled
+        cross) but NOT exact (the quantization genuinely engaged)."""
+        n = hvd.size()
+        rng = np.random.default_rng(1)
+        x = jnp.asarray(rng.standard_normal((n, n * 512)), jnp.float32)
+        per = int(np.prod(x.shape[1:]))
+        ref = np.asarray(hvd.alltoall(x))                 # flat reference
+
+        hvd.set_alltoall_strategy("hier_qcross")
+        hvd.set_alltoall_cross_dtype("int8")
+        jax.block_until_ready(hvd.alltoall(x))            # warm
+        t0 = _tier_bytes(hvd)
+        got = np.asarray(hvd.alltoall(x))
+        d = _delta(t0, _tier_bytes(hvd))
+        rel = np.abs(got - ref).max() / (np.abs(ref).max() + 1e-9)
+        assert 0 < rel < 0.05, rel
+        h_exact = wire.hierarchical_a2a_bytes(per, n, 2, 4)
+        h_int8 = wire.hierarchical_a2a_bytes(per, n, 2, 4,
+                                             cross_wire="int8")
+        assert h_int8["cross_label"] == "int8"
+        ct = h_int8["cross_tiers"]
+        assert d == {("float32", "ici"): float(h_int8["local"]),
+                     ("int8", "ici"): float(ct["ici"]),
+                     ("int8", "dcn"): float(ct["dcn"])}, d
+        assert h_int8["dcn"] < 0.3 * h_exact["dcn"]       # acceptance
+
+    def test_sub_block_payload_keeps_cross_exact(self, hvd, a2a_hier):
+        """A per-rank payload below one BLOCK per destination slice must
+        refuse the quantized cross leg (padding would inflate it) and
+        stay bit-exact — the shared wire.quantized_eligible refusal."""
+        n = hvd.size()
+        x = jnp.asarray(np.arange(n * n * 8, dtype=np.float32)
+                        .reshape(n, n * 8))
+        ref = np.asarray(hvd.alltoall(x))
+        hvd.set_alltoall_strategy("hier_qcross")
+        hvd.set_alltoall_cross_dtype("int8")
+        got = np.asarray(hvd.alltoall(x))
+        np.testing.assert_array_equal(got, ref)
+        h = wire.hierarchical_a2a_bytes(int(np.prod(x.shape[1:])), n, 2, 4,
+                                        cross_wire="int8")
+        assert h["cross_label"] is None
+
+    def test_plan_keys_carry_hierarchy_tail_and_invalidate(self, hvd,
+                                                           a2a_hier):
+        """Plan-cache contract: the hierarchy facts join the eager a2a
+        plan key (index 4), so a strategy flip routes through a
+        differently-keyed plan with both coexisting — and
+        clear_program_caches drops the plans, the hier a2a program cache
+        AND the verdict cache (elastic reset / slice-layout change)."""
+        from horovod_tpu.ops import collective_ops as C
+        n = hvd.size()
+        x = jnp.ones((n, n * 512), jnp.float32)
+        jax.block_until_ready(hvd.alltoall(x))
+        hvd.set_alltoall_strategy("hier")
+        jax.block_until_ready(hvd.alltoall(x))
+        hvd.set_alltoall_strategy("hier_qcross")
+        hvd.set_alltoall_cross_dtype("int8")
+        jax.block_until_ready(hvd.alltoall(x))
+        tails = sorted((k[4] for k in C._plans if k[0] == "alltoall"),
+                       key=str)
+        assert tails == [(2, "int8"), (2, None), None], tails
+        assert C._hier_alltoall_program.cache_info().currsize > 0
+        assert C._a2a_hier_verdict.cache_info().currsize > 0
+        C.clear_program_caches()
+        assert not [k for k in C._plans if k[0] == "alltoall"]
+        assert C._hier_alltoall_program.cache_info().currsize == 0
+        assert C._a2a_hier_verdict.cache_info().currsize == 0
+
+    def test_one_slice_layout_stays_flat(self, hvd, monkeypatch):
+        """An armed a2a tier over a 1-slice layout must keep the flat
+        path (the slice-local leg would duplicate the exchange on the
+        same ICI for no DCN saving — HVP113's eager premise)."""
+        from horovod_tpu.metrics import instruments as ins
+        from horovod_tpu.ops import collective_ops as C
+        monkeypatch.delenv("HOROVOD_MESH_SLICES", raising=False)
+        wire.clear_strategy_registry()
+        ins.reset_tier_split()
+        C.clear_program_caches()
+        hvd.set_alltoall_strategy("hier_qcross")
+        try:
+            n = hvd.size()
+            x = jnp.asarray(np.arange(n * n * 64, dtype=np.float32)
+                            .reshape(n, n * 64))
+            t0 = _tier_bytes(hvd)
+            out = np.asarray(hvd.alltoall(x))
+            d = _delta(t0, _tier_bytes(hvd))
+            ref = np.asarray(x).reshape(n, n, -1).transpose(1, 0, 2) \
+                .reshape(n, -1)
+            np.testing.assert_array_equal(out, ref)
+            assert all(k[1] == "ici" for k in d), d       # no dcn series
+            assert all(k[4] is None for k in C._plans
+                       if k[0] == "alltoall")
+        finally:
+            wire.clear_strategy_registry()
+            ins.reset_tier_split()
+            C.clear_program_caches()
+
+
+class TestMoETrainStepParity:
+    """CPU-tier acceptance: the MoE layer's dispatch/combine through the
+    2-level alltoall, flat vs hierarchical, single-process."""
+
+    def _apply(self, hvd, moe, params, x):
+        mesh = Mesh(np.array(jax.devices()[:N], dtype=object), ("ep",))
+        specs = {"router": {"kernel": P()}, "w_in": P("ep"),
+                 "w_out": P("ep")}
+
+        def apply_fn(p, xl):
+            y, aux = moe.apply({"params": p}, xl)
+            return y, jax.lax.pmean(aux, "ep")
+
+        return jax.jit(jax.shard_map(
+            apply_fn, mesh=mesh, in_specs=(specs, P("ep")),
+            out_specs=(P("ep"), P())))(params, x)
+
+    def test_flat_vs_hierarchical_bit_equal(self, hvd, a2a_hier, rng):
+        """With the exact cross leg the hierarchical expert dispatch is
+        the SAME exchange as the flat tiled a2a — outputs, aux loss and
+        parameter gradients all bit-equal."""
+        from horovod_tpu.parallel.moe import MoEMlp
+        d, f, E, T = 8, 16, 8, 32
+        x = jnp.asarray(rng.standard_normal((N * T, d)), jnp.float32)
+        oracle = MoEMlp(num_experts=E, hidden_size=d, intermediate_size=f,
+                        capacity_factor=float(E), axis_name="ep")
+        params = oracle.init(jax.random.PRNGKey(1), x)["params"]
+
+        outs, grads = {}, {}
+        for name, hier in (("flat", False), ("hier", True)):
+            moe = MoEMlp(num_experts=E, hidden_size=d,
+                         intermediate_size=f, capacity_factor=float(E),
+                         axis_name="ep", hierarchical=hier)
+
+            def loss(p, moe=moe):
+                y, aux = self._apply(hvd, moe, p, x)
+                return jnp.sum(y * y) + aux
+
+            l, g = jax.value_and_grad(loss)(params)
+            outs[name] = float(l)
+            grads[name] = g
+        assert outs["flat"] == outs["hier"], outs
+        for a, b in zip(jax.tree_util.tree_leaves(grads["flat"]),
+                        jax.tree_util.tree_leaves(grads["hier"])):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_int8_cross_close_and_compression_metered(self, hvd,
+                                                      a2a_hier, rng):
+        """A pinned int8 expert cross wire: the MoE output tracks the
+        flat route within the block-scale bound (STE backward keeps the
+        gradient exchange exact), and the jit compression counter proves
+        the quantized leg actually engaged."""
+        from horovod_tpu.parallel.moe import MoEMlp
+        d, f, E, T = 16, 32, 8, 128            # slots/shard = 4096 elems
+        x = jnp.asarray(rng.standard_normal((N * T, d)), jnp.float32)
+        oracle = MoEMlp(num_experts=E, hidden_size=d, intermediate_size=f,
+                        capacity_factor=2.0, axis_name="ep")
+        params = oracle.init(jax.random.PRNGKey(2), x)["params"]
+        flat = MoEMlp(num_experts=E, hidden_size=d, intermediate_size=f,
+                      capacity_factor=2.0, axis_name="ep",
+                      hierarchical=False)
+        y_flat, _ = self._apply(hvd, flat, params, x)
+        hvd.set_alltoall_cross_dtype("int8")
+
+        def _events(snap):
+            return {tuple(sorted(s["labels"].items())): s["value"]
+                    for s in snap.get("wire_compression_events_total",
+                                      {}).get("series", ())}
+
+        e0 = _events(hvd.metrics_snapshot())
+        hier = MoEMlp(num_experts=E, hidden_size=d, intermediate_size=f,
+                      capacity_factor=2.0, axis_name="ep",
+                      hierarchical=True)
+        y_hier, _ = self._apply(hvd, hier, params, x)
+        e1 = _events(hvd.metrics_snapshot())
+        key = (("dtype", "int8"), ("path", "jit"))
+        assert e1.get(key, 0) >= e0.get(key, 0) + 2   # dispatch + combine
+        a, b = np.asarray(y_flat), np.asarray(y_hier)
+        rel = np.abs(a - b).max() / (np.abs(a).max() + 1e-9)
+        assert 0 < rel < 0.05, rel
+
+
+class TestCompositeMoEHierarchical:
+    def test_dp_pp_moe_routes_through_tiered_exchange(self, hvd, rng,
+                                                      a2a_hier,
+                                                      monkeypatch):
+        """The composite dp x pp x moe scenario with
+        ``moe_hierarchical=True``: expert dispatch AND combine trace
+        through strategies.alltoall_tiered_groups over the dp axis (spied
+        at trace time), and the pipeline still trains."""
+        import optax
+        from horovod_tpu.models.gpt import GPTConfig
+        from horovod_tpu.parallel import strategies
+        from horovod_tpu.parallel.composite import CompositeGPT, build_mesh3d
+
+        spy = []
+        orig = strategies._record_jit_a2a_tiered
+
+        def spying(x, n, num_slices, cross_label):
+            spy.append((int(n), int(num_slices), cross_label))
+            return orig(x, n, num_slices, cross_label)
+
+        monkeypatch.setattr(strategies, "_record_jit_a2a_tiered", spying)
+
+        cfg = GPTConfig.tiny(vocab_size=64, hidden_size=32, num_layers=2,
+                             num_heads=4, intermediate_size=64,
+                             max_position_embeddings=16, num_experts=4,
+                             capacity_factor=4.0, moe_hierarchical=True)
+        mesh = build_mesh3d(dp=2, pp=2, tp=2)
+        comp = CompositeGPT(cfg, mesh, optax.adam(3e-3), n_micro=2)
+        ids = jnp.asarray(rng.integers(0, 64, (8, 16)), jnp.int32)
+        params, opt_state, specs = comp.init(jax.random.PRNGKey(0), ids)
+        step = comp.make_train_step(specs, donate=False)
+        losses = []
+        for _ in range(6):
+            params, opt_state, loss = step(params, opt_state, ids)
+            losses.append(float(loss))
+        assert all(np.isfinite(losses))
+        assert losses[-1] < losses[0], losses
+        # dp=2 over 2 forced slices: dispatch + combine per micro-batch
+        # direction, all through the 2-level exchange (exact cross: no
+        # cross dtype pinned)
+        assert spy and all(rec == (2, 2, None) for rec in spy), spy
+
+
+class TestStaticCostMirror:
+    def test_hier_a2a_what_if_is_as_dispatched_delta_zero(self, hvd,
+                                                          a2a_hier):
+        """Acceptance: with the hierarchical a2a armed, the cost model's
+        hierarchical what-if IS the as-dispatched prediction and
+        cross_check_bytes closes at per-tier delta 0 against the runtime
+        counters — and the predicted DCN equals flat-total/slices."""
+        from horovod_tpu.analysis import cost as an_cost
+        n = hvd.size()
+        x = np.ones((n, n * 512), np.float32)
+        per = int(np.prod(x.shape[1:]))
+        hvd.set_alltoall_strategy("hier")
+
+        def step(x):
+            return hvd.alltoall(x)
+
+        jax.block_until_ready(step(x))       # warm: compile + plan
+        base = hvd.metrics_snapshot()
+        iters = 3
+        for _ in range(iters):
+            jax.block_until_ready(step(x))
+        after = hvd.metrics_snapshot()
+        rep = hvd.check_program(step, (x,), world_size=n)
+        cost = an_cost.cost_report(rep)      # slices from the forced env
+        assert cost.num_slices == 2
+        res = an_cost.cross_check_bytes(cost, after, base, steps=iters)
+        assert res["match"], res
+        for t, row in res["per_tier"].items():
+            assert row["delta"] == 0.0, (t, res)
+        assert cost.hierarchical["ici"] == cost.bytes_by_tier["ici"]
+        assert cost.hierarchical["dcn"] == cost.bytes_by_tier["dcn"]
+        assert cost.bytes_by_tier["dcn"] == n * per * 4 // 2
+
+
+class TestJitTieredAlltoall:
+    def test_alltoall_tiered_parity_and_trace_accounting(self, hvd,
+                                                         a2a_hier):
+        """The in-jit entry over a (cross, local) mesh: bit-equal to the
+        flat tiled a2a over the flattened axis pair, per-tier bytes
+        recorded at trace time with the shared integer formulas."""
+        from horovod_tpu.ops import collective_ops as C
+        from horovod_tpu.parallel.strategies import alltoall_tiered
+        n = hvd.size()
+        hmesh = C._hier_mesh(hvd.global_process_set.mesh, 2)
+        rng = np.random.default_rng(3)
+        x = jnp.asarray(rng.standard_normal((n * n, 512)), jnp.float32)
+
+        flat = jax.jit(jax.shard_map(
+            lambda v: jax.lax.all_to_all(v, ("cross", "local"),
+                                         split_axis=0, concat_axis=0,
+                                         tiled=True),
+            mesh=hmesh, in_specs=P(("cross", "local")),
+            out_specs=P(("cross", "local"))))
+        ref = np.asarray(flat(x))
+
+        t0 = _tier_bytes(hvd)
+        tiered = jax.jit(jax.shard_map(
+            lambda v: alltoall_tiered(v),
+            mesh=hmesh, in_specs=P(("cross", "local")),
+            out_specs=P(("cross", "local")), check_vma=False))
+        got = np.asarray(tiered(x))
+        d = _delta(t0, _tier_bytes(hvd))
+        np.testing.assert_array_equal(got, ref)
+        per = n * 512                        # per-shard elems
+        h = wire.hierarchical_a2a_bytes(per, n, 2, 4)
+        assert d == {("float32", "ici"): float(h["ici"]),
+                     ("float32", "dcn"): float(h["dcn"])}, d
+
+
+class TestSweepLevers:
+    def test_a2a_strategy_joins_only_when_armed_over_slices(self):
+        from horovod_tpu.autotune import sweep_categoricals
+        cats = sweep_categoricals("flat", "", True, a2a_strategy="hier")
+        assert cats["a2a_strategy"] == ["hier", "flat", "hier_qcross"]
+        assert "a2a_cross_dtype" not in cats
+        # disarmed tier or 1-slice layout: no a2a levers
+        assert "a2a_strategy" not in sweep_categoricals("flat", "", True)
+        assert "a2a_strategy" not in sweep_categoricals(
+            "flat", "", False, a2a_strategy="hier")
+
+    def test_a2a_cross_dtype_sweeps_up_to_exact_only_on_opt_in(self):
+        """The cross-dtype lever exists only when the user already opted
+        into a QUANTIZED expert cross wire, and sweeps toward the exact
+        leg — the sweep never quantizes activations on its own."""
+        from horovod_tpu.autotune import sweep_categoricals
+        cats = sweep_categoricals("flat", "", True,
+                                  a2a_strategy="hier_qcross",
+                                  a2a_cross_dtype="int8")
+        assert cats["a2a_cross_dtype"] == ["int8", ""]
+        cats = sweep_categoricals("flat", "", True,
+                                  a2a_strategy="hier_qcross",
+                                  a2a_cross_dtype="bfloat16")
+        assert "a2a_cross_dtype" not in cats
+
+
+def _moe_hier_worker(_):
+    """8-process leg of the MoE-dispatch acceptance under
+    HOROVOD_MESH_SLICES=2: an expert-dispatch train loop whose
+    dispatch/combine exchanges ride the eager alltoall — flat vs
+    hierarchical bit-parity, with the hierarchical DCN bytes equal to the
+    flat dispatch's TOTAL bytes over the slice width, per dispatch,
+    exactly (importable by value via cloudpickle)."""
+    import numpy as np
+
+    import jax
+    import jax.numpy as jnp
+
+    import horovod_tpu as hvd
+    from horovod_tpu.ops import wire as _w
+
+    hvd.init()
+    n = hvd.size()
+    me = hvd.cross_rank()
+
+    def tiers():
+        out = {}
+        snap = hvd.metrics_snapshot()
+        for s in snap.get("wire_bytes_total", {}).get("series", ()):
+            key = (s["labels"]["dtype"], s["labels"].get("tier"))
+            out[key] = out.get(key, 0.0) + s["value"]
+        return out
+
+    d, C = 32, 64                          # per-rank slots: n*C rows
+    rng = np.random.default_rng(11)
+    slots = rng.standard_normal((1, n * C, d)).astype(np.float32) \
+        * (me + 1)
+    w = rng.standard_normal((d, d)).astype(np.float32)
+    per = n * C * d
+
+    def train_step():
+        """dispatch -> local expert matmul -> combine, eager a2a both
+        ways (the MoE layer's exchange pattern at the dispatch tier)."""
+        z = hvd.alltoall(jnp.asarray(slots))
+        y = jnp.einsum("rtd,df->rtf", z, jnp.asarray(w))
+        return np.asarray(hvd.alltoall(y))
+
+    out = {"rank": me, "slices": hvd.topology().num_slices}
+    hvd.set_alltoall_strategy("flat")
+    ref = train_step()                     # warm + reference
+    t0 = tiers()
+    ref = train_step()
+    d_flat = {k: v - t0.get(k, 0.0) for k, v in tiers().items()
+              if v != t0.get(k, 0.0)}
+    hvd.set_alltoall_strategy("hier")
+    got = train_step()                     # warm hier plans
+    t0 = tiers()
+    got = train_step()
+    d_hier = {k: v - t0.get(k, 0.0) for k, v in tiers().items()
+              if v != t0.get(k, 0.0)}
+    hvd.set_alltoall_strategy("")
+    out["exact"] = bool(np.array_equal(got, ref))
+    flat_total = sum(d_flat.values())      # 2 a2a x n*per*4 bytes
+    out["flat_total"] = flat_total
+    out["flat_expected"] = float(2 * n * per * 4)
+    out["hier_dcn"] = d_hier.get(("float32", "dcn"), 0.0)
+    return out
+
+
+@pytest.mark.slow
+class TestMoEHierarchy8Proc:
+    def test_cluster_dispatch_parity_and_exact_dcn_split(self,
+                                                         shared_cluster):
+        """Acceptance: 8-proc CPU-tier cluster under
+        HOROVOD_MESH_SLICES=2 — every worker's hierarchical expert
+        dispatch is bit-equal to the flat route, and the measured DCN
+        bytes are EXACTLY the flat total divided by the slice width."""
+        cluster = shared_cluster(
+            "localhost:1,127.0.0.1:1,127.0.0.2:1,127.0.0.3:1,"
+            "127.0.0.4:1,127.0.0.5:1,127.0.0.6:1,127.0.0.7:1",
+            extra_env={"HOROVOD_MESH_SLICES": "2"})
+        out = cluster.run(_moe_hier_worker, args=(None,), timeout=600)
+        assert len(out) == 8
+        for r in out:
+            assert r["slices"] == 2, r
+            assert r["exact"], r
+            assert r["flat_total"] == r["flat_expected"], r
+            assert r["hier_dcn"] == r["flat_total"] / 2, r
