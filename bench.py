@@ -1410,6 +1410,83 @@ def _bench_control_sweep(hvd):
     return 0
 
 
+def _bench_twin_sweep(hvd):
+    """Scale-twin sweep (`HVD_BENCH_MODEL=twin_sweep`): the control_sweep
+    ladder continued past the thread-feasible worlds through the hvdsim
+    event twin (``horovod_tpu/sim`` — virtual ranks over a deterministic
+    event heap, the same exchange math) up to n=65536. Hier cells are
+    event-simulated at every rung; flat past ``sim.FLAT_WORLD_CAP``
+    would be O(world^2) events, so those cells are priced analytically
+    from ``control_plane.exchange_plan`` + the twin latency model
+    (labeled ``priced="analytic"``). Every (world, slices, strategy)
+    cell lands as a `twin_sweep` record on the HVD_BENCH_PROGRESS_FILE
+    channel; the final BENCH record carries the hier-vs-flat worst-rank
+    gets ratio at n=65536 — the fan-out collapse, now measured two
+    orders of magnitude past the thread dryrun."""
+    from horovod_tpu.common import control_plane as cp
+    from horovod_tpu.sim import FLAT_WORLD_CAP, LatencyModel
+    from horovod_tpu.sim.control import twin_exchange
+
+    rounds = max(int(os.environ.get("HVD_BENCH_ITERS", "2")), 1)
+    latency = LatencyModel.from_env()
+    ladder = [(512, 16), (4096, 64), (16384, 64), (65536, 256)]
+    ratio_largest = 1.0
+    for world, slices in ladder:
+        cells = {}
+        for strategy, k in (("flat", 0), ("hier", slices)):
+            t0 = time.perf_counter()
+            if strategy == "flat" and world > FLAT_WORLD_CAP:
+                plan = cp.exchange_plan(world, 1)
+                worst = float(plan["leader_gets"])
+                cell = {
+                    "world": world, "slices": 1, "strategy": "flat",
+                    "rounds": rounds, "priced": "analytic",
+                    "identical": True,
+                    "gets_total_per_round": plan["round_gets_total"],
+                    "worst_rank_gets_per_round": worst,
+                    "member_gets_per_round": float(plan["member_gets"]),
+                    "leader_gets_per_round": worst,
+                    # serial blocking chain of the worst rank, priced by
+                    # the same per-RPC latency model the event twin uses
+                    "virtual_s": round(worst * latency.seconds(False), 6),
+                }
+            else:
+                r = twin_exchange(world, k, rounds=rounds,
+                                  strategy=strategy, latency=latency)
+                worst = max(c["gets"] for c in r["per_proc"]) / rounds
+                cell = {
+                    "world": world, "slices": r["num_slices"],
+                    "strategy": r["strategy"], "rounds": rounds,
+                    "priced": "event", "identical": r["identical"],
+                    "gets_total_per_round": r["gets_total"] / rounds,
+                    "worst_rank_gets_per_round": worst,
+                    "member_gets_per_round": r["member_gets_per_round"],
+                    "leader_gets_per_round": r["leader_gets_per_round"],
+                    "payload_bytes_per_round":
+                        r["payload_bytes"] / rounds,
+                    "events": r["events"],
+                    "virtual_s": round(r["virtual_s"] / rounds, 6),
+                }
+            cell["wall_s"] = round(time.perf_counter() - t0, 3)
+            cells[cell["strategy"]] = cell
+            _progress_record("twin_sweep", **cell)
+            _mark(f"twin_sweep w={world} s={slices} {cell['strategy']} "
+                  f"[{cell['priced']}]: worst-rank gets/round "
+                  f"{cell['worst_rank_gets_per_round']:.0f}, "
+                  f"virtual {cell['virtual_s']*1e3:.2f} ms, "
+                  f"wall {cell['wall_s']:.2f} s")
+        if "hier" in cells and "flat" in cells:
+            ratio_largest = cells["hier"]["worst_rank_gets_per_round"] \
+                / max(cells["flat"]["worst_rank_gets_per_round"], 1.0)
+    _progress_record("twin_sweep_summary",
+                     hier_vs_flat_worst_rank_gets_ratio=round(
+                         ratio_largest, 6))
+    _emit("twin_sweep_worst_rank_gets_ratio", round(ratio_largest, 6),
+          "hier/flat worst-rank negotiation gets ratio at n=65536 "
+          "(event twin)", 0.0)
+    return 0
+
+
 def _bench_autopilot_sweep(hvd):
     """Autopilot convergence sweep (`HVD_BENCH_MODEL=autopilot_sweep`):
     start the runtime deliberately detuned (tiny fusion threshold, flat
@@ -1539,6 +1616,10 @@ _EXTRA_MODELS = {
     "autopilot_sweep": (_bench_autopilot_sweep,
                         "autopilot_sweep_score_ratio",
                         "converged/detuned autopilot score ratio"),
+    "twin_sweep": (_bench_twin_sweep,
+                   "twin_sweep_worst_rank_gets_ratio",
+                   "hier/flat worst-rank negotiation gets ratio at "
+                   "n=65536 (event twin)"),
 }
 
 

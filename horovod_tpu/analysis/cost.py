@@ -609,6 +609,35 @@ def _control_plane_cost(events, world, num_slices, config):
     }
 
 
+def collective_step_tiers(per_rank_elems, world, num_slices, *,
+                          strategy="flat", width=4, cross_wire=""):
+    """Per-tier wire bytes of ONE allreduce of ``per_rank_elems``
+    elements under ``strategy`` — the callable per-event pricing seam
+    the scale digital twin's step model uses (:mod:`horovod_tpu.sim.
+    autopilot`): same ``wire.hierarchical_wire_bytes`` /
+    ``ring_dcn_fraction`` integers :func:`cost_report` books, so the
+    twin and the static model can never disagree on a step's bytes.
+
+    Returns ``{"ici": int, "dcn": int}``. Hierarchical strategies book
+    tier-explicit legs (local RS+AG on ICI, slice-reduced shards on the
+    cross wire over DCN; ``torus_qcross`` forces the int8 cross leg);
+    flat books the full ring volume split on the slice-boundary
+    fraction of a rank-major contiguous group."""
+    e = max(int(per_rank_elems), 0)
+    n = max(int(world), 1)
+    k, slice_size = resolve_slices(n, num_slices)
+    if strategy in ("hierarchical", "torus", "torus_qcross") and k > 1:
+        h = _wire.hierarchical_wire_bytes(
+            e, n, k, width,
+            cross_wire=("int8" if strategy == "torus_qcross"
+                        else cross_wire or ""))
+        return {"ici": int(h["ici"]), "dcn": int(h["dcn"])}
+    total = 2 * n * e * width
+    frac = _ring_dcn_fraction(list(range(n)), slice_size) if k > 1 else 0.0
+    dcn = int(round(total * frac))
+    return {"ici": total - dcn, "dcn": dcn}
+
+
 def check_cost(step_fn, args=(), kwargs=None, *, world_size=None,
                num_slices=None, config=None, dcn_budget_bytes=None,
                **check_kwargs):

@@ -150,7 +150,7 @@ class AutopilotController:
             self._slices() > 1, a2a_strategy=a2a_cur or None,
             a2a_cross_dtype=getattr(self._config, "alltoall_cross_dtype",
                                     ""))
-        return ParameterManager(
+        pm = ParameterManager(
             warmup_samples=0,
             steps_per_sample=1,
             bayes_opt_max_samples=int(
@@ -162,6 +162,37 @@ class AutopilotController:
             initial_cycle_ms=runtime._cycle_s * 1000.0,
             categorical_knobs=cats,
             max_move_log2=1.0)
+        self._load_prior(pm)
+        return pm
+
+    def _load_prior(self, pm):
+        """Warm-start ``pm`` from a twin-pretrained prior artifact
+        (``HOROVOD_AUTOPILOT_PRIOR`` — an ``export_observations`` JSON
+        file written by ``horovod_tpu.sim.autopilot``): the categorical
+        sweep is skipped and the numeric search starts at the twin's
+        best point. Fail-soft by design — a missing, malformed, or
+        space-mismatched prior logs and leaves the cold start intact
+        (a bad artifact must never take the autopilot down with it)."""
+        path = str(getattr(self._config, "autopilot_prior", "") or "")
+        if not path:
+            return
+        try:
+            import json
+            with open(path) as f:
+                data = json.load(f)
+            consumed = pm.import_observations(data)
+        except Exception as e:  # noqa: BLE001 — cold start still valid
+            hvd_logging.warning(
+                "autopilot prior %s not loaded (%s); starting cold",
+                path, e)
+            self._record("tuner", "prior_rejected", path=path,
+                         error=str(e)[:200])
+            return
+        hvd_logging.info(
+            "autopilot warm-started from twin prior %s: %d observations,"
+            " categoricals=%s", path, consumed, pm.categoricals)
+        self._record("tuner", "prior_loaded", path=path,
+                     observations=consumed, categoricals=pm.categoricals)
 
     def _score(self, frame):
         """The epoch's objective: reduced payload bytes per second (the

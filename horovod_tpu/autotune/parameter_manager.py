@@ -214,6 +214,107 @@ class ParameterManager:
             score = 0.0
         return self._end_sample(score)
 
+    # --- twin-prior serialization seam --------------------------------
+
+    def export_observations(self):
+        """JSON-serializable record of everything this manager observed —
+        the sweep space it ran over, per-combo categorical scores, the
+        numeric BO samples, and the best point seen. This is the twin
+        prior artifact (``horovod_tpu.sim.autopilot`` writes it, a live
+        controller loads it through ``HOROVOD_AUTOPILOT_PRIOR``)."""
+        best_point, best_score = self._best
+        if best_point is None:
+            best_point = self._current
+        return {
+            "version": 1,
+            "bounds": [list(self._LOG2_THR), list(self._LOG2_CYC)],
+            "categoricals": {n: list(self._cat_knobs[n])
+                             for n in self._cat_names},
+            "cat_scores": [
+                {"combo": dict(zip(self._cat_names, combo)),
+                 "scores": [float(s) for s in scores]}
+                for combo, scores in self._cat_scores.items()],
+            "samples": [
+                {"point": [float(v) for v in x], "score": float(y)}
+                for x, y in zip(self._bo.x_samples, self._bo.y_samples)],
+            "best": {
+                "point": [float(v) for v in best_point],
+                "score": (float(best_score)
+                          if np.isfinite(best_score) else 0.0),
+                "categoricals": self.categoricals,
+            },
+        }
+
+    def import_observations(self, data, adopt_best=True):
+        """Warm-start this manager from an :meth:`export_observations`
+        artifact: the categorical sweep is SKIPPED (the prior's winning
+        combo is adopted directly) and the numeric search starts at the
+        prior's best point instead of the configured initials. Returns
+        the number of prior observations consumed.
+
+        The prior's scores are deliberately NOT fed to the live GP: twin
+        scores are modeled bytes/sec, live scores are measured — mixing
+        the two scales would distort expected improvement and could let
+        a modeled score win ``_best`` at freeze time. What transfers is
+        the sweep OUTCOME (combo + starting point); the prior's raw
+        ``cat_scores`` are kept for forensics/tie context only.
+
+        Raises ``ValueError`` when the artifact does not match this
+        manager's sweep space (different bounds, categorical knob names,
+        or choice sets) — a prior from a different layout or build must
+        be rejected loudly, not silently misapplied."""
+        if not isinstance(data, dict) or data.get("version") != 1:
+            raise ValueError(
+                "autopilot prior: expected an export_observations dict "
+                f"with version=1, got {type(data).__name__}")
+        bounds = [list(self._LOG2_THR), list(self._LOG2_CYC)]
+        got_bounds = [[float(v) for v in b] for b in data.get("bounds", [])]
+        if got_bounds != bounds:
+            raise ValueError(
+                f"autopilot prior: numeric bounds {got_bounds} do not "
+                f"match this build's {bounds}")
+        prior_cats = {n: list(v)
+                      for n, v in (data.get("categoricals") or {}).items()}
+        if sorted(prior_cats) != self._cat_names or any(
+                set(prior_cats[n]) != set(self._cat_knobs[n])
+                for n in self._cat_names):
+            raise ValueError(
+                "autopilot prior: categorical space "
+                f"{ {n: sorted(v) for n, v in prior_cats.items()} } does "
+                "not match this manager's "
+                f"{ {n: sorted(v) for n, v in self._cat_knobs.items()} }")
+        best = data.get("best") or {}
+        best_cats = best.get("categoricals") or {}
+        if self._cat_names:
+            combo = tuple(best_cats.get(n) for n in self._cat_names)
+            if any(c not in self._cat_knobs[n]
+                   for n, c in zip(self._cat_names, combo)):
+                raise ValueError(
+                    f"autopilot prior: best categoricals {best_cats} not "
+                    "in this manager's sweep space")
+            self._cat_current = combo
+            self._cat_done = True
+            self._cat_queue = []
+            self._cat_warmed = combo  # already compiled/ran in the twin
+            for entry in data.get("cat_scores") or []:
+                key = tuple(entry["combo"].get(n) for n in self._cat_names)
+                if key in self._cat_scores:
+                    self._cat_scores[key] = [float(s)
+                                             for s in entry["scores"]]
+        consumed = len(data.get("samples") or []) + sum(
+            len(e.get("scores") or [])
+            for e in data.get("cat_scores") or [])
+        if adopt_best and best.get("point") is not None:
+            point = np.asarray([float(v) for v in best["point"]], float)
+            if point.shape != self._current.shape:
+                raise ValueError(
+                    f"autopilot prior: best point {best['point']} has "
+                    f"wrong dimensionality (want {len(self._current)})")
+            point[0] = np.clip(point[0], *self._LOG2_THR)
+            point[1] = np.clip(point[1], *self._LOG2_CYC)
+            self._current = point
+        return consumed
+
     def _knobs(self):
         return self.fusion_threshold, self.cycle_time_ms, self.categoricals
 

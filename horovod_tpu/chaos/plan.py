@@ -160,6 +160,49 @@ def _unit(seed, spec_idx, n):
     return int.from_bytes(h.digest(), "big") / 2.0 ** 64
 
 
+class TriggerCursor:
+    """The bookkeeping half of the determinism contract, reusable outside
+    the process-level injector: per-(site, rank) call counters, per-spec
+    firing budgets and the (spec, step) once-per-step set, driving
+    :meth:`FaultSpec.matches` — which stays the single trigger decision.
+    The in-process :mod:`~horovod_tpu.chaos.injector` keeps its own
+    per-process counters (one process = one rank there); the scale
+    digital twin (:mod:`horovod_tpu.sim`) hosts EVERY virtual rank in one
+    process, so its counters must be rank-keyed — this class is that
+    seam. Purely deterministic: same plan + same call sequence → same
+    verdicts, no wall clock, no RNG stream."""
+
+    def __init__(self, plan):
+        self.plan = plan
+        self._counts = {}       # (site, rank) -> site call count
+        self._fires = {}        # spec idx -> total fires
+        self._step_fired = set()
+        self.log = []           # (site, rank, step, n, kind) fired log
+
+    def decide(self, site, rank, step=None):
+        """Advance the (site, rank) call counter and return the list of
+        :class:`FaultSpec` entries that fire for this call."""
+        if self.plan is None:
+            return []
+        specs = self.plan.by_site.get(site)
+        n = self._counts.get((site, rank), 0)
+        self._counts[(site, rank)] = n + 1
+        if not specs:
+            return []
+        fired = []
+        for idx, spec in specs:
+            if not spec.matches(n, step, rank, self.plan.seed, idx,
+                                self._fires.get(idx, 0),
+                                self._step_fired):
+                continue
+            self._fires[idx] = self._fires.get(idx, 0) + 1
+            if spec.at_step is not None and step is not None:
+                self._step_fired.add((idx, step))
+            fired.append(spec)
+            self.log.append((site, rank, step, n, spec.kind))
+        return fired
+
+
 class ChaosPlan:
     def __init__(self, faults, seed=0, note=""):
         self.faults = list(faults)

@@ -89,6 +89,20 @@ class Config:
     # Do-not-shrink floor: never remediate below this world size
     # (0 = derive: the elastic launch's --min-np, else 1).
     autopilot_min_world: int = 0
+    # Twin-pretrained warm start: path to an export_observations JSON
+    # artifact (horovod_tpu.sim.autopilot writes one) — the controller
+    # skips the categorical sweep and starts the numeric search at the
+    # twin's best point. "" = cold start. A mismatched/malformed prior
+    # is rejected with a warning, never fatal.
+    autopilot_prior: str = ""
+
+    # --- hvdsim scale digital twin (horovod_tpu/sim; ROADMAP item 3) —
+    # latency model of the virtual control plane: base cost of one KV
+    # RPC and the cross-slice (DCN) surcharge, in microseconds. The
+    # twin's guards assert on RPC *counts*; these only shape virtual
+    # timings (docs/scale_validation.md).
+    sim_kv_us: float = 5.0
+    sim_dcn_us: float = 50.0
 
     # --- timeline (reference common.h:117-118) ---
     timeline_filename: str = ""
@@ -479,6 +493,10 @@ class Config:
                                           c.autopilot_hysteresis)
         c.autopilot_min_world = _env_int("HOROVOD_AUTOPILOT_MIN_WORLD",
                                          c.autopilot_min_world)
+        c.autopilot_prior = os.environ.get("HOROVOD_AUTOPILOT_PRIOR",
+                                           c.autopilot_prior)
+        c.sim_kv_us = _env_float("HOROVOD_SIM_KV_US", c.sim_kv_us)
+        c.sim_dcn_us = _env_float("HOROVOD_SIM_DCN_US", c.sim_dcn_us)
         c.timeline_filename = os.environ.get("HOROVOD_TIMELINE", c.timeline_filename)
         c.timeline_mark_cycles = _env_bool("HOROVOD_TIMELINE_MARK_CYCLES",
                                            c.timeline_mark_cycles)

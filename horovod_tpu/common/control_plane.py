@@ -257,13 +257,23 @@ class CoordKV:
 class LocalKV:
     """In-memory blocking KV with the :class:`CoordKV` surface — the
     virtual-world simulation tier (one thread per simulated rank drives
-    the real exchange code against it)."""
+    the real exchange code against it).
 
-    def __init__(self):
+    ``observer(op, key)`` (optional) is called once per ``set``/``get``
+    entry — the event seam the scale digital twin
+    (:mod:`horovod_tpu.sim`) and the dryrun cross-checks hang per-op
+    accounting on. It runs OUTSIDE the condition lock, before the
+    blocking wait, so an observer can never deadlock the exchange (and
+    a get that times out still counts as the one RPC it issued)."""
+
+    def __init__(self, observer=None):
         self._d = {}
         self._cv = threading.Condition()
+        self._observer = observer
 
     def set(self, key, value, overwrite=False):
+        if self._observer is not None:
+            self._observer("set", key)
         with self._cv:
             if key in self._d and not overwrite:
                 raise KeyError(f"key exists: {key}")
@@ -271,6 +281,8 @@ class LocalKV:
             self._cv.notify_all()
 
     def get(self, key, timeout_ms):
+        if self._observer is not None:
+            self._observer("get", key)
         deadline = time.monotonic() + timeout_ms / 1000.0
         with self._cv:
             while key not in self._d:
@@ -388,7 +400,7 @@ def gc_exchange_keys(kv, me, base_prev, groups):
 # --- virtual-world dryrun tier ------------------------------------------
 
 def simulate_exchange(world, num_slices, rounds=1, payload_fn=None,
-                      strategy="hier", sweep_ms=5):
+                      strategy="hier", sweep_ms=5, observer=None):
     """Drive the REAL exchange implementations at a virtual world size:
     one thread per simulated rank over a :class:`LocalKV`, ``rounds``
     exchange rounds each. This is the n=128-512 control-plane dryrun —
@@ -397,14 +409,16 @@ def simulate_exchange(world, num_slices, rounds=1, payload_fn=None,
 
     Returns a dict with the resolved layout, whether every rank produced
     the identical ordered payload list (the SPMD contract), and per-role
-    RPC counters aggregated over all rounds."""
+    RPC counters aggregated over all rounds. ``observer`` is forwarded to
+    :class:`LocalKV` — per-op ``(op, key)`` callbacks, the hook the twin
+    parity cross-checks use."""
     world = int(world)
     procs = list(range(world))
     k, per = slice_layout(world, num_slices or None)
     hier = strategy == "hier" and k > 1
     groups = [procs[i * per:(i + 1) * per] for i in range(k)] if hier \
         else None
-    kv = LocalKV()
+    kv = LocalKV(observer=observer)
     payload_fn = payload_fn or (lambda p, r: [p + 1, r, p % 7])
     counters = [dict.fromkeys(
         ("sets", "gets", "attempts", "gets_local", "gets_cross",

@@ -78,6 +78,7 @@ _ARG_ENV_MAP = [
     ("autopilot", "HOROVOD_AUTOPILOT", lambda v: "1" if v else None),
     ("no_autopilot", "HOROVOD_AUTOPILOT", lambda v: "0" if v else None),
     ("autopilot_interval", "HOROVOD_AUTOPILOT_INTERVAL", str),
+    ("autopilot_prior", "HOROVOD_AUTOPILOT_PRIOR", str),
     ("serving", "HOROVOD_SERVING", lambda v: "1" if v else None),
     ("serving_port", "HOROVOD_SERVING_PORT", str),
     ("serving_slots", "HOROVOD_SERVING_SLOTS", str),

@@ -204,6 +204,17 @@ def parse_args(argv=None):
                            help="Decision-epoch cadence in seconds "
                                 "(HOROVOD_AUTOPILOT_INTERVAL, "
                                 "default 10).")
+    autopilot.add_argument("--autopilot-prior", type=str,
+                           dest="autopilot_prior",
+                           help="Twin-pretrained warm start: path to an "
+                                "export_observations JSON artifact "
+                                "written by horovod_tpu.sim.autopilot "
+                                "(HOROVOD_AUTOPILOT_PRIOR). The "
+                                "controller skips the categorical sweep "
+                                "and starts the numeric search at the "
+                                "twin's best point; a mismatched prior "
+                                "is rejected with a warning, never "
+                                "fatal. See docs/scale_validation.md.")
 
     tracing = p.add_argument_group("tracing")
     tracing.add_argument("--trace", action="store_true", dest="trace",
@@ -509,6 +520,8 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_AUTOPILOT_MAX_REMOVALS",
                 "HOROVOD_AUTOPILOT_HYSTERESIS",
                 "HOROVOD_AUTOPILOT_MIN_WORLD",
+                "HOROVOD_AUTOPILOT_PRIOR",
+                "HOROVOD_SIM_KV_US", "HOROVOD_SIM_DCN_US",
                 "HOROVOD_SERVING", "HOROVOD_SERVING_PORT",
                 "HOROVOD_SERVING_SLOTS", "HOROVOD_SERVING_MAX_LEN",
                 "HOROVOD_SERVING_PREFILL_CHUNK",

@@ -1,0 +1,17 @@
+"""hvdsim — the event-driven scale digital twin (ROADMAP item 3).
+
+Virtual ranks as generators over a deterministic event heap
+(:mod:`~horovod_tpu.sim.core`), control-plane programs that mirror the
+real exchange math (:mod:`~horovod_tpu.sim.control`), and a
+twin-pretrained autopilot prior (:mod:`~horovod_tpu.sim.autopilot`).
+``python -m horovod_tpu.sim`` runs the scale-guard battery with
+lint-style exit codes (docs/scale_validation.md)."""
+
+from horovod_tpu.sim.core import LatencyModel, Simulator, SimTimeout
+from horovod_tpu.sim.control import (FLAT_WORLD_CAP, TwinJob,
+                                     flat_reference, twin_exchange)
+
+__all__ = [
+    "FLAT_WORLD_CAP", "LatencyModel", "SimTimeout", "Simulator",
+    "TwinJob", "flat_reference", "twin_exchange",
+]

@@ -348,6 +348,36 @@ class TestRoleHygiene:
         chaos.uninstall()
         assert injector._role == "worker"
 
+    def test_sim_driven_chaos_restores_role_and_injector_state(self):
+        """The scale twin (horovod_tpu/sim) decides faults through
+        plan.TriggerCursor — rank-keyed counters of its OWN, because one
+        twin process hosts every virtual rank — while the process-level
+        injector may be armed with a different plan for the real
+        workload. A twin run must not advance the injector's site
+        counters, and ``uninstall()`` afterwards must still restore the
+        role (the test_runner -> test_chaos load-order leak, re-pinned
+        with the sim-driven path in the mix)."""
+        from horovod_tpu.sim.control import TwinJob
+
+        chaos.install(_plan({"site": "telemetry.tick", "kind": "delay",
+                             "at": [0]}, seed=1))
+        chaos.set_role("driver")
+        try:
+            twin_plan = _plan({"site": "http_kv.request", "kind": "delay",
+                               "p": 0.05, "delay_ms": 10},
+                              {"site": "negotiation.exchange",
+                               "kind": "crash", "rank": 3, "at": [1],
+                               "max_fires": 1}, seed=3)
+            report = TwinJob(64, 4, rounds=3, plan=twin_plan).run()
+            assert report["stats"]["kv_ops"] > 0
+            assert 3 in report["dead"]
+            # The twin's chaos bookkeeping never touched the injector.
+            assert not injector._site_counts
+            assert not injector._spec_fires
+        finally:
+            chaos.uninstall()
+        assert injector._role == "worker"
+
     def test_in_process_driver_run_restores_roles(self, tmp_path):
         """run_elastic_driver claims the driver roles (chaos + flight)
         for its own process; in-process runs must hand them back even

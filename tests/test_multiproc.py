@@ -892,6 +892,45 @@ class TestControlPlaneDryrun:
         # hierarchy removes.
         assert f["member_gets_per_round"] == 127
 
+    # --- twin anchor: these thread legs are the ground truth the hvdsim
+    # event twin must reproduce before its 16k-65k extrapolations
+    # (tests/test_sim.py) are worth anything. Compare everything except
+    # "attempts": the flat thread path's bounded short-timeout sweep
+    # retries are timing-dependent by design; the gets the guards count
+    # are not.
+
+    @staticmethod
+    def _assert_twin_matches_thread(thread, twin):
+        for key in ("world", "num_slices", "slice_size", "strategy",
+                    "rounds", "identical", "payload_bytes", "gets_total",
+                    "member_gets_per_round", "leader_gets_per_round"):
+            assert thread[key] == twin[key], \
+                (key, thread[key], twin[key])
+        assert thread["result"] == twin["result"]
+        for tc, wc in zip(thread["per_proc"], twin["per_proc"]):
+            for key in ("sets", "gets", "gets_local", "gets_cross",
+                        "gets_fanback"):
+                assert tc[key] == wc[key], (key, tc, wc)
+
+    @pytest.mark.timeout(120)
+    def test_twin_matches_thread_dryrun_n128(self):
+        from horovod_tpu.common import control_plane as cp
+        from horovod_tpu.sim.control import twin_exchange
+        self._assert_twin_matches_thread(
+            cp.simulate_exchange(128, 8, rounds=2),
+            twin_exchange(128, 8, rounds=2))
+        self._assert_twin_matches_thread(
+            cp.simulate_exchange(128, 0, rounds=1, strategy="flat"),
+            twin_exchange(128, 0, rounds=1, strategy="flat"))
+
+    @pytest.mark.timeout(300)
+    def test_twin_matches_thread_dryrun_n512(self):
+        from horovod_tpu.common import control_plane as cp
+        from horovod_tpu.sim.control import twin_exchange
+        self._assert_twin_matches_thread(
+            cp.simulate_exchange(512, 16, rounds=1),
+            twin_exchange(512, 16, rounds=1))
+
 
 def _frontend_battery():
     """Frontend eager ops across a real process boundary: the stacked-rows
