@@ -56,6 +56,11 @@ def _progress_record(phase, **extra):
         csum, _ = _cluster_snapshot_field()
         if csum is not None:
             rec["cluster_snapshot"] = csum
+        # Goodput evidence: was the run productive up to this phase mark
+        # (and if not, which badput category ate the wall)?
+        gsum, _ = _goodput_summary_field()
+        if gsum is not None:
+            rec["goodput"] = gsum
         with open(_PROGRESS_PATH, "a") as f:
             f.write(json.dumps(rec) + "\n")
     except OSError:
@@ -321,6 +326,23 @@ def _cluster_snapshot_field():
         return None, (str(e).splitlines() or ["?"])[0][:160]
 
 
+def _goodput_summary_field():
+    """The goodput-ledger ride-along: the wall-clock decomposition
+    (goodput ratio + per-category badput seconds + conservation error),
+    so every BENCH record says not just how fast the steps were but how
+    much of the run's wall was productive at all. ``None`` (with a
+    reason) when accounting is off.
+    Returns ``(summary_or_None, reason_or_None)``."""
+    try:
+        from horovod_tpu.goodput import ledger as goodput_ledger
+        snap = goodput_ledger.snapshot()
+        if not snap.get("enabled"):
+            return None, "goodput accounting off (HOROVOD_GOODPUT=0)"
+        return snap, None
+    except Exception as e:  # noqa: BLE001 — telemetry must not fail bench
+        return None, (str(e).splitlines() or ["?"])[0][:160]
+
+
 def _with_metrics(record):
     snap, reason = _metrics_snapshot_field()
     record["metrics_snapshot"] = snap
@@ -338,6 +360,24 @@ def _with_metrics(record):
     record["cluster_snapshot"] = csum
     if csum is None:
         record["cluster_snapshot_reason"] = creason
+    gsum, greason = _goodput_summary_field()
+    record["goodput"] = gsum
+    if gsum is None:
+        record["goodput_reason"] = greason
+    else:
+        # Durable evidence: when a run journal is armed (rank 0 +
+        # HOROVOD_RUN_HISTORY_DIR) the BENCH record rides into the
+        # cross-run history too — `goodput.report` then regresses perf
+        # and efficiency from the same file.
+        try:
+            from horovod_tpu.goodput import history as _history
+            _history.journal_append(
+                "bench", record={k: record.get(k) for k in
+                                 ("metric", "value", "unit",
+                                  "vs_baseline")},
+                goodput=gsum)
+        except Exception:  # noqa: BLE001
+            pass
     return record
 
 
@@ -1585,6 +1625,111 @@ def _bench_autopilot_sweep(hvd):
     return 0
 
 
+def _bench_goodput_sweep(hvd):
+    """Goodput-decomposition fidelity sweep: drive a fake-clock
+    :class:`~horovod_tpu.goodput.ledger.GoodputLedger` through a KNOWN
+    injected badput schedule (compile stall, straggler steps, checkpoint
+    commits, an autopilot trial window, exposed cross-slice waits, a
+    wedge, an elastic reset) and assert the measured decomposition
+    recovers every injected quantity exactly — the virtual clock leaves
+    no jitter to hide behind. Each schedule leg lands as a labeled
+    ``goodput_sweep`` record on the HVD_BENCH_PROGRESS_FILE channel; the
+    final BENCH record carries recovered/injected badput ratio (1.0 =
+    perfect recovery) and the conservation error."""
+    from horovod_tpu.goodput.ledger import (GoodputLedger,
+                                            PRODUCTIVE as PRODUCTIVE_CAT)
+
+    led = GoodputLedger()
+    t = 0.0
+    led.start(now=t)
+
+    def step_rec(comm=0.1, cross=0.0):
+        return {"attribution": {"host_dispatch": comm / 2,
+                                "collective": comm / 2,
+                                "cross_wait": cross}}
+
+    def boundary(dt, step, rec):
+        nonlocal t
+        t += dt
+        led.on_step_boundary(rec, step=step, now=t)
+
+    injected = {"init_compile": 5.0, "straggler_wait": 2.0,
+                "checkpoint_commit": 2.0, "autopilot_trial": 3.0,
+                "cross_wait_comm": 0.6, "wedge_idle": 2.0,
+                "rendezvous_recovery": 4.5}
+    step = 0
+    boundary(5.0, step, None)               # compile stall -> init_compile
+    _progress_record("goodput_sweep", leg="init", injected_s=5.0)
+    for _ in range(12):                     # clean baseline (builds the
+        step += 1                           # rolling comm median)
+        boundary(1.0, step, step_rec())
+    for _ in range(4):                      # straggler: comm 0.5s over the
+        step += 1                           # 0.1s median -> 0.5s excess/step
+        boundary(1.0, step, step_rec(comm=0.6))
+    _progress_record("goodput_sweep", leg="straggler", injected_s=2.0)
+    led.note_commit(2.0)                    # checkpoint: consumed from the
+    for _ in range(2):                      # next two 1s windows
+        step += 1
+        boundary(1.0, step, step_rec())
+    _progress_record("goodput_sweep", leg="commit", injected_s=2.0)
+    led.set_trial(True)                     # autopilot trial window
+    for _ in range(3):
+        step += 1
+        boundary(1.0, step, step_rec())
+    led.set_trial(False)
+    _progress_record("goodput_sweep", leg="trial", injected_s=3.0)
+    for _ in range(2):                      # exposed cross-slice wait
+        step += 1
+        boundary(1.0, step, step_rec(cross=0.3))
+    _progress_record("goodput_sweep", leg="cross_wait", injected_s=0.6)
+    led.note_wedge(now=t)                   # stall verdict, recovers
+    t += 2.0                                # without a reset
+    led.note_unwedged(now=t)
+    _progress_record("goodput_sweep", leg="wedge", injected_s=2.0)
+    t += 1.5                                # reset mid-window: the lost
+    led.on_reset(now=t)                     # partial step is badput too
+    t += 3.0                                # rendezvous + restore; the first
+    step += 1                               # post-restore marker only OPENS
+    led.on_step_boundary(None, step=step, now=t)  # a window (profile
+    # ledger was reset) -> the whole gap books to rendezvous_recovery
+    _progress_record("goodput_sweep", leg="reset",
+                     injected_s=1.5 + 3.0)
+    for _ in range(2):                      # post-recovery steps
+        step += 1
+        boundary(1.0, step, step_rec())
+
+    snap = led.assert_conservation(now=t, tol=1e-9)
+    cats = snap["categories"]
+    worst = ""
+    recovered = injected_total = 0.0
+    for cat, want in injected.items():
+        got = cats.get(cat, 0.0)
+        injected_total += want
+        recovered += got
+        if abs(got - want) > 1e-6:
+            worst = (f"{cat}: recovered {got:.6f}s of injected "
+                     f"{want:.6f}s")
+    expect_productive = 12.0 + 4 * 0.5 + 2 * 0.7 + 2.0
+    if abs(cats[PRODUCTIVE_CAT] - expect_productive) > 1e-6:
+        worst = worst or (f"productive_compute: {cats[PRODUCTIVE_CAT]:.6f}"
+                          f"s vs expected {expect_productive:.6f}s")
+    _progress_record(
+        "goodput_sweep_summary", categories=cats,
+        conservation_error=snap["conservation_error"],
+        goodput_ratio=snap["goodput_ratio"], mismatch=worst or None)
+    if worst:
+        raise RuntimeError(f"goodput_sweep decomposition mismatch — "
+                           f"{worst}")
+    ratio = recovered / injected_total
+    _mark(f"goodput_sweep: recovered {recovered:.2f}s of "
+          f"{injected_total:.2f}s injected badput "
+          f"(conservation error {snap['conservation_error']:.2e})")
+    _emit("goodput_sweep_recovered_ratio", round(ratio, 6),
+          "recovered/injected badput seconds (fake-clock schedule; "
+          "1.0 = the decomposition names every injected fault)", 0.0)
+    return 0
+
+
 # Non-image benchmarks: selector -> (bench fn, metric name, unit). One
 # registry so dispatch and failure records can never disagree.
 _EXTRA_MODELS = {
@@ -1620,6 +1765,9 @@ _EXTRA_MODELS = {
                    "twin_sweep_worst_rank_gets_ratio",
                    "hier/flat worst-rank negotiation gets ratio at "
                    "n=65536 (event twin)"),
+    "goodput_sweep": (_bench_goodput_sweep,
+                      "goodput_sweep_recovered_ratio",
+                      "recovered/injected badput seconds"),
 }
 
 

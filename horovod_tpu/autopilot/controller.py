@@ -306,6 +306,15 @@ class AutopilotController:
                         self._walls.append(frame["wall_mean_s"])
                 else:
                     self._walls.append(frame["wall_mean_s"])
+        # Tell the goodput ledger whether a guarded trial window is open:
+        # steps measured under a trial book to autopilot_trial (the trial
+        # pays for itself in the decomposition), not productive_compute.
+        try:
+            from horovod_tpu.goodput import ledger as _goodput
+            _goodput.set_trial(self._cross_trial is not None
+                               or self._a2a_cross_trial is not None)
+        except Exception:  # noqa: BLE001
+            pass
 
     # --- tuning arm -----------------------------------------------------
 

@@ -80,6 +80,15 @@ def snapshot():
         snap["slo_burn"] = _slo.burn_rates()
     except Exception:  # noqa: BLE001
         snap["slo_burn"] = {}
+    try:
+        # Goodput decomposition (cumulative; frame() diffs the category
+        # seconds) — lets the controller see efficiency, not just
+        # bytes/sec: a tuning trial that moves bytes but grows
+        # straggler_wait is a loss.
+        from horovod_tpu.goodput import ledger as _goodput
+        snap["goodput"] = _goodput.snapshot()
+    except Exception:  # noqa: BLE001
+        snap["goodput"] = {}
     return snap
 
 
@@ -128,6 +137,10 @@ class SignalFrame(dict):
     - ``straggler_namings``   {rank: count} new watchdog namings
     - ``slo_burn``            {objective: burn} declared-SLO burn rates
                               (absolute; {} when no SLO is declared)
+    - ``goodput_ratio``       cumulative job goodput ratio (absolute;
+                              None when accounting is off)
+    - ``badput_delta_s``      {category: seconds} badput booked this
+                              epoch (goodput-ledger category deltas)
     """
 
 
@@ -208,6 +221,24 @@ def frame(prev, cur, cluster_view=None):
     f["straggler_namings"] = namings
 
     f["slo_burn"] = dict(cur.get("slo_burn", {}))
+
+    # Goodput: ratio rides absolute (it is already cumulative and the
+    # controller wants the level), badput as per-category deltas so a
+    # trial's verdict can charge exactly the badput it caused.
+    gp_cur, gp_prev = cur.get("goodput") or {}, prev.get("goodput") or {}
+    f["goodput_ratio"] = gp_cur.get("goodput_ratio") \
+        if gp_cur.get("enabled") else None
+    deltas = {}
+    if gp_cur.get("enabled"):
+        c_cats = gp_cur.get("categories") or {}
+        p_cats = gp_prev.get("categories") or {}
+        for cat, v in c_cats.items():
+            if cat == "productive_compute":
+                continue
+            dv = float(v) - float(p_cats.get(cat, 0.0))
+            if dv > 0.0:
+                deltas[cat] = round(dv, 6)
+    f["badput_delta_s"] = deltas
 
     f["health_counts"] = {}
     f["unhealthy"] = {}

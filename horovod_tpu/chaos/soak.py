@@ -110,6 +110,9 @@ def soak_train(total_steps):
                     total += s.get("count", s.get("value", 0))
             return total
 
+        # Per-rank goodput decomposition: the wall-clock evidence the
+        # driver's conservation / bracket assertions read.
+        from horovod_tpu.goodput import ledger as goodput_ledger
         return {
             "steps": state.step,
             "w": np.asarray(state.w).tolist(),
@@ -124,6 +127,7 @@ def soak_train(total_steps):
             # Telemetry-plane evidence: the job view after the final
             # membership converged (local-only when the plane is off).
             "cluster": wait_cluster_view(),
+            "goodput": goodput_ledger.snapshot(),
         }
 
     return loop(state)
@@ -479,6 +483,160 @@ def run_autopilot_soak(procs=8, steps=56, seed=777, workdir=None,
             "workdir": workdir}
 
 
+def goodput_badput_plan(procs, seed, steps, kill_step=3,
+                        straggler_rank=2, delay_ms=120,
+                        straggler_from=12):
+    """Seeded badput schedule for the goodput acceptance soak: one hard
+    kill early (rendezvous_recovery badput on every survivor) plus a
+    WINDOWED collective-dispatch straggler on a rank that survives the
+    kill. The straggler window starts only after the survivors have
+    rebuilt a clean >= 8-step comm baseline post-reset — a delay injected
+    from step 0 is absorbed into the victim's own rolling median and
+    books no excess — and runs to the end so the watchdog's published
+    median actually goes outlier-high."""
+    kill_rank = procs - 3 if procs > 3 else procs - 1
+    assert straggler_rank != kill_rank
+    return kill_rank, {
+        "seed": seed,
+        "note": f"goodput soak: kill r{kill_rank}@s{kill_step}, "
+                f"{delay_ms}ms straggler r{straggler_rank}"
+                f"@s{straggler_from}..{steps - 1}",
+        "faults": [
+            {"site": "elastic.commit", "kind": "crash", "rank": kill_rank,
+             "at_step": [kill_step], "max_fires": 1},
+            {"site": "collective.dispatch", "kind": "delay",
+             "delay_ms": delay_ms, "rank": straggler_rank,
+             "at_step": list(range(straggler_from, steps))},
+        ],
+    }
+
+
+def run_goodput_soak(procs=8, steps=32, seed=555, workdir=None,
+                     delay_ms=120, straggler_rank=2):
+    """The goodput ledger's acceptance soak: an elastic run with a seeded
+    kill and a windowed straggler must come back with a decomposition
+    that (a) CONSERVES wall time on every rank, (b) BRACKETS the injected
+    badput — ``rendezvous_recovery`` on every reset rank,
+    ``straggler_wait`` on the victim against the chaos ledger's exact
+    fire count — and (c) leaves a durable journal from which the report
+    CLI names the victim rank. Asserted:
+
+    1. every survivor reaches the target step at world ``procs - 1``;
+    2. ``conservation_error <= 1%`` on EVERY rank's decomposition;
+    3. every rank that reset booked ``rendezvous_recovery`` in
+       ``(0, wall)``;
+    4. the victim's ``straggler_wait`` brackets the injected delay total
+       (loose CPU-box bounds; the exact total comes from the injection
+       ledger, not the plan);
+    5. the step watchdog's cross-rank naming reached the goodput ledger:
+       some survivor's snapshot carries ``straggler_named == victim``;
+    6. the run journal is durable and complete (``run_end`` present) and
+       ``python -m horovod_tpu.goodput.report`` renders it, naming
+       ``victim: rank <straggler_rank>``.
+    """
+    import io
+    import tempfile
+    workdir = workdir or tempfile.mkdtemp(prefix="hvd_goodput_soak_")
+    os.makedirs(workdir, exist_ok=True)
+    kill_rank, plan_dict = goodput_badput_plan(
+        procs, seed, steps, straggler_rank=straggler_rank,
+        delay_ms=delay_ms)
+    plan_path = os.path.join(workdir, "plan.yaml")
+    with open(plan_path, "w") as f:
+        json.dump(plan_dict, f)
+    ledger_dir = os.path.join(workdir, "ledger")
+    history_dir = os.path.join(workdir, "run_history")
+    goodput_dir = os.path.join(workdir, "goodput")
+    _progress("goodput soak start", procs=procs, steps=steps,
+              kill_rank=kill_rank, straggler_rank=straggler_rank)
+    try:
+        results = _elastic_run(steps, procs, procs - 1, workdir, {
+            "HOROVOD_CHAOS_PLAN": plan_path,
+            "HOROVOD_CHAOS_SEED": str(seed),
+            "HOROVOD_CHAOS_LEDGER": ledger_dir,
+            "HOROVOD_FLIGHT_DIR": os.path.join(workdir, "flight"),
+            "HOROVOD_GOODPUT": "1",
+            "HOROVOD_GOODPUT_DIR": goodput_dir,
+            "HOROVOD_RUN_HISTORY_DIR": history_dir,
+            "HOROVOD_GOODPUT_JOURNAL_S": "2",
+            # Watchdog publish rounds every 2 steps (cross-rank straggler
+            # naming) + live telemetry beacons (per-rank goodput rows in
+            # the journaled cluster view).
+            "HOROVOD_PROFILE_PUBLISH_STEPS": "2",
+            "HOROVOD_TELEMETRY_INTERVAL": "0.5",
+        })
+    finally:
+        from horovod_tpu import chaos
+        chaos.uninstall()
+    survivors = procs - 1
+    # (1) elastic recovery held.
+    assert all(r["steps"] == steps for r in results), \
+        f"goodput soak fell short of {steps} steps: {results}"
+    assert all(r["final_world"] == survivors for r in results), results
+    by_rank = {r["cross_rank"]: r for r in results}
+    # The injected straggler total from the injection ledger — the exact
+    # count of delay fires, not the plan's intent (a fire suppressed by
+    # the recovery window would silently shrink the bracket's target).
+    from horovod_tpu.chaos import injector
+    entries = injector.read_ledger(ledger_dir)
+    delays = [e for e in entries if e["kind"] == "delay"]
+    assert delays, f"straggler never fired: {entries}"
+    injected_s = len(delays) * delay_ms / 1e3
+    # (2) conservation on every rank.
+    for r in results:
+        gp = r["goodput"]
+        assert gp.get("enabled"), f"goodput off on r{r['cross_rank']}"
+        assert gp["conservation_error"] <= 0.01, \
+            f"conservation violated on r{r['cross_rank']}: {gp}"
+    # (3) recovery badput on every reset rank.
+    for r in results:
+        if r["resets"]:
+            rr = r["goodput"]["categories"]["rendezvous_recovery"]
+            assert 0.0 < rr < r["goodput"]["wall_s"], \
+                (r["cross_rank"], r["goodput"])
+    # (4) the victim's straggler_wait brackets the injected total. Lower
+    # bound: at least 3 full-delay steps booked before the victim's own
+    # rolling median adapts to the elevated window. Upper: generous
+    # CPU-contention slack — the wait must still be the same order as
+    # the injection, not the whole run.
+    wait = by_rank[straggler_rank]["goodput"]["categories"][
+        "straggler_wait"]
+    lower = 3 * delay_ms / 1e3
+    upper = 3.0 * injected_s + 2.0
+    assert lower <= wait <= upper, \
+        f"victim straggler_wait {wait:.3f}s outside " \
+        f"[{lower:.3f}, {upper:.3f}] for {injected_s:.3f}s injected"
+    # (5) the comparative (watchdog) naming reached the ledger.
+    named = {r["cross_rank"]: r["goodput"].get("straggler_named")
+             for r in results}
+    assert any(v == straggler_rank for v in named.values()), \
+        f"no survivor's watchdog named r{straggler_rank}: {named}"
+    # (6) durable journal + report CLI naming.
+    from horovod_tpu.goodput import report as goodput_report
+    from horovod_tpu.goodput.history import read_runs
+    runs = read_runs(history_dir)
+    assert runs, f"no run journal under {history_dir}"
+    rid = sorted(runs, key=lambda r: runs[r].get("t0") or 0)[-1]
+    summary = runs[rid]
+    assert summary.get("ended"), \
+        f"journal {rid} has no run_end marker: {summary['records']} recs"
+    victim = goodput_report.find_victim(summary)
+    assert victim is not None and int(victim[0]) == straggler_rank, \
+        f"report blamed {victim}, expected rank {straggler_rank}"
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = goodput_report.main(["--dir", history_dir])
+    rendered = buf.getvalue()
+    assert rc == 0 and f"victim: rank {straggler_rank}" in rendered, \
+        rendered
+    _progress("goodput soak done", ok=True, injected_s=injected_s,
+              straggler_wait=round(wait, 3), named=named)
+    return {"procs": procs, "steps": steps, "kill_rank": kill_rank,
+            "straggler_rank": straggler_rank, "injected_s": injected_s,
+            "straggler_wait_s": wait, "named": named, "run_id": rid,
+            "report": rendered, "results": results, "workdir": workdir}
+
+
 def run_soak(procs=8, steps=8, seed=123, workdir=None, plan_dict=None,
              loss_tol=1e-5, reruns=1):
     """Run clean + chaos (+ ``reruns`` same-seed repeats), assert the
@@ -566,6 +724,24 @@ def _run_soak_inner(procs, steps, seed, workdir, plan_dict, plan_path,
             assert recovered and all(r["recoveries"] >= 1
                                      for r in recovered), \
                 f"elastic_recovery_seconds not populated: {results}"
+            # (4b) goodput conservation on every rank, clean AND chaos
+            # legs — the decomposition must account the full wall within
+            # 1% no matter how the run was disrupted — and every
+            # recovering worker booked rendezvous_recovery badput.
+            for r in clean + results:
+                gp = r.get("goodput") or {}
+                if not gp.get("enabled"):
+                    continue
+                assert gp["conservation_error"] <= 0.01, \
+                    f"goodput conservation violated on " \
+                    f"r{r['cross_rank']}: {gp}"
+            for r in recovered:
+                gp = r.get("goodput") or {}
+                if not gp.get("enabled"):
+                    continue
+                assert gp["categories"]["rendezvous_recovery"] > 0.0, \
+                    f"r{r['cross_rank']} reset {r['resets']}x but booked " \
+                    f"no rendezvous_recovery: {gp['categories']}"
             # the injected kill actually fired (exactly once)
             kills = [e for e in entries if e["kind"] == "crash"]
             assert len(kills) == budget, entries

@@ -116,6 +116,14 @@ def init(comm=None, process_sets=None, devices=None):
         from horovod_tpu.telemetry import slo as _slo
         _slo.configure(config)
 
+        # Goodput accounting: start the wall clock before the distributed
+        # bootstrap so rendezvous + compile book to init_compile. The
+        # ledger survives elastic re-init (configure is start-once); the
+        # durable run journal arms after bootstrap, once the rank is
+        # known (rank 0 only).
+        from horovod_tpu.goodput import ledger as _goodput
+        _goodput.configure(config)
+
         # Decide on distributed bootstrap from the env alone: probing
         # jax.process_count() here would initialize the local backend and
         # forbid jax.distributed.initialize afterwards.
@@ -241,6 +249,21 @@ def init(comm=None, process_sets=None, devices=None):
             _telemetry.start_from_config(config, topology)
         except Exception as e:  # noqa: BLE001 — telemetry must not block init
             hvd_logging.warning("telemetry plane failed to start: %s", e)
+
+        # Durable run-history journal (HOROVOD_RUN_HISTORY_DIR): armed on
+        # the coordinator rank once the world shape is known. Arm-once
+        # like the goodput ledger — an elastic re-init keeps appending to
+        # the same run's journal (a new coordinator after a rank-0 death
+        # opens its own).
+        try:
+            from horovod_tpu.goodput import history as _run_history
+            if _run_history.get_journal() is None:
+                # config.cross_rank is the launcher-assigned process id
+                # (0 on the coordinator / single-controller).
+                _run_history.journal_configure(
+                    config, rank=config.cross_rank, world=topology.size)
+        except Exception as e:  # noqa: BLE001 — must not block init
+            hvd_logging.warning("run-history journal failed to arm: %s", e)
 
         # Autopilot (HOROVOD_AUTOPILOT): the online controller closing the
         # signal plane → knobs loop, coordinator rank only (followers
@@ -535,6 +558,24 @@ def shutdown():
             _state.timeline.close()
         from horovod_tpu import metrics as hvd_metrics
         hvd_metrics.stop_http_server()
+        # Run-history journal: append the current cluster view + goodput
+        # summary while the telemetry agent is still alive (the reader
+        # takes the LAST of each kind, so mid-run elastic resets just
+        # refresh the evidence; the final run_end marker comes from the
+        # goodput atexit finalizer).
+        try:
+            from horovod_tpu.goodput import history as _run_history
+            if _run_history.get_journal() is not None:
+                from horovod_tpu.goodput import ledger as _goodput_ledger
+                from horovod_tpu.telemetry import aggregator as _telemetry
+                agent = _telemetry.get_agent()
+                if agent is not None:
+                    _run_history.journal_append(
+                        "cluster", view=agent.cluster_snapshot())
+                _run_history.journal_append(
+                    "goodput", summary=_goodput_ledger.snapshot())
+        except Exception:  # noqa: BLE001 — history must not block exit
+            pass
         # Telemetry agent: stopped here, restarted by the next init (an
         # elastic re-init restarts it under the new membership generation
         # — rank numbering changes across memberships, so the old agent's

@@ -408,6 +408,29 @@ class Config:
     slo_tps: float = 0.0
     slo_window_s: float = 60.0
 
+    # --- goodput/badput accounting + durable run history
+    # (horovod_tpu/goodput; docs/observability.md "Goodput accounting").
+    # Per-rank wall-clock decomposition into productive_compute vs named
+    # badput categories with a 1% conservation guarantee. Always-on like
+    # the flight recorder: the hot path is one boundary call per step.
+    goodput: bool = True
+    # Directory for per-rank goodput summary dumps at shutdown
+    # (goodput_r<rank>.json; "" = no dumps).
+    goodput_dir: str = ""
+    # Durable cross-run history: rank 0 appends run_<id>.jsonl journals
+    # (run id, config fingerprint, goodput heartbeats, bench records,
+    # final cluster view) under this directory, one flushed line per
+    # record so a killed run still leaves evidence. "" = off.
+    run_history_dir: str = ""
+    # Journal goodput-heartbeat cadence in seconds (HOROVOD_GOODPUT_JOURNAL_S):
+    # how often rank 0 appends a goodput summary line, so a SIGKILLed run's
+    # last record is at most this stale.
+    goodput_journal_s: float = 10.0
+    # Run id override (HOROVOD_RUN_ID): names the journal file
+    # run_<id>.jsonl; default is a launch-time timestamp+pid. Set it when
+    # an external scheduler already has a job id worth correlating on.
+    run_id: str = ""
+
     # --- metrics / telemetry (horovod_tpu/metrics; no reference analog —
     # the reference's observability stops at timeline + stall inspector).
     # Always-on by default: the registry hot path is O(1) and lock-light
@@ -462,6 +485,21 @@ class Config:
             raise ValueError(
                 f"slo_window_s={self.slo_window_s}: the burn-rate "
                 "window must be positive")
+        # Normalize the goodput paths: surrounding whitespace in an env
+        # var must not silently create a different directory, and the
+        # journal requires goodput accounting (there would be nothing to
+        # heartbeat into it).
+        self.goodput_dir = (self.goodput_dir or "").strip()
+        self.run_history_dir = (self.run_history_dir or "").strip()
+        if self.run_history_dir and not self.goodput:
+            raise ValueError(
+                "run_history_dir requires goodput=True "
+                "(HOROVOD_GOODPUT=1): the run journal's heartbeat IS the "
+                "goodput summary")
+        if self.goodput_journal_s <= 0.0:
+            raise ValueError(
+                f"goodput_journal_s={self.goodput_journal_s}: the journal "
+                "heartbeat cadence must be positive")
 
     @classmethod
     def from_env(cls):
@@ -653,6 +691,16 @@ class Config:
         c.slo_tps = _env_float("HOROVOD_SLO_TPS", c.slo_tps)
         c.slo_window_s = _env_float("HOROVOD_SLO_WINDOW_S",
                                     c.slo_window_s)
+        c.goodput = _env_bool("HOROVOD_GOODPUT", c.goodput)
+        c.goodput_dir = os.environ.get("HOROVOD_GOODPUT_DIR",
+                                       c.goodput_dir)
+        c.run_history_dir = os.environ.get("HOROVOD_RUN_HISTORY_DIR",
+                                           c.run_history_dir)
+        c.goodput_journal_s = _env_float("HOROVOD_GOODPUT_JOURNAL_S",
+                                         c.goodput_journal_s)
+        c.run_id = os.environ.get("HOROVOD_RUN_ID", c.run_id)
+        c.__post_init__()  # re-validate: goodput paths read after the
+        # control-plane re-normalization above
         c.metrics = _env_bool("HOROVOD_METRICS", c.metrics)
         c.metrics_port = _env_int("HOROVOD_METRICS_PORT", c.metrics_port)
         c.metrics_addr = os.environ.get("HOROVOD_METRICS_ADDR",

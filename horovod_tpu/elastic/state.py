@@ -29,6 +29,7 @@ from horovod_tpu.common import logging as hvd_logging
 from horovod_tpu.common.exceptions import (HorovodInternalError,
                                            HostsUpdatedInterrupt)
 from horovod_tpu.flight import recorder as _flight
+from horovod_tpu.goodput import ledger as _goodput
 from horovod_tpu.metrics import instruments as _metrics
 
 
@@ -60,7 +61,9 @@ class State:
 
     def commit(self):
         """Commit (save) + check for host changes (reference: elastic.py:54)."""
+        t_save = time.monotonic()
         self.save()
+        _goodput.note_commit(time.monotonic() - t_save)
         step = getattr(self, "step", None)
         if step is not None:
             # Step annotation BEFORE the chaos site: a crash injected at
@@ -286,6 +289,8 @@ def run(func):
                 skip_sync = False
                 known_version = configured_version()
                 if recovering is not None:
+                    _goodput.note_recovery(
+                        recovering[0], time.monotonic() - recovering[1])
                     _metrics.record_elastic_recovery(
                         recovering[0], time.monotonic() - recovering[1])
                     recovering = None
@@ -303,6 +308,10 @@ def run(func):
             except HorovodInternalError:
                 if recovering is None:
                     recovering = ("failure", time.monotonic())
+                # Goodput phase flip: everything from here to the first
+                # post-restore step boundary (including the destroyed
+                # open window) is rendezvous_recovery badput.
+                _goodput.note_reset()
                 _metrics.record_elastic_event("restore")
                 # The ring's tail at this moment is the failed collective
                 # plus everything leading up to it — dump before restore
@@ -321,6 +330,7 @@ def run(func):
             except HostsUpdatedInterrupt as e:
                 if recovering is None:
                     recovering = ("host_update", time.monotonic())
+                _goodput.note_reset()
                 _metrics.record_elastic_event("host_update")
                 hvd_logging.info("host set updated; re-initializing")
                 reset_required = True

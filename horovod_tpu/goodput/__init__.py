@@ -1,0 +1,54 @@
+"""hvdgoodput: job-level goodput/badput accounting + durable run history.
+
+Every other observability plane in this tree (metrics, flight, step
+profiler, telemetry, tracing/SLO) answers *within-run* questions and
+evaporates with the process. This package answers the two questions that
+survive the run:
+
+1. **What fraction of this job's wall-clock was productive training, and
+   where did the rest go?** — :mod:`horovod_tpu.goodput.ledger`, a
+   per-rank state machine that decomposes total wall time into
+   ``productive_compute`` plus seven named badput categories, with the
+   repo's signature conservation guarantee: the categories sum to the
+   measured wall within 1% (asserted, like the byte-accounting
+   cross-checks in the dispatch tier).
+
+2. **How does this run compare to every run before it?** —
+   :mod:`horovod_tpu.goodput.history`, an append-only per-run JSONL
+   journal flushed line-by-line (the ``HVD_BENCH_PROGRESS_FILE``
+   discipline) so a SIGKILLed run still leaves evidence, and
+   :mod:`horovod_tpu.goodput.report` (``python -m
+   horovod_tpu.goodput.report``) to render one run and diff/regress
+   across runs with the same robust-z the step profiler uses for
+   straggler naming.
+
+Knobs: ``HOROVOD_GOODPUT`` (default on), ``HOROVOD_GOODPUT_DIR``
+(per-rank shutdown summaries), ``HOROVOD_RUN_HISTORY_DIR`` (the durable
+journal; empty = off). Like every observability plane here, goodput must
+never fail the job: all module-level entry points are armed-gated and
+fail-soft.
+"""
+
+from horovod_tpu.goodput.ledger import (BADPUT_CATEGORIES, CATEGORIES,
+                                        PRODUCTIVE, GoodputLedger,
+                                        ServingGoodput, armed, configure,
+                                        get_ledger, note_commit,
+                                        note_recovery, note_reset,
+                                        note_straggler, note_unwedged,
+                                        note_wedge, on_step_boundary,
+                                        reset, serving_snapshot, set_trial,
+                                        shutdown, snapshot, wedge_from_rows)
+from horovod_tpu.goodput.history import (RunJournal, config_fingerprint,
+                                         get_journal, journal_append,
+                                         journal_configure, read_journal,
+                                         read_runs)
+
+__all__ = [
+    "BADPUT_CATEGORIES", "CATEGORIES", "PRODUCTIVE", "GoodputLedger",
+    "ServingGoodput", "RunJournal", "armed", "configure",
+    "config_fingerprint", "get_journal", "get_ledger", "journal_append",
+    "journal_configure", "note_commit", "note_recovery", "note_reset",
+    "note_straggler", "note_unwedged", "note_wedge", "on_step_boundary",
+    "read_journal", "read_runs", "reset", "serving_snapshot", "set_trial",
+    "shutdown", "snapshot", "wedge_from_rows",
+]

@@ -1,0 +1,5 @@
+"""``python -m horovod_tpu.goodput`` == ``... goodput.report``."""
+
+from horovod_tpu.goodput.report import main
+
+raise SystemExit(main())

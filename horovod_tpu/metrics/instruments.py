@@ -190,6 +190,17 @@ STALL_EVENTS = REGISTRY.counter(
     "stall_events_total",
     "Stall-inspector findings (kind=warning|shutdown).",
     ("kind",))
+GOODPUT_SECONDS = REGISTRY.counter(
+    "goodput_seconds_total",
+    "Wall-clock seconds decomposed by the goodput ledger "
+    "(horovod_tpu/goodput): category=productive_compute plus the named "
+    "badput categories (init_compile, rendezvous_recovery, "
+    "checkpoint_commit, straggler_wait, cross_wait_comm, autopilot_trial, "
+    "wedge_idle). Conservation contract: the categories sum to the "
+    "rank's measured wall time within 1%, so "
+    "rate(goodput_seconds_total{category='productive_compute'}) over the "
+    "sum of all categories IS the job's goodput ratio.",
+    ("category",))
 KV_CLIENT_RETRIES = REGISTRY.counter(
     "kv_client_retries_total",
     "Runner HTTP-KV client attempts that failed transiently and were "
@@ -502,6 +513,14 @@ def record_elastic_recovery(cause, seconds):
     if not _enabled:
         return
     ELASTIC_RECOVERY.labels(cause).observe(seconds)
+
+
+def record_goodput_seconds(category, seconds):
+    """Delta export from the goodput ledger (horovod_tpu/goodput/ledger
+    throttles and computes the per-category deltas; counters only grow)."""
+    if not _enabled:
+        return
+    GOODPUT_SECONDS.labels(category).inc(float(seconds))
 
 
 def record_kv_retry():

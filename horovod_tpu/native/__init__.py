@@ -20,6 +20,33 @@ _lib = None
 _tried = False
 
 
+def _stale():
+    """True when libhvdtpu.so predates any native source (or is absent).
+
+    The .so is a gitignored build artifact, so a checkout that updates
+    src/ keeps whatever binary an earlier build left behind — and ctypes
+    would happily load it. That was the root of the long-tailed
+    "escapes_json" timeline flake: a stale writer built before the
+    JsonEscape backslash case shipped kept serving whichever session
+    (and whichever test order) imported native first, until an unrelated
+    missing-symbol AttributeError forced a rebuild. Compare mtimes like
+    make would and rebuild eagerly instead."""
+    try:
+        built = os.path.getmtime(_LIB_PATH)
+    except OSError:
+        return True
+    srcs = [os.path.join(_HERE, "Makefile")]
+    src_dir = os.path.join(_HERE, "src")
+    try:
+        srcs += [os.path.join(src_dir, f) for f in os.listdir(src_dir)]
+    except OSError:
+        pass
+    try:
+        return any(os.path.getmtime(s) > built for s in srcs)
+    except OSError:
+        return True
+
+
 def _build():
     # Build into a process-private target and publish with an atomic rename,
     # so concurrent first-use builds (multiple workers, shared NFS checkout)
@@ -95,7 +122,7 @@ def get_lib():
         if _lib is not None or _tried:
             return _lib
         _tried = True
-        if not os.path.exists(_LIB_PATH) and not _build():
+        if _stale() and not _build() and not os.path.exists(_LIB_PATH):
             return None
         try:
             _lib = _bind(ctypes.CDLL(_LIB_PATH))

@@ -415,6 +415,14 @@ def on_step(step):
         _step_side_work(step, rec)
     except Exception:  # noqa: BLE001
         pass
+    # Hand the boundary to the goodput ledger (its own armed gate +
+    # fail-soft wrapper): a closed window's attribution decomposes into
+    # productive vs badput; the first open ends the init_compile phase.
+    try:
+        from horovod_tpu.goodput import ledger as _goodput
+        _goodput.on_step_boundary(rec, step=step)
+    except Exception:  # noqa: BLE001
+        pass
 
 
 def _step_side_work(step, rec):

@@ -91,6 +91,17 @@ def _emit(finding):
         _metrics.record_profiler_event(finding["kind"])
     except Exception:  # noqa: BLE001
         pass
+    if finding["kind"] == "straggler":
+        # The goodput report's victim naming: this is the cross-rank
+        # COMPARATIVE verdict (a straggler stalls in its own dispatch
+        # path), stronger evidence than each rank's self-relative
+        # straggler_wait excess — under a synchronous collective every
+        # rank books wait, but only the victim is named here.
+        try:
+            from horovod_tpu.goodput import ledger as _goodput
+            _goodput.note_straggler(finding.get("rank"))
+        except Exception:  # noqa: BLE001
+            pass
     try:
         from horovod_tpu.flight import recorder as _flight
         if _flight.armed:

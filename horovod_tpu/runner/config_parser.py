@@ -86,6 +86,10 @@ _ARG_ENV_MAP = [
     ("trace", "HOROVOD_TRACE", lambda v: "1" if v else None),
     ("no_trace", "HOROVOD_TRACE", lambda v: "0" if v else None),
     ("trace_dir", "HOROVOD_TRACE_DIR", str),
+    ("goodput", "HOROVOD_GOODPUT", lambda v: "1" if v else None),
+    ("no_goodput", "HOROVOD_GOODPUT", lambda v: "0" if v else None),
+    ("goodput_dir", "HOROVOD_GOODPUT_DIR", str),
+    ("run_history_dir", "HOROVOD_RUN_HISTORY_DIR", str),
 ]
 
 

@@ -233,6 +233,27 @@ def parse_args(argv=None):
                          help="Directory for per-rank trace shard dumps "
                               "(HOROVOD_TRACE_DIR).")
 
+    goodput = p.add_argument_group("goodput accounting")
+    goodput.add_argument("--goodput", action="store_true", dest="goodput",
+                         default=False,
+                         help="Arm job goodput/badput accounting "
+                              "(HOROVOD_GOODPUT=1; the default — this "
+                              "flag overrides an ambient env opt-out). "
+                              "See docs/observability.md.")
+    goodput.add_argument("--no-goodput", action="store_true",
+                         dest="no_goodput",
+                         help="Disarm goodput accounting "
+                              "(HOROVOD_GOODPUT=0).")
+    goodput.add_argument("--goodput-dir", dest="goodput_dir",
+                         help="Directory for per-rank goodput summary "
+                              "dumps at exit (HOROVOD_GOODPUT_DIR).")
+    goodput.add_argument("--run-history-dir", dest="run_history_dir",
+                         help="Durable cross-run history root "
+                              "(HOROVOD_RUN_HISTORY_DIR): rank 0 appends "
+                              "a per-run JSONL journal here; render and "
+                              "regress with `python -m "
+                              "horovod_tpu.goodput.report`.")
+
     timeline = p.add_argument_group("timeline")
     timeline.add_argument("--timeline-filename", dest="timeline_filename")
     timeline.add_argument("--no-timeline-mark-cycles", action="store_false",
@@ -536,6 +557,9 @@ def build_worker_env(base_env, slot_infos_for_host, coordinator_addr,
                 "HOROVOD_KV_RETRY_BACKOFF_MAX_MS",
                 "HOROVOD_SLO_TTFT_P99_MS", "HOROVOD_SLO_TPS",
                 "HOROVOD_SLO_WINDOW_S",
+                "HOROVOD_GOODPUT", "HOROVOD_GOODPUT_DIR",
+                "HOROVOD_GOODPUT_JOURNAL_S", "HOROVOD_RUN_HISTORY_DIR",
+                "HOROVOD_RUN_ID",
                 "HOROVOD_METRICS", "HOROVOD_METRICS_PORT",
                 "HOROVOD_METRICS_ADDR", "HOROVOD_METRICS_PREFIX"):
         if os.environ.get(var):

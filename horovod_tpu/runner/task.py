@@ -93,6 +93,15 @@ def main():
             # cleanly (its result would be wiped and the new membership
             # would wait forever on this exited rank).
             client.put("elastic", "finished", b"1")
+    # Finalize the goodput run journal on the clean-exit path, while the
+    # telemetry agent is still alive to contribute the cluster view.
+    # Relying on atexit is not enough: compat elastic workers end in
+    # os._exit (see _compat_exit), which skips atexit entirely.
+    try:
+        from horovod_tpu.goodput import ledger as _goodput
+        _goodput.shutdown()
+    except Exception:
+        pass
     hvd.shutdown()
     _orderly_distributed_exit()
 

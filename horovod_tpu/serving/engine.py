@@ -343,6 +343,18 @@ class ServingEngine:
         _metrics.record_serving_step(dt, len(active), self.num_slots,
                                      committed)
         _slo.observe_tokens(committed)
+        # Serving goodput: this step's token-seconds count as goodput iff
+        # every declared SLO objective is within budget right now (burn
+        # <= 1); with no declared objectives all traffic is in-SLO. The
+        # burn read follows observe_tokens so the step judges itself.
+        try:
+            from horovod_tpu.goodput import ledger as _goodput
+            burns = _slo.burn_rates()
+            _goodput.record_serving_step(
+                dt, committed,
+                in_slo=all(b <= 1.0 for b in burns.values()))
+        except Exception:  # noqa: BLE001
+            pass
         self._step_count += 1
         if self.mark_steps:
             _flight.step_marker(self._step_count)
@@ -605,4 +617,11 @@ class ServingEngine:
         # {} unless SLO objectives are declared (HOROVOD_SLO_*); the
         # read also refreshes the slo_burn_rate{objective} gauges.
         frame["slo"] = _slo.burn_rates()
+        try:
+            from horovod_tpu.goodput import ledger as _goodput
+            gp = _goodput.serving_snapshot()
+            if gp.get("steps"):
+                frame["goodput"] = gp
+        except Exception:  # noqa: BLE001
+            pass
         return frame

@@ -78,6 +78,36 @@ def slice_members(sid, world, num_slices):
     return [r for r in range(world) if r * k // world == sid]
 
 
+def goodput_view(rows):
+    """Job-level goodput aggregate from per-rank health rows: the job
+    ``ratio`` is wall-weighted (a rank that lived longer weighs more),
+    and each beaconing rank keeps its ratio plus the two badput numbers
+    the victim-naming report reads. None until any rank reports."""
+    walls = prod = 0.0
+    ranks = {}
+    for r, row in rows.items():
+        if not row or row.get("goodput_ratio") is None:
+            continue
+        w = float(row.get("goodput_wall_s") or 0.0)
+        ratio = float(row["goodput_ratio"])
+        walls += w
+        prod += ratio * w
+        ranks[str(r)] = {
+            "ratio": round(ratio, 6),
+            "straggler_wait_s": round(
+                float(row.get("straggler_wait_s") or 0.0), 6),
+            "rendezvous_recovery_s": round(
+                float(row.get("rendezvous_recovery_s") or 0.0), 6),
+        }
+    if not ranks:
+        return None
+    return {
+        "ratio": round(prod / walls, 6) if walls > 0 else None,
+        "wall_s": round(walls, 6),
+        "ranks": ranks,
+    }
+
+
 class TelemetryAgent:
     """One process's member of the aggregation plane. ``kv`` is any
     object with the :class:`horovod_tpu.runner.http_kv.KVStoreClient`
@@ -438,6 +468,17 @@ class TelemetryAgent:
         states, progress = _health.classify(rows, now, self.thresholds)
         self._record_transitions({str(r): s for r, s in states.items()},
                                  now, summaries)
+        # Feed this rank's own stall verdict back into its goodput
+        # ledger: a "stalled" classification flips the phase to
+        # wedge_idle, "healthy" flips it back (a completed step always
+        # overrides both — see goodput/ledger.note_wedge).
+        try:
+            from horovod_tpu.goodput import ledger as _goodput
+            _goodput.wedge_from_rows(
+                [{"rank": r, "state": s["state"]}
+                 for r, s in states.items()], self.rank)
+        except Exception:  # noqa: BLE001
+            pass
         return {
             "v": 1, "t": round(now, 6), "gen": self.gen,
             "leader": self.rank, "leader_slice": self.slice,
@@ -448,6 +489,7 @@ class TelemetryAgent:
             "health": {str(r): states[r] for r in sorted(states)},
             "counts": _health.counts(states),
             "progress": progress,
+            "goodput": goodput_view(rows),
             "events": list(self._events),
         }
 
@@ -598,6 +640,7 @@ def _local_view():
         "health": {str(r): s for r, s in states.items()},
         "counts": _health.counts(states),
         "progress": progress,
+        "goodput": goodput_view({d["rank"]: row}),
         "events": [],
     }
 

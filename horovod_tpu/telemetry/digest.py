@@ -68,6 +68,13 @@ def collect(rank=None, include_metrics=None):
         d["findings"] = watchdog.findings(last=4)
     except Exception:  # noqa: BLE001
         pass
+    try:
+        from horovod_tpu.goodput import ledger as goodput_ledger
+        snap = goodput_ledger.snapshot()
+        if snap.get("enabled"):
+            d["goodput"] = snap
+    except Exception:  # noqa: BLE001
+        pass
     if include_metrics is None:
         include_metrics = _env_bool("HOROVOD_TELEMETRY_METRICS", True)
     if include_metrics:
@@ -87,7 +94,7 @@ def health_row(digest_dict):
     keeping exactly the health-model inputs + identity."""
     prof = digest_dict.get("profile") or {}
     flight = digest_dict.get("flight") or {}
-    return {
+    row = {
         "t": digest_dict.get("t"),
         "host": digest_dict.get("host"),
         "pid": digest_dict.get("pid"),
@@ -102,3 +109,14 @@ def health_row(digest_dict):
         "max_seq": flight.get("max_seq") or {},
         "findings": digest_dict.get("findings") or [],
     }
+    gp = digest_dict.get("goodput") or {}
+    if gp.get("enabled"):
+        cats = gp.get("categories") or {}
+        row["goodput_ratio"] = gp.get("goodput_ratio")
+        row["goodput_wall_s"] = gp.get("wall_s")
+        # The two per-rank badput numbers the victim-naming report (and
+        # the chaos-soak brackets) need; the full decomposition stays in
+        # the digest, not every row.
+        row["straggler_wait_s"] = cats.get("straggler_wait", 0.0)
+        row["rendezvous_recovery_s"] = cats.get("rendezvous_recovery", 0.0)
+    return row

@@ -124,6 +124,16 @@ def render(view, now=None):
             f"steps: median={progress['median_step']} "
             f"min={progress.get('min_step')} "
             f"max={progress.get('max_step')}")
+    gp = view.get("goodput") or {}
+    if gp.get("ratio") is not None:
+        worst = None
+        for r, d in (gp.get("ranks") or {}).items():
+            ratio = (d or {}).get("ratio")
+            if ratio is not None and (worst is None or ratio < worst[1]):
+                worst = (r, ratio)
+        lines.append(
+            f"goodput: {gp['ratio']:.1%}"
+            + (f"  worst=r{worst[0]} ({worst[1]:.1%})" if worst else ""))
     lines.append("health: " + "  ".join(
         f"{s}={counts.get(s, 0)}" for s in
         ("healthy", "straggling", "desynced", "stalled", "dead")))

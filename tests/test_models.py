@@ -687,6 +687,16 @@ class TestGenerate:
 
         results = {}
         for remat in (False, True):
+            # Equalize compiler state between the two builds: under the
+            # full suite the remat=False executable can be a compile-
+            # cache hit left by an earlier test (fused/scheduled under
+            # different context) while remat=True compiles fresh, and
+            # the re-associated fp32 reductions then disagree by more
+            # than they ever do in isolation (the tier-1 "remat llama"
+            # load-order flake). Clearing before EACH build gives both
+            # compilations identical cache state, which makes the
+            # comparison order-independent.
+            jax.clear_caches()
             model, args, pick = build(remat)
             variables = model.init(jax.random.PRNGKey(0), *args)
 
@@ -700,17 +710,14 @@ class TestGenerate:
             results[remat] = (float(loss), grads)
         np.testing.assert_allclose(results[False][0], results[True][0],
                                    rtol=1e-6)
-        # Gradient tolerance: remat recomputes the forward pass, and XLA
-        # is free to re-associate those fp32 reductions — near-zero grads
-        # then wobble past rtol=1e-5/atol=1e-6 depending on what the
-        # full-suite compile cache scheduled first (the documented
-        # tier-1 "remat llama" load-order flake, green in isolation).
-        # The check guards "remat changes nothing numerically", not
-        # bit-exactness, so the bound is set just above reduction-order
-        # noise.
+        # Gradient tolerance: remat recomputes the forward pass and XLA
+        # may re-associate fp32 reductions, so exact bit-equality is not
+        # guaranteed — but with the compile cache equalized above, both
+        # builds schedule identically and the original tight bound holds
+        # under the full suite too.
         jax.tree_util.tree_map(
             lambda a, b: np.testing.assert_allclose(
-                np.asarray(a), np.asarray(b), rtol=5e-5, atol=5e-6),
+                np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6),
             results[False][1], results[True][1])
 
     @pytest.mark.parametrize("family", ["gpt", "llama"])
